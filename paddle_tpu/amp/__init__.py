@@ -1,0 +1,15 @@
+"""paddle_tpu.amp — automatic mixed precision.
+
+Reference analog: paddle.amp (amp/auto_cast.py:20 auto_cast,
+amp/grad_scaler.py:20 GradScaler; C++ white/black lists
+imperative/amp_auto_cast.cc:130; amp ops operators/amp/
+check_finite_and_unscale_op, update_loss_scaling_op).
+
+TPU-native: bf16 is the native reduced precision — no loss scaling needed
+(bf16 has f32's exponent range).  auto_cast level O1 casts white-list op
+inputs (matmul/conv) to the low dtype; GradScaler reproduces the reference's
+dynamic loss-scaling state machine exactly for fp16 parity, but becomes a
+transparent no-op scale=1 when dtype is bfloat16 — the recommended TPU mode.
+"""
+from .auto_cast import amp_guard, auto_cast, decorate, white_list, black_list  # noqa: F401
+from .grad_scaler import AmpScaler, GradScaler  # noqa: F401

@@ -1,0 +1,101 @@
+"""auto_cast context (reference: amp/auto_cast.py:20; op lists
+contrib/mixed_precision/fp16_lists.py).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..framework import dtype as _dt
+
+# reference fp16_lists.py white/black lists, trimmed to ops that exist here
+white_list = {
+    "conv2d", "conv1d", "conv3d", "matmul_v2", "mul", "linear", "einsum",
+    "conv2d_transpose", "lstm_scan", "gru_scan", "rnn_tanh_scan",
+    "flash_attention", "scaled_dot_product_attention", "mha_weights",
+}
+black_list = {
+    "exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+    "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+    "cross_entropy", "bce_loss", "layer_norm", "batch_norm", "reduce_sum",
+    "reduce_mean", "logsumexp", "p_norm",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = None
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+class auto_cast:
+    """with paddle.amp.auto_cast(): — low-precision autocast region."""
+
+    def __init__(self, enable=True, custom_white_list=None, custom_black_list=None,
+                 level="O1", dtype="bfloat16"):
+        self._enable = enable
+        self._white = set(custom_white_list or ())
+        self._black = set(custom_black_list or ())
+        self._level = level
+        self._dtype = _dt.convert_dtype(dtype)
+
+    def __enter__(self):
+        self._prev = (_state.enabled, _state.dtype, _state.level,
+                      _state.custom_white, _state.custom_black)
+        _state.enabled = self._enable
+        _state.dtype = self._dtype
+        _state.level = self._level
+        _state.custom_white = self._white
+        _state.custom_black = self._black
+        return self
+
+    def __exit__(self, *exc):
+        (_state.enabled, _state.dtype, _state.level,
+         _state.custom_white, _state.custom_black) = self._prev
+        return False
+
+
+amp_guard = auto_cast
+
+
+def should_cast(op_name: str) -> Optional[object]:
+    """Called by the dispatcher: returns the target dtype for this op's float
+    inputs, or None (imperative/amp_auto_cast.cc:130 AutoCastInputs analog)."""
+    if not _state.enabled:
+        return None
+    wl = (white_list | _state.custom_white) - _state.custom_black
+    if _state.level == "O2":
+        bl = black_list | _state.custom_black
+        if op_name in bl:
+            return _dt.float32
+        return _state.dtype
+    if op_name in wl:
+        return _state.dtype
+    if op_name in (black_list | _state.custom_black):
+        return _dt.float32
+    return None
+
+
+def decorate(models=None, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None, **kw):
+    """O2 decoration: cast model params to the low dtype (reference
+    contrib/mixed_precision/decorator.py:36 OptimizerWithMixedPrecision).
+    On TPU: cast to bf16; optimizer updates accumulate in f32 (multi
+    precision handled inside optimizers)."""
+    if level == "O2" and models is not None:
+        items = models if isinstance(models, (list, tuple)) else [models]
+        for m in items:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models
+    return models, optimizers

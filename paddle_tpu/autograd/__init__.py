@@ -1,0 +1,54 @@
+"""paddle_tpu.autograd — imperative autograd API.
+
+Reference analog: paddle.autograd + imperative engines
+(/root/reference/paddle/fluid/imperative/basic_engine.cc,
+partial_grad_engine.cc).
+"""
+from .tape import (  # noqa: F401
+    GradNode,
+    enable_grad,
+    is_grad_enabled,
+    no_grad,
+    run_backward,
+    set_grad_enabled,
+)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward parity."""
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(list(tensors), grad_tensors, retain_graph=retain_graph)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+):
+    """paddle.grad parity (partial_grad_engine.cc analog), incl. create_graph
+    double-grad: with create_graph the backward walk itself records on the
+    tape, so grad-of-grad works."""
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+    return run_backward(
+        list(outputs),
+        grad_outputs,
+        retain_graph=retain_graph,
+        create_graph=create_graph,
+        inputs=list(inputs),
+        allow_unused=allow_unused,
+    )

@@ -1,0 +1,266 @@
+"""Eager reverse-mode autograd engine.
+
+TPU-native re-design of the reference's dygraph engine
+(/root/reference/paddle/fluid/imperative/basic_engine.cc:39,235,305 and
+gradient_accumulator.cc): instead of recorded grad *OpDescs* executed by a C++
+interpreter, every eager op records a ``jax.vjp`` closure (GradNode).
+``run_backward`` is the dependency-counted queue walk of BasicEngine::Execute,
+with gradient accumulation into leaf ``.grad``, per-tensor hooks
+(imperative/hooks.h analog) and ``create_graph`` double-grad support
+(partial_grad_engine.cc analog) — cotangents flow as Tensors, so recording the
+backward pass itself is just running it with grad mode on.
+
+The jit training path does not use this tape at all: whole-step ``jax.grad``
+under ``jax.jit`` is the performant route; the tape exists for imperative UX
+parity and op-level grad tests.
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict, deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+class GradNode:
+    """One recorded eager op: its vjp closure + edges to producers of its
+    differentiable inputs."""
+
+    __slots__ = ("name", "vjp_fn", "edges", "out_avals", "out_treedef", "id",
+                 "fwd_fn")
+
+    _counter = 0
+
+    def __init__(self, name, vjp_fn, edges, out_avals, out_treedef, fwd_fn=None):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.edges: List[Optional["Edge"]] = edges
+        self.out_avals = out_avals  # list of (shape, dtype) per flat output
+        self.out_treedef = out_treedef
+        self.fwd_fn = fwd_fn  # closed forward (for create_graph double-grad)
+        GradNode._counter += 1
+        self.id = GradNode._counter
+
+    def release(self):
+        self.vjp_fn = None
+        self.fwd_fn = None
+        self.edges = []
+
+
+class Edge:
+    """Connects a node input slot back to the tensor that produced it."""
+
+    __slots__ = ("tensor", "node", "out_index")
+
+    def __init__(self, tensor):
+        self.tensor = tensor
+        self.node = tensor._grad_node
+        self.out_index = tensor._out_index
+
+
+class _GradMode(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_grad_mode = _GradMode()
+
+
+def is_grad_enabled() -> bool:
+    return _grad_mode.enabled
+
+
+def set_grad_enabled(mode: bool):
+    _grad_mode.enabled = bool(mode)
+
+
+class no_grad:
+    """Context manager + decorator (paddle.no_grad parity)."""
+
+    def __enter__(self):
+        self._prev = _grad_mode.enabled
+        _grad_mode.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _grad_mode.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad(no_grad):
+    def __enter__(self):
+        self._prev = _grad_mode.enabled
+        _grad_mode.enabled = True
+        return self
+
+
+def _zeros_like_aval(aval):
+    import jax.numpy as jnp
+
+    shape, dtype = aval
+    return jnp.zeros(shape, dtype)
+
+
+def run_backward(
+    tensors: Sequence,
+    grad_tensors: Optional[Sequence] = None,
+    retain_graph: bool = False,
+    create_graph: bool = False,
+    inputs: Optional[Sequence] = None,
+    allow_unused: bool = False,
+    accumulate: bool = True,
+):
+    """Reverse-mode walk. If ``inputs`` is given, returns their grads as a list
+    (paddle.grad semantics, .grad untouched); otherwise accumulates into leaf
+    ``.grad`` (loss.backward semantics).
+
+    Reference parity: BasicEngine::PrepareDeps (dependency counting) +
+    Execute (ready-queue), basic_engine.cc:235,305.
+    """
+    from ..tensor import Tensor
+    from ..ops import dispatch as _dispatch
+    import jax.numpy as jnp
+    import numpy as np
+
+    tensors = list(tensors)
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+
+    # Seed cotangents.
+    seeds: List[Tuple[Any, Any]] = []  # (root tensor, seed ct)
+    leaf_sink: Dict[int, Any] = {}
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}"
+                )
+            g = Tensor(jnp.ones(t._value.shape, t._value.dtype), stop_gradient=not create_graph)
+        elif not isinstance(g, Tensor):
+            g = Tensor(jnp.asarray(g), stop_gradient=not create_graph)
+        if t._grad_node is not None:
+            seeds.append((t, g))
+        elif not t.stop_gradient:
+            # backward on a leaf: grad is the seed itself
+            leaf_sink[id(t)] = (t, g)
+
+    # Dependency counting over the reachable graph.
+    dep: Dict[GradNode, int] = defaultdict(int)
+    visited = set()
+    stack = [t._grad_node for (t, _) in seeds]
+    nodes_in_graph = []
+    while stack:
+        node = stack.pop()
+        if node in visited:
+            continue
+        visited.add(node)
+        nodes_in_graph.append(node)
+        for edge in node.edges:
+            if edge is not None and edge.node is not None:
+                dep[edge.node] += 1
+                if edge.node not in visited:
+                    stack.append(edge.node)
+
+    pending: Dict[GradNode, Dict[int, Any]] = defaultdict(dict)
+
+    # inputs tracking for paddle.grad
+    want: Dict[int, Any] = {}
+    input_ids = set()
+    if inputs is not None:
+        input_ids = {id(t) for t in inputs}
+
+    def _deliver(tensor, ct):
+        """Cotangent arrived for `tensor` (a Tensor object): hooks, leaf/.grad
+        accumulation, retain_grads, paddle.grad capture."""
+        for hook in tensor._backward_hooks:
+            res = hook(ct)
+            if res is not None:
+                ct = res if isinstance(res, Tensor) else Tensor(jnp.asarray(res))
+        if inputs is not None and id(tensor) in input_ids:
+            prev = want.get(id(tensor))
+            want[id(tensor)] = ct if prev is None else _accum(prev, ct, create_graph)
+        is_leaf = tensor._grad_node is None
+        if (is_leaf and not tensor.stop_gradient) or tensor._retain_grad:
+            if inputs is None or tensor._retain_grad:
+                if accumulate and tensor._grad is not None:
+                    tensor._grad = _accum(tensor._grad, ct, create_graph)
+                else:
+                    tensor._grad = ct
+                if not create_graph:
+                    tensor._grad = tensor._grad.detach()
+                    tensor._grad.stop_gradient = True
+        return ct
+
+    for tid, (t, g) in leaf_sink.items():
+        _deliver(t, g)
+
+    # deliver seeds to the roots themselves (hooks/retain_grads on outputs),
+    # then enqueue into their producing nodes' pending slots
+    for t, g in seeds:
+        g = _deliver(t, g)
+        slot = pending[t._grad_node]
+        cur = slot.get(t._out_index)
+        slot[t._out_index] = g if cur is None else _accum(cur, g, create_graph)
+
+    ready = deque(n for n in nodes_in_graph if dep[n] == 0)
+    processed = set()
+    while ready:
+        node = ready.popleft()
+        if node in processed:
+            continue
+        processed.add(node)
+        cts = pending.pop(node, {})
+        flat_cts = []
+        for i, aval in enumerate(node.out_avals):
+            ct = cts.get(i)
+            if ct is None:
+                ct = Tensor(_zeros_like_aval(aval), stop_gradient=True)
+            flat_cts.append(ct)
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "trying to backward through the graph a second time after it "
+                "was freed; pass retain_graph=True to backward()"
+            )
+        in_cts = _dispatch.apply_vjp(node, flat_cts, create_graph)
+        for edge, ct in zip(node.edges, in_cts):
+            if edge is None or ct is None:
+                continue
+            ct = _deliver(edge.tensor, ct)
+            if edge.node is not None:
+                slot = pending[edge.node]
+                prev = slot.get(edge.out_index)
+                slot[edge.out_index] = ct if prev is None else _accum(prev, ct, create_graph)
+                dep[edge.node] -= 1
+                if dep[edge.node] == 0:
+                    ready.append(edge.node)
+        if not retain_graph:
+            node.release()
+
+    if inputs is not None:
+        out = []
+        for t in inputs:
+            g = want.get(id(t))
+            if g is None and not allow_unused:
+                raise RuntimeError(
+                    "one of the input tensors was not used in the graph; set "
+                    "allow_unused=True to return None for it"
+                )
+            out.append(g)
+        return out
+    return None
+
+
+def _accum(a, b, create_graph):
+    from ..ops import dispatch as _dispatch
+
+    return _dispatch.accumulate_grad(a, b, create_graph)

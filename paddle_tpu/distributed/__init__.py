@@ -1,0 +1,38 @@
+"""paddle_tpu.distributed — collectives, meshes, parallel training.
+
+Reference analog: paddle.distributed (§2 SURVEY — collective.py, parallel.py,
+fleet/, launch) over NCCL rings; here over ICI/DCN via jax mesh collectives.
+"""
+from . import fleet  # noqa: F401
+from .collective import (  # noqa: F401
+    Group,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    alltoall,
+    barrier,
+    broadcast,
+    get_group,
+    is_initialized,
+    new_group,
+    p2p_shift,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    split,
+    wait,
+)
+from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env  # noqa: F401
+from .mesh import get_mesh, init_mesh, set_mesh, shard_array, sharding, spec  # noqa: F401
+from .parallel import DataParallel, make_sharded_train_step, sync_params_buffers  # noqa: F401
+from .parallel_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .spawn import spawn  # noqa: F401
+from . import launch  # noqa: F401

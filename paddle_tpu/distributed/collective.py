@@ -1,0 +1,330 @@
+"""Collective communication API.
+
+Reference analog: python/paddle/distributed/collective.py (all_reduce :365,
+new_group :163, broadcast/scatter/all_gather/…) over the C++ collective ops
+(operators/collective/: c_allreduce_sum, c_broadcast, c_allgather,
+c_reducescatter, send_v2/recv_v2) and NCCL rings.
+
+TPU-native semantics: a Group is a named mesh axis (ring_id ↔ axis name).
+Inside traced SPMD code (shard_map/pjit) these lower to jax.lax collectives
+over ICI.  Called eagerly on replicated single-process tensors they are
+identities (world of one), matching the reference's behavior for nranks=1 —
+the multi-chip path is always the traced one on TPU (there is no eager
+cross-chip dispatch to hide latency in; XLA overlaps collectives instead,
+subsuming c_sync_*/c_wait_* stream ops).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops._helpers import to_tensor_like
+from ..ops.dispatch import apply
+from ..tensor import Tensor
+from .env import get_rank, get_world_size
+from .mesh import get_mesh, mesh_axis_size
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A communication group = a mesh axis (ring_id analog)."""
+
+    _next_id = 0
+
+    def __init__(self, ranks=None, axis_name: str = "dp", id: Optional[int] = None):
+        if id is None:
+            Group._next_id += 1
+            id = Group._next_id
+        self.id = id
+        self.axis_name = axis_name
+        self._ranks = ranks
+
+    @property
+    def nranks(self):
+        if self._ranks is not None:
+            return len(self._ranks)
+        return mesh_axis_size(self.axis_name) * max(get_world_size(), 1)
+
+    @property
+    def ranks(self):
+        return self._ranks if self._ranks is not None else list(range(self.nranks))
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axis={self.axis_name!r}, nranks={self.nranks})"
+
+
+_default_group = Group(axis_name="dp", id=0)
+_groups = {0: _default_group}
+
+
+def _get_default_group():
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, axis_name=None):
+    g = Group(ranks=ranks, axis_name=axis_name or "dp")
+    _groups[g.id] = g
+    return g
+
+
+def get_group(gid=0):
+    return _groups.get(gid, _default_group)
+
+
+def is_initialized():
+    return True
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _axis_in_trace(axis_name) -> bool:
+    """True if axis_name is bound in the current trace (inside shard_map)."""
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except Exception:
+        return False
+
+
+def _reduce_fn(op):
+    return {
+        ReduceOp.SUM: jax.lax.psum,
+        ReduceOp.MAX: jax.lax.pmax,
+        ReduceOp.MIN: jax.lax.pmin,
+        ReduceOp.AVG: lambda v, a: jax.lax.pmean(v, a),
+        ReduceOp.PROD: lambda v, a: jnp.exp(jax.lax.psum(jnp.log(v), a)),
+    }[op]
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place allreduce (reference c_allreduce_sum, collective.py:365)."""
+    group = group or _default_group
+    t = to_tensor_like(tensor)
+    if _is_traced(t._value):
+        out = apply("c_allreduce", lambda v: _reduce_fn(op)(v, group.axis_name), t)
+        if isinstance(tensor, Tensor):
+            tensor._replace_from(out)
+            return tensor
+        return out
+    # eager: single participant → identity
+    return tensor
+
+
+def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
+    group = group or _default_group
+    t = to_tensor_like(tensor)
+    if _is_traced(t._value):
+        def f(v):
+            red = _reduce_fn(op)(v, group.axis_name)
+            idx = jax.lax.axis_index(group.axis_name)
+            return jnp.where(idx == dst, red, v)
+
+        out = apply("c_reduce", f, t)
+        if isinstance(tensor, Tensor):
+            tensor._replace_from(out)
+            return tensor
+        return out
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    """reference c_allgather: gather shards from every rank."""
+    group = group or _default_group
+    t = to_tensor_like(tensor)
+    if _is_traced(t._value):
+        out = apply(
+            "c_allgather",
+            lambda v: jax.lax.all_gather(v, group.axis_name, axis=0, tiled=False),
+            t,
+        )
+        if tensor_list is not None and isinstance(tensor_list, list):
+            n = group.nranks if group._ranks is not None else mesh_axis_size(group.axis_name)
+            for i in range(out.shape[0]):
+                tensor_list.append(out[i])
+            return None
+        return out
+    if tensor_list is not None and isinstance(tensor_list, list):
+        tensor_list.append(t)
+        return None
+    return t
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)
+
+
+def broadcast(tensor, src, group=None, sync_op=True):
+    group = group or _default_group
+    t = to_tensor_like(tensor)
+    if _is_traced(t._value):
+        def f(v):
+            # select src's shard on every member: gather then index
+            gathered = jax.lax.all_gather(v, group.axis_name, axis=0)
+            return gathered[src]
+
+        out = apply("c_broadcast", f, t)
+        if isinstance(tensor, Tensor):
+            tensor._replace_from(out)
+            return tensor
+        return out
+    return tensor
+
+
+def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    group = group or _default_group
+    inp = tensor_list_or_input
+    if isinstance(inp, (list, tuple)):
+        from ..ops.manipulation import concat
+
+        inp = concat(list(inp), axis=0)
+    t = to_tensor_like(inp)
+    if _is_traced(t._value):
+        def f(v):
+            return jax.lax.psum_scatter(v, group.axis_name, scatter_dimension=0,
+                                        tiled=True)
+
+        out = apply("c_reducescatter", f, t)
+        if isinstance(tensor, Tensor):
+            tensor._replace_from(out)
+            return tensor
+        return out
+    if isinstance(tensor, Tensor):
+        tensor._replace_from(t)
+        return tensor
+    return t
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    group = group or _default_group
+    if tensor_list:
+        from ..ops.manipulation import stack
+
+        stacked = stack(list(tensor_list), axis=0)
+        t = to_tensor_like(stacked)
+        if _is_traced(t._value):
+            def f(v):
+                idx = jax.lax.axis_index(group.axis_name)
+                return v[idx]
+
+            out = apply("c_scatter", f, t)
+            if isinstance(tensor, Tensor):
+                tensor._replace_from(out)
+                return tensor
+            return out
+        out = tensor_list[0]
+        if isinstance(tensor, Tensor):
+            tensor._replace_from(to_tensor_like(out))
+            return tensor
+        return out
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    """reference alltoall: exchange the i-th shard with rank i."""
+    group = group or _default_group
+    from ..ops.manipulation import stack
+
+    if isinstance(in_tensor_list, (list, tuple)):
+        x = stack(list(in_tensor_list), axis=0)
+    else:
+        x = to_tensor_like(in_tensor_list)
+    if _is_traced(x._value):
+        out = apply(
+            "alltoall",
+            lambda v: jax.lax.all_to_all(v, group.axis_name, split_axis=0,
+                                         concat_axis=0, tiled=False),
+            x,
+        )
+        if out_tensor_list is not None:
+            for i in range(out.shape[0]):
+                out_tensor_list.append(out[i])
+            return None
+        return out
+    if out_tensor_list is not None:
+        for t in (in_tensor_list if isinstance(in_tensor_list, (list, tuple)) else [x]):
+            out_tensor_list.append(to_tensor_like(t))
+        return None
+    return x
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """p2p send (reference send_v2). Traced: ppermute pair; eager: no-op."""
+    group = group or _default_group
+    t = to_tensor_like(tensor)
+    if _is_traced(t._value):
+        n = mesh_axis_size(group.axis_name)
+        src = get_rank()
+        out = apply(
+            "send_v2",
+            lambda v: jax.lax.ppermute(v, group.axis_name, [(i, dst) for i in range(n)]),
+            t,
+        )
+        return out
+    return None
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return tensor
+
+
+def p2p_shift(tensor, group=None, shift=1):
+    """Ring shift: every member passes its value to (rank+shift) — the
+    building block of ring attention / pipeline p2p (ppermute over ICI)."""
+    group = group or _default_group
+    t = to_tensor_like(tensor)
+    n = mesh_axis_size(group.axis_name)
+    if _is_traced(t._value):
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        return apply("ppermute",
+                     lambda v: jax.lax.ppermute(v, group.axis_name, perm), t)
+    return t
+
+
+def barrier(group=None):
+    """reference barrier_op: eager = device sync."""
+    jax.effects_barrier()
+    try:
+        jax.block_until_ready(jnp.zeros(()))
+    except Exception:
+        pass
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """reference c_wait_*: XLA schedules; block for API parity."""
+    t = to_tensor_like(tensor)
+    if not _is_traced(t._value):
+        jax.block_until_ready(t._value)
+    return tensor
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Tensor-parallel building block (reference collective.py:811
+    paddle.distributed.split: parallel embedding / row- / column-parallel
+    linear). See paddle_tpu.distributed.parallel_layers for the layer forms —
+    this functional form routes there."""
+    from .parallel_layers import split as _split
+
+    return _split(x, size, operation, axis=axis, num_partitions=num_partitions,
+                  gather_out=gather_out, weight_attr=weight_attr,
+                  bias_attr=bias_attr, name=name)

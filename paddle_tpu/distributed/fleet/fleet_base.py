@@ -1,0 +1,122 @@
+"""Fleet facade (reference: fleet/base/fleet_base.py — init :130,
+distributed_optimizer :598, distributed_model :649, minimize :1076).
+
+The reference's meta-optimizer stack rewrites Programs; here each enabled
+strategy wraps the training objects with its TPU mechanism (see
+meta_optimizers.py).  fleet.distributed_model / distributed_optimizer return
+wrapped objects whose jitted step realizes the whole enabled stack.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...optimizer.optimizer import Optimizer
+from ..env import get_rank, get_world_size, init_parallel_env
+from .meta_optimizers import apply_meta_optimizers
+from .role_maker import PaddleCloudRoleMaker, RoleMakerBase
+from .strategy import DistributedStrategy
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker: Optional[RoleMakerBase] = None
+        self._strategy: Optional[DistributedStrategy] = None
+        self._is_initialized = False
+        self._user_defined_optimizer = None
+
+    def init(self, role_maker=None, is_collective=False, strategy=None):
+        if role_maker is None:
+            role_maker = PaddleCloudRoleMaker(is_collective=is_collective)
+        self._role_maker = role_maker
+        self._strategy = strategy or DistributedStrategy()
+        init_parallel_env()
+        self._is_initialized = True
+        return self
+
+    # --- identity ----------------------------------------------------------
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def is_worker(self):
+        return self._role_maker.is_worker()
+
+    def is_server(self):
+        return self._role_maker.is_server()
+
+    def server_num(self):
+        return self._role_maker.server_num()
+
+    def server_index(self):
+        return self._role_maker.server_index()
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._role_maker.get_trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def server_endpoints(self, to_string=False):
+        eps = self._role_maker.get_pserver_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def barrier_worker(self):
+        from ..collective import barrier
+
+        barrier()
+
+    # --- training objects --------------------------------------------------
+    def distributed_optimizer(self, optimizer: Optimizer, strategy=None):
+        if strategy is not None:
+            self._strategy = strategy
+        self._user_defined_optimizer = optimizer
+        return apply_meta_optimizers(self, optimizer, self._strategy)
+
+    def distributed_model(self, model):
+        from ..parallel import DataParallel
+
+        if get_world_size() > 1:
+            return DataParallel(model)
+        return model
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        opt = self._user_defined_optimizer
+        return opt.minimize(loss)
+
+    # --- checkpoint --------------------------------------------------------
+    def save_persistables(self, executor=None, dirname=None, main_program=None,
+                          mode=0):
+        from ...framework_io import save
+
+        if hasattr(executor, "state_dict"):
+            save(executor.state_dict(), dirname)
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None, export_for_deployment=True):
+        raise NotImplementedError("use paddle_tpu.jit.save for inference export")
+
+    @property
+    def util(self):
+        return _UtilBase()
+
+
+class _UtilBase:
+    def all_reduce(self, input, mode="sum"):
+        return input
+
+    def barrier(self):
+        from ..collective import barrier
+
+        barrier()
+
+    def get_file_shard(self, files):
+        n = get_world_size()
+        r = get_rank()
+        return files[r::n]
+
+
+fleet = Fleet()

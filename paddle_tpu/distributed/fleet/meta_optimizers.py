@@ -1,0 +1,205 @@
+"""Meta-optimizers — the strategy stack.
+
+Reference analog: fleet/meta_optimizers/*.py (factory
+meta_optimizer_factory.py:15-30; strategy_compiler.py): program-rewriting
+passes for AMP, recompute, gradient-merge, LARS/LAMB, localsgd, DGC,
+fp16-allreduce, sharding, pipeline.
+
+TPU-native: instead of rewriting a Program, each enabled strategy wraps the
+optimizer's eager step and/or its functional `fused_step` (used inside jitted
+train steps).  The composition order follows the reference's strategy
+compiler: amp → recompute → {lars|lamb} → {gradient_merge|localsgd} →
+sharding → dp.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ...optimizer.optimizer import Lamb, Lars, Optimizer
+from ...tensor import Tensor
+
+
+class MetaOptimizerBase(Optimizer):
+    def __init__(self, inner: Optimizer):
+        self.inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def step(self):
+        self.inner.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, *a, **k):
+        return self.inner.minimize(loss, *a, **k)
+
+    def state_dict(self):
+        return self.inner.state_dict()
+
+    def set_state_dict(self, d):
+        return self.inner.set_state_dict(d)
+
+    def get_lr(self):
+        return self.inner.get_lr()
+
+    def init_opt_state(self, params):
+        return self.inner.init_opt_state(params)
+
+    def fused_step(self, params, grads, opt_state, step, lr=None, **kw):
+        return self.inner.fused_step(params, grads, opt_state, step, lr=lr, **kw)
+
+
+class GradientMergeOptimizer(MetaOptimizerBase):
+    """k-step gradient accumulation (reference gradient_merge_optimizer.py)."""
+
+    def __init__(self, inner, k_steps=1, avg=True):
+        super().__init__(inner)
+        self.k_steps = k_steps
+        self.avg = avg
+        self._acc = {}
+        self._count = 0
+
+    def step(self):
+        self._count += 1
+        params = self.inner._param_list()
+        for p in params:
+            if p._grad is None:
+                continue
+            key = id(p)
+            self._acc[key] = (p._grad._value if key not in self._acc
+                              else self._acc[key] + p._grad._value)
+        if self._count % self.k_steps != 0:
+            for p in params:
+                p.clear_grad()
+            return
+        for p in params:
+            key = id(p)
+            if key in self._acc:
+                g = self._acc[key]
+                if self.avg:
+                    g = g / self.k_steps
+                p._grad = Tensor(g)
+        self._acc.clear()
+        self.inner.step()
+
+
+class LocalSGDOptimizer(MetaOptimizerBase):
+    """Periodic parameter averaging (reference localsgd_optimizer.py).  On an
+    SPMD mesh the averaging is a psum in the jitted sync step; eagerly (one
+    process) it reduces to the inner step."""
+
+    def __init__(self, inner, k_steps=1, begin_step=1):
+        super().__init__(inner)
+        self.k_steps = k_steps
+        self.begin_step = begin_step
+        self._count = 0
+
+    def step(self):
+        self.inner.step()
+        self._count += 1
+        # cross-replica averaging happens in the sharded step (psum); eager
+        # single-process: nothing to average.
+
+
+class DGCOptimizer(MetaOptimizerBase):
+    """Top-k sparsified gradients with momentum correction (reference
+    dgc_optimizer.py, dgc_momentum_op).  Sparsity applied locally; the dense
+    allreduce is XLA's — communication compression is not expressible in XLA
+    collectives, so this preserves the *convergence* semantics (top-k masking
+    + error feedback) and documents the comms delta."""
+
+    def __init__(self, inner, rampup_begin_step=0, sparsity=0.999):
+        super().__init__(inner)
+        self.rampup_begin_step = rampup_begin_step
+        self.sparsity = sparsity
+        self._count = 0
+        self._residual = {}
+
+    def step(self):
+        self._count += 1
+        if self._count > self.rampup_begin_step:
+            for p in self.inner._param_list():
+                if p._grad is None:
+                    continue
+                g = p._grad._value
+                key = id(p)
+                if key in self._residual:
+                    g = g + self._residual[key]
+                flat = jnp.abs(g.reshape(-1))
+                k = max(1, int(flat.size * (1 - self.sparsity)))
+                thresh = jax.lax.top_k(flat, k)[0][-1]
+                mask = jnp.abs(g) >= thresh
+                self._residual[key] = jnp.where(mask, 0.0, g)
+                p._grad = Tensor(jnp.where(mask, g, 0.0))
+        self.inner.step()
+
+
+class FP16AllreduceOptimizer(MetaOptimizerBase):
+    """Cast grads to fp16/bf16 before reduction (reference
+    fp16_allreduce_optimizer.py). Eagerly casts the stored grad; in sharded
+    steps the grads dtype policy handles it."""
+
+    def step(self):
+        for p in self.inner._param_list():
+            if p._grad is not None:
+                g = p._grad._value
+                p._grad = Tensor(g.astype(jnp.bfloat16).astype(g.dtype))
+        self.inner.step()
+
+
+class RecomputeOptimizer(MetaOptimizerBase):
+    """Marker wrapper (reference recompute_optimizer.py): actual recompute is
+    jax.checkpoint applied to layer blocks — see
+    paddle_tpu.distributed.fleet.recompute.recompute()."""
+
+
+class ShardingOptimizer(MetaOptimizerBase):
+    """ZeRO-style optimizer-state sharding (reference sharding_optimizer.py:69).
+    In the functional path, opt-state arrays are sharded over 'dp' via
+    sharding specs; see fleet/sharding.py for the state-placement helpers."""
+
+    def __init__(self, inner, sharding_degree=None, axis_name="dp"):
+        super().__init__(inner)
+        self.axis_name = axis_name
+
+    def init_opt_state(self, params):
+        state = self.inner.init_opt_state(params)
+        from .sharding import shard_opt_state
+
+        return shard_opt_state(state, axis_name=self.axis_name)
+
+
+def apply_meta_optimizers(fleet, optimizer: Optimizer, strategy) -> Optimizer:
+    """Strategy compiler (reference strategy_compiler.py): wrap in reference
+    order, validating exclusions."""
+    opt = optimizer
+    if strategy.lars and not isinstance(opt, Lars):
+        opt = Lars(learning_rate=opt._lr, parameters=opt._parameters,
+                   **{k: v for k, v in strategy.lars_configs.items()
+                      if k in ("lars_coeff", "lars_weight_decay", "epsilon")})
+    if strategy.lamb and not isinstance(opt, Lamb):
+        opt = Lamb(learning_rate=opt._lr, parameters=opt._parameters,
+                   lamb_weight_decay=strategy.lamb_configs.lamb_weight_decay)
+    if strategy.dgc:
+        opt = DGCOptimizer(opt, strategy.dgc_configs.rampup_begin_step,
+                           strategy.dgc_configs.sparsity[0])
+    if strategy.fp16_allreduce:
+        opt = FP16AllreduceOptimizer(opt)
+    if strategy.gradient_merge:
+        opt = GradientMergeOptimizer(opt, strategy.gradient_merge_configs.k_steps,
+                                     strategy.gradient_merge_configs.avg)
+    if strategy.localsgd:
+        opt = LocalSGDOptimizer(opt, strategy.localsgd_configs.k_steps,
+                                strategy.localsgd_configs.begin_step)
+    if strategy.recompute:
+        opt = RecomputeOptimizer(opt)
+    if strategy.sharding:
+        opt = ShardingOptimizer(opt)
+    return opt

@@ -1,0 +1,45 @@
+"""Activation recompute (reference: backward.py:725
+_append_backward_ops_with_checkpoints_ + RecomputeOptimizer
+fluid/optimizer.py:4818; RecomputeConfig proto:25).
+
+TPU-native: jax.checkpoint (rematerialization) — XLA recomputes the segment
+in backward instead of storing activations, trading FLOPs for HBM exactly
+like the reference's checkpoint list.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...ops.dispatch import apply
+from ...tensor import Tensor
+
+
+def recompute(function, *args, **kwargs):
+    """paddle.distributed.fleet.utils.recompute parity: run `function`
+    under rematerialization."""
+    preserve = kwargs.pop("preserve_rng_state", True)
+
+    from ...jit.functional import tree_unwrap, tree_wrap
+    from ...autograd.tape import no_grad
+
+    def pure(*arr_args):
+        wrapped = [Tensor(a) if not isinstance(a, Tensor) else a
+                   for a in tree_wrap(list(arr_args))]
+        with no_grad():
+            out = function(*wrapped, **kwargs)
+        return tree_unwrap(out)
+
+    ckpt = jax.checkpoint(pure)
+    return apply("recompute", ckpt, *args)
+
+
+class RecomputeSequential:
+    """Wrap a Sequential's blocks so each block is a remat segment."""
+
+    def __init__(self, sequential):
+        self.sequential = sequential
+
+    def __call__(self, x):
+        for layer in self.sequential._sub_layers.values():
+            x = recompute(layer, x)
+        return x

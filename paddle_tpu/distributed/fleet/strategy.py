@@ -1,0 +1,87 @@
+"""DistributedStrategy (reference: fleet/base/distributed_strategy.py:104 over
+distributed_strategy.proto — the full distributed feature matrix).
+
+Same property surface; each toggle maps to a TPU-native mechanism:
+amp→bf16 policy, recompute→jax.checkpoint, sharding→opt-state sharding specs,
+pipeline→microbatched scan schedule, tensor_parallel→'mp' mesh axis,
+dp→'dp' axis. localsgd/dgc are accepted and emulated at the step level.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class _Config(dict):
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError:
+            raise AttributeError(k)
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # feature switches (proto:126-169)
+        self.amp = False
+        self.amp_configs = _Config(
+            init_loss_scaling=32768.0, incr_every_n_steps=1000,
+            decr_every_n_nan_or_inf=2, incr_ratio=2.0, decr_ratio=0.5,
+            use_dynamic_loss_scaling=True, custom_white_list=[],
+            custom_black_list=[], use_pure_fp16=False, use_bf16=True)
+        self.recompute = False
+        self.recompute_configs = _Config(checkpoints=[], enable_offload=False,
+                                         checkpoint_shape=[])
+        self.pipeline = False
+        self.pipeline_configs = _Config(micro_batch_size=1, accumulate_steps=1,
+                                        schedule_mode="1F1B")
+        self.gradient_merge = False
+        self.gradient_merge_configs = _Config(k_steps=1, avg=True)
+        self.sharding = False
+        self.sharding_configs = _Config(sharding_degree=1, mp_degree=1,
+                                        hybrid_dp=False, fuse_broadcast_MB=32.0)
+        self.localsgd = False
+        self.localsgd_configs = _Config(k_steps=1, begin_step=1)
+        self.adaptive_localsgd = False
+        self.adaptive_localsgd_configs = _Config(init_k_steps=1, begin_step=1)
+        self.dgc = False
+        self.dgc_configs = _Config(rampup_begin_step=0, rampup_step=1,
+                                   sparsity=[0.999])
+        self.lars = False
+        self.lars_configs = _Config(lars_coeff=0.001, lars_weight_decay=0.0005,
+                                    epsilon=0, exclude_from_weight_decay=[])
+        self.lamb = False
+        self.lamb_configs = _Config(lamb_weight_decay=0.01,
+                                    exclude_from_weight_decay=[])
+        self.fp16_allreduce = False
+        self.a_sync = False
+        self.a_sync_configs = _Config(k_steps=0, max_merge_var_num=1,
+                                      send_queue_size=16,
+                                      independent_recv_thread=False,
+                                      thread_pool_size=1, send_wait_times=1,
+                                      runtime_split_send_recv=False)
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = _Config(tensor_parallel_degree=1,
+                                               tensor_init_seed=-1)
+        self.elastic = False
+        self.auto = False
+        self.nccl_comm_num = 1
+        self.use_hierarchical_allreduce = False
+        self.hierarchical_allreduce_inter_nranks = 1
+        self.sync_nccl_allreduce = True
+        self.fuse_grad_size_in_MB = 32
+        self.fuse_all_reduce_ops = True
+        self.sync_batch_norm = False
+        self.without_graph_optimization = False
+        # execution/build strategy stand-ins (proto:84,99)
+        self.execution_strategy = _Config(num_threads=1, num_iteration_per_drop_scope=10)
+        self.build_strategy = _Config(enable_sequential_execution=False)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in self.__dict__.items()}
+
+    def __repr__(self):
+        on = [k for k, v in self.__dict__.items() if v is True]
+        return f"DistributedStrategy(enabled={on})"

@@ -1,0 +1,185 @@
+"""Tensor-parallel layers (reference: paddle.distributed.split
+collective.py:737,771,811 — parallel embedding, row-parallel linear,
+column-parallel linear; fleet.meta_parallel in later reference versions).
+
+TPU-native: weights carry a PartitionSpec over the 'mp' mesh axis; inside
+pjit, XLA inserts the allreduce/allgather the reference codes by hand.  The
+layers also work eagerly (single chip) where the spec is just metadata.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn import initializer as init
+from ..nn.layer import Layer
+from ..ops._helpers import to_tensor_like
+from ..ops.dispatch import apply
+from ..tensor import Tensor
+from .collective import Group, _default_group, _is_traced
+from .mesh import mesh_axis_size
+
+
+class ColumnParallelLinear(Layer):
+    """Weight [in, out/mp]; forward: local matmul; gather_output → allgather
+    over 'mp' (reference _c_split/_c_concat pattern, collective.py:811)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, axis_name="mp", name=None):
+        super().__init__()
+        self.axis_name = axis_name
+        self.gather_output = gather_output
+        nparts = mesh_axis_size(axis_name)
+        assert out_features % max(nparts, 1) == 0
+        self.out_per_part = out_features // max(nparts, 1)
+        self.weight = self.create_parameter(
+            [in_features, self.out_per_part], attr=weight_attr,
+            default_initializer=init.XavierNormal())
+        self.weight.partition_spec = (None, axis_name)
+        self.bias = (self.create_parameter([self.out_per_part], is_bias=True)
+                     if has_bias else None)
+        if self.bias is not None:
+            self.bias.partition_spec = (axis_name,)
+            self.add_parameter("bias", self.bias)
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output and _is_traced(out._value):
+            out = apply(
+                "c_concat",
+                lambda v: jax.lax.all_gather(v, self.axis_name, axis=v.ndim - 1,
+                                             tiled=True),
+                out,
+            )
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Weight [in/mp, out]; input comes pre-split (or is split here); local
+    matmul then psum over 'mp'."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, axis_name="mp", name=None):
+        super().__init__()
+        self.axis_name = axis_name
+        self.input_is_parallel = input_is_parallel
+        nparts = mesh_axis_size(axis_name)
+        assert in_features % max(nparts, 1) == 0
+        self.in_per_part = in_features // max(nparts, 1)
+        self.weight = self.create_parameter(
+            [self.in_per_part, out_features], attr=weight_attr,
+            default_initializer=init.XavierNormal())
+        self.weight.partition_spec = (axis_name, None)
+        self.bias = (self.create_parameter([out_features], is_bias=True)
+                     if has_bias else None)
+        if self.bias is not None:
+            self.bias.partition_spec = (None,)
+            self.add_parameter("bias", self.bias)
+
+    def forward(self, x):
+        x = to_tensor_like(x)
+        if not self.input_is_parallel and _is_traced(x._value):
+            # split local slice of the feature dim
+            def f(v):
+                idx = jax.lax.axis_index(self.axis_name)
+                return jax.lax.dynamic_slice_in_dim(
+                    v, idx * self.in_per_part, self.in_per_part, axis=v.ndim - 1)
+
+            x = apply("c_split", f, x)
+        out = F.linear(x, self.weight, None)
+        if _is_traced(out._value):
+            out = apply("mp_allreduce_sum",
+                        lambda v: jax.lax.psum(v, self.axis_name), out)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class VocabParallelEmbedding(Layer):
+    """Row-split embedding table + psum (reference parallel embedding,
+    collective.py:737)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 axis_name="mp", name=None):
+        super().__init__()
+        self.axis_name = axis_name
+        nparts = max(mesh_axis_size(axis_name), 1)
+        assert num_embeddings % nparts == 0
+        self.per_part = num_embeddings // nparts
+        self.num_embeddings = num_embeddings
+        self.weight = self.create_parameter(
+            [self.per_part, embedding_dim], attr=weight_attr,
+            default_initializer=init.XavierNormal())
+        self.weight.partition_spec = (axis_name, None)
+
+    def forward(self, x):
+        x = to_tensor_like(x)
+        if _is_traced(x._value):
+            def f(idx, w):
+                rank = jax.lax.axis_index(self.axis_name)
+                lo = rank * self.per_part
+                local = idx.astype(jnp.int32) - lo
+                valid = (local >= 0) & (local < self.per_part)
+                safe = jnp.clip(local, 0, self.per_part - 1)
+                emb = jnp.take(w, safe, axis=0)
+                emb = jnp.where(valid[..., None], emb, 0.0)
+                return jax.lax.psum(emb, self.axis_name)
+
+            return apply("parallel_embedding", f, x, self.weight)
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-parallel softmax cross entropy over the 'mp' axis: max/psum over
+    shards without materializing the full vocab logits on one chip."""
+
+    def __init__(self, axis_name="mp", ignore_index=-100, name=None):
+        super().__init__()
+        self.axis_name = axis_name
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, label):
+        logits, label = to_tensor_like(logits), to_tensor_like(label)
+        axis_name = self.axis_name
+        if not _is_traced(logits._value):
+            return F.cross_entropy(logits, label, reduction="none")
+        per_part = logits.shape[-1]
+
+        def f(z, y):
+            zf = z.astype(jnp.float32)
+            m = jax.lax.pmax(jnp.max(zf, axis=-1, keepdims=True), axis_name)
+            e = jnp.exp(zf - m)
+            denom = jax.lax.psum(jnp.sum(e, axis=-1, keepdims=True), axis_name)
+            rank = jax.lax.axis_index(axis_name)
+            lo = rank * per_part
+            local = y.astype(jnp.int32) - lo
+            valid = (local >= 0) & (local < per_part)
+            safe = jnp.clip(local, 0, per_part - 1)
+            zy = jnp.take_along_axis(zf, safe[..., None], axis=-1)[..., 0]
+            zy = jnp.where(valid, zy, 0.0)
+            zy = jax.lax.psum(zy, axis_name)
+            return (jnp.log(denom[..., 0]) + m[..., 0]) - zy
+
+        return apply("parallel_cross_entropy", f, logits, label)
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference paddle.distributed.split (collective.py:811): build + apply a
+    parallel layer in one call."""
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 0:
+            layer = RowParallelLinear(in_f, out_f, weight_attr,
+                                      has_bias=bias_attr is not False)
+        else:
+            layer = ColumnParallelLinear(in_f, out_f, weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        return layer(x)
+    if operation == "embedding":
+        num_emb, emb_dim = size
+        layer = VocabParallelEmbedding(num_emb, emb_dim, weight_attr)
+        return layer(x)
+    raise ValueError(f"unknown split operation {operation!r}")

@@ -1,0 +1,60 @@
+"""Framework core: dtypes, places, flags, errors, random state.
+
+Reference analog: paddle/fluid/platform/ + paddle/fluid/framework/ process
+globals. On TPU the heavy parts (DeviceContext pools, allocators, kernel
+registries) are owned by XLA; this layer keeps the public semantics.
+"""
+from . import _globals  # noqa: F401
+from .dtype import (  # noqa: F401
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    convert_dtype,
+    dtype_name,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    is_floating,
+    set_default_dtype,
+    uint8,
+)
+from .errors import (  # noqa: F401
+    EnforceNotMet,
+    InvalidArgumentError,
+    NotFoundError,
+    OutOfRangeError,
+    PreconditionNotMetError,
+    UnimplementedError,
+    enforce,
+    enforce_eq,
+)
+from .flags import define_flag, flag_value, get_flags, set_flags  # noqa: F401
+from .place import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    XPUPlace,
+    default_place,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    is_compiled_with_xpu,
+    set_device,
+)
+from .random import (  # noqa: F401
+    Generator,
+    default_generator,
+    get_rng_state,
+    next_rng_key,
+    rng_scope,
+    seed,
+    set_rng_state,
+)

@@ -1,0 +1,5 @@
+"""Process-global defaults (reference analog: platform/init.cc globals)."""
+import numpy as np
+
+DEFAULT_DTYPE = np.dtype("float32")
+DEFAULT_PLACE = None  # resolved lazily by place.default_place()

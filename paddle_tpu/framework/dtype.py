@@ -1,0 +1,92 @@
+"""Dtype registry.
+
+TPU-native dtype system: names mirror the reference's VarType dtypes
+(/root/reference/paddle/fluid/framework/framework.proto:106) but map directly to
+JAX/XLA dtypes.  bfloat16 is first-class (TPU MXU native); float16 is supported
+for parity but bf16 is the recommended reduced precision on TPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical dtype objects are numpy dtypes (what jax uses under the hood).
+bool_ = jnp.bool_.dtype if hasattr(jnp.bool_, "dtype") else np.dtype("bool")
+uint8 = np.dtype("uint8")
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+float16 = np.dtype("float16")
+bfloat16 = jnp.bfloat16.dtype if hasattr(jnp.bfloat16, "dtype") else np.dtype(jnp.bfloat16)
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+
+_NAME_TO_DTYPE = {
+    "bool": np.dtype("bool"),
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+FLOATING = {float16, bfloat16, float32, float64}
+INTEGER = {uint8, int8, int16, int32, int64}
+
+
+def convert_dtype(dtype):
+    """Normalize a user-provided dtype (str / np.dtype / jnp type / Tensor dtype)
+    to a numpy dtype object."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype in _NAME_TO_DTYPE:
+            return _NAME_TO_DTYPE[dtype]
+        raise ValueError(f"unsupported dtype string: {dtype!r}")
+    if isinstance(dtype, np.dtype):
+        return dtype
+    try:
+        return np.dtype(dtype)
+    except TypeError:
+        pass
+    # jnp scalar types like jnp.float32
+    if hasattr(dtype, "dtype"):
+        return np.dtype(dtype.dtype)
+    raise ValueError(f"cannot interpret dtype: {dtype!r}")
+
+
+def dtype_name(dtype) -> str:
+    d = convert_dtype(dtype)
+    return d.name
+
+
+def is_floating(dtype) -> bool:
+    return convert_dtype(dtype) in FLOATING
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in INTEGER
+
+
+def get_default_dtype():
+    from . import _globals
+
+    return _globals.DEFAULT_DTYPE
+
+
+def set_default_dtype(dtype):
+    from . import _globals
+
+    d = convert_dtype(dtype)
+    if d not in (float16, bfloat16, float32, float64):
+        raise ValueError("default dtype must be a floating dtype, got %s" % d)
+    _globals.DEFAULT_DTYPE = d

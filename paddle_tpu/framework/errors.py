@@ -1,0 +1,78 @@
+"""Typed error taxonomy.
+
+Mirrors the reference's enforce.h error codes
+(/root/reference/paddle/fluid/platform/error_codes.proto, enforce.h) as Python
+exception classes plus ``enforce`` helpers.  Stack traces come for free from
+Python; op provenance (op_call_stack.cc analog) is attached by the eager
+dispatcher when an op fails.
+"""
+from __future__ import annotations
+
+
+class EnforceNotMet(RuntimeError):
+    """Base of all framework errors (reference: platform::EnforceNotMet)."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class ResourceExhaustedError(EnforceNotMet, MemoryError):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class PermissionDeniedError(EnforceNotMet, PermissionError):
+    pass
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+class FatalError(EnforceNotMet):
+    pass
+
+
+class ExternalError(EnforceNotMet):
+    pass
+
+
+def enforce(condition, message="", error_cls=InvalidArgumentError):
+    if not condition:
+        raise error_cls(message)
+
+
+def enforce_eq(a, b, message="", error_cls=InvalidArgumentError):
+    if a != b:
+        raise error_cls(f"{message} (expected {a!r} == {b!r})")
+
+
+def enforce_shape_match(shape_a, shape_b, message=""):
+    if tuple(shape_a) != tuple(shape_b):
+        raise InvalidArgumentError(
+            f"{message} (shape mismatch: {tuple(shape_a)} vs {tuple(shape_b)})"
+        )

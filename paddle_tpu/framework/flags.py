@@ -1,0 +1,100 @@
+"""Global flag system.
+
+Re-expresses the reference's gflags config tier
+(/root/reference/paddle/fluid/platform/flags.cc:33-577 and the pybind
+get/set surface in pybind/global_value_getter_setter.cc) as a Python registry:
+flags are declared with defaults, overridable from the environment via
+``FLAGS_<name>`` and from code via ``set_flags``/``get_flags``.
+
+Flags that configured CUDA allocator/stream behavior in the reference have TPU
+analogs where meaningful (XLA owns device memory) and are accepted-but-inert
+otherwise, so user scripts that set them keep working.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+
+class _Flag:
+    __slots__ = ("name", "default", "value", "type", "help", "on_change")
+
+    def __init__(self, name, default, help="", on_change=None):
+        self.name = name
+        self.default = default
+        self.value = default
+        self.type = type(default)
+        self.help = help
+        self.on_change: Optional[Callable[[Any], None]] = on_change
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def define_flag(name: str, default, help: str = "", on_change=None):
+    flag = _Flag(name, default, help, on_change)
+    _REGISTRY[name] = flag
+    env = os.environ.get("FLAGS_" + name)
+    if env is not None:
+        flag.value = _parse(env, flag.type)
+    return flag
+
+
+def _parse(text: str, ty):
+    if ty is bool:
+        return text.strip().lower() in ("1", "true", "yes", "on")
+    if ty is int:
+        return int(text)
+    if ty is float:
+        return float(text)
+    return text
+
+
+def set_flags(flags: Dict[str, Any]):
+    for name, value in flags.items():
+        key = name[6:] if name.startswith("FLAGS_") else name
+        if key not in _REGISTRY:
+            raise KeyError(f"unknown flag {name!r}")
+        flag = _REGISTRY[key]
+        flag.value = _parse(value, flag.type) if isinstance(value, str) else flag.type(value)
+        if flag.on_change is not None:
+            flag.on_change(flag.value)
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for name in flags:
+        key = name[6:] if name.startswith("FLAGS_") else name
+        if key not in _REGISTRY:
+            raise KeyError(f"unknown flag {name!r}")
+        out[name] = _REGISTRY[key].value
+    return out
+
+
+def flag_value(name: str):
+    return _REGISTRY[name].value
+
+
+# --- declared flags (subset of reference flags.cc with TPU-relevant semantics) ---
+define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf after each eager op")
+define_flag("benchmark", False, "block on each op for timing")
+define_flag("eager_delete_tensor_gb", 0.0, "inert on TPU: XLA owns deallocation")
+define_flag("allocator_strategy", "auto_growth", "inert on TPU: XLA owns device memory")
+define_flag("fraction_of_gpu_memory_to_use", 0.92, "inert on TPU")
+define_flag("cudnn_deterministic", False, "map to XLA deterministic reductions")
+define_flag("seed", 0, "global random seed (0 = nondeterministic)")
+define_flag("max_inplace_grad_add", 0, "grad accumulation chunking hint")
+define_flag("tpu_matmul_precision", "default",
+            "jax matmul precision: default|high|highest")
+define_flag("enable_unused_var_check", False, "warn on ops with unused inputs")
+define_flag("call_stack_level", 1, "error report verbosity")
+define_flag("use_mkldnn", False, "inert: XLA:CPU subsumes oneDNN")
+define_flag("sync_nccl_allreduce", False, "inert: XLA schedules collectives")
+define_flag("fuse_parameter_memory_size", -1.0, "inert: XLA fuses")
+define_flag("init_allocated_mem", False, "inert on TPU")
+define_flag("free_idle_chunk", False, "inert on TPU")
+define_flag("use_pinned_memory", True, "host staging buffers for H2D feeds")
+define_flag("reader_queue_speed_test_mode", False, "datafeed benchmarking mode")
+define_flag("tpu_donate_buffers", True, "donate input buffers in jitted train steps")

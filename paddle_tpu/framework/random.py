@@ -1,0 +1,102 @@
+"""Random state management.
+
+The reference threads per-device curand generators through DeviceContext; the
+TPU-native design is a functional PRNG (jax.random) with a convenience
+stateful facade:
+
+* Eager mode: a global ``Generator`` splits a fresh subkey per request.
+* Traced/jit mode: a ``rng_scope(key)`` context supplies the step key as a
+  traced value; each consumption site folds in a Python-level counter that is
+  fixed at trace time, so one traced step consumes deterministic, distinct
+  subkeys derived from the per-step key argument (the idiomatic jax pattern —
+  no traced global state).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self._key = jax.random.key(seed)
+        self._lock = threading.Lock()
+
+    def manual_seed(self, seed: int):
+        self._seed = seed
+        self._key = jax.random.key(seed)
+        return self
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, key):
+        self._key = key
+
+    def split_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    @property
+    def initial_seed(self):
+        return self._seed
+
+
+default_generator = Generator(np.random.randint(0, 2**31 - 1))
+
+
+def seed(value: int):
+    """paddle.seed parity: seeds the global generator (and numpy for data aug)."""
+    default_generator.manual_seed(int(value))
+    np.random.seed(int(value) % (2**32))
+    return default_generator
+
+
+class _RngScope(threading.local):
+    def __init__(self):
+        self.key = None
+        self.counter = 0
+
+
+_scope = _RngScope()
+
+
+class rng_scope:
+    """Provide the PRNG key for a traced step: ``with rng_scope(key): ...``."""
+
+    def __init__(self, key):
+        self._key = key
+        self._prev = None
+        self._prev_counter = 0
+
+    def __enter__(self):
+        self._prev, self._prev_counter = _scope.key, _scope.counter
+        _scope.key, _scope.counter = self._key, 0
+        return self
+
+    def __exit__(self, *exc):
+        _scope.key, _scope.counter = self._prev, self._prev_counter
+        return False
+
+
+def next_rng_key() -> jax.Array:
+    """Next PRNG key: from the active rng_scope if any (trace-safe), else the
+    global eager generator."""
+    if _scope.key is not None:
+        site = _scope.counter
+        _scope.counter += 1
+        return jax.random.fold_in(_scope.key, site)
+    return default_generator.split_key()
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state):
+    default_generator.set_state(state)

@@ -1,0 +1,60 @@
+"""paddle_tpu.save / paddle_tpu.load.
+
+Reference analog: python/paddle/framework/io.py:202 (save) / :292 (load) —
+pickled nested state dicts with tensors converted to numpy.  Large-scale /
+sharded checkpointing lives in paddle_tpu.incubate.checkpoint (orbax-backed);
+this is the simple single-host path.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from .tensor import Parameter, Tensor
+
+_PROTOCOL = 4
+
+
+def _to_serializable(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj._value),
+                "stop_gradient": obj.stop_gradient,
+                "is_param": isinstance(obj, Parameter), "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_serializable(v) for v in obj)
+    return obj
+
+
+def _from_serializable(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["data"]
+            cls = Parameter if obj.get("is_param") else Tensor
+            t = cls(obj["data"])
+            t.stop_gradient = obj.get("stop_gradient", True)
+            return t
+        return {k: _from_serializable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_serializable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = _PROTOCOL, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs):
+    with open(path, "rb") as f:
+        raw = pickle.load(f)
+    return _from_serializable(raw, return_numpy=return_numpy)

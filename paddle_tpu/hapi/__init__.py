@@ -1,0 +1,13 @@
+"""paddle_tpu.hapi — high-level training API (reference: python/paddle/hapi/)."""
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+    ReduceLROnPlateau,
+    VisualDL,
+)
+from .model import Model  # noqa: F401
+from .summary import flops, summary  # noqa: F401

@@ -1,0 +1,351 @@
+"""hapi Model (reference: python/paddle/hapi/model.py — Model :810, fit :1299,
+DynamicGraphAdapter :609).
+
+TPU-native: Model.prepare builds ONE jitted train step (forward + loss +
+grad + optimizer update, donated arrays) over the functional layer state —
+the whole-step XLA program is the performance path the reference approximates
+with per-op kernels.  `accelerate=False` falls back to eager (tape) stepping
+for debugging parity.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.random import default_generator, rng_scope
+from ..jit.functional import functional_call, get_state
+from ..metric.metrics import Metric
+from ..tensor import Tensor
+from .callbacks import CallbackList, ProgBarLogger
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _batch_size_of(x):
+    try:
+        return int(x.shape[0])
+    except Exception:
+        return 1
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._accelerate = True
+        self._train_step = None
+        self._eval_fn = None
+        self._state = None
+        self.stop_training = False
+
+    # --- prepare -----------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None,
+                accelerate=True):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        self._accelerate = accelerate
+        self._train_step = None
+        self._eval_fn = None
+        return self
+
+    # --- state sync: functional state <-> layer tensors ---------------------
+    def _ensure_state(self):
+        if self._state is None:
+            params, buffers = get_state(self.network)
+            opt = (self._optimizer.init_opt_state(params)
+                   if self._optimizer is not None else {})
+            self._state = {"params": params, "buffers": buffers, "opt": opt,
+                           "step": jnp.zeros((), jnp.int32)}
+
+    def _writeback_state(self):
+        """Push functional state back into layer tensors (so state_dict etc.
+        observe trained weights)."""
+        if self._state is None:
+            return
+        for n, p in self.network.named_parameters():
+            if n in self._state["params"]:
+                p._value = self._state["params"][n]
+        for n, b in self.network.named_buffers():
+            if n in self._state["buffers"]:
+                b._value = self._state["buffers"][n]
+
+    def _build_train_step(self):
+        network, loss_fn, optimizer = self.network, self._loss, self._optimizer
+
+        def step_fn(state, key, x, y):
+            def loss_of(params):
+                with rng_scope(key):
+                    out, new_bufs = functional_call(
+                        network, params, state["buffers"], (x,), training=True)
+                out_t = jax.tree_util.tree_map(
+                    lambda v: Tensor(v) if isinstance(v, jax.Array) else v, out)
+                if not isinstance(out_t, (list, tuple)):
+                    out_t = [out_t]
+                loss = loss_fn(*out_t, Tensor(y))
+                return loss._value.astype(jnp.float32), (new_bufs, out)
+
+            (loss, (new_bufs, out)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(state["params"])
+            count = state["step"] + 1
+            new_params, new_opt = optimizer.fused_step(
+                state["params"], grads, state["opt"], count)
+            new_state = {"params": new_params, "buffers": new_bufs,
+                         "opt": new_opt, "step": count}
+            return new_state, loss, out
+
+        return jax.jit(step_fn, donate_argnums=(0,))
+
+    def _build_eval_fn(self):
+        network = self.network
+
+        def eval_fn(params, buffers, x):
+            out, _ = functional_call(network, params, buffers, (x,),
+                                     training=False)
+            return out
+
+        return jax.jit(eval_fn)
+
+    # --- single-batch API ----------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        x = inputs[0]
+        y = labels[0] if labels else None
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+        yv = y._value if isinstance(y, Tensor) else jnp.asarray(np.asarray(y))
+
+        if self._accelerate:
+            self._ensure_state()
+            if self._train_step is None:
+                self._train_step = self._build_train_step()
+            key = default_generator.split_key()
+            self._state, loss, out = self._train_step(self._state, key, xv, yv)
+            metrics_out = self._update_metrics(out, yv)
+            return [float(np.asarray(loss))] + metrics_out
+
+        # eager path
+        self.network.train()
+        outputs = self.network(Tensor(xv))
+        outs = _to_list(outputs)
+        loss = self._loss(*outs, Tensor(yv))
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics_out = self._update_metrics(outs[0]._value, yv)
+        return [float(np.asarray(loss._value))] + metrics_out
+
+    def _update_metrics(self, out, yv):
+        res = []
+        first = out[0] if isinstance(out, (list, tuple)) else out
+        for m in self._metrics:
+            c = m.compute(Tensor(first), Tensor(yv))
+            r = m.update(c)
+            res.append(r)
+        return res
+
+    def eval_batch(self, inputs, labels=None):
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        x = inputs[0]
+        y = labels[0] if labels else None
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+        if self._accelerate:
+            self._ensure_state()
+            if self._eval_fn is None:
+                self._eval_fn = self._build_eval_fn()
+            out = self._eval_fn(self._state["params"], self._state["buffers"], xv)
+        else:
+            self.network.eval()
+            out = self.network(Tensor(xv))._value
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        res = []
+        if y is not None:
+            yv = y._value if isinstance(y, Tensor) else jnp.asarray(np.asarray(y))
+            if self._loss is not None:
+                loss = self._loss(Tensor(outs[0]), Tensor(yv))
+                res.append(float(np.asarray(loss._value)))
+            res += self._update_metrics(outs[0], yv)
+        return res
+
+    def predict_batch(self, inputs):
+        inputs = _to_list(inputs)
+        x = inputs[0]
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+        if self._accelerate:
+            self._ensure_state()
+            if self._eval_fn is None:
+                self._eval_fn = self._build_eval_fn()
+            out = self._eval_fn(self._state["params"], self._state["buffers"], xv)
+            return [np.asarray(out)]
+        self.network.eval()
+        return [self.network(Tensor(xv)).numpy()]
+
+    # --- loops ---------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader
+        from ..io.dataset import Dataset
+
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        steps = None
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            pass
+        cbks = CallbackList(callbacks, model=self, verbose=verbose,
+                            metrics=["loss"] + self._metric_names(),
+                            epochs=epochs, steps=steps, log_freq=log_freq)
+        cbks.on_begin("train")
+        self.stop_training = False
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                if num_iters is not None and step >= num_iters:
+                    break
+                cbks.on_batch_begin("train", step, logs)
+                x, y = batch[0], batch[1] if len(batch) > 1 else None
+                outs = self.train_batch([x], [y])
+                logs = {"loss": outs[0], "batch_size": _batch_size_of(x)}
+                for name, val in zip(self._metric_names(), outs[1:]):
+                    logs[name] = val
+                cbks.on_batch_end("train", step, logs)
+                if self.stop_training:
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          _inside_fit=True)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+            if self.stop_training:
+                break
+        cbks.on_end("train", logs)
+        if save_dir:
+            self.save(f"{save_dir}/final")
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None, _inside_fit=False):
+        from ..io import DataLoader
+        from ..io.dataset import Dataset
+
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = eval_data
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            x, y = batch[0], batch[1] if len(batch) > 1 else None
+            outs = self.eval_batch([x], [y])
+            if outs:
+                losses.append(outs[0])
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, list) else [vals]
+            for n, v in zip(names, vals):
+                logs[n] = v
+        if verbose and not _inside_fit:
+            print("Eval:", logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                callbacks=None, verbose=1):
+        from ..io import DataLoader
+        from ..io.dataset import Dataset
+
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = test_data
+        outputs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outputs.append(self.predict_batch([x])[0])
+        if stack_outputs:
+            return [np.concatenate(outputs, axis=0)]
+        return [outputs]
+
+    # --- persistence ---------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework_io import save as _save
+
+        self._writeback_state()
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework_io import load as _load
+
+        state = _load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        self._state = None  # rebuild functional state from layer tensors
+        self._train_step = None
+        self._eval_fn = None
+        import os
+
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def state_dict(self):
+        self._writeback_state()
+        return self.network.state_dict()
+
+    def _metric_names(self):
+        names = []
+        for m in self._metrics:
+            n = m.name()
+            names += n if isinstance(n, list) else [n]
+        return names
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+
+        return _summary(self.network, input_size, dtype)

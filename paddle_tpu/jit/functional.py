@@ -1,0 +1,93 @@
+"""Functional execution of Layers.
+
+This is the TPU-native replacement for the reference's dygraph→static
+machinery (fluid/dygraph/dygraph_to_static/ — a 9k-LoC AST transpiler,
+program_translator.py:233): because every eager op here is already a jax
+function, *tracing the Python directly with jax* replaces AST rewriting.
+
+``functional_call(layer, params, buffers, args)`` runs a Layer as a pure
+function of its state: parameter/buffer tensors are temporarily bound to the
+given arrays (which may be jax tracers), the forward runs with the tape
+disabled, and mutated buffers (e.g. BN running stats) are collected as
+outputs.  Everything jit/pjit/shard_map-compatible builds on this.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+
+from ..autograd.tape import no_grad
+from ..tensor import Tensor
+
+
+def tree_unwrap(obj):
+    if isinstance(obj, Tensor):
+        return obj._value
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(tree_unwrap(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: tree_unwrap(v) for k, v in obj.items()}
+    return obj
+
+
+def tree_wrap(obj):
+    if isinstance(obj, jax.Array):
+        return Tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(tree_wrap(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: tree_wrap(v) for k, v in obj.items()}
+    return obj
+
+
+def get_state(layer) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    params = {n: p._value for n, p in layer.named_parameters()}
+    buffers = {n: b._value for n, b in layer.named_buffers()}
+    return params, buffers
+
+
+_bind_lock = threading.RLock()
+
+
+def functional_call(layer, params: Dict[str, Any], buffers: Dict[str, Any],
+                    args=(), kwargs=None, training: Optional[bool] = None,
+                    forward_fn=None):
+    """Run layer.forward as a pure function.
+
+    Returns (output_tree_of_arrays, new_buffers_dict).
+    ``forward_fn`` overrides the callable (used by to_static, whose wrapper
+    has replaced layer.forward).
+    """
+    kwargs = kwargs or {}
+    fwd = forward_fn if forward_fn is not None else layer.forward
+    param_objs = dict(layer.named_parameters())
+    buffer_objs = dict(layer.named_buffers())
+    with _bind_lock:
+        old_vals = {n: p._value for n, p in param_objs.items()}
+        old_bufs = {n: b._value for n, b in buffer_objs.items()}
+        old_training = [(l, l.training) for l in layer.sublayers(include_self=True)]
+        try:
+            for n, p in param_objs.items():
+                if n in params:
+                    p._value = params[n]
+            for n, b in buffer_objs.items():
+                if n in buffers:
+                    b._value = buffers[n]
+            if training is not None:
+                for l, _ in old_training:
+                    l.training = training
+            wrapped_args = [Tensor(a) if isinstance(a, jax.Array) else a for a in args]
+            with no_grad():
+                out = fwd(*wrapped_args, **kwargs)
+            out_arrays = tree_unwrap(out)
+            new_buffers = {n: b._value for n, b in buffer_objs.items()}
+        finally:
+            for n, p in param_objs.items():
+                p._value = old_vals[n]
+            for n, b in buffer_objs.items():
+                b._value = old_bufs[n]
+            for l, t in old_training:
+                l.training = t
+    return out_arrays, new_buffers

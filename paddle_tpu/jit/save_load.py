@@ -1,0 +1,92 @@
+"""jit.save / jit.load — inference model export.
+
+Reference analog: paddle.jit.save (fluid/dygraph/jit.py; dygraph/io.py
+TranslatedLayer): saves a traced program + params reloadable WITHOUT the
+original Python class.
+
+TPU-native: the traced computation is serialized with jax.export (StableHLO
+bytes — the XLA-world ProgramDesc analog) next to a pickled state dict.
+``jit.load`` rebuilds a TranslatedLayer whose forward invokes the deserialized
+StableHLO executable.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from ..nn.layer import Layer
+from ..tensor import Tensor
+from .functional import functional_call, get_state
+
+_PDMODEL_SUFFIX = ".pdmodel"  # StableHLO bytes
+_PDPARAMS_SUFFIX = ".pdiparams"  # pickled numpy state dict
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Export layer for inference. input_spec: list of InputSpec or Tensors."""
+    from .to_static import InputSpec, StaticFunction
+
+    if isinstance(getattr(layer, "forward", None), StaticFunction):
+        fwd = layer.forward._fn
+    else:
+        fwd = None
+
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (shapes are static on TPU)")
+    args = []
+    for spec in input_spec:
+        if isinstance(spec, Tensor):
+            args.append(jax.ShapeDtypeStruct(tuple(spec.shape), spec.dtype))
+        elif isinstance(spec, InputSpec):
+            args.append(jax.ShapeDtypeStruct(spec.shape, spec.dtype))
+        else:
+            raise TypeError(f"bad input spec {spec!r}")
+
+    params, buffers = get_state(layer)
+
+    def infer_fn(*arr_args):
+        out, _ = functional_call(layer, params, buffers, arr_args, training=False)
+        return out
+
+    exported = jax.export.export(jax.jit(infer_fn))(*args)
+    blob = exported.serialize()
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + _PDMODEL_SUFFIX, "wb") as f:
+        f.write(blob)
+    state = {n: np.asarray(v) for n, v in {**params, **buffers}.items()}
+    with open(path + _PDPARAMS_SUFFIX, "wb") as f:
+        pickle.dump(state, f, protocol=4)
+
+
+class TranslatedLayer(Layer):
+    """Reloaded inference program (reference: fluid/dygraph/io.py:TranslatedLayer)."""
+
+    def __init__(self, exported, state):
+        super().__init__()
+        self._exported = exported
+        self._state = state
+
+    def forward(self, *args):
+        arr_args = [a._value if isinstance(a, Tensor) else np.asarray(a) for a in args]
+        out = self._exported.call(*arr_args)
+        if isinstance(out, (list, tuple)):
+            return type(out)(Tensor(o) for o in out)
+        return Tensor(out)
+
+    def program(self):
+        return self._exported.mlir_module()
+
+
+def load(path, **configs):
+    with open(path + _PDMODEL_SUFFIX, "rb") as f:
+        blob = f.read()
+    exported = jax.export.deserialize(blob)
+    with open(path + _PDPARAMS_SUFFIX, "rb") as f:
+        state = pickle.load(f)
+    return TranslatedLayer(exported, state)

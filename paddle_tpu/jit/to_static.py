@@ -1,0 +1,170 @@
+"""@to_static: jit compilation of dygraph code.
+
+Reference analog: paddle.jit.to_static / @declarative (fluid/dygraph/jit.py:160
++ dygraph_to_static/program_translator.py:233 StaticFunction) — there, an AST
+transpiler rewrites Python into a static Program.  Here jax tracing does the
+capture: the layer/function is traced once per (shapes, dtypes, training)
+signature into an XLA computation, cached, and dispatched through the eager
+tape as a single fused op — so ``backward()`` still works across a jitted
+forward (jax.vjp of the compiled function).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..framework.random import next_rng_key, rng_scope
+from ..ops.dispatch import apply
+from ..tensor import Tensor
+from .functional import functional_call, get_state, tree_unwrap, tree_wrap
+
+
+class InputSpec:
+    """paddle.static.InputSpec parity."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        from ..framework import dtype as _dt
+
+        self.dtype = _dt.convert_dtype(dtype)
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def _sig_of(args):
+    sig = []
+    for a in args:
+        if isinstance(a, Tensor):
+            sig.append(("T", tuple(a._value.shape), str(a._value.dtype)))
+        elif isinstance(a, (np.ndarray, jax.Array)):
+            sig.append(("A", tuple(a.shape), str(a.dtype)))
+        else:
+            sig.append(("S", repr(a)))
+    return tuple(sig)
+
+
+class StaticFunction:
+    """Compiled callable over a Layer's forward or a free function."""
+
+    def __init__(self, function, input_spec=None, layer=None):
+        self._fn = function
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache: Dict[Any, Any] = {}
+        functools.update_wrapper(self, function)
+
+    @property
+    def concrete_programs(self):
+        return list(self._cache.values())
+
+    def _compile_layer(self, sig, training):
+        layer = self._layer
+        fwd = self._fn
+
+        def pure(key, params, buffers, *arr_args):
+            with rng_scope(key):
+                out, new_bufs = functional_call(layer, params, buffers, arr_args,
+                                                training=training,
+                                                forward_fn=fwd)
+            return out, new_bufs
+
+        return jax.jit(pure)
+
+    def __call__(self, *args, **kwargs):
+        if self._layer is not None:
+            training = self._layer.training
+            sig = (_sig_of(args), training)
+            if sig not in self._cache:
+                self._cache[sig] = self._compile_layer(sig, training)
+            jitted = self._cache[sig]
+            params, buffers = get_state(self._layer)
+            key = next_rng_key()
+            param_names = list(params.keys())
+            param_tensors = dict(self._layer.named_parameters())
+
+            # dispatch through the tape: grads flow to parameters
+            def run(key_, *param_vals_and_args):
+                pvals = dict(zip(param_names, param_vals_and_args[: len(param_names)]))
+                arr_args = param_vals_and_args[len(param_names):]
+                out, new_bufs = jitted(key_, pvals, buffers, *arr_args)
+                flat_out, treedef = jax.tree_util.tree_flatten(out)
+                flat_bufs, buf_def = jax.tree_util.tree_flatten(new_bufs)
+                run._treedef = treedef
+                run._buf_def = buf_def
+                run._n_out = len(flat_out)
+                return tuple(flat_out) + tuple(flat_bufs)
+
+            tensor_args = [a for a in args]
+            all_args = [Tensor(key)] + [param_tensors[n] for n in param_names] + tensor_args
+            results = apply("jit_program", run, *all_args)
+            if not isinstance(results, tuple):
+                results = (results,)
+            n_out = run._n_out
+            out_flat = list(results[:n_out])
+            buf_flat = [r._value for r in results[n_out:]]
+            # write back mutated buffers
+            new_bufs = jax.tree_util.tree_unflatten(run._buf_def, buf_flat)
+            for n, b in self._layer.named_buffers():
+                if n in new_bufs:
+                    b._value = new_bufs[n]
+            out = jax.tree_util.tree_unflatten(run._treedef, out_flat)
+            return out
+
+        # free function: jit over unwrapped args
+        sig = _sig_of(args)
+        if sig not in self._cache:
+            fn = self._fn
+
+            def pure(key, *arr_args):
+                with rng_scope(key):
+                    wrapped = [Tensor(a) if isinstance(a, jax.Array) else a
+                               for a in arr_args]
+                    from ..autograd.tape import no_grad
+
+                    with no_grad():
+                        out = fn(*wrapped)
+                    return tree_unwrap(out)
+
+            self._cache[sig] = jax.jit(pure)
+        jitted = self._cache[sig]
+        key = next_rng_key()
+
+        def run(key_, *arr_args):
+            out = jitted(key_, *arr_args)
+            flat, treedef = jax.tree_util.tree_flatten(out)
+            run._treedef = treedef
+            return tuple(flat)
+
+        results = apply("jit_function", run, Tensor(key), *args)
+        if not isinstance(results, tuple):
+            results = (results,)
+        return jax.tree_util.tree_unflatten(run._treedef, list(results))
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None):
+    """Decorator / wrapper converting dygraph callables to compiled ones."""
+    from ..nn.layer import Layer
+
+    def decorate(obj):
+        if isinstance(obj, Layer):
+            static = StaticFunction(obj.forward, input_spec, layer=obj)
+            obj.forward = static
+            return obj
+        return StaticFunction(obj, input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+declarative = to_static
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn

@@ -1,0 +1,2 @@
+"""paddle_tpu.metric (reference: python/paddle/metric/metrics.py)."""
+from .metrics import Accuracy, Auc, Metric, Precision, Recall, accuracy  # noqa: F401

@@ -1,0 +1,35 @@
+"""paddle_tpu.nn — neural network layers.
+
+Reference analog: python/paddle/nn/ (modern API) + fluid/dygraph/layers.py.
+"""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .activation_layers import *  # noqa: F401,F403
+from .common_layers import *  # noqa: F401,F403
+from .container import LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
+from .conv_layers import *  # noqa: F401,F403
+from .layer import Layer  # noqa: F401
+from .loss_layers import *  # noqa: F401,F403
+from .norm_layers import *  # noqa: F401,F403
+from .pool_layers import *  # noqa: F401,F403
+
+# sequence / attention stacks
+from .rnn import (  # noqa: F401
+    GRU,
+    GRUCell,
+    LSTM,
+    LSTMCell,
+    RNN,
+    BiRNN,
+    SimpleRNN,
+    SimpleRNNCell,
+)
+from .transformer import (  # noqa: F401
+    MultiHeadAttention,
+    Transformer,
+    TransformerDecoder,
+    TransformerDecoderLayer,
+    TransformerEncoder,
+    TransformerEncoderLayer,
+)
+from .clip_grad import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401

@@ -1,0 +1,72 @@
+"""Gradient clipping (reference: fluid/clip.py — GradientClipByValue :133,
+GradientClipByNorm :232, GradientClipByGlobalNorm :338)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd.tape import no_grad
+from ..tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        with no_grad():
+            for p, g in params_grads:
+                if g is None or not getattr(p, "need_clip", True):
+                    out.append((p, g))
+                    continue
+                out.append((p, Tensor(jnp.clip(g._value, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        with no_grad():
+            for p, g in params_grads:
+                if g is None or not getattr(p, "need_clip", True):
+                    out.append((p, g))
+                    continue
+                n = jnp.sqrt(jnp.sum(jnp.square(g._value.astype(jnp.float32))))
+                scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+                out.append((p, Tensor((g._value * scale).astype(g._value.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        with no_grad():
+            sq = 0.0
+            clippable = []
+            for p, g in params_grads:
+                if g is None or not getattr(p, "need_clip", True):
+                    continue
+                sq = sq + jnp.sum(jnp.square(g._value.astype(jnp.float32)))
+                clippable.append(id(g))
+            if not clippable:
+                return params_grads
+            global_norm = jnp.sqrt(sq)
+            scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+            out = []
+            for p, g in params_grads:
+                if g is None or not getattr(p, "need_clip", True):
+                    out.append((p, g))
+                else:
+                    out.append((p, Tensor((g._value * scale).astype(g._value.dtype))))
+        return out

@@ -1,0 +1,29 @@
+"""paddle_tpu.nn.functional — functional neural-net ops.
+
+Reference analog: python/paddle/nn/functional/ (the modern functional API).
+"""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+
+# attention ops (flash/ring) are registered lazily to avoid importing pallas
+# at package import time on hosts without TPU support.
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    from ...ops.attention import scaled_dot_product_attention as _sdpa
+
+    return _sdpa(query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
+                 is_causal=is_causal, training=training)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, name=None):
+    from ...ops.attention import flash_attention as _fa
+
+    return _fa(query, key, value, dropout=dropout, causal=causal,
+               return_softmax=return_softmax)

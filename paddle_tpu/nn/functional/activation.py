@@ -1,0 +1,214 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py).
+
+All are jax.nn / jnp compositions; XLA fuses them into surrounding matmuls on
+TPU, so there is no need for the reference's fused activation kernels
+(operators/fused/fuse_elewise_add_act) — the compiler does it.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import norm_axis, to_tensor_like
+from ...ops.dispatch import apply
+
+
+def _unop(name, fn):
+    def op(x, name=None):
+        return apply(name_, fn, to_tensor_like(x))
+
+    name_ = name
+    op.__name__ = name
+    return op
+
+
+relu = _unop("relu", jax.nn.relu)
+relu6 = _unop("relu6", jax.nn.relu6)
+sigmoid = _unop("sigmoid", jax.nn.sigmoid)
+tanh = _unop("tanh", jnp.tanh)
+softsign = _unop("softsign", jax.nn.soft_sign)
+silu = _unop("silu", jax.nn.silu)
+mish = _unop("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+tanhshrink = _unop("tanh_shrink", lambda x: x - jnp.tanh(x))
+log_sigmoid = _unop("logsigmoid", jax.nn.log_sigmoid)
+
+
+def gelu(x, approximate=False, name=None):
+    x = to_tensor_like(x)
+    return apply("gelu", lambda v: jax.nn.gelu(v, approximate=approximate), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    x = to_tensor_like(x)
+    return apply("leaky_relu", lambda v: jax.nn.leaky_relu(v, negative_slope), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply("elu", lambda v: jax.nn.elu(v, alpha), to_tensor_like(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply("celu", lambda v: jax.nn.celu(v, alpha), to_tensor_like(x))
+
+
+def selu(
+    x,
+    scale=1.0507009873554804934193349852946,
+    alpha=1.6732632423543772848170429916717,
+    name=None,
+):
+    return apply(
+        "selu",
+        lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)),
+        to_tensor_like(x),
+    )
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(
+        "hard_shrink",
+        lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0).astype(v.dtype),
+        to_tensor_like(x),
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(
+        "softshrink",
+        lambda v: jnp.where(
+            v > threshold, v - threshold, jnp.where(v < -threshold, v + threshold, 0.0)
+        ).astype(v.dtype),
+        to_tensor_like(x),
+    )
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply("brelu", lambda v: jnp.clip(v, min, max), to_tensor_like(x))
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply(
+        "hard_sigmoid", lambda v: jnp.clip(v * slope + offset, 0.0, 1.0),
+        to_tensor_like(x),
+    )
+
+
+def hardswish(x, name=None):
+    return apply(
+        "hard_swish", lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, to_tensor_like(x)
+    )
+
+
+def swish(x, name=None):
+    return apply("swish", jax.nn.silu, to_tensor_like(x))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(
+        "softplus",
+        lambda v: jnp.where(
+            beta * v > threshold, v, (1.0 / beta) * jax.nn.softplus(beta * v)
+        ).astype(v.dtype),
+        to_tensor_like(x),
+    )
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = to_tensor_like(x)
+
+    def f(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1 :]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+
+    return apply("maxout", f, x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = to_tensor_like(x), to_tensor_like(weight)
+
+    def f(v, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        else:
+            ch_axis = 1 if data_format[1] == "C" else v.ndim - 1
+            shape = [1] * v.ndim
+            shape[ch_axis] = -1
+            wb = w.reshape(shape)
+        return jnp.where(v >= 0, v, wb * v).astype(v.dtype)
+
+    return apply("prelu", f, x, weight)
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=True, name=None):
+    x = to_tensor_like(x)
+    if training:
+        from ...framework.random import next_rng_key
+
+        key = next_rng_key()
+
+        def f(v):
+            a = jax.random.uniform(key, v.shape, jnp.float32, lower, upper).astype(v.dtype)
+            return jnp.where(v >= 0, v, a * v)
+
+        return apply("rrelu", f, x)
+    mid = (lower + upper) / 2.0
+    return apply("rrelu", lambda v: jnp.where(v >= 0, v, mid * v).astype(v.dtype), x)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply(
+        "thresholded_relu",
+        lambda v: jnp.where(v > threshold, v, 0.0).astype(v.dtype),
+        to_tensor_like(x),
+    )
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = to_tensor_like(x)
+    from ...framework import dtype as _dt
+
+    d = _dt.convert_dtype(dtype) if dtype is not None else None
+
+    def f(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.softmax(v, axis=axis)
+
+    return apply("softmax", f, x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = to_tensor_like(x)
+    from ...framework import dtype as _dt
+
+    d = _dt.convert_dtype(dtype) if dtype is not None else None
+
+    def f(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.log_softmax(v, axis=axis)
+
+    return apply("log_softmax", f, x)
+
+
+def glu(x, axis=-1, name=None):
+    return apply("glu", lambda v: jax.nn.glu(v, axis=axis), to_tensor_like(x))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random import next_rng_key
+
+    x = to_tensor_like(x)
+    key = next_rng_key()
+
+    def f(v):
+        g = jax.random.gumbel(key, v.shape, v.dtype if jnp.issubdtype(v.dtype, jnp.floating) else jnp.float32)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            one_hot = (y == jnp.max(y, axis=axis, keepdims=True)).astype(y.dtype)
+            y = jax.lax.stop_gradient(one_hot - y) + y
+        return y
+
+    return apply("gumbel_softmax", f, x)

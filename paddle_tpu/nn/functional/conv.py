@@ -1,0 +1,221 @@
+"""Convolution functionals (reference: nn/functional/conv.py; CUDA kernels
+operators/conv_op.cu.cc, conv_cudnn_op.cu.cc, conv_transpose_op).
+
+TPU-native: all convs lower to lax.conv_general_dilated / conv_transpose — XLA
+tiles them onto the MXU; weight layout is paddle's [out_c, in_c/groups, *k],
+data layout NCHW or NHWC per data_format (XLA handles physical layout).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import to_tensor_like
+from ...ops.dispatch import apply
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (int, float)):
+        return (int(v),) * n
+    v = tuple(int(i) for i in v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _norm_padding(padding, n, strides, dilations, ksize):
+    """Returns jax-style padding: string 'SAME'/'VALID' or [(lo,hi)]*n."""
+    if isinstance(padding, str):
+        p = padding.upper()
+        if p in ("SAME", "VALID"):
+            return p
+        raise ValueError(f"unknown padding {padding!r}")
+    if isinstance(padding, (int, float)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, float)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    # paddle also allows [[0,0],[0,0],[a,b],[c,d]] incl. batch/channel dims
+    if len(padding) == n and all(isinstance(p, (list, tuple)) for p in padding):
+        return [tuple(int(i) for i in p) for p in padding]
+    if len(padding) == n + 2:
+        return [tuple(int(i) for i in p) for p in padding[2:]]
+    raise ValueError(f"cannot interpret padding {padding!r}")
+
+
+def _dim_numbers(ndim_spatial, channel_last):
+    if ndim_spatial == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if ndim_spatial == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _weight_perm(ndim_spatial, channel_last):
+    # paddle weight layout is always [out_c, in_c/groups, *k] (OI...)
+    if not channel_last:
+        return None
+    # to HWIO-style: spatial..., I, O
+    return tuple(range(2, 2 + ndim_spatial)) + (1, 0)
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, channel_last, n, name):
+    x, weight = to_tensor_like(x), to_tensor_like(weight)
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    ksize = weight.shape[2:]
+    pad = _norm_padding(padding, n, stride, dilation, ksize)
+    dn = _dim_numbers(n, channel_last)
+    wperm = _weight_perm(n, channel_last)
+
+    def f(v, w, *maybe_b):
+        if wperm is not None:
+            w = jnp.transpose(w, wperm)
+        out = jax.lax.conv_general_dilated(
+            v,
+            w,
+            window_strides=stride,
+            padding=pad,
+            rhs_dilation=dilation,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None,
+        )
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = -1
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply(name, f, x, weight, to_tensor_like(bias))
+    return apply(name, f, x, weight)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    data_format in ("NLC",), 1, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    data_format == "NHWC", 2, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    data_format == "NDHWC", 3, "conv3d")
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding, dilation,
+                       groups, channel_last, n, output_size, name):
+    x, weight = to_tensor_like(x), to_tensor_like(weight)
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    ksize = weight.shape[2:]
+    pad = _norm_padding(padding, n, stride, dilation, ksize)
+    out_pad = _norm_tuple(output_padding, n) if output_padding is not None else (0,) * n
+    dn = _dim_numbers(n, channel_last)
+
+    # paddle conv_transpose weight layout: [in_c, out_c/groups, *k]
+    def f(v, w, *maybe_b):
+        # grad-of-conv formulation: transposed convolution = lhs dilation
+        if channel_last:
+            wt = jnp.transpose(w, tuple(range(2, 2 + n)) + (0, 1))  # spatial, I(in), O(out)
+            # lax expects kernel as (spatial..., I, O) where I matches v channels
+        else:
+            wt = jnp.transpose(w, (1, 0) + tuple(range(2, 2 + n)))  # (out, in, spatial)
+        if isinstance(pad, str):
+            pads = None
+        else:
+            pads = pad
+        k_eff = [(k - 1) * d + 1 for k, d in zip(ksize, dilation)]
+        if pads is None:
+            if pad == "VALID":
+                pads_list = [(0, 0)] * n
+            else:  # SAME
+                pads_list = []
+                for i in range(n):
+                    total = k_eff[i] - stride[i]
+                    lo = total // 2
+                    pads_list.append((max(lo, 0), max(total - lo, 0)))
+        else:
+            pads_list = list(pads)
+        trans_pads = [
+            (k_eff[i] - 1 - pads_list[i][0],
+             k_eff[i] - 1 - pads_list[i][1] + out_pad[i])
+            for i in range(n)
+        ]
+        if groups > 1:
+            # split input channels and grouped kernels
+            ch_axis = -1 if channel_last else 1
+            vs = jnp.split(v, groups, axis=ch_axis)
+            if channel_last:
+                ws = jnp.split(wt, groups, axis=n)  # I axis
+            else:
+                ws = jnp.split(wt, groups, axis=1)
+            outs = [
+                jax.lax.conv_general_dilated(
+                    vv, jnp.flip(ww, axis=tuple(range(2, 2 + n))) if not channel_last
+                    else jnp.flip(ww, axis=tuple(range(n))),
+                    window_strides=(1,) * n,
+                    padding=trans_pads,
+                    lhs_dilation=stride,
+                    rhs_dilation=dilation,
+                    dimension_numbers=dn,
+                )
+                for vv, ww in zip(vs, ws)
+            ]
+            out = jnp.concatenate(outs, axis=ch_axis)
+        else:
+            ww = (jnp.flip(wt, axis=tuple(range(2, 2 + n))) if not channel_last
+                  else jnp.flip(wt, axis=tuple(range(n))))
+            out = jax.lax.conv_general_dilated(
+                v,
+                ww,
+                window_strides=(1,) * n,
+                padding=trans_pads,
+                lhs_dilation=stride,
+                rhs_dilation=dilation,
+                dimension_numbers=dn,
+            )
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = -1
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply(name, f, x, weight, to_tensor_like(bias))
+    return apply(name, f, x, weight)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL",
+                     name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, data_format == "NLC", 1,
+                              output_size, "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW",
+                     name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, data_format == "NHWC", 2,
+                              output_size, "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW",
+                     name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, data_format == "NDHWC", 3,
+                              output_size, "conv3d_transpose")

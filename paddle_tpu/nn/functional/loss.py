@@ -1,0 +1,370 @@
+"""Loss functionals (reference: nn/functional/loss.py; CUDA kernel
+operators/softmax_with_cross_entropy_op.cu).
+
+cross_entropy fuses log_softmax+NLL in one traced expression — XLA emits the
+same fused stable softmax-xent the reference hand-wrote in CUDA.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import to_tensor_like, value_of
+from ...ops.dispatch import apply
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    input, label = to_tensor_like(input), to_tensor_like(label)
+
+    def f(logits, lab, *maybe_w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+        n_classes = logits.shape[axis]
+        if soft_label:
+            soft = lab.astype(jnp.float32)
+            if label_smoothing > 0.0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=axis)
+            valid = jnp.ones_like(loss, dtype=jnp.bool_)
+        else:
+            idx = lab.astype(jnp.int32)
+            if idx.ndim == logp.ndim:
+                idx = jnp.squeeze(idx, axis=axis)
+            valid = idx != ignore_index
+            safe = jnp.where(valid, idx, 0)
+            if label_smoothing > 0.0:
+                one_hot = jax.nn.one_hot(safe, n_classes, axis=axis, dtype=jnp.float32)
+                soft = one_hot * (1 - label_smoothing) + label_smoothing / n_classes
+                loss = -jnp.sum(soft * logp, axis=axis)
+            else:
+                loss = -jnp.take_along_axis(
+                    logp, jnp.expand_dims(safe, axis), axis=axis
+                ).squeeze(axis)
+            loss = jnp.where(valid, loss, 0.0)
+        if maybe_w:
+            w = maybe_w[0].astype(jnp.float32)
+            if soft_label:
+                wl = jnp.sum(lab.astype(jnp.float32) * w, axis=axis)
+            else:
+                wl = jnp.take(w, safe)
+                wl = jnp.where(valid, wl, 0.0)
+            loss = loss * wl
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(wl), 1e-12)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(to_tensor_like(weight))
+    return apply("softmax_with_cross_entropy", f, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False,
+                               axis=-1):
+    out = cross_entropy(logits, label, soft_label=soft_label,
+                        ignore_index=ignore_index, reduction="none", axis=axis)
+    # reference returns loss with a trailing 1-dim
+    from ...ops.manipulation import unsqueeze
+
+    loss = unsqueeze(out, axis)
+    if return_softmax:
+        from .activation import softmax as _softmax
+
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    input, label = to_tensor_like(input), to_tensor_like(label)
+
+    def f(logp, lab, *maybe_w):
+        idx = lab.astype(jnp.int32)
+        valid = idx != ignore_index
+        safe = jnp.where(valid, idx, 0)
+        loss = -jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1).squeeze(1)
+        wl = jnp.where(valid, 1.0, 0.0)
+        if maybe_w:
+            wl = wl * jnp.take(maybe_w[0], safe)
+        loss = loss * wl
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(wl), 1e-12)
+        return _reduce(loss, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(to_tensor_like(weight))
+    return apply("nll_loss", f, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    input, label = to_tensor_like(input), to_tensor_like(label)
+    return apply("mse_loss",
+                 lambda a, b: _reduce(jnp.square(a - b), reduction), input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    input, label = to_tensor_like(input), to_tensor_like(label)
+    return apply("l1_loss", lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                 input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    input, label = to_tensor_like(input), to_tensor_like(label)
+
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        # paddle uses huber_loss * delta semantics
+        return _reduce(loss * delta, reduction)
+
+    return apply("smooth_l1_loss", f, input, label)
+
+
+def square_error_cost(input, label):
+    input, label = to_tensor_like(input), to_tensor_like(label)
+    return apply("square_error_cost", lambda a, b: jnp.square(a - b), input, label)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    input, label = to_tensor_like(input), to_tensor_like(label)
+
+    def f(p, y, *maybe_w):
+        p = jnp.clip(p.astype(jnp.float32), 1e-12, 1.0 - 1e-7)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if maybe_w:
+            loss = loss * maybe_w[0]
+        return _reduce(loss, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(to_tensor_like(weight))
+    return apply("bce_loss", f, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    logit, label = to_tensor_like(logit), to_tensor_like(label)
+
+    def f(z, y, *rest):
+        zf = z.astype(jnp.float32)
+        yf = y.astype(jnp.float32)
+        # stable: max(z,0) - z*y + log(1+exp(-|z|))
+        loss = jnp.maximum(zf, 0) - zf * yf + jnp.log1p(jnp.exp(-jnp.abs(zf)))
+        i = 0
+        if pos_weight is not None:
+            pw = rest[i]
+            i += 1
+            # stable: loss = (1-y)*z + (1 + (pw-1)*y) * log(1+exp(-|z|)) + max(-z,0))
+            log_weight = (pw - 1) * yf + 1
+            loss = (1 - yf) * zf + log_weight * (
+                jnp.log1p(jnp.exp(-jnp.abs(zf))) + jnp.maximum(-zf, 0)
+            )
+        if weight is not None:
+            loss = loss * rest[i]
+        return _reduce(loss, reduction)
+
+    args = [logit, label]
+    if pos_weight is not None:
+        args.append(to_tensor_like(pos_weight))
+    if weight is not None:
+        args.append(to_tensor_like(weight))
+    return apply("sigmoid_cross_entropy_with_logits", f, *args)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    input, label = to_tensor_like(input), to_tensor_like(label)
+
+    def f(logp, y):
+        yf = y.astype(jnp.float32)
+        loss = jnp.where(yf > 0, yf * (jnp.log(jnp.maximum(yf, 1e-30)) - logp), 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply("kldiv_loss", f, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    input, other, label = (to_tensor_like(input), to_tensor_like(other),
+                           to_tensor_like(label))
+    return apply(
+        "margin_ranking_loss",
+        lambda a, b, y: _reduce(jnp.maximum(-y * (a - b) + margin, 0.0), reduction),
+        input, other, label,
+    )
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    input, label = to_tensor_like(input), to_tensor_like(label)
+    return apply(
+        "hinge_embedding_loss",
+        lambda a, y: _reduce(
+            jnp.where(y == 1, a, jnp.maximum(margin - a, 0.0)), reduction
+        ),
+        input, label,
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean", name=None):
+    from .common import cosine_similarity
+
+    sim = cosine_similarity(input1, input2, axis=1, eps=1e-8)
+    label = to_tensor_like(label)
+    return apply(
+        "cosine_embedding_loss",
+        lambda s, y: _reduce(
+            jnp.where(y == 1, 1 - s, jnp.maximum(s - margin, 0.0)), reduction
+        ),
+        sim, label,
+    )
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    input, positive, negative = (to_tensor_like(input), to_tensor_like(positive),
+                                 to_tensor_like(negative))
+
+    def f(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v + epsilon) ** p, axis=-1) ** (1.0 / p)
+
+        dp = dist(a, pos)
+        dn = dist(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, dist(pos, neg))
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply("triplet_margin_loss", f, input, positive, negative)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    anchor, positive, labels = (to_tensor_like(anchor), to_tensor_like(positive),
+                                to_tensor_like(labels))
+
+    def f(a, pos, lab):
+        batch = a.shape[0]
+        sim = jnp.matmul(a, pos.T)
+        lab2 = lab.reshape(-1, 1)
+        target = (lab2 == lab2.T).astype(jnp.float32)
+        target = target / jnp.sum(target, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        xent = -jnp.mean(jnp.sum(target * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1)) +
+                        jnp.mean(jnp.sum(pos * pos, axis=1))) * 0.25 * 2
+        return xent + reg
+
+    return apply("npair_loss", f, anchor, positive, labels)
+
+
+def log_loss(input, label, epsilon=0.0001, name=None):
+    input, label = to_tensor_like(input), to_tensor_like(label)
+    return apply(
+        "log_loss",
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        input, label,
+    )
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss via dynamic-programming in log space (reference warpctc_op).
+
+    log_probs: [T, N, C] (paddle layout) raw logits; labels: [N, S]."""
+    log_probs = to_tensor_like(log_probs)
+    labels = to_tensor_like(labels)
+    input_lengths = to_tensor_like(input_lengths)
+    label_lengths = to_tensor_like(label_lengths)
+
+    def f(lp, lab, in_len, lab_len):
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        T, N, C = lp.shape
+        S = lab.shape[1]
+        # extended label seq: blank, l1, blank, l2, ... blank  (len 2S+1)
+        ext = jnp.full((N, 2 * S + 1), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        ext_len = 2 * lab_len.astype(jnp.int32) + 1
+        NEG = -1e30
+
+        # can-skip mask: ext[s] != blank and ext[s] != ext[s-2]
+        skip_ok = jnp.zeros((N, 2 * S + 1), dtype=bool)
+        skip_ok = skip_ok.at[:, 2:].set(
+            (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])
+        )
+
+        alpha0 = jnp.full((N, 2 * S + 1), NEG)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(N), ext[:, 0]])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0, lp[0, jnp.arange(N), ext[:, 1]], NEG)
+        )
+
+        def step(alpha, t_lp):
+            shift1 = jnp.concatenate([jnp.full((N, 1), NEG), alpha[:, :-1]], axis=1)
+            shift2 = jnp.concatenate([jnp.full((N, 2), NEG), alpha[:, :-2]], axis=1)
+            shift2 = jnp.where(skip_ok, shift2, NEG)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, shift1), shift2)
+            new = merged + jnp.take_along_axis(t_lp, ext, axis=1)
+            return new, new
+
+        _, traj = jax.lax.scan(step, alpha0, lp[1:])
+        traj = jnp.concatenate([alpha0[None], traj], axis=0)  # [T, N, 2S+1]
+        tidx = jnp.clip(in_len.astype(jnp.int32) - 1, 0, T - 1)
+        final = traj[tidx, jnp.arange(N)]  # [N, 2S+1]
+        last = jnp.take_along_axis(final, (ext_len - 1)[:, None], axis=1).squeeze(1)
+        prev = jnp.take_along_axis(final, jnp.maximum(ext_len - 2, 0)[:, None], axis=1).squeeze(1)
+        ll = jnp.logaddexp(last, jnp.where(ext_len >= 2, prev, NEG))
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(jnp.float32), 1.0))
+        return _reduce(loss, reduction)
+
+    return apply("warpctc", f, log_probs, labels, input_lengths, label_lengths)
+
+
+def dice_loss(input, label, epsilon=1e-05, name=None):
+    input, label = to_tensor_like(input), to_tensor_like(label)
+
+    def f(p, y):
+        yf = jax.nn.one_hot(y.squeeze(-1).astype(jnp.int32), p.shape[-1])
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * yf, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(yf, axis=red)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return apply("dice_loss", f, input, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    logit, label = to_tensor_like(logit), to_tensor_like(label)
+
+    def f(z, y, *maybe_n):
+        p = jax.nn.sigmoid(z.astype(jnp.float32))
+        yf = y.astype(jnp.float32)
+        ce = jnp.maximum(z, 0) - z * yf + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * yf + (1 - p) * (1 - yf)
+        a_t = alpha * yf + (1 - alpha) * (1 - yf)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if maybe_n:
+            loss = loss / maybe_n[0]
+        return _reduce(loss, reduction)
+
+    args = [logit, label]
+    if normalizer is not None:
+        args.append(to_tensor_like(normalizer))
+    return apply("sigmoid_focal_loss", f, *args)

@@ -1,0 +1,203 @@
+"""Normalization functionals (reference: nn/functional/norm.py; CUDA kernels
+operators/batch_norm_op.cu, layer_norm_op.cu, instance_norm_op.cu,
+group_norm_op.cu).
+
+XLA fuses the mean/var/normalize chain; layer_norm additionally has a Pallas
+fused kernel in ops/pallas_ops/layer_norm.py used on TPU for long rows.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops._helpers import to_tensor_like
+from ...ops.dispatch import apply
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-05, data_format="NCHW", use_global_stats=None,
+               name=None):
+    """Functional batch norm.
+
+    In training mode also *updates* running_mean/running_var in place (host-side
+    mutation of the buffer tensors, matching the reference's in-place running
+    stats; under jit use the functional_call path which threads buffers).
+    """
+    x = to_tensor_like(x)
+    rm, rv = to_tensor_like(running_mean), to_tensor_like(running_var)
+    if use_global_stats is None:
+        use_global_stats = not training
+    ch_axis = 1 if data_format in ("NCHW", "NCL", "NCDHW", "NC") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+
+    def shape_c(v, nd):
+        s = [1] * nd
+        s[ch_axis] = -1
+        return v.reshape(s)
+
+    has_w, has_b = weight is not None, bias is not None
+    extra = ([to_tensor_like(weight)] if has_w else []) + \
+            ([to_tensor_like(bias)] if has_b else [])
+
+    def _affine(out, v_ndim, wb):
+        i = 0
+        if has_w:
+            out = out * shape_c(wb[i].astype(jnp.float32), v_ndim)
+            i += 1
+        if has_b:
+            out = out + shape_c(wb[i].astype(jnp.float32), v_ndim)
+        return out
+
+    if use_global_stats:
+        def f_infer(v, m, var, *wb):
+            inv = jax.lax.rsqrt(var.astype(jnp.float32) + epsilon)
+            out = (v.astype(jnp.float32) - shape_c(m.astype(jnp.float32), v.ndim)) * shape_c(inv, v.ndim)
+            return _affine(out, v.ndim, wb).astype(v.dtype)
+
+        return apply("batch_norm", f_infer, x, rm, rv, *extra)
+
+    # training: batch statistics
+    def f_train(v, *wb):
+        vf = v.astype(jnp.float32)
+        m = jnp.mean(vf, axis=axes)
+        var = jnp.var(vf, axis=axes)
+        inv = jax.lax.rsqrt(var + epsilon)
+        out = (vf - shape_c(m, v.ndim)) * shape_c(inv, v.ndim)
+        return _affine(out, v.ndim, wb).astype(v.dtype), m, var
+
+    out, m, var = apply("batch_norm", f_train, x, *extra)
+
+    # update running stats in place (detached)
+    from ...autograd.tape import no_grad
+
+    with no_grad():
+        mom = momentum
+        new_rm = rm._value * mom + m._value.astype(rm._value.dtype) * (1 - mom)
+        new_rv = rv._value * mom + var._value.astype(rv._value.dtype) * (1 - mom)
+        rm._value = new_rm
+        rv._value = new_rv
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    x = to_tensor_like(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n_axes = len(tuple(normalized_shape))
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+
+    has_w, has_b = weight is not None, bias is not None
+
+    def f(v, *wb):
+        vf = v.astype(jnp.float32)
+        m = jnp.mean(vf, axis=axes, keepdims=True)
+        var = jnp.var(vf, axis=axes, keepdims=True)
+        out = (vf - m) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i].astype(jnp.float32)
+            i += 1
+        if has_b:
+            out = out + wb[i].astype(jnp.float32)
+        return out.astype(v.dtype)
+
+    args = [x] + ([to_tensor_like(weight)] if has_w else []) \
+               + ([to_tensor_like(bias)] if has_b else [])
+    return apply("layer_norm", f, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW",
+                  name=None):
+    x = to_tensor_like(x)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(2, x.ndim)) if ch_axis == 1 else tuple(range(1, x.ndim - 1))
+
+    has_w, has_b = weight is not None, bias is not None
+
+    def f(v, *wb):
+        vf = v.astype(jnp.float32)
+        m = jnp.mean(vf, axis=axes, keepdims=True)
+        var = jnp.var(vf, axis=axes, keepdims=True)
+        out = (vf - m) * jax.lax.rsqrt(var + eps)
+        s = [1] * v.ndim
+        s[ch_axis] = -1
+        i = 0
+        if has_w:
+            out = out * wb[i].astype(jnp.float32).reshape(s)
+            i += 1
+        if has_b:
+            out = out + wb[i].astype(jnp.float32).reshape(s)
+        return out.astype(v.dtype)
+
+    args = [x] + ([to_tensor_like(weight)] if has_w else []) \
+               + ([to_tensor_like(bias)] if has_b else [])
+    return apply("instance_norm", f, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = to_tensor_like(x)
+    channel_last = not data_format.startswith("NC")
+    ch_axis = x.ndim - 1 if channel_last else 1
+    has_w, has_b = weight is not None, bias is not None
+
+    def f(v, *wb):
+        vf = v.astype(jnp.float32)
+        if channel_last:
+            perm = (0, v.ndim - 1) + tuple(range(1, v.ndim - 1))
+            vf = jnp.transpose(vf, perm)
+        N, C = vf.shape[0], vf.shape[1]
+        rest = vf.shape[2:]
+        g = vf.reshape(N, num_groups, C // num_groups, *rest)
+        axes = tuple(range(2, g.ndim))
+        m = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - m) * jax.lax.rsqrt(var + epsilon)).reshape(N, C, *rest)
+        s = [1] * out.ndim
+        s[1] = -1
+        i = 0
+        if has_w:
+            out = out * wb[i].astype(jnp.float32).reshape(s)
+            i += 1
+        if has_b:
+            out = out + wb[i].astype(jnp.float32).reshape(s)
+        if channel_last:
+            inv = (0,) + tuple(range(2, v.ndim)) + (1,)
+            out = jnp.transpose(out, inv)
+        return out.astype(v.dtype)
+
+    args = [x] + ([to_tensor_like(weight)] if has_w else []) \
+               + ([to_tensor_like(bias)] if has_b else [])
+    return apply("group_norm", f, *args)
+
+
+def local_response_norm(x, size, alpha=0.0001, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    x = to_tensor_like(x)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+
+    def f(v):
+        sq = jnp.square(v.astype(jnp.float32))
+        half = size // 2
+        pads = [(0, 0)] * v.ndim
+        pads[ch_axis] = (half, size - 1 - half)
+        padded = jnp.pad(sq, pads)
+        window = [1] * v.ndim
+        window[ch_axis] = size
+        s = jax.lax.reduce_window(padded, 0.0, jax.lax.add, tuple(window),
+                                  (1,) * v.ndim, [(0, 0)] * v.ndim)
+        div = jnp.power(k + alpha * s, beta)
+        return (v.astype(jnp.float32) / div).astype(v.dtype)
+
+    return apply("lrn", f, x)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = to_tensor_like(x)
+
+    def f(v):
+        n = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+
+    return apply("normalize", f, x)

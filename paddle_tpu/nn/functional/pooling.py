@@ -1,0 +1,175 @@
+"""Pooling functionals (reference: nn/functional/pooling.py; operators/pool_op).
+
+lax.reduce_window is the TPU-native pooling primitive.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops._helpers import to_tensor_like
+from ...ops.dispatch import apply
+from .conv import _norm_padding, _norm_tuple
+
+
+def _pool(x, ksize, stride, padding, n, channel_last, mode, ceil_mode=False,
+          exclusive=True, name="pool"):
+    x = to_tensor_like(x)
+    ksize = _norm_tuple(ksize, n)
+    stride = _norm_tuple(stride if stride is not None else ksize, n)
+    pad = _norm_padding(padding, n, stride, (1,) * n, ksize)
+
+    if channel_last:
+        window = (1,) + ksize + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        window = (1, 1) + ksize
+        strides = (1, 1) + stride
+
+    if isinstance(pad, str):
+        pads = pad
+    else:
+        spatial = list(pad)
+        pads = ([(0, 0)] + spatial + [(0, 0)]) if channel_last else [(0, 0), (0, 0)] + spatial
+
+    def f(v):
+        if mode == "max":
+            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
+            return jax.lax.reduce_window(v, init, jax.lax.max, window, strides, pads)
+        # avg
+        ones = jnp.ones_like(v)
+        s = jax.lax.reduce_window(v, 0.0 if jnp.issubdtype(v.dtype, jnp.floating) else 0,
+                                  jax.lax.add, window, strides, pads)
+        if exclusive:
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+            return s / cnt
+        return s / float(np.prod(ksize))
+
+    return apply(name, f, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC", "max",
+                 ceil_mode, name="max_pool1d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC", "max",
+                ceil_mode, name="max_pool2d")
+    if return_mask:
+        # indices of max within each window (flattened spatial index)
+        x_t = to_tensor_like(x)
+        ks = _norm_tuple(kernel_size, 2)
+        st = _norm_tuple(stride if stride is not None else kernel_size, 2)
+
+        def idx_f(v):
+            N, C, H, W = v.shape
+            lin = jnp.arange(H * W, dtype=jnp.float32).reshape(1, 1, H, W)
+            lin = jnp.broadcast_to(lin, v.shape)
+            # argmax trick: pack value and index
+            pad_spec = _norm_padding(padding, 2, st, (1, 1), ks)
+            spatial = pad_spec if not isinstance(pad_spec, str) else None
+            pads = [(0, 0), (0, 0)] + (spatial if spatial else [(0, 0), (0, 0)])
+
+            def sel(a, b):
+                av, ai = a
+                bv, bi = b
+                pick = bv > av
+                return jnp.where(pick, bv, av), jnp.where(pick, bi, ai)
+
+            init = (jnp.array(-jnp.inf, v.dtype), jnp.array(-1.0))
+            vals, idxs = jax.lax.reduce_window(
+                (v, lin), init, sel, (1, 1) + ks, (1, 1) + st, pads
+            )
+            return idxs.astype(jnp.int32)
+
+        idx = apply("max_pool2d_index", idx_f, x_t)
+        return out, idx
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC", "max",
+                 ceil_mode, name="max_pool3d")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC", "avg",
+                 ceil_mode, exclusive, name="avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC", "avg",
+                 ceil_mode, exclusive, name="avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC", "avg",
+                 ceil_mode, exclusive, name="avg_pool3d")
+
+
+def _adaptive_pool(x, output_size, n, channel_last, mode, name):
+    x = to_tensor_like(x)
+    out_size = _norm_tuple(output_size, n)
+
+    def f(v):
+        spatial_off = 1 if channel_last else 2
+        res = v
+        for d in range(n):
+            axis = spatial_off + d
+            in_sz = v.shape[axis]
+            o = out_size[d]
+            if o is None:
+                continue
+            if in_sz % o == 0:
+                k = in_sz // o
+                shape = res.shape[:axis] + (o, k) + res.shape[axis + 1 :]
+                res = res.reshape(shape)
+                res = jnp.max(res, axis=axis + 1) if mode == "max" else jnp.mean(res, axis=axis + 1)
+            else:
+                # general adaptive: per-output-bin reduce
+                starts = (np.arange(o) * in_sz) // o
+                ends = ((np.arange(o) + 1) * in_sz + o - 1) // o
+                pieces = [
+                    (jnp.max if mode == "max" else jnp.mean)(
+                        jax.lax.slice_in_dim(res, int(s), int(e), axis=axis),
+                        axis=axis, keepdims=True)
+                    for s, e in zip(starts, ends)
+                ]
+                res = jnp.concatenate(pieces, axis=axis)
+        return res
+
+    return apply(name, f, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, False, "avg", "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format == "NHWC", "avg",
+                          "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format == "NDHWC", "avg",
+                          "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, False, "max", "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, False, "max", "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, False, "max", "adaptive_max_pool3d")

@@ -1,0 +1,211 @@
+"""Weight initializers (reference: fluid/initializer.py).
+
+Initializers are callables that fill a Parameter in place using the global
+PRNG (framework.random).  fan_in/fan_out computed paddle-style: dim 0 = fan_in
+for 2-D weights [in, out] (paddle Linear stores weight as [in_features,
+out_features]); conv weights are [out_c, in_c, *k].
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.random import next_rng_key
+from ..tensor import Tensor
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) < 2:
+        fan_in = fan_out = int(shape[0]) if shape else 1
+    elif len(shape) == 2:
+        fan_in, fan_out = int(shape[0]), int(shape[1])
+    else:
+        receptive = int(np.prod(shape[2:]))
+        fan_in = int(shape[1]) * receptive
+        fan_out = int(shape[0]) * receptive
+    return fan_in, fan_out
+
+
+class Initializer:
+    def __call__(self, param: Tensor, block=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, param, block=None):
+        param._value = jnp.full(param._value.shape, self.value, param._value.dtype)
+        return param
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, param, block=None):
+        arr = self.value.numpy() if isinstance(self.value, Tensor) else np.asarray(self.value)
+        param._value = jnp.asarray(arr, dtype=param._value.dtype).reshape(param._value.shape)
+        return param
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, param, block=None):
+        k = next_rng_key()
+        v = jax.random.normal(k, param._value.shape, jnp.float32) * self.std + self.mean
+        param._value = v.astype(param._value.dtype)
+        return param
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, param, block=None):
+        k = next_rng_key()
+        v = jax.random.truncated_normal(k, -2.0, 2.0, param._value.shape, jnp.float32)
+        param._value = (v * self.std + self.mean).astype(param._value.dtype)
+        return param
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, param, block=None):
+        k = next_rng_key()
+        v = jax.random.uniform(k, param._value.shape, jnp.float32, self.low, self.high)
+        param._value = v.astype(param._value.dtype)
+        return param
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, param, block=None):
+        fi, fo = _fans(param._value.shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        k = next_rng_key()
+        v = jax.random.normal(k, param._value.shape, jnp.float32) * std
+        param._value = v.astype(param._value.dtype)
+        return param
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, param, block=None):
+        fi, fo = _fans(param._value.shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        k = next_rng_key()
+        v = jax.random.uniform(k, param._value.shape, jnp.float32, -limit, limit)
+        param._value = v.astype(param._value.dtype)
+        return param
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self._fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, param, block=None):
+        fi, _ = _fans(param._value.shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope**2))
+        std = gain / math.sqrt(fi)
+        k = next_rng_key()
+        v = jax.random.normal(k, param._value.shape, jnp.float32) * std
+        param._value = v.astype(param._value.dtype)
+        return param
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self._fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, param, block=None):
+        fi, _ = _fans(param._value.shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope**2))
+        limit = gain * math.sqrt(3.0 / fi)
+        k = next_rng_key()
+        v = jax.random.uniform(k, param._value.shape, jnp.float32, -limit, limit)
+        param._value = v.astype(param._value.dtype)
+        return param
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, param, block=None):
+        shape = param._value.shape
+        rows = shape[0]
+        cols = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        k = next_rng_key()
+        a = jax.random.normal(k, (max(rows, cols), min(rows, cols)), jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        param._value = (self.gain * q[:rows, :cols]).reshape(shape).astype(param._value.dtype)
+        return param
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, param, block=None):
+        shape = param._value.shape
+        v = np.zeros(shape, dtype=np.float32)
+        out_per_group = shape[0] // self.groups
+        minc = min(out_per_group, shape[1])
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for i in range(minc):
+                idx = (g * out_per_group + i, i) + tuple(centers)
+                v[idx] = 1.0
+        param._value = jnp.asarray(v, dtype=param._value.dtype)
+        return param
+
+
+def calculate_gain(nonlinearity, param=None):
+    gains = {
+        "sigmoid": 1.0,
+        "linear": 1.0,
+        "conv1d": 1.0,
+        "conv2d": 1.0,
+        "conv3d": 1.0,
+        "tanh": 5.0 / 3,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4,
+    }
+    return gains[nonlinearity]
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Stored hint consumed by Layer.create_parameter defaults."""
+    global _GLOBAL_WEIGHT_INIT, _GLOBAL_BIAS_INIT
+    _GLOBAL_WEIGHT_INIT, _GLOBAL_BIAS_INIT = weight_init, bias_init
+
+
+_GLOBAL_WEIGHT_INIT = None
+_GLOBAL_BIAS_INIT = None

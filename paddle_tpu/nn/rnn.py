@@ -1,0 +1,382 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py; CUDA kernels
+operators/rnn_op / cudnn_lstm).
+
+TPU-native design: the whole sequence loop is ONE op — a lax.scan inside a
+single dispatched function — instead of the reference's per-timestep op chain.
+XLA unrolls/pipelines the scan on TPU; the tape records one GradNode per
+layer-direction, so eager backward is cheap too.
+
+Layout: time_major=False → [batch, time, size] (paddle default).
+Gate orders match paddle: LSTM [i, f, g, o]; GRU [r, z, c].
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..ops._helpers import to_tensor_like
+from ..ops.dispatch import apply
+from . import initializer as init
+from .layer import Layer
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        from ..ops.creation import full
+
+        batch = to_tensor_like(batch_ref).shape[batch_dim_idx]
+        return full([batch, self.hidden_size], init_value, dtype)
+
+
+def _make_cell_params(layer, input_size, hidden_size, n_gates, weight_ih_attr,
+                      weight_hh_attr, bias_ih_attr, bias_hh_attr):
+    std = 1.0 / math.sqrt(hidden_size)
+    u = init.Uniform(-std, std)
+    layer.weight_ih = layer.create_parameter(
+        [n_gates * hidden_size, input_size], attr=weight_ih_attr,
+        default_initializer=u)
+    layer.weight_hh = layer.create_parameter(
+        [n_gates * hidden_size, hidden_size], attr=weight_hh_attr,
+        default_initializer=u)
+    if bias_ih_attr is not False:
+        layer.bias_ih = layer.create_parameter(
+            [n_gates * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        layer.add_parameter("bias_ih", layer.bias_ih)
+    else:
+        layer.bias_ih = None
+    if bias_hh_attr is not False:
+        layer.bias_hh = layer.create_parameter(
+            [n_gates * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=u)
+        layer.add_parameter("bias_hh", layer.bias_hh)
+    else:
+        layer.bias_hh = None
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        _make_cell_params(self, input_size, hidden_size, 1, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        inputs, states = to_tensor_like(inputs), to_tensor_like(states)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, w_ih, w_hh, *biases):
+            z = x @ w_ih.T + h @ w_hh.T
+            for b in biases:
+                z = z + b
+            return act(z)
+
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        args += [b for b in (self.bias_ih, self.bias_hh) if b is not None]
+        h = apply("simple_rnn_cell", f, *args)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        _make_cell_params(self, input_size, hidden_size, 4, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        from ..ops.creation import zeros
+
+        if states is None:
+            b = to_tensor_like(inputs).shape[0]
+            states = (zeros([b, self.hidden_size]), zeros([b, self.hidden_size]))
+        h, c = states
+        inputs = to_tensor_like(inputs)
+
+        def f(x, hh, cc, w_ih, w_hh, *biases):
+            z = x @ w_ih.T + hh @ w_hh.T
+            for bb in biases:
+                z = z + bb
+            i, fgate, g, o = jnp.split(z, 4, axis=-1)
+            i, fgate, o = jax.nn.sigmoid(i), jax.nn.sigmoid(fgate), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            new_c = fgate * cc + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_c
+
+        args = [inputs, h, c, self.weight_ih, self.weight_hh]
+        args += [b for b in (self.bias_ih, self.bias_hh) if b is not None]
+        new_h, new_c = apply("lstm_cell", f, *args)
+        return new_h, (new_h, new_c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        _make_cell_params(self, input_size, hidden_size, 3, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        inputs, h = to_tensor_like(inputs), to_tensor_like(states)
+
+        def f(x, hh, w_ih, w_hh, *biases):
+            gi = x @ w_ih.T
+            gh = hh @ w_hh.T
+            b_ih = biases[0] if len(biases) > 0 else 0
+            b_hh = biases[1] if len(biases) > 1 else 0
+            gi = gi + b_ih
+            gh = gh + b_hh
+            ri, zi, ci = jnp.split(gi, 3, axis=-1)
+            rh, zh, ch = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ri + rh)
+            z = jax.nn.sigmoid(zi + zh)
+            c = jnp.tanh(ci + r * ch)
+            return (1 - z) * c + z * hh
+
+        args = [inputs, h, self.weight_ih, self.weight_hh]
+        args += [b for b in (self.bias_ih, self.bias_hh) if b is not None]
+        new_h = apply("gru_cell", f, *args)
+        return new_h, new_h
+
+
+class RNN(Layer):
+    """Wraps a cell into a full-sequence scan (reference rnn.py:RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        inputs = to_tensor_like(inputs)
+        time_axis = 0 if self.time_major else 1
+        steps = inputs.shape[time_axis]
+        outputs = []
+        states = initial_states
+        idx = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        from ..ops.manipulation import stack
+
+        for t in idx:
+            x_t = inputs[:, t] if time_axis == 1 else inputs[t]
+            out, states = self.cell(x_t, states)
+            outputs.append(out)
+        if self.is_reverse:
+            outputs = outputs[::-1]
+        out = stack(outputs, axis=time_axis)
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.manipulation import concat
+
+        states_fw, states_bw = (initial_states if initial_states is not None
+                                else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _MultiLayerRNN(Layer):
+    """Stacked multi-layer (bi)directional recurrent net executed as fused
+    per-layer scans."""
+
+    MODE = "RNN_TANH"
+    N_GATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dirs = 2 if self.bidirect else 1
+        std = 1.0 / math.sqrt(hidden_size)
+        u = init.Uniform(-std, std)
+        self._param_names = []
+        for l in range(num_layers):
+            layer_in = input_size if l == 0 else hidden_size * num_dirs
+            for d in range(num_dirs):
+                suffix = f"l{l}" + ("_reverse" if d == 1 else "")
+                w_ih = self.create_parameter(
+                    [self.N_GATES * hidden_size, layer_in], attr=weight_ih_attr,
+                    default_initializer=u)
+                w_hh = self.create_parameter(
+                    [self.N_GATES * hidden_size, hidden_size], attr=weight_hh_attr,
+                    default_initializer=u)
+                b_ih = self.create_parameter(
+                    [self.N_GATES * hidden_size], attr=bias_ih_attr, is_bias=True,
+                    default_initializer=u)
+                b_hh = self.create_parameter(
+                    [self.N_GATES * hidden_size], attr=bias_hh_attr, is_bias=True,
+                    default_initializer=u)
+                self.add_parameter(f"weight_ih_{suffix}", w_ih)
+                self.add_parameter(f"weight_hh_{suffix}", w_hh)
+                self.add_parameter(f"bias_ih_{suffix}", b_ih)
+                self.add_parameter(f"bias_hh_{suffix}", b_hh)
+                self._param_names.append(suffix)
+
+    # cell math on raw arrays; h/c: [B, H]; x: [B, I]
+    def _step(self, x, state, w_ih, w_hh, b_ih, b_hh):
+        raise NotImplementedError
+
+    def _init_state(self, batch):
+        raise NotImplementedError
+
+    def _scan_direction(self, seq, suffix, reverse, state0):
+        """seq: Tensor [T, B, I] (time-major internally). Single apply call."""
+        w_ih = getattr(self, f"weight_ih_{suffix}")
+        w_hh = getattr(self, f"weight_hh_{suffix}")
+        b_ih = getattr(self, f"bias_ih_{suffix}")
+        b_hh = getattr(self, f"bias_hh_{suffix}")
+        step = self._step
+        state_leaves = state0 if isinstance(state0, tuple) else (state0,)
+        tuple_state = isinstance(state0, tuple)
+
+        def f(xs, wi, wh, bi, bh, *s0):
+            s0 = s0 if tuple_state else s0[0]
+
+            def body(carry, x):
+                new = step(x, carry, wi, wh, bi, bh)
+                out = new[0] if isinstance(new, tuple) else new
+                return new, out
+
+            carry, ys = jax.lax.scan(body, s0, xs, reverse=reverse)
+            return ys, carry
+
+        return apply(f"{self.MODE.lower()}_scan", f, seq, w_ih, w_hh,
+                     b_ih, b_hh, *state_leaves)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.manipulation import concat, stack, transpose
+        from ..tensor import Tensor
+
+        inputs = to_tensor_like(inputs)
+        x = inputs if self.time_major else transpose(inputs, [1, 0, 2])
+        batch = x.shape[1]
+        num_dirs = 2 if self.bidirect else 1
+
+        init_states = self._prepare_states(initial_states, batch, num_dirs)
+        final_states = []
+        for l in range(self.num_layers):
+            outs = []
+            for d in range(num_dirs):
+                suffix = f"l{l}" + ("_reverse" if d == 1 else "")
+                s0 = init_states[l * num_dirs + d]
+                ys, carry = self._scan_direction(x, suffix, d == 1, s0)
+                outs.append(ys)
+                final_states.append(carry)
+            x = outs[0] if num_dirs == 1 else concat(outs, axis=-1)
+            if self.dropout > 0 and l < self.num_layers - 1:
+                from . import functional as F
+
+                x = F.dropout(x, self.dropout, training=self.training)
+        out = x if self.time_major else transpose(x, [1, 0, 2])
+        states = self._collect_states(final_states)
+        return out, states
+
+    def _prepare_states(self, initial_states, batch, num_dirs):
+        raise NotImplementedError
+
+    def _collect_states(self, finals):
+        raise NotImplementedError
+
+
+class SimpleRNN(_MultiLayerRNN):
+    MODE = "RNN_TANH"
+    N_GATES = 1
+
+    def _step(self, x, h, w_ih, w_hh, b_ih, b_hh):
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        return act(x @ w_ih.T + b_ih + h @ w_hh.T + b_hh)
+
+    def _prepare_states(self, initial_states, batch, num_dirs):
+        from ..ops.creation import zeros
+
+        n = self.num_layers * num_dirs
+        if initial_states is None:
+            return [zeros([batch, self.hidden_size]) for _ in range(n)]
+        # [L*D, B, H] tensor
+        return [initial_states[i] for i in range(n)]
+
+    def _collect_states(self, finals):
+        from ..ops.manipulation import stack
+
+        return stack(finals, axis=0)
+
+
+class GRU(SimpleRNN):
+    MODE = "GRU"
+    N_GATES = 3
+
+    def _step(self, x, h, w_ih, w_hh, b_ih, b_hh):
+        gi = x @ w_ih.T + b_ih
+        gh = h @ w_hh.T + b_hh
+        ri, zi, ci = jnp.split(gi, 3, axis=-1)
+        rh, zh, ch = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ri + rh)
+        z = jax.nn.sigmoid(zi + zh)
+        c = jnp.tanh(ci + r * ch)
+        return (1 - z) * c + z * h
+
+
+class LSTM(_MultiLayerRNN):
+    MODE = "LSTM"
+    N_GATES = 4
+
+    def _step(self, x, state, w_ih, w_hh, b_ih, b_hh):
+        h, c = state
+        z = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        new_c = f * c + i * g
+        new_h = o * jnp.tanh(new_c)
+        return (new_h, new_c)
+
+    def _prepare_states(self, initial_states, batch, num_dirs):
+        from ..ops.creation import zeros
+
+        n = self.num_layers * num_dirs
+        if initial_states is None:
+            return [
+                (zeros([batch, self.hidden_size]), zeros([batch, self.hidden_size]))
+                for _ in range(n)
+            ]
+        h0, c0 = initial_states
+        return [(h0[i], c0[i]) for i in range(n)]
+
+    def _collect_states(self, finals):
+        from ..ops.manipulation import stack
+
+        hs = stack([f[0] for f in finals], axis=0)
+        cs = stack([f[1] for f in finals], axis=0)
+        return (hs, cs)

@@ -1,0 +1,52 @@
+"""Shared helpers for the op modules."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dt
+from ..tensor import Tensor
+
+
+def to_tensor_like(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x))
+
+
+def value_of(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return x
+
+
+def norm_axis(axis):
+    """Paddle accepts int, list, tuple, or None for axis."""
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    if isinstance(axis, Tensor):
+        return tuple(int(v) for v in np.asarray(axis._value).reshape(-1))
+    return int(axis)
+
+
+def norm_shape(shape):
+    """Paddle shapes may be ints, lists, tuples, or Tensors."""
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape._value).reshape(-1))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    out = []
+    for s in shape:
+        if isinstance(s, Tensor):
+            out.append(int(np.asarray(s._value)))
+        else:
+            out.append(int(s))
+    return tuple(out)
+
+
+def resolve_dtype(dtype, default=None):
+    if dtype is None:
+        return default if default is not None else _dt.get_default_dtype()
+    return _dt.convert_dtype(dtype)

@@ -1,0 +1,103 @@
+"""Attention ops.
+
+scaled_dot_product_attention: XLA-fused attention (einsum+softmax chain — XLA
+fuses; fine for short/medium sequences).
+flash_attention: tiled online-softmax attention; on TPU uses the Pallas kernel
+(ops/pallas_ops/flash_attention.py), with a lax fallback elsewhere.
+
+Reference: absent in the reference (SURVEY §5.7 — vanilla MultiHeadAttention
+materializing full QK^T, nn/layer/transformer.py:115); this is a new
+TPU-native capability.
+
+Layout: [batch, seq, num_heads, head_dim] (paddle's MHA internal layout after
+head split is [B, H, S, D]; we accept BSHD and transpose internally).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.random import next_rng_key
+from ..tensor import Tensor
+from ._helpers import to_tensor_like
+from .dispatch import apply
+
+
+def _sdpa_core(q, k, v, mask, dropout_p, is_causal, key, scale=None):
+    # q,k,v: [B, H, S, D]
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d**0.5)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * s
+    if is_causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        logits = jnp.where(causal, logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """Inputs [B, S, H, D] (paddle convention); returns [B, S, H, D]."""
+    query, key, value = (to_tensor_like(query), to_tensor_like(key),
+                         to_tensor_like(value))
+    rng = next_rng_key() if (dropout_p > 0.0 and training) else None
+
+    def f(q, k, v, *maybe_mask):
+        qt = jnp.swapaxes(q, 1, 2)
+        kt = jnp.swapaxes(k, 1, 2)
+        vt = jnp.swapaxes(v, 1, 2)
+        m = maybe_mask[0] if maybe_mask else None
+        out = _sdpa_core(qt, kt, vt, m, dropout_p if training else 0.0, is_causal, rng)
+        return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+    if attn_mask is not None:
+        return apply("scaled_dot_product_attention", f, query, key, value,
+                     to_tensor_like(attn_mask))
+    return apply("scaled_dot_product_attention", f, query, key, value)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, name=None):
+    """Flash attention entry: [B, S, H, D] inputs.
+
+    Uses the Pallas TPU kernel when running on TPU with supported shapes;
+    otherwise falls back to the fused XLA path (same math).
+    """
+    query, key, value = (to_tensor_like(query), to_tensor_like(key),
+                         to_tensor_like(value))
+    use_pallas = _pallas_ok(query)
+    rng = next_rng_key() if dropout > 0.0 else None
+
+    if use_pallas and dropout == 0.0:
+        from .pallas_ops.flash_attention import flash_attention_bshd
+
+        def f(q, k, v):
+            return flash_attention_bshd(q, k, v, causal=causal)
+
+        out = apply("flash_attention", f, query, key, value)
+    else:
+        out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
+                                           is_causal=causal)
+    if return_softmax:
+        return out, None
+    return out
+
+
+def _pallas_ok(q) -> bool:
+    try:
+        dev = list(q._value.devices())[0]
+        if dev.platform != "tpu":
+            return False
+    except Exception:
+        return False
+    B, S, H, D = q.shape
+    return S % 128 == 0 and D in (64, 128, 256)

@@ -1,0 +1,166 @@
+"""Tensor creation ops (reference: paddle.tensor.creation / fill_constant etc.)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dt
+from ..framework.random import next_rng_key
+from ..tensor import Parameter, Tensor
+from ._helpers import norm_shape, resolve_dtype, to_tensor_like, value_of
+from .dispatch import apply
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    if isinstance(data, Tensor):
+        arr = data._value
+    else:
+        arr = np.asarray(data)
+        if arr.dtype == np.float64 and dtype is None:
+            arr = arr.astype(np.float32)  # paddle default_dtype convention
+        arr = jnp.asarray(arr)
+    if dtype is not None:
+        arr = arr.astype(_dt.convert_dtype(dtype))
+    if place is not None:
+        arr = jax.device_put(arr, place.jax_device)
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros(norm_shape(shape), resolve_dtype(dtype)))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones(norm_shape(shape), resolve_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    fill_value = value_of(fill_value)
+    return Tensor(jnp.full(norm_shape(shape), fill_value, resolve_dtype(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    x = to_tensor_like(x)
+    d = _dt.convert_dtype(dtype) if dtype is not None else x._value.dtype
+    return Tensor(jnp.zeros(x._value.shape, d))
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    x = to_tensor_like(x)
+    d = _dt.convert_dtype(dtype) if dtype is not None else x._value.dtype
+    return Tensor(jnp.ones(x._value.shape, d))
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    x = to_tensor_like(x)
+    d = _dt.convert_dtype(dtype) if dtype is not None else x._value.dtype
+    return Tensor(jnp.full(x._value.shape, value_of(fill_value), d))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    start, end, step = value_of(start), value_of(end), value_of(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        vals = (start, end, step)
+        dtype = (
+            np.dtype("int64")
+            if all(float(v) == int(v) for v in map(float, vals))
+            else _dt.get_default_dtype()
+        )
+    else:
+        dtype = _dt.convert_dtype(dtype)
+    return Tensor(jnp.arange(start, end, step, dtype=dtype))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    return Tensor(
+        jnp.linspace(value_of(start), value_of(stop), int(num),
+                     dtype=resolve_dtype(dtype))
+    )
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    return Tensor(
+        jnp.logspace(value_of(start), value_of(stop), int(num), base=base,
+                     dtype=resolve_dtype(dtype))
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.eye(int(num_rows),
+                          None if num_columns is None else int(num_columns),
+                          dtype=resolve_dtype(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    x = to_tensor_like(x)
+    if x.ndim == 1 and padding_value != 0:
+        def f(v):
+            n = v.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, v.dtype)
+            d = jnp.diag(v, k=offset)
+            mask = jnp.eye(n, k=offset, dtype=bool)
+            return jnp.where(mask, d, base)
+        return apply("diag", f, x)
+    return apply("diag", lambda v: jnp.diag(v, k=offset), x)
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    x = to_tensor_like(x)
+    return apply("diagflat", lambda v: jnp.diagflat(v, k=offset), x)
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    return apply("tril", lambda v: jnp.tril(v, k=diagonal), to_tensor_like(x))
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    return apply("triu", lambda v: jnp.triu(v, k=diagonal), to_tensor_like(x))
+
+
+def meshgrid(*args, **kwargs):
+    tensors = [to_tensor_like(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    outs = jnp.meshgrid(*[t._value for t in tensors], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None) -> Tensor:
+    x = to_tensor_like(x)
+    out = apply("assign", lambda v: v + 0 if jnp.issubdtype(v.dtype, jnp.number) else v, x)
+    if output is not None:
+        output._replace_from(out)
+        return output
+    return out
+
+
+def clone(x) -> Tensor:
+    return to_tensor_like(x).clone()
+
+
+def numel(x) -> Tensor:
+    return Tensor(jnp.asarray(to_tensor_like(x).size, dtype=jnp.int64))
+
+
+def create_parameter(shape, dtype=None, name=None, attr=None, is_bias=False,
+                     default_initializer=None) -> Parameter:
+    from ..nn import initializer as init
+
+    d = resolve_dtype(dtype)
+    p = Parameter(jnp.zeros(norm_shape(shape), d), name=name)
+    if default_initializer is not None:
+        default_initializer(p)
+    elif is_bias:
+        init.Constant(0.0)(p)
+    else:
+        init.XavierNormal()(p)
+    return p

@@ -1,0 +1,187 @@
+"""Eager op dispatcher.
+
+Reference analog: imperative::Tracer::TraceOp
+(/root/reference/paddle/fluid/imperative/tracer.cc:132) + the generated
+``core.ops`` fast path (pybind/op_function_generator.cc:529).  On TPU there is
+no per-op kernel registry to dispatch into: every op *is* a jax function, and
+XLA owns kernel choice.  ``apply`` runs the function eagerly and, when grad is
+required, records a GradNode holding the op's ``jax.vjp`` closure
+(tracer.cc:205 CreateGradOpNode analog).
+
+FLAGS_check_nan_inf reproduces the reference's per-op NaN/Inf sweep
+(details/nan_inf_utils_detail.cc) on eager outputs.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd.tape import Edge, GradNode, is_grad_enabled, no_grad
+from ..framework import dtype as _dtype_mod
+from ..framework.flags import flag_value
+
+
+def _tensor_cls():
+    from ..tensor import Tensor
+
+    return Tensor
+
+
+def _amp_should_cast(name):
+    """AMP autocast hook (tracer.cc:160 AutoCastInputs analog)."""
+    try:
+        from ..amp.auto_cast import should_cast
+    except ImportError:
+        return None
+    return should_cast(name)
+
+
+def wrap(value, stop_gradient=True, node=None, index=0):
+    Tensor = _tensor_cls()
+    t = Tensor(value, stop_gradient=stop_gradient)
+    if node is not None:
+        t._grad_node = node
+        t._out_index = index
+        t.stop_gradient = False
+    return t
+
+
+def _is_diff_dtype(arr) -> bool:
+    return jnp.issubdtype(arr.dtype, jnp.floating) or jnp.issubdtype(
+        arr.dtype, jnp.complexfloating
+    )
+
+
+def _check_nan_inf(name, flat_outs):
+    for i, o in enumerate(flat_outs):
+        if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.floating):
+            if not bool(jnp.all(jnp.isfinite(o))):
+                raise FloatingPointError(
+                    f"Operator {name} output #{i} contains NaN or Inf "
+                    "(FLAGS_check_nan_inf is set)"
+                )
+
+
+def apply(name, fn, *args, n_outputs=None, **kwargs):
+    """Run ``fn(*arrays, **kwargs)`` eagerly; record vjp if needed.
+
+    ``args`` may mix Tensors and raw values; ``kwargs`` are static attrs.
+    Returns Tensor or tuple of Tensors mirroring fn's output structure
+    (only flat tuples/lists of arrays or a single array are supported).
+    """
+    Tensor = _tensor_cls()
+    cast_to = _amp_should_cast(name)
+    arrays = []
+    tracked_idx = []
+    for i, a in enumerate(args):
+        if isinstance(a, Tensor):
+            v = a._value
+            if cast_to is not None and jnp.issubdtype(v.dtype, jnp.floating) \
+                    and v.dtype != cast_to:
+                v = v.astype(cast_to)
+            arrays.append(v)
+            if a._tracked and _is_diff_dtype(a._value):
+                tracked_idx.append(i)
+        else:
+            arrays.append(a)
+
+    record = is_grad_enabled() and bool(tracked_idx)
+
+    if not record:
+        out = fn(*arrays, **kwargs)
+        if flag_value("check_nan_inf"):
+            flat, _ = jax.tree_util.tree_flatten(out)
+            _check_nan_inf(name, flat)
+        return _wrap_outputs(out, stop_gradient=True)
+
+    def closed(*diff_vals):
+        call = list(arrays)
+        for i, v in zip(tracked_idx, diff_vals):
+            call[i] = v
+        return fn(*call, **kwargs)
+
+    primals = [arrays[i] for i in tracked_idx]
+    try:
+        out, vjp_fn = jax.vjp(closed, *primals)
+    except Exception as e:
+        raise type(e)(f"[operator < {name} >] {e}") from e
+    if flag_value("check_nan_inf"):
+        flat, _ = jax.tree_util.tree_flatten(out)
+        _check_nan_inf(name, flat)
+
+    flat_out, treedef = jax.tree_util.tree_flatten(out)
+    out_avals = [(o.shape, o.dtype) for o in flat_out]
+    edges = [Edge(args[i]) for i in tracked_idx]
+    node = GradNode(name, vjp_fn, edges, out_avals, treedef, fwd_fn=closed)
+    wrapped = [wrap(o, node=node, index=i) for i, o in enumerate(flat_out)]
+    if _is_single(out):
+        return wrapped[0]
+    return jax.tree_util.tree_unflatten(treedef, wrapped)
+
+
+def _is_single(out):
+    return not isinstance(out, (tuple, list))
+
+
+def _wrap_outputs(out, stop_gradient=True):
+    Tensor = _tensor_cls()
+    if _is_single(out):
+        return Tensor(out, stop_gradient=stop_gradient)
+    flat, treedef = jax.tree_util.tree_flatten(out)
+    return jax.tree_util.tree_unflatten(
+        treedef, [Tensor(o, stop_gradient=stop_gradient) for o in flat]
+    )
+
+
+def apply_vjp(node: GradNode, flat_cts: List, create_graph: bool):
+    """Run a node's vjp closure on cotangent Tensors.
+
+    With ``create_graph`` the vjp call itself is dispatched through ``apply``
+    so the backward computation is recorded (double grad —
+    partial_grad_engine.cc analog); otherwise it runs unrecorded.
+    """
+    Tensor = _tensor_cls()
+    treedef = node.out_treedef
+    vjp_fn = node.vjp_fn
+    n_in = len(node.edges)
+
+    if create_graph and node.fwd_fn is not None:
+        # re-derive the vjp as a function of (primals, cotangents) so the
+        # recorded backward depends on the primals — grad-of-grad flows
+        # (partial_grad_engine.cc create_graph analog).
+        fwd = node.fwd_fn
+
+        def h(*args):
+            primals = args[:n_in]
+            cts = args[n_in:]
+            _, inner_vjp = jax.vjp(fwd, *primals)
+            ct_struct = jax.tree_util.tree_unflatten(treedef, list(cts))
+            return tuple(inner_vjp(ct_struct))
+
+        primal_tensors = [e.tensor for e in node.edges]
+        out = apply(f"grad[{node.name}]", h, *primal_tensors, *flat_cts)
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        return list(out)
+
+    def run(*ct_arrays):
+        ct_struct = jax.tree_util.tree_unflatten(treedef, list(ct_arrays))
+        res = vjp_fn(ct_struct)
+        return tuple(res)
+
+    with no_grad():
+        ct_arrays = [c._value for c in flat_cts]
+        res = run(*ct_arrays)
+        return [Tensor(r, stop_gradient=True) for r in res]
+
+
+def accumulate_grad(a, b, create_graph: bool):
+    """Gradient accumulation (gradient_accumulator.cc analog)."""
+    Tensor = _tensor_cls()
+    if create_graph:
+        return apply("grad_accumulate", jnp.add, a, b)
+    with no_grad():
+        return Tensor(jnp.add(a._value, b._value), stop_gradient=True)

@@ -1,0 +1,249 @@
+"""Linear algebra ops (reference: paddle.tensor.linalg; operators/matmul_v2_op).
+
+matmul runs on the MXU; precision is governed by FLAGS_tpu_matmul_precision
+('default' = bf16 inputs accumulate in f32 on TPU — the fast path).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.flags import flag_value
+from ..tensor import Tensor
+from ._helpers import norm_axis, to_tensor_like
+from .dispatch import apply
+
+
+def _precision():
+    p = flag_value("tpu_matmul_precision")
+    return None if p == "default" else p
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b, precision=_precision())
+
+    return apply("matmul_v2", f, x, y)
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+    return apply("dot", lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def matmul_with_flatten(x, y, x_num_col_dims=1, name=None):
+    """reference mul_op: flatten x to 2-D then matmul."""
+    x, y = to_tensor_like(x), to_tensor_like(y)
+
+    def f(a, b):
+        lead = 1
+        for d in a.shape[:x_num_col_dims]:
+            lead *= d
+        a2 = a.reshape(lead, -1)
+        return jnp.matmul(a2, b, precision=_precision()).reshape(
+            a.shape[:x_num_col_dims] + (b.shape[-1],)
+        )
+
+    return apply("mul", f, x, y)
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    x = to_tensor_like(x)
+    ax = norm_axis(axis)
+
+    def f(v):
+        if p == "fro":
+            if ax is None:
+                return jnp.sqrt(jnp.sum(v * v))
+            return jnp.sqrt(jnp.sum(v * v, axis=ax, keepdims=keepdim))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(v) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+    return apply("p_norm", f, x)
+
+
+def dist(x, y, p=2, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+
+    def f(a, b):
+        d = a - b
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+    return apply("dist", f, x, y)
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+    ax = axis
+    if ax == 9:  # paddle default: first axis with dim 3
+        ax = next(i for i, d in enumerate(x.shape) if d == 3)
+    return apply("cross", lambda a, b: jnp.cross(a, b, axis=ax), x, y)
+
+
+def cholesky(x, upper=False, name=None):
+    x = to_tensor_like(x)
+
+    def f(v):
+        c = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(c, -1, -2) if upper else c
+
+    return apply("cholesky", f, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+
+    def f(b, chol):
+        return jax.scipy.linalg.cho_solve((chol, not upper), b)
+
+    return apply("cholesky_solve", f, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular
+        )
+
+    return apply("triangular_solve", f, x, y)
+
+
+def inverse(x, name=None):
+    return apply("inverse", jnp.linalg.inv, to_tensor_like(x))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply("pinv", lambda v: jnp.linalg.pinv(v, rcond=rcond, hermitian=hermitian),
+                 to_tensor_like(x))
+
+
+def solve(x, y, name=None):
+    return apply("solve", jnp.linalg.solve, to_tensor_like(x), to_tensor_like(y))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+    sol, res, rank, sv = np.linalg.lstsq(np.asarray(x._value), np.asarray(y._value),
+                                         rcond=rcond)
+    return (Tensor(jnp.asarray(sol)), Tensor(jnp.asarray(res)),
+            Tensor(jnp.asarray(rank)), Tensor(jnp.asarray(sv)))
+
+
+def det(x, name=None):
+    return apply("determinant", jnp.linalg.det, to_tensor_like(x))
+
+
+def slogdet(x, name=None):
+    x = to_tensor_like(x)
+    out = apply("slogdet", lambda v: tuple(jnp.linalg.slogdet(v)), x)
+    return out
+
+
+def svd(x, full_matrices=False, name=None):
+    x = to_tensor_like(x)
+    return apply("svd", lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)), x)
+
+
+def qr(x, mode="reduced", name=None):
+    x = to_tensor_like(x)
+    return apply("qr", lambda v: tuple(jnp.linalg.qr(v, mode=mode)), x)
+
+
+def eig(x, name=None):
+    x = to_tensor_like(x)
+    w, v = np.linalg.eig(np.asarray(x._value))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    x = to_tensor_like(x)
+    return apply("eigh", lambda v: tuple(jnp.linalg.eigh(v, symmetrize_input=True)), x)
+
+
+def eigvals(x, name=None):
+    x = to_tensor_like(x)
+    w = np.linalg.eigvals(np.asarray(x._value))
+    return Tensor(jnp.asarray(w))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply("eigvalsh", jnp.linalg.eigvalsh, to_tensor_like(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply("matrix_rank",
+                 lambda v: jnp.linalg.matrix_rank(v, tol=tol), to_tensor_like(x))
+
+
+def matrix_power(x, n, name=None):
+    return apply("matrix_power", lambda v: jnp.linalg.matrix_power(v, n), to_tensor_like(x))
+
+
+def multi_dot(tensors, name=None):
+    ts = [to_tensor_like(t) for t in tensors]
+    return apply("multi_dot", lambda *vs: jnp.linalg.multi_dot(vs), *ts)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    input = to_tensor_like(input)
+    v = np.asarray(input._value)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (v.min(), v.max())
+    hist, _ = np.histogram(v, bins=bins, range=(lo, hi))
+    return Tensor(jnp.asarray(hist.astype(np.int64)))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = to_tensor_like(x)
+    w = to_tensor_like(weights) if weights is not None else None
+
+    if w is None:
+        return apply("bincount",
+                     lambda v: jnp.bincount(v.astype(jnp.int32), minlength=minlength,
+                                            length=int(np.asarray(x._value).max(initial=0)) + 1 if minlength == 0 else None), x)
+    out = np.bincount(np.asarray(x._value), np.asarray(w._value), minlength)
+    return Tensor(jnp.asarray(out))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    x = to_tensor_like(x)
+    return apply("corrcoef", lambda v: jnp.corrcoef(v, rowvar=rowvar), x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    x = to_tensor_like(x)
+    return apply("cov", lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0), x)
+
+
+def einsum(equation, *operands):
+    ts = [to_tensor_like(t) for t in operands]
+    return apply("einsum",
+                 lambda *vs: jnp.einsum(equation, *vs, precision=_precision()), *ts)

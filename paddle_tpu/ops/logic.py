@@ -1,0 +1,73 @@
+"""Comparison / logical ops (reference: paddle.tensor.logic)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ._helpers import to_tensor_like
+from .dispatch import apply
+
+
+def _binop(name, fn):
+    def op(x, y, name=None):
+        return apply(name, fn, to_tensor_like(x), to_tensor_like(y))
+
+    op.__name__ = name
+    return op
+
+
+equal = _binop("equal", jnp.equal)
+not_equal = _binop("not_equal", jnp.not_equal)
+greater_than = _binop("greater_than", jnp.greater)
+greater_equal = _binop("greater_equal", jnp.greater_equal)
+less_than = _binop("less_than", jnp.less)
+less_equal = _binop("less_equal", jnp.less_equal)
+logical_and = _binop("logical_and", jnp.logical_and)
+logical_or = _binop("logical_or", jnp.logical_or)
+logical_xor = _binop("logical_xor", jnp.logical_xor)
+bitwise_and = _binop("bitwise_and", jnp.bitwise_and)
+bitwise_or = _binop("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _binop("bitwise_xor", jnp.bitwise_xor)
+
+
+def logical_not(x, name=None):
+    return apply("logical_not", jnp.logical_not, to_tensor_like(x))
+
+
+def bitwise_not(x, name=None):
+    return apply("bitwise_not", jnp.bitwise_not, to_tensor_like(x))
+
+
+def is_empty(x, name=None):
+    x = to_tensor_like(x)
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = to_tensor_like(condition)
+    if x is None and y is None:
+        from .search import nonzero
+
+        return nonzero(condition, as_tuple=True)
+    return apply("where", jnp.where, condition, to_tensor_like(x), to_tensor_like(y))
+
+
+def cond(pred, true_fn, false_fn, name=None):
+    """Eager conditional (reference controlflow/conditional_block_op.cc analog).
+
+    Eagerly evaluates one branch; inside traced code use
+    paddle_tpu.static.nn.cond which lowers to lax.cond."""
+    import jax
+
+    p = to_tensor_like(pred)._value
+    try:
+        concrete = bool(p)
+    except jax.errors.TracerBoolConversionError:
+        from ..jit.control_flow import traced_cond
+
+        return traced_cond(p, true_fn, false_fn)
+    return true_fn() if concrete else false_fn()

@@ -1,0 +1,449 @@
+"""Shape/layout manipulation ops (reference: paddle.tensor.manipulation)."""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dt
+from ..tensor import Tensor
+from ._helpers import norm_axis, norm_shape, to_tensor_like, value_of
+from .dispatch import apply
+
+
+def reshape(x, shape, name=None):
+    x = to_tensor_like(x)
+    shp = norm_shape(shape)
+    return apply("reshape", lambda v: jnp.reshape(v, shp), x)
+
+
+def reshape_(x, shape, name=None):
+    x = to_tensor_like(x)
+    out = reshape(x, shape)
+    return x._replace_from(out)
+
+
+def transpose(x, perm=None, name=None):
+    x = to_tensor_like(x)
+    if perm is not None:
+        perm = tuple(int(p) for p in perm)
+    return apply("transpose", lambda v: jnp.transpose(v, perm), x)
+
+
+def t(x, name=None):
+    x = to_tensor_like(x)
+    if x.ndim > 2:
+        raise ValueError("paddle.t only supports ndim <= 2")
+    return apply("t", lambda v: v.T, x)
+
+
+def moveaxis(x, source, destination, name=None):
+    x = to_tensor_like(x)
+    return apply("moveaxis", lambda v: jnp.moveaxis(v, source, destination), x)
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    x = to_tensor_like(x)
+    return apply("swapaxes", lambda v: jnp.swapaxes(v, axis1, axis2), x)
+
+
+transpose_ = transpose
+
+
+def concat(x, axis=0, name=None):
+    ts = [to_tensor_like(t) for t in x]
+    ax = int(value_of(axis)) if not isinstance(axis, int) else axis
+    return apply("concat", lambda *vs: jnp.concatenate(vs, axis=ax), *ts)
+
+
+def stack(x, axis=0, name=None):
+    ts = [to_tensor_like(t) for t in x]
+    return apply("stack", lambda *vs: jnp.stack(vs, axis=axis), *ts)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = to_tensor_like(x)
+    n = num if num is not None else x.shape[axis]
+    out = apply("unstack", lambda v: tuple(jnp.moveaxis(v, axis, 0)[i] for i in range(n)), x)
+    return list(out) if isinstance(out, (tuple, list)) else [out]
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = to_tensor_like(x)
+    ax = int(value_of(axis)) if not isinstance(axis, int) else axis
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"paddle.split: axis {ax} length {dim} is not divisible by "
+                f"num_or_sections={num_or_sections}")
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(value_of(s)) for s in num_or_sections]
+        neg = [i for i, s in enumerate(sizes) if s < 0]
+        if neg:
+            known = builtins_sum(s for s in sizes if s >= 0)
+            sizes[neg[0]] = dim - known
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def f(v):
+        return tuple(
+            jax.lax.slice_in_dim(v, o, o + s, axis=ax) for o, s in zip(offsets, sizes)
+        )
+
+    out = apply("split", f, x)
+    return list(out) if isinstance(out, (tuple, list)) else [out]
+
+
+def builtins_sum(it):
+    total = 0
+    for v in it:
+        total += v
+    return total
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    x = to_tensor_like(x)
+    ax = norm_axis(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+
+    def f(v):
+        if ax is None:
+            return jnp.squeeze(v)
+        real = tuple(a for a in ax if v.shape[a] == 1)
+        return jnp.squeeze(v, axis=real) if real else v
+
+    return apply("squeeze", f, x)
+
+
+def squeeze_(x, axis=None, name=None):
+    x = to_tensor_like(x)
+    return x._replace_from(squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    x = to_tensor_like(x)
+    ax = norm_axis(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+    return apply("unsqueeze", lambda v: jnp.expand_dims(v, ax), x)
+
+
+def unsqueeze_(x, axis, name=None):
+    x = to_tensor_like(x)
+    return x._replace_from(unsqueeze(x, axis))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = to_tensor_like(x)
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+
+    def f(v):
+        shp = list(v.shape)
+        new = shp[:s] + [-1 if shp[s : e + 1] else 1] + shp[e + 1 :]
+        flat = 1
+        for d in shp[s : e + 1]:
+            flat *= d
+        new = shp[:s] + [flat] + shp[e + 1 :]
+        return jnp.reshape(v, new)
+
+    return apply("flatten", f, x)
+
+
+def gather(x, index, axis=0, name=None):
+    x, index = to_tensor_like(x), to_tensor_like(index)
+    ax = int(value_of(axis)) if not isinstance(axis, int) else axis
+    return apply("gather", lambda v, i: jnp.take(v, i.reshape(-1).astype(jnp.int32), axis=ax), x, index)
+
+
+def gather_nd(x, index, name=None):
+    x, index = to_tensor_like(x), to_tensor_like(index)
+
+    def f(v, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        it = tuple(idx[..., i] for i in range(k))
+        return v[it]
+
+    return apply("gather_nd", f, x, index)
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    arr, indices = to_tensor_like(arr), to_tensor_like(indices)
+    return apply(
+        "take_along_axis",
+        lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32), axis=axis),
+        arr,
+        indices,
+    )
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    arr, indices = to_tensor_like(arr), to_tensor_like(indices)
+    values = to_tensor_like(values)
+
+    def f(v, i, val):
+        i = i.astype(jnp.int32)
+        val = jnp.broadcast_to(val, i.shape).astype(v.dtype)
+        dims = [jnp.arange(s).reshape([-1 if k == d else 1 for k in range(i.ndim)])
+                for d, s in enumerate(i.shape)]
+        idx = tuple(i if d == axis else jnp.broadcast_to(dims[d], i.shape)
+                    for d in range(i.ndim))
+        if reduce == "add":
+            return v.at[idx].add(val)
+        if reduce == "multiply" or reduce == "mul":
+            return v.at[idx].multiply(val)
+        return v.at[idx].set(val)
+
+    return apply("put_along_axis", f, arr, indices, values)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, index, updates = to_tensor_like(x), to_tensor_like(index), to_tensor_like(updates)
+
+    def f(v, i, u):
+        i = i.reshape(-1).astype(jnp.int32)
+        if overwrite:
+            return v.at[i].set(u.astype(v.dtype))
+        base = v.at[i].set(jnp.zeros_like(u, dtype=v.dtype))
+        return base.at[i].add(u.astype(v.dtype))
+
+    return apply("scatter", f, x, index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = to_tensor_like(x), to_tensor_like(index), to_tensor_like(updates)
+
+    def f(v, idx, u):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        it = tuple(idx[..., i] for i in range(k))
+        return v.at[it].add(u.astype(v.dtype))
+
+    return apply("scatter_nd_add", f, x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    index, updates = to_tensor_like(index), to_tensor_like(updates)
+    shp = norm_shape(shape)
+
+    def f(idx, u):
+        z = jnp.zeros(shp, u.dtype)
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        it = tuple(idx[..., i] for i in range(k))
+        return z.at[it].add(u)
+
+    return apply("scatter_nd", f, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    x, index = to_tensor_like(x), to_tensor_like(index)
+    return apply("index_select",
+                 lambda v, i: jnp.take(v, i.reshape(-1).astype(jnp.int32), axis=axis),
+                 x, index)
+
+
+def index_sample(x, index):
+    x, index = to_tensor_like(x), to_tensor_like(index)
+    return apply(
+        "index_sample",
+        lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32), axis=1),
+        x, index,
+    )
+
+
+def take(x, index, mode="raise", name=None):
+    x, index = to_tensor_like(x), to_tensor_like(index)
+    if mode == "raise" and not isinstance(index._value, jax.core.Tracer):
+        iv = np.asarray(index._value)
+        if iv.size and (iv.min() < -x.size or iv.max() >= x.size):
+            raise IndexError(
+                f"paddle.take: index out of range for tensor of size {x.size}")
+    m = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    return apply("take", lambda v, i: jnp.take(v.reshape(-1), i.astype(jnp.int32), mode=m), x, index)
+
+
+def expand(x, shape, name=None):
+    x = to_tensor_like(x)
+    shp = list(norm_shape(shape))
+    xs = x.shape
+    # paddle allows -1 meaning "keep this dim"
+    off = len(shp) - len(xs)
+    for i, s in enumerate(shp):
+        if s == -1:
+            shp[i] = xs[i - off]
+    return apply("expand", lambda v: jnp.broadcast_to(v, tuple(shp)), x)
+
+
+def expand_as(x, y, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+    return apply("expand_as", lambda v, w: jnp.broadcast_to(v, w.shape), x, y)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [to_tensor_like(t) for t in inputs]
+    out = apply("broadcast_tensors", lambda *vs: tuple(jnp.broadcast_arrays(*vs)), *ts)
+    return list(out)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def tile(x, repeat_times, name=None):
+    x = to_tensor_like(x)
+    reps = norm_shape(repeat_times)
+    return apply("tile", lambda v: jnp.tile(v, reps), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    x = to_tensor_like(x)
+    return apply("roll", lambda v: jnp.roll(v, shifts, axis=axis), x)
+
+
+def flip(x, axis, name=None):
+    x = to_tensor_like(x)
+    ax = norm_axis(axis)
+    return apply("flip", lambda v: jnp.flip(v, axis=ax), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    x = to_tensor_like(x)
+    return apply("rot90", lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), x)
+
+
+def slice(input, axes, starts, ends):
+    input = to_tensor_like(input)
+    axes = [int(a) for a in axes]
+    starts = [int(value_of(s)) for s in starts]
+    ends = [int(value_of(e)) for e in ends]
+
+    def f(v):
+        idx = [slice_builtin(None)] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            dim = v.shape[a]
+            s2 = max(s + dim, 0) if s < 0 else min(s, dim)
+            e2 = max(e + dim, 0) if e < 0 else min(e, dim)
+            idx[a] = slice_builtin(s2, e2)
+        return v[tuple(idx)]
+
+    return apply("slice", f, input)
+
+
+slice_builtin = builtins.slice
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = to_tensor_like(x)
+
+    def f(v):
+        idx = [slice_builtin(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[int(a)] = slice_builtin(int(value_of(s)), int(value_of(e)), int(value_of(st)))
+        return v[tuple(idx)]
+
+    return apply("strided_slice", f, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ..nn import functional as F
+
+    return F.pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = to_tensor_like(x)
+    r = value_of(repeats)
+    return apply("repeat_interleave",
+                 lambda v: jnp.repeat(v, r, axis=axis), x)
+
+
+def unbind(input, axis=0):
+    return unstack(input, axis=axis)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    x = to_tensor_like(x)
+    res = np.unique(np.asarray(x._value), return_index=return_index,
+                    return_inverse=return_inverse, return_counts=return_counts,
+                    axis=axis)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(jnp.asarray(res))
+    out = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(out)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    x = np.asarray(to_tensor_like(x)._value)
+    if axis is None:
+        x = x.reshape(-1)
+    keep = np.ones(x.shape[0], dtype=bool)
+    keep[1:] = np.any(
+        x[1:].reshape(x.shape[0] - 1, -1) != x[:-1].reshape(x.shape[0] - 1, -1), axis=1
+    )
+    vals = x[keep]
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, x.shape[0]))
+        outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def as_complex(x, name=None):
+    x = to_tensor_like(x)
+    return apply("as_complex", lambda v: jax.lax.complex(v[..., 0], v[..., 1]), x)
+
+
+def as_real(x, name=None):
+    x = to_tensor_like(x)
+    return apply("as_real", lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = to_tensor_like(x)
+    shp = norm_shape(shape)
+    offs = [0] * x.ndim if offsets is None else [int(value_of(o)) for o in offsets]
+
+    def f(v):
+        idx = tuple(slice_builtin(o, o + s) for o, s in zip(offs, shp))
+        return v[idx]
+
+    return apply("crop", f, x)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    input = to_tensor_like(input)
+    size = (index_num + nshards - 1) // nshards
+
+    def f(v):
+        shard = v // size
+        return jnp.where(shard == shard_id, v % size, ignore_value)
+
+    return apply("shard_index", f, input)
+
+
+def tensordot(x, y, axes=2, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(int(i) for i in a) if isinstance(a, (list, tuple)) else int(a) for a in axes)
+    return apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)

@@ -1,0 +1,388 @@
+"""Math ops (reference: paddle.tensor.math; operators/elementwise, reduce_ops).
+
+Every op is a jax function run through the eager dispatcher; under jit these
+trace straight into XLA (no per-op kernels to maintain — the MXU/VPU mapping
+is XLA's job, matmul precision governed by FLAGS_tpu_matmul_precision).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dt
+from ..framework.flags import flag_value
+from ..tensor import Tensor
+from ._helpers import norm_axis, to_tensor_like, value_of
+from .dispatch import apply
+
+
+def _binop(name, fn):
+    def op(x, y, name=None):
+        x, y = to_tensor_like(x), to_tensor_like(y)
+        return apply(name, fn, x, y)
+
+    op.__name__ = name
+    return op
+
+
+def _unop(name, fn):
+    def op(x, name=None):
+        return apply(name, fn, to_tensor_like(x))
+
+    op.__name__ = name
+    return op
+
+
+add = _binop("add", jnp.add)
+subtract = _binop("subtract", jnp.subtract)
+multiply = _binop("multiply", jnp.multiply)
+divide = _binop("divide", jnp.divide)
+floor_divide = _binop("floor_divide", jnp.floor_divide)
+mod = _binop("mod", jnp.mod)
+remainder = mod
+floor_mod = mod
+pow = _binop("pow", jnp.power)
+maximum = _binop("maximum", jnp.maximum)
+minimum = _binop("minimum", jnp.minimum)
+fmax = _binop("fmax", jnp.fmax)
+fmin = _binop("fmin", jnp.fmin)
+atan2 = _binop("atan2", jnp.arctan2)
+hypot = _binop("hypot", jnp.hypot)
+logaddexp = _binop("logaddexp", jnp.logaddexp)
+heaviside = _binop("heaviside", jnp.heaviside)
+nextafter = _binop("nextafter", jnp.nextafter)
+copysign = _binop("copysign", jnp.copysign)
+gcd = _binop("gcd", jnp.gcd)
+lcm = _binop("lcm", jnp.lcm)
+
+exp = _unop("exp", jnp.exp)
+expm1 = _unop("expm1", jnp.expm1)
+log = _unop("log", jnp.log)
+log2 = _unop("log2", jnp.log2)
+log10 = _unop("log10", jnp.log10)
+log1p = _unop("log1p", jnp.log1p)
+sqrt = _unop("sqrt", jnp.sqrt)
+rsqrt = _unop("rsqrt", lambda x: jax.lax.rsqrt(x))
+square = _unop("square", jnp.square)
+abs = _unop("abs", jnp.abs)
+sign = _unop("sign", jnp.sign)
+neg = _unop("neg", jnp.negative)
+reciprocal = _unop("reciprocal", jnp.reciprocal)
+floor = _unop("floor", jnp.floor)
+ceil = _unop("ceil", jnp.ceil)
+round = _unop("round", jnp.round)
+trunc = _unop("trunc", jnp.trunc)
+frac = _unop("frac", lambda x: x - jnp.trunc(x))
+sin = _unop("sin", jnp.sin)
+cos = _unop("cos", jnp.cos)
+tan = _unop("tan", jnp.tan)
+asin = _unop("asin", jnp.arcsin)
+acos = _unop("acos", jnp.arccos)
+atan = _unop("atan", jnp.arctan)
+sinh = _unop("sinh", jnp.sinh)
+cosh = _unop("cosh", jnp.cosh)
+tanh = _unop("tanh", jnp.tanh)
+asinh = _unop("asinh", jnp.arcsinh)
+acosh = _unop("acosh", jnp.arccosh)
+atanh = _unop("atanh", jnp.arctanh)
+erf = _unop("erf", jax.scipy.special.erf)
+erfinv = _unop("erfinv", jax.scipy.special.erfinv)
+sigmoid = _unop("sigmoid", jax.nn.sigmoid)
+digamma = _unop("digamma", jax.scipy.special.digamma)
+lgamma = _unop("lgamma", jax.scipy.special.gammaln)
+angle = _unop("angle", jnp.angle)
+conj = _unop("conj", jnp.conj)
+real = _unop("real", jnp.real)
+imag = _unop("imag", jnp.imag)
+deg2rad = _unop("deg2rad", jnp.deg2rad)
+rad2deg = _unop("rad2deg", jnp.rad2deg)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = to_tensor_like(x)
+    s, b = value_of(scale), value_of(bias)
+
+    def f(v, s=s, b=b):
+        out = v * s + b if bias_after_scale else (v + b) * s
+        return out
+
+    out = apply("scale", f, x)
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def increment(x, value=1.0, name=None):
+    x = to_tensor_like(x)
+    out = apply("increment", lambda v: v + value, x)
+    x._replace_from(out)
+    return x
+
+
+def clip(x, min=None, max=None, name=None):
+    x = to_tensor_like(x)
+    lo = value_of(min) if min is not None else None
+    hi = value_of(max) if max is not None else None
+    return apply("clip", lambda v: jnp.clip(v, lo, hi), x)
+
+
+def lerp(x, y, weight, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+    if isinstance(weight, Tensor):
+        return apply("lerp", lambda a, b, w: a + w * (b - a), x, y, weight)
+    return apply("lerp", lambda a, b: a + weight * (b - a), x, y)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply("stanh", lambda v: scale_b * jnp.tanh(scale_a * v), to_tensor_like(x))
+
+
+def multiplex(inputs, index, name=None):
+    ts = [to_tensor_like(t) for t in inputs]
+    index = to_tensor_like(index)
+
+    def f(idx, *xs):
+        stacked = jnp.stack(xs, axis=0)
+        rows = idx.reshape(-1).astype(jnp.int32)
+        return stacked[rows, jnp.arange(xs[0].shape[0])]
+
+    return apply("multiplex", f, index, *ts)
+
+
+# --- reductions -----------------------------------------------------------
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    x = to_tensor_like(x)
+    ax = norm_axis(axis)
+    d = _dt.convert_dtype(dtype) if dtype is not None else None
+    return apply("reduce_sum", lambda v: jnp.sum(v, axis=ax, keepdims=keepdim, dtype=d), x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    x = to_tensor_like(x)
+    ax = norm_axis(axis)
+    return apply("reduce_mean", lambda v: jnp.mean(v, axis=ax, keepdims=keepdim), x)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    x = to_tensor_like(x)
+    ax = norm_axis(axis)
+    return apply("reduce_max", lambda v: jnp.max(v, axis=ax, keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    x = to_tensor_like(x)
+    ax = norm_axis(axis)
+    return apply("reduce_min", lambda v: jnp.min(v, axis=ax, keepdims=keepdim), x)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    x = to_tensor_like(x)
+    ax = norm_axis(axis)
+    d = _dt.convert_dtype(dtype) if dtype is not None else None
+    return apply("reduce_prod", lambda v: jnp.prod(v, axis=ax, keepdims=keepdim, dtype=d), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    x = to_tensor_like(x)
+    ax = norm_axis(axis)
+    return apply("logsumexp", lambda v: jax.scipy.special.logsumexp(v, axis=ax, keepdims=keepdim), x)
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    x = to_tensor_like(x)
+    ax = norm_axis(axis)
+    return apply("reduce_all", lambda v: jnp.all(v, axis=ax, keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    x = to_tensor_like(x)
+    ax = norm_axis(axis)
+    return apply("reduce_any", lambda v: jnp.any(v, axis=ax, keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = to_tensor_like(x)
+    ax = norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply("var", lambda v: jnp.var(v, axis=ax, ddof=ddof, keepdims=keepdim), x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = to_tensor_like(x)
+    ax = norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply("std", lambda v: jnp.std(v, axis=ax, ddof=ddof, keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    x = to_tensor_like(x)
+    ax = norm_axis(axis)
+    return apply("median", lambda v: jnp.median(v, axis=ax, keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    x = to_tensor_like(x)
+    ax = norm_axis(axis)
+    return apply("quantile", lambda v: jnp.quantile(v, q, axis=ax, keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    x = to_tensor_like(x)
+    ax = norm_axis(axis)
+    return apply("nanmean", lambda v: jnp.nanmean(v, axis=ax, keepdims=keepdim), x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    x = to_tensor_like(x)
+    ax = norm_axis(axis)
+    d = _dt.convert_dtype(dtype) if dtype is not None else None
+    return apply("nansum", lambda v: jnp.nansum(v, axis=ax, keepdims=keepdim, dtype=d), x)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = to_tensor_like(x)
+    d = _dt.convert_dtype(dtype) if dtype is not None else None
+
+    def f(v):
+        if axis is None:
+            return jnp.cumsum(v.reshape(-1), dtype=d)
+        return jnp.cumsum(v, axis=axis, dtype=d)
+
+    return apply("cumsum", f, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = to_tensor_like(x)
+    d = _dt.convert_dtype(dtype) if dtype is not None else None
+    return apply("cumprod", lambda v: jnp.cumprod(v, axis=dim, dtype=d), x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = to_tensor_like(x)
+
+    def g(v):
+        ax = axis if axis is not None else 0
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.associative_scan(jnp.maximum, vv, axis=ax)
+        n = vv.shape[ax]
+        ar = jnp.arange(n).reshape([-1 if i == ax % vv.ndim else 1 for i in range(vv.ndim)])
+        eq = vv == vals
+        idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, ar, -1), axis=ax)
+        return vals, idx.astype(_dt.convert_dtype(dtype))
+
+    return apply("cummax", g, x)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = to_tensor_like(x)
+
+    def g(v):
+        ax = axis if axis is not None else 0
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.associative_scan(jnp.minimum, vv, axis=ax)
+        n = vv.shape[ax]
+        ar = jnp.arange(n).reshape([-1 if i == ax % vv.ndim else 1 for i in range(vv.ndim)])
+        eq = vv == vals
+        idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, ar, -1), axis=ax)
+        return vals, idx.astype(_dt.convert_dtype(dtype))
+
+    return apply("cummin", g, x)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    x = to_tensor_like(x)
+
+    def f(v):
+        vv = v.reshape(-1) if axis is None else v
+        ax = 0 if axis is None else axis
+        return jax.lax.associative_scan(jnp.logaddexp, vv, axis=ax)
+
+    return apply("logcumsumexp", f, x)
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    ts = [to_tensor_like(t) for t in inputs]
+    return apply("add_n", lambda *xs: functools.reduce(jnp.add, xs), *ts)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = to_tensor_like(x)
+    ax = norm_axis(axis)
+    return apply("count_nonzero",
+                 lambda v: jnp.count_nonzero(v, axis=ax, keepdims=keepdim), x)
+
+
+def isfinite(x, name=None):
+    return apply("isfinite", jnp.isfinite, to_tensor_like(x))
+
+
+def isinf(x, name=None):
+    return apply("isinf", jnp.isinf, to_tensor_like(x))
+
+
+def isnan(x, name=None):
+    return apply("isnan", jnp.isnan, to_tensor_like(x))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+    return apply("isclose",
+                 lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                 x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+    return apply("allclose",
+                 lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                 x, y)
+
+
+def equal_all(x, y, name=None):
+    x, y = to_tensor_like(x), to_tensor_like(y)
+    return apply("equal_all", lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    x = to_tensor_like(x)
+    return apply("nan_to_num",
+                 lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def kron(x, y, name=None):
+    return apply("kron", jnp.kron, to_tensor_like(x), to_tensor_like(y))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = to_tensor_like(x)
+    pre = value_of(prepend) if prepend is not None else None
+    app = value_of(append) if append is not None else None
+    return apply("diff", lambda v: jnp.diff(v, n=n, axis=axis, prepend=pre, append=app), x)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    x = to_tensor_like(x)
+    return apply("trace", lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def inner(x, y, name=None):
+    return apply("inner", jnp.inner, to_tensor_like(x), to_tensor_like(y))
+
+
+def outer(x, y, name=None):
+    return apply("outer", jnp.outer, to_tensor_like(x), to_tensor_like(y))

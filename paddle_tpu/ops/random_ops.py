@@ -1,0 +1,141 @@
+"""Random sampling ops.
+
+All sampling consumes keys from framework.random.next_rng_key — a fresh subkey
+per call in eager mode, fold_in-derived per-site keys under rng_scope in traced
+steps (see framework/random.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dt
+from ..framework.random import next_rng_key
+from ..tensor import Tensor
+from ._helpers import norm_shape, resolve_dtype, to_tensor_like, value_of
+from .dispatch import apply
+
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    d = resolve_dtype(dtype)
+    key = next_rng_key()
+    return Tensor(jax.random.normal(key, norm_shape(shape), dtype=d))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    d = resolve_dtype(dtype)
+    key = jax.random.key(seed) if seed else next_rng_key()
+    return Tensor(
+        jax.random.uniform(key, norm_shape(shape), dtype=d,
+                           minval=value_of(min), maxval=value_of(max))
+    )
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x = to_tensor_like(x)
+    x.set_value(
+        jax.random.uniform(
+            jax.random.key(seed) if seed else next_rng_key(),
+            x._value.shape, dtype=x._value.dtype, minval=min, maxval=max,
+        )
+    )
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = to_tensor_like(mean)._value if isinstance(mean, Tensor) else mean
+        s = to_tensor_like(std)._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            m.shape if hasattr(m, "shape") else (), s.shape if hasattr(s, "shape") else ()
+        )
+        key = next_rng_key()
+        return Tensor(jax.random.normal(key, shp, _dt.get_default_dtype()) * s + m)
+    shp = norm_shape(shape) if shape is not None else ()
+    key = next_rng_key()
+    return Tensor(
+        jax.random.normal(key, shp, _dt.get_default_dtype()) * std + mean
+    )
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x = to_tensor_like(x)
+    x.set_value(
+        jax.random.normal(next_rng_key(), x._value.shape, x._value.dtype) * std + mean
+    )
+    return x
+
+
+def standard_normal(shape, dtype=None, name=None) -> Tensor:
+    return randn(shape, dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    d = _dt.convert_dtype(dtype)
+    key = next_rng_key()
+    return Tensor(jax.random.randint(key, norm_shape(shape), low, high).astype(d))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
+    x = to_tensor_like(x)
+    d = _dt.convert_dtype(dtype) if dtype is not None else x.dtype
+    if high is None:
+        low, high = 0, low
+    key = next_rng_key()
+    return Tensor(jax.random.randint(key, x._value.shape, low, high).astype(d))
+
+
+def randperm(n, dtype="int64", name=None) -> Tensor:
+    key = next_rng_key()
+    return Tensor(jax.random.permutation(key, int(n)).astype(_dt.convert_dtype(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    x = to_tensor_like(x)
+    key = next_rng_key()
+    v = x._value
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(num_samples,) + v.shape[:-1])
+        if v.ndim == 1:
+            return Tensor(out.astype(jnp.int64))
+        return Tensor(jnp.moveaxis(out, 0, -1).astype(jnp.int64))
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(key, v.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def bernoulli(x, name=None) -> Tensor:
+    x = to_tensor_like(x)
+    key = next_rng_key()
+    return Tensor(
+        jax.random.bernoulli(key, x._value.astype(jnp.float32), x._value.shape).astype(
+            x._value.dtype
+        )
+    )
+
+
+def poisson(x, name=None) -> Tensor:
+    x = to_tensor_like(x)
+    key = next_rng_key()
+    return Tensor(jax.random.poisson(key, x._value).astype(x._value.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x = to_tensor_like(x)
+    key = next_rng_key()
+    x.set_value(
+        (jax.random.exponential(key, x._value.shape, jnp.float32) / lam).astype(
+            x._value.dtype
+        )
+    )
+    return x

@@ -1,0 +1,173 @@
+"""Search/sort ops (reference: paddle.tensor.search)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dt
+from ..tensor import Tensor
+from ._helpers import norm_axis, to_tensor_like
+from .dispatch import apply
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = to_tensor_like(x)
+    d = _dt.convert_dtype(dtype)
+
+    def f(v):
+        out = jnp.argmax(v if axis is not None else v.reshape(-1), axis=axis)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(d)
+
+    return apply("argmax", f, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = to_tensor_like(x)
+    d = _dt.convert_dtype(dtype)
+
+    def f(v):
+        out = jnp.argmin(v if axis is not None else v.reshape(-1), axis=axis)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(d)
+
+    return apply("argmin", f, x)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    x = to_tensor_like(x)
+
+    def f(v):
+        idx = jnp.argsort(-v if descending else v, axis=axis, stable=True)
+        return idx.astype(jnp.int64)
+
+    return apply("argsort", f, x)
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    x = to_tensor_like(x)
+
+    def f(v):
+        s = jnp.sort(v, axis=axis)
+        return jnp.flip(s, axis=axis) if descending else s
+
+    return apply("sort", f, x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    x = to_tensor_like(x)
+    kk = int(k) if not isinstance(k, Tensor) else int(np.asarray(k._value))
+    ax = -1 if axis is None else axis
+
+    def f(v):
+        vv = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax_topk(vv, kk)
+        else:
+            vals, idx = jax_topk(-vv, kk)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+
+    return apply("topk", f, x)
+
+
+def jax_topk(v, k):
+    import jax.lax
+
+    return jax.lax.top_k(v, k)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = to_tensor_like(x)
+
+    def f(v):
+        s = jnp.sort(v, axis=axis)
+        i = jnp.argsort(v, axis=axis, stable=True)
+        vals = jnp.take(s, k - 1, axis=axis)
+        idx = jnp.take(i, k - 1, axis=axis).astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx
+
+    return apply("kthvalue", f, x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = to_tensor_like(x)
+    v = np.asarray(x._value)
+    vv = np.moveaxis(v, axis, -1)
+    flat = vv.reshape(-1, vv.shape[-1])
+    vals = np.empty(flat.shape[0], v.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts)]
+        vals[i] = best
+        idxs[i] = np.where(row == best)[0][-1]
+    out_shape = vv.shape[:-1]
+    vals = vals.reshape(out_shape)
+    idxs = idxs.reshape(out_shape)
+    if keepdim:
+        vals = np.expand_dims(vals, axis)
+        idxs = np.expand_dims(idxs, axis)
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idxs))
+
+
+def nonzero(x, as_tuple=False):
+    x = to_tensor_like(x)
+    idx = np.nonzero(np.asarray(x._value))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int64))[:, None]) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1).astype(np.int64)))
+
+
+def masked_select(x, mask, name=None):
+    x, mask = to_tensor_like(x), to_tensor_like(mask)
+    out = np.asarray(x._value)[np.asarray(mask._value).astype(bool)]
+    return Tensor(jnp.asarray(out))
+
+
+def masked_fill(x, mask, value, name=None):
+    x, mask = to_tensor_like(x), to_tensor_like(mask)
+    from ._helpers import value_of
+
+    v = value_of(value)
+    return apply("masked_fill", lambda a, m: jnp.where(m.astype(bool), jnp.asarray(v, a.dtype), a), x, mask)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    ss, v = to_tensor_like(sorted_sequence), to_tensor_like(values)
+    side = "right" if right else "left"
+
+    def f(a, b):
+        if a.ndim == 1:
+            out = jnp.searchsorted(a, b, side=side)
+        else:
+            import jax
+
+            out = jax.vmap(lambda ar, br: jnp.searchsorted(ar, br, side=side))(
+                a.reshape(-1, a.shape[-1]), b.reshape(-1, b.shape[-1])
+            ).reshape(b.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return apply("searchsorted", f, ss, v)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = to_tensor_like(x)
+    value = to_tensor_like(value)
+    idx = tuple(to_tensor_like(i)._value for i in indices)
+
+    def f(v, val):
+        if accumulate:
+            return v.at[idx].add(val.astype(v.dtype))
+        return v.at[idx].set(val.astype(v.dtype))
+
+    return apply("index_put", f, x, value)

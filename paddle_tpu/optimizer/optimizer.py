@@ -1,0 +1,492 @@
+"""Optimizers (reference: python/paddle/optimizer/optimizer.py:48 base +
+adam/adamw/momentum/lamb/…; CUDA kernels operators/optimizers/adam_op.cu etc.).
+
+TPU-native: each optimizer's update rule is ONE jitted jax function applied to
+the whole parameter pytree at once (donated buffers — update happens in-place
+in HBM), not a per-parameter kernel launch loop.  Accumulators (moments etc.)
+live in a state dict keyed by parameter name.  The hapi / jit training path
+calls ``fused_step`` inside a jitted whole-train-step for zero python
+dispatch; eager ``step()`` shares the same rule.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.tape import no_grad
+from ..framework.flags import flag_value
+from ..regularizer import L1Decay, L2Decay
+from ..tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._parameters = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, float):
+            self._regularization = L2Decay(weight_decay)
+            self._wd_coeff = weight_decay
+        elif isinstance(weight_decay, (L1Decay, L2Decay)):
+            self._regularization = weight_decay
+            self._wd_coeff = weight_decay.coeff
+        else:
+            self._regularization = None
+            self._wd_coeff = 0.0
+        self._accumulators: Dict[str, Dict[int, jax.Array]] = {}
+        self._step_count = 0
+        self._update_jit = None
+
+    # --- lr ----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError(
+                "cannot set_lr when the learning rate is a scheduler")
+        self._lr = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr = scheduler
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # --- state -------------------------------------------------------------
+    def _acc(self, kind: str, p: Parameter) -> jax.Array:
+        store = self._accumulators.setdefault(kind, {})
+        key = id(p)
+        if key not in store:
+            store[key] = jnp.zeros_like(p._value)
+        return store[key]
+
+    def _set_acc(self, kind: str, p: Parameter, value):
+        self._accumulators[kind][id(p)] = value
+
+    def state_dict(self):
+        """Accumulators are keyed positionally (param_<i>_<kind>) — parameter
+        *creation-order names* are process-dependent, but the parameters list
+        order is the construction order of the model, which is stable across
+        runs of the same script (same property the reference relies on for
+        state matching)."""
+        out = {"LR_Scheduler": (self._lr.state_dict()
+                                if isinstance(self._lr, LRScheduler) else {}),
+               "step_count": self._step_count}
+        params = self._param_list()
+        for kind, store in self._accumulators.items():
+            for i, p in enumerate(params):
+                if id(p) in store:
+                    out[f"param_{i}_{kind}"] = Tensor(store[id(p)])
+        return out
+
+    def set_state_dict(self, state_dict):
+        if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state_dict:
+            self._lr.set_state_dict(state_dict["LR_Scheduler"])
+        self._step_count = int(state_dict.get("step_count", 0))
+        params = self._param_list()
+        for kind in self._acc_kinds():
+            store = self._accumulators.setdefault(kind, {})
+            for i, p in enumerate(params):
+                for key in (f"param_{i}_{kind}", f"{p.name}_{kind}"):
+                    if key in state_dict:
+                        v = state_dict[key]
+                        store[id(p)] = (v._value if isinstance(v, Tensor)
+                                        else jnp.asarray(v))
+                        break
+
+    set_dict = set_state_dict
+
+    def _acc_kinds(self) -> List[str]:
+        return []
+
+    # --- main entry points ---------------------------------------------------
+    def _param_list(self):
+        if self._parameters is None:
+            raise ValueError(
+                "optimizer created without a parameters list; pass parameters= "
+                "or use it through a Fleet/Model wrapper that supplies them")
+        return [p for p in self._parameters if isinstance(p, Parameter) or isinstance(p, Tensor)]
+
+    @no_grad()
+    def step(self):
+        params = [p for p in self._param_list() if p._grad is not None
+                  and getattr(p, "trainable", True)]
+        grads = [p._grad for p in params]
+        if self._grad_clip is not None:
+            pg = self._grad_clip(list(zip(params, grads)))
+            params, grads = [p for p, _ in pg], [g for _, g in pg]
+        self._step_count += 1
+        lr = self.get_lr()
+        for p, g in zip(params, grads):
+            if g is None:
+                continue
+            gv = g._value
+            if gv.dtype != p._value.dtype:
+                gv = gv.astype(p._value.dtype)
+            reg = p.regularizer if getattr(p, "regularizer", None) is not None else self._regularization
+            if isinstance(reg, L2Decay):
+                gv = gv + reg.coeff * p._value
+            elif isinstance(reg, L1Decay):
+                gv = gv + reg.coeff * jnp.sign(p._value)
+            p_lr = lr * p.optimize_attr.get("learning_rate", 1.0) if hasattr(p, "optimize_attr") else lr
+            self._update_param(p, gv, p_lr)
+
+    def _update_param(self, p, g, lr):
+        raise NotImplementedError
+
+    def clear_grad(self, set_to_zero=True):
+        if self._parameters is not None:
+            for p in self._parameters:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        params = self._param_list()
+        return None, [(p, p._grad) for p in params]
+
+    # --- functional (jit) path ----------------------------------------------
+    def init_opt_state(self, params: Dict[str, jax.Array]):
+        """Functional accumulator init for the jitted train-step path."""
+        return {kind: {k: jnp.zeros_like(v) for k, v in params.items()}
+                for kind in self._acc_kinds()}
+
+    def fused_step(self, params, grads, opt_state, step, lr=None,
+                   master_params=None):
+        """Pure-functional whole-tree update: called inside jitted train steps.
+        params/grads: dict name→array. Returns (new_params, new_opt_state)."""
+        lr = self.get_lr() if lr is None else lr
+        new_params, new_state = {}, {kind: {} for kind in self._acc_kinds()}
+        for name, p in params.items():
+            g = grads[name]
+            if g is None:
+                new_params[name] = p
+                for kind in self._acc_kinds():
+                    new_state[kind][name] = opt_state[kind][name]
+                continue
+            g = g.astype(p.dtype) if g.dtype != p.dtype else g
+            if isinstance(self._regularization, L2Decay):
+                g = g + self._regularization.coeff * p
+            accs = {kind: opt_state[kind][name] for kind in self._acc_kinds()}
+            np_, naccs = self._rule(p, g, accs, lr, step)
+            new_params[name] = np_
+            for kind in self._acc_kinds():
+                new_state[kind][name] = naccs[kind]
+        return new_params, new_state
+
+    def _rule(self, p, g, accs, lr, step):
+        raise NotImplementedError
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update_param(self, p, g, lr):
+        p._value = p._value - lr * g
+        p._inplace_version += 1
+
+    def _rule(self, p, g, accs, lr, step):
+        return p - lr * g, {}
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _acc_kinds(self):
+        return ["velocity"]
+
+    def _update_param(self, p, g, lr):
+        v = self._acc("velocity", p)
+        new_v = self._momentum * v + g
+        if self._nesterov:
+            p._value = p._value - lr * (g + self._momentum * new_v)
+        else:
+            p._value = p._value - lr * new_v
+        self._set_acc("velocity", p, new_v)
+        p._inplace_version += 1
+
+    def _rule(self, p, g, accs, lr, step):
+        v = accs["velocity"]
+        new_v = self._momentum * v + g
+        if self._nesterov:
+            new_p = p - lr * (g + self._momentum * new_v)
+        else:
+            new_p = p - lr * new_v
+        return new_p, {"velocity": new_v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _acc_kinds(self):
+        return ["moment"]
+
+    def _update_param(self, p, g, lr):
+        m = self._acc("moment", p)
+        new_m = m + g * g
+        p._value = p._value - lr * g / (jnp.sqrt(new_m) + self._epsilon)
+        self._set_acc("moment", p, new_m)
+        p._inplace_version += 1
+
+    def _rule(self, p, g, accs, lr, step):
+        new_m = accs["moment"] + g * g
+        return p - lr * g / (jnp.sqrt(new_m) + self._epsilon), {"moment": new_m}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._multi_precision = multi_precision
+
+    def _acc_kinds(self):
+        return ["moment1", "moment2"]
+
+    def _update_param(self, p, g, lr):
+        t = self._step_count
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        gf = g.astype(jnp.float32)
+        new_m = b1 * m.astype(jnp.float32) + (1 - b1) * gf
+        new_v = b2 * v.astype(jnp.float32) + (1 - b2) * gf * gf
+        mhat = new_m / (1 - b1**t)
+        vhat = new_v / (1 - b2**t)
+        upd = lr * mhat / (jnp.sqrt(vhat) + eps)
+        p._value = (p._value.astype(jnp.float32) - upd).astype(p._value.dtype)
+        self._set_acc("moment1", p, new_m.astype(m.dtype))
+        self._set_acc("moment2", p, new_v.astype(v.dtype))
+        p._inplace_version += 1
+
+    def _rule(self, p, g, accs, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        gf = g.astype(jnp.float32)
+        m = b1 * accs["moment1"].astype(jnp.float32) + (1 - b1) * gf
+        v = b2 * accs["moment2"].astype(jnp.float32) + (1 - b2) * gf * gf
+        mhat = m / (1 - b1**step)
+        vhat = v / (1 - b2**step)
+        new_p = (p.astype(jnp.float32) - lr * mhat / (jnp.sqrt(vhat) + eps)).astype(p.dtype)
+        return new_p, {"moment1": m.astype(accs["moment1"].dtype),
+                       "moment2": v.astype(accs["moment2"].dtype)}
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._wd = weight_decay if isinstance(weight_decay, float) else float(weight_decay)
+        self._apply_decay_fn = apply_decay_param_fun
+
+    def _update_param(self, p, g, lr):
+        if self._apply_decay_fn is None or self._apply_decay_fn(p.name):
+            p._value = (p._value.astype(jnp.float32) * (1 - lr * self._wd)).astype(p._value.dtype)
+        super()._update_param(p, g, lr)
+
+    def _rule(self, p, g, accs, lr, step):
+        decayed = (p.astype(jnp.float32) * (1 - lr * self._wd)).astype(p.dtype)
+        return super()._rule(decayed, g, accs, lr, step)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _acc_kinds(self):
+        return ["moment", "inf_norm"]
+
+    def _update_param(self, p, g, lr):
+        t = self._step_count
+        m = self._acc("moment", p)
+        u = self._acc("inf_norm", p)
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        new_m = b1 * m + (1 - b1) * g
+        new_u = jnp.maximum(b2 * u, jnp.abs(g))
+        p._value = p._value - (lr / (1 - b1**t)) * new_m / (new_u + eps)
+        self._set_acc("moment", p, new_m)
+        self._set_acc("inf_norm", p, new_u)
+        p._inplace_version += 1
+
+    def _rule(self, p, g, accs, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * accs["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * accs["inf_norm"], jnp.abs(g))
+        return p - (lr / (1 - b1**step)) * m / (u + eps), {"moment": m, "inf_norm": u}
+
+
+class AdamDelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _acc_kinds(self):
+        return ["avg_squared_grad", "avg_squared_update"]
+
+    def _update_param(self, p, g, lr):
+        eg = self._acc("avg_squared_grad", p)
+        eu = self._acc("avg_squared_update", p)
+        rho, eps = self._rho, self._epsilon
+        new_eg = rho * eg + (1 - rho) * g * g
+        upd = jnp.sqrt(eu + eps) / jnp.sqrt(new_eg + eps) * g
+        new_eu = rho * eu + (1 - rho) * upd * upd
+        p._value = p._value - lr * upd
+        self._set_acc("avg_squared_grad", p, new_eg)
+        self._set_acc("avg_squared_update", p, new_eu)
+        p._inplace_version += 1
+
+    def _rule(self, p, g, accs, lr, step):
+        rho, eps = self._rho, self._epsilon
+        new_eg = rho * accs["avg_squared_grad"] + (1 - rho) * g * g
+        upd = jnp.sqrt(accs["avg_squared_update"] + eps) / jnp.sqrt(new_eg + eps) * g
+        new_eu = rho * accs["avg_squared_update"] + (1 - rho) * upd * upd
+        return p - lr * upd, {"avg_squared_grad": new_eg, "avg_squared_update": new_eu}
+
+
+Adadelta = AdamDelta
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _acc_kinds(self):
+        return ["mean_square", "mean_grad", "momentum"]
+
+    def _update_param(self, p, g, lr):
+        ms = self._acc("mean_square", p)
+        mg = self._acc("mean_grad", p)
+        mom = self._acc("momentum", p)
+        rho, eps = self._rho, self._epsilon
+        new_ms = rho * ms + (1 - rho) * g * g
+        if self._centered:
+            new_mg = rho * mg + (1 - rho) * g
+            denom = jnp.sqrt(new_ms - new_mg * new_mg + eps)
+        else:
+            new_mg = mg
+            denom = jnp.sqrt(new_ms + eps)
+        new_mom = self._momentum * mom + lr * g / denom
+        p._value = p._value - new_mom
+        self._set_acc("mean_square", p, new_ms)
+        self._set_acc("mean_grad", p, new_mg)
+        self._set_acc("momentum", p, new_mom)
+        p._inplace_version += 1
+
+    def _rule(self, p, g, accs, lr, step):
+        rho, eps = self._rho, self._epsilon
+        new_ms = rho * accs["mean_square"] + (1 - rho) * g * g
+        if self._centered:
+            new_mg = rho * accs["mean_grad"] + (1 - rho) * g
+            denom = jnp.sqrt(new_ms - new_mg * new_mg + eps)
+        else:
+            new_mg = accs["mean_grad"]
+            denom = jnp.sqrt(new_ms + eps)
+        new_mom = self._momentum * accs["momentum"] + lr * g / denom
+        return p - new_mom, {"mean_square": new_ms, "mean_grad": new_mg,
+                             "momentum": new_mom}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _acc_kinds(self):
+        return ["moment1", "moment2"]
+
+    def _lamb_update(self, p, g, m, v, lr, t, wd):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        gf = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        new_m = b1 * m + (1 - b1) * gf
+        new_v = b2 * v + (1 - b2) * gf * gf
+        mhat = new_m / (1 - b1**t)
+        vhat = new_v / (1 - b2**t)
+        r = mhat / (jnp.sqrt(vhat) + eps) + wd * pf
+        w_norm = jnp.linalg.norm(pf)
+        r_norm = jnp.linalg.norm(r)
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (pf - lr * ratio * r).astype(p.dtype), new_m, new_v
+
+    def _update_param(self, p, g, lr):
+        wd = 0.0 if (self._exclude_fn is not None and self._exclude_fn(p)) else self._wd
+        m = self._acc("moment1", p).astype(jnp.float32)
+        v = self._acc("moment2", p).astype(jnp.float32)
+        new_p, new_m, new_v = self._lamb_update(p._value, g, m, v, lr,
+                                                self._step_count, wd)
+        p._value = new_p
+        self._set_acc("moment1", p, new_m)
+        self._set_acc("moment2", p, new_v)
+        p._inplace_version += 1
+
+    def _rule(self, p, g, accs, lr, step):
+        new_p, new_m, new_v = self._lamb_update(
+            p, g, accs["moment1"].astype(jnp.float32),
+            accs["moment2"].astype(jnp.float32), lr, step, self._wd)
+        return new_p, {"moment1": new_m, "moment2": new_v}
+
+
+class Lars(Momentum):
+    """LARS (reference fluid/optimizer.py LarsMomentumOptimizer)."""
+
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0, name=None):
+        super().__init__(learning_rate, momentum, parameters, False, None,
+                         grad_clip, name)
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._lars_eps = epsilon
+
+    def _update_param(self, p, g, lr):
+        pf = p._value.astype(jnp.float32)
+        gf = g.astype(jnp.float32)
+        w_norm = jnp.linalg.norm(pf)
+        g_norm = jnp.linalg.norm(gf)
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm /
+            (g_norm + self._lars_wd * w_norm + self._lars_eps),
+            1.0,
+        )
+        v = self._acc("velocity", p)
+        new_v = self._momentum * v + lr * local_lr * (gf + self._lars_wd * pf)
+        p._value = (pf - new_v).astype(p._value.dtype)
+        self._set_acc("velocity", p, new_v)
+        p._inplace_version += 1

@@ -1,0 +1,19 @@
+"""Weight-decay regularizers (reference: fluid/regularizer.py)."""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+
+class L2Decay(WeightDecayRegularizer):
+    """grad += coeff * param (applied inside the optimizer update)."""
+
+
+class L1Decay(WeightDecayRegularizer):
+    """grad += coeff * sign(param)."""

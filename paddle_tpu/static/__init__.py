@@ -1,0 +1,34 @@
+"""paddle_tpu.static — declarative (graph) mode.
+
+Reference analog: paddle.static — Program/Executor/CompiledProgram
+(fluid/framework.py:4174 Program, fluid/executor.py:916 Executor.run,
+compiler.py:88).  TPU-native: a Program records layer calls symbolically and
+lowers to ONE jitted XLA computation per (feed-shapes) signature; Executor.run
+feeds/fetches.  The reference's ParallelExecutor/ir-pass machinery (SSA
+graphs, fusion passes, memory passes) is subsumed by XLA compilation.
+"""
+from . import nn  # noqa: F401
+from ._mode import disable_static, enable_static, static_mode_enabled  # noqa: F401
+from .program import (  # noqa: F401
+    CompiledProgram,
+    Executor,
+    Program,
+    data,
+    default_main_program,
+    default_startup_program,
+    global_scope,
+    program_guard,
+    scope_guard,
+)
+from ..jit.to_static import InputSpec  # noqa: F401
+from ..framework_io import load, save  # noqa: F401
+
+
+def name_scope(name):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _scope():
+        yield
+
+    return _scope()

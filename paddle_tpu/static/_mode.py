@@ -1,0 +1,16 @@
+"""Static-mode switch (reference: fluid/framework.py:181 in_dygraph_mode)."""
+_STATIC = False
+
+
+def static_mode_enabled() -> bool:
+    return _STATIC
+
+
+def enable_static():
+    global _STATIC
+    _STATIC = True
+
+
+def disable_static():
+    global _STATIC
+    _STATIC = False

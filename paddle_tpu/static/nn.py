@@ -1,0 +1,72 @@
+"""static.nn — declarative layer API + control flow.
+
+Reference analog: paddle.static.nn (fluid/layers/nn.py legacy ops API) and
+controlflow ops (while_op.cc, conditional_block_op.cc).  Control flow lowers
+to lax.cond/while_loop via jit.control_flow.
+"""
+from __future__ import annotations
+
+from ..jit.control_flow import scan, traced_cond, while_loop  # noqa: F401
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    from ..ops.logic import cond as _cond
+
+    return _cond(pred, true_fn, false_fn)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Legacy fluid.layers.fc."""
+    from ..nn import Linear
+    from ..ops._helpers import to_tensor_like
+    from ..ops.manipulation import flatten
+
+    x = to_tensor_like(x)
+    xf = flatten(x, num_flatten_dims, -1) if x.ndim > num_flatten_dims + 1 else x
+    in_f = xf.shape[-1]
+    layer = Linear(in_f, size, weight_attr, bias_attr)
+    out = layer(xf)
+    if activation:
+        from ..nn import functional as F
+
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, param_attr=None,
+              dtype="float32"):
+    from ..nn import Embedding
+
+    layer = Embedding(size[0], size[1], padding_idx=padding_idx,
+                      weight_attr=param_attr)
+    return layer(input)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-05, param_attr=None,
+               bias_attr=None, data_layout="NCHW", is_test=False, name=None):
+    from ..nn.norm_layers import BatchNorm
+
+    layer = BatchNorm(input.shape[1] if data_layout == "NCHW" else input.shape[-1],
+                      act=act, momentum=momentum, epsilon=epsilon,
+                      param_attr=param_attr, bias_attr=bias_attr,
+                      data_layout=data_layout)
+    if is_test:
+        layer.eval()
+    return layer(input)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    from ..nn import Conv2D
+    from ..nn import functional as F
+
+    in_c = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    layer = Conv2D(in_c, num_filters, filter_size, stride, padding, dilation,
+                   groups, weight_attr=param_attr, bias_attr=bias_attr,
+                   data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out

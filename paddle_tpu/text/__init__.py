@@ -1,0 +1,5 @@
+"""paddle_tpu.text (reference: python/paddle/text/ — dataset loaders).
+
+Zero-egress: datasets read local cache files or generate synthetic stand-ins.
+"""
+from .datasets import Imdb, UCIHousing  # noqa: F401

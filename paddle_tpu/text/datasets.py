@@ -1,0 +1,59 @@
+"""Text datasets (reference: python/paddle/text/datasets/imdb.py,
+uci_housing.py). Synthetic fallback when cache files are absent."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+_CACHE = os.path.expanduser("~/.cache/paddle_tpu/datasets")
+
+
+class UCIHousing(Dataset):
+    FEATURES = 13
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        path = data_file or os.path.join(_CACHE, "housing.data")
+        if os.path.exists(path):
+            raw = np.loadtxt(path).astype(np.float32)
+        else:
+            rng = np.random.RandomState(0)
+            X = rng.randn(506, self.FEATURES).astype(np.float32)
+            w = rng.randn(self.FEATURES).astype(np.float32)
+            y = X @ w + 0.1 * rng.randn(506).astype(np.float32)
+            raw = np.concatenate([X, y[:, None]], axis=1)
+        mu, sigma = raw[:, :-1].mean(0), raw[:, :-1].std(0) + 1e-8
+        raw[:, :-1] = (raw[:, :-1] - mu) / sigma
+        split = int(len(raw) * 0.8)
+        self.data = raw[:split] if mode == "train" else raw[split:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    def __init__(self, data_file=None, mode="train", cutoff=150, download=True):
+        rng = np.random.RandomState(1 if mode == "train" else 2)
+        n = 2000 if mode == "train" else 400
+        self.vocab_size = 5000
+        self.seq_len = 128
+        self.labels = rng.randint(0, 2, n).astype(np.int64)
+        # synthetic: positive docs skew to low token ids
+        self.docs = np.where(
+            self.labels[:, None] == 1,
+            rng.randint(0, self.vocab_size // 2, (n, self.seq_len)),
+            rng.randint(self.vocab_size // 2, self.vocab_size, (n, self.seq_len)),
+        ).astype(np.int64)
+        self.word_idx = {f"tok{i}": i for i in range(self.vocab_size)}
+
+    def __getitem__(self, idx):
+        return self.docs[idx], np.asarray([self.labels[idx]], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.docs)

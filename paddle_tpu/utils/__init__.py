@@ -1,0 +1,38 @@
+"""paddle_tpu.utils (reference: python/paddle/utils/)."""
+from . import download  # noqa: F401
+from . import profiler  # noqa: F401
+from . import unique_name  # noqa: F401
+
+try:
+    from . import cpp_extension  # noqa: F401
+except Exception:  # pragma: no cover
+    pass
+
+
+def deprecated(update_to="", since="", reason=""):
+    def wrapper(fn):
+        return fn
+
+    return wrapper
+
+
+def run_check():
+    """paddle.utils.run_check parity: verify compute on the available device."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((128, 128), jnp.float32)
+    y = (x @ x).block_until_ready()
+    dev = list(y.devices())[0]
+    n = len(jax.devices())
+    print(f"paddle_tpu works on {dev.platform} ({n} device(s) visible).")
+    return True
+
+
+def try_import(module_name):
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        return None

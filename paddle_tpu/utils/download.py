@@ -1,0 +1,37 @@
+"""Download utilities (reference: paddle/utils/download.py).
+
+TPU training hosts are zero-egress; get_weights_path_from_url resolves from
+the local cache only and raises a clear error if the file is absent.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/hapi/weights")
+DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/datasets")
+
+
+def _md5check(fullname, md5sum=None):
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(fullname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True):
+    root_dir = root_dir or DATA_HOME
+    fname = os.path.basename(url)
+    fullname = os.path.join(root_dir, fname)
+    if os.path.exists(fullname) and _md5check(fullname, md5sum):
+        return fullname
+    raise FileNotFoundError(
+        f"{fullname} not present and this host has no network egress; place "
+        f"the file there manually (expected source: {url})")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

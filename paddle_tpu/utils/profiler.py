@@ -1,0 +1,76 @@
+"""Profiler (reference: fluid/profiler.py:255 profiler context,
+platform/profiler.h:127 RecordEvent, device_tracer.h CUPTI timeline).
+
+TPU-native: jax.profiler (XPlane/TensorBoard trace — libtpu's tracer subsumes
+DeviceTracer) + named_scope RecordEvent analog + a host-side event aggregator
+for the reference's summary table.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+_events = defaultdict(lambda: [0, 0.0])  # name -> [calls, total_s]
+_active_trace_dir = None
+
+
+class RecordEvent:
+    """RAII op-scope timer (platform/profiler.h:127)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def __enter__(self):
+        self._scope = jax.named_scope(self.name)
+        self._scope.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        ev = _events[self.name]
+        ev[0] += 1
+        ev[1] += dt
+        self._scope.__exit__(*exc)
+        return False
+
+
+def start_profiler(state="All", tracer_option="Default", log_dir="/tmp/paddle_tpu_prof"):
+    global _active_trace_dir
+    _active_trace_dir = log_dir
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    global _active_trace_dir
+    if _active_trace_dir is not None:
+        jax.profiler.stop_trace()
+        _active_trace_dir = None
+    if sorted_key:
+        print(summary(sorted_key))
+
+
+def reset_profiler():
+    _events.clear()
+
+
+def summary(sorted_key="total"):
+    rows = sorted(_events.items(), key=lambda kv: -kv[1][1])
+    lines = [f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
+    for name, (calls, total) in rows:
+        lines.append(f"{name:<40}{calls:>8}{total * 1e3:>12.3f}"
+                     f"{total * 1e3 / max(calls, 1):>12.3f}")
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path=None,
+             tracer_option="Default"):
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

@@ -1,0 +1,28 @@
+"""Unique name generator (reference: fluid/unique_name.py)."""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+_counters = defaultdict(int)
+
+
+def generate(key="tmp"):
+    _counters[key] += 1
+    return f"{key}_{_counters[key] - 1}"
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    global _counters
+    prev = _counters
+    _counters = defaultdict(int)
+    try:
+        yield
+    finally:
+        _counters = prev
+
+
+def switch(new_generator=None):
+    global _counters
+    _counters = defaultdict(int)

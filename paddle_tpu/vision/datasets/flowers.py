@@ -1,0 +1,28 @@
+"""Flowers-102 (reference: vision/datasets/flowers.py). Synthetic fallback."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+
+class Flowers(Dataset):
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        self.transform = transform
+        n = 512 if mode == "train" else 128
+        rng = np.random.RandomState(3)
+        self.labels = rng.randint(0, 102, n).astype(np.int64)
+        self.images = rng.randint(0, 255, (n, 64, 64, 3)).astype(np.uint8)
+        self.synthetic = True
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32).transpose(2, 0, 1) / 255.0
+        return img, np.asarray([self.labels[idx]], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)

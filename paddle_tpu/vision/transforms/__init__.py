@@ -1,0 +1,26 @@
+"""paddle_tpu.vision.transforms (reference: python/paddle/vision/transforms/).
+
+Numpy/host-side transforms (the data pipeline runs on CPU; device work starts
+at the DataLoader boundary).
+"""
+from .transforms import (  # noqa: F401
+    BrightnessTransform,
+    CenterCrop,
+    ColorJitter,
+    Compose,
+    ContrastTransform,
+    Grayscale,
+    HueTransform,
+    Normalize,
+    Pad,
+    RandomCrop,
+    RandomHorizontalFlip,
+    RandomResizedCrop,
+    RandomRotation,
+    RandomVerticalFlip,
+    Resize,
+    SaturationTransform,
+    ToTensor,
+    Transpose,
+)
+from . import functional  # noqa: F401

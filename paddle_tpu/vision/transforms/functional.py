@@ -1,0 +1,153 @@
+"""Transform functionals on numpy arrays / PIL-free (reference:
+vision/transforms/functional.py — implemented over numpy instead of PIL/cv2:
+zero-egress TPU hosts preprocess with numpy)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _as_hwc(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def resize(img, size, interpolation="bilinear"):
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        if h <= w:
+            oh, ow = size, int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), size
+    else:
+        oh, ow = size
+    ys = np.linspace(0, h - 1, oh)
+    xs = np.linspace(0, w - 1, ow)
+    if interpolation == "nearest":
+        out = img[np.round(ys).astype(int)[:, None], np.round(xs).astype(int)[None, :]]
+        return out
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    im = img.astype(np.float32)
+    top = im[y0][:, x0] * (1 - wx) + im[y0][:, x1] * wx
+    bot = im[y1][:, x0] * (1 - wx) + im[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return out.astype(img.dtype) if img.dtype != np.uint8 else np.clip(out, 0, 255).astype(np.uint8)
+
+
+def center_crop(img, output_size):
+    img = _as_hwc(img)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    h, w = img.shape[:2]
+    th, tw = output_size
+    i = max((h - th) // 2, 0)
+    j = max((w - tw) // 2, 0)
+    return img[i : i + th, j : j + tw]
+
+
+def crop(img, top, left, height, width):
+    return _as_hwc(img)[top : top + height, left : left + width]
+
+
+def hflip(img):
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_hwc(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    img = _as_hwc(img)
+    if isinstance(padding, int):
+        padding = (padding, padding, padding, padding)
+    if len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    l, t, r, b = padding
+    pads = [(t, b), (l, r), (0, 0)]
+    if padding_mode == "constant":
+        return np.pad(img, pads, constant_values=fill)
+    mode = {"reflect": "reflect", "edge": "edge", "symmetric": "symmetric"}[padding_mode]
+    return np.pad(img, pads, mode=mode)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    img = np.asarray(img, dtype=np.float32)
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data_format == "CHW":
+        return (img - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+    return (img - mean) / std
+
+
+def to_tensor(img, data_format="CHW"):
+    img = _as_hwc(img)
+    arr = img.astype(np.float32)
+    if img.dtype == np.uint8:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return arr
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None, fill=0):
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    cy, cx = ((h - 1) / 2, (w - 1) / 2) if center is None else center
+    rad = np.deg2rad(angle)
+    cos, sin = np.cos(rad), np.sin(rad)
+    yy, xx = np.mgrid[0:h, 0:w]
+    ys = cos * (yy - cy) + sin * (xx - cx) + cy
+    xs = -sin * (yy - cy) + cos * (xx - cx) + cx
+    yi = np.clip(np.round(ys).astype(int), 0, h - 1)
+    xi = np.clip(np.round(xs).astype(int), 0, w - 1)
+    valid = (ys >= 0) & (ys < h) & (xs >= 0) & (xs < w)
+    out = img[yi, xi]
+    out[~valid] = fill
+    return out
+
+
+def adjust_brightness(img, factor):
+    img = _as_hwc(img).astype(np.float32) * factor
+    return np.clip(img, 0, 255).astype(np.uint8)
+
+
+def adjust_contrast(img, factor):
+    img = _as_hwc(img).astype(np.float32)
+    mean = img.mean()
+    out = (img - mean) * factor + mean
+    return np.clip(out, 0, 255).astype(np.uint8)
+
+
+def adjust_saturation(img, factor):
+    img = _as_hwc(img).astype(np.float32)
+    gray = img.mean(axis=2, keepdims=True)
+    out = (img - gray) * factor + gray
+    return np.clip(out, 0, 255).astype(np.uint8)
+
+
+def adjust_hue(img, factor):
+    # cheap approximation: channel roll interpolation
+    img = _as_hwc(img).astype(np.float32)
+    rolled = np.roll(img, 1, axis=2)
+    out = img * (1 - abs(factor)) + rolled * abs(factor)
+    return np.clip(out, 0, 255).astype(np.uint8)
+
+
+def to_grayscale(img, num_output_channels=1):
+    img = _as_hwc(img).astype(np.float32)
+    if img.shape[2] >= 3:
+        g = (0.299 * img[:, :, 0] + 0.587 * img[:, :, 1] + 0.114 * img[:, :, 2])
+    else:
+        g = img[:, :, 0]
+    g = g[:, :, None]
+    if num_output_channels == 3:
+        g = np.repeat(g, 3, axis=2)
+    return np.clip(g, 0, 255).astype(np.uint8)

@@ -1,0 +1,29 @@
+"""Test configuration.
+
+Forces an 8-device virtual CPU platform (SURVEY §4: reference distributed
+tests run multi-process on localhost; here multi-device single-process on a
+virtual mesh — --xla_force_host_platform_device_count).
+"""
+import os
+
+# NOTE: a sitecustomize on TPU hosts pins JAX_PLATFORMS=axon; override BEFORE
+# jax initializes its backends.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu
+
+    paddle_tpu.seed(102)
+    yield

@@ -1,0 +1,160 @@
+"""End-to-end training slices (BASELINE.json config 1: dygraph LeNet/MNIST)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn, optimizer
+from paddle_tpu.io import DataLoader
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+
+
+def test_lenet_eager_training_reduces_loss():
+    paddle.seed(0)
+    model = LeNet()
+    opt = optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+    ds = MNIST(mode="train")
+    loader = DataLoader(ds, batch_size=32, shuffle=True, drop_last=True)
+    losses = []
+    for i, (x, y) in enumerate(loader):
+        out = model(x)
+        loss = F.cross_entropy(out, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+        if i >= 20:
+            break
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+
+def test_lenet_hapi_fit():
+    paddle.seed(0)
+    from paddle_tpu.metric import Accuracy
+
+    model = paddle.Model(LeNet())
+    model.prepare(
+        optimizer.Adam(learning_rate=1e-3, parameters=model.parameters()),
+        nn.CrossEntropyLoss(),
+        Accuracy(),
+    )
+    train = MNIST(mode="train")
+    test = MNIST(mode="test")
+    model.fit(train, batch_size=64, epochs=1, verbose=0, num_iters=15)
+    res = model.evaluate(test, batch_size=64, verbose=0, num_iters=5)
+    assert "loss" in res and "acc" in res
+    # synthetic MNIST is nearly linearly separable — training should move acc
+    assert res["acc"] > 0.15
+
+
+def test_hapi_predict_and_save_load(tmp_path):
+    model = paddle.Model(LeNet())
+    model.prepare(optimizer.SGD(0.1, parameters=model.parameters()),
+                  nn.CrossEntropyLoss())
+    test = MNIST(mode="test")
+    out = model.predict(test, batch_size=32, stack_outputs=True)
+    assert out[0].shape == (len(test), 10)
+    path = str(tmp_path / "ckpt" / "model")
+    model.save(path)
+    model2 = paddle.Model(LeNet())
+    model2.prepare(optimizer.SGD(0.1, parameters=model2.parameters()),
+                   nn.CrossEntropyLoss())
+    model2.load(path)
+    w1 = model.network.state_dict()["features.0.weight"].numpy()
+    w2 = model2.network.state_dict()["features.0.weight"].numpy()
+    np.testing.assert_array_equal(w1, w2)
+
+
+def test_jitted_train_step_matches_eager():
+    """The hapi accelerate path and the eager path must optimize the same."""
+    paddle.seed(3)
+    x = np.random.randn(64, 10).astype(np.float32)
+    w_true = np.random.randn(10, 1).astype(np.float32)
+    y = x @ w_true + 0.01 * np.random.randn(64, 1).astype(np.float32)
+
+    def train(accelerate):
+        paddle.seed(5)
+        net = nn.Linear(10, 1)
+        model = paddle.Model(net)
+        model.prepare(optimizer.SGD(0.1, parameters=net.parameters()),
+                      nn.MSELoss(), accelerate=accelerate)
+        for _ in range(30):
+            model.train_batch([paddle.to_tensor(x)], [paddle.to_tensor(y)])
+        if accelerate:
+            model._writeback_state()
+        return net.weight.numpy()
+
+    w_fast = train(True)
+    w_eager = train(False)
+    np.testing.assert_allclose(w_fast, w_eager, rtol=1e-3, atol=1e-4)
+
+
+def test_save_load_tensor_roundtrip(tmp_path):
+    obj = {"a": paddle.to_tensor([1.0, 2.0]), "nested": {"b": paddle.ones([2, 2])},
+           "scalar": 3}
+    p = str(tmp_path / "obj.pd")
+    paddle.save(obj, p)
+    loaded = paddle.load(p)
+    np.testing.assert_array_equal(loaded["a"].numpy(), [1.0, 2.0])
+    np.testing.assert_array_equal(loaded["nested"]["b"].numpy(), np.ones((2, 2)))
+    assert loaded["scalar"] == 3
+
+
+def test_to_static_linear():
+    net = nn.Linear(4, 2)
+    eager_out = net(paddle.ones([3, 4])).numpy()
+    snet = paddle.jit.to_static(net)
+    static_out = snet(paddle.ones([3, 4])).numpy()
+    np.testing.assert_allclose(static_out, eager_out, rtol=1e-6)
+
+
+def test_to_static_grads_flow():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    ref_params = [p.numpy().copy() for p in net.parameters()]
+    paddle.jit.to_static(net)
+    x = paddle.ones([2, 4])
+    out = net(x)
+    out.sum().backward()
+    grads = [p.grad for p in net.parameters()]
+    assert all(g is not None for g in grads)
+    opt = optimizer.SGD(0.1, parameters=net.parameters())
+    opt.step()
+    moved = any(not np.allclose(p.numpy(), r)
+                for p, r in zip(net.parameters(), ref_params))
+    assert moved
+
+
+def test_amp_autocast_bf16():
+    with paddle.amp.auto_cast(dtype="bfloat16"):
+        a = paddle.ones([4, 4])
+        b = paddle.ones([4, 4])
+        out = paddle.matmul(a, b)
+    assert out.dtype == paddle.bfloat16
+    out2 = paddle.matmul(a, b)
+    assert out2.dtype == np.dtype("float32")
+
+
+def test_grad_scaler_fp16_parity():
+    p = paddle.Parameter(np.array([1.0], np.float32))
+    opt = optimizer.SGD(1.0, parameters=[p])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+    loss = (p * 2).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    np.testing.assert_allclose(p.grad.numpy(), [16.0])  # scaled grad
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(p.numpy(), [-1.0])  # unscaled grad 2 applied
+
+
+def test_grad_scaler_skips_on_inf():
+    p = paddle.Parameter(np.array([1.0], np.float32))
+    opt = optimizer.SGD(1.0, parameters=[p])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=8.0,
+                                   decr_every_n_nan_or_inf=1)
+    p.grad = paddle.to_tensor([np.inf])
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(p.numpy(), [1.0])  # step skipped
+    assert scaler.get_loss_scaling() == 4.0  # scale halved

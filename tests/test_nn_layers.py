@@ -1,0 +1,324 @@
+"""nn layer tests (reference: unittests for conv/norm/pool/linear ops +
+dygraph Layer tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+class TestLayerInfra:
+    def test_parameters_registry(self):
+        l = nn.Linear(3, 4)
+        names = [n for n, _ in l.named_parameters()]
+        assert set(names) == {"weight", "bias"}
+        assert l.weight.shape == [3, 4]
+        assert l.bias.shape == [4]
+
+    def test_sublayers(self):
+        m = nn.Sequential(nn.Linear(2, 3), nn.ReLU(), nn.Linear(3, 1))
+        assert len(m.sublayers()) == 3
+        assert len(m.parameters()) == 4
+
+    def test_state_dict_roundtrip(self):
+        m = nn.Sequential(nn.Linear(2, 3), nn.Linear(3, 1))
+        sd = m.state_dict()
+        assert set(sd.keys()) == {"0.weight", "0.bias", "1.weight", "1.bias"}
+        m2 = nn.Sequential(nn.Linear(2, 3), nn.Linear(3, 1))
+        m2.set_state_dict({k: v.numpy() for k, v in sd.items()})
+        np.testing.assert_array_equal(m2[0].weight.numpy(), m[0].weight.numpy())
+
+    def test_train_eval_mode(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        m.eval()
+        assert not m[1].training
+        m.train()
+        assert m[1].training
+
+    def test_forward_hooks(self):
+        l = nn.Linear(2, 2)
+        calls = []
+        h = l.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+        l(paddle.ones([1, 2]))
+        assert calls
+        h.remove()
+        l(paddle.ones([1, 2]))
+        assert len(calls) == 1
+
+    def test_layer_to_dtype(self):
+        l = nn.Linear(2, 2)
+        l.to(dtype="bfloat16")
+        assert l.weight.dtype == paddle.bfloat16
+
+    def test_containers(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3
+        ll.append(nn.Linear(2, 2))
+        assert len(list(ll.parameters())) == 8
+        pl = nn.ParameterList([paddle.Parameter(np.zeros((2, 2), np.float32))])
+        assert len(pl) == 1
+        ld = nn.LayerDict({"a": nn.Linear(1, 1)})
+        assert "a" in ld
+
+
+class TestFunctional:
+    def test_linear(self):
+        x = paddle.ones([2, 3])
+        w = paddle.ones([3, 4])
+        b = paddle.ones([4])
+        out = F.linear(x, w, b)
+        np.testing.assert_allclose(out.numpy(), np.full((2, 4), 4.0))
+
+    def test_activations(self):
+        x = paddle.to_tensor([-1.0, 0.0, 2.0])
+        assert F.relu(x).numpy().tolist() == [0, 0, 2]
+        np.testing.assert_allclose(F.sigmoid(x).numpy(),
+                                   1 / (1 + np.exp([1.0, 0, -2])), rtol=1e-6)
+        np.testing.assert_allclose(F.softmax(x).numpy().sum(), 1.0, rtol=1e-6)
+        assert F.relu6(paddle.to_tensor([8.0])).item() == 6.0
+        assert F.leaky_relu(paddle.to_tensor([-1.0])).item() == pytest.approx(-0.01)
+        np.testing.assert_allclose(
+            F.gelu(paddle.to_tensor([1.0])).item(), 0.8413, atol=1e-3)
+
+    def test_conv2d_known_result(self):
+        x = paddle.ones([1, 1, 3, 3])
+        w = paddle.ones([1, 1, 2, 2])
+        out = F.conv2d(x, w)
+        assert out.shape == [1, 1, 2, 2]
+        np.testing.assert_allclose(out.numpy(), np.full((1, 1, 2, 2), 4.0))
+
+    def test_conv2d_padding_stride(self):
+        x = paddle.ones([1, 1, 4, 4])
+        w = paddle.ones([2, 1, 3, 3])
+        out = F.conv2d(x, w, stride=2, padding=1)
+        assert out.shape == [1, 2, 2, 2]
+
+    def test_conv2d_groups(self):
+        x = paddle.ones([1, 4, 5, 5])
+        w = paddle.ones([4, 2, 3, 3])
+        out = F.conv2d(x, w, padding=1, groups=2)
+        assert out.shape == [1, 4, 5, 5]
+
+    def test_conv2d_grad(self):
+        x = paddle.to_tensor(np.random.randn(1, 1, 4, 4).astype(np.float32),
+                             stop_gradient=False)
+        w = paddle.to_tensor(np.random.randn(2, 1, 3, 3).astype(np.float32),
+                             stop_gradient=False)
+        F.conv2d(x, w).sum().backward()
+        assert x.grad is not None and w.grad is not None
+        assert x.grad.shape == [1, 1, 4, 4]
+
+    def test_conv_transpose(self):
+        x = paddle.ones([1, 1, 2, 2])
+        w = paddle.ones([1, 1, 3, 3])
+        out = F.conv2d_transpose(x, w, stride=2)
+        assert out.shape == [1, 1, 5, 5]
+        # compare against torch-convention reference computed by hand:
+        # each input pixel paints a 3x3 block of ones; overlaps add.
+        expected = np.zeros((5, 5), np.float32)
+        for i in (0, 2):
+            for j in (0, 2):
+                expected[i : i + 3, j : j + 3] += 1
+        np.testing.assert_allclose(out.numpy()[0, 0], expected)
+
+    def test_pools(self):
+        x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        mp = F.max_pool2d(x, 2, 2)
+        np.testing.assert_allclose(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+        ap = F.avg_pool2d(x, 2, 2)
+        np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+        gp = F.adaptive_avg_pool2d(x, 1)
+        assert gp.numpy()[0, 0, 0, 0] == pytest.approx(7.5)
+        a3 = F.adaptive_avg_pool2d(x, 3)
+        assert a3.shape == [1, 1, 3, 3]
+
+    def test_batch_norm_train_and_stats(self):
+        bn = nn.BatchNorm2D(3)
+        x = paddle.to_tensor(np.random.randn(4, 3, 2, 2).astype(np.float32))
+        out = bn(x)
+        # normalized output: near-zero mean/unit var per channel
+        o = out.numpy()
+        np.testing.assert_allclose(o.mean(axis=(0, 2, 3)), 0, atol=1e-5)
+        np.testing.assert_allclose(o.var(axis=(0, 2, 3)), 1, atol=1e-2)
+        # running stats moved away from init
+        assert not np.allclose(bn._mean.numpy(), 0)
+        bn.eval()
+        out2 = bn(x)  # uses running stats — different from train out
+        assert not np.allclose(out2.numpy(), o)
+
+    def test_layer_norm(self):
+        ln = nn.LayerNorm(8)
+        x = paddle.to_tensor(np.random.randn(2, 4, 8).astype(np.float32))
+        o = ln(x).numpy()
+        np.testing.assert_allclose(o.mean(-1), 0, atol=1e-5)
+        np.testing.assert_allclose(o.var(-1), 1, atol=1e-2)
+
+    def test_group_instance_norm(self):
+        gn = nn.GroupNorm(2, 4)
+        x = paddle.to_tensor(np.random.randn(2, 4, 3, 3).astype(np.float32))
+        assert gn(x).shape == [2, 4, 3, 3]
+        inorm = nn.InstanceNorm2D(4)
+        assert inorm(x).shape == [2, 4, 3, 3]
+
+    def test_dropout(self):
+        x = paddle.ones([1000])
+        out = F.dropout(x, 0.5, training=True)
+        kept = (out.numpy() != 0).mean()
+        assert 0.3 < kept < 0.7
+        np.testing.assert_allclose(out.numpy()[out.numpy() != 0], 2.0)
+        out_eval = F.dropout(x, 0.5, training=False)
+        np.testing.assert_allclose(out_eval.numpy(), 1.0)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        idx = paddle.to_tensor(np.array([[1, 0, 3]]))
+        out = emb(idx)
+        assert out.shape == [1, 3, 4]
+        np.testing.assert_allclose(out.numpy()[0, 1], 0.0)
+
+    def test_embedding_grad(self):
+        emb = nn.Embedding(10, 4)
+        idx = paddle.to_tensor(np.array([1, 1, 2]))
+        emb(idx).sum().backward()
+        g = emb.weight.grad.numpy()
+        np.testing.assert_allclose(g[1], 2.0)  # index 1 used twice
+        np.testing.assert_allclose(g[2], 1.0)
+        np.testing.assert_allclose(g[3], 0.0)
+
+    def test_losses(self):
+        logits = paddle.to_tensor([[10.0, 0.0], [0.0, 10.0]])
+        labels = paddle.to_tensor(np.array([0, 1]))
+        assert F.cross_entropy(logits, labels).item() < 0.01
+        assert F.mse_loss(paddle.ones([3]), paddle.zeros([3])).item() == 1.0
+        assert F.l1_loss(paddle.ones([3]) * 2, paddle.zeros([3])).item() == 2.0
+        bce = F.binary_cross_entropy_with_logits(
+            paddle.to_tensor([100.0]), paddle.to_tensor([1.0]))
+        assert bce.item() < 1e-3
+
+    def test_cross_entropy_ignore_index(self):
+        logits = paddle.to_tensor(np.random.randn(3, 5).astype(np.float32))
+        labels = paddle.to_tensor(np.array([1, -100, 2]))
+        loss = F.cross_entropy(logits, labels, ignore_index=-100)
+        manual = F.cross_entropy(logits[np.array([0, 2])],
+                                 paddle.to_tensor(np.array([1, 2])))
+        np.testing.assert_allclose(loss.item(), manual.item(), rtol=1e-5)
+
+    def test_pad_interpolate(self):
+        x = paddle.ones([1, 1, 2, 2])
+        p = F.pad(x, [1, 1, 1, 1])
+        assert p.shape == [1, 1, 4, 4]
+        up = F.interpolate(x, scale_factor=2, mode="nearest")
+        assert up.shape == [1, 1, 4, 4]
+        bi = F.interpolate(x, size=[3, 3], mode="bilinear")
+        assert bi.shape == [1, 1, 3, 3]
+
+    def test_one_hot(self):
+        out = F.one_hot(paddle.to_tensor(np.array([0, 2])), 3)
+        np.testing.assert_allclose(out.numpy(), [[1, 0, 0], [0, 0, 1]])
+
+    def test_pixel_shuffle(self):
+        x = paddle.ones([1, 4, 2, 2])
+        assert F.pixel_shuffle(x, 2).shape == [1, 1, 4, 4]
+
+
+class TestRNN:
+    def test_lstm_shapes(self):
+        lstm = nn.LSTM(input_size=8, hidden_size=16, num_layers=2)
+        x = paddle.to_tensor(np.random.randn(4, 5, 8).astype(np.float32))
+        out, (h, c) = lstm(x)
+        assert out.shape == [4, 5, 16]
+        assert h.shape == [2, 4, 16]
+        assert c.shape == [2, 4, 16]
+
+    def test_lstm_bidirectional(self):
+        lstm = nn.LSTM(8, 16, direction="bidirect")
+        x = paddle.to_tensor(np.random.randn(2, 5, 8).astype(np.float32))
+        out, (h, c) = lstm(x)
+        assert out.shape == [2, 5, 32]
+        assert h.shape == [2, 2, 16]
+
+    def test_gru_simple_rnn(self):
+        x = paddle.to_tensor(np.random.randn(2, 5, 8).astype(np.float32))
+        gru = nn.GRU(8, 12)
+        out, h = gru(x)
+        assert out.shape == [2, 5, 12] and h.shape == [1, 2, 12]
+        rnn = nn.SimpleRNN(8, 12)
+        out, h = rnn(x)
+        assert out.shape == [2, 5, 12]
+
+    def test_lstm_grad(self):
+        lstm = nn.LSTM(4, 8)
+        x = paddle.to_tensor(np.random.randn(2, 3, 4).astype(np.float32),
+                             stop_gradient=False)
+        out, _ = lstm(x)
+        out.sum().backward()
+        assert x.grad is not None
+        assert lstm.weight_ih_l0.grad is not None
+
+    def test_lstm_cell(self):
+        cell = nn.LSTMCell(4, 8)
+        x = paddle.to_tensor(np.random.randn(2, 4).astype(np.float32))
+        h, (h2, c2) = cell(x)
+        assert h.shape == [2, 8] and c2.shape == [2, 8]
+
+
+class TestTransformer:
+    def test_mha(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.to_tensor(np.random.randn(2, 5, 16).astype(np.float32))
+        out = mha(x, x, x)
+        assert out.shape == [2, 5, 16]
+
+    def test_mha_mask(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.to_tensor(np.random.randn(1, 4, 16).astype(np.float32))
+        mask = paddle.to_tensor(np.tril(np.ones((1, 4, 4, 4))).astype(bool))
+        out = mha(x, attn_mask=mask)
+        assert out.shape == [1, 4, 16]
+
+    def test_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = paddle.to_tensor(np.random.randn(2, 6, 16).astype(np.float32))
+        out = enc(x)
+        assert out.shape == [2, 6, 16]
+
+    def test_full_transformer(self):
+        model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=2,
+                               num_decoder_layers=2, dim_feedforward=32)
+        src = paddle.to_tensor(np.random.randn(2, 5, 16).astype(np.float32))
+        tgt = paddle.to_tensor(np.random.randn(2, 3, 16).astype(np.float32))
+        out = model(src, tgt)
+        assert out.shape == [2, 3, 16]
+
+    def test_transformer_grad(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        x = paddle.to_tensor(np.random.randn(2, 4, 16).astype(np.float32),
+                             stop_gradient=False)
+        layer(x).sum().backward()
+        assert x.grad is not None
+        assert layer.self_attn.q_proj.weight.grad is not None
+
+
+class TestModels:
+    def test_lenet_forward_backward(self):
+        from paddle_tpu.vision.models import LeNet
+
+        model = LeNet()
+        x = paddle.to_tensor(np.random.randn(2, 1, 28, 28).astype(np.float32))
+        out = model(x)
+        assert out.shape == [2, 10]
+        loss = F.cross_entropy(out, paddle.to_tensor(np.array([1, 2])))
+        loss.backward()
+        for p in model.parameters():
+            assert p.grad is not None
+
+    def test_resnet18_tiny_forward(self):
+        from paddle_tpu.vision.models import resnet18
+
+        model = resnet18(num_classes=10)
+        model.eval()
+        x = paddle.to_tensor(np.random.randn(1, 3, 32, 32).astype(np.float32))
+        out = model(x)
+        assert out.shape == [1, 10]

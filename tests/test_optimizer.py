@@ -1,0 +1,211 @@
+"""Optimizer tests (reference: unittests test_adam_op, test_momentum_op,
+test_sgd_op + lr scheduler tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.nn import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+
+
+def quad_problem():
+    p = paddle.Parameter(np.array([5.0, -3.0], np.float32))
+    return p
+
+
+def loss_and_backward(p):
+    loss = (p * p).sum()
+    loss.backward()
+    return float(loss.numpy())
+
+
+class TestOptimizers:
+    def test_sgd_converges(self):
+        p = quad_problem()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+        for _ in range(50):
+            loss_and_backward(p)
+            opt.step()
+            opt.clear_grad()
+        assert np.abs(p.numpy()).max() < 1e-3
+
+    def test_sgd_update_value(self):
+        p = paddle.Parameter(np.array([1.0], np.float32))
+        opt = optimizer.SGD(learning_rate=0.5, parameters=[p])
+        (p * 2).backward()  # grad = 2
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [0.0])
+
+    def test_momentum_matches_reference_formula(self):
+        p = paddle.Parameter(np.array([1.0], np.float32))
+        opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9, parameters=[p])
+        vel = 0.0
+        ref = 1.0
+        for _ in range(5):
+            (p * 3).backward()  # grad = 3
+            opt.step()
+            opt.clear_grad()
+            vel = 0.9 * vel + 3
+            ref = ref - 0.1 * vel
+        np.testing.assert_allclose(p.numpy(), [ref], rtol=1e-6)
+
+    def test_adam_matches_reference_formula(self):
+        p = paddle.Parameter(np.array([1.0], np.float32))
+        opt = optimizer.Adam(learning_rate=0.01, parameters=[p])
+        m = v = 0.0
+        ref = 1.0
+        for t in range(1, 6):
+            (p * 2).backward()
+            opt.step()
+            opt.clear_grad()
+            g = 2.0
+            m = 0.9 * m + 0.1 * g
+            v = 0.999 * v + 0.001 * g * g
+            mh = m / (1 - 0.9**t)
+            vh = v / (1 - 0.999**t)
+            ref -= 0.01 * mh / (np.sqrt(vh) + 1e-8)
+        np.testing.assert_allclose(p.numpy(), [ref], rtol=1e-5)
+
+    def test_adamw_decay(self):
+        p = paddle.Parameter(np.array([1.0], np.float32))
+        opt = optimizer.AdamW(learning_rate=0.01, parameters=[p], weight_decay=0.1)
+        (p * 0).sum().backward()
+        opt.step()
+        # zero grad → only decoupled decay applies (adam update ~0)
+        np.testing.assert_allclose(p.numpy(), [1.0 * (1 - 0.01 * 0.1)], atol=1e-6)
+
+    def test_all_optimizers_step(self):
+        for cls, kw in [
+            (optimizer.Adagrad, {"learning_rate": 0.1}),
+            (optimizer.Adamax, {}),
+            (optimizer.Adadelta, {}),
+            (optimizer.RMSProp, {"learning_rate": 0.01}),
+            (optimizer.Lamb, {}),
+            (optimizer.Lars, {"learning_rate": 0.1}),
+        ]:
+            p = quad_problem()
+            opt = cls(parameters=[p], **kw)
+            l0 = loss_and_backward(p)
+            opt.step()
+            opt.clear_grad()
+            l1 = loss_and_backward(p)
+            opt.step()
+            assert l1 < l0, cls.__name__
+
+    def test_minimize(self):
+        p = quad_problem()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+        loss = (p * p).sum()
+        opt.minimize(loss)
+        assert float((p * p).sum().numpy()) < float(loss.numpy())
+
+    def test_state_dict_roundtrip(self):
+        p = paddle.Parameter(np.array([1.0], np.float32), name="p0")
+        opt = optimizer.Adam(learning_rate=0.01, parameters=[p])
+        (p * 2).backward()
+        opt.step()
+        sd = opt.state_dict()
+        p2 = paddle.Parameter(np.array([1.0], np.float32), name="p0")
+        opt2 = optimizer.Adam(learning_rate=0.01, parameters=[p2])
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == 1
+        np.testing.assert_allclose(
+            opt2._accumulators["moment1"][id(p2)],
+            opt._accumulators["moment1"][id(p)])
+
+
+class TestGradClip:
+    def test_clip_by_value(self):
+        p = paddle.Parameter(np.array([1.0], np.float32))
+        opt = optimizer.SGD(learning_rate=1.0, parameters=[p],
+                            grad_clip=ClipGradByValue(0.5))
+        (p * 10).backward()  # grad 10 → clipped to 0.5
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [0.5])
+
+    def test_clip_by_norm(self):
+        p = paddle.Parameter(np.array([3.0, 4.0], np.float32))
+        opt = optimizer.SGD(learning_rate=1.0, parameters=[p],
+                            grad_clip=ClipGradByNorm(1.0))
+        (p * paddle.to_tensor([3.0, 4.0])).sum().backward()  # grad [3,4], norm 5
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [3 - 0.6, 4 - 0.8], rtol=1e-6)
+
+    def test_clip_by_global_norm(self):
+        p1 = paddle.Parameter(np.array([3.0], np.float32))
+        p2 = paddle.Parameter(np.array([4.0], np.float32))
+        opt = optimizer.SGD(learning_rate=1.0, parameters=[p1, p2],
+                            grad_clip=ClipGradByGlobalNorm(1.0))
+        (p1 * 3 + p2 * 4).backward()
+        opt.step()
+        np.testing.assert_allclose(p1.numpy(), [3 - 0.6], rtol=1e-5)
+        np.testing.assert_allclose(p2.numpy(), [4 - 0.8], rtol=1e-5)
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        vals = []
+        for _ in range(5):
+            vals.append(s())
+            s.step()
+        np.testing.assert_allclose(vals, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    def test_piecewise(self):
+        s = optimizer.lr.PiecewiseDecay([2, 4], [0.1, 0.01, 0.001])
+        vals = [s() for _ in range(1)]
+        for _ in range(4):
+            s.step()
+            vals.append(s())
+        np.testing.assert_allclose(vals, [0.1, 0.1, 0.01, 0.01, 0.001])
+
+    def test_cosine(self):
+        s = optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        assert s() == pytest.approx(1.0)
+        for _ in range(10):
+            s.step()
+        assert s() == pytest.approx(0.0, abs=1e-6)
+
+    def test_warmup(self):
+        s = optimizer.lr.LinearWarmup(0.1, warmup_steps=5, start_lr=0.0, end_lr=0.1)
+        assert s() == pytest.approx(0.0)
+        for _ in range(5):
+            s.step()
+        assert s() == pytest.approx(0.1)
+
+    def test_noam(self):
+        s = optimizer.lr.NoamDecay(d_model=512, warmup_steps=10)
+        peak_region = []
+        for _ in range(20):
+            s.step()
+            peak_region.append(s())
+        assert max(peak_region) == pytest.approx(peak_region[9], rel=1e-6)
+
+    def test_scheduler_with_optimizer(self):
+        p = quad_problem()
+        sched = optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.1)
+        opt = optimizer.SGD(learning_rate=sched, parameters=[p])
+        assert opt.get_lr() == pytest.approx(0.1)
+        sched.step()
+        assert opt.get_lr() == pytest.approx(0.01)
+
+    def test_reduce_on_plateau(self):
+        s = optimizer.lr.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+        s.step(1.0)
+        s.step(1.0)
+        s.step(1.0)
+        s.step(1.0)
+        assert s() == pytest.approx(0.05)
+
+
+class TestRegularizer:
+    def test_l2_decay(self):
+        from paddle_tpu.regularizer import L2Decay
+
+        p = paddle.Parameter(np.array([1.0], np.float32))
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[p],
+                            weight_decay=L2Decay(0.5))
+        (p * 0).sum().backward()
+        opt.step()
+        # grad = 0 + 0.5*1.0 → p = 1 - 0.1*0.5
+        np.testing.assert_allclose(p.numpy(), [0.95], rtol=1e-6)

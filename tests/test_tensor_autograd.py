@@ -1,0 +1,310 @@
+"""Tensor + autograd tape tests (reference test strategy: OpTest check_grad
+numeric-vs-analytic, fluid/tests/unittests/op_test.py:1362)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import Tensor
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    """Finite differences (reference op_test.py:110 get_numeric_gradient)."""
+    x = x.astype(np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy()
+        xp[idx] += eps
+        xm = x.copy()
+        xm[idx] -= eps
+        g[idx] = (fn(xp.astype(np.float32)) - fn(xm.astype(np.float32))) / (2 * eps)
+        it.iternext()
+    return g
+
+
+class TestTensorBasics:
+    def test_creation(self):
+        t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.shape == [2, 2]
+        assert t.dtype == np.float32
+        assert t.numpy().tolist() == [[1.0, 2.0], [3.0, 4.0]]
+
+    def test_creation_ops(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([4]).numpy().sum() == 4
+        assert paddle.full([2], 7).numpy().tolist() == [7.0, 7.0]
+        assert paddle.arange(5).numpy().tolist() == [0, 1, 2, 3, 4]
+        assert paddle.eye(3).numpy().trace() == 3
+        assert paddle.linspace(0, 1, 3).numpy().tolist() == [0.0, 0.5, 1.0]
+
+    def test_arithmetic(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.to_tensor([3.0, 4.0])
+        assert (a + b).numpy().tolist() == [4.0, 6.0]
+        assert (a * b).numpy().tolist() == [3.0, 8.0]
+        assert (b - a).numpy().tolist() == [2.0, 2.0]
+        assert (b / a).numpy().tolist() == [3.0, 2.0]
+        assert (a ** 2).numpy().tolist() == [1.0, 4.0]
+        assert (2 + a).numpy().tolist() == [3.0, 4.0]
+        assert (-a).numpy().tolist() == [-1.0, -2.0]
+
+    def test_methods(self):
+        a = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert float(a.sum()) == 10.0
+        assert float(a.mean()) == 2.5
+        assert a.reshape([4]).shape == [4]
+        assert a.transpose([1, 0]).numpy()[0, 1] == 3.0
+        assert a.astype("int32").dtype == np.int32
+        assert a.t().shape == [2, 2]
+
+    def test_indexing(self):
+        a = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        assert a[0].numpy().tolist() == [0, 1, 2, 3]
+        assert a[1, 2].item() == 6.0
+        assert a[:, 1].numpy().tolist() == [1, 5, 9]
+        a[0, 0] = 99.0
+        assert a[0, 0].item() == 99.0
+
+    def test_setitem_grad(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+        y = x * 2
+        y[0] = 0.0
+        loss = y.sum()
+        loss.backward()
+        assert x.grad.numpy().tolist() == [0.0, 2.0, 2.0]
+
+    def test_item_scalar(self):
+        t = paddle.to_tensor(3.5)
+        assert t.item() == 3.5
+        assert float(t) == 3.5
+        assert t.ndim == 0
+
+
+class TestAutograd:
+    def test_simple_backward(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+    def test_chain(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.exp(paddle.sin(x))
+        y.backward()
+        expected = np.cos(1.0) * np.exp(np.sin(1.0))
+        np.testing.assert_allclose(x.grad.numpy(), [expected], rtol=1e-5)
+
+    def test_accumulation(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).backward()
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_branching_graph(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        a = x * 3
+        b = x * 4
+        (a + b).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+    def test_shared_intermediate(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        h = x * x
+        y = h * 2 + h * 3  # dy/dh = 5, dh/dx = 2x
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+    def test_stop_gradient(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.to_tensor([2.0], stop_gradient=True)
+        (x * y).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+        assert y.grad is None
+
+    def test_no_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y._grad_node is None
+
+    def test_detach(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * 2).detach()
+        assert y.stop_gradient
+        z = y * 3
+        assert z._grad_node is None
+
+    def test_multi_output_op(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                             stop_gradient=False)
+        parts = paddle.split(x, 3, axis=1)
+        loss = parts[0].sum() + 2 * parts[2].sum()
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   [[1, 0, 2], [1, 0, 2]])
+
+    def test_hook(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        seen = []
+
+        def hook(g):
+            seen.append(g.numpy().copy())
+            return g * 2
+
+        x.register_hook(hook)
+        (x * 3).backward()
+        assert seen and seen[0][0] == 3.0
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+    def test_double_backward_raises_without_retain(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_paddle_grad(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * x
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [6.0])
+        assert x.grad is None  # paddle.grad must not touch .grad
+
+    def test_double_grad(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * x * x
+        (gx,) = paddle.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(gx.numpy(), [27.0])
+        (ggx,) = paddle.grad(gx, x)
+        np.testing.assert_allclose(ggx.numpy(), [18.0])
+
+    def test_numeric_grad_matmul(self):
+        np.random.seed(0)
+        xv = np.random.randn(3, 4).astype(np.float32)
+        wv = np.random.randn(4, 2).astype(np.float32)
+
+        x = paddle.to_tensor(xv, stop_gradient=False)
+        w = paddle.to_tensor(wv, stop_gradient=False)
+        loss = paddle.matmul(x, w).sum()
+        loss.backward()
+
+        ng = numeric_grad(
+            lambda v: float((v @ wv).sum()), xv)
+        np.testing.assert_allclose(x.grad.numpy(), ng, rtol=1e-2, atol=1e-3)
+
+    def test_numeric_grad_softmax_xent(self):
+        np.random.seed(1)
+        logits = np.random.randn(4, 5).astype(np.float32)
+        labels = np.array([0, 2, 1, 4])
+
+        def f(v):
+            t = paddle.to_tensor(v)
+            return float(paddle.nn.functional.cross_entropy(
+                t, paddle.to_tensor(labels)).numpy())
+
+        x = paddle.to_tensor(logits, stop_gradient=False)
+        loss = paddle.nn.functional.cross_entropy(x, paddle.to_tensor(labels))
+        loss.backward()
+        ng = numeric_grad(f, logits)
+        np.testing.assert_allclose(x.grad.numpy(), ng, rtol=1e-2, atol=1e-3)
+
+    def test_check_nan_inf_flag(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = paddle.to_tensor([1.0, 0.0])
+            with pytest.raises(FloatingPointError):
+                y = paddle.log(x * 0 - 1)
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+class TestOps:
+    def test_reductions(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        assert paddle.sum(x, axis=0).numpy().tolist() == [3, 5, 7]
+        assert paddle.max(x).item() == 5
+        assert paddle.min(x, axis=1).numpy().tolist() == [0, 3]
+        assert paddle.prod(paddle.to_tensor([2.0, 3.0])).item() == 6.0
+        np.testing.assert_allclose(paddle.std(x).item(), np.std(np.arange(6), ddof=1),
+                                   rtol=1e-6)
+
+    def test_manipulation(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        assert paddle.concat([x, x], axis=0).shape == [4, 3]
+        assert paddle.stack([x, x]).shape == [2, 2, 3]
+        assert paddle.flatten(x).shape == [6]
+        assert paddle.unsqueeze(x, 0).shape == [1, 2, 3]
+        assert paddle.squeeze(paddle.unsqueeze(x, 0)).shape == [2, 3]
+        assert paddle.tile(x, [2, 1]).shape == [4, 3]
+        assert paddle.flip(x, 0).numpy()[0].tolist() == [3, 4, 5]
+        parts = paddle.split(x, [1, 2], axis=1)
+        assert parts[0].shape == [2, 1] and parts[1].shape == [2, 2]
+
+    def test_gather_scatter(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+        idx = paddle.to_tensor([0, 2])
+        g = paddle.gather(x, idx, axis=0)
+        assert g.numpy()[1].tolist() == [6, 7, 8]
+        upd = paddle.scatter(x, paddle.to_tensor([1]),
+                             paddle.to_tensor([[9.0, 9.0, 9.0]]))
+        assert upd.numpy()[1].tolist() == [9, 9, 9]
+
+    def test_search(self):
+        x = paddle.to_tensor([[3.0, 1.0, 2.0]])
+        assert paddle.argmax(x, axis=1).item() == 0
+        assert paddle.argsort(x, axis=1).numpy()[0].tolist() == [1, 2, 0]
+        vals, idx = paddle.topk(x, 2, axis=1)
+        assert vals.numpy()[0].tolist() == [3.0, 2.0]
+        assert idx.numpy()[0].tolist() == [0, 2]
+
+    def test_logic(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.to_tensor([2.0, 2.0])
+        assert paddle.equal(a, b).numpy().tolist() == [False, True]
+        assert paddle.less_than(a, b).numpy().tolist() == [True, False]
+        assert paddle.where(paddle.greater_than(b, a), a, b).numpy().tolist() == [1.0, 2.0]
+        assert bool(paddle.allclose(a, a))
+
+    def test_linalg(self):
+        m = paddle.to_tensor([[2.0, 0.0], [0.0, 3.0]])
+        assert abs(paddle.det(m).item() - 6.0) < 1e-5
+        inv = paddle.inverse(m)
+        np.testing.assert_allclose(inv.numpy(), [[0.5, 0], [0, 1 / 3]], rtol=1e-5)
+        np.testing.assert_allclose(paddle.norm(paddle.to_tensor([3.0, 4.0]),
+                                               p=2).item(), 5.0, rtol=1e-6)
+
+    def test_random_shapes(self):
+        assert paddle.rand([2, 3]).shape == [2, 3]
+        assert paddle.randn([4]).shape == [4]
+        r = paddle.randint(0, 10, [100])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
+
+    def test_seed_determinism(self):
+        paddle.seed(7)
+        a = paddle.randn([4]).numpy()
+        paddle.seed(7)
+        b = paddle.randn([4]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_cumsum_clip(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0])
+        assert paddle.cumsum(x).numpy().tolist() == [1, 3, 6]
+        assert paddle.clip(x, 1.5, 2.5).numpy().tolist() == [1.5, 2.0, 2.5]
+
+    def test_einsum(self):
+        a = paddle.to_tensor(np.random.randn(2, 3).astype(np.float32))
+        b = paddle.to_tensor(np.random.randn(3, 4).astype(np.float32))
+        out = paddle.einsum("ij,jk->ik", a, b)
+        np.testing.assert_allclose(out.numpy(), a.numpy() @ b.numpy(), rtol=1e-5)
