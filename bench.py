@@ -1,0 +1,184 @@
+"""Benchmark driver: prints ONE JSON line.
+
+Measures steady-state ResNet-50 training throughput (imgs/sec/chip, bf16
+autocast, jitted whole train step with donated buffers) on the available
+accelerator — BASELINE.md config 2/3.  vs_baseline compares against the
+public V100 fp32 reference point named by BASELINE.json (~383 imgs/sec for
+ResNet-50 ImageNet training, the widely reported V100 fp32 number; the
+reference repo publishes no in-repo numbers — BASELINE.md).
+
+Env overrides: BENCH_MODEL=resnet50|bert, BENCH_BATCH, BENCH_STEPS.
+
+Timing protocol: on the axon-tunneled TPU, jax.block_until_ready does NOT
+synchronize (relay executes lazily); only a device->host fetch does.  Steps
+are chained through the donated train state, so fetching the final step's
+scalar loss forces the whole chain; the tunnel's round-trip latency is
+measured separately and subtracted.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+V100_RESNET50_FP32_IMGS_PER_SEC = 383.0
+V100_BERT_BASE_TOKENS_PER_SEC = 11600.0  # public V100 fp32 BERT-base pretrain ref
+
+
+def build_step(model, loss_fn, opt):
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.random import rng_scope
+    from paddle_tpu.jit.functional import functional_call, get_state
+    from paddle_tpu.tensor import Tensor
+
+    params, buffers = get_state(model)
+    opt_state = opt.init_opt_state(params)
+
+    def step_fn(state, key, x, y):
+        def loss_of(p):
+            with rng_scope(key):
+                with paddle.amp.auto_cast(dtype="bfloat16"):
+                    out, new_bufs = functional_call(
+                        model, p, state["buffers"], (x,), training=True)
+            loss = loss_fn(Tensor(out), Tensor(y))
+            return loss._value.astype(jnp.float32), new_bufs
+
+        (loss, new_bufs), grads = jax.value_and_grad(loss_of, has_aux=True)(
+            state["params"])
+        count = state["step"] + 1
+        new_params, new_opt = opt.fused_step(state["params"], grads,
+                                             state["opt"], count)
+        return {"params": new_params, "buffers": new_bufs, "opt": new_opt,
+                "step": count}, loss
+
+    state = {"params": params, "buffers": buffers, "opt": opt_state,
+             "step": jnp.zeros((), jnp.int32)}
+    return jax.jit(step_fn, donate_argnums=(0,)), state
+
+
+def _sync_scalar(x):
+    """Force execution: fetch a scalar (block_until_ready is a no-op on the
+    axon relay)."""
+    import numpy as np
+
+    return float(np.asarray(x.reshape(-1)[0] if x.ndim else x))
+
+
+def _roundtrip_latency():
+    import jax.numpy as jnp
+
+    t = jnp.zeros(())
+    _sync_scalar(t + 1)  # warm path
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _sync_scalar(t + 1)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _timed_chain(step, state, key, x, y, steps):
+    """Run `steps` chained train steps; return (elapsed_compute_seconds, loss)."""
+    # warmup (compile + first executions)
+    for _ in range(3):
+        state, loss = step(state, key, x, y)
+    _sync_scalar(loss)
+    rt = _roundtrip_latency()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step(state, key, x, y)
+    loss_val = _sync_scalar(loss)
+    dt = time.perf_counter() - t0 - rt
+    return max(dt, 1e-9), loss_val
+
+
+def bench_resnet50(batch, steps):
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    step, state = build_step(model, loss_fn, opt)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(batch, 3, 224, 224).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 1000, (batch,)).astype(np.int32))
+    key = jax.random.key(0)
+
+    dt, loss_val = _timed_chain(step, state, key, x, y, steps)
+    imgs_per_sec = batch * steps / dt
+    return {
+        "metric": "resnet50_train_imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "imgs/sec/chip",
+        "vs_baseline": round(imgs_per_sec / V100_RESNET50_FP32_IMGS_PER_SEC, 3),
+        "detail": {"batch": batch, "steps": steps, "dtype": "bf16-autocast",
+                   "loss": loss_val},
+    }
+
+
+def bench_bert(batch, steps, seq_len=128):
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.text.models import BertForSequenceClassification
+
+    paddle.seed(0)
+    model = BertForSequenceClassification(num_classes=2)
+    opt = optimizer.AdamW(learning_rate=5e-5, parameters=model.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    step, state = build_step(model, loss_fn, opt)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randint(0, 30000, (batch, seq_len)).astype(np.int32))
+    y = jnp.asarray(rng.randint(0, 2, (batch,)).astype(np.int32))
+    key = jax.random.key(0)
+    dt, loss_val = _timed_chain(step, state, key, x, y, steps)
+    tokens_per_sec = batch * seq_len * steps / dt
+    return {
+        "metric": "bert_base_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tokens_per_sec / V100_BERT_BASE_TOKENS_PER_SEC, 3),
+        "detail": {"batch": batch, "seq_len": seq_len, "steps": steps,
+                   "dtype": "bf16-autocast", "loss": loss_val},
+    }
+
+
+def main():
+    which = os.environ.get("BENCH_MODEL", "resnet50")
+    steps = int(os.environ.get("BENCH_STEPS", "30"))
+    if which == "bert":
+        batch = int(os.environ.get("BENCH_BATCH", "32"))
+        result = bench_bert(batch, steps)
+    else:
+        batch = int(os.environ.get("BENCH_BATCH", "128"))
+        try:
+            result = bench_resnet50(batch, steps)
+        except Exception as e:  # OOM etc: retry smaller
+            sys.stderr.write(f"batch {batch} failed ({type(e).__name__}); retry 32\n")
+            result = bench_resnet50(32, steps)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -34,5 +34,11 @@ from .parallel_layers import (  # noqa: F401
     RowParallelLinear,
     VocabParallelEmbedding,
 )
+from .pipeline import pipeline_apply, pipeline_forward, stack_stage_params  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    ring_attention,
+    sequence_parallel_attention,
+    ulysses_attention,
+)
 from .spawn import spawn  # noqa: F401
 from . import launch  # noqa: F401

@@ -16,11 +16,30 @@ from ...tensor import Tensor
 
 def recompute(function, *args, **kwargs):
     """paddle.distributed.fleet.utils.recompute parity: run `function`
-    under rematerialization."""
+    under rematerialization.  When `function` is a Layer, its parameters are
+    threaded through as differentiable inputs so their grads flow (and get
+    the remat treatment too)."""
     preserve = kwargs.pop("preserve_rng_state", True)
 
-    from ...jit.functional import tree_unwrap, tree_wrap
     from ...autograd.tape import no_grad
+    from ...jit.functional import functional_call, get_state, tree_unwrap, tree_wrap
+    from ...nn.layer import Layer
+
+    if isinstance(function, Layer):
+        params, buffers = get_state(function)
+        names = list(params.keys())
+        param_tensors = dict(function.named_parameters())
+
+        def pure(*vals):
+            pvals = dict(zip(names, vals[: len(names)]))
+            xs = vals[len(names):]
+            out, _ = functional_call(function, pvals, buffers, xs,
+                                     kwargs=kwargs)
+            return out
+
+        ckpt = jax.checkpoint(pure)
+        return apply("recompute", ckpt,
+                     *[param_tensors[n] for n in names], *args)
 
     def pure(*arr_args):
         wrapped = [Tensor(a) if not isinstance(a, Tensor) else a

@@ -1,0 +1,135 @@
+"""Pipeline parallelism over the 'pp' mesh axis.
+
+Reference analog: PipelineOptimizer (fluid/optimizer.py:3718 — program cut by
+device_guard into stage sub-programs, send_v2/recv_v2 p2p, micro-batch loop in
+SectionWorker, F-then-B and 1F1B schedules; fleet
+meta_optimizers/pipeline_optimizer.py:25).
+
+TPU-native design (the "pipelined scan" from the scaling-book playbook):
+every device runs the SAME program under shard_map over 'pp'; each holds its
+stage's layer parameters; microbatches stream through the ring via
+jax.lax.ppermute inside a lax.scan over fill+steady+drain ticks.  The
+backward pass is jax.grad of the scan — XLA reverses the schedule (the
+F-then-B equivalent), so no hand-written send/recv of gradients is needed.
+Activation stash for the backward is handled by autodiff-of-scan; pair with
+jax.checkpoint on the stage fn for 1F1B-like memory behavior.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, List
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+NEG = 0.0
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
+                   axis_name: str = "pp", remat: bool = True):
+    """Run microbatches through the pipeline inside shard_map.
+
+    stage_fn(params, x) -> y : one stage's computation (same code every stage).
+    stage_params: this device's stage parameters (pytree).
+    x_microbatches: [M, mb, ...] microbatches, valid data on EVERY device
+      (replicated); stage 0 consumes them in order.
+    Returns [M, mb, ...] outputs (valid on the last stage; replicated out by
+    caller via ppermute/psum as needed).
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    M = x_microbatches.shape[0]
+    mb_shape = x_microbatches.shape[1:]
+
+    fn = stage_fn
+    if remat:
+        fn = jax.checkpoint(stage_fn)
+
+    total = M + n - 1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # derive initial carries from a probe so their shard_map varying-axis
+    # types match the loop body's outputs on any mesh (pp alone, pp×dp, …)
+    probe = fn(stage_params, x_microbatches[0]) * 0
+    buf0 = probe
+    outs0 = jnp.zeros((M,) + probe.shape, probe.dtype) + probe[None]
+
+    if probe.shape != mb_shape:
+        raise ValueError(
+            "pipeline stage_fn must preserve the activation shape "
+            f"(got {mb_shape} -> {probe.shape}); wrap shape-changing head/"
+            "tail layers outside the pipelined block")
+
+    def tick(carry, t):
+        cur, outs = carry
+        # stage 0 ingests microbatch t (if in range) — other stages use the
+        # activation that arrived from the previous stage
+        feed = jax.lax.dynamic_index_in_dim(
+            x_microbatches, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+        cur = jnp.where(idx == 0, feed, cur)
+        y = fn(stage_params, cur)
+        # last stage records its finished microbatch (t - (n-1))
+        out_t = t - (n - 1)
+        record = (idx == n - 1) & (out_t >= 0)
+        outs = jax.lax.cond(
+            record,
+            lambda o: jax.lax.dynamic_update_index_in_dim(
+                o, y, jnp.clip(out_t, 0, M - 1), axis=0),
+            lambda o: o,
+            outs,
+        )
+        # rotate activations to the next stage
+        nxt = jax.lax.ppermute(y, axis_name, perm)
+        return (nxt, outs), None
+
+    (_, outs), _ = jax.lax.scan(tick, (buf0, outs0), jnp.arange(total))
+    return outs
+
+
+class PipelineStage:
+    """Describes the per-stage computation for pipeline_train_step."""
+
+    def __init__(self, stage_fn, params):
+        self.stage_fn = stage_fn
+        self.params = params
+
+
+def pipeline_forward(mesh, stage_fn, params_by_stage, x, micro_batch_size,
+                     axis_name: str = "pp", remat: bool = True):
+    """Whole-array entry: params_by_stage is a pytree whose leaves have a
+    leading stage dimension (sharded over 'pp'); x is the global batch
+    (replicated). Returns final-stage outputs for the full batch."""
+    from jax import shard_map
+
+    B = x.shape[0]
+    M = B // micro_batch_size
+    xm = x.reshape((M, micro_batch_size) + x.shape[1:])
+
+    def inner(params_local, xm_local):
+        params_local = jax.tree_util.tree_map(
+            lambda p: jnp.squeeze(p, axis=0), params_local)
+        outs = pipeline_apply(stage_fn, params_local, xm_local,
+                              axis_name=axis_name, remat=remat)
+        # broadcast final-stage outputs to all stages so out_specs can be
+        # replicated (last stage holds the real values)
+        n = jax.lax.psum(1, axis_name)
+        idx = jax.lax.axis_index(axis_name)
+        mask = (idx == n - 1).astype(outs.dtype)
+        return jax.lax.psum(outs * mask, axis_name)
+
+    fn = shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(PartitionSpec(axis_name), PartitionSpec()),
+        out_specs=PartitionSpec(),
+    )
+    outs = fn(params_by_stage, xm)
+    return outs.reshape((B,) + outs.shape[2:])
+
+
+def stack_stage_params(per_stage_params: List):
+    """Stack a list of per-stage parameter pytrees along a new leading axis
+    (to be sharded over 'pp')."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)

@@ -1,0 +1,122 @@
+"""Ring attention — context/sequence parallelism over a mesh axis.
+
+New capability vs the reference (SURVEY §5.7: no CP/SP exists there).  The
+sequence dimension is sharded over the 'sp' mesh axis; each device holds a
+local Q/K/V shard and the KV shards rotate around the ring via
+jax.lax.ppermute (ICI neighbor exchange) while each device accumulates its
+queries' attention with online-softmax merging — full attention over
+sequences n_devices× longer than one chip's memory, with communication
+overlapped by XLA's async collectives.
+
+Use inside shard_map (see sequence_parallel_attention) or through
+paddle_tpu.nn.functional.ring_attention.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Blockwise ring attention.
+
+    q, k, v: local shards [B, S_local, H, D] (BSHD, paddle layout) inside a
+    shard_map over `axis_name`. Returns local output shard [B, S_local, H, D].
+    """
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+
+    # work in BHSD
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+
+    # derive initial carries from the data so their shard_map varying-axis
+    # types match the loop outputs on any mesh
+    zero = jnp.sum(qt * 0.0, axis=-1)  # [B,H,S] varying like qt
+    acc0 = qt * 0.0
+    m0 = zero + NEG_INF
+    l0 = zero
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(i, carry):
+        acc, m, l, k_cur, v_cur = carry
+        src = (my_idx - i) % n  # whose KV shard we hold this round
+        s = jnp.einsum("bhqd,bhkd->bhqk", qt, k_cur.astype(jnp.float32))
+        if causal:
+            q_pos = my_idx * S + jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+            k_pos = src * S + jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+            s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return acc_new, m_new, l_new, k_next, v_next
+
+    acc, m, l, _, _ = jax.lax.fori_loop(0, n, step, (acc0, m0, l0, kt, vt))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def sequence_parallel_attention(q, k, v, mesh=None, axis_name: str = "sp",
+                                causal: bool = False):
+    """Whole-array entry: q/k/v are global [B, S, H, D]; runs ring attention
+    with S sharded over `axis_name` of the (global) mesh."""
+    from .mesh import get_mesh
+    from jax import shard_map
+
+    mesh = mesh or get_mesh()
+    spec = PartitionSpec(None, axis_name, None, None)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
+                      local_attention=None):
+    """DeepSpeed-Ulysses style SP: all-to-all scatters heads / gathers
+    sequence so each device runs FULL-sequence attention on H/n heads, then
+    all-to-all back.  Complements ring attention (better for moderate S,
+    head-divisible meshes).  Call inside shard_map with S sharded over
+    axis_name; q/k/v local [B, S_local, H, D]."""
+    n = jax.lax.psum(1, axis_name)
+    B, S, H, D = q.shape
+
+    def a2a(x, split_axis, concat_axis):
+        return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
+
+    # heads→devices, gather sequence: [B, S_loc, H, D] -> [B, S_full, H/n, D]
+    qh = a2a(q, 2, 1)
+    kh = a2a(k, 2, 1)
+    vh = a2a(v, 2, 1)
+    if local_attention is None:
+        from ..ops.attention import _sdpa_core
+
+        qs = jnp.swapaxes(qh, 1, 2)
+        ks = jnp.swapaxes(kh, 1, 2)
+        vs = jnp.swapaxes(vh, 1, 2)
+        o = _sdpa_core(qs, ks, vs, None, 0.0, causal, None)
+        o = jnp.swapaxes(o, 1, 2)
+    else:
+        o = local_attention(qh, kh, vh, causal)
+    # sequence→devices, gather heads back
+    return a2a(o, 1, 2)

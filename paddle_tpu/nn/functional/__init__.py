@@ -27,3 +27,17 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
 
     return _fa(query, key, value, dropout=dropout, causal=causal,
                return_softmax=return_softmax)
+
+
+def ring_attention(query, key, value, axis_name="sp", causal=False, name=None):
+    """Context-parallel attention over a mesh axis (sequence sharded).  New
+    capability vs the reference — see distributed/ring_attention.py."""
+    from ...distributed.ring_attention import sequence_parallel_attention
+    from ...ops._helpers import to_tensor_like, value_of
+    from ...tensor import Tensor
+
+    q = to_tensor_like(query)
+    out = sequence_parallel_attention(q._value, to_tensor_like(key)._value,
+                                      to_tensor_like(value)._value,
+                                      axis_name=axis_name, causal=causal)
+    return Tensor(out)

@@ -36,6 +36,7 @@ class Tensor:
         "_inplace_version",
         "name",
         "persistable",
+        "partition_spec",
         "__weakref__",
     )
 

@@ -3,3 +3,9 @@
 Zero-egress: datasets read local cache files or generate synthetic stand-ins.
 """
 from .datasets import Imdb, UCIHousing  # noqa: F401
+from .models import (  # noqa: F401
+    BertForQuestionAnswering,
+    BertForSequenceClassification,
+    BertModel,
+    GPTModel,
+)

@@ -1,0 +1,183 @@
+"""Transformer language models (flagship models for the TPU build).
+
+Reference analog: BERT-style encoders are built from paddle.nn.Transformer
+(nn/layer/transformer.py:437 TransformerEncoderLayer) — BASELINE config 4
+(BERT-base SQuAD fine-tune) uses exactly this stack.  This module provides the
+assembled model the reference leaves to downstream libraries, because the
+benchmark needs it.
+
+TPU-native: parameters carry partition_spec metadata ('mp' axis on the big
+matmuls — column-parallel QKV/FFN-in, row-parallel proj/FFN-out) so pjit
+shards them over the mesh; attention runs through ops.attention (flash kernel
+on TPU).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from ..tensor import Tensor
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, vocab_size, hidden_size, max_position_embeddings=512,
+                 type_vocab_size=2, dropout=0.1):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(vocab_size, hidden_size)
+        self.position_embeddings = nn.Embedding(max_position_embeddings, hidden_size)
+        self.token_type_embeddings = nn.Embedding(type_vocab_size, hidden_size)
+        self.layer_norm = nn.LayerNorm(hidden_size)
+        self.dropout = nn.Dropout(dropout)
+        # shard the vocab table rows over mp
+        self.word_embeddings.weight.partition_spec = ("mp", None)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        from ..ops.creation import arange, zeros_like
+        from ..ops.manipulation import expand
+
+        seq = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = arange(seq, dtype="int64")
+        if token_type_ids is None:
+            token_type_ids = zeros_like(input_ids, dtype="int64")
+        emb = (self.word_embeddings(input_ids)
+               + self.position_embeddings(position_ids)
+               + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertModel(nn.Layer):
+    """BERT encoder (bert-base defaults)."""
+
+    def __init__(self, vocab_size=30522, hidden_size=768, num_hidden_layers=12,
+                 num_attention_heads=12, intermediate_size=3072,
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=512, type_vocab_size=2):
+        super().__init__()
+        self.embeddings = BertEmbeddings(vocab_size, hidden_size,
+                                         max_position_embeddings,
+                                         type_vocab_size, hidden_dropout_prob)
+        enc_layer = nn.TransformerEncoderLayer(
+            hidden_size, num_attention_heads, intermediate_size,
+            dropout=hidden_dropout_prob, activation="gelu",
+            attn_dropout=attention_probs_dropout_prob)
+        self.encoder = nn.TransformerEncoder(enc_layer, num_hidden_layers)
+        self.pooler = nn.Linear(hidden_size, hidden_size)
+        self._annotate_tp()
+
+    def _annotate_tp(self):
+        """Megatron-style partition specs: QKV + FFN-in column parallel, attn
+        proj + FFN-out row parallel (XLA inserts the psums under pjit)."""
+        for layer in self.encoder.layers:
+            attn = layer.self_attn
+            for proj in (attn.q_proj, attn.k_proj, attn.v_proj):
+                proj.weight.partition_spec = (None, "mp")
+                proj.bias.partition_spec = ("mp",)
+            attn.out_proj.weight.partition_spec = ("mp", None)
+            layer.linear1.weight.partition_spec = (None, "mp")
+            layer.linear1.bias.partition_spec = ("mp",)
+            layer.linear2.weight.partition_spec = ("mp", None)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        if attention_mask is not None and attention_mask.ndim == 2:
+            from ..ops.manipulation import unsqueeze
+
+            # [B, S] -> [B, 1, 1, S] additive mask
+            am = unsqueeze(attention_mask, [1, 2])
+            attention_mask = (1.0 - am.astype("float32")) * -1e4
+        x = self.embeddings(input_ids, token_type_ids)
+        x = self.encoder(x, src_mask=attention_mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, bert: BertModel = None, num_classes=2, dropout=0.1,
+                 **bert_kwargs):
+        super().__init__()
+        self.bert = bert or BertModel(**bert_kwargs)
+        hidden = self.bert.pooler.weight.shape[0]
+        self.dropout = nn.Dropout(dropout)
+        self.classifier = nn.Linear(hidden, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class BertForQuestionAnswering(nn.Layer):
+    """SQuAD head (BASELINE config 4)."""
+
+    def __init__(self, bert: BertModel = None, **bert_kwargs):
+        super().__init__()
+        self.bert = bert or BertModel(**bert_kwargs)
+        hidden = self.bert.pooler.weight.shape[0]
+        self.classifier = nn.Linear(hidden, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        logits = self.classifier(seq)
+        from ..ops.manipulation import split as _split
+
+        start, end = _split(logits, 2, axis=-1)
+        return start.squeeze(-1), end.squeeze(-1)
+
+
+class GPTDecoderLayer(nn.Layer):
+    def __init__(self, hidden, heads, ffn, dropout=0.0):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(hidden)
+        self.attn = nn.MultiHeadAttention(hidden, heads, dropout=dropout)
+        self.ln2 = nn.LayerNorm(hidden)
+        self.fc1 = nn.Linear(hidden, ffn)
+        self.fc2 = nn.Linear(ffn, hidden)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x, mask=None):
+        h = self.ln1(x)
+        x = x + self.attn(h, h, h, attn_mask=mask)
+        h = self.ln2(x)
+        x = x + self.dropout(self.fc2(F.gelu(self.fc1(h))))
+        return x
+
+
+class GPTModel(nn.Layer):
+    """Decoder-only causal LM — the long-context flagship (pairs with ring
+    attention / context parallelism; new capability per SURVEY §5.7)."""
+
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, ffn_size=3072, max_seq_len=1024, dropout=0.0):
+        super().__init__()
+        self.wte = nn.Embedding(vocab_size, hidden_size)
+        self.wpe = nn.Embedding(max_seq_len, hidden_size)
+        self.layers = nn.LayerList([
+            GPTDecoderLayer(hidden_size, num_heads, ffn_size, dropout)
+            for _ in range(num_layers)
+        ])
+        self.ln_f = nn.LayerNorm(hidden_size)
+        self.wte.weight.partition_spec = ("mp", None)
+        for layer in self.layers:
+            attn = layer.attn
+            for proj in (attn.q_proj, attn.k_proj, attn.v_proj):
+                proj.weight.partition_spec = (None, "mp")
+                proj.bias.partition_spec = ("mp",)
+            attn.out_proj.weight.partition_spec = ("mp", None)
+            layer.fc1.weight.partition_spec = (None, "mp")
+            layer.fc1.bias.partition_spec = ("mp",)
+            layer.fc2.weight.partition_spec = ("mp", None)
+
+    def forward(self, input_ids):
+        import jax.numpy as jnp
+
+        from ..ops.creation import arange
+
+        B, S = input_ids.shape
+        pos = arange(S, dtype="int64")
+        x = self.wte(input_ids) + self.wpe(pos)
+        causal = Tensor(jnp.tril(jnp.ones((1, 1, S, S), bool)))
+        for layer in self.layers:
+            x = layer(x, mask=causal)
+        x = self.ln_f(x)
+        # weight-tied LM head
+        return F.linear(x, self.wte.weight.t())

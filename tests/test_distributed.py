@@ -1,0 +1,299 @@
+"""Distributed tests on the 8-virtual-device CPU mesh.
+
+Reference analog: test_collective_base.py (2-rank collective op checks vs
+numpy, SURVEY §4) — here single-process multi-device shard_map, the TPU-native
+equivalent.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import init_mesh
+from paddle_tpu.tensor import Tensor
+
+
+@pytest.fixture
+def mesh8():
+    return init_mesh({"dp": 8})
+
+
+class TestMesh:
+    def test_init_mesh(self):
+        mesh = init_mesh({"dp": 4, "mp": 2})
+        assert mesh.shape == {"dp": 4, "mp": 2}
+        assert dist.get_mesh() is mesh
+
+    def test_shard_array(self, mesh8):
+        x = np.arange(16, dtype=np.float32).reshape(8, 2)
+        arr = dist.shard_array(x, "dp")
+        assert len(arr.sharding.device_set) == 8
+        np.testing.assert_array_equal(np.asarray(arr), x)
+
+
+class TestCollectives:
+    """Each collective asserted against numpy (reference
+    test_collective_base.py:212 check_with_place pattern)."""
+
+    def _run(self, fn, x, mesh, in_spec=P("dp"), out_spec=P("dp")):
+        return shard_map(fn, mesh=mesh, in_specs=(in_spec,),
+                         out_specs=out_spec)(x)
+
+    def test_all_reduce_sum(self, mesh8):
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+        def f(shard):
+            t = Tensor(shard)
+            return dist.all_reduce(t)._value
+
+        out = self._run(f, x, mesh8)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.full((8, 1), x.sum(), np.float32))
+
+    def test_all_reduce_max(self, mesh8):
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+        def f(shard):
+            return dist.all_reduce(Tensor(shard), op=dist.ReduceOp.MAX)._value
+
+        out = self._run(f, x, mesh8)
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 7.0))
+
+    def test_all_gather(self, mesh8):
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+        def f(shard):
+            return dist.all_gather(None, Tensor(shard))._value
+
+        out = shard_map(f, mesh=mesh8, in_specs=(P("dp"),),
+                        out_specs=P("dp"))(x)
+        # each rank returns [8,1,1] gathered stack; global [64,1,1]
+        assert np.asarray(out).shape == (64, 1, 1)
+
+    def test_broadcast(self, mesh8):
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+        def f(shard):
+            return dist.broadcast(Tensor(shard), src=3)._value
+
+        out = self._run(f, x, mesh8)
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 3.0))
+
+    def test_reduce_scatter(self, mesh8):
+        # every rank holds [8,1]; psum_scatter → rank r gets sum of row r
+        x = np.tile(np.arange(8, dtype=np.float32)[:, None], (8, 1)).reshape(64, 1)
+
+        def f(shard):
+            return dist.reduce_scatter(None, Tensor(shard))._value
+
+        out = shard_map(f, mesh=mesh8, in_specs=(P("dp"),),
+                        out_specs=P("dp"))(x)
+        np.testing.assert_allclose(np.asarray(out).reshape(-1),
+                                   np.arange(8) * 8)
+
+    def test_p2p_shift_ring(self, mesh8):
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+        def f(shard):
+            return dist.p2p_shift(Tensor(shard), shift=1)._value
+
+        out = self._run(f, x, mesh8)
+        np.testing.assert_allclose(np.asarray(out).reshape(-1),
+                                   np.roll(np.arange(8), 1))
+
+    def test_alltoall(self, mesh8):
+        x = np.arange(64, dtype=np.float32).reshape(64, 1)
+
+        def f(shard):
+            return dist.alltoall(Tensor(shard))._value
+
+        out = shard_map(f, mesh=mesh8, in_specs=(P("dp"),),
+                        out_specs=P("dp"))(x)
+        ref = np.asarray(x).reshape(8, 8).T.reshape(64, 1)
+        np.testing.assert_allclose(np.asarray(out), ref)
+
+    def test_collectives_grad(self, mesh8):
+        """allreduce must be differentiable (grads flow in SPMD steps)."""
+        x = np.ones((8, 1), np.float32)
+
+        def loss(xv):
+            def f(shard):
+                return dist.all_reduce(Tensor(shard))._value
+
+            out = shard_map(f, mesh=mesh8, in_specs=(P("dp"),),
+                            out_specs=P("dp"))(xv)
+            return jnp.sum(out)
+
+        g = jax.grad(loss)(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(g), np.full((8, 1), 8.0))
+
+
+class TestDataParallelStep:
+    def test_sharded_train_step_runs_and_replicates(self, mesh8):
+        paddle.seed(0)
+        from paddle_tpu.distributed.parallel import make_sharded_train_step
+
+        net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+        opt = optimizer.Momentum(0.1, parameters=net.parameters())
+        loss_fn = nn.CrossEntropyLoss()
+        step, state = make_sharded_train_step(net, lambda o, y: loss_fn(o, y), opt)
+        x = np.random.randn(16, 4).astype(np.float32)
+        y = np.random.randint(0, 2, (16,)).astype(np.int32)
+        losses = []
+        for _ in range(10):
+            state, loss = step(state, x, y)
+            losses.append(float(np.asarray(loss)))
+        assert losses[-1] < losses[0]
+
+    def test_dp_matches_single_device(self):
+        """DP over 8 shards must equal the same batch on one device (allreduce
+        grad semantics — reference TestDistBase loss comparison)."""
+        from paddle_tpu.distributed.parallel import make_sharded_train_step
+
+        x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+        y = np.random.RandomState(1).randint(0, 2, (16,)).astype(np.int32)
+
+        def run(mesh_axes):
+            paddle.seed(7)
+            init_mesh(mesh_axes)
+            net = nn.Linear(4, 2)
+            opt = optimizer.SGD(0.1, parameters=net.parameters())
+            loss_fn = nn.CrossEntropyLoss()
+            step, state = make_sharded_train_step(net, lambda o, yy: loss_fn(o, yy), opt)
+            for _ in range(5):
+                state, loss = step(state, x, y)
+            return np.asarray(state["params"]["weight"])
+
+        w8 = run({"dp": 8})
+        w1 = run({"dp": 1})
+        np.testing.assert_allclose(w8, w1, rtol=1e-5, atol=1e-6)
+
+
+class TestTensorParallel:
+    def test_bert_tp_step(self):
+        """dp×mp sharded BERT train step (the dryrun_multichip path)."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "graft_entry", "/root/repo/__graft_entry__.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.dryrun_multichip(8)
+
+    def test_column_row_parallel_linear_shapes(self):
+        init_mesh({"dp": 4, "mp": 2})
+        col = dist.ColumnParallelLinear(8, 16, gather_output=True)
+        assert col.weight.shape == [8, 8]  # 16/2 per shard
+        row = dist.RowParallelLinear(8, 16)
+        assert row.weight.shape == [4, 16]
+        emb = dist.VocabParallelEmbedding(100, 8)
+        assert emb.weight.shape == [50, 8]
+
+    def test_tp_linear_forward_matches_dense(self):
+        """Column->Row megatron pair under shard_map == dense computation."""
+        mesh = init_mesh({"mp": 8})
+        np.random.seed(0)
+        col = dist.ColumnParallelLinear(8, 16, gather_output=False, has_bias=False)
+        row = dist.RowParallelLinear(16, 4, input_is_parallel=True, has_bias=False)
+
+        # dense references: gather the full weights
+        w1 = np.random.randn(8, 16).astype(np.float32)
+        w2 = np.random.randn(16, 4).astype(np.float32)
+        x = np.random.randn(2, 8).astype(np.float32)
+
+        def f(w1_shard, w2_shard, xv):
+            col.weight._value = w1_shard
+            row.weight._value = w2_shard
+            h = col(Tensor(xv))
+            return row(h)._value
+
+        out = shard_map(
+            f, mesh=mesh,
+            in_specs=(P(None, "mp"), P("mp", None), P()),
+            out_specs=P(),
+        )(jnp.asarray(w1), jnp.asarray(w2), jnp.asarray(x))
+        ref = x @ w1 @ w2
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+
+class TestSharding:
+    def test_opt_state_sharded(self):
+        mesh = init_mesh({"dp": 8})
+        from paddle_tpu.distributed.fleet.sharding import shard_opt_state
+
+        state = {"moment1": {"w": jnp.zeros((16, 4)), "b": jnp.zeros((3,))}}
+        sharded = shard_opt_state(state)
+        w_shard = sharded["moment1"]["w"]
+        assert len(w_shard.sharding.device_set) == 8
+        spec = w_shard.sharding.spec
+        assert spec[0] == "dp"  # dim0 16 divisible by 8 → sharded
+        b_spec = sharded["moment1"]["b"].sharding.spec
+        assert len(b_spec) == 0 or b_spec[0] is None  # 3 not divisible → replicated
+
+
+class TestFleet:
+    def test_fleet_init_and_strategy(self):
+        from paddle_tpu.distributed import fleet
+
+        strategy = fleet.DistributedStrategy()
+        strategy.amp = True
+        strategy.recompute = True
+        fleet.init(is_collective=True, strategy=strategy)
+        assert fleet.worker_num() == 1
+        assert fleet.is_first_worker()
+
+    def test_meta_optimizer_stack(self):
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            GradientMergeOptimizer,
+        )
+
+        p = paddle.Parameter(np.array([1.0], np.float32))
+        strategy = fleet.DistributedStrategy()
+        strategy.gradient_merge = True
+        strategy.gradient_merge_configs.k_steps = 2
+        fleet.init(is_collective=True, strategy=strategy)
+        opt = fleet.distributed_optimizer(
+            optimizer.SGD(0.5, parameters=[p]), strategy=strategy)
+        assert isinstance(opt, GradientMergeOptimizer)
+        # two accumulation steps then apply averaged grad
+        (p * 2).backward()
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [1.0])  # not yet applied
+        (p * 2).backward()
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [0.0])  # avg grad 2 * lr 0.5
+
+    def test_recompute(self):
+        from paddle_tpu.distributed.fleet.recompute import recompute
+
+        x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32),
+                             stop_gradient=False)
+        layer = nn.Linear(8, 8)
+        out = recompute(layer, x)
+        out.sum().backward()
+        assert x.grad is not None
+        assert layer.weight.grad is not None
+
+
+class TestDistributedBatchSampler:
+    def test_shards_and_pads(self):
+        from paddle_tpu.io import DistributedBatchSampler
+        from paddle_tpu.io.dataset import TensorDataset
+
+        ds = TensorDataset([paddle.ones([10, 2])])
+        samplers = [DistributedBatchSampler(ds, batch_size=2, num_replicas=4,
+                                            rank=r) for r in range(4)]
+        all_idx = []
+        for s in samplers:
+            for batch in s:
+                all_idx.extend(batch)
+        # padded to 12 total, every rank equal count
+        assert len(all_idx) == 12
+        assert set(all_idx) == set(range(10))
