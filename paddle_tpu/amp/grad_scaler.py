@@ -28,6 +28,14 @@ class AmpScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # per-optimizer unscale guard + inf record for the current step cycle
+        # (reference OptimizerState: unscale_ before clipping must not be
+        # repeated by step(), and each optimizer's inf status is its own);
+        # cleared in update().  _stepped guards against step() twice without
+        # update() — the stale unscale record would otherwise let scaled
+        # grads through silently.
+        self._unscaled: dict = {}
+        self._stepped: set = set()
 
     def scale(self, var):
         if not self._enable:
@@ -37,7 +45,11 @@ class AmpScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
-        self._found_inf = False
+        if id(optimizer) in self._unscaled:
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer since "
+                "the last update()")
+        found = False
         inv = 1.0 / self._scale
         with no_grad():
             for p in optimizer._param_list():
@@ -45,11 +57,22 @@ class AmpScaler:
                     continue
                 g = p._grad._value.astype(jnp.float32) * inv
                 if not bool(jnp.all(jnp.isfinite(g))):
-                    self._found_inf = True
+                    found = True
                 p._grad = Tensor(g.astype(p._grad._value.dtype))
+        # per-optimizer record: an inf in one optimizer's grads must not be
+        # erased by a later, finite unscale_ of a different optimizer
+        self._unscaled[id(optimizer)] = found
+        self._found_inf = found
 
     def minimize(self, optimizer, scaled_loss):
-        scaled_loss.backward()
+        """Canonical pattern is ``scaler.scale(loss).backward();
+        scaler.minimize(opt, scaled)`` — only run backward here if the graph
+        has not been consumed yet (same guard as Optimizer.minimize)."""
+        node = getattr(scaled_loss, "_grad_node", None)
+        graph_alive = (node is not None
+                       and getattr(node, "vjp_fn", None) is not None)
+        if graph_alive:
+            scaled_loss.backward()
         self.step(optimizer)
         self.update()
 
@@ -57,11 +80,22 @@ class AmpScaler:
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
-        if not self._found_inf:
+        if id(optimizer) in self._stepped:
+            raise RuntimeError(
+                "step() has already been called on this optimizer since the "
+                "last update()")
+        if id(optimizer) not in self._unscaled:
+            self.unscale_(optimizer)
+        self._stepped.add(id(optimizer))
+        if not self._unscaled[id(optimizer)]:
             optimizer.step()
 
     def update(self):
+        # an inf in ANY optimizer unscaled this cycle marks the step bad
+        if self._unscaled:
+            self._found_inf = any(self._unscaled.values())
+        self._unscaled.clear()
+        self._stepped.clear()
         if not self._enable or not self._dynamic:
             return
         if self._found_inf:

@@ -37,7 +37,18 @@ class ReduceOp:
 
 
 class Group:
-    """A communication group = a mesh axis (ring_id analog)."""
+    """A communication group = a mesh axis (ring_id analog), optionally
+    restricted to a subset of its ranks.
+
+    Subgroup semantics (reference collective.py:163 — new_group = its own
+    ring): collectives over a subgroup are expressed as ONE full-axis
+    collective with a member mask — members contribute their value, outsiders
+    contribute the identity element and select their own value back
+    afterwards.  (jax's ``axis_index_groups`` demands equal-size partitions,
+    which a lone subgroup + its complement generally isn't; the masked form is
+    also the cheaper ICI pattern: a single fused collective instead of a
+    partitioned one.)
+    """
 
     _next_id = 0
 
@@ -47,7 +58,7 @@ class Group:
             id = Group._next_id
         self.id = id
         self.axis_name = axis_name
-        self._ranks = ranks
+        self._ranks = sorted(ranks) if ranks is not None else None
 
     @property
     def nranks(self):
@@ -65,6 +76,19 @@ class Group:
     @property
     def world_size(self):
         return self.nranks
+
+    def member_mask(self):
+        """Inside a trace: scalar bool — is this axis position a member?"""
+        if self._ranks is None:
+            return None
+        idx = jax.lax.axis_index(self.axis_name)
+        return jnp.any(idx == jnp.asarray(self._ranks))
+
+    def group_local_index(self):
+        """Inside a trace: this member's position within the sorted ranks
+        (meaningless for outsiders)."""
+        idx = jax.lax.axis_index(self.axis_name)
+        return jnp.searchsorted(jnp.asarray(self.ranks), idx)
 
     def __repr__(self):
         return f"Group(id={self.id}, axis={self.axis_name!r}, nranks={self.nranks})"
@@ -105,14 +129,52 @@ def _axis_in_trace(axis_name) -> bool:
         return False
 
 
-def _reduce_fn(op):
-    return {
-        ReduceOp.SUM: jax.lax.psum,
-        ReduceOp.MAX: jax.lax.pmax,
-        ReduceOp.MIN: jax.lax.pmin,
-        ReduceOp.AVG: lambda v, a: jax.lax.pmean(v, a),
-        ReduceOp.PROD: lambda v, a: jnp.exp(jax.lax.psum(jnp.log(v), a)),
-    }[op]
+def _reduce_identity(op, dtype):
+    """Identity element an outsider contributes to a masked reduction."""
+    if op in (ReduceOp.SUM, ReduceOp.AVG):
+        return 0
+    if dtype == jnp.bool_:
+        return False if op == ReduceOp.MAX else True
+    if jnp.issubdtype(dtype, jnp.floating):
+        return -jnp.inf if op == ReduceOp.MAX else jnp.inf
+    info = jnp.iinfo(dtype)
+    return info.min if op == ReduceOp.MAX else info.max
+
+
+def _reduce_fn(op, group: Group):
+    """Collective reduction over a group's axis. Full-axis groups use lax
+    collectives directly; subgroups use the masked-identity form (class
+    docstring). PROD gathers then multiplies — exact for zeros/negatives
+    (exp∘psum∘log is not, ADVICE r1)."""
+    axis = group.axis_name
+    sub = group._ranks is not None
+    lax_red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.AVG: jax.lax.psum,
+               ReduceOp.MAX: jax.lax.pmax, ReduceOp.MIN: jax.lax.pmin}
+
+    def fn(v):
+        mask = group.member_mask() if sub else None
+        if op == ReduceOp.PROD:
+            gathered = jax.lax.all_gather(v, axis, axis=0)
+            if sub:
+                red = jnp.prod(gathered[jnp.asarray(group.ranks)], axis=0)
+                return jnp.where(mask, red, v)
+            return jnp.prod(gathered, axis=0)
+        if op not in lax_red:
+            raise ValueError(f"unknown ReduceOp {op}")
+        if sub:
+            ident = jnp.full_like(v, _reduce_identity(op, v.dtype))
+            contrib = jnp.where(mask, v, ident)
+        else:
+            contrib = v
+        red = lax_red[op](contrib, axis)
+        if op == ReduceOp.AVG:
+            # divisor = participants on THIS axis (len(ranks) for a subgroup,
+            # axis size for the full axis — NOT nranks, which scales by
+            # process count)
+            red = red / (len(group.ranks) if sub else mesh_axis_size(axis))
+        return jnp.where(mask, red, v) if sub else red
+
+    return fn
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -120,7 +182,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     group = group or _default_group
     t = to_tensor_like(tensor)
     if _is_traced(t._value):
-        out = apply("c_allreduce", lambda v: _reduce_fn(op)(v, group.axis_name), t)
+        out = apply("c_allreduce", _reduce_fn(op, group), t)
         if isinstance(tensor, Tensor):
             tensor._replace_from(out)
             return tensor
@@ -134,7 +196,7 @@ def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
     t = to_tensor_like(tensor)
     if _is_traced(t._value):
         def f(v):
-            red = _reduce_fn(op)(v, group.axis_name)
+            red = _reduce_fn(op, group)(v)
             idx = jax.lax.axis_index(group.axis_name)
             return jnp.where(idx == dst, red, v)
 
@@ -151,13 +213,16 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     group = group or _default_group
     t = to_tensor_like(tensor)
     if _is_traced(t._value):
-        out = apply(
-            "c_allgather",
-            lambda v: jax.lax.all_gather(v, group.axis_name, axis=0, tiled=False),
-            t,
-        )
+        def f(v):
+            g = jax.lax.all_gather(v, group.axis_name, axis=0, tiled=False)
+            if group._ranks is not None:
+                # subgroup: keep member rows only (static take — every rank
+                # computes the same gather; outsiders see the group's view)
+                g = g[jnp.asarray(group.ranks)]
+            return g
+
+        out = apply("c_allgather", f, t)
         if tensor_list is not None and isinstance(tensor_list, list):
-            n = group.nranks if group._ranks is not None else mesh_axis_size(group.axis_name)
             for i in range(out.shape[0]):
                 tensor_list.append(out[i])
             return None
@@ -177,8 +242,11 @@ def broadcast(tensor, src, group=None, sync_op=True):
     t = to_tensor_like(tensor)
     if _is_traced(t._value):
         def f(v):
-            # select src's shard on every member: gather then index
+            # select src's shard on every member: gather then index; with a
+            # subgroup, outsiders keep their own value
             gathered = jax.lax.all_gather(v, group.axis_name, axis=0)
+            if group._ranks is not None:
+                return jnp.where(group.member_mask(), gathered[src], v)
             return gathered[src]
 
         out = apply("c_broadcast", f, t)
@@ -200,8 +268,23 @@ def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None,
     t = to_tensor_like(inp)
     if _is_traced(t._value):
         def f(v):
-            return jax.lax.psum_scatter(v, group.axis_name, scatter_dimension=0,
-                                        tiled=True)
+            if group._ranks is not None:
+                # subgroup: one masked psum, then each member dynamic-slices
+                # its chunk; outsiders get zeros (they hold no shard)
+                m = len(group.ranks)
+                if v.shape[0] % m:
+                    raise ValueError(
+                        f"reduce_scatter: leading dim {v.shape[0]} not "
+                        f"divisible by subgroup size {m}")
+                k = v.shape[0] // m
+                mask = group.member_mask()
+                red = jax.lax.psum(
+                    jnp.where(mask, v, jnp.zeros_like(v)), group.axis_name)
+                pos = group.group_local_index()
+                chunk = jax.lax.dynamic_slice_in_dim(red, pos * k, k, axis=0)
+                return jnp.where(mask, chunk, jnp.zeros_like(chunk))
+            return jax.lax.psum_scatter(
+                v, group.axis_name, scatter_dimension=0, tiled=True)
 
         out = apply("c_reducescatter", f, t)
         if isinstance(tensor, Tensor):
@@ -249,12 +332,26 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     else:
         x = to_tensor_like(in_tensor_list)
     if _is_traced(x._value):
-        out = apply(
-            "alltoall",
-            lambda v: jax.lax.all_to_all(v, group.axis_name, split_axis=0,
-                                         concat_axis=0, tiled=False),
-            x,
-        )
+        def _a2a(v):
+            if group._ranks is not None:
+                # subgroup: full gather, then member p takes the p-th slice of
+                # every member's contribution
+                m = len(group.ranks)
+                if v.shape[0] % m:
+                    raise ValueError(
+                        f"alltoall: leading dim {v.shape[0]} not divisible "
+                        f"by subgroup size {m}")
+                k = v.shape[0] // m
+                g = jax.lax.all_gather(v, group.axis_name, axis=0)
+                rows = g[jnp.asarray(group.ranks)]          # (m, m*k, ...)
+                pos = group.group_local_index()
+                sel = jax.lax.dynamic_slice_in_dim(rows, pos * k, k, axis=1)
+                out = sel.reshape((m * k,) + v.shape[1:])
+                return jnp.where(group.member_mask(), out, jnp.zeros_like(out))
+            return jax.lax.all_to_all(v, group.axis_name, split_axis=0,
+                                      concat_axis=0, tiled=False)
+
+        out = apply("alltoall", _a2a, x)
         if out_tensor_list is not None:
             for i in range(out.shape[0]):
                 out_tensor_list.append(out[i])
@@ -267,23 +364,47 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     return x
 
 
-def send(tensor, dst=0, group=None, sync_op=True):
-    """p2p send (reference send_v2). Traced: ppermute pair; eager: no-op."""
+def _p2p(t, src, dst, group):
+    """The one true p2p: a single-edge ppermute (src→dst).  Under SPMD every
+    member executes the same collective; dst receives src's value, everyone
+    else receives zeros (reference send_v2/recv_v2 semantics,
+    operators/collective/send_v2_op.cc — here one ICI hop, no streams)."""
+    return apply(
+        "p2p",
+        lambda v: jax.lax.ppermute(v, group.axis_name, [(src, dst)]),
+        t,
+    )
+
+
+def send(tensor, dst=0, group=None, sync_op=True, src=None):
+    """p2p send (reference send_v2).
+
+    Traced (SPMD): emits the single-edge ppermute (src→dst).  ``src`` defaults
+    to this process's rank — correct in multi-process mode; in
+    single-controller traced code pass ``src`` explicitly (or use
+    :func:`p2p_shift` for ring patterns).  The matching :func:`recv` emits the
+    identical collective, so XLA CSEs the pair into one transfer.
+    """
     group = group or _default_group
     t = to_tensor_like(tensor)
     if _is_traced(t._value):
-        n = mesh_axis_size(group.axis_name)
-        src = get_rank()
-        out = apply(
-            "send_v2",
-            lambda v: jax.lax.ppermute(v, group.axis_name, [(i, dst) for i in range(n)]),
-            t,
-        )
-        return out
+        s = get_rank() if src is None else src
+        return _p2p(t, s, dst, group)
     return None
 
 
-def recv(tensor, src=0, group=None, sync_op=True):
+def recv(tensor, src=0, group=None, sync_op=True, dst=None):
+    """p2p recv (reference recv_v2): the other half of the matched
+    single-edge ppermute. ``dst`` defaults to this process's rank."""
+    group = group or _default_group
+    t = to_tensor_like(tensor)
+    if _is_traced(t._value):
+        d = get_rank() if dst is None else dst
+        out = _p2p(t, src, d, group)
+        if isinstance(tensor, Tensor):
+            tensor._replace_from(out)
+            return tensor
+        return out
     return tensor
 
 
@@ -301,7 +422,14 @@ def p2p_shift(tensor, group=None, shift=1):
 
 
 def barrier(group=None):
-    """reference barrier_op: eager = device sync."""
+    """reference barrier_op: cross-process rendezvous when running
+    multi-process (jax.distributed), local device sync otherwise."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        barrier._seq = getattr(barrier, "_seq", 0) + 1
+        multihost_utils.sync_global_devices(f"paddle_tpu_barrier_{barrier._seq}")
+        return
     jax.effects_barrier()
     try:
         jax.block_until_ready(jnp.zeros(()))

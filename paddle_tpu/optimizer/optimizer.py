@@ -151,7 +151,18 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
-        loss.backward()
+        """Reference dygraph semantics (fluid/optimizer.py:779): the canonical
+        pattern is ``loss.backward(); opt.minimize(loss)`` — minimize collects
+        the already-computed grads (the consumed graph is the signal backward
+        already ran).  A bare ``minimize(loss)`` still runs backward itself
+        whenever the loss's grad graph is alive.  Caveat: after
+        ``backward(retain_graph=True)`` the graph is still alive and minimize
+        will run backward again, accumulating — call step() directly in that
+        pattern."""
+        node = getattr(loss, "_grad_node", None)
+        graph_alive = node is not None and getattr(node, "vjp_fn", None) is not None
+        if graph_alive:
+            loss.backward()
         self.step()
         params = self._param_list()
         return None, [(p, p._grad) for p in params]

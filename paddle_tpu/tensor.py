@@ -381,8 +381,37 @@ class Parameter(Tensor):
         self.need_clip = True
 
 
-jax.tree_util.register_pytree_node(
-    Parameter,
-    _tensor_flatten,
-    lambda aux, children: _tensor_unflatten(aux, children),
-)
+def _param_flatten(p: Parameter):
+    # aux must be hashable (PyTreeDef is a jit cache key) → dict as sorted
+    # tuple; the unique auto-name is deliberately NOT carried (it would make
+    # structurally identical Parameters tree-unequal and defeat jit caching)
+    opt_attr = tuple(sorted(p.optimize_attr.items()))
+    return (p._value,), (p.stop_gradient, p.trainable, opt_attr,
+                         p.regularizer, p.need_clip,
+                         getattr(p, "partition_spec", None))
+
+
+def _param_unflatten(aux, children):
+    """Rebuild a real Parameter (not a plain Tensor) so trainable/optimize
+    metadata survives jax.tree_util / jit boundaries (ADVICE r1)."""
+    p = Parameter.__new__(Parameter)
+    p._value = children[0]
+    p.stop_gradient = aux[0]
+    p._grad_node = None
+    p._out_index = 0
+    p._grad = None
+    p._backward_hooks = []
+    p._retain_grad = False
+    p._inplace_version = 0
+    p.persistable = True
+    p.trainable = aux[1]
+    p.optimize_attr = dict(aux[2])
+    p.regularizer = aux[3]
+    p.need_clip = aux[4]
+    if aux[5] is not None:
+        p.partition_spec = aux[5]
+    p.name = "tree_parameter"
+    return p
+
+
+jax.tree_util.register_pytree_node(Parameter, _param_flatten, _param_unflatten)
