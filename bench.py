@@ -164,19 +164,44 @@ def bench_bert(batch, steps, seq_len=128):
     }
 
 
+def _bench_resnet_guarded(steps):
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    try:
+        return bench_resnet50(batch, steps)
+    except Exception as e:  # OOM etc: retry smaller
+        sys.stderr.write(f"batch {batch} failed ({type(e).__name__}); retry 32\n")
+        return bench_resnet50(32, steps)
+
+
 def main():
-    which = os.environ.get("BENCH_MODEL", "resnet50")
+    which = os.environ.get("BENCH_MODEL", "all")
     steps = int(os.environ.get("BENCH_STEPS", "30"))
     if which == "bert":
         batch = int(os.environ.get("BENCH_BATCH", "32"))
         result = bench_bert(batch, steps)
+    elif which == "resnet50":
+        result = _bench_resnet_guarded(steps)
     else:
-        batch = int(os.environ.get("BENCH_BATCH", "128"))
+        # default: BOTH flagship benches in one driver run (VERDICT r1 #2);
+        # headline value = geometric mean of the vs-V100 ratios
+        resnet = _bench_resnet_guarded(steps)
         try:
-            result = bench_resnet50(batch, steps)
-        except Exception as e:  # OOM etc: retry smaller
-            sys.stderr.write(f"batch {batch} failed ({type(e).__name__}); retry 32\n")
-            result = bench_resnet50(32, steps)
+            bert = bench_bert(int(os.environ.get("BENCH_BERT_BATCH", "32")),
+                              steps)
+        except Exception as e:
+            sys.stderr.write(f"bert bench failed ({type(e).__name__}: {e})\n")
+            bert = None
+        if bert is None:
+            result = resnet
+        else:
+            geomean = (resnet["vs_baseline"] * bert["vs_baseline"]) ** 0.5
+            result = {
+                "metric": "train_throughput_geomean_vs_v100_fp32",
+                "value": round(geomean, 3),
+                "unit": "x V100 fp32",
+                "vs_baseline": round(geomean, 3),
+                "detail": {"resnet50": resnet, "bert_base": bert},
+            }
     print(json.dumps(result))
 
 

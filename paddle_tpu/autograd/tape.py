@@ -26,17 +26,28 @@ class GradNode:
     differentiable inputs."""
 
     __slots__ = ("name", "vjp_fn", "edges", "out_avals", "out_treedef", "id",
-                 "fwd_fn")
+                 "fwd_fn", "op_fn", "op_kwargs", "op_args", "tracked_idx",
+                 "cast_to")
 
     _counter = 0
 
-    def __init__(self, name, vjp_fn, edges, out_avals, out_treedef, fwd_fn=None):
+    def __init__(self, name, vjp_fn, edges, out_avals, out_treedef, fwd_fn=None,
+                 op_fn=None, op_kwargs=None, op_args=None, tracked_idx=None,
+                 cast_to=None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.edges: List[Optional["Edge"]] = edges
         self.out_avals = out_avals  # list of (shape, dtype) per flat output
         self.out_treedef = out_treedef
         self.fwd_fn = fwd_fn  # closed forward (for create_graph double-grad)
+        # raw op identity for create_graph: the vjp must be re-derivable as a
+        # function of ALL inputs (incl. non-tracked ones like feeds), not a
+        # closure over their build-time values
+        self.op_fn = op_fn
+        self.op_kwargs = op_kwargs
+        self.op_args = op_args
+        self.tracked_idx = tracked_idx
+        self.cast_to = cast_to
         GradNode._counter += 1
         self.id = GradNode._counter
 
@@ -44,6 +55,8 @@ class GradNode:
         self.vjp_fn = None
         self.fwd_fn = None
         self.edges = []
+        self.op_fn = None
+        self.op_args = None
 
 
 class Edge:

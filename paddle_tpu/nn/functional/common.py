@@ -44,18 +44,30 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
         return apply("dropout", lambda v: jnp.zeros_like(v), x)
     key = next_rng_key()
 
-    def f(v):
+    def f(v, k):
         shape = list(v.shape)
         if axis is not None:
             axes = (axis,) if isinstance(axis, int) else tuple(axis)
             shape = [s if i in axes else 1 for i, s in enumerate(shape)]
-        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        keep = jax.random.bernoulli(k, 1.0 - p, tuple(shape))
         keep = jnp.broadcast_to(keep, v.shape)
         if mode == "upscale_in_train":
             return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
         return jnp.where(keep, v, 0.0).astype(v.dtype)
 
-    return apply("dropout", f, x)
+    # the key rides as an op INPUT (not a closure constant) so static-graph
+    # replay can refresh it per run — otherwise every Executor.run would
+    # re-apply the identical dropout mask
+    from ...static.program import _active_recorder
+    from ...tensor import Tensor as _Tensor
+
+    key_t = _Tensor(key, stop_gradient=True)
+    prog = _active_recorder()
+    if prog is not None:
+        from ...framework.random import default_generator
+
+        prog.note_state(key_t, refresh=default_generator.split_key)
+    return apply("dropout", f, x, key_t)
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):

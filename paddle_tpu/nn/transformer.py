@@ -124,6 +124,8 @@ class MultiHeadAttention(Layer):
             logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt).astype(jnp.float32) * scale
             if mm:
                 m = mm[0]
+                if m.ndim == 2:  # [B, S] validity mask
+                    m = (m > 0.5)[:, None, None, :]
                 if m.dtype == jnp.bool_:
                     logits = jnp.where(m, logits, -1e30)
                 else:

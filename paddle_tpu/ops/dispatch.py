@@ -39,6 +39,16 @@ def _amp_should_cast(name):
     return should_cast(name)
 
 
+def _recording_program():
+    """Static-graph recording hook: the active Program being built, if any
+    (static/program.py — TraceOp's OpDesc-append analog, tracer.cc:205)."""
+    try:
+        from ..static.program import _active_recorder
+    except ImportError:
+        return None
+    return _active_recorder()
+
+
 def wrap(value, stop_gradient=True, node=None, index=0):
     Tensor = _tensor_cls()
     t = Tensor(value, stop_gradient=stop_gradient)
@@ -89,13 +99,17 @@ def apply(name, fn, *args, n_outputs=None, **kwargs):
             arrays.append(a)
 
     record = is_grad_enabled() and bool(tracked_idx)
+    recorder = _recording_program()
 
     if not record:
         out = fn(*arrays, **kwargs)
         if flag_value("check_nan_inf"):
             flat, _ = jax.tree_util.tree_flatten(out)
             _check_nan_inf(name, flat)
-        return _wrap_outputs(out, stop_gradient=True)
+        wrapped = _wrap_outputs(out, stop_gradient=True)
+        if recorder is not None:
+            recorder.add_record(name, fn, args, kwargs, wrapped, cast_to)
+        return wrapped
 
     def closed(*diff_vals):
         call = list(arrays)
@@ -115,11 +129,15 @@ def apply(name, fn, *args, n_outputs=None, **kwargs):
     flat_out, treedef = jax.tree_util.tree_flatten(out)
     out_avals = [(o.shape, o.dtype) for o in flat_out]
     edges = [Edge(args[i]) for i in tracked_idx]
-    node = GradNode(name, vjp_fn, edges, out_avals, treedef, fwd_fn=closed)
+    node = GradNode(name, vjp_fn, edges, out_avals, treedef, fwd_fn=closed,
+                    op_fn=fn, op_kwargs=dict(kwargs), op_args=list(args),
+                    tracked_idx=list(tracked_idx), cast_to=cast_to)
     wrapped = [wrap(o, node=node, index=i) for i, o in enumerate(flat_out)]
-    if _is_single(out):
-        return wrapped[0]
-    return jax.tree_util.tree_unflatten(treedef, wrapped)
+    result = (wrapped[0] if _is_single(out)
+              else jax.tree_util.tree_unflatten(treedef, wrapped))
+    if recorder is not None:
+        recorder.add_record(name, fn, args, kwargs, result, cast_to)
+    return result
 
 
 def _is_single(out):
@@ -148,21 +166,43 @@ def apply_vjp(node: GradNode, flat_cts: List, create_graph: bool):
     vjp_fn = node.vjp_fn
     n_in = len(node.edges)
 
-    if create_graph and node.fwd_fn is not None:
-        # re-derive the vjp as a function of (primals, cotangents) so the
-        # recorded backward depends on the primals — grad-of-grad flows
-        # (partial_grad_engine.cc create_graph analog).
-        fwd = node.fwd_fn
+    if create_graph and node.op_fn is not None:
+        # re-derive the vjp as a function of ALL tensor inputs (tracked AND
+        # non-tracked — a feed placeholder is stop_gradient yet its VALUE is
+        # a primal of the vjp) plus the cotangents, so the recorded backward
+        # depends on live values, not build-time constants.  Double grad
+        # (partial_grad_engine.cc analog) and static-graph replay both need
+        # this.
+        op_fn, op_kwargs = node.op_fn, node.op_kwargs
+        op_args, tracked = node.op_args, node.tracked_idx
+        cast_to = node.cast_to
+        tensor_pos = [i for i, a in enumerate(op_args)
+                      if isinstance(a, Tensor)]
 
-        def h(*args):
-            primals = args[:n_in]
-            cts = args[n_in:]
-            _, inner_vjp = jax.vjp(fwd, *primals)
+        def h(*vals):
+            n_t = len(tensor_pos)
+            tensor_vals = vals[:n_t]
+            cts = vals[n_t:]
+            call = list(op_args)
+            for pos, v in zip(tensor_pos, tensor_vals):
+                if cast_to is not None and hasattr(v, "dtype") and \
+                        jnp.issubdtype(v.dtype, jnp.floating) and \
+                        v.dtype != cast_to:
+                    v = v.astype(cast_to)
+                call[pos] = v
+
+            def fwd_tr(*tr_vals):
+                c = list(call)
+                for i, v in zip(tracked, tr_vals):
+                    c[i] = v
+                return op_fn(*c, **op_kwargs)
+
+            _, inner_vjp = jax.vjp(fwd_tr, *[call[i] for i in tracked])
             ct_struct = jax.tree_util.tree_unflatten(treedef, list(cts))
             return tuple(inner_vjp(ct_struct))
 
-        primal_tensors = [e.tensor for e in node.edges]
-        out = apply(f"grad[{node.name}]", h, *primal_tensors, *flat_cts)
+        input_tensors = [op_args[i] for i in tensor_pos]
+        out = apply(f"grad[{node.name}]", h, *input_tensors, *flat_cts)
         if not isinstance(out, (tuple, list)):
             out = (out,)
         return list(out)

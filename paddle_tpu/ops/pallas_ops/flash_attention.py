@@ -1,4 +1,4 @@
-"""Pallas flash attention (TPU).
+"""Pallas flash attention (TPU) — mask + dropout capable, Pallas backward.
 
 New capability vs the reference (SURVEY §5.7: the reference's
 MultiHeadAttention materializes full QK^T — nn/layer/transformer.py:115).
@@ -6,9 +6,20 @@ Tiled online-softmax attention: per (batch·head, q-block) grid cell the kernel
 streams KV blocks through VMEM, keeping running max/denominator — O(S) memory
 instead of O(S²), MXU-shaped 128-wide tiles.
 
-Backward: custom_vjp whose backward recomputes attention blockwise with the
-same online-softmax math expressed in jax (XLA fuses it); residuals are only
-(q, k, v, o, logsumexp) — no S×S tensor is ever materialized in either pass.
+Round-2 upgrades (VERDICT r1 #2):
+- **Padding mask**: a per-token kv validity mask [B, S] (the BERT padding
+  form) rides along as an O(S) input; masked keys get -inf logits in-kernel.
+  Arbitrary [B, H, S, S] masks stay on the XLA path (they are O(S²) by
+  construction and defeat flash).
+- **Dropout**: attention-prob dropout inside the kernel using a counter-based
+  hash of (seed, batch·head, global row, global col) computed with plain
+  uint32 vector ops — platform-independent (works under interpret mode on
+  CPU, unlike pltpu.prng_*) and exactly reproducible in the backward kernels.
+- **Pallas backward**: dk/dv and dq kernels (two passes, standard flash-2
+  split) recompute probabilities blockwise from the saved logsumexp and
+  regenerate identical dropout bits — no S×S residual in either direction.
+- **Shape freedom**: sequence length is padded to the block size and head_dim
+  padded to an MXU-friendly width inside the wrapper; outputs are sliced back.
 """
 from __future__ import annotations
 
@@ -38,10 +49,37 @@ def _pick_block(default, seq_len):
     return b
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
-                scale, causal, block_q, block_k, nk):
+def _keep_mask(seed, bh, rows, cols, dropout_p):
+    """Deterministic dropout keep-mask: xorshift-mix hash of the GLOBAL
+    (row, col) position + seed + batch·head.  Independent of block shape, so
+    forward and both backward kernels regenerate identical bits."""
+    x = (rows.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+         ^ cols.astype(jnp.uint32) * jnp.uint32(0x85EBCA77))
+    x = x + seed.astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D)
+    x = x ^ (bh.astype(jnp.uint32) * jnp.uint32(0x27D4EB2F))
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x2C1B3C6D)
+    x = x ^ (x >> 12)
+    x = x * jnp.uint32(0x297A2D39)
+    x = x ^ (x >> 15)
+    thresh = jnp.uint32(min(int(dropout_p * 4294967296.0), 4294967295))
+    return x >= thresh
+
+
+def _global_rc(qi, j, block_q, block_k):
+    rows = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return rows, cols
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+                acc_sc, m_sc, l_sc, *, scale, causal, dropout_p,
+                block_q, block_k, nk):
     """Grid (BH, nq, nk) with KV innermost: pallas double-buffers the KV block
     DMAs while the MXU works; running max/denominator live in VMEM scratch."""
+    b = pl.program_id(0)
     qi = pl.program_id(1)
     j = pl.program_id(2)
 
@@ -64,20 +102,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
         vblk = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # [BQ, BK]
+        rows, cols = _global_rc(qi, j, block_q, block_k)
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
+        # kv validity mask (1.0 = attend) — [1, BK] broadcast over rows
+        s = jnp.where(mask_ref[0] > 0, s, NEG_INF)
         m_prev = m_sc[:, :1]  # [BQ, 1]
         l_prev = l_sc[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed_ref[0], b, rows, cols, dropout_p)
+            # dropout scales the PV accumulation only; the softmax
+            # denominator keeps the full probability mass
+            p_acc = jnp.where(keep, p * (1.0 / (1.0 - dropout_p)), 0.0)
+        else:
+            p_acc = p
         acc_sc[:] = acc_sc[:] * alpha + jax.lax.dot_general(
-            p, vblk, (((1,), (0,)), ((), ())),
+            p_acc, vblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
         l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
@@ -89,14 +133,127 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
         lse_ref[0] = m_sc[:, :1] + jnp.log(l_safe)
 
 
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    mask_ref, dk_ref, dv_ref, dk_sc, dv_sc, *, scale, causal,
+                    dropout_p, block_q, block_k, nq):
+    """Grid (BH, nk, nq): fixed KV block, stream q/do blocks, accumulate
+    dk/dv in VMEM scratch."""
+    b = pl.program_id(0)
+    jj = pl.program_id(1)
+    ii = pl.program_id(2)
+
+    @pl.when(ii == 0)
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    if causal:
+        compute = (ii + 1) * block_q - 1 >= jj * block_k
+    else:
+        compute = ii >= 0
+
+    @pl.when(compute)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale     # [BQ, D]
+        kblk = k_ref[0].astype(jnp.float32)          # [BK, D]
+        vblk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)           # [BQ, D]
+        lse = lse_ref[0]                             # [BQ, 1]
+        delta = delta_ref[0]                         # [BQ, 1]
+        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        rows, cols = _global_rc(ii, jj, block_q, block_k)
+        if causal:
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        s = jnp.where(mask_ref[0] > 0, s, NEG_INF)
+        p = jnp.exp(s - lse)                         # normalized probs
+        dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed_ref[0], b, rows, cols, dropout_p)
+            inv = 1.0 / (1.0 - dropout_p)
+            p_v = jnp.where(keep, p * inv, 0.0)      # dropped probs for dv
+            dpn = jnp.where(keep, dp * inv, 0.0)     # d(prob) through dropout
+        else:
+            p_v = p
+            dpn = dp
+        dv_sc[:] += jax.lax.dot_general(p_v, do, (((0,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+        ds = p * (dpn - delta)
+        # q was pre-scaled → this accumulates scale * dsᵀ·q = dk
+        dk_sc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(ii == nq - 1)
+    def _write():
+        dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   mask_ref, dq_ref, dq_sc, *, scale, causal, dropout_p,
+                   block_q, block_k, nk):
+    """Grid (BH, nq, nk): fixed q block, stream KV blocks, accumulate dq."""
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    if causal:
+        compute = j * block_k <= (qi + 1) * block_q - 1
+    else:
+        compute = j >= 0
+
+    @pl.when(compute)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale
+        kblk = k_ref[0].astype(jnp.float32)
+        vblk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        rows, cols = _global_rc(qi, j, block_q, block_k)
+        if causal:
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        s = jnp.where(mask_ref[0] > 0, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed_ref[0], b, rows, cols, dropout_p)
+            dpn = jnp.where(keep, dp * (1.0 / (1.0 - dropout_p)), 0.0)
+        else:
+            dpn = dp
+        ds = p * (dpn - delta)
+        dq_sc[:] += jax.lax.dot_general(ds, kblk, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _write():
+        dq_ref[0] = (dq_sc[:] * scale).astype(dq_ref.dtype)
+
+
 def _interpret_mode() -> bool:
     """Pallas interpret mode off-TPU (CPU tests exercise the same kernel)."""
     return jax.default_backend() != "tpu"
 
 
-def _flash_fwd_bhsd(q, k, v, causal, block_q, block_k):
+def _compiler_params():
+    try:
+        return pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    except Exception:  # param name drift across jax versions
+        return None
+
+
+def _flash_fwd_bhsd(q, k, v, mask, seed, scale, causal, dropout_p,
+                    block_q, block_k):
     B, H, S, D = q.shape
-    scale = 1.0 / math.sqrt(D)
     nk = S // block_k
     grid = (B * H, S // block_q, nk)
 
@@ -104,20 +261,17 @@ def _flash_fwd_bhsd(q, k, v, causal, block_q, block_k):
     k3 = k.reshape(B * H, S, D)
     v3 = v.reshape(B * H, S, D)
 
-    try:
-        compiler_params = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"))
-    except Exception:  # param name drift across jax versions
-        compiler_params = None
-
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, nk=nk),
+                          dropout_p=dropout_p, block_q=block_q,
+                          block_k=block_k, nk=nk),
         grid=grid,
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # seed
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j, h=H: (b // h, 0, j)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
@@ -134,63 +288,159 @@ def _flash_fwd_bhsd(q, k, v, causal, block_q, block_k):
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
-        compiler_params=compiler_params,
+        compiler_params=_compiler_params(),
         interpret=_interpret_mode(),
-    )(q3, k3, v3)
-    return out.reshape(B, H, S, D), lse.reshape(B, H, S)
+    )(seed, q3, k3, v3, mask)
+    return out.reshape(B, H, S, D), lse
 
 
-def _attention_bwd_math(q, k, v, o, lse, g, causal, scale):
-    """Blockwise-safe backward math in jax (XLA): uses saved logsumexp so no
-    softmax renormalization pass is needed; O(S²) intermediates are formed
-    per-block by XLA fusion, not materialized to HBM as residuals."""
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    gf = g.astype(jnp.float32)
-    of = o.astype(jnp.float32)
-    s = jnp.einsum("bhqd,bhkd->bhqk", qf * scale, kf)
-    if causal:
-        S = q.shape[2]
-        mask = jnp.tril(jnp.ones((S, S), bool))
-        s = jnp.where(mask, s, NEG_INF)
-    p = jnp.exp(s - lse[..., None])
-    dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
-    dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
-    delta = jnp.sum(of * gf, axis=-1, keepdims=True)  # [B,H,S,1]
-    ds = p * (dp - delta)
-    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
-    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+def _flash_bwd_bhsd(q, k, v, o, lse, g, mask, seed, scale, causal, dropout_p,
+                    block_q, block_k):
+    B, H, S, D = q.shape
+    q3 = q.reshape(B * H, S, D)
+    k3 = k.reshape(B * H, S, D)
+    v3 = v.reshape(B * H, S, D)
+    g3 = g.reshape(B * H, S, D)
+    # delta = rowsum(dO ⊙ O): O(S·D), precomputed once in XLA
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1).reshape(B * H, S, 1)
+
+    nq, nk = S // block_q, S // block_k
+    common = dict(scale=scale, causal=causal, dropout_p=dropout_p,
+                  block_q=block_q, block_k=block_k)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, nq=nq, **common),
+        grid=(B * H, nk, nq),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, D), lambda b, jj, ii: (b, ii, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, jj, ii: (b, jj, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, jj, ii: (b, jj, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, jj, ii: (b, ii, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, jj, ii: (b, ii, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, jj, ii: (b, ii, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, jj, ii, h=H: (b // h, 0, jj)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, jj, ii: (b, jj, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, jj, ii: (b, jj, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, S, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=_interpret_mode(),
+    )(seed, q3, k3, v3, g3, lse, delta, mask)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, nk=nk, **common),
+        grid=(B * H, nq, nk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j, h=H: (b // h, 0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((B * H, S, D), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=_compiler_params(),
+        interpret=_interpret_mode(),
+    )(seed, q3, k3, v3, g3, lse, delta, mask)[0]
+
+    return (dq.reshape(B, H, S, D), dk.reshape(B, H, S, D),
+            dv.reshape(B, H, S, D))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_attention_core(q, k, v, causal, block_q, block_k):
-    out, _ = _flash_fwd_bhsd(q, k, v, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_attention_core(q, k, v, mask, seed, scale, causal, dropout_p,
+                          block_q, block_k):
+    out, _ = _flash_fwd_bhsd(q, k, v, mask, seed, scale, causal, dropout_p,
+                             block_q, block_k)
     return out
 
 
-def _core_fwd(q, k, v, causal, block_q, block_k):
-    out, lse = _flash_fwd_bhsd(q, k, v, causal, block_q, block_k)
-    return out, (q, k, v, out, lse)
+def _core_fwd(q, k, v, mask, seed, scale, causal, dropout_p, block_q, block_k):
+    out, lse = _flash_fwd_bhsd(q, k, v, mask, seed, scale, causal, dropout_p,
+                               block_q, block_k)
+    return out, (q, k, v, out, lse, mask, seed)
 
 
-def _core_bwd(causal, block_q, block_k, res, g):
-    q, k, v, o, lse = res
-    scale = 1.0 / math.sqrt(q.shape[-1])
-    return _attention_bwd_math(q, k, v, o, lse, g, causal, scale)
+def _core_bwd(scale, causal, dropout_p, block_q, block_k, res, g):
+    q, k, v, o, lse, mask, seed = res
+    dq, dk, dv = _flash_bwd_bhsd(q, k, v, o, lse, g, mask, seed, scale,
+                                 causal, dropout_p, block_q, block_k)
+    return dq, dk, dv, jnp.zeros_like(mask), jnp.zeros_like(seed)
 
 
 _flash_attention_core.defvjp(_core_fwd, _core_bwd)
 
 
-def flash_attention_bshd(q, k, v, causal=False, block_q=None, block_k=None):
-    """Flash attention on [B, S, H, D] arrays (paddle layout). Returns BSHD."""
+def _pad_head_dim(d):
+    """MXU-friendly head width: 64 stays, otherwise next multiple of 128."""
+    if d <= 64:
+        return 64
+    return ((d + 127) // 128) * 128
+
+
+def flash_attention_bshd(q, k, v, causal=False, kv_mask=None, dropout_p=0.0,
+                         seed=None, block_q=None, block_k=None):
+    """Flash attention on [B, S, H, D] arrays (paddle layout). Returns BSHD.
+
+    kv_mask: optional [B, S] validity mask (True/1 = attend) — the padding
+    form every BERT-style model produces.  dropout_p: attention-prob dropout
+    applied in-kernel with deterministic counter-based bits (`seed`).
+    Sequence length and head_dim are padded to kernel-friendly shapes
+    internally and sliced back.
+    """
     B, S, H, D = q.shape
-    bq = block_q or _pick_block(DEFAULT_BLOCK_Q, S)
-    bk = block_k or _pick_block(DEFAULT_BLOCK_K, S)
+    scale = 1.0 / math.sqrt(D)
+
+    Sp = ((S + 127) // 128) * 128
+    Dp = _pad_head_dim(D)
+    if kv_mask is None:
+        mask = jnp.ones((B, Sp), jnp.float32)
+        if Sp != S:
+            mask = mask.at[:, S:].set(0.0)
+    else:
+        mask = kv_mask.astype(jnp.float32)
+        if Sp != S:
+            mask = jnp.pad(mask, ((0, 0), (0, Sp - S)))
+    # carried as [B, 1, Sp]: mosaic wants the last-two block dims (1, block_k)
+    # to tile the array dims exactly — a 2D (B, Sp) mask with block (1, bk)
+    # violates the 8×128 rule when B isn't a multiple of 8
+    mask = mask.reshape(B, 1, Sp)
+    if Sp != S or Dp != D:
+        pad = ((0, 0), (0, Sp - S), (0, 0), (0, Dp - D))
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+
+    bq = block_q or _pick_block(DEFAULT_BLOCK_Q, Sp)
+    bk = block_k or _pick_block(DEFAULT_BLOCK_K, Sp)
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
+    else:
+        seed = jnp.asarray(seed, jnp.int32).reshape(-1)[:1]
+
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    out = _flash_attention_core(qt, kt, vt, causal, bq, bk)
-    return jnp.swapaxes(out, 1, 2)
+    out = _flash_attention_core(qt, kt, vt, mask, seed, scale, causal,
+                                float(dropout_p), bq, bk)
+    out = jnp.swapaxes(out, 1, 2)
+    if Sp != S or Dp != D:
+        out = out[:, :S, :, :D]
+    return out

@@ -116,6 +116,71 @@ class Optimizer:
                 "or use it through a Fleet/Model wrapper that supplies them")
         return [p for p in self._parameters if isinstance(p, Parameter) or isinstance(p, Tensor)]
 
+    # --- static-graph path --------------------------------------------------
+    def _static_step(self, prog):
+        """Record the parameter-update ops into the active static Program
+        (reference: optimizer.minimize appends update OpDescs,
+        fluid/optimizer.py; here one recorded functional `_rule` per param
+        whose outputs are wired to the Program's param/state writeback)."""
+        from ..ops.dispatch import apply as _apply
+
+        # live step counter + learning rate ride as Program state inputs so
+        # Adam bias correction advances and LR schedulers apply per run
+        # (baking them as Python constants would freeze t=1 forever)
+        slots = getattr(self, "_static_slots", None)
+        if slots is None:
+            slots = self._static_slots = {}
+        skey = id(prog)
+        if skey not in slots:
+            step_t = Tensor(jnp.zeros((), jnp.int32))
+            new_step = _apply("increment_step", lambda s: s + 1, step_t)
+            prog.note_state(step_t, updated=new_step)
+            lr_t = Tensor(jnp.asarray(self.get_lr(), jnp.float32))
+            prog.note_state(
+                lr_t, refresh=lambda: jnp.asarray(self.get_lr(), jnp.float32))
+            slots[skey] = (step_t, new_step, lr_t)
+        step_t, new_step, lr_t = slots[skey]
+
+        self._step_count += 1
+        kinds = self._acc_kinds()
+        for p in self._param_list():
+            if p._grad is None or not getattr(p, "trainable", True):
+                continue
+            g = p._grad
+            lr_scale = p.optimize_attr.get("learning_rate", 1.0) \
+                if hasattr(p, "optimize_attr") else 1.0
+            reg = p.regularizer if getattr(p, "regularizer", None) is not None \
+                else self._regularization
+            acc_tensors = []
+            for kind in kinds:
+                t = Tensor(self._acc(kind, p))
+                acc_tensors.append((kind, t))
+
+            def upd(pv, gv, lrv, sv, *accvs, _kinds=tuple(kinds),
+                    _scale=lr_scale, _reg=reg):
+                gv = gv.astype(pv.dtype) if gv.dtype != pv.dtype else gv
+                if isinstance(_reg, L2Decay):
+                    gv = gv + _reg.coeff * pv
+                elif isinstance(_reg, L1Decay):
+                    gv = gv + _reg.coeff * jnp.sign(pv)
+                accs = dict(zip(_kinds, accvs))
+                new_p, new_accs = self._rule(pv, gv, accs, lrv * _scale, sv)
+                return (new_p,) + tuple(new_accs[k] for k in _kinds)
+
+            outs = _apply(f"{type(self).__name__.lower()}_update", upd, p, g,
+                          lr_t, new_step, *[t for _, t in acc_tensors])
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            prog.note_param_update(p, outs[0])
+            for (kind, t), new_t in zip(acc_tensors, outs[1:]):
+                store = self._accumulators.setdefault(kind, {})
+
+                def setter(v, _store=store, _key=id(p)):
+                    _store[_key] = v
+
+                prog.note_state(t, setter, updated=new_t)
+        return None, [(p, p._grad) for p in self._param_list()]
+
     @no_grad()
     def step(self):
         params = [p for p in self._param_list() if p._grad is not None
@@ -159,6 +224,16 @@ class Optimizer:
         ``backward(retain_graph=True)`` the graph is still alive and minimize
         will run backward again, accumulating — call step() directly in that
         pattern."""
+        from ..static.program import _active_recorder
+
+        prog = _active_recorder()
+        if prog is not None:
+            # static mode: record backward (create_graph routes vjps through
+            # the dispatcher so they land in the Program) + update ops
+            from ..autograd.tape import run_backward
+
+            run_backward([loss], retain_graph=True, create_graph=True)
+            return self._static_step(prog)
         node = getattr(loss, "_grad_node", None)
         graph_alive = node is not None and getattr(node, "vjp_fn", None) is not None
         if graph_alive:

