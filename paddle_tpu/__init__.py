@@ -68,6 +68,7 @@ from . import autograd  # noqa: F401
 from . import distributed  # noqa: F401
 from . import hapi as _hapi  # noqa: F401
 from . import incubate  # noqa: F401
+from . import inference  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import metric  # noqa: F401

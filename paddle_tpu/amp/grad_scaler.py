@@ -49,11 +49,22 @@ class AmpScaler:
             raise RuntimeError(
                 "unscale_() has already been called on this optimizer since "
                 "the last update()")
+        from ..sparse_grad import IndexedSlices
+
         found = False
         inv = 1.0 / self._scale
         with no_grad():
             for p in optimizer._param_list():
                 if p._grad is None:
+                    continue
+                if isinstance(p._grad, IndexedSlices):
+                    sl = p._grad
+                    vals = sl.values.astype(jnp.float32) * inv
+                    if not bool(jnp.all(jnp.isfinite(vals))):
+                        found = True
+                    p._grad = IndexedSlices(sl.rows,
+                                            vals.astype(sl.values.dtype),
+                                            sl.dense_shape)
                     continue
                 g = p._grad._value.astype(jnp.float32) * inv
                 if not bool(jnp.all(jnp.isfinite(g))):

@@ -18,6 +18,7 @@ from ..ops._helpers import to_tensor_like
 from ..ops.dispatch import apply
 from ..tensor import Tensor
 from .collective import Group, _default_group, _is_traced
+from .env import get_rank
 from .mesh import mesh_axis_size
 
 
@@ -127,7 +128,20 @@ class VocabParallelEmbedding(Layer):
                 return jax.lax.psum(emb, self.axis_name)
 
             return apply("parallel_embedding", f, x, self.weight)
-        return F.embedding(x, self.weight)
+
+        # eager (single participant): same masked local lookup as the traced
+        # path — ids outside this rank's row range contribute zeros (they
+        # would be filled in by the psum across ranks); an unmasked take
+        # would read out-of-bounds and return NaN fill
+        def f_eager(idx, w):
+            lo = get_rank() * self.per_part
+            local = idx.astype(jnp.int32) - lo
+            valid = (local >= 0) & (local < self.per_part)
+            safe = jnp.clip(local, 0, self.per_part - 1)
+            emb = jnp.take(w, safe, axis=0)
+            return jnp.where(valid[..., None], emb, 0.0)
+
+        return apply("parallel_embedding", f_eager, x, self.weight)
 
 
 class ParallelCrossEntropy(Layer):

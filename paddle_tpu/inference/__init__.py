@@ -1,0 +1,17 @@
+"""paddle_tpu.inference — the serving path.
+
+Reference analog: paddle.inference (paddle_inference_api.h): AnalysisConfig
+(inference/api/analysis_config.cc) + AnalysisPredictor
+(analysis_predictor.cc:173 Init, :354 Run, :602 CreatePaddlePredictor) with
+named input/output handles.
+
+TPU-native: a predictor wraps a jax.export StableHLO artifact produced by
+``paddle_tpu.jit.save`` — deserialization + first call AOT-compiles the
+whole graph once (the IR-pass/TensorRT-offload machinery of the reference is
+subsumed by XLA compilation).  Batch-size buckets are handled by padding the
+feed batch up to the exported batch and slicing the fetch back.
+"""
+from .config import Config
+from .predictor import Predictor, PredictorTensor, create_predictor
+
+__all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor"]

@@ -1,0 +1,93 @@
+"""Inference Config (reference AnalysisConfig, analysis_config.cc).
+
+Holds the model path + execution knobs.  GPU/TensorRT/MKLDNN toggles of the
+reference map to documented no-ops or XLA equivalents — kept for API parity
+so reference serving code ports without edits.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class Config:
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        """prog_file: path prefix used with ``paddle_tpu.jit.save`` (the
+        ``.pdmodel``/``.pdiparams`` suffixes are appended automatically, or
+        pass the full ``.pdmodel`` path)."""
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._model_prefix = prog_file
+        self._params_file = params_file
+        self._device = "tpu"
+        self._device_id = 0
+        self._enable_memory_optim = True
+        self._ir_optim = True          # XLA always optimizes; kept for parity
+        self._glog_info = False
+        self._warmup = True            # AOT-compile at predictor creation
+
+    # --- model location ----------------------------------------------------
+    def set_model(self, prog_file, params_file=None):
+        if prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._model_prefix = prog_file
+        self._params_file = params_file
+
+    def model_dir(self):
+        return os.path.dirname(self._model_prefix or "")
+
+    def prog_file(self):
+        return (self._model_prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return self._params_file or (self._model_prefix or "") + ".pdiparams"
+
+    # --- device ------------------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        """Reference API parity: on this framework the accelerator is the
+        TPU; the call selects the default jax device."""
+        self._device = "tpu"
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device == "tpu"
+
+    def gpu_device_id(self):
+        return self._device_id
+
+    # --- optimization knobs (XLA-subsumed, kept for parity) -----------------
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def ir_optim(self):
+        return self._ir_optim
+
+    def enable_memory_optim(self):
+        self._enable_memory_optim = True
+
+    def set_warmup(self, flag: bool):
+        """AOT-compile the artifact at create_predictor time (not reference
+        API; TPU-specific: first-call compile latency moved to load)."""
+        self._warmup = flag
+
+    def enable_tensorrt_engine(self, *a, **k):
+        """No-op: XLA fusion/AOT is the subgraph-offload analog
+        (SURVEY §2 row 36)."""
+
+    def enable_mkldnn(self):
+        """No-op: XLA:CPU covers the CPU path."""
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def summary(self):
+        return {
+            "model": self._model_prefix,
+            "device": self._device,
+            "ir_optim": self._ir_optim,
+            "warmup": self._warmup,
+        }

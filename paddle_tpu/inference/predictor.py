@@ -1,0 +1,158 @@
+"""Predictor (reference AnalysisPredictor, analysis_predictor.cc).
+
+create_predictor(config) loads a ``jit.save`` artifact in a fresh process —
+no model class needed — and serves named inputs/outputs:
+
+    config = Config("model_prefix")
+    predictor = create_predictor(config)
+    h = predictor.get_input_handle(predictor.get_input_names()[0])
+    h.copy_from_cpu(batch_np)
+    predictor.run()
+    out = predictor.get_output_handle(predictor.get_output_names()[0])
+    result = out.copy_to_cpu()
+
+Batch-size buckets: the exported artifact has a static batch B0; a smaller
+feed batch is padded up to B0 (rows repeated) and the fetch sliced back —
+one compiled executable serves every batch size ≤ B0 (reference predictors
+re-run the IR pipeline per shape; XLA would recompile, so padding is the
+TPU-native bucket).
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from .config import Config
+
+
+class PredictorTensor:
+    """Named feed/fetch handle (reference PaddleTensor / ZeroCopyTensor)."""
+
+    def __init__(self, name, shape=None, dtype=None):
+        self.name = name
+        self._shape = shape
+        self._dtype = dtype
+        self._value: Optional[np.ndarray] = None
+
+    def copy_from_cpu(self, arr):
+        self._value = np.asarray(arr)
+
+    def copy_to_cpu(self):
+        return self._value
+
+    def reshape(self, shape):
+        self._shape = tuple(shape)
+
+    @property
+    def shape(self):
+        return (tuple(self._value.shape) if self._value is not None
+                else self._shape)
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        self._config = config
+        with open(config.prog_file(), "rb") as f:
+            self._exported = jax.export.deserialize(f.read())
+        try:
+            with open(config.params_file(), "rb") as f:
+                self._state = pickle.load(f)
+        except FileNotFoundError:
+            self._state = {}
+        meta = {}
+        try:
+            with open(config.prog_file()[: -len(".pdmodel")] + ".pdmeta",
+                      "rb") as f:
+                meta = pickle.load(f)
+        except FileNotFoundError:
+            pass
+        in_specs = list(self._exported.in_avals)
+        self._input_names = meta.get(
+            "input_names", [f"x{i}" for i in range(len(in_specs))])
+        self._in_specs = in_specs
+        n_out = len(self._exported.out_avals)
+        self._output_names = meta.get(
+            "output_names", [f"out_{i}" for i in range(n_out)])
+        self._inputs: Dict[str, PredictorTensor] = {
+            n: PredictorTensor(n, tuple(s.shape), s.dtype)
+            for n, s in zip(self._input_names, in_specs)}
+        self._outputs: Dict[str, PredictorTensor] = {
+            n: PredictorTensor(n) for n in self._output_names}
+        if config._warmup:
+            self._warmup_call()
+
+    def _warmup_call(self):
+        """AOT-compile once at load (analysis_predictor.cc:231
+        OptimizeInferenceProgram analog — here XLA compilation)."""
+        feeds = [np.zeros(tuple(s.shape), s.dtype) for s in self._in_specs]
+        try:
+            self._exported.call(*feeds)
+        except Exception:
+            pass  # warmup is best-effort (e.g. int embedding ids need bounds)
+
+    # --- reference API ------------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names)
+
+    def get_input_handle(self, name) -> PredictorTensor:
+        return self._inputs[name]
+
+    def get_output_handle(self, name) -> PredictorTensor:
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Execute. inputs: optional positional feeds (else the values set on
+        the input handles)."""
+        if inputs is not None:
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(a)
+        feeds = []
+        batch = None
+        for n, spec in zip(self._input_names, self._in_specs):
+            v = self._inputs[n]._value
+            if v is None:
+                raise ValueError(f"input {n!r} not set (copy_from_cpu first)")
+            want = tuple(spec.shape)
+            if v.shape != want:
+                if (len(v.shape) == len(want) and v.shape[1:] == want[1:]
+                        and v.shape[0] < want[0]):
+                    # batch bucket: pad rows up to the exported batch
+                    batch = v.shape[0] if batch is None else batch
+                    pad = np.repeat(v[-1:], want[0] - v.shape[0], axis=0)
+                    v = np.concatenate([v, pad], axis=0)
+                else:
+                    raise ValueError(
+                        f"input {n!r} shape {v.shape} incompatible with "
+                        f"exported {want}")
+            feeds.append(v.astype(spec.dtype))
+        outs = self._exported.call(*feeds)
+        if not isinstance(outs, (list, tuple)):
+            outs = (outs,)
+        for n, o in zip(self._output_names, outs):
+            arr = np.asarray(o)
+            if batch is not None and arr.ndim >= 1 \
+                    and arr.shape[0] == self._in_specs[0].shape[0]:
+                arr = arr[:batch]
+            self._outputs[n].copy_from_cpu(arr)
+        return [self._outputs[n].copy_to_cpu() for n in self._output_names]
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    """reference CreatePaddlePredictor (analysis_predictor.cc:602)."""
+    return Predictor(config)

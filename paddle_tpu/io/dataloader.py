@@ -3,14 +3,22 @@ dataloader_iter.py:100,230 — multiprocess workers, mmap shared memory,
 blocking queue; operators/reader/buffered_reader.cc — async host→device
 double buffering).
 
-TPU-native: worker threads collate numpy batches into a bounded queue; the
-iterator optionally stages the next batch onto device (jax.device_put is
-async) while the current step computes — the buffered_reader analog.  If the
-native csrc datafeed library is built, index shuffling and batch assembly for
-array datasets run in C++.
+TPU-native, three feed paths by cost:
+1. **Native array path**: TensorDataset-style contiguous arrays are batch-
+   assembled by the csrc gather engine (csrc/datafeed.cc) — one C call per
+   batch, no per-row Python.
+2. **Process workers** (num_workers>0, use_shared_memory): forked worker
+   processes fetch+collate and ship batches through posix shared memory
+   (dataloader_iter.py:230 _DataLoaderIterMultiProcess analog) — Python
+   transform pipelines escape the GIL.
+3. **Thread workers**: the fallback for cheap datasets / platforms without
+   fork.
+`prefetch_to_device` stages the next batch onto the accelerator while the
+current step computes (buffered_reader.cc analog).
 """
 from __future__ import annotations
 
+import multiprocessing as mp
 import queue
 import threading
 from typing import Optional
@@ -18,7 +26,7 @@ from typing import Optional
 import numpy as np
 
 from ..tensor import Tensor
-from .dataset import Dataset, IterableDataset
+from .dataset import Dataset, IterableDataset, TensorDataset
 from .sampler import BatchSampler
 
 
@@ -55,6 +63,212 @@ def _to_tensor_tree(obj):
 
 
 _SENTINEL = object()
+
+
+def _dataset_arrays(ds):
+    """numpy views of a TensorDataset's columns, or None."""
+    if not isinstance(ds, TensorDataset):
+        return None
+    cols = []
+    for t in ds.tensors:
+        if isinstance(t, Tensor):
+            cols.append(np.asarray(t._value))
+        elif isinstance(t, np.ndarray):
+            cols.append(t)
+        else:
+            return None
+    return cols
+
+
+class _NativeArrayIter:
+    """Feed path 1: whole-batch gather through the csrc engine (or numpy
+    fancy-indexing fallback) — no workers, no queues."""
+
+    def __init__(self, loader, cols):
+        from . import native_feed
+
+        self._nf = native_feed
+        self._cols = [np.ascontiguousarray(c) for c in cols]
+        self._batches = iter(loader.batch_sampler)
+        self._loader = loader
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        idxs = np.asarray(next(self._batches), np.int64)
+        out = []
+        for c in self._cols:
+            scale = 1.0 / 255.0 if c.dtype == np.uint8 else None
+            out.append(self._nf.gather_rows(c, idxs, u8_scale=scale))
+        return _to_tensor_tree(list(out))
+
+
+def _mp_worker(dataset, collate_fn, index_q, result_q, use_shm):
+    """Worker process body (dataloader_iter.py:100 _worker_loop analog)."""
+    from multiprocessing import shared_memory
+
+    while True:
+        item = index_q.get()
+        if item is None:
+            return
+        i, idxs = item
+        try:
+            batch = collate_fn([dataset[j] for j in idxs])
+            flat, spec = _flatten_np(batch)
+            if use_shm:
+                blocks = []
+                for arr in flat:
+                    arr = np.ascontiguousarray(arr)
+                    shm = shared_memory.SharedMemory(create=True,
+                                                     size=max(arr.nbytes, 1))
+                    np.ndarray(arr.shape, arr.dtype,
+                               buffer=shm.buf)[...] = arr
+                    blocks.append((shm.name, arr.shape, arr.dtype.str))
+                    shm.close()
+                result_q.put((i, "shm", (blocks, spec)))
+            else:
+                result_q.put((i, "pickle", (flat, spec)))
+        except Exception as e:  # propagate to parent
+            result_q.put((i, "error", repr(e)))
+            return
+
+
+def _flatten_np(batch):
+    """Flatten a collated batch (nested list/tuple/dict of arrays) into
+    (arrays, spec) for shared-memory transport."""
+    flat = []
+
+    def go(x):
+        if isinstance(x, (list, tuple)):
+            return ("seq", type(x).__name__, [go(v) for v in x])
+        if isinstance(x, dict):
+            return ("dict", sorted(x), [go(x[k]) for k in sorted(x)])
+        flat.append(np.asarray(x))
+        return ("leaf", len(flat) - 1, None)
+
+    spec = go(batch)
+    return flat, spec
+
+
+def _unflatten_np(flat, spec):
+    kind, a, b = spec
+    if kind == "leaf":
+        return flat[a]
+    if kind == "seq":
+        seq = [_unflatten_np(flat, s) for s in b]
+        return tuple(seq) if a == "tuple" else seq
+    return {k: _unflatten_np(flat, s) for k, s in zip(a, b)}
+
+
+class _ProcessIter:
+    """Feed path 2: forked worker processes + shared-memory transport
+    (reference _DataLoaderIterMultiProcess, dataloader_iter.py:230)."""
+
+    def __init__(self, loader):
+        from multiprocessing import shared_memory  # noqa: F401 (probe)
+
+        self.loader = loader
+        ctx = mp.get_context("fork")
+        self._index_q = ctx.Queue()
+        self._result_q = ctx.Queue()
+        batches = list(iter(loader.batch_sampler))
+        self._n_batches = len(batches)
+        for i, idxs in enumerate(batches):
+            self._index_q.put((i, list(idxs)))
+        n_workers = max(1, loader.num_workers)
+        for _ in range(n_workers):
+            self._index_q.put(None)
+        self._procs = [
+            ctx.Process(target=_mp_worker,
+                        args=(loader.dataset, loader.collate_fn,
+                              self._index_q, self._result_q,
+                              loader.use_shared_memory),
+                        daemon=True)
+            for _ in range(n_workers)]
+        for p in self._procs:
+            p.start()
+        self._pending = {}
+        self._next_out = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from multiprocessing import shared_memory
+
+        if self._next_out >= self._n_batches:
+            self._shutdown()
+            raise StopIteration
+        while self._next_out not in self._pending:
+            i, kind, payload = self._result_q.get()
+            if kind == "error":
+                self._shutdown()
+                raise RuntimeError(f"DataLoader worker failed: {payload}")
+            self._pending[i] = (kind, payload)
+        kind, payload = self._pending.pop(self._next_out)
+        self._next_out += 1
+        if kind == "shm":
+            blocks, spec = payload
+            flat = []
+            for name, shape, dtype in blocks:
+                shm = shared_memory.SharedMemory(name=name)
+                arr = np.ndarray(shape, np.dtype(dtype),
+                                 buffer=shm.buf).copy()
+                shm.close()
+                shm.unlink()
+                flat.append(arr)
+        else:
+            flat, spec = payload
+        batch = _unflatten_np(flat, spec)
+        out = _to_tensor_tree(batch)
+        if isinstance(out, tuple):
+            out = list(out)
+        return out
+
+    def _shutdown(self):
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self._procs:
+            p.join(timeout=1)
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
+
+
+def prefetch_to_device(iterator, depth=2):
+    """Double-buffered host→device staging (buffered_reader.cc analog):
+    device_put of batch N+1 overlaps step N's compute (jax transfers are
+    async)."""
+    import jax
+
+    from ..tensor import Tensor as _T
+
+    def stage(batch):
+        if isinstance(batch, (list, tuple)):
+            return [stage(b) for b in batch]
+        if isinstance(batch, _T):
+            return _T(jax.device_put(batch._value))
+        return batch
+
+    buf = []
+    it = iter(iterator)
+    try:
+        for _ in range(depth):
+            buf.append(stage(next(it)))
+    except StopIteration:
+        pass
+    while buf:
+        out = buf.pop(0)
+        try:
+            buf.append(stage(next(it)))
+        except StopIteration:
+            pass
+        yield out
 
 
 class _LoaderIter:
@@ -174,6 +388,7 @@ class DataLoader:
         self.prefetch_factor = prefetch_factor
         self.drop_last = drop_last
         self.batch_size = batch_size
+        self.use_shared_memory = use_shared_memory
         if isinstance(dataset, IterableDataset):
             self.batch_sampler = None
         elif batch_sampler is not None:
@@ -185,6 +400,21 @@ class DataLoader:
                                               drop_last=drop_last)
 
     def __iter__(self):
+        # path 1: contiguous arrays + default collate → native batch gather
+        if (self.batch_sampler is not None
+                and self.collate_fn is default_collate_fn):
+            cols = _dataset_arrays(self.dataset)
+            if cols is not None:
+                return _NativeArrayIter(self, cols)
+        # path 2: process workers with shared-memory transport
+        if (self.num_workers > 0 and self.use_shared_memory
+                and self.batch_sampler is not None
+                and hasattr(mp, "get_context")):
+            try:
+                return _ProcessIter(self)
+            except Exception:
+                pass  # fork/shm unavailable → thread fallback
+        # path 3: thread workers
         return _LoaderIter(self)
 
     def __len__(self):

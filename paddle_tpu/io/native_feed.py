@@ -1,0 +1,134 @@
+"""ctypes binding for the native csrc datafeed engine.
+
+Reference analog: the pybind layer over data_feed.cc/data_set.cc
+(pybind/data_set_py.cc).  Gracefully degrades to numpy when the .so is not
+built; `ensure_built()` compiles it on demand with the in-tree Makefile.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_CSRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_SO_PATH = os.path.join(_CSRC_DIR, "libptpu_datafeed.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def ensure_built(rebuild=False) -> bool:
+    """Build the native library if missing. Returns availability."""
+    global _tried, _lib
+    if rebuild:
+        _tried = False
+        _lib = None
+    if not os.path.exists(_SO_PATH) or rebuild:
+        try:
+            subprocess.run(["make", "-C", _CSRC_DIR], capture_output=True,
+                           timeout=120, check=True)
+        except Exception:
+            return False
+    return _load() is not None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_SO_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        return None
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.ptpu_shuffle_indices.argtypes = [i64p, ctypes.c_int64,
+                                         ctypes.c_uint64]
+    lib.ptpu_gather_f32.argtypes = [f32p, i64p, ctypes.c_int64,
+                                    ctypes.c_int64, f32p]
+    lib.ptpu_gather_u8_to_f32.argtypes = [u8p, i64p, ctypes.c_int64,
+                                          ctypes.c_int64, f32p,
+                                          ctypes.c_float]
+    lib.ptpu_gather_i64.argtypes = [i64p, i64p, ctypes.c_int64,
+                                    ctypes.c_int64, i64p]
+    lib.ptpu_version.restype = ctypes.c_int
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def shuffle_indices(n: int, seed: int) -> np.ndarray:
+    """Deterministic permutation of [0, n) — native Fisher-Yates when built,
+    numpy otherwise."""
+    lib = _load()
+    if lib is None:
+        rng = np.random.RandomState(seed % (2**32))
+        return rng.permutation(n).astype(np.int64)
+    idx = np.arange(n, dtype=np.int64)
+    lib.ptpu_shuffle_indices(
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        ctypes.c_uint64(seed))
+    return idx
+
+
+def gather_rows(src: np.ndarray, rows: np.ndarray,
+                out: Optional[np.ndarray] = None,
+                u8_scale: Optional[float] = None) -> np.ndarray:
+    """Batch assembly: out[r] = src[rows[r]] (optionally casting u8→f32 with
+    scale).  `src` must be C-contiguous with rows along axis 0."""
+    lib = _load()
+    rows = np.ascontiguousarray(rows, np.int64)
+    n = rows.shape[0]
+    row_shape = src.shape[1:]
+    row_elems = int(np.prod(row_shape)) if row_shape else 1
+    if lib is None:
+        batch = src[rows]
+        if u8_scale is not None:
+            batch = batch.astype(np.float32) * u8_scale
+        if out is not None:
+            out[...] = batch
+            return out
+        return batch
+    src = np.ascontiguousarray(src)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    if src.dtype == np.uint8 and u8_scale is not None:
+        if out is None:
+            out = np.empty((n,) + row_shape, np.float32)
+        lib.ptpu_gather_u8_to_f32(
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            rows.ctypes.data_as(i64p), n, row_elems,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_float(u8_scale))
+        return out
+    if src.dtype == np.float32:
+        if out is None:
+            out = np.empty((n,) + row_shape, np.float32)
+        lib.ptpu_gather_f32(
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            rows.ctypes.data_as(i64p), n, row_elems,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        return out
+    if src.dtype == np.int64:
+        if out is None:
+            out = np.empty((n,) + row_shape, np.int64)
+        lib.ptpu_gather_i64(
+            src.ctypes.data_as(i64p), rows.ctypes.data_as(i64p), n,
+            row_elems, out.ctypes.data_as(i64p))
+        return out
+    # unsupported dtype: numpy fallback
+    batch = src[rows]
+    if out is not None:
+        out[...] = batch
+        return out
+    return batch

@@ -62,6 +62,16 @@ def save(layer, path, input_spec=None, **configs):
     state = {n: np.asarray(v) for n, v in {**params, **buffers}.items()}
     with open(path + _PDPARAMS_SUFFIX, "wb") as f:
         pickle.dump(state, f, protocol=4)
+    # named input/output meta for the serving predictor
+    # (paddle_tpu.inference.create_predictor)
+    input_names = []
+    for i, spec in enumerate(input_spec):
+        name = getattr(spec, "name", None)
+        input_names.append(name if name else f"x{i}")
+    output_names = [f"out_{i}" for i in range(len(exported.out_avals))]
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump({"input_names": input_names,
+                     "output_names": output_names}, f, protocol=4)
 
 
 class TranslatedLayer(Layer):

@@ -137,18 +137,41 @@ def zeropad2d(x, padding, data_format="NCHW", name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    """Gather rows (reference lookup_table_v2). `sparse` selects the
-    SelectedRows grad path in the reference; here grads are dense — XLA
-    scatter-add handles it (documented delta, selected_rows.h:41)."""
+    """Gather rows (reference lookup_table_v2).  ``sparse=True`` selects the
+    SelectedRows grad path (selected_rows.h:41): the weight cotangent is an
+    IndexedSlices of (touched rows, row grads) — the [vocab, dim] dense
+    gradient is never materialized, and optimizers apply row-sparse updates
+    (sparse_grad.rowwise_update)."""
     x, weight = to_tensor_like(x), to_tensor_like(weight)
+    pad = None
+    if padding_idx is not None:
+        pad = padding_idx if padding_idx >= 0 else weight.shape[0] + padding_idx
 
     def f(w, idx):
         out = jnp.take(w, idx.astype(jnp.int32), axis=0)
-        if padding_idx is not None:
-            pad = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+        if pad is not None:
             mask = (idx == pad)[..., None]
             out = jnp.where(mask, 0.0, out)
         return out
+
+    from ...autograd.tape import Edge, GradNode, is_grad_enabled
+
+    if sparse and is_grad_enabled() and weight._tracked:
+        from ...ops.dispatch import wrap
+        from ...sparse_grad import IndexedSlices, embedding_sparse_vjp
+
+        out_val = f(weight._value, x._value)
+        wgrad = embedding_sparse_vjp(x._value, weight.shape[0], pad)
+        dense_shape = tuple(weight._value.shape)
+
+        def vjp_fn(ct):
+            rows, values = wgrad(ct)
+            return (IndexedSlices(rows, values, dense_shape),)
+
+        flat, treedef = jax.tree_util.tree_flatten(out_val)
+        node = GradNode("lookup_table_v2_sparse", vjp_fn, [Edge(weight)],
+                        [(out_val.shape, out_val.dtype)], treedef)
+        return wrap(out_val, node=node, index=0)
 
     return apply("lookup_table_v2", f, weight, x)
 

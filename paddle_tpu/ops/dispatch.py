@@ -212,15 +212,30 @@ def apply_vjp(node: GradNode, flat_cts: List, create_graph: bool):
         res = vjp_fn(ct_struct)
         return tuple(res)
 
+    from ..sparse_grad import IndexedSlices
+
     with no_grad():
         ct_arrays = [c._value for c in flat_cts]
         res = run(*ct_arrays)
-        return [Tensor(r, stop_gradient=True) for r in res]
+        return [r if isinstance(r, IndexedSlices)
+                else Tensor(r, stop_gradient=True) for r in res]
 
 
 def accumulate_grad(a, b, create_graph: bool):
-    """Gradient accumulation (gradient_accumulator.cc analog)."""
+    """Gradient accumulation (gradient_accumulator.cc analog).  Handles
+    row-sparse IndexedSlices grads: sparse+sparse concatenates (merged
+    lazily at update time); sparse+dense densifies."""
+    from ..sparse_grad import IndexedSlices
+
     Tensor = _tensor_cls()
+    a_sp = isinstance(a, IndexedSlices)
+    b_sp = isinstance(b, IndexedSlices)
+    if a_sp or b_sp:
+        if a_sp and b_sp:
+            return a.add(b)
+        dense = a.to_dense() if a_sp else a._value
+        other = b.to_dense() if b_sp else b._value
+        return Tensor(jnp.add(dense, other), stop_gradient=True)
     if create_graph:
         return apply("grad_accumulate", jnp.add, a, b)
     with no_grad():

@@ -183,14 +183,27 @@ class Optimizer:
 
     @no_grad()
     def step(self):
+        from ..sparse_grad import IndexedSlices
+
         params = [p for p in self._param_list() if p._grad is not None
                   and getattr(p, "trainable", True)]
         grads = [p._grad for p in params]
-        if self._grad_clip is not None:
+        # row-sparse grads (SelectedRows analog) take the lazy rowwise path
+        # and bypass global clipping (reference sparse-optimizer semantics)
+        sparse_pairs = [(p, g) for p, g in zip(params, grads)
+                        if isinstance(g, IndexedSlices)]
+        dense = [(p, g) for p, g in zip(params, grads)
+                 if not isinstance(g, IndexedSlices)]
+        params, grads = [p for p, _ in dense], [g for _, g in dense]
+        if self._grad_clip is not None and params:
             pg = self._grad_clip(list(zip(params, grads)))
             params, grads = [p for p, _ in pg], [g for _, g in pg]
         self._step_count += 1
         lr = self.get_lr()
+        for p, g in sparse_pairs:
+            p_lr = lr * p.optimize_attr.get("learning_rate", 1.0) \
+                if hasattr(p, "optimize_attr") else lr
+            self._sparse_update(p, g, p_lr)
         for p, g in zip(params, grads):
             if g is None:
                 continue
@@ -207,6 +220,22 @@ class Optimizer:
 
     def _update_param(self, p, g, lr):
         raise NotImplementedError
+
+    def _sparse_update(self, p, slices, lr):
+        """Row-sparse (lazy) update: run the dense `_rule` on the touched
+        rows only (reference adam_op.h lazy mode / sgd_op sparse kernel).
+        Regularization is not applied on the sparse path (matching the
+        reference's sparse kernels, which update grad rows only)."""
+        from ..sparse_grad import rowwise_update
+
+        kinds = self._acc_kinds()
+        accs = {k: self._acc(k, p) for k in kinds}
+        new_p, new_accs = rowwise_update(self._rule, p._value, slices, accs,
+                                         lr, self._step_count)
+        p._value = new_p
+        p._inplace_version += 1
+        for k in kinds:
+            self._set_acc(k, p, new_accs[k])
 
     def clear_grad(self, set_to_zero=True):
         if self._parameters is not None:

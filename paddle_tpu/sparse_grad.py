@@ -1,0 +1,115 @@
+"""Row-sparse gradients — the SelectedRows analog.
+
+Reference: paddle/fluid/framework/selected_rows.h:41 ({rows index vector +
+value tensor}) produced by lookup_table_v2's sparse grad kernel and consumed
+by the sparse optimizer kernels (operators/optimizers/adam_op.h lazy mode)
+and the PS sparse tables (distributed/table/common_sparse_table.cc).
+
+TPU-native: an IndexedSlices carries (rows, values) for an embedding
+gradient; optimizers apply ROW updates by gathering the touched rows of the
+parameter/accumulators, running the ordinary dense update rule on the
+[n_rows, dim] slice (pure MXU/VPU work), and scattering back — the
+[vocab, dim] dense gradient is never materialized in HBM.  Duplicate row
+ids within a batch are merged with a segment-sum (SelectedRows::Merge
+analog) so the update is exact.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class IndexedSlices:
+    """Sparse gradient: values[i] is the grad of row rows[i] of a
+    [dense_shape[0], ...] parameter."""
+
+    __slots__ = ("rows", "values", "dense_shape", "stop_gradient")
+
+    def __init__(self, rows, values, dense_shape):
+        self.rows = rows              # int32 [N]
+        self.values = values          # [N, *dense_shape[1:]]
+        self.dense_shape = tuple(dense_shape)
+        self.stop_gradient = True
+
+    # minimal Tensor-compatible surface for the autograd tape
+    def detach(self):
+        return self
+
+    @property
+    def shape(self):
+        return list(self.dense_shape)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def __repr__(self):
+        return (f"IndexedSlices(nnz_rows={self.rows.shape[0]}, "
+                f"dense_shape={self.dense_shape})")
+
+    # --- conversions -------------------------------------------------------
+    def to_dense(self):
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.rows].add(self.values, mode="drop")
+
+    def merged(self) -> "IndexedSlices":
+        """Merge duplicate rows (SelectedRows::Merge): unique row ids with
+        segment-summed values.  Shapes stay static (jnp.unique with a fixed
+        size = nnz rows); padding slots get an OUT-OF-BOUNDS row id
+        (= dense_shape[0]) so scatters drop them — they must not alias a
+        real row, which 'pad with 0' would."""
+        n = self.rows.shape[0]
+        rows, inv = jnp.unique(self.rows, return_inverse=True,
+                               size=n, fill_value=-1)
+        summed = jax.ops.segment_sum(self.values, inv.reshape(-1),
+                                     num_segments=n)
+        valid = rows >= 0
+        rows = jnp.where(valid, rows, self.dense_shape[0])
+        summed = jnp.where(valid[:, None], summed, 0.0)
+        return IndexedSlices(rows, summed, self.dense_shape)
+
+    def add(self, other) -> "IndexedSlices":
+        """Accumulate with another IndexedSlices (concat) or return a dense
+        sum when mixed with a dense array."""
+        if isinstance(other, IndexedSlices):
+            return IndexedSlices(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]),
+                self.dense_shape)
+        return self.to_dense() + other
+
+
+def embedding_sparse_vjp(idx, vocab_size, padding_idx=None):
+    """Build the weight-cotangent function for a sparse embedding lookup:
+    ct [*, dim] → IndexedSlices(rows=flat ids, values=flat cts)."""
+    flat_idx = idx.reshape(-1).astype(jnp.int32)
+
+    def wgrad(ct):
+        values = ct.reshape(flat_idx.shape[0], -1)
+        if padding_idx is not None:
+            keep = flat_idx != padding_idx
+            values = jnp.where(keep[:, None], values, 0.0)
+        return flat_idx, values
+
+    return wgrad
+
+
+def rowwise_update(rule, p_value, slices: "IndexedSlices", accs: dict,
+                   lr, step) -> Tuple[jax.Array, dict]:
+    """Apply a dense optimizer `_rule` to ONLY the touched rows (reference
+    lazy/sparse optimizer kernels): gather rows of param + accumulators, run
+    the rule on the [n, dim] slice, scatter results back."""
+    m = slices.merged()
+    rows = m.rows                      # padding slots are out-of-bounds
+    gather_rows = jnp.minimum(rows, slices.dense_shape[0] - 1)
+    p_rows = p_value[gather_rows]
+    acc_rows = {k: v[gather_rows] for k, v in accs.items()}
+    new_rows, new_acc_rows = rule(p_rows, m.values.astype(p_rows.dtype),
+                                  acc_rows, lr, step)
+    # merged() deduplicates; padding slots scatter out-of-bounds → dropped
+    new_p = p_value.at[rows].set(new_rows, mode="drop")
+    new_accs = {k: accs[k].at[rows].set(new_acc_rows[k], mode="drop")
+                for k in accs}
+    return new_p, new_accs
