@@ -16,7 +16,7 @@ from ...ops.dispatch import apply
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
                momentum=0.9, epsilon=1e-05, data_format="NCHW", use_global_stats=None,
-               name=None):
+               act=None, name=None):
     """Functional batch norm.
 
     In training mode also *updates* running_mean/running_var in place (host-side
@@ -48,24 +48,45 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
             out = out + shape_c(wb[i].astype(jnp.float32), v_ndim)
         return out
 
+    from ...ops.fused_norm import bn_train_fused, fold_scale_shift
+
+    if act not in (None, "relu"):
+        raise ValueError(f"batch_norm act must be None or 'relu', got {act!r}")
+
+    def _unpack(wb):
+        i = 0
+        w_arr = wb[i] if has_w else None
+        i += 1 if has_w else 0
+        b_arr = wb[i] if has_b else None
+        return w_arr, b_arr
+
     if use_global_stats:
+        # fold stats+affine into per-channel scale/shift (f32): the big
+        # activation tensor is touched by ONE low-precision multiply-add —
+        # batch_norm_op.cu/cuDNN fuse the same way; helper shared with the
+        # training op so the two paths cannot diverge
         def f_infer(v, m, var, *wb):
+            w_arr, b_arr = _unpack(wb)
             inv = jax.lax.rsqrt(var.astype(jnp.float32) + epsilon)
-            out = (v.astype(jnp.float32) - shape_c(m.astype(jnp.float32), v.ndim)) * shape_c(inv, v.ndim)
-            return _affine(out, v.ndim, wb).astype(v.dtype)
+            scale, shift = fold_scale_shift(m.astype(jnp.float32), inv,
+                                            w_arr, b_arr)
+            out = (v * shape_c(scale, v.ndim).astype(v.dtype)
+                   + shape_c(shift, v.ndim).astype(v.dtype))
+            if act == "relu":
+                out = jnp.maximum(out, 0)
+            return out
 
         return apply("batch_norm", f_infer, x, rm, rv, *extra)
 
-    # training: batch statistics
-    def f_train(v, *wb):
-        vf = v.astype(jnp.float32)
-        m = jnp.mean(vf, axis=axes)
-        var = jnp.var(vf, axis=axes)
-        inv = jax.lax.rsqrt(var + epsilon)
-        out = (vf - shape_c(m, v.ndim)) * shape_c(inv, v.ndim)
-        return _affine(out, v.ndim, wb).astype(v.dtype), m, var
+    # training: batch statistics via the fused custom-VJP op — minimal HBM
+    # passes fwd and bwd (ops/fused_norm.py); the running mean acts as the
+    # single-pass variance pivot (stop-gradient inside the op)
+    def f_train(v, pivot, *wb):
+        w_arr, b_arr = _unpack(wb)
+        return bn_train_fused(v, w_arr, b_arr, axes, ch_axis, epsilon,
+                              relu=(act == "relu"), pivot=pivot)
 
-    out, m, var = apply("batch_norm", f_train, x, *extra)
+    out, m, var = apply("batch_norm", f_train, x, rm, *extra)
 
     # update running stats in place (detached)
     from ...autograd.tape import no_grad

@@ -10,13 +10,19 @@ from .layer import Layer
 
 
 class _BatchNormBase(Layer):
+    """``act='relu'`` fuses the activation into the norm's custom VJP (the
+    reference's fluid.layers.batch_norm(act=...) — a real traffic win on
+    TPU, see ops/fused_norm.py)."""
+
     def __init__(self, num_features, momentum=0.9, epsilon=1e-05, weight_attr=None,
-                 bias_attr=None, data_format="NCHW", use_global_stats=None, name=None):
+                 bias_attr=None, data_format="NCHW", use_global_stats=None,
+                 act=None, name=None):
         super().__init__()
         self._num_features = num_features
         self._momentum, self._epsilon = momentum, epsilon
         self._data_format = data_format
         self._use_global_stats = use_global_stats
+        self._fused_act = act
         if weight_attr is False:
             self.weight = None
         else:
@@ -36,7 +42,8 @@ class _BatchNormBase(Layer):
         return F.batch_norm(
             x, self._mean, self._variance, self.weight, self.bias,
             training=self.training, momentum=self._momentum, epsilon=self._epsilon,
-            data_format=self._data_format, use_global_stats=self._use_global_stats)
+            data_format=self._data_format, use_global_stats=self._use_global_stats,
+            act=self._fused_act)
 
 
 class BatchNorm(_BatchNormBase):
@@ -52,10 +59,11 @@ class BatchNorm(_BatchNormBase):
         self._act = act
 
     def forward(self, x):
+        if self._act in (None, "relu"):
+            self._fused_act = self._act
+            return super().forward(x)
         out = super().forward(x)
-        if self._act:
-            out = getattr(F, self._act)(out)
-        return out
+        return getattr(F, self._act)(out)
 
 
 class BatchNorm1D(_BatchNormBase):
@@ -83,7 +91,9 @@ class SyncBatchNorm(_BatchNormBase):
             layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
         if isinstance(layer, _BatchNormBase) and not isinstance(layer, SyncBatchNorm):
             new = SyncBatchNorm(layer._num_features, layer._momentum, layer._epsilon,
-                                data_format=layer._data_format)
+                                data_format=layer._data_format,
+                                use_global_stats=layer._use_global_stats,
+                                act=layer._fused_act)
             new.weight = layer.weight
             new.bias = layer.bias
             new._mean = layer._mean

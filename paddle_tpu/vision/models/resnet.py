@@ -5,25 +5,57 @@ from __future__ import annotations
 from ... import nn
 
 
+def _norm_kwargs(norm_layer, df, act=None):
+    """Keyword args norm_layer actually accepts (custom norm callables may
+    take neither data_format nor act)."""
+    import inspect
+
+    try:
+        sig = inspect.signature(norm_layer)
+        params = sig.parameters
+        has_kw = any(p.kind == inspect.Parameter.VAR_KEYWORD
+                     for p in params.values())
+    except (TypeError, ValueError):
+        params, has_kw = {}, False
+    kw = {}
+    if "data_format" in params or has_kw:
+        kw["data_format"] = df
+    if act is not None and ("act" in params or has_kw):
+        kw["act"] = act
+    return kw
+
+
+def _norm(norm_layer, ch, df, act=None):
+    """Build a norm layer, fusing a following ReLU into it when the layer
+    supports it (BN+ReLU is one custom-VJP op on TPU — fluid's
+    batch_norm(act='relu') analog).  Returns (layer, relu_was_fused)."""
+    layer = norm_layer(ch, **_norm_kwargs(norm_layer, df, act))
+    return layer, act is not None and getattr(layer, "_fused_act", None) == act
+
+
 class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
-                 base_width=64, dilation=1, norm_layer=None):
+                 base_width=64, dilation=1, norm_layer=None, data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
+        df = data_format
         self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1,
-                               bias_attr=False)
-        self.bn1 = norm_layer(planes)
+                               bias_attr=False, data_format=df)
+        self.bn1, self._fused1 = _norm(norm_layer, planes, df, act="relu")
         self.relu = nn.ReLU()
-        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False)
-        self.bn2 = norm_layer(planes)
+        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False,
+                               data_format=df)
+        self.bn2, _ = _norm(norm_layer, planes, df)
         self.downsample = downsample
         self.stride = stride
 
     def forward(self, x):
         identity = x
-        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.bn1(self.conv1(x))
+        if not self._fused1:
+            out = self.relu(out)
         out = self.bn2(self.conv2(out))
         if self.downsample is not None:
             identity = self.downsample(x)
@@ -34,25 +66,32 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
-                 base_width=64, dilation=1, norm_layer=None):
+                 base_width=64, dilation=1, norm_layer=None, data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
+        df = data_format
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
-        self.bn1 = norm_layer(width)
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False, data_format=df)
+        self.bn1, self._fused1 = _norm(norm_layer, width, df, act="relu")
         self.conv2 = nn.Conv2D(width, width, 3, padding=dilation, stride=stride,
-                               groups=groups, dilation=dilation, bias_attr=False)
-        self.bn2 = norm_layer(width)
-        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1, bias_attr=False)
-        self.bn3 = norm_layer(planes * self.expansion)
+                               groups=groups, dilation=dilation, bias_attr=False,
+                               data_format=df)
+        self.bn2, self._fused2 = _norm(norm_layer, width, df, act="relu")
+        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1, bias_attr=False,
+                               data_format=df)
+        self.bn3, _ = _norm(norm_layer, planes * self.expansion, df)
         self.relu = nn.ReLU()
         self.downsample = downsample
         self.stride = stride
 
     def forward(self, x):
         identity = x
-        out = self.relu(self.bn1(self.conv1(x)))
-        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.bn1(self.conv1(x))
+        if not self._fused1:
+            out = self.relu(out)
+        out = self.bn2(self.conv2(out))
+        if not self._fused2:
+            out = self.relu(out)
         out = self.bn3(self.conv3(out))
         if self.downsample is not None:
             identity = self.downsample(x)
@@ -60,8 +99,12 @@ class BottleneckBlock(nn.Layer):
 
 
 class ResNet(nn.Layer):
+    """ResNet (reference resnet.py:151). TPU extension: `data_format="NHWC"`
+    runs the whole network channel-last — the layout the v5e MXU/VMEM tiling
+    wants — with a single input transpose handled by the caller."""
+
     def __init__(self, block, depth=50, width=64, num_classes=1000,
-                 with_pool=True, groups=1):
+                 with_pool=True, groups=1, data_format="NCHW"):
         super().__init__()
         layer_cfg = {
             18: [2, 2, 2, 2],
@@ -78,41 +121,48 @@ class ResNet(nn.Layer):
         self._norm_layer = nn.BatchNorm2D
         self.inplanes = 64
         self.dilation = 1
+        self.data_format = data_format
 
+        df = data_format
         self.conv1 = nn.Conv2D(3, self.inplanes, kernel_size=7, stride=2,
-                               padding=3, bias_attr=False)
-        self.bn1 = self._norm_layer(self.inplanes)
+                               padding=3, bias_attr=False, data_format=df)
+        self.bn1, self._fused1 = _norm(self._norm_layer, self.inplanes, df,
+                                       act="relu")
         self.relu = nn.ReLU()
-        self.maxpool = nn.MaxPool2D(kernel_size=3, stride=2, padding=1)
+        self.maxpool = nn.MaxPool2D(kernel_size=3, stride=2, padding=1,
+                                    data_format=df)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1), data_format=df)
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
     def _make_layer(self, block, planes, blocks, stride=1, dilate=False):
         norm_layer = self._norm_layer
+        df = self.data_format
         downsample = None
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1,
-                          stride=stride, bias_attr=False),
-                norm_layer(planes * block.expansion),
+                          stride=stride, bias_attr=False, data_format=df),
+                _norm(norm_layer, planes * block.expansion, df)[0],
             )
         layers = [block(self.inplanes, planes, stride, downsample, self.groups,
-                        self.base_width, 1, norm_layer)]
+                        self.base_width, 1, norm_layer, data_format=df)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes, groups=self.groups,
                                 base_width=self.base_width,
-                                norm_layer=norm_layer))
+                                norm_layer=norm_layer, data_format=df))
         return nn.Sequential(*layers)
 
     def forward(self, x):
-        x = self.relu(self.bn1(self.conv1(x)))
+        x = self.bn1(self.conv1(x))
+        if not self._fused1:
+            x = self.relu(x)
         x = self.maxpool(x)
         x = self.layer1(x)
         x = self.layer2(x)

@@ -1,0 +1,106 @@
+"""Fused batch-norm custom-VJP op (ops/fused_norm.py): forward/backward
+parity with naive autodiff, pivot stability, fused-ReLU gate, and the
+BatchNorm2D(act='relu') layer path."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.ops.fused_norm import bn_train_fused
+
+AXES, CH, EPS = (0, 1, 2), 3, 1e-5
+
+
+def _ref(x, w, b):
+    m = jnp.mean(x, axis=AXES)
+    v = jnp.var(x, axis=AXES)
+    return ((x - m) * jax.lax.rsqrt(v + EPS)) * w + b
+
+
+@pytest.fixture
+def data():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 5, 5, 8).astype(np.float32))
+    w = jnp.asarray(rng.randn(8).astype(np.float32))
+    b = jnp.asarray(rng.randn(8).astype(np.float32))
+    g = jnp.asarray(rng.randn(4, 5, 5, 8).astype(np.float32))
+    return x, w, b, g
+
+
+def test_forward_matches_reference(data):
+    x, w, b, _ = data
+    out, m, var = bn_train_fused(x, w, b, AXES, CH, EPS)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(x, w, b)),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(m), np.asarray(jnp.mean(x, axis=AXES)),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(var), np.asarray(jnp.var(x, axis=AXES)),
+                               atol=1e-5)
+
+
+def test_backward_matches_autodiff(data):
+    x, w, b, g = data
+    l_ref = lambda *a: jnp.sum(_ref(*a) * g)
+    l_fus = lambda *a: jnp.sum(bn_train_fused(*a, AXES, CH, EPS)[0] * g)
+    g1 = jax.grad(l_ref, argnums=(0, 1, 2))(x, w, b)
+    g2 = jax.grad(l_fus, argnums=(0, 1, 2))(x, w, b)
+    for a, bb in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=1e-4)
+
+
+def test_relu_fusion_matches_separate(data):
+    x, w, b, g = data
+    l_ref = lambda *a: jnp.sum(jnp.maximum(_ref(*a), 0) * g)
+    l_fus = lambda *a: jnp.sum(
+        bn_train_fused(*a, AXES, CH, EPS, relu=True)[0] * g)
+    np.testing.assert_allclose(float(l_ref(x, w, b)), float(l_fus(x, w, b)),
+                               rtol=1e-5)
+    g1 = jax.grad(l_ref, argnums=(0, 1, 2))(x, w, b)
+    g2 = jax.grad(l_fus, argnums=(0, 1, 2))(x, w, b)
+    for a, bb in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=1e-4)
+
+
+def test_pivot_stabilizes_large_mean(data):
+    _, w, b, _ = data
+    rng = np.random.RandomState(1)
+    x = jnp.asarray((rng.randn(4, 5, 5, 8) + 3000.0).astype(np.float32))
+    pivot = jnp.full((8,), 3000.0, jnp.float32)
+    out = bn_train_fused(x, w, b, AXES, CH, EPS, pivot=pivot)[0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(x, w, b)),
+                               atol=1e-2)
+
+
+def test_no_affine():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(2, 3, 3, 4).astype(np.float32))
+    out, _, _ = bn_train_fused(x, None, None, AXES, CH, EPS)
+    ref = (x - jnp.mean(x, axis=AXES)) * jax.lax.rsqrt(
+        jnp.var(x, axis=AXES) + EPS)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_layer_act_relu_matches_separate():
+    paddle.seed(0)
+    bn_fused = nn.BatchNorm2D(6, act="relu")
+    paddle.seed(0)
+    bn_plain = nn.BatchNorm2D(6)
+    x = paddle.to_tensor(
+        np.random.RandomState(3).randn(2, 6, 5, 5).astype(np.float32))
+    a = bn_fused(x)
+    bmp = bn_plain(x)
+    b = paddle.nn.functional.relu(bmp)
+    np.testing.assert_allclose(np.asarray(a._value), np.asarray(b._value),
+                               atol=1e-5)
+    # running stats updated identically
+    np.testing.assert_allclose(np.asarray(bn_fused._mean._value),
+                               np.asarray(bn_plain._mean._value), atol=1e-6)
+
+
+def test_sync_convert_preserves_act():
+    m = nn.BatchNorm2D(4, act="relu")
+    s = nn.SyncBatchNorm.convert_sync_batchnorm(m)
+    assert isinstance(s, nn.SyncBatchNorm)
+    assert s._fused_act == "relu"
