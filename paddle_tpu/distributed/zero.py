@@ -1,0 +1,74 @@
+"""Manual-collective ZeRO (stage 1/2) optimizer sharding for shard_map code.
+
+Reference analog: fleet/meta_optimizers/sharding_optimizer.py:161,224,308 —
+params assigned to shards, gradients allreduced, each rank updating its slice
+then broadcasting.  The GSPMD path (fleet/sharding.py here) lets XLA derive
+that pattern from NamedShardings; THIS module is the explicit version for
+code running inside ``shard_map`` (e.g. combined with pipeline/tensor axes
+where GSPMD propagation is unavailable):
+
+  grads --psum_scatter('dp')--> per-rank chunk   (ZeRO-2: grad shard)
+  chunk + sharded Adam state  --> updated param chunk
+  chunk --all_gather('dp')--> full new param     (ZeRO-1: state shard)
+
+Every rank holds 1/dp of the optimizer state; HBM for Adam m/v drops by dp×.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _chunk_len(size: int, n: int) -> int:
+    """Per-rank chunk length for a flattened param of `size` over n ranks.
+    Callers build the [_leading axes_, axis_size, chunk] state arrays
+    themselves (the leading dims depend on how the param is sharded over
+    other mesh axes — see hybrid_step.make_hybrid_step)."""
+    return -(-size // n)
+
+
+def zero_adam_update(params, grads, state, count, axis_name: str,
+                     axis_size: int, lr=1e-3, beta1=0.9, beta2=0.999,
+                     eps=1e-8, weight_decay=0.0,
+                     grad_mean: bool = True) -> Tuple[dict, dict]:
+    """Per-rank ZeRO update, called INSIDE shard_map.
+
+    params/grads: full (replicated-view) pytrees of this rank.
+    state: local slice of init_zero_adam_state (leading dim 1 after
+      sharding over axis_name) — {'m': {...}, 'v': {...}}.
+    Returns (new_params_full, new_state_local).
+    """
+    new_params, new_m, new_v = {}, {}, {}
+    b1c = 1.0 - beta1 ** count.astype(jnp.float32)
+    b2c = 1.0 - beta2 ** count.astype(jnp.float32)
+    for name, p in params.items():
+        g = grads[name]
+        size = int(np.prod(p.shape))
+        c = _chunk_len(size, axis_size)
+        pad = axis_size * c - size
+        gf = jnp.pad(g.reshape(-1).astype(jnp.float32), (0, pad))
+        # reduce-scatter: each rank receives the SUM of its chunk
+        g_chunk = jax.lax.psum_scatter(gf.reshape(axis_size, c), axis_name,
+                                       scatter_dimension=0, tiled=False)
+        if grad_mean:
+            g_chunk = g_chunk / axis_size
+        pf = jnp.pad(jax.lax.stop_gradient(p).reshape(-1).astype(jnp.float32),
+                     (0, pad))
+        idx = jax.lax.axis_index(axis_name)
+        p_chunk = jax.lax.dynamic_slice(pf, (idx * c,), (c,))
+        if weight_decay:
+            g_chunk = g_chunk + weight_decay * p_chunk
+        m = state["m"][name].reshape(-1)
+        v = state["v"][name].reshape(-1)
+        m = beta1 * m + (1 - beta1) * g_chunk
+        v = beta2 * v + (1 - beta2) * g_chunk * g_chunk
+        update = (m / b1c) / (jnp.sqrt(v / b2c) + eps)
+        p_new_chunk = p_chunk - lr * update
+        full = jax.lax.all_gather(p_new_chunk, axis_name, tiled=True)
+        new_params[name] = full[:size].reshape(p.shape).astype(p.dtype)
+        new_m[name] = m.reshape(state["m"][name].shape)
+        new_v[name] = v.reshape(state["v"][name].shape)
+    return new_params, {"m": new_m, "v": new_v}

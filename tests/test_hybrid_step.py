@@ -1,0 +1,98 @@
+"""Hybrid dp×pp×mp + ZeRO step (distributed/hybrid_step.py) must match a
+single-device reference implementation of the same model + Adam exactly."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from paddle_tpu.distributed.hybrid_step import make_hybrid_step
+
+VOCAB, D, F, K, T = 64, 32, 64, 4, 8
+LR = 1e-2
+
+
+def _mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    devs = np.asarray(jax.devices()[:8]).reshape(2, 2, 2)
+    return Mesh(devs, ("dp", "pp", "mp"))
+
+
+def _ref_step_factory(params0):
+    """Single-device reference: same math (2 pipeline stages sequential),
+    plain Adam (matching zero_adam_update's bias-corrected rule)."""
+    p = {k: np.asarray(v, np.float64) for k, v in params0.items()}
+    m = {k: np.zeros_like(v) for k, v in p.items()}
+    v_ = {k: np.zeros_like(v) for k, v in p.items()}
+    t = [0]
+
+    def step(x, y):
+        jp = {k: jnp.asarray(v) for k, v in p.items()}
+
+        def jloss(jpp):
+            e = jpp["emb"][x]
+            h = e
+            for s in range(2):
+                a = jax.nn.gelu(
+                    jnp.einsum("btd,df->btf", h, jpp["w1"][s]) + jpp["b1"][s])
+                h = h + jnp.einsum("btf,fd->btd", a, jpp["w2"][s]) + jpp["b2"][s]
+            pooled = h.mean(axis=1)
+            logits = pooled @ jpp["head"]
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            ll = jnp.take_along_axis(logits, y[:, None], axis=-1)[:, 0]
+            return jnp.mean(lse - ll)
+
+        lval, g = jax.value_and_grad(jloss)(jp)
+        t[0] += 1
+        b1c = 1 - 0.9 ** t[0]
+        b2c = 1 - 0.999 ** t[0]
+        for k in p:
+            gk = np.asarray(g[k], np.float64)
+            m[k] = 0.9 * m[k] + 0.1 * gk
+            v_[k] = 0.999 * v_[k] + 0.001 * gk * gk
+            p[k] = p[k] - LR * (m[k] / b1c) / (np.sqrt(v_[k] / b2c) + 1e-8)
+        return float(lval)
+
+    return step
+
+
+def test_hybrid_matches_reference():
+    mesh = _mesh()
+    step, state = make_hybrid_step(mesh, vocab=VOCAB, d_model=D, d_ff=F,
+                                   n_classes=K, seq=T, micro_batch=1, lr=LR,
+                                   seed=0)
+    params0 = {k: np.asarray(v) for k, v in state[0].items()}
+    # reference sees the same initial params; squeeze nothing (w1 has [pp,...])
+    ref = _ref_step_factory(params0)
+
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randint(0, VOCAB, (4, T)), jnp.int32)
+    y = jnp.asarray(rng.randint(0, K, (4,)), jnp.int32)
+
+    for i in range(4):
+        state, loss = step(state, x, y)
+        ref_loss = ref(x, y)
+        assert np.isfinite(float(loss))
+        np.testing.assert_allclose(float(loss), ref_loss, rtol=2e-4,
+                                   err_msg=f"step {i}")
+
+    # ZeRO state really is dp-sharded: m chunks sum to the dense moment shape
+    zm = state[1]["m"]["emb"]
+    assert zm.shape[-2] == 2  # dp chunks present
+
+
+def test_hybrid_loss_decreases_multi_step():
+    mesh = _mesh()
+    step, state = make_hybrid_step(mesh, vocab=VOCAB, d_model=D, d_ff=F,
+                                   n_classes=K, seq=T, micro_batch=2, lr=2e-2,
+                                   seed=3)
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randint(0, VOCAB, (8, T)), jnp.int32)
+    y = jnp.asarray(rng.randint(0, K, (8,)), jnp.int32)
+    losses = []
+    for _ in range(6):
+        state, loss = step(state, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9, losses
