@@ -74,6 +74,7 @@ from . import jit  # noqa: F401
 from . import metric  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import slim  # noqa: F401
 from . import static  # noqa: F401
 from . import text  # noqa: F401
 from . import utils  # noqa: F401

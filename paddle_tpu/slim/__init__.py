@@ -1,0 +1,27 @@
+"""paddle.fluid.contrib.slim analog — model compression (quantization).
+
+Reference: /root/reference/python/paddle/fluid/contrib/slim/quantization/
+  imperative/qat.py:40   ImperativeQuantAware (dygraph QAT)
+  imperative/quant_nn.py FakeQuant*/Quantized* layers
+  post_training_quantization.py:121 PostTrainingQuantization (PTQ)
+  quantization_pass.py:1069 QuantizationFreezePass (-> int8 inference)
+
+TPU-native design: fake-quant runs as jax ops with a straight-through
+estimator; the frozen int8 path computes real s8×s8→s32 matmuls on the MXU
+via lax.dot_general(preferred_element_type=int32).
+"""
+from .quant_layers import (FakeQuantAbsMax, FakeChannelWiseQuantAbsMax,
+                           FakeQuantMovingAverage, MovingAverageAbsMaxScale,
+                           QuantizedConv2D, QuantizedLinear,
+                           quant_dequant_abs_max)
+from .qat import ImperativeQuantAware
+from .ptq import PostTrainingQuantization, quantize_for_inference
+from .int8_layers import Int8Linear, Int8Conv2D
+
+__all__ = [
+    "ImperativeQuantAware", "PostTrainingQuantization",
+    "quantize_for_inference", "FakeQuantAbsMax",
+    "FakeChannelWiseQuantAbsMax", "FakeQuantMovingAverage",
+    "MovingAverageAbsMaxScale", "QuantizedConv2D", "QuantizedLinear",
+    "Int8Linear", "Int8Conv2D", "quant_dequant_abs_max",
+]

@@ -1,0 +1,140 @@
+"""Frozen int8 inference layers (reference: QuantizationFreezePass
+quantization_pass.py:1069 + ConvertToInt8Pass :1388 — fake-quant graphs
+rewritten to real int8 kernels).
+
+TPU-native: s8×s8→s32 runs on the MXU via
+lax.dot_general/conv_general_dilated with preferred_element_type=int32;
+per-channel weight scales and a per-tensor input scale dequantize the
+accumulator in one epilogue multiply.  ``compute='simulate'`` dequantizes to
+f32 before the contraction (same numerics, for backends without s8 kernels).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from ..ops._helpers import to_tensor_like
+from ..ops.dispatch import apply
+from ..tensor import Tensor
+
+
+def _quantize_weight(w, channel_axis, bits=8):
+    """-> (int8 weights, per-channel f32 dequant scales)."""
+    qmax = float(2 ** (bits - 1) - 1)
+    w = np.asarray(w)
+    axes = tuple(i for i in range(w.ndim) if i != channel_axis)
+    scale = np.maximum(np.abs(w).max(axis=axes), 1e-9)
+    shape = [1] * w.ndim
+    shape[channel_axis] = -1
+    q = np.clip(np.round(w / scale.reshape(shape) * qmax), -qmax, qmax)
+    return q.astype(np.int8), (scale / qmax).astype(np.float32)
+
+
+class Int8Linear(Layer):
+    """y = dequant(q(x) @ q(W)) + b with the matmul in s8 on the MXU."""
+
+    def __init__(self, linear, in_scale, weight_bits=8, act_bits=8,
+                 compute="int8", bits=None):
+        super().__init__()
+        if bits is not None:  # legacy single-bits arg
+            weight_bits = act_bits = bits
+        qw, wscale = _quantize_weight(np.asarray(linear.weight._value),
+                                      channel_axis=1, bits=weight_bits)
+        self.register_buffer("qweight", Tensor(jnp.asarray(qw)))
+        self.register_buffer("wscale", Tensor(jnp.asarray(wscale)))
+        self.bias = linear.bias
+        self._qmax = float(2 ** (act_bits - 1) - 1)
+        self._s_in = float(in_scale) / self._qmax
+        self._compute = compute
+
+    def forward(self, x):
+        x = to_tensor_like(x)
+        s_in, qmax, compute = self._s_in, self._qmax, self._compute
+
+        def f(v, qw, ws, *b):
+            xq = jnp.clip(jnp.round(v.astype(jnp.float32) / s_in),
+                          -qmax, qmax).astype(jnp.int8)
+            if compute == "int8":
+                acc = jax.lax.dot_general(
+                    xq, qw, (((v.ndim - 1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                out = acc.astype(jnp.float32) * (s_in * ws)
+            else:
+                out = (xq.astype(jnp.float32) * s_in) @ (
+                    qw.astype(jnp.float32) * ws)
+            if b:
+                out = out + b[0].astype(jnp.float32)
+            return out.astype(v.dtype)
+
+        args = [x, self.qweight, self.wscale]
+        if self.bias is not None:
+            args.append(self.bias)
+        return apply("int8_linear", f, *args)
+
+
+class Int8Conv2D(Layer):
+    """Conv2D with s8 weights/inputs, s32 accumulation, f32 epilogue."""
+
+    def __init__(self, conv, in_scale, weight_bits=8, act_bits=8,
+                 compute="int8", bits=None):
+        super().__init__()
+        if bits is not None:
+            weight_bits = act_bits = bits
+        from ..nn.functional.conv import (_dim_numbers, _norm_padding,
+                                          _norm_tuple, _weight_perm)
+
+        qw, wscale = _quantize_weight(np.asarray(conv.weight._value),
+                                      channel_axis=0, bits=weight_bits)
+        channel_last = conv._data_format == "NHWC"
+        wperm = _weight_perm(2, channel_last)
+        if wperm:  # store pre-transposed: no per-forward relayout
+            qw = np.transpose(qw, wperm)
+        self.register_buffer("qweight", Tensor(jnp.asarray(qw)))
+        self.register_buffer("wscale", Tensor(jnp.asarray(wscale)))
+        self.bias = conv.bias
+        self._qmax = float(2 ** (act_bits - 1) - 1)
+        self._s_in = float(in_scale) / self._qmax
+        self._compute = compute
+        self._groups = conv._groups
+        self._channel_last = channel_last
+        self._stride = _norm_tuple(conv._stride, 2)
+        self._dilation = _norm_tuple(conv._dilation, 2)
+        ksize = conv.weight.shape[2:]
+        self._pad = _norm_padding(conv._padding, 2, self._stride,
+                                  self._dilation, ksize)
+        self._dn = _dim_numbers(2, channel_last)
+
+    def forward(self, x):
+        x = to_tensor_like(x)
+        s_in, qmax, compute = self._s_in, self._qmax, self._compute
+        channel_last = self._channel_last
+        stride, dilation = self._stride, self._dilation
+        pad, dn, groups = self._pad, self._dn, self._groups
+
+        def f(v, qw, ws, *b):
+            xq = jnp.clip(jnp.round(v.astype(jnp.float32) / s_in),
+                          -qmax, qmax).astype(jnp.int8)
+            if compute == "int8":
+                lhs, rhs, acc_t = xq, qw, jnp.int32
+            else:
+                lhs = xq.astype(jnp.float32)
+                rhs = qw.astype(jnp.float32)
+                acc_t = jnp.float32
+            acc = jax.lax.conv_general_dilated(
+                lhs, rhs, window_strides=stride, padding=pad,
+                rhs_dilation=dilation, dimension_numbers=dn,
+                feature_group_count=groups, preferred_element_type=acc_t)
+            cshape = [1] * acc.ndim
+            cshape[-1 if channel_last else 1] = -1
+            out = acc.astype(jnp.float32) * (s_in * ws.reshape(cshape))
+            if b:
+                out = out + b[0].astype(jnp.float32).reshape(cshape)
+            return out.astype(v.dtype)
+
+        args = [x, self.qweight, self.wscale]
+        if self.bias is not None:
+            args.append(self.bias)
+        return apply("int8_conv2d", f, *args)

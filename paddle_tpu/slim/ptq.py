@@ -1,0 +1,261 @@
+"""Post-training quantization (reference: slim/quantization/
+post_training_quantization.py:121 PostTrainingQuantization — calibrate
+activation scales over sample data with abs_max/avg/hist/mse/KL, quantize
+weights per-channel, emit an int8 inference model; :919 WeightQuantization).
+
+TPU flow: run the eval model over calibration batches with input-recording
+hooks on every quantizable layer, derive scales, then swap the layers for
+Int8Conv2D/Int8Linear (real s8 MXU kernels) — the result feeds jit.save /
+the Predictor directly."""
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+import numpy as np
+
+from .. import nn
+from .int8_layers import Int8Conv2D, Int8Linear
+
+_SUPPORTED_ALGOS = ("abs_max", "avg", "hist", "mse", "KL")
+_HIST_BINS = 2048
+
+
+class _Collector:
+    """Per-layer activation statistics accumulated over calibration."""
+
+    def __init__(self, algo):
+        self.algo = algo
+        self.abs_maxes = []
+        self.hist = None
+        self.hist_max = None
+        self.samples = []
+
+    def update(self, x):
+        a = np.abs(np.asarray(x, np.float32))
+        amax = float(a.max()) if a.size else 0.0
+        self.abs_maxes.append(amax)
+        if self.algo in ("hist", "KL"):
+            if self.hist is None or amax > self.hist_max:
+                # grow the range; fold the old histogram in approximately
+                new_max = max(amax, self.hist_max or 0.0, 1e-9)
+                new_hist = np.zeros(_HIST_BINS, np.float64)
+                if self.hist is not None:
+                    old_edges = (np.arange(_HIST_BINS) + 0.5) * (
+                        self.hist_max / _HIST_BINS)
+                    idx = np.minimum(
+                        (old_edges / new_max * _HIST_BINS).astype(int),
+                        _HIST_BINS - 1)
+                    np.add.at(new_hist, idx, self.hist)
+                self.hist, self.hist_max = new_hist, new_max
+            # clip into the top bin so no sample mass is dropped (reference
+            # collects with a fixed abs-max range the same way)
+            h, _ = np.histogram(np.minimum(a.ravel(), self.hist_max),
+                                bins=_HIST_BINS, range=(0, self.hist_max))
+            self.hist += h
+        if self.algo == "mse":
+            flat = a.ravel()
+            if flat.size > 4096:
+                flat = flat[:: max(1, flat.size // 4096)][:4096]
+            self.samples.append(flat)
+
+    def scale(self, hist_percent=0.99999, bits=8):
+        if not self.abs_maxes:
+            return 1.0
+        if self.algo == "abs_max":
+            return max(max(self.abs_maxes), 1e-9)
+        if self.algo == "avg":
+            return max(float(np.mean(self.abs_maxes)), 1e-9)
+        if self.algo == "hist":
+            c = np.cumsum(self.hist)
+            if c[-1] <= 0:
+                return max(max(self.abs_maxes), 1e-9)
+            idx = int(np.searchsorted(c, c[-1] * hist_percent))
+            return max((idx + 0.5) / _HIST_BINS * self.hist_max, 1e-9)
+        if self.algo == "mse":
+            sample = np.concatenate(self.samples) if self.samples else \
+                np.asarray([1.0])
+            amax = max(max(self.abs_maxes), 1e-9)
+            qmax = 2 ** (bits - 1) - 1
+            best, best_s = None, amax
+            for frac in np.linspace(0.1, 1.0, 19):
+                s = amax * frac
+                q = np.clip(np.round(sample / s * qmax), -qmax, qmax)
+                err = float(np.mean((q / qmax * s - sample) ** 2))
+                if best is None or err < best:
+                    best, best_s = err, s
+            return best_s
+        if self.algo == "KL":
+            return self._kl_scale(bits)
+        raise ValueError(self.algo)
+
+    def _kl_scale(self, bits=8, num_quantized_bins=255):
+        """Reference _get_kl_scaling_factor
+        (post_training_quantization.py:818): scan thresholds over the top
+        30% of the histogram; P = clipped distribution (outlier mass folded
+        into the edge bin), Q = P merged into 255 bins and re-expanded over
+        P's support; pick the threshold minimizing KL(P||Q)."""
+        if self.hist is None or self.hist.sum() <= 0:
+            return max(max(self.abs_maxes), 1e-9)
+        hist = self.hist
+        bin_width = self.hist_max / _HIST_BINS
+        ending = _HIST_BINS - 1
+        starting = int(ending * 0.7)
+        p_sum = float(hist.sum())
+        best_kl, best_i = None, 0
+        for i in range(starting, ending + 1):
+            if hist[i - 1] == 0:
+                continue
+            p = hist[:i].astype(np.float64).copy()
+            p[i - 1] += float(hist[i:].sum())
+            # merge hist[:i] into num_quantized_bins, last bin absorbs tail
+            nm = int(i / num_quantized_bins)
+            q = np.zeros(i, np.float64)
+            for idx in range(num_quantized_bins):
+                lo = idx * nm
+                hi = i if idx == num_quantized_bins - 1 else lo + nm
+                seg = hist[lo:hi].astype(np.float64)
+                nz = (seg > 0).sum()
+                if nz:
+                    q[lo:hi] = np.where(seg > 0, seg.sum() / nz, 0.0)
+            q_sum = float(q.sum())
+            if q_sum <= 0:
+                continue
+            mask = p > 0
+            qm = np.maximum(q[mask], 1e-12)
+            kl = float(np.sum(p[mask] / p_sum
+                              * np.log((p[mask] / p_sum) / (qm / q_sum))))
+            if best_kl is None or kl < best_kl:
+                best_kl, best_i = kl, i
+        if best_i == 0:
+            best_i = starting
+        return max((best_i + 0.5) * bin_width, 1e-9)
+
+
+def _walk_quantizable(layer, types, prefix=""):
+    for name, sub in list(layer._sub_layers.items()):
+        path = f"{prefix}.{name}" if prefix else name
+        if type(sub) in types and not getattr(sub, "skip_quant", False):
+            yield layer, name, path, sub
+        else:
+            yield from _walk_quantizable(sub, types, path)
+
+
+class PostTrainingQuantization:
+    """TPU-shaped PTQ (reference post_training_quantization.py:121).
+
+    Args:
+      model: eval-mode Layer.
+      data_loader: iterable yielding model inputs — a Tensor/array, a tuple
+        of positional inputs, or (inputs, label) pairs.
+      batch_nums: number of calibration batches (None = whole loader).
+      algo: 'abs_max' | 'avg' | 'hist' | 'mse' | 'KL'.
+      quantizable_op_type: layer classes to quantize.
+      weight_bits / activation_bits, hist_percent: as reference.
+      compute: 'int8' (MXU s8 kernels) or 'simulate'.
+    """
+
+    def __init__(self, model=None, data_loader=None, batch_nums=None,
+                 algo="KL", quantizable_op_type=("Conv2D", "Linear"),
+                 weight_bits=8, activation_bits=8, hist_percent=0.99999,
+                 compute="int8", executor=None, scope=None, model_dir=None,
+                 **unused):
+        if algo not in _SUPPORTED_ALGOS:
+            raise ValueError(f"algo must be one of {_SUPPORTED_ALGOS}")
+        self._model = model
+        self._loader = data_loader
+        self._batch_nums = batch_nums
+        self._algo = algo
+        self._types = tuple(
+            {"Conv2D": nn.Conv2D, "Linear": nn.Linear}[t]
+            if isinstance(t, str) else t for t in quantizable_op_type)
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._hist_percent = hist_percent
+        self._compute = compute
+        self._scales = {}
+
+    def quantize(self):
+        """Calibrate + swap layers in place; returns the quantized model."""
+        model = self._model
+        model.eval()
+        sites = list(_walk_quantizable(model, self._types))
+        collectors = {path: _Collector(self._algo) for _, _, path, _ in sites}
+
+        # input-recording hooks
+        saved = []
+        for parent, name, path, sub in sites:
+            col = collectors[path]
+
+            def rec(x, _orig=sub.forward, _c=col):
+                _c.update(x._value if hasattr(x, "_value") else x)
+                return _orig(x)
+
+            saved.append((sub, sub.__dict__.get("forward")))
+            sub.forward = rec
+
+        try:
+            n = 0
+            for batch in self._loader:
+                args = self._to_args(batch)
+                model(*args)
+                n += 1
+                if self._batch_nums and n >= self._batch_nums:
+                    break
+            if n == 0:
+                raise ValueError("calibration data_loader yielded no batches")
+        finally:
+            for sub, old in saved:
+                if old is None:
+                    del sub.forward
+                else:
+                    sub.forward = old
+
+        for parent, name, path, sub in sites:
+            scale = collectors[path].scale(self._hist_percent, self._abits)
+            self._scales[path] = scale
+            cls = Int8Conv2D if isinstance(sub, nn.Conv2D) else Int8Linear
+            parent._sub_layers[name] = cls(
+                sub, scale, weight_bits=self._wbits, act_bits=self._abits,
+                compute=self._compute)
+        return model
+
+    @staticmethod
+    def _to_args(batch):
+        from ..tensor import Tensor
+
+        if isinstance(batch, (list, tuple)):
+            if len(batch) == 2:
+                # (inputs, label) convention: drop the SECOND element only
+                # when it looks like labels (integer dtype, rank <= 1) —
+                # a real float second input is kept
+                second = np.asarray(
+                    batch[1]._value if isinstance(batch[1], Tensor)
+                    else batch[1])
+                if second.ndim <= 1 and np.issubdtype(second.dtype,
+                                                      np.integer):
+                    batch = batch[:1]
+            return tuple(b if isinstance(b, Tensor) else Tensor(np.asarray(b))
+                         for b in batch)
+        return (batch if isinstance(batch, Tensor)
+                else Tensor(np.asarray(batch)),)
+
+    @property
+    def activation_scales(self):
+        return dict(self._scales)
+
+    def save_quantized_model(self, save_model_path, input_spec=None, **kw):
+        from .. import jit
+
+        return jit.save(self._model, save_model_path, input_spec=input_spec,
+                        **kw)
+
+
+def quantize_for_inference(model, calib_data, algo="abs_max", batch_nums=None,
+                           compute="int8", **kw):
+    """One-call PTQ: quantize `model` in place using `calib_data` (iterable
+    of input batches) and return it — the jit.save/Predictor-time entry the
+    reference reaches via QuantizationFreezePass."""
+    ptq = PostTrainingQuantization(model=model, data_loader=calib_data,
+                                   algo=algo, batch_nums=batch_nums,
+                                   compute=compute, **kw)
+    return ptq.quantize()

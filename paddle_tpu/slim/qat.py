@@ -1,0 +1,99 @@
+"""Imperative quantization-aware training (reference: slim/quantization/
+imperative/qat.py:40 ImperativeQuantAware, :229 ImperativeQuantizeInputs,
+:346 ImperativeQuantizeOutputs)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import nn
+from .quant_layers import (MovingAverageAbsMaxScale, QuantizedConv2D,
+                           QuantizedLinear)
+
+_QUANT_MAP = {"Conv2D": (nn.Conv2D, QuantizedConv2D),
+              "Linear": (nn.Linear, QuantizedLinear)}
+
+
+class ImperativeQuantAware:
+    """Rewrites a dygraph model in place, replacing quantizable layers with
+    fake-quant wrappers (qat.py:40).  Layers with ``skip_quant=True`` are
+    left untouched."""
+
+    def __init__(self, quantizable_layer_type=("Conv2D", "Linear"),
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 weight_preprocess_layer=None, act_preprocess_layer=None,
+                 weight_quantize_layer=None, act_quantize_layer=None):
+        if weight_quantize_type not in ("abs_max", "channel_wise_abs_max"):
+            raise ValueError(
+                f"unsupported weight_quantize_type {weight_quantize_type!r}")
+        if activation_quantize_type not in ("abs_max",
+                                            "moving_average_abs_max"):
+            raise ValueError("unsupported activation_quantize_type "
+                             f"{activation_quantize_type!r}")
+        self._types = []
+        for t in quantizable_layer_type:
+            key = t if isinstance(t, str) else t.__name__
+            if key not in _QUANT_MAP:
+                raise ValueError(f"layer type {key!r} not quantizable")
+            self._types.append(key)
+        self._kw = dict(
+            weight_bits=weight_bits, activation_bits=activation_bits,
+            moving_rate=moving_rate,
+            weight_quantize_type=weight_quantize_type,
+            activation_quantize_type=activation_quantize_type,
+            weight_quant_layer=weight_quantize_layer,
+            act_quant_layer=act_quantize_layer,
+            weight_pre_layer=weight_preprocess_layer,
+            act_pre_layer=act_preprocess_layer)
+        self._moving_rate = moving_rate
+
+    def quantize(self, model):
+        """In-place rewrite; returns the model for chaining."""
+        self._rewrite(model)
+        return model
+
+    def _rewrite(self, layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if getattr(sub, "skip_quant", False):
+                continue
+            replaced = False
+            for key in self._types:
+                base, quant_cls = _QUANT_MAP[key]
+                if type(sub) is base:
+                    layer._sub_layers[name] = quant_cls(sub, **self._kw)
+                    replaced = True
+                    break
+            if not replaced:
+                self._rewrite(sub)
+
+    def save_quantized_model(self, model, path, input_spec=None, **config):
+        """jit.save with the fake-quant graph baked in (qat.py
+        save_quantized_model analog; the Predictor reloads it directly)."""
+        from .. import jit
+
+        model.eval()
+        return jit.save(model, path, input_spec=input_spec, **config)
+
+
+class ImperativeQuantizeOutputs:
+    """Adds out-scale recording to quantized layers' outputs
+    (qat.py:346 / OutScaleForTrainingPass)."""
+
+    def __init__(self, moving_rate=0.9):
+        self._moving_rate = moving_rate
+
+    def apply(self, model):
+        for name, sub in list(model._sub_layers.items()):
+            if isinstance(sub, (QuantizedConv2D, QuantizedLinear)):
+                scale = MovingAverageAbsMaxScale(moving_rate=self._moving_rate)
+                sub.add_sublayer("_out_scale", scale)
+                orig_forward = sub.forward
+
+                def wrapped(x, _f=orig_forward, _s=scale):
+                    return _s(_f(x))
+
+                sub.forward = wrapped
+            else:
+                self.apply(sub)
+        return model

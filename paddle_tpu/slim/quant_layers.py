@@ -1,0 +1,223 @@
+"""Fake-quant layers for QAT (reference: slim/quantization/imperative/
+quant_nn.py — FakeQuantMovingAverage :33, FakeQuantAbsMax :131,
+FakeChannelWiseQuantDequantAbsMax :213, QuantizedConv2D :323,
+QuantizedLinear :412, MovingAverageAbsMaxScale :509; CUDA kernels
+operators/fake_quantize_op.cu).
+
+TPU-native: quant-dequant is a pure jax expression with a straight-through
+estimator (x + stop_gradient(qdq(x) - x)) — the whole thing fuses into one
+elementwise pass under jit, no custom kernels needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..ops._helpers import to_tensor_like
+from ..ops.dispatch import apply
+from ..tensor import Tensor
+
+
+def _qdq(x, scale, qmax):
+    """Quantize-dequantize: round(clip(x/scale)*qmax)/qmax*scale."""
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q / qmax * s
+
+
+def quant_dequant_abs_max(x, bits=8, channel_axis=None):
+    """Simulated quantization with abs-max scale; straight-through gradient.
+
+    channel_axis: per-channel scales along this axis (weights), else
+    per-tensor (reference fake_quantize_op.cc FakeQuantizeAbsMax /
+    FakeChannelWiseQuantizeAbsMax)."""
+    x = to_tensor_like(x)
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def f(v):
+        if channel_axis is None:
+            scale = jnp.max(jnp.abs(v))
+        else:
+            axes = tuple(i for i in range(v.ndim) if i != channel_axis)
+            shape = [1] * v.ndim
+            shape[channel_axis] = -1
+            scale = jnp.max(jnp.abs(v), axis=axes).reshape(shape)
+        out = _qdq(v, scale, qmax)
+        # straight-through estimator
+        return v + jax.lax.stop_gradient(out - v)
+
+    return apply("fake_quantize_dequantize_abs_max", f, x)
+
+
+class FakeQuantAbsMax(Layer):
+    """Per-tensor abs-max fake quant (quant_nn.py:131)."""
+
+    def __init__(self, name=None, quant_bits=8, dtype="float32"):
+        super().__init__()
+        self._quant_bits = quant_bits
+
+    def forward(self, x):
+        return quant_dequant_abs_max(x, bits=self._quant_bits)
+
+
+class FakeChannelWiseQuantAbsMax(Layer):
+    """Per-channel abs-max fake quant (quant_nn.py:213)."""
+
+    def __init__(self, name=None, channel_num=None, quant_bits=8,
+                 channel_axis=0, dtype="float32"):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._channel_axis = channel_axis
+
+    def forward(self, x):
+        return quant_dequant_abs_max(x, bits=self._quant_bits,
+                                     channel_axis=self._channel_axis)
+
+
+class FakeQuantMovingAverage(Layer):
+    """Activation fake quant with a moving-average abs-max scale
+    (quant_nn.py:33; op fake_quantize_moving_average_abs_max).  The scale is
+    a buffer updated in training and frozen for eval."""
+
+    def __init__(self, name=None, moving_rate=0.9, quant_bits=8,
+                 dtype="float32"):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._quant_bits = quant_bits
+        self.register_buffer("scale", Tensor(jnp.ones((), jnp.float32)))
+        self.register_buffer("state", Tensor(jnp.ones((), jnp.float32)))
+
+    def forward(self, x):
+        x = to_tensor_like(x)
+        qmax = float(2 ** (self._quant_bits - 1) - 1)
+        if self.training:
+            cur = apply("abs_max", lambda v: jnp.max(jnp.abs(v)).astype(jnp.float32), x)
+            from ..autograd.tape import no_grad
+
+            with no_grad():
+                r = self._moving_rate
+                new_state = self.state._value * r + 1.0
+                new_scale = (self.scale._value * self.state._value * r
+                             + cur._value) / new_state
+                self.state._value = new_state
+                self.scale._value = new_scale
+        scale = self.scale
+
+        def f(v, s):
+            out = _qdq(v, s.astype(v.dtype), qmax)
+            return v + jax.lax.stop_gradient(out - v)
+
+        return apply("fake_quantize_dequantize_moving_average_abs_max", f,
+                     x, scale)
+
+
+class MovingAverageAbsMaxScale(Layer):
+    """Records the moving-average abs-max of a tensor without quantizing —
+    the per-layer output scale used at freeze time (quant_nn.py:509,
+    OutScaleForTrainingPass quantization_pass.py:1518)."""
+
+    def __init__(self, name=None, moving_rate=0.9, dtype="float32"):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self.register_buffer("scale", Tensor(jnp.ones((), jnp.float32)))
+        self.register_buffer("state", Tensor(jnp.ones((), jnp.float32)))
+
+    def forward(self, x):
+        x = to_tensor_like(x)
+        if self.training:
+            cur = apply("abs_max", lambda v: jnp.max(jnp.abs(v)).astype(jnp.float32), x)
+            from ..autograd.tape import no_grad
+
+            with no_grad():
+                r = self._moving_rate
+                new_state = self.state._value * r + 1.0
+                new_scale = (self.scale._value * self.state._value * r
+                             + cur._value) / new_state
+                self.state._value = new_state
+                self.scale._value = new_scale
+        return x
+
+
+class QuantizedConv2D(Layer):
+    """Conv2D with fake-quantized weight + input (quant_nn.py:323)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quant_layer=None, act_quant_layer=None,
+                 weight_pre_layer=None, act_pre_layer=None):
+        super().__init__()
+        self._conv = layer
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self._w_pre = weight_pre_layer() if weight_pre_layer else None
+        self._a_pre = act_pre_layer() if act_pre_layer else None
+        if weight_quant_layer is not None:
+            self._w_fake = weight_quant_layer()
+        elif weight_quantize_type == "channel_wise_abs_max":
+            self._w_fake = FakeChannelWiseQuantAbsMax(
+                quant_bits=weight_bits, channel_axis=0)
+        else:
+            self._w_fake = FakeQuantAbsMax(quant_bits=weight_bits)
+        if act_quant_layer is not None:
+            self._a_fake = act_quant_layer()
+        elif activation_quantize_type == "moving_average_abs_max":
+            self._a_fake = FakeQuantMovingAverage(
+                moving_rate=moving_rate, quant_bits=activation_bits)
+        else:
+            self._a_fake = FakeQuantAbsMax(quant_bits=activation_bits)
+
+    def forward(self, x):
+        if self._a_pre is not None:
+            x = self._a_pre(x)
+        x = self._a_fake(x)
+        w = self.weight
+        if self._w_pre is not None:
+            w = self._w_pre(w)
+        w = self._w_fake(w)
+        c = self._conv
+        return F.conv2d(x, w, c.bias, stride=c._stride, padding=c._padding,
+                        dilation=c._dilation, groups=c._groups,
+                        data_format=c._data_format)
+
+
+class QuantizedLinear(Layer):
+    """Linear with fake-quantized weight + input (quant_nn.py:412)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quant_layer=None, act_quant_layer=None,
+                 weight_pre_layer=None, act_pre_layer=None):
+        super().__init__()
+        self._linear = layer
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self._w_pre = weight_pre_layer() if weight_pre_layer else None
+        self._a_pre = act_pre_layer() if act_pre_layer else None
+        if weight_quant_layer is not None:
+            self._w_fake = weight_quant_layer()
+        elif weight_quantize_type == "channel_wise_abs_max":
+            self._w_fake = FakeChannelWiseQuantAbsMax(
+                quant_bits=weight_bits, channel_axis=1)
+        else:
+            self._w_fake = FakeQuantAbsMax(quant_bits=weight_bits)
+        if act_quant_layer is not None:
+            self._a_fake = act_quant_layer()
+        elif activation_quantize_type == "moving_average_abs_max":
+            self._a_fake = FakeQuantMovingAverage(
+                moving_rate=moving_rate, quant_bits=activation_bits)
+        else:
+            self._a_fake = FakeQuantAbsMax(quant_bits=activation_bits)
+
+    def forward(self, x):
+        if self._a_pre is not None:
+            x = self._a_pre(x)
+        x = self._a_fake(x)
+        w = self.weight
+        if self._w_pre is not None:
+            w = self._w_pre(w)
+        w = self._w_fake(w)
+        return F.linear(x, w, self._linear.bias)
