@@ -75,6 +75,11 @@ def _check_nan_inf(name, flat_outs):
                 )
 
 
+# fns that executed fine but failed jax.vjp once — skip re-attempting the
+# linearization (and re-warning) on every subsequent call
+_non_linearizable: set = set()
+
+
 def apply(name, fn, *args, n_outputs=None, **kwargs):
     """Run ``fn(*arrays, **kwargs)`` eagerly; record vjp if needed.
 
@@ -101,8 +106,7 @@ def apply(name, fn, *args, n_outputs=None, **kwargs):
     record = is_grad_enabled() and bool(tracked_idx)
     recorder = _recording_program()
 
-    if not record:
-        out = fn(*arrays, **kwargs)
+    def _finish_nograd(out):
         if flag_value("check_nan_inf"):
             flat, _ = jax.tree_util.tree_flatten(out)
             _check_nan_inf(name, flat)
@@ -110,6 +114,9 @@ def apply(name, fn, *args, n_outputs=None, **kwargs):
         if recorder is not None:
             recorder.add_record(name, fn, args, kwargs, wrapped, cast_to)
         return wrapped
+
+    if not record or fn in _non_linearizable:
+        return _finish_nograd(fn(*arrays, **kwargs))
 
     def closed(*diff_vals):
         call = list(arrays)
@@ -121,7 +128,25 @@ def apply(name, fn, *args, n_outputs=None, **kwargs):
     try:
         out, vjp_fn = jax.vjp(closed, *primals)
     except Exception as e:
-        raise type(e)(f"[operator < {name} >] {e}") from e
+        # Some ops execute fine but cannot be linearized (e.g. a custom op
+        # whose BACKWARD rule contains a raw Pallas kernel, reached when the
+        # backward itself is being recorded for double grad / static replay).
+        # If the plain forward works, degrade to a non-differentiable record
+        # instead of failing — further grads through it are simply cut.
+        try:
+            out = fn(*arrays, **kwargs)
+        except Exception:
+            raise RuntimeError(f"[operator < {name} >] {e}") from e
+        import warnings
+
+        if fn not in _non_linearizable:
+            _non_linearizable.add(fn)
+            warnings.warn(
+                f"operator < {name} > executes but cannot be linearized "
+                f"({type(e).__name__}); gradients through it are cut. "
+                "Register a custom vjp if it must be differentiable here.",
+                stacklevel=2)
+        return _finish_nograd(out)
     if flag_value("check_nan_inf"):
         flat, _ = jax.tree_util.tree_flatten(out)
         _check_nan_inf(name, flat)

@@ -2,6 +2,8 @@
 from . import download  # noqa: F401
 from . import profiler  # noqa: F401
 from . import unique_name  # noqa: F401
+from .custom_op import (get_op, register_op, registered_ops,  # noqa: F401
+                        unregister_op)
 
 try:
     from . import cpp_extension  # noqa: F401

@@ -1,0 +1,140 @@
+"""User custom-op registration — the TPU analog of the reference's
+custom-operator plugin (framework/custom_operator.cc:511 RegisterOperatorWithMetaInfo,
+:865 LoadOpMetaInfoAndRegisterOp; python/paddle/utils/cpp_extension/
+cpp_extension.py:206 CppExtension / :678 load).
+
+Where the reference compiles user C++/CUDA into a .so and registers kernels,
+the TPU framework registers a *jax function* (plain jnp code or a Pallas
+kernel — the TPU-legit equivalent of a CUDA kernel).  The registered op:
+
+  * dispatches through ops/dispatch.apply → autograd tape records it, AMP
+    autocast applies, NaN/Inf sweeps run, static-graph Programs record it;
+  * may carry a custom VJP, either as a one-shot ``vjp`` (recompute style)
+    or a jax-style ``fwd``/``bwd`` pair with residuals;
+  * works under jax.jit / the static Executor unchanged (it is traceable).
+
+Example (see tests/test_custom_op.py for a trained end-to-end Pallas op)::
+
+    import paddle_tpu as paddle
+
+    def swish(x, beta=1.0):
+        return x * jax.nn.sigmoid(beta * x)
+
+    op = paddle.utils.register_op("my_swish", swish)
+    y = op(paddle.to_tensor(x), beta=2.0)    # trainable, jit-able
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+_REGISTRY: Dict[str, "CustomOp"] = {}
+
+
+class CustomOp:
+    """A registered user op (callable)."""
+
+    def __init__(self, name: str, fn: Callable, vjp: Optional[Callable],
+                 fwd: Optional[Callable], bwd: Optional[Callable]):
+        import jax
+
+        self.name = name
+        self._fn = fn
+        self._vjp, self._fwd, self._bwd = vjp, fwd, bwd
+        self._jfn_cache: Dict[tuple, Callable] = {}
+        self._jax = jax
+
+    def _jfn(self, attrs: tuple) -> Callable:
+        """Build (and cache) the jax callable for a given static-attr set,
+        wiring the user's custom gradient if provided."""
+        if attrs in self._jfn_cache:
+            return self._jfn_cache[attrs]
+        jax = self._jax
+        kw = dict(attrs)
+        fn, vjp, fwd, bwd = self._fn, self._vjp, self._fwd, self._bwd
+
+        if vjp is None and bwd is None:
+            def jfn(*arrays):
+                return fn(*arrays, **kw)
+        else:
+            @jax.custom_vjp
+            def jfn(*arrays):
+                return fn(*arrays, **kw)
+
+            if bwd is not None:
+                def _f(*arrays):
+                    out, res = fwd(*arrays, **kw)
+                    return out, res
+
+                def _b(res, cts):
+                    g = bwd(res, cts, **kw)
+                    return tuple(g) if isinstance(g, (list, tuple)) else (g,)
+            else:
+                # recompute-style: vjp(cts, *inputs, **attrs) -> grads
+                # (reference custom-op backward signature: grad func takes
+                # grad-outputs + forward inputs)
+                def _f(*arrays):
+                    return fn(*arrays, **kw), arrays
+
+                def _b(res, cts):
+                    g = vjp(cts, *res, **kw)
+                    return tuple(g) if isinstance(g, (list, tuple)) else (g,)
+
+            jfn.defvjp(_f, _b)
+        self._jfn_cache[attrs] = jfn
+        return jfn
+
+    def __call__(self, *tensors, **attrs):
+        from ..ops.dispatch import apply
+
+        key = tuple(sorted(attrs.items()))
+        return apply(self.name, self._jfn(key), *tensors)
+
+
+def register_op(name: str, fn: Callable, vjp: Optional[Callable] = None,
+                fwd: Optional[Callable] = None, bwd: Optional[Callable] = None,
+                amp: Optional[str] = None, exist_ok: bool = False) -> CustomOp:
+    """Register a user op into the dispatcher (custom_operator.cc:511 analog).
+
+    Args:
+      name: op name (appears in profiles, error messages, Program records).
+      fn:  jax function ``fn(*arrays, **attrs) -> array | tuple`` — jnp code
+           or a Pallas kernel launch.
+      vjp: optional recompute-style gradient
+           ``vjp(cotangents, *inputs, **attrs) -> grads`` (one per input).
+      fwd/bwd: alternative jax custom_vjp pair —
+           ``fwd(*inputs, **attrs) -> (out, residuals)``,
+           ``bwd(residuals, cotangents, **attrs) -> grads``.
+      amp: 'white' runs the op in low precision under amp.auto_cast,
+           'black' pins it to float32 (fp16_lists.py analog).
+      exist_ok: allow re-registration under the same name.
+
+    Returns the op as a callable taking Tensors (+ static attrs).
+    """
+    if (vjp is not None) and (bwd is not None):
+        raise ValueError("pass either vjp= or fwd=/bwd=, not both")
+    if (bwd is None) != (fwd is None):
+        raise ValueError("fwd= and bwd= must be given together")
+    if name in _REGISTRY and not exist_ok:
+        raise ValueError(f"op {name!r} already registered "
+                         "(pass exist_ok=True to replace)")
+    if amp not in (None, "white", "black"):
+        raise ValueError("amp must be None, 'white' or 'black'")
+    if amp:
+        from ..amp.auto_cast import black_list, white_list
+
+        (white_list if amp == "white" else black_list).add(name)
+    op = CustomOp(name, fn, vjp, fwd, bwd)
+    _REGISTRY[name] = op
+    return op
+
+
+def get_op(name: str) -> CustomOp:
+    return _REGISTRY[name]
+
+
+def registered_ops():
+    return sorted(_REGISTRY)
+
+
+def unregister_op(name: str) -> None:
+    _REGISTRY.pop(name, None)

@@ -1,0 +1,186 @@
+"""Custom-op plugin API (paddle_tpu.utils.register_op) — reference
+custom_operator.cc:511 / cpp_extension.py:206 analog.
+
+VERDICT r2 task 5 done-criteria: a user-defined op (incl. a Pallas kernel)
+trains end-to-end eager AND static."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, static, utils
+from paddle_tpu.utils import register_op, unregister_op
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    yield
+    for name in ("t_swish", "t_vjp", "t_fwdbwd", "t_pallas", "t_amp",
+                 "t_static", "t_dup"):
+        unregister_op(name)
+
+
+class TestRegisterOp:
+    def test_basic_autodiff(self):
+        op = register_op("t_swish",
+                         lambda x, beta=1.0: x * jax.nn.sigmoid(beta * x))
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 4).astype(np.float32))
+        x.stop_gradient = False
+        y = op(x, beta=2.0)
+        y.sum().backward()
+        # grads match jax autodiff of the same expression
+        want = jax.grad(
+            lambda v: (v * jax.nn.sigmoid(2.0 * v)).sum())(x._value)
+        np.testing.assert_allclose(np.asarray(x.grad._value),
+                                   np.asarray(want), rtol=1e-5)
+
+    def test_recompute_style_vjp(self):
+        def f(x, w):
+            return x @ w
+
+        def f_vjp(ct, x, w):
+            return ct @ w.T, x.T @ ct
+
+        op = register_op("t_vjp", f, vjp=f_vjp)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(3, 5).astype(np.float32))
+        w = paddle.to_tensor(rng.randn(5, 2).astype(np.float32))
+        x.stop_gradient = False
+        w.stop_gradient = False
+        op(x, w).sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad._value),
+                                   np.asarray(jnp.ones((3, 2)) @ w._value.T),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(w.grad._value),
+                                   np.asarray(x._value.T @ jnp.ones((3, 2))),
+                                   rtol=1e-5)
+
+    def test_fwd_bwd_pair_with_residuals(self):
+        def f(x):
+            return jnp.tanh(x)
+
+        def f_fwd(x):
+            y = jnp.tanh(x)
+            return y, y  # residual: the output
+
+        def f_bwd(res, ct):
+            return (ct * (1 - res * res),)
+
+        op = register_op("t_fwdbwd", f, fwd=f_fwd, bwd=f_bwd)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(6).astype(np.float32))
+        x.stop_gradient = False
+        op(x).sum().backward()
+        want = 1 - np.tanh(np.asarray(x._value)) ** 2
+        np.testing.assert_allclose(np.asarray(x.grad._value), want, rtol=1e-5)
+
+    def test_duplicate_name_raises(self):
+        register_op("t_dup", lambda x: x)
+        with pytest.raises(ValueError):
+            register_op("t_dup", lambda x: x + 1)
+        register_op("t_dup", lambda x: x + 1, exist_ok=True)  # replace ok
+
+    def test_amp_white_listed(self):
+        op = register_op("t_amp", lambda x: x * 2.0, amp="white")
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            y = op(x)
+        assert str(y.dtype).endswith("bfloat16")
+
+
+def _pallas_scale_shift(x, scale, shift):
+    """Worked Pallas example: fused y = x*scale + shift elementwise kernel
+    (interpret mode off-TPU; compiles to Mosaic on TPU)."""
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, s_ref, b_ref, o_ref):
+        o_ref[:] = x_ref[:] * s_ref[0] + b_ref[0]
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=jax.default_backend() != "tpu",
+    )(x, scale.reshape(1), shift.reshape(1))
+
+
+def _pallas_scale_shift_vjp(ct, x, scale, shift):
+    return (_pallas_scale_shift(ct, scale, jnp.zeros_like(shift)),
+            jnp.sum(ct * x).reshape(()),
+            jnp.sum(ct).reshape(()))
+
+
+class _PallasScaleLayer(nn.Layer):
+    def __init__(self, op):
+        super().__init__()
+        self._op = op
+        self.scale = self.create_parameter([1])
+        self.shift = self.create_parameter([1], is_bias=True)
+
+    def forward(self, x):
+        return self._op(x, self.scale.reshape([]), self.shift.reshape([]))
+
+
+class TestPallasCustomOp:
+    def test_trains_eager(self):
+        op = register_op("t_pallas", _pallas_scale_shift,
+                         vjp=_pallas_scale_shift_vjp, exist_ok=True)
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 8), _PallasScaleLayer(op),
+                            nn.Linear(8, 1))
+        opt = optimizer.Adam(5e-2, parameters=net.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+        y = paddle.to_tensor((rng.randn(16, 1) * 0.1 + 1.0).astype(np.float32))
+        first = None
+        for _ in range(15):
+            loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first if first is not None else float(loss._value)
+        assert float(loss._value) < first * 0.5
+
+    def test_trains_static(self):
+        """The op records into a static Program and the Executor replays
+        it with gradients + optimizer updates."""
+        op = register_op("t_static", _pallas_scale_shift,
+                         vjp=_pallas_scale_shift_vjp, exist_ok=True)
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [16, 8], "float32")
+            y = static.data("y", [16, 1], "float32")
+            lin = nn.Linear(8, 1)
+            h = lin(x)
+            out = op(h, paddle.to_tensor(np.float32(1.5)),
+                     paddle.to_tensor(np.float32(0.25)))
+            loss = ((out - y) ** 2).mean()
+            opt = optimizer.SGD(learning_rate=0.05,
+                                parameters=lin.parameters())
+            opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        losses = []
+        for i in range(10):
+            xv = rng.randn(16, 8).astype(np.float32)
+            yv = (xv.sum(axis=1, keepdims=True) * 0.05).astype(np.float32)
+            lv, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            losses.append(float(lv))
+        assert losses[-1] < losses[0]
+
+    def test_pallas_matches_reference_math(self):
+        op = register_op("t_pallas", _pallas_scale_shift,
+                         vjp=_pallas_scale_shift_vjp, exist_ok=True)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        got = op(x, paddle.to_tensor(np.float32(3.0)),
+                 paddle.to_tensor(np.float32(-1.0)))
+        np.testing.assert_allclose(np.asarray(got._value),
+                                   np.asarray(x._value) * 3.0 - 1.0,
+                                   rtol=1e-4)
