@@ -71,8 +71,10 @@ from . import incubate  # noqa: F401
 from . import inference  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401
+from . import distribution  # noqa: F401
 from . import metric  # noqa: F401
 from . import nn  # noqa: F401
+from . import onnx  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import slim  # noqa: F401
 from . import static  # noqa: F401

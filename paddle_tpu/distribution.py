@@ -1,0 +1,215 @@
+"""paddle.distribution (reference: python/paddle/distribution.py —
+Distribution :41, Uniform :168, Normal :390, Categorical :640).
+
+TPU-native: sampling uses the framework's threaded PRNG (framework/random.py
+splits keys — jax.random under the hood), densities are jnp expressions
+dispatched through ops/dispatch so they differentiate and record like any
+other op."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .framework.random import next_rng_key
+from .ops._helpers import to_tensor_like
+from .ops.dispatch import apply
+from .tensor import Tensor
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical",
+           "kl_divergence"]
+
+
+def _as_value(x, dtype=jnp.float32):
+    if isinstance(x, Tensor):
+        v = x._value
+        return v.astype(dtype) if jnp.issubdtype(v.dtype, jnp.floating) \
+            else v
+    return jnp.asarray(x, dtype)
+
+
+class Distribution:
+    """Base class (reference distribution.py:41)."""
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        return apply("exp", jnp.exp, self.log_prob(value))
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U(low, high) (reference :168); broadcasting batch parameters."""
+
+    def __init__(self, low, high, name=None):
+        self.low = low
+        self.high = high
+        self.name = name or "Uniform"
+
+    def _params(self):
+        return _as_value(self.low), _as_value(self.high)
+
+    def sample(self, shape, seed=0):
+        lo, hi = self._params()
+        batch = jnp.broadcast_shapes(lo.shape, hi.shape)
+        out_shape = tuple(shape) + batch
+        key = jax.random.key(seed) if seed else next_rng_key()
+        u = jax.random.uniform(key, out_shape, jnp.float32)
+        return Tensor(u * (hi - lo) + lo)
+
+    def log_prob(self, value):
+        def f(v, lo, hi):
+            inside = ((lo < v) & (v < hi)).astype(v.dtype)
+            return jnp.log(inside) - jnp.log(hi - lo)
+
+        return apply("uniform_log_prob", f, to_tensor_like(value),
+                     to_tensor_like(self.low), to_tensor_like(self.high))
+
+    def probs(self, value):
+        def f(v, lo, hi):
+            inside = ((lo < v) & (v < hi)).astype(v.dtype)
+            return inside / (hi - lo)
+
+        return apply("uniform_probs", f, to_tensor_like(value),
+                     to_tensor_like(self.low), to_tensor_like(self.high))
+
+    def entropy(self):
+        return apply("uniform_entropy", lambda lo, hi: jnp.log(hi - lo),
+                     to_tensor_like(self.low), to_tensor_like(self.high))
+
+
+class Normal(Distribution):
+    """N(loc, scale) (reference :390)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = loc
+        self.scale = scale
+        self.name = name or "Normal"
+
+    def _params(self):
+        return _as_value(self.loc), _as_value(self.scale)
+
+    def sample(self, shape, seed=0):
+        loc, scale = self._params()
+        batch = jnp.broadcast_shapes(loc.shape, scale.shape)
+        out_shape = tuple(shape) + batch
+        key = jax.random.key(seed) if seed else next_rng_key()
+        z = jax.random.normal(key, out_shape, jnp.float32)
+        return Tensor(z * scale + loc)
+
+    def entropy(self):
+        def f(loc, scale):
+            return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(
+                jnp.broadcast_to(scale,
+                                 jnp.broadcast_shapes(loc.shape, scale.shape)))
+
+        return apply("normal_entropy", f, to_tensor_like(self.loc),
+                     to_tensor_like(self.scale))
+
+    def log_prob(self, value):
+        """Differentiable in value AND in Tensor-valued loc/scale (both are
+        routed through the dispatcher as op inputs)."""
+        value = to_tensor_like(value)
+        loc_t = to_tensor_like(self.loc)
+        scale_t = to_tensor_like(self.scale)
+
+        def f(v, loc, scale):
+            var = scale * scale
+            return (-((v - loc) ** 2) / (2 * var)
+                    - jnp.log(scale) - 0.5 * math.log(2 * math.pi))
+
+        return apply("normal_log_prob", f, value, loc_t, scale_t)
+
+    def kl_divergence(self, other):
+        """KL(self || other) for two Normals (reference :595)."""
+        if not isinstance(other, Normal):
+            raise NotImplementedError
+
+        def f(l1, s1, l2, s2):
+            ratio = s1 / s2
+            t1 = (l1 - l2) / s2
+            return 0.5 * (ratio * ratio + t1 * t1) - 0.5 - jnp.log(ratio)
+
+        return apply("normal_kl", f, to_tensor_like(self.loc),
+                     to_tensor_like(self.scale), to_tensor_like(other.loc),
+                     to_tensor_like(other.scale))
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits (reference :640 — note the
+    reference's `logits` are *unnormalized probabilities*; probabilities are
+    logits/sum, matching that convention)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = to_tensor_like(logits)
+        self.name = name or "Categorical"
+
+    def _probs(self):
+        lg = _as_value(self.logits)
+        return lg / jnp.sum(lg, axis=-1, keepdims=True)
+
+    def sample(self, shape, seed=0):
+        p = self._probs()
+        key = jax.random.key(seed) if seed else next_rng_key()
+        out_shape = tuple(shape) + p.shape[:-1]
+        idx = jax.random.categorical(key, jnp.log(p), axis=-1,
+                                     shape=out_shape)
+        return Tensor(idx)
+
+    def entropy(self):
+        def f(lg):
+            p = lg / jnp.sum(lg, axis=-1, keepdims=True)
+            plogp = jnp.where(p > 0, p * jnp.log(jnp.where(p > 0, p, 1.0)),
+                              0.0)
+            return -jnp.sum(plogp, axis=-1)
+
+        return apply("categorical_entropy", f, self.logits)
+
+    def probs(self, value):
+        value = to_tensor_like(value)
+
+        def f(lg, idx):
+            p = lg / jnp.sum(lg, axis=-1, keepdims=True)
+            idx = idx.astype(jnp.int32)
+            if p.ndim == 1:
+                return p[idx]
+            return jnp.take_along_axis(p, idx[..., None], axis=-1)[..., 0]
+
+        return apply("categorical_probs", f, self.logits, value)
+
+    def log_prob(self, value):
+        return apply("log", jnp.log, self.probs(value))
+
+    def kl_divergence(self, other):
+        """KL(self || other) (reference :773)."""
+        if not isinstance(other, Categorical):
+            raise NotImplementedError
+
+        def f(lg, lg2):
+            p = lg / jnp.sum(lg, axis=-1, keepdims=True)
+            q = lg2 / jnp.sum(lg2, axis=-1, keepdims=True)
+            terms = jnp.where(
+                p > 0,
+                p * (jnp.log(jnp.where(p > 0, p, 1.0))
+                     - jnp.log(jnp.maximum(q, 1e-38))),
+                0.0)
+            return jnp.sum(terms, axis=-1)
+
+        return apply("categorical_kl", f, self.logits, other.logits)
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    """Functional form: KL(p || q)."""
+    return p.kl_divergence(q)

@@ -80,6 +80,9 @@ class Predictor:
         n_out = len(self._exported.out_avals)
         self._output_names = meta.get(
             "output_names", [f"out_{i}" for i in range(n_out)])
+        # optional pruning: serve only these exported-output positions
+        # (paddle.onnx.export output_spec analog)
+        self._output_indices = meta.get("output_indices")
         self._inputs: Dict[str, PredictorTensor] = {
             n: PredictorTensor(n, tuple(s.shape), s.dtype)
             for n, s in zip(self._input_names, in_specs)}
@@ -138,6 +141,8 @@ class Predictor:
         outs = self._exported.call(*feeds)
         if not isinstance(outs, (list, tuple)):
             outs = (outs,)
+        if self._output_indices is not None:
+            outs = [outs[i] for i in self._output_indices]
         for n, o in zip(self._output_names, outs):
             arr = np.asarray(o)
             if batch is not None and arr.ndim >= 1 \

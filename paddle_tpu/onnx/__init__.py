@@ -1,0 +1,13 @@
+"""paddle.onnx analog (reference: python/paddle/onnx/export.py:21).
+
+The reference exports to ONNX via paddle2onnx for cross-runtime serving.
+The TPU framework's portable serving artifact is **StableHLO** (the
+XLA-ecosystem interchange format): ``export`` traces the layer with the
+given input_spec and writes the same artifact set as ``paddle.jit.save``
+(``<path>.pdmodel`` = serialized StableHLO, ``.pdiparams`` = weights,
+``.pdmeta`` = named IO), so it round-trips through
+``paddle_tpu.inference.create_predictor`` and any StableHLO-consuming
+runtime."""
+from .export import export
+
+__all__ = ["export"]
