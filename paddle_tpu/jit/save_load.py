@@ -77,17 +77,23 @@ def save(layer, path, input_spec=None, **configs):
 class TranslatedLayer(Layer):
     """Reloaded inference program (reference: fluid/dygraph/io.py:TranslatedLayer)."""
 
-    def __init__(self, exported, state):
+    def __init__(self, exported, state, output_indices=None):
         super().__init__()
         self._exported = exported
         self._state = state
+        self._output_indices = output_indices
 
     def forward(self, *args):
         arr_args = [a._value if isinstance(a, Tensor) else np.asarray(a) for a in args]
         out = self._exported.call(*arr_args)
-        if isinstance(out, (list, tuple)):
-            return type(out)(Tensor(o) for o in out)
-        return Tensor(out)
+        if not isinstance(out, (list, tuple)):
+            return Tensor(out)
+        if self._output_indices is not None:
+            # onnx.export output_spec pruning (meta output_indices)
+            out = [out[i] for i in self._output_indices]
+            if len(out) == 1:
+                return Tensor(out[0])
+        return type(out)(Tensor(o) for o in out)
 
     def program(self):
         return self._exported.mlir_module()
@@ -99,4 +105,10 @@ def load(path, **configs):
     exported = jax.export.deserialize(blob)
     with open(path + _PDPARAMS_SUFFIX, "rb") as f:
         state = pickle.load(f)
-    return TranslatedLayer(exported, state)
+    indices = None
+    try:
+        with open(path + ".pdmeta", "rb") as f:
+            indices = pickle.load(f).get("output_indices")
+    except OSError:
+        pass
+    return TranslatedLayer(exported, state, output_indices=indices)

@@ -66,7 +66,8 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
     if prog is not None:
         from ...framework.random import default_generator
 
-        prog.note_state(key_t, refresh=default_generator.split_key)
+        prog.note_state(key_t, refresh=default_generator.split_key,
+                        spec=("rng", None))
     return apply("dropout", f, x, key_t)
 
 

@@ -137,7 +137,8 @@ class Optimizer:
             prog.note_state(step_t, updated=new_step)
             lr_t = Tensor(jnp.asarray(self.get_lr(), jnp.float32))
             prog.note_state(
-                lr_t, refresh=lambda: jnp.asarray(self.get_lr(), jnp.float32))
+                lr_t, refresh=lambda: jnp.asarray(self.get_lr(), jnp.float32),
+                spec=("lr", self._lr))
             slots[skey] = (step_t, new_step, lr_t)
         step_t, new_step, lr_t = slots[skey]
 

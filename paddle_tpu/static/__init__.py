@@ -13,12 +13,14 @@ from .program import (  # noqa: F401
     CompiledProgram,
     Executor,
     LoadedProgram,
+    LoadedTrainProgram,
     Program,
     data,
     default_main_program,
     default_startup_program,
     global_scope,
     load_inference_program,
+    load_train_program,
     program_guard,
     scope_guard,
 )

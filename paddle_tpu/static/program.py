@@ -105,14 +105,23 @@ class Program:
         self._kept.append(new_tensor)  # keep alive: id() keys the env
         self._version += 1
 
-    def note_state(self, tensor, setter=None, updated=None, refresh=None):
+    def note_state(self, tensor, setter=None, updated=None, refresh=None,
+                   spec=("plain", None)):
         """Register extra mutable state (optimizer accumulators, step
         counters, RNG keys): `tensor` is the env input slot — its ``_value``
         is re-read on every Executor.run (or produced by ``refresh()`` when
         given, e.g. a fresh dropout key per run).  After replay the new value
         is written back into ``tensor._value`` and passed to ``setter`` for
-        any external store (optimizer accumulator dicts)."""
-        self._state_writeback[id(tensor)] = (tensor, setter, refresh)
+        any external store (optimizer accumulator dicts).
+
+        ``spec`` is the state's *serializable* descriptor, used by
+        ``save_train`` so a reloaded program can reproduce the refresh
+        behavior without the (unpicklable) closure:
+          ("plain", None)     — carried value, updated by the program
+          ("rng", None)       — PRNG key, refreshed per run
+          ("lr", lr_or_sched) — learning rate from a float/LRScheduler
+        """
+        self._state_writeback[id(tensor)] = (tensor, setter, refresh, spec)
         if updated is not None:
             self._state_updates[id(tensor)] = id(updated)
             self._kept = getattr(self, "_kept", [])
@@ -190,7 +199,7 @@ class Program:
 
         def infer(feed_vals, param_vals):
             fetches, _, _ = run(feed_vals, list(param_vals),
-                                [t._value for _, (t, _, _) in state_items])
+                                [t._value for _, (t, *_rest) in state_items])
             return tuple(fetches)
 
         feed_specs = [jax.ShapeDtypeStruct(v._value.shape, v._value.dtype)
@@ -206,6 +215,124 @@ class Program:
             pickle.dump({"params": [np.asarray(v) for v in param_vals],
                          "feed_names": [v.name for v in self.feed_vars],
                          "n_fetch": len(fetch_list)}, f)
+
+
+    def save_train(self, path, fetch_list):
+        """Serialize the FULL training replay — feeds + parameters +
+        optimizer state as live inputs (not baked) — so a fresh process can
+        resume training bit-exact without the model code (reference:
+        framework.proto:201 trainable ProgramDesc + save_op.cc persistables,
+        fluid/io.py save_persistables).
+
+        Artifacts: ``<path>.trainprogram`` (StableHLO of one train step) and
+        ``<path>.trainstate`` (params, accumulators, step/LR/RNG specs)."""
+        fetch_ids = [id(f) for f in fetch_list]
+        run, param_items, state_items = self._replay_fn(fetch_ids)
+        specs = [spec for _, (_t, _s, _r, spec) in state_items]
+
+        def train_step(feed_vals, param_vals, state_vals):
+            # rng states ride as raw key_data (uint32) — typed PRNG keys
+            # don't serialize as export inputs
+            states = [jax.random.wrap_key_data(v) if sp[0] == "rng" else v
+                      for v, sp in zip(state_vals, specs)]
+            fetches, new_params, new_states = run(feed_vals, param_vals,
+                                                  states)
+            new_states = [
+                jax.random.key_data(v) if sp[0] == "rng" and v is not None
+                else v
+                for v, sp in zip(new_states, specs)]
+            return tuple(fetches), tuple(new_params), tuple(new_states)
+
+        def raw_state(t, sp):
+            return jax.random.key_data(t._value) if sp[0] == "rng" \
+                else t._value
+
+        feed_specs = [jax.ShapeDtypeStruct(v._value.shape, v._value.dtype)
+                      for v in self.feed_vars]
+        param_vals = [p._value for _, p in param_items]
+        state_vals = [raw_state(t, sp)
+                      for (_, (t, *_r)), sp in zip(state_items, specs)]
+        exported = jax.export.export(jax.jit(train_step))(
+            feed_specs,
+            [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in param_vals],
+            [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in state_vals])
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path + ".trainprogram", "wb") as f:
+            f.write(exported.serialize())
+        def sanitize(sp, cur_val):
+            # LR schedulers may hold unpicklable members (LambdaDecay's
+            # user lambda) — fall back to the current lr value
+            if sp[0] == "lr":
+                try:
+                    pickle.dumps(sp[1])
+                except Exception:
+                    return ("lr", float(np.asarray(cur_val)))
+            return sp
+
+        saved_specs = [sanitize(sp, v) for sp, v in zip(specs, state_vals)]
+        with open(path + ".trainstate", "wb") as f:
+            pickle.dump({
+                "params": [np.asarray(v) for v in param_vals],
+                "param_names": [p.name for _, p in param_items],
+                "states": [np.asarray(v) for v in state_vals],
+                "state_specs": saved_specs,
+                "feed_names": [v.name for v in self.feed_vars],
+                "n_fetch": len(fetch_list),
+            }, f, protocol=4)
+
+
+class LoadedTrainProgram:
+    """A deserialized TRAINABLE program: holds live parameters + optimizer
+    state; each ``run`` executes one recorded train step and advances them
+    (fresh-process resume, no model code needed)."""
+
+    def __init__(self, path):
+        with open(path + ".trainprogram", "rb") as f:
+            self._exported = jax.export.deserialize(f.read())
+        with open(path + ".trainstate", "rb") as f:
+            meta = pickle.load(f)
+        self.params = [jnp.asarray(p) for p in meta["params"]]
+        self.param_names = meta["param_names"]
+        self.states = [jnp.asarray(s) for s in meta["states"]]
+        self.state_specs = meta["state_specs"]
+        self.feed_names = meta["feed_names"]
+        self._n_fetch = meta["n_fetch"]
+
+    def _refresh_states(self):
+        out = []
+        for v, (kind, arg) in zip(self.states, self.state_specs):
+            if kind == "rng":
+                # fresh dropout key per step, continuing the saved stream
+                nxt = jax.random.key_data(
+                    jax.random.split(jax.random.wrap_key_data(v), 1)[0])
+                out.append(nxt)
+            elif kind == "lr":
+                lr = arg() if callable(arg) else arg
+                out.append(jnp.asarray(lr, v.dtype).reshape(v.shape))
+            else:
+                out.append(v)
+        return out
+
+    def run(self, feed: Dict[str, Any]):
+        missing = [n for n in self.feed_names if n not in feed]
+        if missing:
+            raise KeyError(f"missing feeds {missing}")
+        feeds = [jnp.asarray(feed[n]) for n in self.feed_names]
+        states = self._refresh_states()
+        fetches, new_params, new_states = self._exported.call(
+            feeds, self.params, states)
+        self.params = list(new_params)
+        self.states = [s if ns is None else ns
+                       for s, ns in zip(states, new_states)]
+        return [np.asarray(o) for o in fetches]
+
+    def state_dict(self):
+        return {n: np.asarray(p)
+                for n, p in zip(self.param_names, self.params)}
+
+
+def load_train_program(path) -> LoadedTrainProgram:
+    return LoadedTrainProgram(path)
 
 
 class LoadedProgram:
@@ -347,11 +474,15 @@ class Executor:
 
         feed_vals = []
         for v in program.feed_vars:
-            if v.name in feed:
-                val = feed[v.name]
-                arr = val.numpy() if isinstance(val, Tensor) else np.asarray(val)
-            else:
-                arr = np.asarray(v._value)
+            if v.name not in feed:
+                # reference check_feed_shape_type/executor.py raises on a
+                # missing feed; computing on the zero placeholder silently
+                # returns garbage
+                raise ValueError(
+                    f"feed variable {v.name!r} was declared by the program "
+                    f"but not fed (got feeds {sorted(feed)})")
+            val = feed[v.name]
+            arr = val.numpy() if isinstance(val, Tensor) else np.asarray(val)
             feed_vals.append(jnp.asarray(arr))
 
         # resolve fetch-by-name (reference Executor accepts var names)
@@ -388,7 +519,7 @@ class Executor:
 
         param_vals = [p._value for _, p in param_items]
         state_vals = [(refresh() if refresh is not None else t._value)
-                      for _, (t, _, refresh) in state_items]
+                      for _, (t, _, refresh, _spec) in state_items]
         fetches, new_params, new_states = jitted(feed_vals, param_vals,
                                                  state_vals)
         # state writeback: params mutate like the reference's scope vars; the
@@ -399,11 +530,21 @@ class Executor:
             if nv is not None and pid in program._param_updates:
                 p._value = nv
                 p._inplace_version += 1
-        for (sid, (t, setter, refresh)), nv in zip(state_items, new_states):
+        for (sid, (t, setter, refresh, _spec)), nv in zip(state_items,
+                                                          new_states):
             if nv is not None and sid in program._state_updates:
                 t._value = nv
                 if setter is not None:
                     setter(nv)
+        # populate the Scope with persistables + fetches (reference
+        # executor.py writes results into scope vars; scope.h:52)
+        target = scope if scope is not None else global_scope()
+        for (pid, p), nv in zip(param_items, new_params):
+            if getattr(p, "name", None):
+                target.set(p.name, nv if nv is not None else p._value)
+        for f, val in zip(fetch_list, fetches):
+            if getattr(f, "name", None):
+                target.set(f.name, val)
         if return_numpy:
             return [np.asarray(o) for o in fetches]
         return [Tensor(o) for o in fetches]

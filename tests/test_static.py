@@ -111,7 +111,7 @@ class TestExecutorReplay:
                                    np.asarray(lin_e.weight._value),
                                    rtol=1e-4, atol=1e-5)
         # the state input tensors themselves carry the velocity forward
-        state_tensors = [t for t, _, _ in main._state_writeback.values()]
+        state_tensors = [t for t, *_ in main._state_writeback.values()]
         vel = [t for t in state_tensors if t._value.ndim == 2]
         assert vel and any(np.abs(np.asarray(t._value)).sum() > 0
                            for t in vel)
@@ -134,7 +134,7 @@ class TestExecutorReplay:
         yv = np.zeros((4, 1), np.float32)
         for _ in range(3):
             exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
-        steps = [t for t, _, _ in main._state_writeback.values()
+        steps = [t for t, *_ in main._state_writeback.values()
                  if t._value.ndim == 0 and t._value.dtype == jnp.int32]
         assert steps and int(steps[0]._value) == 3
 

@@ -1,0 +1,193 @@
+"""Trainable-program serialization + Executor Scope/feed checks
+(VERDICT r2 task 4).
+
+Done-criterion: train 10 steps, save, reload WITHOUT model code (fresh
+process), train 10 more, match an uninterrupted 20-step run bit-exact."""
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, static
+from paddle_tpu.nn import functional as F
+
+
+def _build_train_program(seed=0, lr=0.05):
+    paddle.seed(seed)
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [8, 4], "float32")
+        y = static.data("y", [8, 1], "float32")
+        lin = nn.Linear(4, 1)
+        loss = F.mse_loss(lin(x), y)
+        opt = optimizer.Momentum(learning_rate=lr, momentum=0.9,
+                                 parameters=lin.parameters())
+        opt.minimize(loss)
+    return main, startup, loss, lin
+
+
+def _batches(n, seed=42):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(4, 1).astype(np.float32)
+    out = []
+    for _ in range(n):
+        xv = rng.randn(8, 4).astype(np.float32)
+        out.append((xv, (xv @ w).astype(np.float32)))
+    return out
+
+
+class TestTrainCheckpoint:
+    def test_save_resume_matches_uninterrupted(self, tmp_path):
+        batches = _batches(20)
+
+        # uninterrupted 20-step run
+        main, startup, loss, _ = _build_train_program()
+        exe = static.Executor()
+        exe.run(startup, feed={})
+        ref_losses = []
+        for xv, yv in batches:
+            lv, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            ref_losses.append(float(lv))
+
+        # 10 steps, save, reload (same process here; fresh process below),
+        # 10 more
+        main2, startup2, loss2, _ = _build_train_program()
+        exe2 = static.Executor()
+        exe2.run(startup2, feed={})
+        for xv, yv in batches[:10]:
+            exe2.run(main2, feed={"x": xv, "y": yv}, fetch_list=[loss2])
+        path = str(tmp_path / "ckpt")
+        main2.save_train(path, [loss2])
+
+        resumed = static.load_train_program(path)
+        got = []
+        for xv, yv in batches[10:]:
+            lv, = resumed.run({"x": xv, "y": yv})
+            got.append(float(lv))
+        np.testing.assert_allclose(got, ref_losses[10:], rtol=1e-6)
+
+    def test_fresh_process_resume_no_model_code(self, tmp_path):
+        batches = _batches(20)
+        main, startup, loss, _ = _build_train_program()
+        exe = static.Executor()
+        exe.run(startup, feed={})
+        ref = []
+        for xv, yv in batches:
+            lv, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            ref.append(float(lv))
+
+        main2, startup2, loss2, _ = _build_train_program()
+        exe2 = static.Executor()
+        exe2.run(startup2, feed={})
+        for xv, yv in batches[:10]:
+            exe2.run(main2, feed={"x": xv, "y": yv}, fetch_list=[loss2])
+        path = str(tmp_path / "ckpt")
+        main2.save_train(path, [loss2])
+        with open(tmp_path / "batches.pkl", "wb") as f:
+            pickle.dump(batches[10:], f)
+
+        # fresh process: only static.load_train_program, no model class
+        script = f"""
+import os, pickle, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax; jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repr('/root/repo')})
+from paddle_tpu import static
+import numpy as np
+prog = static.load_train_program({path!r})
+with open({str(tmp_path / 'batches.pkl')!r}, 'rb') as f:
+    batches = pickle.load(f)
+losses = []
+for xv, yv in batches:
+    lv, = prog.run({{"x": xv, "y": yv}})
+    losses.append(float(lv))
+print("LOSSES", losses)
+"""
+        res = subprocess.run([sys.executable, "-c", script],
+                             capture_output=True, text=True, timeout=300)
+        assert res.returncode == 0, res.stderr[-2000:]
+        line = [l for l in res.stdout.splitlines() if l.startswith("LOSSES")]
+        got = eval(line[0][len("LOSSES "):])
+        np.testing.assert_allclose(got, ref[10:], rtol=1e-6)
+
+    def test_optimizer_state_really_resumes(self, tmp_path):
+        """Momentum velocity must survive the checkpoint: a resume that
+        re-zeroed it would diverge from the uninterrupted run."""
+        batches = _batches(6, seed=7)
+        main, startup, loss, lin = _build_train_program(seed=1)
+        exe = static.Executor()
+        exe.run(startup, feed={})
+        for xv, yv in batches[:3]:
+            exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        path = str(tmp_path / "c2")
+        main.save_train(path, [loss])
+        resumed = static.load_train_program(path)
+        # velocity state present in the archive (non-zero after 3 steps)
+        vel = [s for s, sp in zip(resumed.states, resumed.state_specs)
+               if sp[0] == "plain" and np.asarray(s).size > 1]
+        assert any(np.abs(np.asarray(v)).max() > 0 for v in vel)
+        # params after resume-step equal continuing in-process
+        lv_resumed, = resumed.run({"x": batches[3][0], "y": batches[3][1]})
+        lv_cont, = exe.run(main, feed={"x": batches[3][0], "y": batches[3][1]},
+                           fetch_list=[loss])
+        np.testing.assert_allclose(float(lv_resumed), float(lv_cont),
+                                   rtol=1e-6)
+
+
+class TestLrSchedulerCheckpoint:
+    def test_lambda_decay_save_falls_back_to_value(self, tmp_path):
+        """A scheduler holding a user lambda can't pickle — save_train must
+        still write the checkpoint (current lr value baked in)."""
+        from paddle_tpu.optimizer import lr as lr_mod
+
+        paddle.seed(4)
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 3], "float32")
+            y = static.data("y", [4, 1], "float32")
+            lin = nn.Linear(3, 1)
+            loss = F.mse_loss(lin(x), y)
+            sched = lr_mod.LambdaDecay(0.1, lambda e: 0.9 ** e)
+            opt = optimizer.SGD(learning_rate=sched,
+                                parameters=lin.parameters())
+            opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup, feed={})
+        xv = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+        yv = np.random.RandomState(1).randn(4, 1).astype(np.float32)
+        exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        path = str(tmp_path / "lmb")
+        main.save_train(path, [loss])  # must not raise
+        resumed = static.load_train_program(path)
+        lv, = resumed.run({"x": xv, "y": yv})
+        assert np.isfinite(float(lv))
+
+
+class TestExecutorStrictness:
+    def test_missing_feed_raises(self):
+        main, startup, loss, _ = _build_train_program(seed=2)
+        exe = static.Executor()
+        exe.run(startup, feed={})
+        with pytest.raises(ValueError, match="not fed"):
+            exe.run(main, feed={"x": np.zeros((8, 4), np.float32)},
+                    fetch_list=[loss])
+
+    def test_scope_populated(self):
+        main, startup, loss, lin = _build_train_program(seed=3)
+        exe = static.Executor()
+        exe.run(startup, feed={})
+        rng = np.random.RandomState(0)
+        xv = rng.randn(8, 4).astype(np.float32)
+        yv = rng.randn(8, 1).astype(np.float32)
+        scope = static.global_scope()
+        exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        w = scope.find_var(lin.weight.name)
+        assert w is not None
+        np.testing.assert_allclose(np.asarray(w),
+                                   np.asarray(lin.weight._value))
+        assert scope.find_var(loss.name) is not None
