@@ -91,9 +91,17 @@ class GradientMergeOptimizer(MetaOptimizerBase):
 
 
 class LocalSGDOptimizer(MetaOptimizerBase):
-    """Periodic parameter averaging (reference localsgd_optimizer.py).  On an
-    SPMD mesh the averaging is a psum in the jitted sync step; eagerly (one
-    process) it reduces to the inner step."""
+    """LocalSGD: k local steps per replica, then parameters are AVERAGED
+    across replicas (reference localsgd_optimizer.py — the opposite of
+    per-step gradient allreduce).
+
+    Eager path: ``step()`` runs the inner update and, every ``k_steps``
+    past ``begin_step``, all-reduces + rescales every parameter over the
+    default group (a real psum under a traced/shard_map context; identity
+    when single-process).  SPMD path: use
+    ``distributed.parallel.make_localsgd_train_step`` — per-replica
+    parameter copies with a pmean every k-th step inside one jitted
+    program."""
 
     def __init__(self, inner, k_steps=1, begin_step=1):
         super().__init__(inner)
@@ -104,8 +112,24 @@ class LocalSGDOptimizer(MetaOptimizerBase):
     def step(self):
         self.inner.step()
         self._count += 1
-        # cross-replica averaging happens in the sharded step (psum); eager
-        # single-process: nothing to average.
+        if self._count >= self.begin_step and \
+                self._count % self.k_steps == 0:
+            self.sync_params()
+
+    def sync_params(self):
+        """Average parameters across the group (localsgd_optimizer.py
+        snapshot/allreduce/scale sequence).  The divide is gated on the
+        SAME traced check as the reduction: eagerly all_reduce is an
+        identity (single participant), so dividing by nranks there would
+        silently shrink the model."""
+        from .. import collective
+
+        group = collective._default_group
+        nranks = getattr(group, "nranks", 1) or 1
+        for p in self.inner._param_list():
+            if collective._is_traced(p._value) and nranks > 1:
+                collective.all_reduce(p)
+                p._value = p._value / nranks
 
 
 class DGCOptimizer(MetaOptimizerBase):

@@ -136,7 +136,7 @@ def make_hybrid_step(mesh, vocab=64, d_model=32, d_ff=64, n_classes=4,
             xm = e.reshape(M, mb, seq, d_model)
             outs = pipeline_apply(
                 stage_fn, (pt["w1"], pt["b1"], pt["w2"], pt["b2"]), xm,
-                axis_name="pp", remat=False)
+                axis_name="pp", schedule="f-then-b")
             pooled = outs.reshape(Bl, seq, d_model).mean(axis=1)
             logits_l = pooled @ pt["head"]          # [Bl, n_classes/mp]
             logits = jax.lax.all_gather(logits_l, "mp", axis=0, tiled=False)

@@ -12,6 +12,7 @@ DataParallel wrapper is kept for API parity: eagerly it is transparent
 """
 from __future__ import annotations
 
+from functools import partial
 from typing import Callable, Dict, Optional
 
 import jax
@@ -50,6 +51,88 @@ class DataParallel(Layer):
         """Reducer analog: in SPMD the psum happens inside the step; eagerly
         single-process this is a no-op."""
         return
+
+
+def make_localsgd_train_step(layer: Layer, loss_fn: Callable, optimizer,
+                             k_steps: int, mesh=None, axis: str = "dp",
+                             begin_step: int = 1):
+    """LocalSGD SPMD step (reference localsgd_optimizer.py semantics): every
+    replica along ``axis`` holds its OWN parameter/optimizer-state copy and
+    takes purely local steps (no gradient collective); every ``k_steps``-th
+    step past ``begin_step``, parameters (and optimizer state) are pmean'd
+    across the axis inside the same compiled program.
+
+    Returns (step_fn, state); step_fn(state, x, y) -> (state, mean_loss).
+    x/y are global batches sharded over ``axis``.
+    """
+    from jax import shard_map
+
+    mesh = mesh or get_mesh()
+    n = mesh.shape[axis]
+    params0, buffers0 = get_state(layer)
+    opt0 = optimizer.init_opt_state(params0)
+
+    def stack(tree):
+        return jax.tree_util.tree_map(
+            lambda v: jnp.broadcast_to(v[None], (n,) + v.shape), tree)
+
+    state = {"params": stack(params0), "buffers": stack(buffers0),
+             "opt": stack(opt0), "step": jnp.zeros((), jnp.int32)}
+
+    from ..framework.random import rng_scope
+
+    def inner(p_st, b_st, o_st, count, x, y, key):
+        squeeze = lambda t: jax.tree_util.tree_map(
+            lambda v: jnp.squeeze(v, 0), t)
+        p, b, o = squeeze(p_st), squeeze(b_st), squeeze(o_st)
+
+        def loss_of(pp, bb):
+            with rng_scope(key):
+                out, nb = functional_call(layer, pp, bb, (x,), training=True)
+            loss = loss_fn(Tensor(out) if isinstance(out, jax.Array) else out,
+                           Tensor(y))
+            return loss._value.astype(jnp.float32), nb
+
+        (loss, nb), grads = jax.value_and_grad(loss_of, has_aux=True)(p, b)
+        count = count + 1
+        new_p, new_o = optimizer.fused_step(p, grads, o, count)
+
+        do_avg = (count >= begin_step) & (count % k_steps == 0)
+        avg = lambda t: jax.tree_util.tree_map(
+            lambda v: jax.lax.pmean(v, axis) if jnp.issubdtype(
+                v.dtype, jnp.floating) else v, t)
+        new_p, new_o = jax.lax.cond(
+            do_avg, lambda a, c: (avg(a), avg(c)), lambda a, c: (a, c),
+            new_p, new_o)
+
+        expand = lambda t: jax.tree_util.tree_map(lambda v: v[None], t)
+        return (expand(new_p), expand(nb), expand(new_o), count,
+                jax.lax.pmean(loss, axis))
+
+    P = PartitionSpec
+    step_sm = shard_map(
+        inner, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(), P(axis), P(axis), P()),
+        out_specs=(P(axis), P(axis), P(axis), P(), P()),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def jit_step(state, x, y, key):
+        p, b, o, c, loss = step_sm(state["params"], state["buffers"],
+                                   state["opt"], state["step"], x, y, key)
+        return {"params": p, "buffers": b, "opt": o, "step": c}, loss
+
+    def run(state, x, y, key=None):
+        from ..framework.random import default_generator
+
+        if key is None:
+            key = default_generator.split_key()
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+        return jit_step(state, xv, yv, key)
+
+    return run, state
 
 
 def make_sharded_train_step(layer: Layer, loss_fn: Callable, optimizer,

@@ -27,55 +27,93 @@ NEG = 0.0
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
-                   axis_name: str = "pp", remat: bool = True):
+                   axis_name: str = "pp", remat: bool = None,
+                   head_fn: Callable = None, head_params=None,
+                   tail_fn: Callable = None, tail_params=None,
+                   schedule: str = "1f1b"):
     """Run microbatches through the pipeline inside shard_map.
 
-    stage_fn(params, x) -> y : one stage's computation (same code every stage).
+    stage_fn(params, x) -> y : one stage's computation (same code every
+      stage); must preserve the activation shape (the carried type).
     stage_params: this device's stage parameters (pytree).
     x_microbatches: [M, mb, ...] microbatches, valid data on EVERY device
       (replicated); stage 0 consumes them in order.
+    head_fn(head_params, x_mb) -> activation: OPTIONAL shape/dtype-changing
+      ingest (e.g. an embedding: int tokens -> hidden states), applied on
+      stage 0 as each microbatch enters the pipe (reference: the first
+      stage's section program holds the pre-pipeline layers).
+    tail_fn(tail_params, activation) -> out: OPTIONAL shape-changing final
+      projection applied on the last stage as each microbatch finishes.
+    schedule: '1f1b' (default) wraps the stage in jax.checkpoint — under
+      autodiff-of-scan only the O(M) stage-BOUNDARY activations are stashed
+      and per-stage intermediates are recomputed during the reverse sweep,
+      the same peak-memory class as the reference's 1F1B interleave
+      (fluid/optimizer.py:4351); 'f-then-b' stashes every intermediate
+      (reference F-then-B :4324 — faster backward, more memory).
     Returns [M, mb, ...] outputs (valid on the last stage; replicated out by
     caller via ppermute/psum as needed).
     """
+    if schedule not in ("1f1b", "f-then-b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    # remat is DERIVED from the schedule ('1f1b' = remat on, 'f-then-b' =
+    # full stash); an explicit contradictory remat is an error, not a
+    # silent override
+    want_remat = schedule == "1f1b"
+    if remat is None:
+        remat = want_remat
+    elif remat != want_remat:
+        raise ValueError(
+            f"remat={remat} contradicts schedule={schedule!r} "
+            "(1f1b = rematerialized, f-then-b = full stash); pass only "
+            "schedule=")
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     M = x_microbatches.shape[0]
-    mb_shape = x_microbatches.shape[1:]
 
-    fn = stage_fn
-    if remat:
-        fn = jax.checkpoint(stage_fn)
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    hfn = head_fn
+    if hfn is not None and remat:
+        hfn = jax.checkpoint(head_fn)
 
     total = M + n - 1
     perm = [(i, (i + 1) % n) for i in range(n)]
 
+    def ingest(t):
+        feed = jax.lax.dynamic_index_in_dim(
+            x_microbatches, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+        return hfn(head_params, feed) if hfn is not None else feed
+
     # derive initial carries from a probe so their shard_map varying-axis
     # types match the loop body's outputs on any mesh (pp alone, pp×dp, …)
-    probe = fn(stage_params, x_microbatches[0]) * 0
-    buf0 = probe
-    outs0 = jnp.zeros((M,) + probe.shape, probe.dtype) + probe[None]
-
-    if probe.shape != mb_shape:
+    probe_in = ingest(0)
+    probe = fn(stage_params, probe_in) * 0
+    if probe.shape != probe_in.shape or probe.dtype != probe_in.dtype:
         raise ValueError(
-            "pipeline stage_fn must preserve the activation shape "
-            f"(got {mb_shape} -> {probe.shape}); wrap shape-changing head/"
-            "tail layers outside the pipelined block")
+            "pipeline stage_fn must preserve the carried activation type "
+            f"(got {probe_in.shape}/{probe_in.dtype} -> "
+            f"{probe.shape}/{probe.dtype}); move shape-changing layers into "
+            "head_fn / tail_fn")
+    buf0 = probe
+    out_probe = (tail_fn(tail_params, probe) if tail_fn is not None
+                 else probe)
+    outs0 = jnp.zeros((M,) + out_probe.shape, out_probe.dtype) + \
+        out_probe[None] * 0
 
     def tick(carry, t):
         cur, outs = carry
         # stage 0 ingests microbatch t (if in range) — other stages use the
-        # activation that arrived from the previous stage
-        feed = jax.lax.dynamic_index_in_dim(
-            x_microbatches, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
-        cur = jnp.where(idx == 0, feed, cur)
+        # activation that arrived from the previous stage (where, not cond:
+        # the branches differ in shard_map varying-axis type)
+        cur = jnp.where(idx == 0, ingest(t), cur)
         y = fn(stage_params, cur)
         # last stage records its finished microbatch (t - (n-1))
         out_t = t - (n - 1)
         record = (idx == n - 1) & (out_t >= 0)
+        out_val = tail_fn(tail_params, y) if tail_fn is not None else y
         outs = jax.lax.cond(
             record,
             lambda o: jax.lax.dynamic_update_index_in_dim(
-                o, y, jnp.clip(out_t, 0, M - 1), axis=0),
+                o, out_val, jnp.clip(out_t, 0, M - 1), axis=0),
             lambda o: o,
             outs,
         )
@@ -96,21 +134,27 @@ class PipelineStage:
 
 
 def pipeline_forward(mesh, stage_fn, params_by_stage, x, micro_batch_size,
-                     axis_name: str = "pp", remat: bool = True):
+                     axis_name: str = "pp", remat: bool = None,
+                     head_fn=None, head_params=None,
+                     tail_fn=None, tail_params=None, schedule: str = "1f1b"):
     """Whole-array entry: params_by_stage is a pytree whose leaves have a
     leading stage dimension (sharded over 'pp'); x is the global batch
-    (replicated). Returns final-stage outputs for the full batch."""
+    (replicated); head/tail params are replicated.  Returns final-stage
+    outputs for the full batch (head/tail may change shape+dtype)."""
     from jax import shard_map
 
     B = x.shape[0]
     M = B // micro_batch_size
     xm = x.reshape((M, micro_batch_size) + x.shape[1:])
 
-    def inner(params_local, xm_local):
+    def inner(params_local, xm_local, head_p, tail_p):
         params_local = jax.tree_util.tree_map(
             lambda p: jnp.squeeze(p, axis=0), params_local)
         outs = pipeline_apply(stage_fn, params_local, xm_local,
-                              axis_name=axis_name, remat=remat)
+                              axis_name=axis_name, remat=remat,
+                              head_fn=head_fn, head_params=head_p,
+                              tail_fn=tail_fn, tail_params=tail_p,
+                              schedule=schedule)
         # broadcast final-stage outputs to all stages so out_specs can be
         # replicated (last stage holds the real values)
         n = jax.lax.psum(1, axis_name)
@@ -121,10 +165,11 @@ def pipeline_forward(mesh, stage_fn, params_by_stage, x, micro_batch_size,
     fn = shard_map(
         inner,
         mesh=mesh,
-        in_specs=(PartitionSpec(axis_name), PartitionSpec()),
+        in_specs=(PartitionSpec(axis_name), PartitionSpec(),
+                  PartitionSpec(), PartitionSpec()),
         out_specs=PartitionSpec(),
     )
-    outs = fn(params_by_stage, xm)
+    outs = fn(params_by_stage, xm, head_params, tail_params)
     return outs.reshape((B,) + outs.shape[2:])
 
 
