@@ -4,6 +4,7 @@ Reference analog: paddle.distributed (§2 SURVEY — collective.py, parallel.py,
 fleet/, launch) over NCCL rings; here over ICI/DCN via jax mesh collectives.
 """
 from . import fleet  # noqa: F401
+from . import ps  # noqa: F401
 from .collective import (  # noqa: F401
     Group,
     ReduceOp,

@@ -68,6 +68,39 @@ class Fleet:
 
         barrier()
 
+    # --- parameter-server runtime (reference fleet/runtime/the_one_ps.py:400
+    # driving the brpc PSServer/PSClient; here the tables are in-process —
+    # the single-host degenerate case of the same pull/push contract) ------
+    def init_server(self, *args, **kwargs):
+        from ..ps import runtime
+
+        runtime.init_server()
+
+    def run_server(self):
+        from ..ps import runtime
+
+        runtime.run_server()
+
+    def init_worker(self):
+        from ..ps import runtime
+
+        runtime.init_worker(self._strategy)
+
+    def stop_worker(self):
+        from ..ps import runtime
+
+        runtime.stop_worker()
+
+    def sparse_embedding(self, name: str, dim: int, rule: str = "sgd",
+                         lr: float = 0.01, **table_kw):
+        """Create (or fetch) a PS-backed sparse embedding whose merge policy
+        follows the strategy's a_sync / a_sync_configs.k_steps flags
+        (distributed_strategy.proto:108-118: sync / async / geo)."""
+        from ..ps import runtime
+
+        return runtime.sparse_embedding(name, dim, rule=rule, lr=lr,
+                                        strategy=self._strategy, **table_kw)
+
     # --- training objects --------------------------------------------------
     def distributed_optimizer(self, optimizer: Optimizer, strategy=None):
         if strategy is not None:

@@ -1,0 +1,19 @@
+"""Parameter-server sparse-table capability (reference:
+/root/reference/paddle/fluid/distributed/table/common_sparse_table.cc —
+shard-partitioned host storage with rowwise optimizer rules;
+service/communicator.cc — sync/async/geo gradient merge;
+service/brpc_ps_client.cc — the pull/push RPC surface).
+
+TPU-native redesign (SURVEY §7 step 10): the accelerator never holds the
+[vocab, dim] table.  Rows live host-side in shard-partitioned numpy arenas;
+each training step PULLS just the batch's unique rows to the device, the
+backward produces a dense [n_unique, dim] grad, and the communicator PUSHes
+it back applying the rowwise optimizer on the host.  Cross-host scale-out
+rides DCN with the same pull/push contract (the in-process table here is
+the single-host degenerate case of the brpc service)."""
+from . import runtime  # noqa: F401
+from .table import SparseTable
+from .communicator import Communicator
+from .embedding import SparseEmbedding
+
+__all__ = ["SparseTable", "Communicator", "SparseEmbedding"]

@@ -1,0 +1,65 @@
+"""Gradient communicator: sync / async / geo merge policies (reference
+service/communicator.cc — AsyncCommunicator:(send queue, merge add),
+GeoCommunicator:(k-step delta push), SyncCommunicator; selected by the
+fleet DistributedStrategy a_sync / a_sync_configs.k_steps flags,
+distributed_strategy.proto:108-118)."""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from .table import SparseTable
+
+
+class Communicator:
+    """Applies embedding gradients to a SparseTable under a merge policy.
+
+    mode='sync'  : push immediately (barrier per step — the k=0 case)
+    mode='async' : push immediately, no barrier semantics (single process
+                   collapses to sync; the distinction matters cross-host)
+    mode='geo'   : accumulate row deltas locally; push the merged deltas
+                   every `k_steps` trainer steps (geo-async k-step delta)
+    """
+
+    def __init__(self, table: SparseTable, mode: str = "sync",
+                 k_steps: int = 1, lr: float = 0.01):
+        if mode not in ("sync", "async", "geo"):
+            raise ValueError(f"unknown communicator mode {mode!r}")
+        if mode == "geo" and k_steps < 1:
+            raise ValueError("geo mode requires k_steps >= 1")
+        self.table = table
+        self.mode = mode
+        self.k_steps = k_steps
+        self.lr = lr
+        self._step = 0
+        self._pending: Dict[int, np.ndarray] = {}
+
+    def on_gradient(self, ids, grads) -> None:
+        """Called with the batch's unique ids + their dense grads."""
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads)
+        if self.mode in ("sync", "async"):
+            self.table.push(ids, grads, lr=self.lr)
+            return
+        # geo: merge into the local delta store
+        for i, gid in enumerate(ids):
+            gid = int(gid)
+            if gid in self._pending:
+                self._pending[gid] = self._pending[gid] + grads[i]
+            else:
+                self._pending[gid] = grads[i].copy()
+
+    def step(self) -> None:
+        """Advance the trainer step; geo mode flushes every k_steps."""
+        self._step += 1
+        if self.mode == "geo" and self._step % self.k_steps == 0:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._pending:
+            return
+        ids = np.asarray(list(self._pending.keys()), np.int64)
+        grads = np.stack(list(self._pending.values()))
+        self._pending.clear()
+        self.table.push(ids, grads, lr=self.lr)

@@ -1,0 +1,59 @@
+"""SparseEmbedding: device-light embedding over a host SparseTable
+(reference: the distributed lookup_table path — operators/
+lookup_table_op + parameter_prefetch.cc pull, push via communicator;
+python paddle.static.nn.sparse_embedding).
+
+Per step: unique(batch ids) -> table.pull -> [n_unique, dim] device rows
+-> gather by inverse index (differentiable) -> backward hook hands the
+dense [n_unique, dim] row-grad to the Communicator.  The device never
+materializes [vocab, dim] — a 1M+ vocab trains with only the touched rows
+resident."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...nn.layer import Layer
+from ...ops.dispatch import apply
+from ...tensor import Tensor
+from .communicator import Communicator
+from .table import SparseTable
+
+
+class SparseEmbedding(Layer):
+    def __init__(self, dim: int, table: SparseTable = None,
+                 communicator: Communicator = None, rule: str = "sgd",
+                 lr: float = 0.01, mode: str = "sync", k_steps: int = 1,
+                 **table_kw):
+        super().__init__()
+        self.table = table or SparseTable(dim, rule=rule, **table_kw)
+        self.communicator = communicator or Communicator(
+            self.table, mode=mode, k_steps=k_steps, lr=lr)
+        self.dim = dim
+
+    def forward(self, ids):
+        ids_np = np.asarray(ids._value if isinstance(ids, Tensor) else ids)
+        shape = ids_np.shape
+        uids, inverse = np.unique(ids_np.reshape(-1), return_inverse=True)
+        rows_np = self.table.pull(uids, create=self.training)
+        rows = Tensor(jnp.asarray(rows_np), stop_gradient=not self.training)
+        inv = jnp.asarray(inverse.astype(np.int32))
+
+        if self.training:
+            comm = self.communicator
+
+            def push_hook(grad):
+                comm.on_gradient(uids, np.asarray(grad._value))
+                return grad
+
+            rows.register_hook(push_hook)
+
+        def gather(r, idx):
+            return r[idx].reshape(shape + (self.dim,))
+
+        return apply("sparse_embedding_lookup", gather, rows, inv)
+
+    def step(self):
+        """Advance the communicator (geo flush cadence)."""
+        self.communicator.step()

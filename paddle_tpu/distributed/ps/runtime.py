@@ -1,0 +1,82 @@
+"""In-process PS runtime: the table registry + worker lifecycle behind the
+fleet facade (reference fleet/runtime/the_one_ps.py:400 — _init_server
+:448 loads tables, _init_worker :759 starts the communicator, :826
+stop_worker; parameter_server_runtime.py:30).
+
+Single-host: tables live in this process.  Multi-host deployments put the
+same SparseTable shards behind a DCN RPC boundary; the worker-side surface
+(sparse_embedding / pull / push / flush) is unchanged."""
+from __future__ import annotations
+
+from typing import Dict
+
+from .communicator import Communicator
+from .embedding import SparseEmbedding
+from .table import SparseTable
+
+_tables: Dict[str, SparseTable] = {}
+_embeddings: Dict[str, SparseEmbedding] = {}
+_server_running = False
+
+
+def _mode_from_strategy(strategy):
+    """sync / async / geo from DistributedStrategy (proto:108-118)."""
+    if strategy is None or not getattr(strategy, "a_sync", False):
+        return "sync", 1
+    k = int(getattr(strategy.a_sync_configs, "k_steps", 0) or 0)
+    if k > 0:
+        return "geo", k
+    return "async", 1
+
+
+def sparse_embedding(name: str, dim: int, rule: str = "sgd", lr: float = 0.01,
+                     strategy=None, **table_kw) -> SparseEmbedding:
+    if name in _embeddings:
+        emb = _embeddings[name]
+        if emb.dim != dim or emb.table.rule != rule:
+            raise ValueError(
+                f"sparse_embedding {name!r} already registered with "
+                f"dim={emb.dim}, rule={emb.table.rule!r}; got dim={dim}, "
+                f"rule={rule!r}")
+        return emb
+    mode, k = _mode_from_strategy(strategy)
+    table = _tables.get(name)
+    if table is None:
+        table = _tables[name] = SparseTable(dim, rule=rule, **table_kw)
+    emb = SparseEmbedding(dim, table=table,
+                          communicator=Communicator(table, mode=mode,
+                                                    k_steps=k, lr=lr))
+    _embeddings[name] = emb
+    return emb
+
+
+def get_table(name: str) -> SparseTable:
+    return _tables[name]
+
+
+def init_server(*_a, **_k):
+    global _server_running
+    _server_running = True
+
+
+def run_server():
+    # single-process: tables are already reachable; nothing to serve
+    global _server_running
+    _server_running = True
+
+
+def init_worker(strategy=None):
+    # communicators are created with their embeddings; nothing extra here
+    return None
+
+
+def stop_worker():
+    """Flush any pending geo deltas (reference Communicator::Stop)."""
+    for emb in _embeddings.values():
+        emb.communicator.flush()
+
+
+def reset():
+    """Test helper: drop all registered tables/embeddings."""
+    _tables.clear()
+    _embeddings.clear()
