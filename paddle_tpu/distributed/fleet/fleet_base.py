@@ -91,8 +91,8 @@ class Fleet:
 
         runtime.stop_worker()
 
-    def sparse_embedding(self, name: str, dim: int, rule: str = "sgd",
-                         lr: float = 0.01, **table_kw):
+    def sparse_embedding(self, name: str, dim: int, rule: str = None,
+                         lr: float = None, **table_kw):
         """Create (or fetch) a PS-backed sparse embedding whose merge policy
         follows the strategy's a_sync / a_sync_configs.k_steps flags
         (distributed_strategy.proto:108-118: sync / async / geo)."""

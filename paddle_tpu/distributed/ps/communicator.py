@@ -1,8 +1,8 @@
 """Gradient communicator: sync / async / geo merge policies (reference
 service/communicator.cc — AsyncCommunicator:(send queue, merge add),
-GeoCommunicator:(k-step delta push), SyncCommunicator; selected by the
-fleet DistributedStrategy a_sync / a_sync_configs.k_steps flags,
-distributed_strategy.proto:108-118)."""
+GeoCommunicator:(local training + k-step weight-delta push),
+SyncCommunicator; selected by the fleet DistributedStrategy a_sync /
+a_sync_configs.k_steps flags, distributed_strategy.proto:108-118)."""
 from __future__ import annotations
 
 from typing import Dict
@@ -18,8 +18,11 @@ class Communicator:
     mode='sync'  : push immediately (barrier per step — the k=0 case)
     mode='async' : push immediately, no barrier semantics (single process
                    collapses to sync; the distinction matters cross-host)
-    mode='geo'   : accumulate row deltas locally; push the merged deltas
-                   every `k_steps` trainer steps (geo-async k-step delta)
+    mode='geo'   : TRAIN LOCALLY every step (an SGD overlay on the pulled
+                   rows, so the trainer sees its own updates immediately)
+                   and push the accumulated WEIGHT DELTAS to the global
+                   table every `k_steps` (reference GeoCommunicator — the
+                   table receives deltas, not gradients)
     """
 
     def __init__(self, table: SparseTable, mode: str = "sync",
@@ -33,7 +36,19 @@ class Communicator:
         self.k_steps = k_steps
         self.lr = lr
         self._step = 0
-        self._pending: Dict[int, np.ndarray] = {}
+        self._delta: Dict[int, np.ndarray] = {}   # pending weight deltas
+
+    def apply_overlay(self, ids: np.ndarray, rows: np.ndarray) -> np.ndarray:
+        """Geo: overlay the local (not-yet-pushed) deltas onto pulled rows
+        so local training sees its own updates between flushes."""
+        if self.mode != "geo" or not self._delta:
+            return rows
+        out = rows.copy()
+        for i, gid in enumerate(np.asarray(ids).reshape(-1)):
+            d = self._delta.get(int(gid))
+            if d is not None:
+                out[i] = out[i] + d
+        return out
 
     def on_gradient(self, ids, grads) -> None:
         """Called with the batch's unique ids + their dense grads."""
@@ -42,13 +57,14 @@ class Communicator:
         if self.mode in ("sync", "async"):
             self.table.push(ids, grads, lr=self.lr)
             return
-        # geo: merge into the local delta store
+        # geo: local SGD step — record the weight delta
         for i, gid in enumerate(ids):
             gid = int(gid)
-            if gid in self._pending:
-                self._pending[gid] = self._pending[gid] + grads[i]
+            d = (-self.lr * grads[i]).astype(np.float32)
+            if gid in self._delta:
+                self._delta[gid] = self._delta[gid] + d
             else:
-                self._pending[gid] = grads[i].copy()
+                self._delta[gid] = d
 
     def step(self) -> None:
         """Advance the trainer step; geo mode flushes every k_steps."""
@@ -57,9 +73,10 @@ class Communicator:
             self.flush()
 
     def flush(self) -> None:
-        if not self._pending:
+        """Push accumulated weight deltas to the global table (geo)."""
+        if not self._delta:
             return
-        ids = np.asarray(list(self._pending.keys()), np.int64)
-        grads = np.stack(list(self._pending.values()))
-        self._pending.clear()
-        self.table.push(ids, grads, lr=self.lr)
+        ids = np.asarray(list(self._delta.keys()), np.int64)
+        deltas = np.stack(list(self._delta.values()))
+        self._delta.clear()
+        self.table.apply_deltas(ids, deltas)

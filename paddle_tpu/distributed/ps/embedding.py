@@ -37,6 +37,7 @@ class SparseEmbedding(Layer):
         shape = ids_np.shape
         uids, inverse = np.unique(ids_np.reshape(-1), return_inverse=True)
         rows_np = self.table.pull(uids, create=self.training)
+        rows_np = self.communicator.apply_overlay(uids, rows_np)
         rows = Tensor(jnp.asarray(rows_np), stop_gradient=not self.training)
         inv = jnp.asarray(inverse.astype(np.int32))
 

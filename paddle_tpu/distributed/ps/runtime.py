@@ -16,7 +16,6 @@ from .table import SparseTable
 
 _tables: Dict[str, SparseTable] = {}
 _embeddings: Dict[str, SparseEmbedding] = {}
-_server_running = False
 
 
 def _mode_from_strategy(strategy):
@@ -29,23 +28,35 @@ def _mode_from_strategy(strategy):
     return "async", 1
 
 
-def sparse_embedding(name: str, dim: int, rule: str = "sgd", lr: float = 0.01,
+def sparse_embedding(name: str, dim: int, rule: str = None, lr: float = None,
                      strategy=None, **table_kw) -> SparseEmbedding:
+    """Create or fetch the named embedding.  On fetch, any EXPLICITLY
+    passed config (rule/lr) must match the original registration — a
+    silent mismatch would train with the wrong optimizer settings."""
     if name in _embeddings:
         emb = _embeddings[name]
-        if emb.dim != dim or emb.table.rule != rule:
+        cm = emb.communicator
+        mismatches = []
+        if emb.dim != dim:
+            mismatches.append(f"dim {emb.dim} != {dim}")
+        if rule is not None and emb.table.rule != rule:
+            mismatches.append(f"rule {emb.table.rule!r} != {rule!r}")
+        if lr is not None and cm.lr != lr:
+            mismatches.append(f"lr {cm.lr} != {lr}")
+        if mismatches:
             raise ValueError(
-                f"sparse_embedding {name!r} already registered with "
-                f"dim={emb.dim}, rule={emb.table.rule!r}; got dim={dim}, "
-                f"rule={rule!r}")
+                f"sparse_embedding {name!r} already registered; "
+                + "; ".join(mismatches))
         return emb
     mode, k = _mode_from_strategy(strategy)
     table = _tables.get(name)
     if table is None:
-        table = _tables[name] = SparseTable(dim, rule=rule, **table_kw)
+        table = _tables[name] = SparseTable(dim, rule=rule or "sgd",
+                                            **table_kw)
     emb = SparseEmbedding(dim, table=table,
-                          communicator=Communicator(table, mode=mode,
-                                                    k_steps=k, lr=lr))
+                          communicator=Communicator(
+                              table, mode=mode, k_steps=k,
+                              lr=0.01 if lr is None else lr))
     _embeddings[name] = emb
     return emb
 
@@ -55,14 +66,13 @@ def get_table(name: str) -> SparseTable:
 
 
 def init_server(*_a, **_k):
-    global _server_running
-    _server_running = True
+    # single-process: tables are created lazily; nothing to load
+    return None
 
 
 def run_server():
     # single-process: tables are already reachable; nothing to serve
-    global _server_running
-    _server_running = True
+    return None
 
 
 def init_worker(strategy=None):

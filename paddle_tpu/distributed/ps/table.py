@@ -159,6 +159,18 @@ class SparseTable:
             if mask.any():
                 self._shards[s].push(ids[mask], grads[mask], lr, **self.hp)
 
+    def apply_deltas(self, ids, deltas) -> None:
+        """Add weight deltas directly to rows (geo-communicator push —
+        rule-independent: the local trainer already applied its optimizer)."""
+        ids, shard_of = self._route(ids)
+        deltas = np.asarray(deltas, np.float32).reshape(len(ids), self.dim)
+        for s in range(self.num_shards):
+            mask = shard_of == s
+            if mask.any():
+                sh = self._shards[s]
+                slots = sh.slots_for(ids[mask], create=True)
+                np.add.at(sh.values, slots, deltas[mask].astype(sh.dtype))
+
     @property
     def size(self) -> int:
         """Number of materialized rows (<< vocab for sparse workloads)."""
@@ -170,20 +182,27 @@ class SparseTable:
         """Rows AND rowwise-optimizer accumulators (a resume that re-zeroed
         adam/adagrad state would jump the effective step size)."""
         fields = self._ACC_FIELDS.get(self.rule, ())
-        ids, rows = [], []
-        accs = {f: [] for f in fields}
+        ids_parts, row_parts = [], []
+        acc_parts = {f: [] for f in fields}
         for s in self._shards:
-            for gid, slot in s.index.items():
-                ids.append(gid)
-                rows.append(s.values[slot])
-                for f in fields:
-                    accs[f].append(getattr(s, f)[slot])
-        out = {"ids": np.asarray(ids, np.int64),
-               "rows": (np.stack(rows) if rows
-                        else np.zeros((0, self.dim), np.float32))}
+            if not s.index:
+                continue
+            gids = np.fromiter(s.index.keys(), np.int64, len(s.index))
+            slots = np.fromiter(s.index.values(), np.int64, len(s.index))
+            ids_parts.append(gids)
+            row_parts.append(s.values[slots])
+            for f in fields:
+                acc_parts[f].append(getattr(s, f)[slots])
+        if not ids_parts:
+            out = {"ids": np.zeros((0,), np.int64),
+                   "rows": np.zeros((0, self.dim), np.float32)}
+            for f in fields:
+                out[f] = np.zeros((0,), np.float32)
+            return out
+        out = {"ids": np.concatenate(ids_parts),
+               "rows": np.concatenate(row_parts)}
         for f in fields:
-            out[f] = (np.stack(accs[f]) if accs[f]
-                      else np.zeros((0,), np.float32))
+            out[f] = np.concatenate(acc_parts[f])
         return out
 
     def set_state_dict(self, d):

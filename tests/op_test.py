@@ -1,0 +1,132 @@
+"""OpTest harness (reference fluid/tests/unittests/op_test.py:255
+check_output :1054, check_grad :1362 / get_numeric_gradient :110).
+
+TPU-shape: every public op in ops/ + nn/functional/ is swept through
+  check_output — op executes on generated inputs, outputs finite,
+  and (where applicable)
+  check_grad — analytic gradients from the autograd tape vs central-
+  difference numeric gradients.
+Per-op input specs live in OVERRIDES; untestable ops carry a WAIVED
+reason (the reference's white_list/op_accuracy_white_list analog); a
+meta-test enforces >=90% swept coverage with zero unclassified ops."""
+from __future__ import annotations
+
+import importlib
+import inspect
+from typing import Callable, Dict, List
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor import Tensor
+
+OP_MODULES = [
+    "paddle_tpu.ops.math",
+    "paddle_tpu.ops.manipulation",
+    "paddle_tpu.ops.logic",
+    "paddle_tpu.ops.creation",
+    "paddle_tpu.ops.search",
+    "paddle_tpu.ops.linalg",
+    "paddle_tpu.ops.random_ops",
+    "paddle_tpu.ops.attention",
+    "paddle_tpu.nn.functional.activation",
+    "paddle_tpu.nn.functional.common",
+    "paddle_tpu.nn.functional.conv",
+    "paddle_tpu.nn.functional.loss",
+    "paddle_tpu.nn.functional.norm",
+    "paddle_tpu.nn.functional.pooling",
+]
+
+
+def discover_ops() -> Dict[str, Callable]:
+    ops = {}
+    for mname in OP_MODULES:
+        mod = importlib.import_module(mname)
+        for n, f in vars(mod).items():
+            if (callable(f) and not n.startswith("_")
+                    and inspect.isfunction(f) and f.__module__ == mname):
+                ops[f"{mname.rsplit('.', 1)[-1]}.{n}"] = f
+    return ops
+
+
+def t(arr):
+    return paddle.to_tensor(np.asarray(arr))
+
+
+def fmat(rng, *shape, lo=0.2, hi=0.9):
+    """Floats away from non-smooth kinks (0, 1) for stable numeric grads."""
+    return (rng.uniform(lo, hi, shape)).astype(np.float32)
+
+
+class Spec:
+    """One op's test recipe."""
+
+    def __init__(self, make_args, kwargs=None, check_grad=True,
+                 grad_args=None, rtol=5e-2, out_index=0):
+        self.make_args = make_args
+        self.kwargs = kwargs or {}
+        self.check_grad = check_grad
+        self.grad_args = grad_args  # indices of args to grad-check (None=all float tensors)
+        self.rtol = rtol
+        self.out_index = out_index
+
+
+def default_spec(**kw):
+    return Spec(lambda rng: [t(fmat(rng, 3, 4))], **kw)
+
+
+def run_check_output(fn, spec, rng):
+    args = spec.make_args(rng)
+    out = fn(*args, **spec.kwargs)
+    leaves = out if isinstance(out, (tuple, list)) else [out]
+    for o in leaves:
+        if isinstance(o, Tensor):
+            a = np.asarray(o._value)
+            if np.issubdtype(a.dtype, np.floating):
+                assert np.isfinite(a).all(), "non-finite output"
+    return args, out
+
+
+def run_check_grad(fn, spec, rng, eps=1e-2):
+    """Numeric-vs-analytic gradient (get_numeric_gradient analog)."""
+    args = spec.make_args(rng)
+    grad_idx = spec.grad_args
+    if grad_idx is None:
+        grad_idx = [i for i, a in enumerate(args)
+                    if isinstance(a, Tensor)
+                    and np.issubdtype(np.asarray(a._value).dtype,
+                                      np.floating)]
+    if not grad_idx:
+        return
+
+    def scalar_out(arglist):
+        out = fn(*arglist, **spec.kwargs)
+        if isinstance(out, (tuple, list)):
+            out = out[spec.out_index]
+        return out.astype("float32").sum()
+
+    # analytic
+    for i in grad_idx:
+        args[i].stop_gradient = False
+    loss = scalar_out(args)
+    loss.backward()
+    for i in grad_idx:
+        a = args[i]
+        analytic = np.asarray(a.grad._value) if a.grad is not None else \
+            np.zeros(np.asarray(a._value).shape, np.float32)
+        base = np.asarray(a._value).astype(np.float64)
+        numeric = np.zeros_like(base)
+        flat = base.reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for j in range(flat.size):
+            for sgn in (1.0, -1.0):
+                pert = flat.copy()
+                pert[j] += sgn * eps
+                trial = [x for x in args]
+                trial[i] = t(pert.reshape(base.shape).astype(np.float32))
+                val = float(scalar_out(trial)._value)
+                num_flat[j] += sgn * val / (2 * eps)
+        scale = max(np.abs(numeric).max(), np.abs(analytic).max(), 1.0)
+        np.testing.assert_allclose(analytic, numeric, rtol=spec.rtol,
+                                   atol=spec.rtol * scale,
+                                   err_msg=f"grad of arg {i}")

@@ -1,0 +1,16 @@
+"""Golden API-surface check (reference: paddle/fluid/API.spec +
+tools/print_signatures.py — CI diffs every public signature)."""
+import os
+import subprocess
+import sys
+
+
+def test_api_spec_matches_golden():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "gen_api_spec.py")],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert res.returncode == 0, (
+        "public API surface diverged from API.spec:\n" + res.stdout[-3000:]
+        + "\nReview the change, then run tools/gen_api_spec.py --update")

@@ -130,8 +130,11 @@ class TestSparseEmbedding:
         ids = np.asarray(sorted(touched))[:10]
         assert np.abs(emb.table.pull(ids)).max() > 0
 
-    def test_geo_mode_defers_then_flushes(self):
-        table = SparseTable(dim=2, rule="sum", initializer="zeros")
+    def test_geo_mode_trains_locally_pushes_deltas(self):
+        """Reference GeoCommunicator semantics: the trainer sees its OWN
+        updates immediately (local overlay), while the global table only
+        receives the accumulated weight deltas every k steps."""
+        table = SparseTable(dim=2, rule="sgd", initializer="zeros")
         comm = Communicator(table, mode="geo", k_steps=3, lr=1.0)
         emb = SparseEmbedding(2, table=table, communicator=comm)
         emb.train()
@@ -140,15 +143,19 @@ class TestSparseEmbedding:
             out = emb(ids)
             out.sum().backward()
             emb.step()
-            before_flush = table.pull([4, 9], )
             if i < 3:
-                # deltas pending, table rows still zero
-                np.testing.assert_allclose(before_flush, 0.0)
-        # after the 3rd step the merged deltas hit the table:
-        # id 4 appears twice per step x 3 steps = 6; id 9 once x 3 = 3
+                # global table untouched before the flush...
+                np.testing.assert_allclose(table.pull([4, 9]), 0.0)
+                # ...but LOCAL training sees the overlay: the next forward
+                # returns the locally-updated rows (id4 grad=2/step,
+                # id9 grad=1/step; lr=1 -> delta -2/-1 per step)
+                local = emb(ids).numpy()
+                np.testing.assert_allclose(local[0], [-2.0 * i] * 2)
+                np.testing.assert_allclose(local[2], [-1.0 * i] * 2)
+        # after the 3rd step the accumulated WEIGHT DELTAS hit the table
         got = table.pull([4, 9])
-        np.testing.assert_allclose(got[0], [6.0, 6.0])
-        np.testing.assert_allclose(got[1], [3.0, 3.0])
+        np.testing.assert_allclose(got[0], [-6.0, -6.0])
+        np.testing.assert_allclose(got[1], [-3.0, -3.0])
 
 
 class TestFleetWiring:

@@ -1,0 +1,111 @@
+"""Generate the golden API surface spec (reference:
+tools/print_signatures.py -> paddle/fluid/API.spec, diffed by CI via
+check_api_approvals.sh).
+
+Each line: `<qualified name> (<signature>)` for every public callable/class
+reachable from the listed public modules.  Run with --update to rewrite
+API.spec; tests/test_api_spec.py fails when the live surface diverges from
+the checked-in golden file."""
+from __future__ import annotations
+
+import importlib
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PUBLIC_MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.amp",
+    "paddle_tpu.autograd",
+    "paddle_tpu.distribution",
+    "paddle_tpu.distributed",
+    "paddle_tpu.distributed.fleet",
+    "paddle_tpu.distributed.ps",
+    "paddle_tpu.hapi",
+    "paddle_tpu.incubate",
+    "paddle_tpu.inference",
+    "paddle_tpu.io",
+    "paddle_tpu.jit",
+    "paddle_tpu.metric",
+    "paddle_tpu.nn",
+    "paddle_tpu.nn.functional",
+    "paddle_tpu.nn.initializer",
+    "paddle_tpu.onnx",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.optimizer.lr",
+    "paddle_tpu.slim",
+    "paddle_tpu.static",
+    "paddle_tpu.text",
+    "paddle_tpu.utils",
+    "paddle_tpu.vision",
+    "paddle_tpu.vision.models",
+    "paddle_tpu.vision.transforms",
+]
+
+
+def _sig(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def collect() -> list:
+    lines = []
+    for mname in PUBLIC_MODULES:
+        mod = importlib.import_module(mname)
+        for name in sorted(vars(mod)):
+            if name.startswith("_"):
+                continue
+            obj = getattr(mod, name)
+            if inspect.ismodule(obj):
+                continue
+            qual = f"{mname}.{name}"
+            if inspect.isclass(obj):
+                lines.append(f"{qual} {_sig(obj)}")
+                for mn in sorted(vars(obj)):
+                    if mn.startswith("_") and mn != "__init__":
+                        continue
+                    m = inspect.getattr_static(obj, mn)
+                    if isinstance(m, (staticmethod, classmethod)):
+                        m = m.__func__
+                    if inspect.isfunction(m):
+                        lines.append(f"{qual}.{mn} {_sig(m)}")
+            elif callable(obj):
+                lines.append(f"{qual} {_sig(obj)}")
+    # dedupe re-exports while keeping order deterministic
+    return sorted(set(lines))
+
+
+def main():
+    spec_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "API.spec")
+    lines = collect()
+    if "--update" in sys.argv:
+        with open(spec_path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        print(f"wrote {len(lines)} entries to {spec_path}")
+        return 0
+    with open(spec_path) as f:
+        golden = f.read().splitlines()
+    cur = set(lines)
+    gold = set(golden)
+    removed = sorted(gold - cur)
+    added = sorted(cur - gold)
+    if removed or added:
+        for r in removed[:20]:
+            print(f"- {r}")
+        for a in added[:20]:
+            print(f"+ {a}")
+        print(f"API surface changed: {len(removed)} removed, "
+              f"{len(added)} added. Run tools/gen_api_spec.py --update "
+              "after reviewing.")
+        return 1
+    print(f"API surface matches ({len(lines)} entries).")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
