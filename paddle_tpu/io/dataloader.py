@@ -104,15 +104,24 @@ class _NativeArrayIter:
         return _to_tensor_tree(list(out))
 
 
-def _mp_worker(dataset, collate_fn, index_q, result_q, use_shm):
-    """Worker process body (dataloader_iter.py:100 _worker_loop analog)."""
+def _mp_worker(dataset, collate_fn, index_q, result_q, use_shm,
+               worker_init_fn, worker_id):
+    """Worker process body (dataloader_iter.py:100 _worker_loop analog).
+    Lives for the pool's lifetime (persistent_workers); a bad sample
+    reports an error for ITS batch and the worker keeps serving."""
     from multiprocessing import shared_memory
 
+    if worker_init_fn is not None:
+        try:
+            worker_init_fn(worker_id)
+        except Exception as e:
+            result_q.put((("__init__", worker_id), "error", repr(e)))
+            return
     while True:
         item = index_q.get()
         if item is None:
             return
-        i, idxs = item
+        i, idxs = item  # i = (epoch, index) tag, echoed back verbatim
         try:
             batch = collate_fn([dataset[j] for j in idxs])
             flat, spec = _flatten_np(batch)
@@ -129,9 +138,8 @@ def _mp_worker(dataset, collate_fn, index_q, result_q, use_shm):
                 result_q.put((i, "shm", (blocks, spec)))
             else:
                 result_q.put((i, "pickle", (flat, spec)))
-        except Exception as e:  # propagate to parent
+        except Exception as e:  # report, but keep the worker alive
             result_q.put((i, "error", repr(e)))
-            return
 
 
 def _flatten_np(batch):
@@ -161,35 +169,121 @@ def _unflatten_np(flat, spec):
     return {k: _unflatten_np(flat, s) for k, s in zip(a, b)}
 
 
-class _ProcessIter:
-    """Feed path 2: forked worker processes + shared-memory transport
-    (reference _DataLoaderIterMultiProcess, dataloader_iter.py:230)."""
+def _discard_result(kind, payload):
+    """Free shared memory of a result that will never be consumed."""
+    if kind != "shm":
+        return
+    from multiprocessing import shared_memory
+
+    blocks, _spec = payload
+    for name, _shape, _dtype in blocks:
+        try:
+            shm = shared_memory.SharedMemory(name=name)
+            shm.close()
+            shm.unlink()
+        except Exception:
+            pass
+
+
+class _WorkerPool:
+    """Forked worker processes + shared-memory transport, reusable across
+    epochs (persistent_workers) with a BOUNDED in-flight window — workers
+    cannot race ahead and materialize the epoch in shared memory
+    (reference _DataLoaderIterMultiProcess outstanding-capacity logic,
+    dataloader_iter.py:230)."""
 
     def __init__(self, loader):
         from multiprocessing import shared_memory  # noqa: F401 (probe)
 
-        self.loader = loader
         ctx = mp.get_context("fork")
+        self.n_workers = max(1, loader.num_workers)
+        # in-flight cap: prefetch_factor batches per worker
+        self.capacity = max(2, loader.prefetch_factor) * self.n_workers
         self._index_q = ctx.Queue()
-        self._result_q = ctx.Queue()
-        batches = list(iter(loader.batch_sampler))
-        self._n_batches = len(batches)
-        for i, idxs in enumerate(batches):
-            self._index_q.put((i, list(idxs)))
-        n_workers = max(1, loader.num_workers)
-        for _ in range(n_workers):
-            self._index_q.put(None)
+        self._result_q = ctx.Queue(maxsize=self.capacity + self.n_workers)
         self._procs = [
             ctx.Process(target=_mp_worker,
                         args=(loader.dataset, loader.collate_fn,
                               self._index_q, self._result_q,
-                              loader.use_shared_memory),
+                              loader.use_shared_memory,
+                              loader.worker_init_fn, wid),
                         daemon=True)
-            for _ in range(n_workers)]
+            for wid in range(self.n_workers)]
         for p in self._procs:
             p.start()
-        self._pending = {}
+        self.alive = True
+        self.epoch = 0
+
+    def submit(self, i, idxs):
+        self._index_q.put((i, list(idxs)))
+
+    def get(self, timeout):
+        deadline = (None if not timeout
+                    else __import__("time").monotonic() + timeout)
+        while True:
+            try:
+                return self._result_q.get(timeout=1.0)
+            except queue.Empty:
+                if deadline is not None and \
+                        __import__("time").monotonic() > deadline:
+                    raise RuntimeError(
+                        f"DataLoader timed out after {timeout}s waiting "
+                        "for a worker batch (timeout= parameter)")
+                if not any(p.is_alive() for p in self._procs):
+                    raise RuntimeError(
+                        "all DataLoader workers died (did worker_init_fn "
+                        "or the dataset crash the processes?)")
+
+    def _drain(self):
+        """Free shm of results that will never be consumed."""
+        while True:
+            try:
+                _tag, kind, payload = self._result_q.get_nowait()
+            except queue.Empty:
+                return
+            _discard_result(kind, payload)
+
+    def shutdown(self):
+        if not self.alive:
+            return
+        self.alive = False
+        for _ in self._procs:
+            try:
+                self._index_q.put(None)
+            except Exception:
+                pass
+        self._drain()
+        for p in self._procs:
+            p.join(timeout=1)
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        self._drain()
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+
+class _ProcessIter:
+    """One epoch over a _WorkerPool: indices stream into the pool as
+    results are consumed (window = pool.capacity)."""
+
+    def __init__(self, loader, pool):
+        self.loader = loader
+        self.pool = pool
+        pool.epoch += 1
+        self._epoch = pool.epoch
+        self._batches = list(iter(loader.batch_sampler))
+        self._n_batches = len(self._batches)
+        self._sent = 0
         self._next_out = 0
+        self._pending = {}
+        while self._sent < min(pool.capacity, self._n_batches):
+            pool.submit((self._epoch, self._sent), self._batches[self._sent])
+            self._sent += 1
 
     def __iter__(self):
         return self
@@ -198,16 +292,35 @@ class _ProcessIter:
         from multiprocessing import shared_memory
 
         if self._next_out >= self._n_batches:
-            self._shutdown()
+            if not self.loader.persistent_workers:
+                self.pool.shutdown()
             raise StopIteration
         while self._next_out not in self._pending:
-            i, kind, payload = self._result_q.get()
-            if kind == "error":
-                self._shutdown()
-                raise RuntimeError(f"DataLoader worker failed: {payload}")
+            tag, kind, payload = self.pool.get(self.loader.timeout)
+            epoch, i = tag
+            if epoch == "__init__":
+                self.pool.shutdown()
+                raise RuntimeError(
+                    f"DataLoader worker_init_fn failed in worker {i}: "
+                    f"{payload}")
+            if epoch != self._epoch:
+                _discard_result(kind, payload)  # stale abandoned-epoch batch
+                continue
             self._pending[i] = (kind, payload)
-        kind, payload = self._pending.pop(self._next_out)
+        kind, payload = self._pending[self._next_out]
+        if kind == "error":
+            # poison stays pending: a retried next() re-raises instead of
+            # hanging on a result that will never arrive
+            if not self.loader.persistent_workers:
+                self.pool.shutdown()
+            raise RuntimeError(f"DataLoader worker failed: {payload}")
+        del self._pending[self._next_out]
         self._next_out += 1
+        # backpressure: one new index per consumed batch
+        if self._sent < self._n_batches:
+            self.pool.submit((self._epoch, self._sent),
+                             self._batches[self._sent])
+            self._sent += 1
         if kind == "shm":
             blocks, spec = payload
             flat = []
@@ -225,19 +338,6 @@ class _ProcessIter:
         if isinstance(out, tuple):
             out = list(out)
         return out
-
-    def _shutdown(self):
-        for p in self._procs:
-            if p.is_alive():
-                p.terminate()
-        for p in self._procs:
-            p.join(timeout=1)
-
-    def __del__(self):
-        try:
-            self._shutdown()
-        except Exception:
-            pass
 
 
 def prefetch_to_device(iterator, depth=2):
@@ -389,6 +489,10 @@ class DataLoader:
         self.drop_last = drop_last
         self.batch_size = batch_size
         self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        self._pool = None
         if isinstance(dataset, IterableDataset):
             self.batch_sampler = None
         elif batch_sampler is not None:
@@ -411,7 +515,11 @@ class DataLoader:
                 and self.batch_sampler is not None
                 and hasattr(mp, "get_context")):
             try:
-                return _ProcessIter(self)
+                if self.persistent_workers:
+                    if self._pool is None or not self._pool.alive:
+                        self._pool = _WorkerPool(self)
+                    return _ProcessIter(self, self._pool)
+                return _ProcessIter(self, _WorkerPool(self))
             except Exception:
                 pass  # fork/shm unavailable → thread fallback
         # path 3: thread workers
