@@ -35,6 +35,8 @@ from .errors import (  # noqa: F401
     enforce_eq,
 )
 from .flags import define_flag, flag_value, get_flags, set_flags  # noqa: F401
+from .monitor import stat_add, stat_get, stat_registry, stat_reset  # noqa: F401
+from .op_version import op_version_registry  # noqa: F401
 from .place import (  # noqa: F401
     CPUPlace,
     CUDAPinnedPlace,

@@ -1,0 +1,70 @@
+"""Global stat counters (reference: platform/monitor.h:77 StatRegistry +
+STAT_ADD/STAT_RESET macros :130 — process-wide named counters exposed to
+Python for observability, e.g. GPU memory stats)."""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class _Stat:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def add(self, v):
+        with self._lock:
+            self.value += v
+            return self.value
+
+    def set(self, v):
+        with self._lock:
+            self.value = v
+
+    def reset(self):
+        self.set(0)
+
+    def get(self):
+        return self.value
+
+
+class StatRegistry:
+    """Named counters (monitor.h:77)."""
+
+    def __init__(self):
+        self._stats: Dict[str, _Stat] = {}
+        self._lock = threading.Lock()
+
+    def get(self, name: str) -> _Stat:
+        with self._lock:
+            s = self._stats.get(name)
+            if s is None:
+                s = self._stats[name] = _Stat()
+            return s
+
+    def stat_values(self) -> Dict[str, int]:
+        with self._lock:
+            return {n: s.get() for n, s in self._stats.items()}
+
+    def reset_all(self):
+        with self._lock:
+            for s in self._stats.values():
+                s.reset()
+
+
+stat_registry = StatRegistry()
+
+
+def stat_add(name: str, value=1):
+    """STAT_ADD analog (monitor.h:130)."""
+    return stat_registry.get(name).add(value)
+
+
+def stat_get(name: str):
+    return stat_registry.get(name).get()
+
+
+def stat_reset(name: str):
+    stat_registry.get(name).reset()

@@ -1,0 +1,67 @@
+"""Op version/compat registry (reference:
+framework/op_version_registry.cc + framework.proto:187 OpVersionMap —
+each op records schema-change checkpoints so serialized programs from
+older framework versions can be validated/upgraded on load)."""
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple
+
+
+class OpCheckpoint(NamedTuple):
+    note: str
+    version: int
+
+
+class OpVersionRegistry:
+    """op name -> ordered schema checkpoints (op_version_registry.cc:
+    OpVersionRegistrar analog)."""
+
+    def __init__(self):
+        self._versions: Dict[str, List[OpCheckpoint]] = {}
+
+    def register(self, op_name: str, note: str) -> "OpVersionRegistry":
+        cps = self._versions.setdefault(op_name, [])
+        cps.append(OpCheckpoint(note, len(cps) + 1))
+        return self
+
+    def version_of(self, op_name: str) -> int:
+        """Current schema version (0 = never changed since 1.0)."""
+        cps = self._versions.get(op_name)
+        return cps[-1].version if cps else 0
+
+    def checkpoints(self, op_name: str) -> List[OpCheckpoint]:
+        return list(self._versions.get(op_name, []))
+
+    def version_map(self) -> Dict[str, int]:
+        """The serialized OpVersionMap (framework.proto:187 analog) —
+        embedded in saved programs for load-time compat checks."""
+        return {n: cps[-1].version for n, cps in self._versions.items()}
+
+    def check_compat(self, saved_map: Dict[str, int]) -> List[str]:
+        """Validate a loaded program's op-version map against the running
+        registry; returns human-readable incompatibility messages."""
+        problems = []
+        for op, saved_v in saved_map.items():
+            cur = self.version_of(op)
+            if saved_v > cur:
+                problems.append(
+                    f"op {op!r}: program saved with schema v{saved_v}, this "
+                    f"framework only knows v{cur} — upgrade the framework")
+            elif saved_v < cur:
+                notes = "; ".join(
+                    c.note for c in self.checkpoints(op)[saved_v:])
+                problems.append(
+                    f"op {op!r}: schema changed since the program was saved "
+                    f"(v{saved_v} -> v{cur}): {notes}")
+        return problems
+
+
+op_version_registry = OpVersionRegistry()
+
+# schema-change history of this framework's own ops
+op_version_registry.register(
+    "batch_norm", "training path fused into a custom-VJP op with "
+    "pivot-shifted single-pass variance (round 3)")
+op_version_registry.register(
+    "dropout", "rng key became an op input (static-replay refresh) "
+    "instead of a closure constant (round 2)")

@@ -97,8 +97,13 @@ class Predictor:
         feeds = [np.zeros(tuple(s.shape), s.dtype) for s in self._in_specs]
         try:
             self._exported.call(*feeds)
-        except Exception:
-            pass  # warmup is best-effort (e.g. int embedding ids need bounds)
+        except Exception as e:
+            # best-effort (e.g. zero int ids may be out of an embedding's
+            # bounds) — but say so instead of hiding a broken artifact
+            import warnings
+
+            warnings.warn(f"Predictor warmup call failed ({e!r}); first "
+                          "real run will compile instead", stacklevel=2)
 
     # --- reference API ------------------------------------------------------
     def get_input_names(self) -> List[str]:
@@ -115,16 +120,49 @@ class Predictor:
 
     def run(self, inputs: Optional[List[np.ndarray]] = None):
         """Execute. inputs: optional positional feeds (else the values set on
-        the input handles)."""
+        the input handles).  Feed batches smaller than the exported bucket
+        are padded + sliced; LARGER batches are chunked over multiple calls
+        and re-concatenated (analysis_predictor Run loop analog)."""
         if inputs is not None:
             for n, a in zip(self._input_names, inputs):
                 self._inputs[n].copy_from_cpu(a)
-        feeds = []
-        batch = None
-        for n, spec in zip(self._input_names, self._in_specs):
+        vals = []
+        for n in self._input_names:
             v = self._inputs[n]._value
             if v is None:
                 raise ValueError(f"input {n!r} not set (copy_from_cpu first)")
+            vals.append(v)
+
+        exported_b = (self._in_specs[0].shape[0]
+                      if len(self._in_specs[0].shape) else None)
+        actual_b = (vals[0].shape[0] if vals and hasattr(vals[0], "shape")
+                    and np.ndim(vals[0]) else None)
+        if (exported_b and actual_b and actual_b > exported_b
+                and all(np.ndim(v) and v.shape[0] == actual_b
+                        for v in vals)):
+            # chunk an oversized batch through the fixed-size executable
+            chunks = []
+            for lo in range(0, actual_b, exported_b):
+                part = [v[lo:lo + exported_b] for v in vals]
+                chunks.append(self._run_once(part))
+            merged = [np.concatenate([c[i] for c in chunks], axis=0)
+                      for i in range(len(self._output_names))]
+            for n, arr in zip(self._output_names, merged):
+                self._outputs[n].copy_from_cpu(arr)
+            return [self._outputs[n].copy_to_cpu()
+                    for n in self._output_names]
+
+        outs = self._run_once(vals)
+        for n, arr in zip(self._output_names, outs):
+            self._outputs[n].copy_from_cpu(arr)
+        return [self._outputs[n].copy_to_cpu() for n in self._output_names]
+
+    def _run_once(self, vals):
+        """One executable call with bucket padding; returns np outputs
+        sliced back to the fed batch."""
+        feeds = []
+        batch = None
+        for n, spec, v in zip(self._input_names, self._in_specs, vals):
             want = tuple(spec.shape)
             if v.shape != want:
                 if (len(v.shape) == len(want) and v.shape[1:] == want[1:]
@@ -143,13 +181,14 @@ class Predictor:
             outs = (outs,)
         if self._output_indices is not None:
             outs = [outs[i] for i in self._output_indices]
-        for n, o in zip(self._output_names, outs):
+        result = []
+        for o in outs:
             arr = np.asarray(o)
             if batch is not None and arr.ndim >= 1 \
                     and arr.shape[0] == self._in_specs[0].shape[0]:
                 arr = arr[:batch]
-            self._outputs[n].copy_from_cpu(arr)
-        return [self._outputs[n].copy_to_cpu() for n in self._output_names]
+            result.append(arr)
+        return result
 
     def clear_intermediate_tensor(self):
         pass

@@ -1,0 +1,60 @@
+"""Op-version registry + StatRegistry counters (reference
+op_version_registry.cc; platform/monitor.h:77)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.framework import (op_version_registry, stat_add, stat_get,
+                                  stat_registry, stat_reset)
+from paddle_tpu.framework.op_version import OpVersionRegistry
+
+
+class TestOpVersion:
+    def test_register_and_version(self):
+        r = OpVersionRegistry()
+        assert r.version_of("foo") == 0
+        r.register("foo", "added axis attr").register("foo", "renamed input")
+        assert r.version_of("foo") == 2
+        assert [c.note for c in r.checkpoints("foo")] == [
+            "added axis attr", "renamed input"]
+
+    def test_compat_check(self):
+        r = OpVersionRegistry()
+        r.register("foo", "change 1").register("foo", "change 2")
+        assert r.check_compat({"foo": 2}) == []
+        older = r.check_compat({"foo": 1})
+        assert older and "change 2" in older[0]
+        newer = r.check_compat({"foo": 3})
+        assert newer and "upgrade the framework" in newer[0]
+        assert r.check_compat({"unknown_op": 1})  # unknown saved > cur 0
+
+    def test_global_registry_has_history(self):
+        assert op_version_registry.version_of("batch_norm") >= 1
+        assert "batch_norm" in op_version_registry.version_map()
+
+
+class TestStatRegistry:
+    def test_add_get_reset(self):
+        stat_reset("t_mem")
+        assert stat_get("t_mem") == 0
+        stat_add("t_mem", 5)
+        stat_add("t_mem", 3)
+        assert stat_get("t_mem") == 8
+        assert stat_registry.stat_values()["t_mem"] == 8
+        stat_reset("t_mem")
+        assert stat_get("t_mem") == 0
+
+    def test_threaded_adds(self):
+        import threading
+
+        stat_reset("t_conc")
+
+        def work():
+            for _ in range(1000):
+                stat_add("t_conc")
+
+        ts = [threading.Thread(target=work) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert stat_get("t_conc") == 4000

@@ -100,3 +100,16 @@ np.save({str(tmp_path / "out.npy")!r}, out)
         predictor.run()
         got = predictor.get_output_handle("out_0").copy_to_cpu()
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestPredictorChunking:
+    def test_larger_than_exported_batch_chunks(self, tmp_path):
+        """A feed batch LARGER than the exported bucket runs in chunks and
+        returns the concatenated outputs (was: ValueError)."""
+        path, x, want = _save_mlp(tmp_path)  # exported batch = 4
+        predictor = inference.create_predictor(inference.Config(path))
+        big = np.concatenate([x, x[:3]], axis=0)  # batch 7 > 4
+        out, = predictor.run([big])
+        assert out.shape == (7, 4)
+        np.testing.assert_allclose(out[:4], want, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(out[4:], want[:3], rtol=1e-5, atol=1e-6)
