@@ -153,7 +153,7 @@ class DGCOptimizer(MetaOptimizerBase):
         self._jit_cache = {}
 
     def _sparsify_fn(self, treedef, sizes):
-        key = (treedef, sizes)
+        key = (treedef, sizes, self.sparsity)
         fn = self._jit_cache.get(key)
         if fn is not None:
             return fn

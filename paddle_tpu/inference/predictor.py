@@ -137,16 +137,35 @@ class Predictor:
                       if len(self._in_specs[0].shape) else None)
         actual_b = (vals[0].shape[0] if vals and hasattr(vals[0], "shape")
                     and np.ndim(vals[0]) else None)
+        # an input is "batched" iff its exported spec shares the leading
+        # batch dim; static side inputs (tables, masks with other leading
+        # dims) are passed through unsliced
+        batched = [len(s.shape) >= 1 and s.shape[0] == exported_b
+                   for s in self._in_specs]
         if (exported_b and actual_b and actual_b > exported_b
-                and all(np.ndim(v) and v.shape[0] == actual_b
-                        for v in vals)):
+                and any(batched)
+                and all((np.ndim(v) and v.shape[0] == actual_b) if b
+                        else v.shape == tuple(s.shape)
+                        for v, b, s in zip(vals, batched, self._in_specs))):
             # chunk an oversized batch through the fixed-size executable
             chunks = []
+            chunk_sizes = []
             for lo in range(0, actual_b, exported_b):
-                part = [v[lo:lo + exported_b] for v in vals]
+                part = [v[lo:lo + exported_b] if b else v
+                        for v, b in zip(vals, batched)]
+                chunk_sizes.append(min(exported_b, actual_b - lo))
                 chunks.append(self._run_once(part))
-            merged = [np.concatenate([c[i] for c in chunks], axis=0)
-                      for i in range(len(self._output_names))]
+            merged = []
+            for i in range(len(self._output_names)):
+                outs_i = [c[i] for c in chunks]
+                if all(o.ndim >= 1 and o.shape[0] == cs
+                       for o, cs in zip(outs_i, chunk_sizes)):
+                    merged.append(np.concatenate(outs_i, axis=0))
+                else:
+                    # non-batched output (scalar/reduced): per-chunk values
+                    # cannot be concatenated meaningfully — return the
+                    # chunk results stacked so nothing is silently dropped
+                    merged.append(np.stack(outs_i, axis=0))
             for n, arr in zip(self._output_names, merged):
                 self._outputs[n].copy_from_cpu(arr)
             return [self._outputs[n].copy_to_cpu()

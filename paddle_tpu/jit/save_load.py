@@ -69,9 +69,13 @@ def save(layer, path, input_spec=None, **configs):
         name = getattr(spec, "name", None)
         input_names.append(name if name else f"x{i}")
     output_names = [f"out_{i}" for i in range(len(exported.out_avals))]
+    from ..framework.op_version import op_version_registry
+
     with open(path + ".pdmeta", "wb") as f:
         pickle.dump({"input_names": input_names,
-                     "output_names": output_names}, f, protocol=4)
+                     "output_names": output_names,
+                     "op_version_map": op_version_registry.version_map()},
+                    f, protocol=4)
 
 
 class TranslatedLayer(Layer):
@@ -108,7 +112,16 @@ def load(path, **configs):
     indices = None
     try:
         with open(path + ".pdmeta", "rb") as f:
-            indices = pickle.load(f).get("output_indices")
+            meta = pickle.load(f)
+        indices = meta.get("output_indices")
+        saved_versions = meta.get("op_version_map")
+        if saved_versions is not None:
+            from ..framework.op_version import op_version_registry
+
+            for msg in op_version_registry.check_compat(saved_versions):
+                import warnings
+
+                warnings.warn(f"loaded program compat: {msg}", stacklevel=2)
     except OSError:
         pass
     return TranslatedLayer(exported, state, output_indices=indices)
