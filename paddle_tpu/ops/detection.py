@@ -1,0 +1,461 @@
+"""Detection ops (reference: paddle/fluid/operators/detection/ — 18k LoC of
+CUDA/C++: iou_similarity_op, box_coder_op, prior_box_op,
+anchor_generator_op, yolo_box_op, multiclass_nms_op, roi_align_op,
+box_clip_op, bipartite_match_op).
+
+TPU-native design: everything is fixed-shape and jittable — NMS returns a
+fixed ``max_out`` slate with a validity count (data-dependent output sizes
+don't exist under XLA); RoI align is a bilinear gather expressed with
+vectorized index arithmetic (no atomics — the backward falls out of
+autodiff of the gather)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import to_tensor_like
+from .dispatch import apply
+
+__all__ = [
+    "iou_similarity", "box_coder", "box_clip", "prior_box",
+    "anchor_generator", "yolo_box", "nms", "multiclass_nms", "roi_align",
+    "bipartite_match", "generate_proposals",
+]
+
+
+def _pairwise_iou(a, b):
+    """a [N,4], b [M,4] (xyxy) -> [N,M] IoU."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * \
+        jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * \
+        jnp.maximum(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """Pairwise IoU (iou_similarity_op.cc)."""
+    return apply("iou_similarity", _pairwise_iou, to_tensor_like(x),
+                 to_tensor_like(y))
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes to image bounds (box_clip_op.cc; im_info rows [h, w, scale])."""
+    def f(boxes, info):
+        h = info[..., 0] / info[..., 2] - 1
+        w = info[..., 1] / info[..., 2] - 1
+        if boxes.ndim == 3:  # [B, N, 4]
+            h = h[:, None]
+            w = w[:, None]
+        x1 = jnp.clip(boxes[..., 0], 0, w)
+        y1 = jnp.clip(boxes[..., 1], 0, h)
+        x2 = jnp.clip(boxes[..., 2], 0, w)
+        y2 = jnp.clip(boxes[..., 3], 0, h)
+        return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+    return apply("box_clip", f, to_tensor_like(input), to_tensor_like(im_info))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """Encode/decode boxes against priors (box_coder_op.cc:
+    EncodeCenterSize / DecodeCenterSize)."""
+    code_type = code_type.lower()
+    norm = 0.0 if box_normalized else 1.0
+
+    def _centers(b):
+        w = b[..., 2] - b[..., 0] + norm
+        h = b[..., 3] - b[..., 1] + norm
+        cx = b[..., 0] + w * 0.5
+        cy = b[..., 1] + h * 0.5
+        return cx, cy, w, h
+
+    def f(prior, var, target):
+        pcx, pcy, pw, ph = _centers(prior)
+        if code_type == "encode_center_size":
+            # target [N,4] against priors [M,4] -> [N,M,4]
+            tcx, tcy, tw, th = _centers(target)
+            dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+            dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+            dw = jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10))
+            dh = jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10))
+            out = jnp.stack([dx, dy, dw, dh], axis=-1)
+            if var is not None:
+                out = out / var
+            return out
+        # decode_center_size: target [N, M, 4] deltas against priors
+        t = target
+        if var is not None:
+            t = t * var
+        b_axis = axis  # 0: priors along dim0 broadcast; 1: along dim1
+        shape = [1, 1]
+        pcx_b = jnp.expand_dims(pcx, 1 - b_axis)
+        pcy_b = jnp.expand_dims(pcy, 1 - b_axis)
+        pw_b = jnp.expand_dims(pw, 1 - b_axis)
+        ph_b = jnp.expand_dims(ph, 1 - b_axis)
+        cx = t[..., 0] * pw_b + pcx_b
+        cy = t[..., 1] * ph_b + pcy_b
+        w = jnp.exp(t[..., 2]) * pw_b
+        h = jnp.exp(t[..., 3]) * ph_b
+        return jnp.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - norm, cy + h / 2 - norm], axis=-1)
+
+    pv = to_tensor_like(prior_box_var) if prior_box_var is not None else None
+    args = [to_tensor_like(prior_box)] + ([pv] if pv is not None else []) + \
+        [to_tensor_like(target_box)]
+    if pv is None:
+        return apply("box_coder", lambda p, t: f(p, None, t), *args)
+    return apply("box_coder", f, *args)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None):
+    """SSD prior boxes for one feature map (prior_box_op.cc).  Returns
+    (boxes [H, W, n_priors, 4], variances broadcast to the same shape)."""
+    x = to_tensor_like(input)
+    img = to_tensor_like(image)
+    H, W = x.shape[-2], x.shape[-1]
+    IH, IW = img.shape[-2], img.shape[-1]
+    step_h = steps[1] or IH / H
+    step_w = steps[0] or IW / W
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+
+    whs = []
+    for ms in min_sizes:
+        for ar in ars:
+            whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+        if max_sizes:
+            mx = max_sizes[min_sizes.index(ms)]
+            whs.append((math.sqrt(ms * mx), math.sqrt(ms * mx)))
+    whs = np.asarray(whs, np.float32)  # [P, 2]
+
+    def f(_x, _img):
+        cx = (jnp.arange(W) + offset) * step_w
+        cy = (jnp.arange(H) + offset) * step_h
+        cxg, cyg = jnp.meshgrid(cx, cy)          # [H, W]
+        cxg = cxg[..., None]
+        cyg = cyg[..., None]
+        w = whs[None, None, :, 0] / 2
+        h = whs[None, None, :, 1] / 2
+        boxes = jnp.stack([(cxg - w) / IW, (cyg - h) / IH,
+                           (cxg + w) / IW, (cyg + h) / IH], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               boxes.shape)
+        return boxes, var
+
+    return apply("prior_box", f, x, img)
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, variances,
+                     stride, offset=0.5, name=None):
+    """FPN-style anchors for one level (anchor_generator_op.cc).  Returns
+    (anchors [H, W, A, 4], variances same shape)."""
+    x = to_tensor_like(input)
+    H, W = x.shape[-2], x.shape[-1]
+    whs = []
+    for size in anchor_sizes:
+        area = float(size) * float(size)
+        for ar in aspect_ratios:
+            w = math.sqrt(area / ar)
+            whs.append((w, w * ar))
+    whs = np.asarray(whs, np.float32)
+
+    def f(_x):
+        cx = (jnp.arange(W) + offset) * stride[0]
+        cy = (jnp.arange(H) + offset) * stride[1]
+        cxg, cyg = jnp.meshgrid(cx, cy)
+        cxg = cxg[..., None]
+        cyg = cyg[..., None]
+        w = whs[None, None, :, 0] / 2
+        h = whs[None, None, :, 1] / 2
+        anchors = jnp.stack([cxg - w, cyg - h, cxg + w, cyg + h], axis=-1)
+        var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                               anchors.shape)
+        return anchors, var
+
+    return apply("anchor_generator", f, x)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, scale_x_y=1.0, name=None):
+    """Decode one YOLO head (yolo_box_op.cc): x [B, A*(5+C), H, W] ->
+    (boxes [B, A*H*W, 4], scores [B, A*H*W, C])."""
+    xt = to_tensor_like(x)
+    A = len(anchors) // 2
+    anc = np.asarray(anchors, np.float32).reshape(A, 2)
+
+    def f(v, imgs):
+        B, _, H, W = v.shape
+        v = v.reshape(B, A, 5 + class_num, H, W)
+        gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+        sig = jax.nn.sigmoid
+        bx = (gx + sig(v[:, :, 0]) * scale_x_y
+              - (scale_x_y - 1) / 2) / W
+        by = (gy + sig(v[:, :, 1]) * scale_x_y
+              - (scale_x_y - 1) / 2) / H
+        in_w = W * downsample_ratio
+        in_h = H * downsample_ratio
+        bw = jnp.exp(v[:, :, 2]) * anc[None, :, 0, None, None] / in_w
+        bh = jnp.exp(v[:, :, 3]) * anc[None, :, 1, None, None] / in_h
+        conf = sig(v[:, :, 4])
+        probs = sig(v[:, :, 5:]) * conf[:, :, None]
+        probs = jnp.where(conf[:, :, None] >= conf_thresh, probs, 0.0)
+        img_h = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        img_w = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * img_w
+        y1 = (by - bh / 2) * img_h
+        x2 = (bx + bw / 2) * img_w
+        y2 = (by + bh / 2) * img_h
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, img_w - 1)
+            y1 = jnp.clip(y1, 0, img_h - 1)
+            x2 = jnp.clip(x2, 0, img_w - 1)
+            y2 = jnp.clip(y2, 0, img_h - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(B, -1, 4)
+        scores = jnp.moveaxis(probs, 2, -1).reshape(B, -1, class_num)
+        return boxes, scores
+
+    return apply("yolo_box", f, xt, to_tensor_like(img_size))
+
+
+def _nms_fixed(boxes, scores, iou_threshold, max_out):
+    """Jittable greedy NMS with a FIXED output slate: returns
+    (indices [max_out] int32, count) — TPU has no dynamic shapes, so the
+    slate is padded with -1 (multiclass_nms_op.cc NMSFast analog)."""
+    n = boxes.shape[0]
+    iou = _pairwise_iou(boxes, boxes)
+
+    def body(carry, _):
+        alive, out, k = carry
+        masked = jnp.where(alive, scores, -jnp.inf)
+        best = jnp.argmax(masked)
+        valid = masked[best] > -jnp.inf
+        out = out.at[k].set(jnp.where(valid, best.astype(jnp.int32), -1))
+        suppress = iou[best] >= iou_threshold
+        alive = alive & ~suppress & valid
+        alive = alive.at[best].set(False)
+        return (alive, out, k + jnp.int32(valid)), None
+
+    alive0 = jnp.ones((n,), bool)
+    out0 = jnp.full((max_out,), -1, jnp.int32)
+    (alive, out, count), _ = jax.lax.scan(
+        body, (alive0, out0, jnp.int32(0)), None, length=max_out)
+    return out, count
+
+
+def nms(boxes, scores, iou_threshold=0.3, max_out=None, name=None):
+    """Greedy hard NMS (nms_op): fixed-size index slate + valid count."""
+    b = to_tensor_like(boxes)
+    max_out = max_out or b.shape[0]
+
+    def f(bb, ss):
+        return _nms_fixed(bb, ss, iou_threshold, max_out)
+
+    return apply("nms", f, b, to_tensor_like(scores))
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=64,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   background_label=-1, name=None):
+    """Per-class NMS + cross-class top-k (multiclass_nms_op.cc).  Fixed
+    slate: returns (out [keep_top_k, 6] rows [label, score, x1, y1, x2, y2]
+    padded with -1, count).  Single-image form: bboxes [N, 4],
+    scores [C, N]."""
+    b = to_tensor_like(bboxes)
+    s = to_tensor_like(scores)
+
+    def f(boxes, sc):
+        C, N = sc.shape
+        top = min(nms_top_k, N)
+
+        def per_class(c_scores):
+            masked = jnp.where(c_scores >= score_threshold, c_scores,
+                               -jnp.inf)
+            vals, idx = jax.lax.top_k(masked, top)
+            cand = boxes[idx]
+            keep, cnt = _nms_fixed(cand, vals, nms_threshold, top)
+            kept_scores = jnp.where(keep >= 0, vals[jnp.maximum(keep, 0)],
+                                    -jnp.inf)
+            kept_boxes = cand[jnp.maximum(keep, 0)]
+            return kept_scores, kept_boxes
+
+        ks, kb = jax.vmap(per_class)(sc)          # [C, top], [C, top, 4]
+        labels = jnp.broadcast_to(jnp.arange(C)[:, None], (C, top))
+        if background_label >= 0:
+            ks = jnp.where(labels == background_label, -jnp.inf, ks)
+        flat_s = ks.reshape(-1)
+        flat_b = kb.reshape(-1, 4)
+        flat_l = labels.reshape(-1)
+        k = min(keep_top_k, flat_s.shape[0])
+        vals, idx = jax.lax.top_k(flat_s, k)
+        valid = vals > -jnp.inf
+        rows = jnp.concatenate(
+            [jnp.where(valid, flat_l[idx], -1)[:, None].astype(jnp.float32),
+             jnp.where(valid, vals, -1)[:, None],
+             jnp.where(valid[:, None], flat_b[idx], -1)], axis=1)
+        if k < keep_top_k:
+            rows = jnp.pad(rows, ((0, keep_top_k - k), (0, 0)),
+                           constant_values=-1)
+        return rows, jnp.sum(valid.astype(jnp.int32))
+
+    return apply("multiclass_nms", f, b, s)
+
+
+def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoI Align (roi_align_op.cc/.cu): bilinear-sampled pooling — a pure
+    gather+average on TPU, differentiable by construction.
+    x [B, C, H, W] (single image B=1 form) or boxes carry batch idx 0."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(feat, rois):
+        B, C, H, W = feat.shape
+        n_roi = rois.shape[0]
+        ratio = sampling_ratio if sampling_ratio > 0 else 2
+        off = 0.5 if aligned else 0.0
+
+        def one_roi(roi):
+            x1, y1, x2, y2 = roi * spatial_scale - off
+            rw = jnp.maximum(x2 - x1, 1e-3)
+            rh = jnp.maximum(y2 - y1, 1e-3)
+            bin_w = rw / ow
+            bin_h = rh / oh
+            # sample grid [oh*ratio, ow*ratio]
+            gy = y1 + (jnp.arange(oh * ratio) + 0.5) * rh / (oh * ratio)
+            gx = x1 + (jnp.arange(ow * ratio) + 0.5) * rw / (ow * ratio)
+            yy, xx = jnp.meshgrid(gy, gx, indexing="ij")
+
+            def bilinear(img):  # img [H, W]
+                y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
+                x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
+                y1i = jnp.clip(y0 + 1, 0, H - 1)
+                x1i = jnp.clip(x0 + 1, 0, W - 1)
+                wy = jnp.clip(yy, 0, H - 1) - y0
+                wx = jnp.clip(xx, 0, W - 1) - x0
+                y0 = y0.astype(jnp.int32)
+                x0 = x0.astype(jnp.int32)
+                y1i = y1i.astype(jnp.int32)
+                x1i = x1i.astype(jnp.int32)
+                v = (img[y0, x0] * (1 - wy) * (1 - wx)
+                     + img[y1i, x0] * wy * (1 - wx)
+                     + img[y0, x1i] * (1 - wy) * wx
+                     + img[y1i, x1i] * wy * wx)
+                return v
+
+            samples = jax.vmap(bilinear)(feat[0])   # [C, oh*r, ow*r]
+            pooled = samples.reshape(C, oh, ratio, ow, ratio).mean((2, 4))
+            return pooled
+
+        return jax.vmap(one_roi)(rois)              # [n_roi, C, oh, ow]
+
+    return apply("roi_align", f, to_tensor_like(x), to_tensor_like(boxes))
+
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
+                    name=None):
+    """Greedy bipartite matching (bipartite_match_op.cc): for each column
+    (prior), the best-matching row; rows claim their argmax column first.
+    Returns (match_indices [M] int32 row-per-col or -1, match_dist [M])."""
+    d = to_tensor_like(dist_matrix)
+
+    def f(dist):
+        N, M = dist.shape
+
+        def body(carry, _):
+            matched_rows, col_row, col_dist = carry
+            masked = jnp.where(matched_rows[:, None], -jnp.inf, dist)
+            masked = jnp.where((col_row >= 0)[None, :], -jnp.inf, masked)
+            flat = jnp.argmax(masked)
+            r, c = flat // M, flat % M
+            valid = masked[r, c] > 0
+            col_row = col_row.at[c].set(
+                jnp.where(valid, r.astype(jnp.int32), col_row[c]))
+            col_dist = col_dist.at[c].set(
+                jnp.where(valid, masked[r, c], col_dist[c]))
+            matched_rows = matched_rows.at[r].set(
+                matched_rows[r] | valid)
+            return (matched_rows, col_row, col_dist), None
+
+        init = (jnp.zeros((N,), bool), jnp.full((M,), -1, jnp.int32),
+                jnp.zeros((M,), dist.dtype))
+        (mr, col_row, col_dist), _ = jax.lax.scan(
+            body, init, None, length=min(N, M))
+        if match_type == "per_prediction":
+            best_row = jnp.argmax(dist, axis=0).astype(jnp.int32)
+            best_val = jnp.max(dist, axis=0)
+            take = (col_row < 0) & (best_val >= dist_threshold)
+            col_row = jnp.where(take, best_row, col_row)
+            col_dist = jnp.where(take, best_val, col_dist)
+        return col_row, col_dist
+
+    return apply("bipartite_match", f, d)
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, name=None):
+    """RPN proposal generation (generate_proposals_op.cc), single image:
+    scores [A], deltas [A, 4], anchors [A, 4] -> (rois [post_nms_top_n, 4]
+    padded -1, roi_scores, count)."""
+    def f(sc, deltas, info, anc, var):
+        t = deltas * var
+        aw = anc[:, 2] - anc[:, 0] + 1
+        ah = anc[:, 3] - anc[:, 1] + 1
+        acx = anc[:, 0] + aw / 2
+        acy = anc[:, 1] + ah / 2
+        cx = t[:, 0] * aw + acx
+        cy = t[:, 1] * ah + acy
+        w = jnp.exp(jnp.minimum(t[:, 2], 10.0)) * aw
+        h = jnp.exp(jnp.minimum(t[:, 3], 10.0)) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                           cx + w / 2, cy + h / 2], axis=1)
+        # clip to image
+        ih = info[0] / info[2]
+        iw = info[1] / info[2]
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, iw - 1),
+                           jnp.clip(boxes[:, 1], 0, ih - 1),
+                           jnp.clip(boxes[:, 2], 0, iw - 1),
+                           jnp.clip(boxes[:, 3], 0, ih - 1)], axis=1)
+        ms = min_size * info[2]
+        keep = ((boxes[:, 2] - boxes[:, 0] >= ms)
+                & (boxes[:, 3] - boxes[:, 1] >= ms))
+        sc = jnp.where(keep, sc, -jnp.inf)
+        top = min(pre_nms_top_n, sc.shape[0])
+        vals, idx = jax.lax.top_k(sc, top)
+        cand = boxes[idx]
+        sel, cnt = _nms_fixed(cand, vals, nms_thresh,
+                              min(post_nms_top_n, top))
+        out_n = min(post_nms_top_n, top)
+        valid = sel >= 0
+        rois = jnp.where(valid[:, None], cand[jnp.maximum(sel, 0)], -1.0)
+        rs = jnp.where(valid, vals[jnp.maximum(sel, 0)], -1.0)
+        if out_n < post_nms_top_n:
+            rois = jnp.pad(rois, ((0, post_nms_top_n - out_n), (0, 0)),
+                           constant_values=-1)
+            rs = jnp.pad(rs, (0, post_nms_top_n - out_n),
+                         constant_values=-1)
+        return rois, rs, cnt
+
+    return apply("generate_proposals", f, to_tensor_like(scores),
+                 to_tensor_like(bbox_deltas), to_tensor_like(im_info),
+                 to_tensor_like(anchors), to_tensor_like(variances))
