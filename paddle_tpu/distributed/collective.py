@@ -120,6 +120,37 @@ def _is_traced(x) -> bool:
     return isinstance(x, jax.core.Tracer)
 
 
+_EAGER_OP_NAMES = {ReduceOp.SUM: "sum", ReduceOp.MAX: "max",
+                   ReduceOp.MIN: "min", ReduceOp.PROD: "prod",
+                   ReduceOp.AVG: "avg"}
+
+
+def _eager_backend(group: "Group"):
+    """Host-side (gloo) backend for eager multi-process collectives.
+
+    Returns None in a world of one (eager collectives are identities there,
+    matching the reference for nranks=1).  In a real multi-process run the
+    backend must exist — returning identity would silently train without
+    synchronization (ADVICE r3), so this raises instead."""
+    if get_world_size() <= 1:
+        return None
+    from . import gloo
+
+    be = gloo.get_backend()
+    if be is None:
+        raise RuntimeError(
+            "eager collective with PADDLE_TRAINERS_NUM > 1 but no host "
+            "backend: call paddle_tpu.distributed.init_parallel_env() (with "
+            "PADDLE_GLOO_ENDPOINT set) or distributed.gloo.init_gloo() "
+            "first — otherwise cross-process synchronization would be "
+            "silently skipped")
+    return be
+
+
+def _eager_member(group: "Group") -> bool:
+    return group._ranks is None or get_rank() in group._ranks
+
+
 def _axis_in_trace(axis_name) -> bool:
     """True if axis_name is bound in the current trace (inside shard_map)."""
     try:
@@ -187,8 +218,18 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
             tensor._replace_from(out)
             return tensor
         return out
-    # eager: single participant → identity
-    return tensor
+    be = _eager_backend(group)
+    if be is None or not _eager_member(group):
+        # world of one (or outsider to a subgroup) → identity
+        return tensor
+    red = be.all_reduce(np.asarray(t._value), _EAGER_OP_NAMES[op],
+                        group_id=group.id,
+                        ranks=group._ranks)
+    out = Tensor(jnp.asarray(red))
+    if isinstance(tensor, Tensor):
+        tensor._replace_from(out)
+        return tensor
+    return out
 
 
 def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -205,6 +246,16 @@ def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
             tensor._replace_from(out)
             return tensor
         return out
+    be = _eager_backend(group)
+    if be is not None and _eager_member(group):
+        red = be.all_reduce(np.asarray(t._value), _EAGER_OP_NAMES[op],
+                            group_id=group.id, ranks=group._ranks)
+        if get_rank() == dst:
+            out = Tensor(jnp.asarray(red))
+            if isinstance(tensor, Tensor):
+                tensor._replace_from(out)
+                return tensor
+            return out
     return tensor
 
 
@@ -227,6 +278,14 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
                 tensor_list.append(out[i])
             return None
         return out
+    be = _eager_backend(group)
+    if be is not None and _eager_member(group):
+        parts = be.all_gather(np.asarray(t._value), group_id=group.id,
+                              ranks=group._ranks)
+        if tensor_list is not None and isinstance(tensor_list, list):
+            tensor_list.extend(Tensor(jnp.asarray(p)) for p in parts)
+            return None
+        return Tensor(jnp.stack([jnp.asarray(p) for p in parts], axis=0))
     if tensor_list is not None and isinstance(tensor_list, list):
         tensor_list.append(t)
         return None
@@ -234,6 +293,12 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
 
 
 def all_gather_object(object_list, obj, group=None):
+    group = group or _default_group
+    be = _eager_backend(group)
+    if be is not None and _eager_member(group):
+        object_list.extend(be.all_gather(obj, group_id=group.id,
+                                         ranks=group._ranks))
+        return
     object_list.append(obj)
 
 
@@ -250,6 +315,16 @@ def broadcast(tensor, src, group=None, sync_op=True):
             return gathered[src]
 
         out = apply("c_broadcast", f, t)
+        if isinstance(tensor, Tensor):
+            tensor._replace_from(out)
+            return tensor
+        return out
+    be = _eager_backend(group)
+    if be is not None and _eager_member(group):
+        payload = np.asarray(t._value) if get_rank() == src else None
+        got = be.broadcast(payload, src=src, group_id=group.id,
+                           ranks=group._ranks)
+        out = Tensor(jnp.asarray(got))
         if isinstance(tensor, Tensor):
             tensor._replace_from(out)
             return tensor
@@ -291,6 +366,23 @@ def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None,
             tensor._replace_from(out)
             return tensor
         return out
+    be = _eager_backend(group)
+    if be is not None and _eager_member(group):
+        red = be.all_reduce(np.asarray(t._value), _EAGER_OP_NAMES[op],
+                            group_id=group.id, ranks=group._ranks)
+        members = sorted(group.ranks)
+        if red.shape[0] % len(members):
+            raise ValueError(
+                f"reduce_scatter: leading dim {red.shape[0]} not divisible "
+                f"by group size {len(members)}")
+        k = red.shape[0] // len(members)
+        pos = members.index(get_rank())
+        chunk = red[pos * k:(pos + 1) * k]
+        out = Tensor(jnp.asarray(chunk))
+        if isinstance(tensor, Tensor):
+            tensor._replace_from(out)
+            return tensor
+        return out
     if isinstance(tensor, Tensor):
         tensor._replace_from(t)
         return tensor
@@ -314,6 +406,25 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
                 tensor._replace_from(out)
                 return tensor
             return out
+    else:
+        t = None
+    be = _eager_backend(group)
+    if be is not None and _eager_member(group):
+        # only src's tensor_list matters (reference scatter semantics);
+        # every member participates in the broadcast rendezvous
+        members = sorted(group.ranks)
+        payload = np.asarray(t._value) \
+            if (get_rank() == src and t is not None) else None
+        rows = be.broadcast(payload, src=src, group_id=group.id,
+                            ranks=group._ranks)
+        if rows is None:
+            raise ValueError("scatter: src rank must pass tensor_list")
+        out = Tensor(jnp.asarray(rows[members.index(get_rank())]))
+        if isinstance(tensor, Tensor):
+            tensor._replace_from(out)
+            return tensor
+        return out
+    if tensor_list:
         out = tensor_list[0]
         if isinstance(tensor, Tensor):
             tensor._replace_from(to_tensor_like(out))
@@ -357,6 +468,18 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
                 out_tensor_list.append(out[i])
             return None
         return out
+    be = _eager_backend(group)
+    if be is not None and _eager_member(group):
+        # exchange: gather everyone's stacked input, take my slice of each
+        members = sorted(group.ranks)
+        parts = be.all_gather(np.asarray(x._value), group_id=group.id,
+                              ranks=group._ranks)
+        pos = members.index(get_rank())
+        mine = [jnp.asarray(p[pos]) for p in parts]
+        if out_tensor_list is not None:
+            out_tensor_list.extend(Tensor(m) for m in mine)
+            return None
+        return Tensor(jnp.stack(mine, axis=0))
     if out_tensor_list is not None:
         for t in (in_tensor_list if isinstance(in_tensor_list, (list, tuple)) else [x]):
             out_tensor_list.append(to_tensor_like(t))
@@ -390,6 +513,11 @@ def send(tensor, dst=0, group=None, sync_op=True, src=None):
     if _is_traced(t._value):
         s = get_rank() if src is None else src
         return _p2p(t, s, dst, group)
+    if _eager_backend(group) is not None:
+        raise NotImplementedError(
+            "eager multi-process send/recv is not supported — p2p is an "
+            "in-graph collective (traced ppermute, reference send_v2); use "
+            "broadcast/scatter for host-side exchange")
     return None
 
 
@@ -405,6 +533,11 @@ def recv(tensor, src=0, group=None, sync_op=True, dst=None):
             tensor._replace_from(out)
             return tensor
         return out
+    if _eager_backend(group) is not None:
+        raise NotImplementedError(
+            "eager multi-process send/recv is not supported — p2p is an "
+            "in-graph collective (traced ppermute, reference recv_v2); use "
+            "broadcast/scatter for host-side exchange")
     return tensor
 
 
@@ -423,7 +556,16 @@ def p2p_shift(tensor, group=None, shift=1):
 
 def barrier(group=None):
     """reference barrier_op: cross-process rendezvous when running
-    multi-process (jax.distributed), local device sync otherwise."""
+    multi-process (host gloo backend or jax.distributed), local device sync
+    otherwise."""
+    group = group or _default_group
+    if jax.process_count() <= 1:
+        # raises when world_size > 1 with no host backend — two processes
+        # proceeding unsynchronized must not look like a successful barrier
+        be = _eager_backend(group)
+        if be is not None and _eager_member(group):
+            be.barrier(group_id=group.id, ranks=group._ranks)
+            return
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 

@@ -24,7 +24,19 @@ def init_parallel_env(strategy=None):
     trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
-    if trainers > 1 and endpoints:
+    backend = os.environ.get("PADDLE_DIST_BACKEND", "auto")
+    if trainers > 1 and backend == "gloo" \
+            and not os.environ.get("PADDLE_GLOO_ENDPOINT"):
+        raise ValueError(
+            "PADDLE_DIST_BACKEND=gloo requires PADDLE_GLOO_ENDPOINT "
+            "(host:port of the rank-0 rendezvous)")
+    if trainers > 1 and os.environ.get("PADDLE_GLOO_ENDPOINT"):
+        # host-side eager collectives (GlooWrapper analog) — always useful
+        # alongside the compiled path, required for backend="gloo"
+        from . import gloo
+
+        gloo.init_gloo(rank=trainer_id, world_size=trainers)
+    if trainers > 1 and endpoints and backend != "gloo":
         coordinator = endpoints.split(",")[0]
         jax.distributed.initialize(
             coordinator_address=coordinator,

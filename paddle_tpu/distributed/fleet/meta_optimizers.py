@@ -126,10 +126,19 @@ class LocalSGDOptimizer(MetaOptimizerBase):
 
         group = collective._default_group
         nranks = getattr(group, "nranks", 1) or 1
+        synced_any = False
         for p in self.inner._param_list():
-            if collective._is_traced(p._value) and nranks > 1:
+            if nranks > 1 and not collective._is_traced(p._value):
+                # eager multi-process: average via the host-side (gloo-style)
+                # allreduce; raises if no eager backend was initialized so a
+                # real multi-rank run can never silently skip averaging
+                collective.all_reduce(p, op=collective.ReduceOp.AVG)
+                synced_any = True
+            elif collective._is_traced(p._value) and nranks > 1:
                 collective.all_reduce(p)
                 p._value = p._value / nranks
+                synced_any = True
+        return synced_any
 
 
 class DGCOptimizer(MetaOptimizerBase):

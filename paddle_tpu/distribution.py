@@ -147,8 +147,10 @@ class Normal(Distribution):
 
 class Categorical(Distribution):
     """Categorical over unnormalized logits (reference :640 — note the
-    reference's `logits` are *unnormalized probabilities*; probabilities are
-    logits/sum, matching that convention)."""
+    reference's `logits` are *unnormalized probabilities* for probs/sample
+    (prob = logits/sum, reference :899), but entropy/kl_divergence use
+    softmax(logits) (reference :811-860). Both conventions are reproduced
+    here, inconsistency included, so results match the reference."""
 
     def __init__(self, logits, name=None):
         self.logits = to_tensor_like(logits)
@@ -168,10 +170,11 @@ class Categorical(Distribution):
 
     def entropy(self):
         def f(lg):
-            p = lg / jnp.sum(lg, axis=-1, keepdims=True)
-            plogp = jnp.where(p > 0, p * jnp.log(jnp.where(p > 0, p, 1.0)),
-                              0.0)
-            return -jnp.sum(plogp, axis=-1)
+            lg = lg - jnp.max(lg, axis=-1, keepdims=True)
+            z = jnp.sum(jnp.exp(lg), axis=-1, keepdims=True)
+            p = jnp.exp(lg) / z
+            neg_h = jnp.sum(p * (lg - jnp.log(z)), axis=-1)
+            return -neg_h
 
         return apply("categorical_entropy", f, self.logits)
 
@@ -196,14 +199,13 @@ class Categorical(Distribution):
             raise NotImplementedError
 
         def f(lg, lg2):
-            p = lg / jnp.sum(lg, axis=-1, keepdims=True)
-            q = lg2 / jnp.sum(lg2, axis=-1, keepdims=True)
-            terms = jnp.where(
-                p > 0,
-                p * (jnp.log(jnp.where(p > 0, p, 1.0))
-                     - jnp.log(jnp.maximum(q, 1e-38))),
-                0.0)
-            return jnp.sum(terms, axis=-1)
+            lg = lg - jnp.max(lg, axis=-1, keepdims=True)
+            lg2 = lg2 - jnp.max(lg2, axis=-1, keepdims=True)
+            z = jnp.sum(jnp.exp(lg), axis=-1, keepdims=True)
+            z2 = jnp.sum(jnp.exp(lg2), axis=-1, keepdims=True)
+            p = jnp.exp(lg) / z
+            return jnp.sum(p * (lg - jnp.log(z) - lg2 + jnp.log(z2)),
+                           axis=-1)
 
         return apply("categorical_kl", f, self.logits, other.logits)
 

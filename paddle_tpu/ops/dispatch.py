@@ -77,7 +77,34 @@ def _check_nan_inf(name, flat_outs):
 
 # fns that executed fine but failed jax.vjp once — skip re-attempting the
 # linearization (and re-warning) on every subsequent call
+# Op NAMES (not closures — most call sites build a fresh closure per call,
+# so identity keys never memoize and grow without bound) whose forward runs
+# but cannot be linearized by jax.vjp. Only populated for the narrow case
+# jax reports as structurally non-linearizable (custom_vjp without jvp);
+# any other vjp failure is a real bug and raises.
 _non_linearizable: set = set()
+
+
+def _is_non_linearizable_error(e) -> bool:
+    """True only for jax's structural can't-differentiate errors — e.g.
+    forward-mode over a custom_vjp (raw Pallas backward being re-recorded
+    for double grad / static replay). Shape bugs, dtype errors, or failures
+    inside a user VJP must keep raising loudly."""
+    msg = str(e)
+    if ("does not support reverse-mode autodiff" in msg
+            or "Linearization failed" in msg
+            or "does not support JVP" in msg
+            or "do not support JVP" in msg):
+        # jax's structural can't-differentiate errors: linearize over a
+        # primitive with no transpose rule (raw Pallas call inside a
+        # recorded backward), pure_callback ("Pure callbacks do not support
+        # JVP"), pallas_call with a mesh ("does not support JVP")
+        return True
+    if isinstance(e, NotImplementedError) and "jvp" in msg.lower():
+        return True
+    return isinstance(e, TypeError) and (
+        "custom_vjp" in msg or "custom_gradient" in msg
+        or "jvp" in msg.lower())
 
 
 def apply(name, fn, *args, n_outputs=None, **kwargs):
@@ -115,7 +142,7 @@ def apply(name, fn, *args, n_outputs=None, **kwargs):
             recorder.add_record(name, fn, args, kwargs, wrapped, cast_to)
         return wrapped
 
-    if not record or fn in _non_linearizable:
+    if not record or name in _non_linearizable:
         return _finish_nograd(fn(*arrays, **kwargs))
 
     def closed(*diff_vals):
@@ -131,16 +158,19 @@ def apply(name, fn, *args, n_outputs=None, **kwargs):
         # Some ops execute fine but cannot be linearized (e.g. a custom op
         # whose BACKWARD rule contains a raw Pallas kernel, reached when the
         # backward itself is being recorded for double grad / static replay).
-        # If the plain forward works, degrade to a non-differentiable record
-        # instead of failing — further grads through it are simply cut.
+        # Degrade ONLY for that structural case; anything else (shape bug in
+        # a user VJP, dtype mismatch, transient failure) must raise rather
+        # than silently cut gradients through part of the model.
+        if not _is_non_linearizable_error(e):
+            raise RuntimeError(f"[operator < {name} >] {e}") from e
         try:
             out = fn(*arrays, **kwargs)
         except Exception:
             raise RuntimeError(f"[operator < {name} >] {e}") from e
         import warnings
 
-        if fn not in _non_linearizable:
-            _non_linearizable.add(fn)
+        if name not in _non_linearizable:
+            _non_linearizable.add(name)
             warnings.warn(
                 f"operator < {name} > executes but cannot be linearized "
                 f"({type(e).__name__}); gradients through it are cut. "

@@ -158,7 +158,7 @@ class PostTrainingQuantization:
                  algo="KL", quantizable_op_type=("Conv2D", "Linear"),
                  weight_bits=8, activation_bits=8, hist_percent=0.99999,
                  compute="int8", executor=None, scope=None, model_dir=None,
-                 **unused):
+                 input_extractor=None, **unused):
         if algo not in _SUPPORTED_ALGOS:
             raise ValueError(f"algo must be one of {_SUPPORTED_ALGOS}")
         self._model = model
@@ -172,6 +172,7 @@ class PostTrainingQuantization:
         self._abits = activation_bits
         self._hist_percent = hist_percent
         self._compute = compute
+        self._input_extractor = input_extractor
         self._scales = {}
 
     def quantize(self):
@@ -219,20 +220,25 @@ class PostTrainingQuantization:
                 compute=self._compute)
         return model
 
-    @staticmethod
-    def _to_args(batch):
+    def _to_args(self, batch):
         from ..tensor import Tensor
 
+        if self._input_extractor is not None:
+            batch = self._input_extractor(batch)
         if isinstance(batch, (list, tuple)):
-            if len(batch) == 2:
+            if len(batch) == 2 and self._input_extractor is None:
                 # (inputs, label) convention: drop the SECOND element only
-                # when it looks like labels (integer dtype, rank <= 1) —
-                # a real float second input is kept
+                # when it looks like labels — integer dtype with at most one
+                # non-unit trailing dim ([B], [B,1], scalar; paddle loaders
+                # commonly yield [B,1] labels). A real float second input or
+                # an integer feature matrix is kept.
                 second = np.asarray(
                     batch[1]._value if isinstance(batch[1], Tensor)
                     else batch[1])
-                if second.ndim <= 1 and np.issubdtype(second.dtype,
-                                                      np.integer):
+                label_like = (np.issubdtype(second.dtype, np.integer)
+                              and (second.ndim <= 1
+                                   or all(d == 1 for d in second.shape[1:])))
+                if label_like:
                     batch = batch[:1]
             return tuple(b if isinstance(b, Tensor) else Tensor(np.asarray(b))
                          for b in batch)

@@ -95,16 +95,33 @@ class TestCategorical:
         np.testing.assert_allclose(c.probs(idx).numpy(), [0.5], rtol=1e-6)
         np.testing.assert_allclose(c.log_prob(idx).numpy(),
                                    [math.log(0.5)], rtol=1e-6)
-        want_h = -(0.25 * math.log(0.25) * 2 + 0.5 * math.log(0.5))
+        # entropy uses softmax(logits), matching the reference's convention
+        # (reference distribution.py:827-860), NOT probs()'s logits/sum.
+        sm = np.exp([1.0, 1.0, 2.0]) / np.exp([1.0, 1.0, 2.0]).sum()
+        want_h = -(sm * np.log(sm)).sum()
         np.testing.assert_allclose(float(c.entropy().numpy()), want_h,
                                    rtol=1e-6)
 
     def test_kl(self):
+        # kl_divergence uses softmax(logits), matching the reference's
+        # convention (reference distribution.py:811-825).
         p = Categorical(paddle.to_tensor(np.asarray([1.0, 1.0], np.float32)))
         q = Categorical(paddle.to_tensor(np.asarray([1.0, 3.0], np.float32)))
-        want = 0.5 * math.log(0.5 / 0.25) + 0.5 * math.log(0.5 / 0.75)
+        pp = np.exp([1.0, 1.0]) / np.exp([1.0, 1.0]).sum()
+        qq = np.exp([1.0, 3.0]) / np.exp([1.0, 3.0]).sum()
+        want = (pp * np.log(pp / qq)).sum()
         np.testing.assert_allclose(float(kl_divergence(p, q).numpy()), want,
                                    rtol=1e-5)
+
+    def test_entropy_negative_logits_finite(self):
+        # Negative logits are fine under softmax; the old logits/sum
+        # convention produced NaN here (ADVICE r3 medium).
+        c = Categorical(paddle.to_tensor(np.asarray([-1.0, -2.0, 0.5],
+                                                    np.float32)))
+        assert np.isfinite(float(c.entropy().numpy()))
+        q = Categorical(paddle.to_tensor(np.asarray([-3.0, 1.0, -0.5],
+                                                    np.float32)))
+        assert np.isfinite(float(kl_divergence(c, q).numpy()))
 
 
 class TestOnnxExport:
