@@ -151,6 +151,16 @@ def _eager_member(group: "Group") -> bool:
     return group._ranks is None or get_rank() in group._ranks
 
 
+def _eager_members(group: "Group") -> list:
+    """Participants of an eager (host-side) collective, in PROCESS-rank
+    space.  group.ranks is device-space (mesh_axis_size x world_size) —
+    correct inside a trace, wrong for the gloo backend, which coordinates
+    processes."""
+    if group._ranks is not None:
+        return sorted(group._ranks)
+    return list(range(get_world_size()))
+
+
 def _axis_in_trace(axis_name) -> bool:
     """True if axis_name is bound in the current trace (inside shard_map)."""
     try:
@@ -370,7 +380,7 @@ def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None,
     if be is not None and _eager_member(group):
         red = be.all_reduce(np.asarray(t._value), _EAGER_OP_NAMES[op],
                             group_id=group.id, ranks=group._ranks)
-        members = sorted(group.ranks)
+        members = _eager_members(group)
         if red.shape[0] % len(members):
             raise ValueError(
                 f"reduce_scatter: leading dim {red.shape[0]} not divisible "
@@ -412,7 +422,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     if be is not None and _eager_member(group):
         # only src's tensor_list matters (reference scatter semantics);
         # every member participates in the broadcast rendezvous
-        members = sorted(group.ranks)
+        members = _eager_members(group)
         payload = np.asarray(t._value) \
             if (get_rank() == src and t is not None) else None
         rows = be.broadcast(payload, src=src, group_id=group.id,
@@ -471,7 +481,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     be = _eager_backend(group)
     if be is not None and _eager_member(group):
         # exchange: gather everyone's stacked input, take my slice of each
-        members = sorted(group.ranks)
+        members = _eager_members(group)
         parts = be.all_gather(np.asarray(x._value), group_id=group.id,
                               ranks=group._ranks)
         pos = members.index(get_rank())
@@ -551,6 +561,11 @@ def p2p_shift(tensor, group=None, shift=1):
         perm = [(i, (i + shift) % n) for i in range(n)]
         return apply("ppermute",
                      lambda v: jax.lax.ppermute(v, group.axis_name, perm), t)
+    if _eager_backend(group) is not None:
+        raise NotImplementedError(
+            "eager multi-process p2p_shift is not supported — ring p2p is "
+            "an in-graph collective (traced ppermute); an eager identity "
+            "here would silently skip the exchange")
     return t
 
 

@@ -320,55 +320,97 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=64,
 
 
 def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
-              sampling_ratio=-1, aligned=True, name=None):
+              sampling_ratio=-1, aligned=True, name=None,
+              max_adaptive_ratio=4):
     """RoI Align (roi_align_op.cc/.cu): bilinear-sampled pooling — a pure
     gather+average on TPU, differentiable by construction.
-    x [B, C, H, W] (single image B=1 form) or boxes carry batch idx 0."""
+
+    x [B, C, H, W]; boxes [N, 4]; boxes_num [B] (boxes per image, in order)
+    routes each RoI to its image. Reference semantics kept: sample points
+    outside [-1, H]x[-1, W] contribute ZERO (roi_align_op.cu bilinear
+    boundary rule), and ``sampling_ratio=-1`` uses the adaptive
+    ceil(roi_size/out_size) count per RoI — realized fixed-shape by sampling
+    a static ``max_adaptive_ratio`` grid and mask-averaging the first
+    ceil() samples of each bin (XLA needs static shapes; the cap is the
+    only delta, documented here)."""
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     oh, ow = output_size
+    static_ratio = sampling_ratio if sampling_ratio > 0 else None
+    R = static_ratio if static_ratio is not None else max_adaptive_ratio
 
-    def f(feat, rois):
+    def f(feat, rois, bn):
         B, C, H, W = feat.shape
         n_roi = rois.shape[0]
-        ratio = sampling_ratio if sampling_ratio > 0 else 2
         off = 0.5 if aligned else 0.0
+        if bn is None:
+            bidx_all = jnp.zeros((n_roi,), jnp.int32)
+        else:
+            # roi i belongs to the image whose cumulative count exceeds i
+            cum = jnp.cumsum(bn.astype(jnp.int32))
+            bidx_all = jnp.searchsorted(cum, jnp.arange(n_roi),
+                                        side="right").astype(jnp.int32)
 
-        def one_roi(roi):
+        def one_roi(roi, bidx):
+            img_c = jnp.take(feat, bidx, axis=0)    # [C, H, W]
             x1, y1, x2, y2 = roi * spatial_scale - off
-            rw = jnp.maximum(x2 - x1, 1e-3)
-            rh = jnp.maximum(y2 - y1, 1e-3)
+            rw = x2 - x1
+            rh = y2 - y1
+            if not aligned:
+                rw = jnp.maximum(rw, 1.0)
+                rh = jnp.maximum(rh, 1.0)
             bin_w = rw / ow
             bin_h = rh / oh
-            # sample grid [oh*ratio, ow*ratio]
-            gy = y1 + (jnp.arange(oh * ratio) + 0.5) * rh / (oh * ratio)
-            gx = x1 + (jnp.arange(ow * ratio) + 0.5) * rw / (ow * ratio)
+            if static_ratio is not None:
+                cnt_h = jnp.asarray(static_ratio, jnp.float32)
+                cnt_w = cnt_h
+            else:
+                cnt_h = jnp.clip(jnp.ceil(bin_h), 1, R)
+                cnt_w = jnp.clip(jnp.ceil(bin_w), 1, R)
+
+            # static [oh*R, ow*R] grid; sample j of bin p sits at
+            # p*bin + (j+0.5)*bin/cnt, active when j < cnt
+            ph = jnp.arange(oh * R) // R
+            jy = (jnp.arange(oh * R) % R).astype(jnp.float32)
+            pw = jnp.arange(ow * R) // R
+            jx = (jnp.arange(ow * R) % R).astype(jnp.float32)
+            gy = y1 + ph * bin_h + (jy + 0.5) * bin_h / cnt_h
+            gx = x1 + pw * bin_w + (jx + 0.5) * bin_w / cnt_w
+            act_y = jy < cnt_h
+            act_x = jx < cnt_w
             yy, xx = jnp.meshgrid(gy, gx, indexing="ij")
+            active = act_y[:, None] & act_x[None, :]
+            # reference boundary rule: points outside [-1, H]x[-1, W]
+            # contribute zero; inside points clamp to [0, dim-1]
+            inside = ((yy >= -1.0) & (yy <= H) & (xx >= -1.0) & (xx <= W))
+            yc = jnp.clip(yy, 0, H - 1)
+            xc = jnp.clip(xx, 0, W - 1)
 
             def bilinear(img):  # img [H, W]
-                y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
-                x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
-                y1i = jnp.clip(y0 + 1, 0, H - 1)
-                x1i = jnp.clip(x0 + 1, 0, W - 1)
-                wy = jnp.clip(yy, 0, H - 1) - y0
-                wx = jnp.clip(xx, 0, W - 1) - x0
+                y0 = jnp.floor(yc)
+                x0 = jnp.floor(xc)
+                y1i = jnp.clip(y0 + 1, 0, H - 1).astype(jnp.int32)
+                x1i = jnp.clip(x0 + 1, 0, W - 1).astype(jnp.int32)
+                wy = yc - y0
+                wx = xc - x0
                 y0 = y0.astype(jnp.int32)
                 x0 = x0.astype(jnp.int32)
-                y1i = y1i.astype(jnp.int32)
-                x1i = x1i.astype(jnp.int32)
                 v = (img[y0, x0] * (1 - wy) * (1 - wx)
                      + img[y1i, x0] * wy * (1 - wx)
                      + img[y0, x1i] * (1 - wy) * wx
                      + img[y1i, x1i] * wy * wx)
-                return v
+                return jnp.where(inside & active, v, 0.0)
 
-            samples = jax.vmap(bilinear)(feat[0])   # [C, oh*r, ow*r]
-            pooled = samples.reshape(C, oh, ratio, ow, ratio).mean((2, 4))
-            return pooled
+            samples = jax.vmap(bilinear)(img_c)     # [C, oh*R, ow*R]
+            sums = samples.reshape(C, oh, R, ow, R).sum((2, 4))
+            return sums / (cnt_h * cnt_w)
 
-        return jax.vmap(one_roi)(rois)              # [n_roi, C, oh, ow]
+        return jax.vmap(one_roi)(rois, bidx_all)    # [n_roi, C, oh, ow]
 
-    return apply("roi_align", f, to_tensor_like(x), to_tensor_like(boxes))
+    args = [to_tensor_like(x), to_tensor_like(boxes)]
+    if boxes_num is not None:
+        return apply("roi_align", f, *args, to_tensor_like(boxes_num))
+    return apply("roi_align", lambda feat, rois: f(feat, rois, None), *args)
 
 
 def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,

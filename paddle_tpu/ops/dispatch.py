@@ -77,11 +77,13 @@ def _check_nan_inf(name, flat_outs):
 
 # fns that executed fine but failed jax.vjp once — skip re-attempting the
 # linearization (and re-warning) on every subsequent call
-# Op NAMES (not closures — most call sites build a fresh closure per call,
-# so identity keys never memoize and grow without bound) whose forward runs
-# but cannot be linearized by jax.vjp. Only populated for the narrow case
-# jax reports as structurally non-linearizable (custom_vjp without jvp);
-# any other vjp failure is a real bug and raises.
+# Op NAMES that have hit a structural can't-linearize error at least once —
+# used ONLY to warn once per name (a name key, because most call sites build
+# a fresh closure per call, so identity keys would never memoize and grow
+# without bound).  NOT a dispatch cache: linearization failure can be
+# context-dependent (e.g. only while a backward is itself being recorded),
+# so every call re-attempts jax.vjp rather than permanently cutting
+# gradients for the op name.
 _non_linearizable: set = set()
 
 
@@ -142,7 +144,7 @@ def apply(name, fn, *args, n_outputs=None, **kwargs):
             recorder.add_record(name, fn, args, kwargs, wrapped, cast_to)
         return wrapped
 
-    if not record or name in _non_linearizable:
+    if not record:
         return _finish_nograd(fn(*arrays, **kwargs))
 
     def closed(*diff_vals):

@@ -1,3 +1,3 @@
 """paddle_tpu.vision (reference: python/paddle/vision/)."""
-from . import datasets, models, transforms  # noqa: F401
+from . import datasets, models, ops, transforms  # noqa: F401
 from .models import LeNet, ResNet, resnet18, resnet50  # noqa: F401

@@ -1,0 +1,25 @@
+"""paddle.vision.ops — detection operators (reference:
+python/paddle/vision/ops.py, backed by paddle/fluid/operators/detection/).
+
+The implementations live in ops/detection.py (fixed-shape XLA designs —
+NMS slates with validity counts, gather-based RoI align); this module is
+the public namespace the reference exposes them under."""
+from ..ops.detection import (  # noqa: F401
+    anchor_generator,
+    bipartite_match,
+    box_clip,
+    box_coder,
+    generate_proposals,
+    iou_similarity,
+    multiclass_nms,
+    nms,
+    prior_box,
+    roi_align,
+    yolo_box,
+)
+
+__all__ = [
+    "anchor_generator", "bipartite_match", "box_clip", "box_coder",
+    "generate_proposals", "iou_similarity", "multiclass_nms", "nms",
+    "prior_box", "roi_align", "yolo_box",
+]

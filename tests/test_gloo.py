@@ -31,16 +31,14 @@ def _run_world(world_size, fn):
     results = [None] * world_size
     errors = []
 
+    backends = [None] * world_size
+
     def work(rank):
-        be = None
         try:
-            be = GlooBackend(rank, world_size, endpoint)
-            results[rank] = fn(be, rank)
+            backends[rank] = GlooBackend(rank, world_size, endpoint)
+            results[rank] = fn(backends[rank], rank)
         except Exception as e:  # pragma: no cover
             errors.append((rank, e))
-        finally:
-            if be is not None and rank != 0:
-                be.close()
 
     threads = [threading.Thread(target=work, args=(r,))
                for r in range(1, world_size)]
@@ -49,6 +47,10 @@ def _run_world(world_size, fn):
     work(0)
     for t in threads:
         t.join(timeout=60)
+    # rank 0 last: it owns the rendezvous server thread + listening port
+    for be in backends[1:] + backends[:1]:
+        if be is not None:
+            be.close()
     assert not errors, errors
     return results
 

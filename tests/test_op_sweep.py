@@ -534,6 +534,59 @@ WAIVED.update({
     "search.jax_topk": "internal raw-jax helper (public topk covers it)",
 })
 
+
+def _boxes(rng, n=6, size=16.0):
+    xy1 = rng.uniform(0, size / 2, (n, 2)).astype(np.float32)
+    wh = rng.uniform(2.0, size / 2, (n, 2)).astype(np.float32)
+    return np.concatenate([xy1, xy1 + wh], axis=1)
+
+
+OVERRIDES.update({
+    # --- detection ops (VERDICT r3 item #2: wired + swept) -----------------
+    "detection.iou_similarity": Spec(
+        lambda rng: [t(_boxes(rng, 5)), t(_boxes(rng, 4))], **NOGRAD),
+    "detection.box_clip": Spec(
+        lambda rng: [t(_boxes(rng, 5)),
+                     t(np.asarray([[12.0, 12.0, 1.0]], np.float32))],
+        **NOGRAD),
+    "detection.box_coder": Spec(
+        lambda rng: [t(_boxes(rng, 4)),
+                     t(np.asarray([0.1, 0.1, 0.2, 0.2], np.float32)),
+                     t(_boxes(rng, 3))], **NOGRAD),
+    "detection.prior_box": Spec(
+        lambda rng: [t(fmat(rng, 1, 3, 4, 4)), t(fmat(rng, 1, 3, 32, 32))],
+        kwargs={"min_sizes": [4.0], "aspect_ratios": [1.0, 2.0]},
+        **NOGRAD),
+    "detection.anchor_generator": Spec(
+        lambda rng: [t(fmat(rng, 1, 3, 4, 4))],
+        kwargs={"anchor_sizes": [8.0], "aspect_ratios": [1.0, 2.0],
+                "variances": [0.1, 0.1, 0.2, 0.2], "stride": [8.0, 8.0]},
+        **NOGRAD),
+    "detection.yolo_box": Spec(
+        lambda rng: [t(fmat(rng, 1, 2 * 7, 3, 3)),
+                     t(np.asarray([[24, 24]], np.int32))],
+        kwargs={"anchors": [4, 6, 8, 6], "class_num": 2,
+                "conf_thresh": 0.01, "downsample_ratio": 8}, **NOGRAD),
+    "detection.nms": Spec(
+        lambda rng: [t(_boxes(rng, 6)), t(fmat(rng, 6))], **NOGRAD),
+    "detection.multiclass_nms": Spec(
+        lambda rng: [t(_boxes(rng, 6)), t(fmat(rng, 3, 6))],
+        kwargs={"nms_top_k": 4, "keep_top_k": 8}, **NOGRAD),
+    "detection.roi_align": Spec(
+        lambda rng: [t(fmat(rng, 1, 2, 8, 8)),
+                     t(_boxes(rng, 3, size=7.0))],
+        kwargs={"output_size": 2, "sampling_ratio": 2}, grad_args=[0],
+        rtol=8e-2),
+    "detection.bipartite_match": Spec(
+        lambda rng: [t(fmat(rng, 4, 5))], **NOGRAD),
+    "detection.generate_proposals": Spec(
+        lambda rng: [t(fmat(rng, 12)), t(fmat(rng, 12, 4)),
+                     t(np.asarray([16.0, 16.0, 1.0], np.float32)),
+                     t(_boxes(rng, 12, size=15.0)),
+                     t(np.full((12, 4), 0.1, np.float32))],
+        kwargs={"pre_nms_top_n": 8, "post_nms_top_n": 4}, **NOGRAD),
+})
+
 # modules whose ops are all non-differentiable value factories / RNG /
 # introspection — checked for execution only, auto-classified below
 AUTO_NOGRAD_MODULES = ("creation", "random_ops", "logic", "search")

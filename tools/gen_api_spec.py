@@ -41,6 +41,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.utils",
     "paddle_tpu.vision",
     "paddle_tpu.vision.models",
+    "paddle_tpu.vision.ops",
     "paddle_tpu.vision.transforms",
 ]
 
