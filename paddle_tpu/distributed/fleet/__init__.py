@@ -3,8 +3,20 @@
 The singleton `fleet` object is the module itself's API (reference
 fleet/__init__.py re-exports the Fleet instance methods at module level).
 """
-from . import meta_optimizers, recompute, sharding  # noqa: F401
+from . import meta_optimizers, recompute, sharding, trainer  # noqa: F401
+from .dataset import (  # noqa: F401
+    DatasetBase,
+    DatasetFactory,
+    InMemoryDataset,
+    QueueDataset,
+)
 from .fleet_base import Fleet, fleet as _fleet_instance
+from .trainer import (  # noqa: F401
+    DeviceWorker,
+    HogwildWorker,
+    MultiTrainer,
+    train_from_dataset,
+)
 from .role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker  # noqa: F401
 from .strategy import DistributedStrategy  # noqa: F401
 from .utils import HDFSClient, LocalFS  # noqa: F401

@@ -131,7 +131,10 @@ class _RendezvousServer:
                 self._arrived.pop(key)
             else:
                 return
-        for sock, _rank in waiters:
+        # rank 0 last: it hosts this server, and on getting its reply may
+        # close the whole process (shutdown) — every other rank's reply must
+        # already be on the wire by then, or they die mid-collective
+        for sock, _rank in sorted(waiters, key=lambda w: -w[1]):
             try:
                 _send_msg(sock, {"ok": True, "result": ordered})
             except OSError:

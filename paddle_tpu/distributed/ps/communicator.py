@@ -36,35 +36,62 @@ class Communicator:
         self.k_steps = k_steps
         self.lr = lr
         self._step = 0
-        self._delta: Dict[int, np.ndarray] = {}   # pending weight deltas
+        # pending weight deltas as a vectorized mini-table: id -> slot into
+        # a growing arena (a CTR batch carries 1e4-1e5 unique ids per step;
+        # per-id Python dict arithmetic was the r3 weak #5 bottleneck)
+        self._delta_index: Dict[int, int] = {}
+        self._delta_rows = np.zeros((0, table.dim), np.float32)
+
+    def _delta_slots(self, ids: np.ndarray) -> np.ndarray:
+        """Slots for `ids` in the delta arena, creating rows as needed."""
+        idx = self._delta_index
+        slots = np.fromiter((idx.get(int(g), -1) for g in ids), np.int64,
+                            len(ids))
+        missing = slots < 0
+        if missing.any():
+            # setdefault + read-back: duplicate new ids in one batch must
+            # share ONE slot (an arange assignment would orphan rows and
+            # alias later ids onto them)
+            for g in ids[missing]:
+                idx.setdefault(int(g), len(idx))
+            cap = self._delta_rows.shape[0]
+            if len(idx) > cap:
+                grown = np.zeros((max(cap * 2, len(idx), 1024),
+                                  self.table.dim), np.float32)
+                grown[:cap] = self._delta_rows
+                self._delta_rows = grown
+            slots[missing] = np.fromiter(
+                (idx[int(g)] for g in ids[missing]), np.int64,
+                int(missing.sum()))
+        return slots
 
     def apply_overlay(self, ids: np.ndarray, rows: np.ndarray) -> np.ndarray:
         """Geo: overlay the local (not-yet-pushed) deltas onto pulled rows
-        so local training sees its own updates between flushes."""
-        if self.mode != "geo" or not self._delta:
+        so local training sees its own updates between flushes.  One
+        vectorized gather — no per-id Python."""
+        if self.mode != "geo" or not self._delta_index:
+            return rows
+        ids = np.asarray(ids).reshape(-1)
+        idx = self._delta_index
+        slots = np.fromiter((idx.get(int(g), -1) for g in ids), np.int64,
+                            len(ids))
+        hit = slots >= 0
+        if not hit.any():
             return rows
         out = rows.copy()
-        for i, gid in enumerate(np.asarray(ids).reshape(-1)):
-            d = self._delta.get(int(gid))
-            if d is not None:
-                out[i] = out[i] + d
+        out[hit] += self._delta_rows[slots[hit]]
         return out
 
     def on_gradient(self, ids, grads) -> None:
         """Called with the batch's unique ids + their dense grads."""
         ids = np.asarray(ids).reshape(-1)
-        grads = np.asarray(grads)
+        grads = np.asarray(grads, np.float32)
         if self.mode in ("sync", "async"):
             self.table.push(ids, grads, lr=self.lr)
             return
-        # geo: local SGD step — record the weight delta
-        for i, gid in enumerate(ids):
-            gid = int(gid)
-            d = (-self.lr * grads[i]).astype(np.float32)
-            if gid in self._delta:
-                self._delta[gid] = self._delta[gid] + d
-            else:
-                self._delta[gid] = d
+        # geo: local SGD step — accumulate weight deltas, one scatter-add
+        slots = self._delta_slots(ids)
+        np.add.at(self._delta_rows, slots, -self.lr * grads)
 
     def step(self) -> None:
         """Advance the trainer step; geo mode flushes every k_steps."""
@@ -74,9 +101,12 @@ class Communicator:
 
     def flush(self) -> None:
         """Push accumulated weight deltas to the global table (geo)."""
-        if not self._delta:
+        if not self._delta_index:
             return
-        ids = np.asarray(list(self._delta.keys()), np.int64)
-        deltas = np.stack(list(self._delta.values()))
-        self._delta.clear()
+        n = len(self._delta_index)
+        ids = np.fromiter(self._delta_index.keys(), np.int64, n)
+        deltas = self._delta_rows[
+            np.fromiter(self._delta_index.values(), np.int64, n)]
+        self._delta_index = {}
+        self._delta_rows = np.zeros((0, self.table.dim), np.float32)
         self.table.apply_deltas(ids, deltas)

@@ -549,5 +549,54 @@ class Executor:
             return [np.asarray(o) for o in fetches]
         return [Tensor(o) for o in fetches]
 
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
+        """Dataset-driven training loop (reference fluid/executor.py
+        train_from_dataset → trainer.h:98 MultiTrainer + hogwild workers).
+
+        Feeds each dataset batch into `self.run(program, ...)`; hogwild
+        thread semantics come from distributed.fleet.trainer.  Note for the
+        static path: ragged sparse slots pad per batch, so keep slot
+        lengths fixed (or dense) to avoid per-shape recompiles."""
+        from ..distributed.fleet.trainer import MultiTrainer
+
+        if dataset is None:
+            raise ValueError("dataset is required")
+        fetch_list = list(fetch_list or [])
+        names = [f if isinstance(f, str) else getattr(f, "name", None)
+                 for f in fetch_list]
+
+        def train_func(batch):
+            out = self.run(program=program, feed=batch,
+                           fetch_list=fetch_list, scope=scope)
+            if debug and out and fetch_info:
+                print(" ".join(f"{i}={np.asarray(v).ravel()[:4]}"
+                               for i, v in zip(fetch_info, out)))
+            return out[0] if out else None
+
+        handler = fetch_handler
+        if handler is None and fetch_info and print_period:
+            def handler(worker_id, batches, loss):
+                print(f"worker {worker_id} batch {batches} "
+                      f"{names[0] if names else 'loss'}={loss}")
+
+        return MultiTrainer(
+            dataset, train_func, thread_num=thread or None,
+            fetch_period=print_period if handler else 0,
+            fetch_handler=handler).run()
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
+        """Inference twin (fluid/executor.py:1526) — same loop, caller's
+        program simply has no optimizer ops."""
+        return self.train_from_dataset(
+            program=program, dataset=dataset, scope=scope, thread=thread,
+            debug=debug, fetch_list=fetch_list, fetch_info=fetch_info,
+            print_period=print_period, fetch_handler=fetch_handler)
+
     def close(self):
         pass

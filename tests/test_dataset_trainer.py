@@ -1,0 +1,354 @@
+"""Dataset/DataFeed engine + Trainer/DeviceWorker stack (VERDICT r3
+missing item #1 / next-round #3: the industrial CTR training path).
+
+Reference: data_feed.h:664 MultiSlotDataFeed text format, data_set.h:109
+Local/GlobalShuffle, trainer.h:53-328 + device_worker.h:150-643 hogwild
+loops, fluid/executor.py train_from_dataset."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn, optimizer, static
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import (InMemoryDataset, MultiTrainer,
+                                          QueueDataset, train_from_dataset)
+from paddle_tpu.distributed.ps import runtime as ps_runtime
+from paddle_tpu.io.multislot import (MultiSlotDataFeed, Slot,
+                                     write_multislot_file)
+
+SLOTS = [
+    Slot("ids", dtype="int64"),                      # ragged sparse
+    Slot("dense", dtype="float32", is_dense=True, dim=4),
+    Slot("label", dtype="float32", is_dense=True, dim=1),
+]
+
+
+def _gen_ctr_files(tmp_path, n_files=2, rows_per_file=64, vocab=500,
+                   seed=0):
+    """Synthetic CTR data with learnable structure: label depends on
+    whether any id is < vocab/2 and on dense[0]."""
+    rng = np.random.RandomState(seed)
+    paths = []
+    for fi in range(n_files):
+        rows = []
+        for _ in range(rows_per_file):
+            n_ids = rng.randint(1, 4)
+            ids = rng.randint(0, vocab, size=n_ids)
+            dense = rng.randn(4).astype(np.float32)
+            score = (ids < vocab // 2).any() * 1.0 + dense[0]
+            label = 1.0 if score > 0.5 else 0.0
+            rows.append({"ids": ids.tolist(),
+                         "dense": [f"{v:.4f}" for v in dense],
+                         "label": [label]})
+        p = str(tmp_path / f"part-{fi}.txt")
+        write_multislot_file(p, rows, SLOTS)
+        paths.append(p)
+    return paths
+
+
+class TestMultiSlotDataFeed:
+    def test_parse_line(self):
+        feed = MultiSlotDataFeed(SLOTS)
+        rec = feed.parse_line("2 7 9 4 0.5 -1.0 2.0 0.0 1 1.0")
+        np.testing.assert_array_equal(rec.slots["ids"], [7, 9])
+        np.testing.assert_allclose(rec.slots["dense"], [0.5, -1.0, 2.0, 0.0])
+        np.testing.assert_allclose(rec.slots["label"], [1.0])
+
+    def test_malformed_lines_raise(self):
+        feed = MultiSlotDataFeed(SLOTS)
+        with pytest.raises(ValueError):
+            feed.parse_line("3 7 9")            # short slot
+        with pytest.raises(ValueError):
+            feed.parse_line("1 7 4 0.5 -1 2 0 1 1.0 99")  # trailing tokens
+        with pytest.raises(ValueError):
+            feed.parse_line("1 7 2 0.5 -1 1 1.0")  # dense dim mismatch
+
+    def test_batch_padding(self):
+        feed = MultiSlotDataFeed(SLOTS)
+        recs = [feed.parse_line("1 5 4 0 0 0 0 1 0"),
+                feed.parse_line("3 1 2 3 4 0 0 0 0 1 1")]
+        b = feed.batch(recs)
+        assert b["ids"].shape == (2, 3)
+        np.testing.assert_array_equal(b["ids"][0], [5, -1, -1])  # padded
+        np.testing.assert_array_equal(b["ids"][1], [1, 2, 3])
+        assert b["dense"].shape == (2, 4)
+        assert b["label"].shape == (2, 1)
+
+
+class TestInMemoryDataset:
+    def test_load_and_batch(self, tmp_path):
+        paths = _gen_ctr_files(tmp_path, n_files=2, rows_per_file=10)
+        ds = InMemoryDataset()
+        ds.set_slots(SLOTS)
+        ds.set_filelist(paths)
+        ds.set_batch_size(4)
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 20
+        batches = list(ds.iter_batches())
+        assert sum(b["label"].shape[0] for b in batches) == 20
+
+    def test_local_shuffle_deterministic_and_preserving(self, tmp_path):
+        paths = _gen_ctr_files(tmp_path, n_files=1, rows_per_file=30)
+        def load():
+            ds = InMemoryDataset()
+            ds.set_slots(SLOTS)
+            ds.set_filelist(paths)
+            ds.set_batch_size(30)
+            ds.load_into_memory()
+            return ds
+
+        ds1, ds2 = load(), load()
+        before = next(iter(ds1.iter_batches()))["dense"].copy()
+        for ds in (ds1, ds2):
+            ds.set_shuffle_seed(11)
+            ds.local_shuffle()
+        a = next(iter(ds1.iter_batches()))["dense"]
+        b = next(iter(ds2.iter_batches()))["dense"]
+        # deterministic: same seed -> same permutation
+        np.testing.assert_array_equal(a, b)
+        # actually permuted, multiset preserved
+        assert not np.array_equal(a, before)
+        np.testing.assert_allclose(np.sort(a.ravel()),
+                                   np.sort(before.ravel()))
+        # successive shuffles advance the stream (per-epoch reshuffling
+        # must not repeat the same permutation)
+        ds1.local_shuffle()
+        c = next(iter(ds1.iter_batches()))["dense"]
+        assert not np.array_equal(a, c)
+
+    def test_global_shuffle_single_process_collapses_to_local(self, tmp_path):
+        paths = _gen_ctr_files(tmp_path, n_files=1, rows_per_file=12)
+        ds = InMemoryDataset()
+        ds.set_slots(SLOTS)
+        ds.set_filelist(paths)
+        ds.set_batch_size(12)
+        ds.load_into_memory()
+        before = next(iter(ds.iter_batches()))["dense"].copy()
+        ds.set_shuffle_seed(3)
+        ds.global_shuffle()
+        after = next(iter(ds.iter_batches()))["dense"]
+        np.testing.assert_allclose(np.sort(after.ravel()),
+                                   np.sort(before.ravel()))
+
+    def test_release_memory(self, tmp_path):
+        paths = _gen_ctr_files(tmp_path, n_files=1, rows_per_file=5)
+        ds = InMemoryDataset()
+        ds.set_slots(SLOTS)
+        ds.set_filelist(paths)
+        ds.load_into_memory()
+        ds.release_memory()
+        assert ds.get_memory_data_size() == 0
+        with pytest.raises(RuntimeError):
+            list(ds.iter_batches())
+
+
+class TestQueueDataset:
+    def test_round_robin_threads_cover_all(self, tmp_path):
+        paths = _gen_ctr_files(tmp_path, n_files=4, rows_per_file=8)
+        ds = QueueDataset()
+        ds.set_slots(SLOTS)
+        ds.set_filelist(paths)
+        ds.set_batch_size(8)
+        ds.set_thread(2)
+        seen = 0
+        for tid in range(2):
+            for b in ds.iter_batches(thread_id=tid, num_threads=2):
+                seen += b["label"].shape[0]
+        assert seen == 32
+
+
+def _make_ctr_model(emb_dim=8):
+    """BOW CTR model: sparse sum-pool + dense features -> logit."""
+    ps_runtime.reset()
+    emb = ps_runtime.sparse_embedding("ctr_emb", emb_dim, rule="adagrad",
+                                      lr=0.1)
+    head = nn.Linear(emb_dim + 4, 1)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=head.parameters())
+
+    def train_step(batch):
+        ids = batch["ids"]                      # [B, L] pad -1
+        mask = (ids >= 0).astype(np.float32)
+        e = emb(paddle.to_tensor(np.where(ids >= 0, ids, 0)))
+        m = paddle.to_tensor(mask[..., None])
+        pooled = (e * m).sum(axis=1)            # [B, D]
+        feats = paddle.concat(
+            [pooled, paddle.to_tensor(batch["dense"])], axis=1)
+        logit = head(feats)
+        y = paddle.to_tensor(batch["label"])
+        loss = F.binary_cross_entropy_with_logits(logit, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        emb.step()
+        return float(loss._value)
+
+    return emb, train_step
+
+
+class TestCTRTrainEndToEnd:
+    def test_file_fed_ctr_loss_decreases(self, tmp_path):
+        """The r3 done-criterion: file-fed CTR train through
+        SparseEmbedding/SparseTable with decreasing loss."""
+        paddle.seed(0)
+        paths = _gen_ctr_files(tmp_path, n_files=2, rows_per_file=128,
+                               seed=1)
+        ds = InMemoryDataset()
+        ds.set_slots(SLOTS)
+        ds.set_filelist(paths)
+        ds.set_batch_size(16)
+        ds.load_into_memory()
+        ds.set_shuffle_seed(5)
+        ds.local_shuffle()
+
+        emb, train_step = _make_ctr_model()
+        losses = []
+        for _epoch in range(4):
+            out = train_from_dataset(ds, train_step)
+            losses.extend(out["losses"])
+        assert emb.table.size > 0              # rows materialized lazily
+        first = np.mean(losses[:8])
+        last = np.mean(losses[-8:])
+        assert last < first * 0.7, (first, last)
+
+    def test_hogwild_two_threads(self, tmp_path):
+        """MultiTrainer with 2 hogwild workers: all batches consumed, the
+        shared table updated concurrently, training still converges."""
+        paddle.seed(0)
+        paths = _gen_ctr_files(tmp_path, n_files=2, rows_per_file=64,
+                               seed=2)
+        ds = InMemoryDataset()
+        ds.set_slots(SLOTS)
+        ds.set_filelist(paths)
+        ds.set_batch_size(16)
+        ds.set_thread(2)
+        ds.load_into_memory()
+
+        emb, train_step = _make_ctr_model()
+        all_losses = []
+        for _epoch in range(4):
+            out = MultiTrainer(ds, train_step, thread_num=2).run()
+            all_losses.append(out["losses"])
+        assert out["batches"] == 8             # 128 rows / 16 per batch
+        assert emb.table.size > 0
+        assert np.mean(all_losses[-1]) < np.mean(all_losses[0])
+
+    def test_worker_error_surfaces(self, tmp_path):
+        paths = _gen_ctr_files(tmp_path, n_files=1, rows_per_file=8)
+        ds = InMemoryDataset()
+        ds.set_slots(SLOTS)
+        ds.set_filelist(paths)
+        ds.set_batch_size(4)
+        ds.load_into_memory()
+
+        def bad_step(batch):
+            raise ValueError("boom")
+
+        with pytest.raises(RuntimeError, match="worker 0 failed"):
+            MultiTrainer(ds, bad_step).run()
+
+
+class TestGeoCommunicatorVectorized:
+    def test_duplicate_ids_share_one_delta_slot(self):
+        """Regression (review r4): duplicate new ids in one on_gradient
+        call must map to one arena slot; later ids must not alias it."""
+        from paddle_tpu.distributed.ps.communicator import Communicator
+        from paddle_tpu.distributed.ps.table import SparseTable
+
+        table = SparseTable(2, rule="sgd")
+        cm = Communicator(table, mode="geo", k_steps=100, lr=1.0)
+        cm.on_gradient(np.asarray([5, 5]),
+                       np.asarray([[1.0, 0.0], [1.0, 0.0]], np.float32))
+        cm.on_gradient(np.asarray([7]),
+                       np.asarray([[0.0, 3.0]], np.float32))
+        rows = cm.apply_overlay(np.asarray([5, 7]),
+                                np.zeros((2, 2), np.float32))
+        np.testing.assert_allclose(rows[0], [-2.0, 0.0])   # both grads of 5
+        np.testing.assert_allclose(rows[1], [0.0, -3.0])   # 7 untainted
+
+
+class TestGlobalShuffleTwoProcess:
+    def test_records_exchange_across_trainers(self, tmp_path):
+        """2 trainer processes, disjoint id ranges; after global_shuffle
+        the union is preserved and records actually crossed processes."""
+        import json
+        import socket
+        import subprocess
+        import sys
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        endpoint = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+        import os as _os
+        runner = _os.path.join(_os.path.dirname(__file__),
+                               "dist_global_shuffle_runner.py")
+        procs = []
+        for rank in range(2):
+            env = dict(_os.environ)
+            env.update({"JAX_PLATFORMS": "cpu", "PADDLE_TRAINERS_NUM": "2",
+                        "PADDLE_TRAINER_ID": str(rank),
+                        "PADDLE_GLOO_ENDPOINT": endpoint,
+                        "PADDLE_DIST_BACKEND": "gloo",
+                        "SHUFFLE_WORKDIR": str(tmp_path)})
+            env.pop("PADDLE_TRAINER_ENDPOINTS", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, runner], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+        outs = []
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=300)
+            assert p.returncode == 0, f"rank failed:\n{stdout}\n{stderr}"
+            line = [ln for ln in stdout.splitlines()
+                    if ln.startswith("RESULT ")][-1]
+            outs.append(json.loads(line[len("RESULT "):]))
+        outs.sort(key=lambda o: o["rank"])
+        ids0, ids1 = set(outs[0]["ids"]), set(outs[1]["ids"])
+        # union preserved, no duplication
+        assert ids0 | ids1 == set(range(40)) | set(range(1000, 1040))
+        assert not (ids0 & ids1)
+        # records actually crossed: each rank holds some foreign ids
+        assert any(i >= 1000 for i in ids0)
+        assert any(i < 1000 for i in ids1)
+
+
+class TestExecutorTrainFromDataset:
+    def test_static_regression_over_dataset(self, tmp_path):
+        """Executor.train_from_dataset drives a recorded static Program
+        from dataset batches (dense slots keep shapes static)."""
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        rows = []
+        w_true = np.asarray([0.5, -1.0, 2.0, 0.3], np.float32)
+        for _ in range(64):
+            x = rng.randn(4).astype(np.float32)
+            yv = float(x @ w_true)
+            rows.append({"dense": [f"{v:.5f}" for v in x],
+                         "label": [f"{yv:.5f}"]})
+        slots = [Slot("dense", "float32", is_dense=True, dim=4),
+                 Slot("label", "float32", is_dense=True, dim=1)]
+        p = str(tmp_path / "reg.txt")
+        write_multislot_file(p, rows, slots)
+
+        ds = InMemoryDataset()
+        ds.set_slots(slots)
+        ds.set_filelist([p])
+        ds.set_batch_size(16)
+        ds.load_into_memory()
+
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("dense", [16, 4], "float32")
+            y = static.data("label", [16, 1], "float32")
+            lin = nn.Linear(4, 1)
+            loss = F.mse_loss(lin(x), y)
+            opt = optimizer.SGD(learning_rate=0.1,
+                                parameters=lin.parameters())
+            opt.minimize(loss)
+        exe = static.Executor()
+        losses = []
+        for _epoch in range(8):
+            out = exe.train_from_dataset(program=main, dataset=ds,
+                                         fetch_list=[loss])
+            losses.extend(out["losses"])
+        assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
