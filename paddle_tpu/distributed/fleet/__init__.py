@@ -33,6 +33,11 @@ server_index = _fleet_instance.server_index
 worker_endpoints = _fleet_instance.worker_endpoints
 server_endpoints = _fleet_instance.server_endpoints
 barrier_worker = _fleet_instance.barrier_worker
+init_server = _fleet_instance.init_server
+run_server = _fleet_instance.run_server
+init_worker = _fleet_instance.init_worker
+stop_worker = _fleet_instance.stop_worker
+sparse_embedding = _fleet_instance.sparse_embedding
 distributed_optimizer = _fleet_instance.distributed_optimizer
 distributed_model = _fleet_instance.distributed_model
 minimize = _fleet_instance.minimize

@@ -55,6 +55,25 @@ def _recv_msg(sock: socket.socket):
     return pickle.loads(_recv_exact(sock, length))
 
 
+def connect_with_retry(host: str, port: int, timeout: float,
+                       what: str = "peer") -> socket.socket:
+    """Retry-connect until `timeout` (shared by the rendezvous client and
+    the PS service client — one place to tune connection behavior)."""
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            s = socket.create_connection((host, port), timeout=5.0)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s.settimeout(timeout)
+            return s
+        except OSError as e:
+            last = e
+            time.sleep(0.05)
+    raise ConnectionError(
+        f"could not reach {what} at {host}:{port}: {last}")
+
+
 class _RendezvousServer:
     """Rank-0 side: collects per-key contributions, answers when complete."""
 
@@ -167,19 +186,8 @@ class GlooBackend:
         self._lock = threading.Lock()
 
     def _connect(self, host, port):
-        deadline = time.time() + self.timeout
-        last = None
-        while time.time() < deadline:
-            try:
-                s = socket.create_connection((host, port), timeout=5.0)
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                s.settimeout(self.timeout)
-                return s
-            except OSError as e:
-                last = e
-                time.sleep(0.05)
-        raise ConnectionError(
-            f"gloo: could not reach rendezvous at {host}:{port}: {last}")
+        return connect_with_retry(host, port, self.timeout,
+                                  what="gloo rendezvous")
 
     def _collective(self, op: str, payload, group_id=0, ranks=None):
         ranks = list(ranks) if ranks is not None \

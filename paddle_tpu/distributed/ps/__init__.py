@@ -15,5 +15,13 @@ from . import runtime  # noqa: F401
 from .table import SparseTable
 from .communicator import Communicator
 from .embedding import SparseEmbedding
+from .service import (  # noqa: F401
+    AsyncPushQueue,
+    DenseTable,
+    PSClient,
+    PSServer,
+    RemoteSparseTable,
+)
 
-__all__ = ["SparseTable", "Communicator", "SparseEmbedding"]
+__all__ = ["SparseTable", "Communicator", "SparseEmbedding", "PSServer",
+           "PSClient", "RemoteSparseTable", "DenseTable", "AsyncPushQueue"]

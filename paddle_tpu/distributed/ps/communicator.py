@@ -26,11 +26,19 @@ class Communicator:
     """
 
     def __init__(self, table: SparseTable, mode: str = "sync",
-                 k_steps: int = 1, lr: float = 0.01):
+                 k_steps: int = 1, lr: float = 0.01,
+                 use_async_queue: bool = False):
         if mode not in ("sync", "async", "geo"):
             raise ValueError(f"unknown communicator mode {mode!r}")
         if mode == "geo" and k_steps < 1:
             raise ValueError("geo mode requires k_steps >= 1")
+        self._async_q = None
+        if use_async_queue:
+            if mode != "async":
+                raise ValueError("use_async_queue requires mode='async'")
+            from .service import AsyncPushQueue
+
+            self._async_q = AsyncPushQueue(table)
         self.table = table
         self.mode = mode
         self.k_steps = k_steps
@@ -87,7 +95,12 @@ class Communicator:
         ids = np.asarray(ids).reshape(-1)
         grads = np.asarray(grads, np.float32)
         if self.mode in ("sync", "async"):
-            self.table.push(ids, grads, lr=self.lr)
+            if self._async_q is not None:
+                # AsyncCommunicator send-queue: the trainer never blocks on
+                # the RPC; the drain thread pushes in arrival order
+                self._async_q.put(ids, grads, self.lr)
+            else:
+                self.table.push(ids, grads, lr=self.lr)
             return
         # geo: local SGD step — accumulate weight deltas, one scatter-add
         slots = self._delta_slots(ids)
@@ -99,8 +112,17 @@ class Communicator:
         if self.mode == "geo" and self._step % self.k_steps == 0:
             self.flush()
 
+    def stop(self) -> None:
+        """Communicator::Stop — flush and terminate the drain thread."""
+        self.flush()
+        if self._async_q is not None:
+            self._async_q.stop()
+            self._async_q = None
+
     def flush(self) -> None:
-        """Push accumulated weight deltas to the global table (geo)."""
+        """Drain the async queue / push accumulated weight deltas (geo)."""
+        if self._async_q is not None:
+            self._async_q.flush()
         if not self._delta_index:
             return
         n = len(self._delta_index)

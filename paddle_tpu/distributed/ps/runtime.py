@@ -1,21 +1,33 @@
-"""In-process PS runtime: the table registry + worker lifecycle behind the
-fleet facade (reference fleet/runtime/the_one_ps.py:400 — _init_server
-:448 loads tables, _init_worker :759 starts the communicator, :826
-stop_worker; parameter_server_runtime.py:30).
+"""PS runtime: table registry + server/worker lifecycle behind the fleet
+facade (reference fleet/runtime/the_one_ps.py:400 — _init_server :448
+loads tables, _run_server :826 joins the brpc server, _init_worker :759
+starts the communicator; parameter_server_runtime.py:30).
 
-Single-host: tables live in this process.  Multi-host deployments put the
-same SparseTable shards behind a DCN RPC boundary; the worker-side surface
-(sparse_embedding / pull / push / flush) is unchanged."""
+Two deployments, one worker surface (sparse_embedding / pull / push):
+- single-process (no PADDLE_PSERVERS_IP_PORT_LIST): tables live here
+- service mode: fleet.init_server()/run_server() host table shards in
+  PSServer processes; fleet.init_worker() connects a PSClient and
+  sparse_embedding transparently binds RemoteSparseTable handles
+"""
 from __future__ import annotations
 
-from typing import Dict
+import os
+from typing import Dict, Optional
 
 from .communicator import Communicator
 from .embedding import SparseEmbedding
+from .service import PSClient, PSServer, RemoteSparseTable
 from .table import SparseTable
 
-_tables: Dict[str, SparseTable] = {}
+_tables: Dict[str, object] = {}
 _embeddings: Dict[str, SparseEmbedding] = {}
+_server: Optional[PSServer] = None
+_client: Optional[PSClient] = None
+
+
+def _server_endpoints():
+    eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+    return eps.split(",") if eps else []
 
 
 def _mode_from_strategy(strategy):
@@ -51,42 +63,88 @@ def sparse_embedding(name: str, dim: int, rule: str = None, lr: float = None,
     mode, k = _mode_from_strategy(strategy)
     table = _tables.get(name)
     if table is None:
-        table = _tables[name] = SparseTable(dim, rule=rule or "sgd",
-                                            **table_kw)
+        if _client is not None:
+            table = RemoteSparseTable(_client, name, dim,
+                                      rule=rule or "sgd", **table_kw)
+        else:
+            table = SparseTable(dim, rule=rule or "sgd", **table_kw)
+        _tables[name] = table
     emb = SparseEmbedding(dim, table=table,
                           communicator=Communicator(
                               table, mode=mode, k_steps=k,
-                              lr=0.01 if lr is None else lr))
+                              lr=0.01 if lr is None else lr,
+                              use_async_queue=(mode == "async"
+                                               and _client is not None)))
     _embeddings[name] = emb
     return emb
 
 
-def get_table(name: str) -> SparseTable:
+def get_table(name: str):
     return _tables[name]
 
 
+def get_client() -> Optional[PSClient]:
+    return _client
+
+
 def init_server(*_a, **_k):
-    # single-process: tables are created lazily; nothing to load
-    return None
+    """Create this process's PSServer from the env contract
+    (PADDLE_PSERVERS_IP_PORT_LIST + PADDLE_PSERVER_ID).  Single-process
+    mode (no endpoint list): nothing to host — tables are local."""
+    global _server
+    eps = _server_endpoints()
+    if not eps:
+        return None
+    sid = int(os.environ.get("PADDLE_PSERVER_ID", "0"))
+    _server = PSServer(eps[sid], server_id=sid, num_servers=len(eps))
+    _server.start()
+    return _server
 
 
 def run_server():
-    # single-process: tables are already reachable; nothing to serve
+    """Serve until a worker sends stop (the_one_ps.py:826 joins brpc)."""
+    if _server is None:
+        return None
+    _server.run()
     return None
 
 
 def init_worker(strategy=None):
-    # communicators are created with their embeddings; nothing extra here
-    return None
+    """Connect the PSClient when servers exist (the_one_ps.py:759)."""
+    global _client
+    eps = _server_endpoints()
+    if eps and _client is None:
+        _client = PSClient(eps)
+        _client.barrier_ping()
+    return _client
 
 
 def stop_worker():
-    """Flush any pending geo deltas (reference Communicator::Stop)."""
+    """Flush pending pushes/deltas and stop drain threads (reference
+    Communicator::Stop)."""
     for emb in _embeddings.values():
-        emb.communicator.flush()
+        emb.communicator.stop()
+
+
+def shutdown_servers():
+    """Test/teardown helper: worker 0 stops the server processes."""
+    if _client is not None:
+        _client.stop_servers()
 
 
 def reset():
-    """Test helper: drop all registered tables/embeddings."""
+    """Test helper: drop all registered tables/embeddings + connections."""
+    global _client, _server
+    for emb in _embeddings.values():
+        try:
+            emb.communicator.stop()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
     _tables.clear()
     _embeddings.clear()
+    if _client is not None:
+        _client.close()
+        _client = None
+    if _server is not None:
+        _server.stop()
+        _server = None

@@ -8,6 +8,7 @@ until ids arrive.  Each shard is a dict id->slot plus growing numpy arenas
 gathers/scatters over the arenas."""
 from __future__ import annotations
 
+import threading
 from typing import Dict, Optional, Sequence
 
 import numpy as np
@@ -130,6 +131,12 @@ class SparseTable:
         self.num_shards = num_shards
         self._shards = [_Shard(dim, rule, init_fn, dtype=dtype)
                         for _ in range(num_shards)]
+        # structure guard: slots_for's read-modify-write on the id index and
+        # _grow's arena rebind are not atomic — PS server connection threads
+        # and hogwild workers hit them concurrently.  Row UPDATES stay
+        # hogwild (last-writer-wins) in spirit; only the index/arena
+        # structure is serialized.
+        self._lock = threading.Lock()
 
     def _route(self, ids: np.ndarray):
         ids = np.asarray(ids).reshape(-1).astype(np.int64)
@@ -143,10 +150,12 @@ class SparseTable:
         allocate nothing."""
         ids, shard_of = self._route(ids)
         out = np.zeros((len(ids), self.dim), self._shards[0].dtype)
-        for s in range(self.num_shards):
-            mask = shard_of == s
-            if mask.any():
-                out[mask] = self._shards[s].pull(ids[mask], create=create)
+        with self._lock:
+            for s in range(self.num_shards):
+                mask = shard_of == s
+                if mask.any():
+                    out[mask] = self._shards[s].pull(ids[mask],
+                                                     create=create)
         return out
 
     def push(self, ids, grads, lr: float = 0.01) -> None:
@@ -154,22 +163,26 @@ class SparseTable:
         (duplicates merged by summation — PushSparse)."""
         ids, shard_of = self._route(ids)
         grads = np.asarray(grads).reshape(len(ids), self.dim)
-        for s in range(self.num_shards):
-            mask = shard_of == s
-            if mask.any():
-                self._shards[s].push(ids[mask], grads[mask], lr, **self.hp)
+        with self._lock:
+            for s in range(self.num_shards):
+                mask = shard_of == s
+                if mask.any():
+                    self._shards[s].push(ids[mask], grads[mask], lr,
+                                         **self.hp)
 
     def apply_deltas(self, ids, deltas) -> None:
         """Add weight deltas directly to rows (geo-communicator push —
         rule-independent: the local trainer already applied its optimizer)."""
         ids, shard_of = self._route(ids)
         deltas = np.asarray(deltas, np.float32).reshape(len(ids), self.dim)
-        for s in range(self.num_shards):
-            mask = shard_of == s
-            if mask.any():
-                sh = self._shards[s]
-                slots = sh.slots_for(ids[mask], create=True)
-                np.add.at(sh.values, slots, deltas[mask].astype(sh.dtype))
+        with self._lock:
+            for s in range(self.num_shards):
+                mask = shard_of == s
+                if mask.any():
+                    sh = self._shards[s]
+                    slots = sh.slots_for(ids[mask], create=True)
+                    np.add.at(sh.values, slots,
+                              deltas[mask].astype(sh.dtype))
 
     @property
     def size(self) -> int:
@@ -184,15 +197,17 @@ class SparseTable:
         fields = self._ACC_FIELDS.get(self.rule, ())
         ids_parts, row_parts = [], []
         acc_parts = {f: [] for f in fields}
-        for s in self._shards:
-            if not s.index:
-                continue
-            gids = np.fromiter(s.index.keys(), np.int64, len(s.index))
-            slots = np.fromiter(s.index.values(), np.int64, len(s.index))
-            ids_parts.append(gids)
-            row_parts.append(s.values[slots])
-            for f in fields:
-                acc_parts[f].append(getattr(s, f)[slots])
+        with self._lock:
+            for s in self._shards:
+                if not s.index:
+                    continue
+                gids = np.fromiter(s.index.keys(), np.int64, len(s.index))
+                slots = np.fromiter(s.index.values(), np.int64,
+                                    len(s.index))
+                ids_parts.append(gids)
+                row_parts.append(s.values[slots])
+                for f in fields:
+                    acc_parts[f].append(getattr(s, f)[slots])
         if not ids_parts:
             out = {"ids": np.zeros((0,), np.int64),
                    "rows": np.zeros((0, self.dim), np.float32)}
@@ -210,11 +225,13 @@ class SparseTable:
             return
         fields = self._ACC_FIELDS.get(self.rule, ())
         ids, shard_of = self._route(d["ids"])
-        for s in range(self.num_shards):
-            mask = shard_of == s
-            if mask.any():
-                slots = self._shards[s].slots_for(ids[mask], create=True)
-                self._shards[s].values[slots] = d["rows"][mask]
-                for f in fields:
-                    if f in d:
-                        getattr(self._shards[s], f)[slots] = d[f][mask]
+        with self._lock:
+            for s in range(self.num_shards):
+                mask = shard_of == s
+                if mask.any():
+                    slots = self._shards[s].slots_for(ids[mask],
+                                                      create=True)
+                    self._shards[s].values[slots] = d["rows"][mask]
+                    for f in fields:
+                        if f in d:
+                            getattr(self._shards[s], f)[slots] = d[f][mask]
