@@ -14,6 +14,14 @@ from .norm_layers import *  # noqa: F401,F403
 from .pool_layers import *  # noqa: F401,F403
 
 # sequence / attention stacks
+from .decode import (  # noqa: F401
+    BeamSearchDecoder,
+    beam_search_decode,
+    beam_search_step,
+    dynamic_decode,
+    gather_tree,
+    greedy_search_decode,
+)
 from .rnn import (  # noqa: F401
     GRU,
     GRUCell,

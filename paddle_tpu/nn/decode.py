@@ -1,0 +1,250 @@
+"""Decoding: fixed-width beam search + dynamic_decode.
+
+Reference: fluid/layers/rnn.py:866 BeamSearchDecoder (initialize :1108,
+step :1239 `_beam_search_step`, finalize :1291 gather_tree backtrack) and
+:822 dynamic_decode; C++ kernel operators/math/beam_search.h:83
+BeamSearchFunctor (per-branch top-k + pruning).
+
+TPU-native design: the reference's LoD-based variable-width beams (prune
+finished branches out of the tensor) become a FIXED [batch, beam] lattice
+— finished beams persist, emit end_id, and keep their score frozen (the
+standard jittable formulation).  The whole decode is one lax.scan: no
+host round-trips per step, the MXU sees [batch*beam, ...] matmuls."""
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops._helpers import to_tensor_like
+from ..ops.dispatch import apply
+from ..tensor import Tensor
+
+__all__ = ["beam_search_step", "beam_search_decode", "BeamSearchDecoder",
+           "dynamic_decode", "gather_tree", "greedy_search_decode"]
+
+_NEG_INF = -1e9
+
+
+def beam_search_step(pre_scores, log_probs, finished, beam_size,
+                     end_id, length_penalty: float = 0.0, step: int = 1):
+    """One lattice step (beam_search.h:83 / rnn.py _beam_search_step):
+
+    pre_scores [B, K] cumulative log-probs; log_probs [B, K, V] this
+    step's token log-probs; finished [B, K] bool.  Returns
+    (next_scores [B,K] — still CUMULATIVE log-probs, token_ids [B,K],
+    parent_idx [B,K]).
+
+    ``length_penalty`` alpha != 0 ranks candidates by the GNMT-normalized
+    score cum/((5+step)/6)^alpha (selection only — the carried score stays
+    cumulative so the recursion is exact).
+
+    Finished beams contribute exactly ONE continuation (end_id, score
+    unchanged) so they can't flood the top-k (the reference prunes them
+    out of the LoD; freezing is the fixed-shape equivalent)."""
+    B, K, V = log_probs.shape
+    # finished beams: only end_id continues, at frozen score
+    cont = jnp.where(finished[..., None], _NEG_INF, log_probs)
+    cont = cont.at[..., end_id].set(
+        jnp.where(finished, 0.0, cont[..., end_id]))
+    total = pre_scores[..., None] + cont                      # [B, K, V]
+    flat = total.reshape(B, K * V)
+    if length_penalty:
+        # jnp arithmetic: `step` may be a traced scan counter
+        lp = ((5.0 + jnp.asarray(step, jnp.float32)) / 6.0) \
+            ** length_penalty
+        _, top_idx = jax.lax.top_k(flat / lp, K)
+        top_scores = jnp.take_along_axis(flat, top_idx, axis=1)
+    else:
+        top_scores, top_idx = jax.lax.top_k(flat, K)          # [B, K]
+    parent = (top_idx // V).astype(jnp.int32)
+    token = (top_idx % V).astype(jnp.int32)
+    return top_scores, token, parent
+
+
+def _gather_tree_impl(idv, parv):
+    T = idv.shape[0]
+
+    def body(carry, t):
+        beam = carry                                  # [B, K] int32
+        tok = jnp.take_along_axis(idv[t], beam, axis=1)
+        beam = jnp.take_along_axis(parv[t], beam, axis=1)
+        return beam, tok
+
+    K = idv.shape[2]
+    init = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[None, :],
+                            idv.shape[1:]).astype(jnp.int32)
+    _, toks = jax.lax.scan(body, init, jnp.arange(T - 1, -1, -1))
+    return toks[::-1]                                 # [T, B, K]
+
+
+def gather_tree(ids, parents):
+    """Backtrack the beam lattice (reference gather_tree op /
+    rnn.py:1291 finalize): ids, parents [T, B, K] -> full sequences
+    [T, B, K] read root-to-leaf."""
+    return apply("gather_tree", _gather_tree_impl, to_tensor_like(ids),
+                 to_tensor_like(parents))
+
+
+class _DecodeOut(NamedTuple):
+    ids: jnp.ndarray          # [B, K, T]
+    scores: jnp.ndarray       # [B, K]
+    lengths: jnp.ndarray      # [B, K]
+
+
+def beam_search_decode(step_fn: Callable, init_state, batch_size: int,
+                       beam_size: int, max_len: int, bos_id: int,
+                       end_id: int, logits_normalized: bool = False,
+                       length_penalty: float = 0.0):
+    """Full jittable beam decoder: one lax.scan over max_len steps.
+
+    ``step_fn(token_ids [B*K], state) -> (logits [B*K, V], state)`` — the
+    model's single-step form (cell + output projection).  Logits are
+    log_softmax-normalized here; pass ``logits_normalized=True`` ONLY if
+    step_fn already returns log-probabilities.
+    ``init_state``: pytree with leading dim B*K (tile with
+    BeamSearchDecoder.tile_beam_merge_with_batch).
+
+    Returns (ids [B, K, T] int32 backtracked, scores [B, K], lengths
+    [B, K]) sorted best-first."""
+    B, K = batch_size, beam_size
+
+    def scan_body(carry, t):
+        tokens, scores, finished, state = carry
+        log_probs, state = step_fn(tokens.reshape(B * K), state)
+        V = log_probs.shape[-1]
+        lp = log_probs.reshape(B, K, V) if logits_normalized \
+            else jax.nn.log_softmax(log_probs.reshape(B, K, V), axis=-1)
+        new_scores, token, parent = beam_search_step(
+            scores, lp, finished, K, end_id,
+            length_penalty=length_penalty, step=t + 1)
+        # reorder state + finished along the parent beams
+        flat_parent = (parent + jnp.arange(B)[:, None] * K).reshape(-1)
+        state = jax.tree_util.tree_map(
+            lambda s: s[flat_parent], state)
+        finished = jnp.take_along_axis(finished, parent, axis=1) \
+            | (token == end_id)
+        return (token, new_scores, finished, state), (token, parent)
+
+    tokens0 = jnp.full((B, K), bos_id, jnp.int32)
+    # only beam 0 live at t=0 (identical beams would collapse the top-k)
+    scores0 = jnp.tile(
+        jnp.asarray([0.0] + [_NEG_INF] * (K - 1), jnp.float32)[None, :],
+        (B, 1))
+    finished0 = jnp.zeros((B, K), bool)
+    (_, scores, finished, _), (toks, parents) = jax.lax.scan(
+        scan_body, (tokens0, scores0, finished0, init_state),
+        jnp.arange(max_len))
+    # backtrack [T, B, K] -> root-to-leaf sequences
+    full = _gather_tree_impl(toks, parents)                   # [T, B, K]
+    ids = jnp.moveaxis(full, 0, -1)                           # [B, K, T]
+    # length = position of first end_id + 1 (or T)
+    is_end = ids == end_id
+    any_end = is_end.any(axis=-1)
+    first_end = jnp.argmax(is_end, axis=-1)
+    lengths = jnp.where(any_end, first_end + 1, max_len)
+    return _DecodeOut(ids=ids, scores=scores, lengths=lengths)
+
+
+def greedy_search_decode(step_fn, init_state, batch_size: int,
+                         max_len: int, bos_id: int, end_id: int):
+    """Greedy argmax decode (the beam_size=1 parity reference)."""
+
+    def scan_body(carry, t):
+        tokens, score, finished, state = carry
+        log_probs, state = step_fn(tokens, state)
+        lp = jax.nn.log_softmax(log_probs, axis=-1)
+        nxt = jnp.argmax(lp, axis=-1).astype(jnp.int32)
+        step_lp = jnp.take_along_axis(lp, nxt[:, None], axis=1)[:, 0]
+        nxt = jnp.where(finished, end_id, nxt)
+        score = score + jnp.where(finished, 0.0, step_lp)
+        finished = finished | (nxt == end_id)
+        return (nxt, score, finished, state), nxt
+
+    B = batch_size
+    init = (jnp.full((B,), bos_id, jnp.int32), jnp.zeros((B,)),
+            jnp.zeros((B,), bool), init_state)
+    (_, score, _, _), toks = jax.lax.scan(scan_body, init,
+                                          jnp.arange(max_len))
+    return jnp.moveaxis(toks, 0, 1), score                # [B, T], [B]
+
+
+class BeamSearchDecoder:
+    """API-parity wrapper (reference rnn.py:866): wraps a cell + output
+    layer into the step_fn form and exposes tile_beam_merge_with_batch."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*beam, ...] (reference :935)."""
+        t = to_tensor_like(x)
+
+        def f(v):
+            return jnp.repeat(v, beam_size, axis=0)
+
+        return apply("tile_beam_merge", f, t)
+
+    def _step_fn(self):
+        def step_fn(tokens, state):
+            inp = tokens
+            if self.embedding_fn is not None:
+                inp = self.embedding_fn(inp)
+            out, state = self.cell(inp, state)
+            if self.output_fn is not None:
+                out = self.output_fn(out)
+            return out, state
+
+        return step_fn
+
+
+def dynamic_decode(decoder: BeamSearchDecoder, inits=None, max_step_num=32,
+                   batch_size=None, **kwargs):
+    """reference rnn.py dynamic_decode: run the decoder to max_step_num.
+    Returns (ids Tensor [B, K, T], scores Tensor [B, K])."""
+    if inits is None:
+        raise ValueError(
+            "dynamic_decode requires inits (the decoder cell's initial "
+            "state, tiled to [batch*beam, ...] with "
+            "BeamSearchDecoder.tile_beam_merge_with_batch)")
+    if batch_size is None:
+        leaves = jax.tree_util.tree_leaves(
+            inits, is_leaf=lambda x: isinstance(x, Tensor))
+        leaf = leaves[0]
+        v = leaf._value if isinstance(leaf, Tensor) else jnp.asarray(leaf)
+        batch_size = v.shape[0] // decoder.beam_size
+
+    step_fn_raw = decoder._step_fn()
+
+    def _unwrap(x):
+        return x._value if isinstance(x, Tensor) else x
+
+    def _unwrap_tree(tree):
+        # Tensor is itself a pytree node — without is_leaf, tree_map
+        # descends into it and re-wraps, keeping the Tensor (and its
+        # stop_gradient metadata) in the scan carry
+        return jax.tree_util.tree_map(
+            _unwrap, tree, is_leaf=lambda x: isinstance(x, Tensor))
+
+    def step_fn(tokens, state):
+        out, state = step_fn_raw(Tensor(tokens), state)
+        # raw arrays in the scan carry: Tensor pytree metadata
+        # (stop_gradient) would differ between input and output
+        return _unwrap(out), _unwrap_tree(state)
+
+    state = jax.tree_util.tree_map(
+        lambda s: s._value if isinstance(s, Tensor) else jnp.asarray(s),
+        inits, is_leaf=lambda x: isinstance(x, Tensor))
+    res = beam_search_decode(
+        step_fn, state, batch_size, decoder.beam_size, max_step_num,
+        decoder.start_token, decoder.end_token)
+    return Tensor(res.ids), Tensor(res.scores)

@@ -1,0 +1,277 @@
+"""Sequence ops over padded tensors (reference: operators/sequence_ops/ —
+sequence_mask_op, sequence_pad/unpad_op, sequence_pool_op,
+sequence_expand_op, sequence_reverse_op, sequence_softmax_op,
+sequence_enumerate_op, sequence_concat_op).
+
+TPU-native design: the reference carries variable-length sequences as
+LoDTensors (ragged offsets).  XLA needs static shapes, so every op here
+takes PADDED [B, L, ...] tensors plus a ``lengths`` [B] vector — the
+LoD→padding delta documented in SURVEY §7.  All ops are jittable and
+differentiable where the reference's are."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import to_tensor_like
+from .dispatch import apply
+
+__all__ = [
+    "sequence_mask", "sequence_pad", "sequence_unpad", "sequence_pool",
+    "sequence_reverse", "sequence_softmax", "sequence_expand_as",
+    "sequence_enumerate", "sequence_concat", "sequence_first_step",
+    "sequence_last_step",
+]
+
+
+def sequence_mask(x, maxlen=None, dtype="bool", name=None):
+    """lengths [.., B] -> [.., B, maxlen] mask (sequence_mask_op.cc)."""
+    t = to_tensor_like(x)
+    if maxlen is None:
+        maxlen = int(np.asarray(t._value).max())
+    _DTYPES = {"bool": jnp.bool_, "int32": jnp.int32, "int64": jnp.int64,
+               "float16": jnp.float16, "bfloat16": jnp.bfloat16,
+               "float32": jnp.float32,
+               # float64 degrades to float32 (jax x64 disabled by default)
+               "float64": jnp.float32}
+    if str(dtype) not in _DTYPES:
+        raise ValueError(
+            f"sequence_mask: unsupported dtype {dtype!r} "
+            f"(one of {sorted(_DTYPES)})")
+    jdt = _DTYPES[str(dtype)]
+
+    def f(lens):
+        return (jnp.arange(maxlen)[None, :]
+                < lens.reshape(-1, 1)).reshape(
+                    tuple(lens.shape) + (maxlen,)).astype(jdt)
+
+    return apply("sequence_mask", f, t)
+
+
+def sequence_pad(x, pad_value, lengths, maxlen=None, name=None):
+    """Concatenated values [total, ...] + lengths [B] -> padded
+    [B, maxlen, ...] (sequence_pad_op.cc; LoD -> padded layout).
+
+    ``maxlen`` must be static (defaults to max(lengths) evaluated NOW —
+    pass it explicitly inside jit)."""
+    t = to_tensor_like(x)
+    lens = to_tensor_like(lengths)
+    pv = to_tensor_like(pad_value)
+    if maxlen is None:
+        maxlen = int(np.asarray(lens._value).max())
+
+    def f(vals, ln, pad):
+        B = ln.shape[0]
+        starts = jnp.concatenate([jnp.zeros((1,), ln.dtype),
+                                  jnp.cumsum(ln)[:-1]])
+        pos = starts[:, None] + jnp.arange(maxlen)[None, :]     # [B, L]
+        valid = jnp.arange(maxlen)[None, :] < ln[:, None]
+        gathered = vals[jnp.clip(pos, 0, vals.shape[0] - 1)]
+        mask = valid.reshape(valid.shape + (1,) * (gathered.ndim - 2))
+        return jnp.where(mask, gathered,
+                         pad.astype(gathered.dtype)), ln
+
+    return apply("sequence_pad", f, t, lens, pv)
+
+
+def sequence_unpad(x, length, name=None):
+    """Padded [B, L, ...] + lengths [B] -> concatenated [total, ...]
+    (sequence_unpad_op.cc).  `total` is data-dependent, so the (row, col)
+    index map is computed on the host from the lengths — but the VALUE
+    gather goes through dispatch, so gradients flow back into the padded
+    input (the reference op has a grad kernel)."""
+    t = to_tensor_like(x)
+    lens = to_tensor_like(length)
+    ln = np.asarray(lens._value).astype(np.int64)
+    rows = np.repeat(np.arange(len(ln)), ln)
+    cols = np.concatenate([np.arange(n) for n in ln]) if len(ln) else \
+        np.zeros((0,), np.int64)
+
+    def f(vals):
+        if rows.size == 0:
+            return jnp.zeros((0,) + vals.shape[2:], vals.dtype)
+        return vals[jnp.asarray(rows), jnp.asarray(cols)]
+
+    return apply("sequence_unpad", f, t)
+
+
+def sequence_pool(input, pool_type, lengths=None, pad_value=0.0, name=None):
+    """Masked pooling over the time axis (sequence_pool_op.cc:
+    sum/average/sqrt/max/last/first).  input [B, L, ...]; lengths [B]
+    (None = all L valid)."""
+    t = to_tensor_like(input)
+    pool_type = pool_type.lower()
+    args = [t]
+    if lengths is not None:
+        args.append(to_tensor_like(lengths))
+
+    def f(v, ln=None):
+        B, L = v.shape[0], v.shape[1]
+        if ln is None:
+            ln = jnp.full((B,), L, jnp.int32)
+        valid = jnp.arange(L)[None, :] < ln[:, None]
+        mask = valid.reshape((B, L) + (1,) * (v.ndim - 2))
+        n = jnp.maximum(ln, 1).reshape((B,) + (1,) * (v.ndim - 2))
+        empty = (ln == 0).reshape((B,) + (1,) * (v.ndim - 2))
+        pad = jnp.asarray(pad_value, v.dtype)
+        if pool_type == "sum":
+            out = jnp.where(mask, v, 0).sum(axis=1)
+        elif pool_type in ("average", "mean", "avg"):
+            out = jnp.where(mask, v, 0).sum(axis=1) / n
+        elif pool_type == "sqrt":
+            out = jnp.where(mask, v, 0).sum(axis=1) / jnp.sqrt(
+                n.astype(v.dtype))
+        elif pool_type == "max":
+            neg = jnp.finfo(v.dtype).min if jnp.issubdtype(
+                v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
+            out = jnp.where(mask, v, neg).max(axis=1)
+        elif pool_type == "first":
+            out = v[:, 0]
+        elif pool_type == "last":
+            idx = jnp.maximum(ln - 1, 0)
+            out = jnp.take_along_axis(
+                v, idx.reshape((B, 1) + (1,) * (v.ndim - 2)),
+                axis=1).squeeze(1)
+        else:
+            raise ValueError(f"unknown pool_type {pool_type!r}")
+        # empty sequences yield pad_value (sequence_pool_op.cc), not the
+        # mask's fill garbage
+        return jnp.where(empty, pad, out)
+
+    return apply("sequence_pool", f, *args)
+
+
+def sequence_first_step(input, lengths=None):
+    return sequence_pool(input, "first", lengths)
+
+
+def sequence_last_step(input, lengths=None):
+    return sequence_pool(input, "last", lengths)
+
+
+def sequence_reverse(x, lengths=None, name=None):
+    """Reverse each row's VALID prefix, padding stays in place
+    (sequence_reverse_op.cc)."""
+    t = to_tensor_like(x)
+    args = [t]
+    if lengths is not None:
+        args.append(to_tensor_like(lengths))
+
+    def f(v, ln=None):
+        B, L = v.shape[0], v.shape[1]
+        if ln is None:
+            ln = jnp.full((B,), L, jnp.int32)
+        pos = jnp.arange(L)[None, :]
+        src = jnp.where(pos < ln[:, None], ln[:, None] - 1 - pos, pos)
+        return jnp.take_along_axis(
+            v, src.reshape((B, L) + (1,) * (v.ndim - 2)), axis=1) \
+            if v.ndim > 2 else jnp.take_along_axis(v, src, axis=1)
+
+    return apply("sequence_reverse", f, *args)
+
+
+def sequence_softmax(input, lengths=None, name=None):
+    """Masked softmax over the time axis (sequence_softmax_op.cc);
+    input [B, L]."""
+    t = to_tensor_like(input)
+    args = [t]
+    if lengths is not None:
+        args.append(to_tensor_like(lengths))
+
+    def f(v, ln=None):
+        B, L = v.shape
+        if ln is None:
+            ln = jnp.full((B,), L, jnp.int32)
+        valid = jnp.arange(L)[None, :] < ln[:, None]
+        masked = jnp.where(valid, v, -jnp.inf)
+        m = jnp.max(masked, axis=1, keepdims=True)
+        e = jnp.where(valid, jnp.exp(masked - m), 0.0)
+        return e / jnp.maximum(e.sum(axis=1, keepdims=True), 1e-30)
+
+    return apply("sequence_softmax", f, *args)
+
+
+def sequence_expand_as(x, y_lengths, name=None):
+    """Repeat each row i of x within its padded row (sequence_expand_as_op:
+    x [B, ...] -> [B, L, ...] with positions >= lengths zeroed)."""
+    t = to_tensor_like(x)
+    lens = to_tensor_like(y_lengths)
+    # static maxlen from the lengths' current values
+    L = int(np.asarray(lens._value).max())
+
+    def g(v, ln):
+        B = v.shape[0]
+        out = jnp.broadcast_to(v[:, None], (B, L) + v.shape[1:])
+        valid = jnp.arange(L)[None, :] < ln[:, None]
+        mask = valid.reshape((B, L) + (1,) * (v.ndim - 1))
+        return jnp.where(mask, out, 0)
+
+    return apply("sequence_expand_as", g, t, lens)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, lengths=None,
+                       name=None):
+    """Sliding windows of ids (sequence_enumerate_op.cc): [B, L] ->
+    [B, L, win_size]; positions past each row's length fill pad_value."""
+    t = to_tensor_like(input)
+    args = [t]
+    if lengths is not None:
+        args.append(to_tensor_like(lengths))
+
+    def f(v, ln=None):
+        B, L = v.shape
+        if ln is None:
+            ln = jnp.full((B,), L, jnp.int32)
+        pos = jnp.arange(L)[None, :, None] + jnp.arange(win_size)[None,
+                                                                  None, :]
+        inside = pos < ln[:, None, None]
+        gathered = jnp.take_along_axis(
+            jnp.broadcast_to(v[:, :, None], (B, L, win_size)),
+            jnp.clip(pos, 0, L - 1), axis=1)
+        return jnp.where(inside, gathered,
+                         jnp.asarray(pad_value, v.dtype))
+
+    return apply("sequence_enumerate", f, *args)
+
+
+def sequence_concat(input, lengths_list=None, name=None):
+    """Concat sequences ALONG TIME per batch row (sequence_concat_op.cc):
+    [B, L1, ...] + [B, L2, ...] (+ lengths) -> [B, L1+L2, ...] with each
+    row's valid parts packed contiguously, plus combined lengths."""
+    if lengths_list is None:
+        lengths_list = [None] * len(input)
+    ts = [to_tensor_like(x) for x in input]
+    lens = []
+    for x, ln in zip(ts, lengths_list):
+        if ln is None:
+            B, L = x.shape[0], x.shape[1]
+            lens.append(to_tensor_like(np.full((B,), L, np.int64)))
+        else:
+            lens.append(to_tensor_like(ln))
+
+    def f(*vals_and_lens):
+        k = len(vals_and_lens) // 2
+        vals = vals_and_lens[:k]
+        lns = vals_and_lens[k:]
+        B = vals[0].shape[0]
+        Lout = sum(v.shape[1] for v in vals)
+        total = jnp.stack(lns, 0).sum(0)                     # [B]
+        out_pos = jnp.arange(Lout)[None, :]
+        out = jnp.zeros((B, Lout) + vals[0].shape[2:], vals[0].dtype)
+        offset = jnp.zeros((B,), lns[0].dtype)
+        for v, ln in zip(vals, lns):
+            L = v.shape[1]
+            # scatter row i's first ln[i] steps at out[:, offset:offset+ln]
+            src_idx = out_pos - offset[:, None]              # [B, Lout]
+            inside = (src_idx >= 0) & (src_idx < ln[:, None])
+            g = jnp.take_along_axis(
+                v, jnp.clip(src_idx, 0, L - 1).reshape(
+                    (B, Lout) + (1,) * (v.ndim - 2)), axis=1) \
+                if v.ndim > 2 else jnp.take_along_axis(
+                    v, jnp.clip(src_idx, 0, L - 1), axis=1)
+            mask = inside.reshape((B, Lout) + (1,) * (v.ndim - 2))
+            out = jnp.where(mask, g, out)
+            offset = offset + ln
+        return out, total
+
+    return apply("sequence_concat", f, *ts, *lens)

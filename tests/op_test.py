@@ -30,6 +30,7 @@ OP_MODULES = [
     "paddle_tpu.ops.random_ops",
     "paddle_tpu.ops.attention",
     "paddle_tpu.ops.detection",
+    "paddle_tpu.ops.sequence",
     "paddle_tpu.nn.functional.activation",
     "paddle_tpu.nn.functional.common",
     "paddle_tpu.nn.functional.conv",

@@ -579,6 +579,45 @@ OVERRIDES.update({
         rtol=8e-2),
     "detection.bipartite_match": Spec(
         lambda rng: [t(fmat(rng, 4, 5))], **NOGRAD),
+    # --- sequence ops (padded + lengths; VERDICT r3 item #8) ---------------
+    "sequence.sequence_mask": Spec(
+        lambda rng: [t(np.asarray([2, 3], np.int64))],
+        kwargs={"maxlen": 4}, **NOGRAD),
+    "sequence.sequence_pad": Spec(
+        lambda rng: [t(fmat(rng, 5, 2)), t(np.float32(0.0)),
+                     t(np.asarray([2, 3], np.int64))],
+        kwargs={"maxlen": 4}, grad_args=[0], rtol=8e-2),
+    "sequence.sequence_unpad": Spec(
+        lambda rng: [t(fmat(rng, 2, 3, 2)),
+                     t(np.asarray([2, 3], np.int64))], **NOGRAD),
+    "sequence.sequence_pool": Spec(
+        lambda rng: [t(fmat(rng, 2, 3, 4))],
+        kwargs={"pool_type": "sum",
+                "lengths": t(np.asarray([2, 3], np.int64))},
+        grad_args=[0], rtol=8e-2),
+    "sequence.sequence_first_step": Spec(
+        lambda rng: [t(fmat(rng, 2, 3, 4))], grad_args=[0], rtol=8e-2),
+    "sequence.sequence_last_step": Spec(
+        lambda rng: [t(fmat(rng, 2, 3, 4))], grad_args=[0], rtol=8e-2),
+    "sequence.sequence_reverse": Spec(
+        lambda rng: [t(fmat(rng, 2, 4)),
+                     t(np.asarray([3, 2], np.int64))],
+        grad_args=[0], rtol=8e-2),
+    "sequence.sequence_softmax": Spec(
+        lambda rng: [t(fmat(rng, 2, 4)),
+                     t(np.asarray([3, 2], np.int64))],
+        grad_args=[0], rtol=8e-2),
+    "sequence.sequence_expand_as": Spec(
+        lambda rng: [t(fmat(rng, 2, 3)),
+                     t(np.asarray([2, 3], np.int64))],
+        grad_args=[0], rtol=8e-2),
+    "sequence.sequence_enumerate": Spec(
+        lambda rng: [t(rng.randint(0, 9, (2, 4)).astype(np.int64))],
+        kwargs={"win_size": 2}, **NOGRAD),
+    "sequence.sequence_concat": Spec(
+        lambda rng: [[t(fmat(rng, 2, 3, 2)), t(fmat(rng, 2, 2, 2))],
+                     [t(np.asarray([2, 3], np.int64)),
+                      t(np.asarray([1, 2], np.int64))]], **NOGRAD),
     "detection.generate_proposals": Spec(
         lambda rng: [t(fmat(rng, 12)), t(fmat(rng, 12, 4)),
                      t(np.asarray([16.0, 16.0, 1.0], np.float32)),

@@ -1,0 +1,274 @@
+"""Sequence ops + beam search (VERDICT r3 missing #4 / next-round #8).
+
+Reference: operators/sequence_ops/ (mask/pad/pool/reverse/softmax/
+enumerate/concat over LoD tensors — here padded+lengths),
+operators/math/beam_search.h:83, fluid/layers/rnn.py:866
+BeamSearchDecoder + dynamic_decode."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn.decode import (beam_search_decode, beam_search_step,
+                                  dynamic_decode, gather_tree,
+                                  greedy_search_decode, BeamSearchDecoder)
+from paddle_tpu.ops import sequence as seq
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestSequenceOps:
+    def test_mask(self):
+        m = seq.sequence_mask(_t([2, 0, 3]), maxlen=4).numpy()
+        np.testing.assert_array_equal(
+            m, [[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]])
+
+    def test_pad_unpad_roundtrip(self):
+        vals = np.arange(10, dtype=np.float32).reshape(5, 2)
+        lens = np.asarray([2, 3])
+        padded, out_lens = seq.sequence_pad(_t(vals), _t(0.0), _t(lens),
+                                            maxlen=4)
+        p = padded.numpy()
+        assert p.shape == (2, 4, 2)
+        np.testing.assert_allclose(p[0, :2], vals[:2])
+        np.testing.assert_allclose(p[0, 2:], 0.0)
+        np.testing.assert_allclose(p[1, :3], vals[2:])
+        back = seq.sequence_unpad(padded, out_lens).numpy()
+        np.testing.assert_allclose(back, vals)
+
+    @pytest.mark.parametrize("pool,want", [
+        ("sum", [[3.0], [5.0]]),
+        ("average", [[1.5], [2.5]]),
+        ("max", [[2.0], [3.0]]),
+        ("first", [[1.0], [2.0]]),
+        ("last", [[2.0], [3.0]]),
+        ("sqrt", [[3.0 / np.sqrt(2)], [5.0 / np.sqrt(2)]]),
+    ])
+    def test_pool(self, pool, want):
+        x = np.asarray([[[1.], [2.], [9.]],
+                        [[2.], [3.], [7.]]], np.float32)
+        lens = np.asarray([2, 2])
+        got = seq.sequence_pool(_t(x), pool, _t(lens)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_pool_grad_masks_padding(self):
+        x = paddle.to_tensor(np.ones((2, 3, 1), np.float32))
+        x.stop_gradient = False
+        out = seq.sequence_pool(x, "sum", _t(np.asarray([2, 1])))
+        out.sum().backward()
+        g = x.grad.numpy()[..., 0]
+        np.testing.assert_array_equal(g, [[1, 1, 0], [1, 0, 0]])
+
+    def test_reverse(self):
+        x = np.asarray([[1, 2, 3, 99], [4, 5, 99, 99]], np.float32)
+        got = seq.sequence_reverse(_t(x), _t([3, 2])).numpy()
+        np.testing.assert_array_equal(got,
+                                      [[3, 2, 1, 99], [5, 4, 99, 99]])
+
+    def test_softmax_masks(self):
+        x = np.asarray([[1.0, 1.0, 50.0]], np.float32)
+        got = seq.sequence_softmax(_t(x), _t([2])).numpy()
+        np.testing.assert_allclose(got, [[0.5, 0.5, 0.0]], atol=1e-6)
+
+    def test_enumerate(self):
+        x = np.asarray([[1, 2, 3]], np.int64)
+        got = seq.sequence_enumerate(_t(x), 2, pad_value=0).numpy()
+        np.testing.assert_array_equal(got[0],
+                                      [[1, 2], [2, 3], [3, 0]])
+
+    def test_concat_packs_time(self):
+        a = np.asarray([[[1.], [2.], [0.]]], np.float32)   # len 2
+        b = np.asarray([[[5.], [0.]]], np.float32)         # len 1
+        out, lens = seq.sequence_concat([_t(a), _t(b)],
+                                        [_t([2]), _t([1])])
+        np.testing.assert_allclose(out.numpy()[0, :3, 0], [1, 2, 5])
+        assert int(lens.numpy()[0]) == 3
+
+    def test_pool_empty_sequence_gets_pad_value(self):
+        x = np.full((2, 3, 1), 7.0, np.float32)
+        for pool in ("max", "sum", "first", "last", "average"):
+            got = seq.sequence_pool(_t(x), pool, _t([0, 2]),
+                                    pad_value=0.0).numpy()
+            assert got[0, 0] == 0.0, pool       # empty row -> pad_value
+            assert np.isfinite(got).all(), pool
+
+    def test_unpad_gradient_flows(self):
+        x = paddle.to_tensor(np.ones((2, 3, 1), np.float32))
+        x.stop_gradient = False
+        out = seq.sequence_unpad(x, _t([2, 1]))
+        out.sum().backward()
+        g = x.grad.numpy()[..., 0]
+        np.testing.assert_array_equal(g, [[1, 1, 0], [1, 0, 0]])
+
+    def test_expand_as(self):
+        x = np.asarray([[1.0], [2.0]], np.float32)
+        got = seq.sequence_expand_as(_t(x), _t([2, 3])).numpy()
+        assert got.shape == (2, 3, 1)
+        np.testing.assert_allclose(got[0, :, 0], [1, 1, 0])
+        np.testing.assert_allclose(got[1, :, 0], [2, 2, 2])
+
+
+def _table_step_fn(table):
+    """Deterministic toy LM: next-token log-probs depend only on the
+    current token (a Markov chain) — ground-truth beam scores are
+    computable by exhaustive search."""
+    logt = jnp.asarray(np.log(table))
+
+    def step_fn(tokens, state):
+        return logt[tokens], state
+
+    return step_fn
+
+
+def _exhaustive_best(table, bos, length):
+    """Brute-force best path score over all sequences of `length`."""
+    V = table.shape[0]
+    best = {}
+    paths = {(bos,): 0.0}
+    for _ in range(length):
+        nxt = {}
+        for path, sc in paths.items():
+            for v in range(V):
+                p = path + (v,)
+                s = sc + np.log(table[path[-1], v])
+                if p not in nxt or nxt[p] < s:
+                    nxt[p] = s
+        paths = nxt
+    return max(paths.values())
+
+
+class TestBeamSearch:
+    def _table(self, seed=0, V=6):
+        rng = np.random.RandomState(seed)
+        t = rng.rand(V, V).astype(np.float64) + 0.05
+        t /= t.sum(axis=1, keepdims=True)
+        return t
+
+    def test_step_topk_math(self):
+        lp = np.log(np.asarray(
+            [[[0.7, 0.2, 0.1], [0.1, 0.1, 0.8]]], np.float32))  # [1,2,3]
+        pre = np.asarray([[0.0, -0.5]], np.float32)
+        fin = np.zeros((1, 2), bool)
+        scores, tok, par = beam_search_step(jnp.asarray(pre),
+                                            jnp.asarray(lp),
+                                            jnp.asarray(fin), 2, end_id=0)
+        # candidates: beam0: log .7/.2/.1; beam1: -0.5+log .1/.1/.8
+        want_best = np.log(0.7)
+        np.testing.assert_allclose(float(scores[0, 0]), want_best,
+                                   rtol=1e-5)
+        assert int(tok[0, 0]) == 0 and int(par[0, 0]) == 0
+        want_second = -0.5 + np.log(0.8)
+        np.testing.assert_allclose(float(scores[0, 1]), want_second,
+                                   rtol=1e-5)
+        assert int(tok[0, 1]) == 2 and int(par[0, 1]) == 1
+
+    def test_finished_beam_frozen(self):
+        lp = np.log(np.full((1, 2, 3), 1 / 3, np.float32))
+        pre = np.asarray([[-0.1, -4.0]], np.float32)
+        fin = np.asarray([[True, False]])
+        scores, tok, par = beam_search_step(jnp.asarray(pre),
+                                            jnp.asarray(lp),
+                                            jnp.asarray(fin), 2, end_id=1)
+        # finished beam 0 continues ONLY via end_id at unchanged score
+        assert int(tok[0, 0]) == 1 and int(par[0, 0]) == 0
+        np.testing.assert_allclose(float(scores[0, 0]), -0.1, rtol=1e-5)
+
+    def test_beam_matches_exhaustive(self):
+        table = self._table(3)
+        T = 4
+        res = beam_search_decode(
+            _table_step_fn(table), init_state=jnp.zeros((1 * 6,)),
+            batch_size=1, beam_size=6, max_len=T, bos_id=0,
+            end_id=99, logits_normalized=True)
+        # beam == vocab -> exact search on a Markov chain
+        want = _exhaustive_best(table, 0, T)
+        np.testing.assert_allclose(float(res.scores[0, 0]), want,
+                                   rtol=1e-4)
+
+    def test_greedy_parity_beam1(self):
+        table = self._table(5)
+        T = 6
+        ids_g, score_g = greedy_search_decode(
+            _table_step_fn(table), jnp.zeros((2,)), batch_size=2,
+            max_len=T, bos_id=1, end_id=99)
+        res = beam_search_decode(
+            _table_step_fn(table), jnp.zeros((2,)), batch_size=2,
+            beam_size=1, max_len=T, bos_id=1, end_id=99,
+            logits_normalized=True)
+        np.testing.assert_array_equal(np.asarray(ids_g),
+                                      np.asarray(res.ids[:, 0, :]))
+        np.testing.assert_allclose(np.asarray(score_g),
+                                   np.asarray(res.scores[:, 0]),
+                                   rtol=1e-5)
+
+    def test_length_penalty_prefers_longer(self):
+        # two-token vocab: token 0 = end, token 1 continues with slightly
+        # worse per-step score; alpha>0 normalization favors the longer
+        # hypothesis at selection time
+        lp = np.log(np.asarray([[[0.6, 0.4]]], np.float32))   # [1,1,2]
+        pre = np.asarray([[-2.0]], np.float32)
+        fin = np.zeros((1, 1), bool)
+        _, tok_plain, _ = beam_search_step(
+            jnp.asarray(pre), jnp.asarray(lp), jnp.asarray(fin), 1,
+            end_id=0)
+        assert int(tok_plain[0, 0]) == 0
+        # selection unchanged for K=1 ties aside; verify scores remain
+        # cumulative under penalty (not divided)
+        sc, tok, _ = beam_search_step(
+            jnp.asarray(pre), jnp.asarray(lp), jnp.asarray(fin), 1,
+            end_id=0, length_penalty=1.0, step=5)
+        np.testing.assert_allclose(float(sc[0, 0]),
+                                   -2.0 + np.log(0.6), rtol=1e-5)
+
+    def test_dynamic_decode_requires_inits(self):
+        dec = BeamSearchDecoder(nn.GRUCell(4, 4), 0, 1, 2)
+        with pytest.raises(ValueError, match="requires inits"):
+            dynamic_decode(dec)
+
+    def test_decode_is_jittable(self):
+        table = self._table(7)
+
+        @jax.jit
+        def run():
+            return beam_search_decode(
+                _table_step_fn(table), jnp.zeros((4,)), batch_size=2,
+                beam_size=2, max_len=3, bos_id=0, end_id=99,
+                logits_normalized=True).ids
+
+        ids = run()
+        assert ids.shape == (2, 2, 3)
+
+    def test_gather_tree(self):
+        # T=2, B=1, K=2; step1 tokens [5,6] parents [0,1];
+        # step2 tokens [7,8] parents [1,0]
+        ids = np.asarray([[[5, 6]], [[7, 8]]], np.int32)
+        par = np.asarray([[[0, 1]], [[1, 0]]], np.int32)
+        full = gather_tree(_t(ids), _t(par)).numpy()
+        # leaf 0 (token 7, parent 1) -> root token 6
+        np.testing.assert_array_equal(full[:, 0, 0], [6, 7])
+        np.testing.assert_array_equal(full[:, 0, 1], [5, 8])
+
+
+class TestDynamicDecodeAPI:
+    def test_cell_based_decoder_runs(self):
+        paddle.seed(0)
+        V, H, B, K = 8, 16, 2, 3
+        cell = nn.GRUCell(H, H)
+        emb = nn.Embedding(V, H)
+        proj = nn.Linear(H, V)
+        dec = BeamSearchDecoder(cell, start_token=1, end_token=2,
+                                beam_size=K, embedding_fn=emb,
+                                output_fn=proj)
+        h0 = paddle.to_tensor(np.zeros((B, H), np.float32))
+        h0_tiled = BeamSearchDecoder.tile_beam_merge_with_batch(h0, K)
+        ids, scores = dynamic_decode(dec, inits=h0_tiled, max_step_num=5)
+        assert ids.numpy().shape == (B, K, 5)
+        s = scores.numpy()
+        assert np.isfinite(s[:, 0]).all()
+        # best-first ordering
+        assert (np.diff(s, axis=1) <= 1e-5).all()
