@@ -1,0 +1,72 @@
+/* C API for the inference Predictor.
+ *
+ * Reference analog: paddle/fluid/inference/capi/paddle_c_api.h
+ * (PD_NewPredictor, PD_PredictorRun, PD_ZeroCopy tensors) — the ABI the
+ * reference's Go/R clients bind (go/paddle/predictor.go:27).
+ *
+ * TPU-native deployment note: the predictor itself is the XLA-compiled
+ * Python Predictor; this shim embeds the interpreter (one per process)
+ * and marshals tensors through the stable C ABI below.  Load with dlopen/
+ * ctypes/cgo; every entry point is thread-safe (GIL acquired inside).
+ */
+#ifndef PTPU_PADDLE_C_API_H
+#define PTPU_PADDLE_C_API_H
+
+#include <stdbool.h>
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum PD_DataType {
+  PD_FLOAT32 = 0,
+  PD_INT32 = 1,
+  PD_INT64 = 2,
+  PD_UINT8 = 3,
+} PD_DataType;
+
+/* Opaque predictor handle (reference PD_Predictor). */
+typedef struct PD_Predictor PD_Predictor;
+
+/* Borrowed-view tensor for inputs; owned-copy tensor for outputs
+ * (reference PD_ZeroCopyData shape). */
+typedef struct PD_Tensor {
+  PD_DataType dtype;
+  int ndim;
+  const int64_t* shape;   /* [ndim] */
+  const void* data;       /* row-major contiguous */
+} PD_Tensor;
+
+/* Process-wide init. Optional: PD_NewPredictor calls it lazily.
+ * `platform` may be NULL (default) or e.g. "cpu" to force the XLA
+ * platform before jax initializes. Returns 0 on success. */
+int PD_Init(const char* platform);
+
+/* Create a predictor from a saved model prefix (paddle_tpu.jit.save /
+ * onnx.export artifact: <prefix>.pdmodel + <prefix>.pdiparams).
+ * NULL on failure — read PD_GetLastError(). */
+PD_Predictor* PD_NewPredictor(const char* model_prefix);
+void PD_DeletePredictor(PD_Predictor* pred);
+
+int PD_GetInputNum(PD_Predictor* pred);
+int PD_GetOutputNum(PD_Predictor* pred);
+/* Returned string is owned by the predictor; valid until deletion. */
+const char* PD_GetInputName(PD_Predictor* pred, int index);
+const char* PD_GetOutputName(PD_Predictor* pred, int index);
+
+/* Run: n_inputs borrowed tensors in declared order -> outputs.
+ * Returns 0 on success. Output tensors are owned by the predictor and
+ * valid until the next PD_PredictorRun or deletion. */
+int PD_PredictorRun(PD_Predictor* pred, const PD_Tensor* inputs,
+                    int n_inputs);
+int PD_GetOutputTensor(PD_Predictor* pred, int index, PD_Tensor* out);
+
+/* Last error message for this thread's most recent failing call. */
+const char* PD_GetLastError(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PTPU_PADDLE_C_API_H */

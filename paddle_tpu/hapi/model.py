@@ -37,6 +37,117 @@ def _batch_size_of(x):
         return 1
 
 
+class StaticGraphAdapter:
+    """Static-graph execution path (reference hapi/model.py:224
+    StaticGraphAdapter): records train/eval/predict Programs from the
+    network + loss + optimizer.minimize and drives them through the
+    static Executor — Model.fit/evaluate/predict run on the SAME loops,
+    only the per-batch engine differs.
+
+    Selected when ``paddle.enable_static()`` is active at prepare() time;
+    requires Model(inputs=[InputSpec...], labels=[InputSpec...]) like the
+    reference."""
+
+    def __init__(self, model: "Model"):
+        from .. import static as _static
+
+        self.model = model
+        if not model._inputs:
+            raise ValueError(
+                "static mode requires Model(network, inputs=[InputSpec], "
+                "labels=[InputSpec]) so the feed layout is known at "
+                "program-build time (reference hapi/model.py:224)")
+        self._static = _static
+        self._exe = _static.Executor()
+        self._progs = {}
+        self._fetches = {}
+
+    def _spec_shape(self, spec):
+        return [d if d is not None else -1 for d in spec.shape]
+
+    def _build(self, mode):
+        """Record the program for `mode` once (reference _make_program)."""
+        if mode in self._progs:
+            return
+        _st = self._static
+        model = self.model
+        prog = _st.Program()
+        with _st.program_guard(prog):
+            ins = [_st.data(s.name or f"input_{i}",
+                            self._spec_shape(s), str(s.dtype))
+                   for i, s in enumerate(_to_list(model._inputs))]
+            outs = model.network(*ins)
+            outs_l = _to_list(outs)
+            fetches = list(outs_l)
+            if mode != "predict" and model._loss is not None:
+                labels = [_st.data(s.name or f"label_{i}",
+                                   self._spec_shape(s), str(s.dtype))
+                          for i, s in enumerate(_to_list(model._labels))]
+                loss = model._loss(*outs_l, *labels)
+                fetches = [loss] + fetches
+                if mode == "train":
+                    model._optimizer.minimize(loss)
+        self._progs[mode] = prog
+        self._fetches[mode] = fetches
+
+    def _feed_dict(self, inputs, labels, mode):
+        model = self.model
+        feed = {}
+        for i, (spec, v) in enumerate(zip(_to_list(model._inputs),
+                                          inputs)):
+            feed[spec.name or f"input_{i}"] = np.asarray(
+                v.numpy() if isinstance(v, Tensor) else v)
+        if mode != "predict":
+            for i, (spec, v) in enumerate(zip(_to_list(model._labels),
+                                              labels)):
+                feed[spec.name or f"label_{i}"] = np.asarray(
+                    v.numpy() if isinstance(v, Tensor) else v)
+        return feed
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.model.network.train()
+        self._build("train")
+        res = self._exe.run(self._progs["train"],
+                            feed=self._feed_dict(inputs, labels, "train"),
+                            fetch_list=self._fetches["train"])
+        loss, outs = res[0], res[1:]
+        yv = labels[0]
+        yv = yv.numpy() if isinstance(yv, Tensor) else np.asarray(yv)
+        metrics_out = self.model._update_metrics(
+            jnp.asarray(outs[0]), jnp.asarray(yv))
+        return [float(np.asarray(loss))] + metrics_out
+
+    def eval_batch(self, inputs, labels=None):
+        self.model.network.eval()
+        labeled = bool(labels) and labels[0] is not None
+        mode = "eval" if (labeled and self.model._loss is not None) \
+            else "predict"
+        self._build(mode)
+        res = self._exe.run(self._progs[mode],
+                            feed=self._feed_dict(inputs, labels, mode),
+                            fetch_list=self._fetches[mode])
+        out = []
+        if labeled:
+            yv = labels[0]
+            yv = yv.numpy() if isinstance(yv, Tensor) else np.asarray(yv)
+            net_out = res[1] if mode == "eval" else res[0]
+            if mode == "eval":
+                out.append(float(np.asarray(res[0])))
+            # metrics update for ANY labeled batch, loss or not — same
+            # contract as the dynamic path
+            out += self.model._update_metrics(jnp.asarray(net_out),
+                                              jnp.asarray(yv))
+        return out
+
+    def predict_batch(self, inputs):
+        self.model.network.eval()
+        self._build("predict")
+        res = self._exe.run(self._progs["predict"],
+                            feed=self._feed_dict(inputs, None, "predict"),
+                            fetch_list=self._fetches["predict"])
+        return [np.asarray(res[0])]
+
+
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
@@ -49,6 +160,7 @@ class Model:
         self._train_step = None
         self._eval_fn = None
         self._state = None
+        self._adapter = None       # StaticGraphAdapter when static mode
         self.stop_training = False
 
     # --- prepare -----------------------------------------------------------
@@ -60,6 +172,10 @@ class Model:
         self._accelerate = accelerate
         self._train_step = None
         self._eval_fn = None
+        from .. import in_dynamic_mode
+
+        self._adapter = None if in_dynamic_mode() else \
+            StaticGraphAdapter(self)
         return self
 
     # --- state sync: functional state <-> layer tensors ---------------------
@@ -123,6 +239,8 @@ class Model:
     def train_batch(self, inputs, labels=None, update=True):
         inputs = _to_list(inputs)
         labels = _to_list(labels)
+        if self._adapter is not None:
+            return self._adapter.train_batch(inputs, labels, update)
         x = inputs[0]
         y = labels[0] if labels else None
         xv = x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
@@ -161,6 +279,8 @@ class Model:
     def eval_batch(self, inputs, labels=None):
         inputs = _to_list(inputs)
         labels = _to_list(labels)
+        if self._adapter is not None:
+            return self._adapter.eval_batch(inputs, labels)
         x = inputs[0]
         y = labels[0] if labels else None
         xv = x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
@@ -184,6 +304,8 @@ class Model:
 
     def predict_batch(self, inputs):
         inputs = _to_list(inputs)
+        if self._adapter is not None:
+            return self._adapter.predict_batch(inputs)
         x = inputs[0]
         xv = x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
         if self._accelerate:

@@ -16,50 +16,117 @@ def _unwrap(x):
     return x._value if isinstance(x, Tensor) else x
 
 
+def _unwrap_tree(tree):
+    # Tensor is a registered pytree node: without is_leaf, tree_map
+    # descends into it and re-wraps, returning Tensors unchanged
+    return jax.tree_util.tree_map(
+        _unwrap, tree, is_leaf=lambda x: isinstance(x, Tensor))
+
+
 def _wrap_tree(tree):
     return jax.tree_util.tree_map(
         lambda v: Tensor(v) if isinstance(v, jax.Array) else v, tree
     )
 
 
+def _recording():
+    from ..ops.dispatch import _recording_program
+
+    return _recording_program() is not None
+
+
 def traced_cond(pred, true_fn, false_fn, *operands):
-    """lax.cond with Tensor-transparent operands."""
-    ops = jax.tree_util.tree_map(_unwrap, operands)
+    """lax.cond with Tensor-transparent EXPLICIT operands — the form that
+    is also recordable into a static Program: pred + operands are the
+    op's inputs, so replay re-evaluates both branches' data dependencies.
+    Branch closures must not capture other tensors (those would bake
+    their build-time values — same rule as the reference's
+    conditional_block input list)."""
+    from ..ops._helpers import to_tensor_like
+    from ..ops.dispatch import apply
+
+    flat_ops, treedef = jax.tree_util.tree_flatten(
+        operands, is_leaf=lambda x: isinstance(x, Tensor))
+
+    def f(pred_v, *op_vals):
+        from ..static.program import suspend_recording
+
+        o = jax.tree_util.tree_unflatten(treedef, op_vals)
+        with suspend_recording():
+            # the cond op records as ONE unit; branch bodies must not
+            # append their own records (tracer outputs would escape)
+            return jax.lax.cond(
+                jnp.reshape(pred_v, ()),
+                lambda oo: _unwrap_tree(true_fn(*_wrap_tree(oo))),
+                lambda oo: _unwrap_tree(false_fn(*_wrap_tree(oo))),
+                o,
+            )
+
+    out = apply("cond", f, to_tensor_like(pred),
+                *[to_tensor_like(x) for x in flat_ops])
+    return out
+
+
+def cond(pred, true_fn, false_fn, name=None):
+    """paddle.static.nn.cond parity (reference controlflow/
+    conditional_block_op.cc; python layers/control_flow.py cond): no-arg
+    closures, lowered to lax.cond.  This is the documented replacement
+    for Python `if` on tensor values inside to_static TRACING.  During
+    static Program RECORDING the closure-captured tensors cannot become
+    program inputs, so this form raises — use traced_cond with explicit
+    operands there."""
+    if _recording():
+        raise TypeError(
+            "control_flow.cond(no-arg closures) is not recordable into a "
+            "static Program: closure-captured tensors would bake their "
+            "build-time values. Use control_flow.traced_cond(pred, "
+            "true_fn, false_fn, *operands) with every tensor dependency "
+            "passed as an operand.")
     out = jax.lax.cond(
-        _unwrap(pred),
-        lambda o: jax.tree_util.tree_map(_unwrap, true_fn(*_wrap_tree(o))),
-        lambda o: jax.tree_util.tree_map(_unwrap, false_fn(*_wrap_tree(o))),
-        ops,
+        _unwrap(pred).reshape(()),
+        lambda _: _unwrap_tree(true_fn()),
+        lambda _: _unwrap_tree(false_fn()),
+        0,
     )
     return _wrap_tree(out)
 
 
 def while_loop(cond_fn, body_fn, loop_vars):
-    """paddle.static.nn.while_loop parity → lax.while_loop."""
-    init = jax.tree_util.tree_map(_unwrap, tuple(loop_vars))
+    """paddle.static.nn.while_loop parity → lax.while_loop.  loop_vars
+    are explicit (recordable); cond_fn/body_fn must not capture other
+    tensors (reference while_op input list rule)."""
+    from ..ops._helpers import to_tensor_like
+    from ..ops.dispatch import apply
 
-    def cond(c):
-        r = cond_fn(*_wrap_tree(c))
-        return _unwrap(r).reshape(())
+    def f(*init_vals):
+        from ..static.program import suspend_recording
 
-    def body(c):
-        r = body_fn(*_wrap_tree(c))
-        if not isinstance(r, tuple):
-            r = (r,)
-        return jax.tree_util.tree_map(_unwrap, r)
+        def cond_(c):
+            r = cond_fn(*_wrap_tree(c))
+            return _unwrap(r).reshape(())
 
-    out = jax.lax.while_loop(cond, body, init)
-    return list(_wrap_tree(out))
+        def body(c):
+            r = body_fn(*_wrap_tree(c))
+            if not isinstance(r, tuple):
+                r = (r,)
+            return _unwrap_tree(r)
+
+        with suspend_recording():
+            return jax.lax.while_loop(cond_, body, init_vals)
+
+    out = apply("while_loop", f,
+                *[to_tensor_like(v) for v in loop_vars])
+    return list(out) if isinstance(out, (tuple, list)) else [out]
 
 
 def scan(f, init, xs, length=None, reverse=False, unroll=1):
     """lax.scan with Tensor-transparent carry/xs."""
-    init_u = jax.tree_util.tree_map(_unwrap, init)
-    xs_u = jax.tree_util.tree_map(_unwrap, xs)
+    init_u = _unwrap_tree(init)
+    xs_u = _unwrap_tree(xs)
 
     def step(carry, x):
         c, y = f(_wrap_tree(carry), _wrap_tree(x))
-        return jax.tree_util.tree_map(_unwrap, c), jax.tree_util.tree_map(_unwrap, y)
+        return _unwrap_tree(c), _unwrap_tree(y)
 
     carry, ys = jax.lax.scan(step, init_u, xs_u, length=length, reverse=reverse,
                              unroll=unroll)

@@ -208,7 +208,8 @@ class BeamSearchDecoder:
 
 
 def dynamic_decode(decoder: BeamSearchDecoder, inits=None, max_step_num=32,
-                   batch_size=None, **kwargs):
+                   batch_size=None, length_penalty: float = 0.0,
+                   logits_normalized: bool = False):
     """reference rnn.py dynamic_decode: run the decoder to max_step_num.
     Returns (ids Tensor [B, K, T], scores Tensor [B, K])."""
     if inits is None:
@@ -225,15 +226,7 @@ def dynamic_decode(decoder: BeamSearchDecoder, inits=None, max_step_num=32,
 
     step_fn_raw = decoder._step_fn()
 
-    def _unwrap(x):
-        return x._value if isinstance(x, Tensor) else x
-
-    def _unwrap_tree(tree):
-        # Tensor is itself a pytree node — without is_leaf, tree_map
-        # descends into it and re-wraps, keeping the Tensor (and its
-        # stop_gradient metadata) in the scan carry
-        return jax.tree_util.tree_map(
-            _unwrap, tree, is_leaf=lambda x: isinstance(x, Tensor))
+    from ..jit.control_flow import _unwrap, _unwrap_tree
 
     def step_fn(tokens, state):
         out, state = step_fn_raw(Tensor(tokens), state)
@@ -246,5 +239,7 @@ def dynamic_decode(decoder: BeamSearchDecoder, inits=None, max_step_num=32,
         inits, is_leaf=lambda x: isinstance(x, Tensor))
     res = beam_search_decode(
         step_fn, state, batch_size, decoder.beam_size, max_step_num,
-        decoder.start_token, decoder.end_token)
+        decoder.start_token, decoder.end_token,
+        logits_normalized=logits_normalized,
+        length_penalty=length_penalty)
     return Tensor(res.ids), Tensor(res.scores)

@@ -14,7 +14,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from ._helpers import to_tensor_like
-from .dispatch import apply
+from .dispatch import apply, _recording_program
+
+
+def _host_lengths(lens_t, op, hint):
+    """Read lengths on the host — loud during static recording, where the
+    zero-filled placeholder would silently bake empty/zero-width shapes
+    into the program (review r4)."""
+    if _recording_program() is not None:
+        raise TypeError(
+            f"{op}: {hint} is computed from the lengths' VALUES on the "
+            "host; while a static Program is recording that would bake "
+            "the build-time placeholder (zeros). Pass a static value / "
+            "use the padded form outside program capture.")
+    return np.asarray(lens_t._value)
 
 __all__ = [
     "sequence_mask", "sequence_pad", "sequence_unpad", "sequence_pool",
@@ -28,7 +41,7 @@ def sequence_mask(x, maxlen=None, dtype="bool", name=None):
     """lengths [.., B] -> [.., B, maxlen] mask (sequence_mask_op.cc)."""
     t = to_tensor_like(x)
     if maxlen is None:
-        maxlen = int(np.asarray(t._value).max())
+        maxlen = int(_host_lengths(t, "sequence_mask", "maxlen=None").max())
     _DTYPES = {"bool": jnp.bool_, "int32": jnp.int32, "int64": jnp.int64,
                "float16": jnp.float16, "bfloat16": jnp.bfloat16,
                "float32": jnp.float32,
@@ -58,7 +71,8 @@ def sequence_pad(x, pad_value, lengths, maxlen=None, name=None):
     lens = to_tensor_like(lengths)
     pv = to_tensor_like(pad_value)
     if maxlen is None:
-        maxlen = int(np.asarray(lens._value).max())
+        maxlen = int(_host_lengths(lens, "sequence_pad",
+                                   "maxlen=None").max())
 
     def f(vals, ln, pad):
         B = ln.shape[0]
@@ -82,7 +96,8 @@ def sequence_unpad(x, length, name=None):
     input (the reference op has a grad kernel)."""
     t = to_tensor_like(x)
     lens = to_tensor_like(length)
-    ln = np.asarray(lens._value).astype(np.int64)
+    ln = _host_lengths(lens, "sequence_unpad",
+                       "the output size").astype(np.int64)
     rows = np.repeat(np.arange(len(ln)), ln)
     cols = np.concatenate([np.arange(n) for n in ln]) if len(ln) else \
         np.zeros((0,), np.int64)
@@ -197,7 +212,7 @@ def sequence_expand_as(x, y_lengths, name=None):
     t = to_tensor_like(x)
     lens = to_tensor_like(y_lengths)
     # static maxlen from the lengths' current values
-    L = int(np.asarray(lens._value).max())
+    L = int(_host_lengths(lens, "sequence_expand_as", "maxlen").max())
 
     def g(v, ln):
         B = v.shape[0]

@@ -364,8 +364,25 @@ _default_startup = Program()
 _RECORDING: List[Program] = []
 
 
+_RECORDING_SUSPENDED = [0]
+
+
 def _active_recorder() -> Optional[Program]:
+    if _RECORDING_SUSPENDED[0]:
+        return None
     return _RECORDING[-1] if _RECORDING else None
+
+
+@contextlib.contextmanager
+def suspend_recording():
+    """Pause op recording (control-flow ops record themselves as ONE op;
+    their branch bodies trace through lax.cond/while_loop and must not
+    also append per-op records with tracer outputs)."""
+    _RECORDING_SUSPENDED[0] += 1
+    try:
+        yield
+    finally:
+        _RECORDING_SUSPENDED[0] -= 1
 
 
 def default_main_program() -> Program:

@@ -271,16 +271,55 @@ class Tensor:
             yield self[i]
 
     # --- scalar conversions ------------------------------------------------
+    def _check_scalar_coercion(self, what):
+        """Loud dy2static (reference program_translator.py:233 — the AST
+        pass rewrites `if`/`while` on Variables into conditional_block/
+        while ops; here the trace either lowers through
+        paddle_tpu.jit.control_flow or must FAIL, never silently
+        specialize).
+
+        Two capture modes are guarded: jax tracing (to_static/jit — the
+        value is a Tracer) and eager static-Program recording (the value
+        is concrete, so Python would happily branch on it and bake ONE
+        path into the program)."""
+        import jax as _jax
+
+        if isinstance(self._value, _jax.core.Tracer):
+            raise TypeError(
+                f"cannot convert a traced Tensor to a Python {what} inside "
+                "to_static/jit capture: data-dependent Python control flow "
+                "would specialize to one branch. Use "
+                "paddle_tpu.jit.control_flow.cond / while_loop (lowered to "
+                "lax.cond / lax.while_loop), or move the condition to a "
+                "non-tensor value.")
+        from .ops.dispatch import _recording_program
+
+        if _recording_program() is not None:
+            raise TypeError(
+                f"cannot convert a Tensor to a Python {what} while a "
+                "static Program is recording: the build-time placeholder "
+                "value would be baked into the program as a constant "
+                "(`if`/`while` would record a single branch; scalar "
+                "coercion a stale number — reference dy2static rewrites "
+                "these into conditional_block/while ops). Use "
+                "paddle_tpu.jit.control_flow.traced_cond / while_loop "
+                "with explicit operands, or compute the value outside "
+                "program capture.")
+
     def __float__(self):
+        self._check_scalar_coercion("float")
         return float(self.numpy())
 
     def __int__(self):
+        self._check_scalar_coercion("int")
         return int(self.numpy())
 
     def __bool__(self):
+        self._check_scalar_coercion("bool")
         return bool(self.numpy())
 
     def __index__(self):
+        self._check_scalar_coercion("index")
         return int(self.numpy())
 
     # --- repr --------------------------------------------------------------

@@ -1,0 +1,129 @@
+"""hapi StaticGraphAdapter (VERDICT r3 missing #6 / next-round #9):
+Model.fit/evaluate/predict through the recorded static Program +
+Executor, matching the dygraph path on LeNet.
+
+Reference: python/paddle/hapi/model.py:224 StaticGraphAdapter (program
+build per mode, Executor.run per batch) vs :609 DynamicGraphAdapter."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import hapi, nn, optimizer
+from paddle_tpu.jit import InputSpec
+from paddle_tpu.io import DataLoader
+from paddle_tpu.io.dataset import Dataset
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.models import LeNet
+
+
+class _ToyDS(Dataset):
+    def __init__(self, n=64, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, 1, 28, 28).astype(np.float32)
+        w = rng.randn(28 * 28).astype(np.float32)
+        score = self.x.reshape(n, -1) @ w
+        self.y = (np.stack([score > 0, score <= 0], 1)
+                  .argmax(1).astype(np.int64)[:, None])
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def _specs():
+    return ([InputSpec([None, 1, 28, 28], "float32", name="img")],
+            [InputSpec([None, 1], "int64", name="lbl")])
+
+
+class TestStaticAdapter:
+    def test_fit_trains_lenet(self, static_mode):
+        paddle.seed(0)
+        inputs, labels = _specs()
+        net = LeNet()
+        model = hapi.Model(net, inputs, labels)
+        opt = optimizer.Adam(1e-3, parameters=net.parameters())
+        model.prepare(opt, loss=F.cross_entropy, metrics=Accuracy())
+        assert model._adapter is not None       # static path selected
+
+        ds = _ToyDS(64)
+        first = model.train_batch([ds.x[:16]], [ds.y[:16]])[0]
+        model.fit(ds, batch_size=16, epochs=3, verbose=0)
+        last = model.train_batch([ds.x[:16]], [ds.y[:16]])[0]
+        assert last < first * 0.5, (first, last)
+
+    def test_evaluate_and_predict(self, static_mode):
+        paddle.seed(1)
+        inputs, labels = _specs()
+        net = LeNet()
+        model = hapi.Model(net, inputs, labels)
+        opt = optimizer.SGD(0.01, parameters=net.parameters())
+        model.prepare(opt, loss=F.cross_entropy, metrics=Accuracy())
+        ds = _ToyDS(32, seed=2)
+        logs = model.evaluate(ds, batch_size=16, verbose=0)
+        assert "loss" in logs and "acc" in logs
+        preds = model.predict(ds, batch_size=16)
+        got = np.concatenate(preds[0], axis=0)
+        assert got.shape == (32, 10)
+
+    def test_matches_dygraph_results(self):
+        """Same seed, same data: static fit reaches the same loss
+        neighborhood as dygraph fit (the adapter done-criterion)."""
+        def run(static):
+            if static:
+                paddle.enable_static()
+            try:
+                paddle.seed(7)
+                inputs, labels = _specs()
+                net = LeNet()
+                model = hapi.Model(net, inputs, labels)
+                opt = optimizer.Adam(1e-3, parameters=net.parameters())
+                model.prepare(opt, loss=F.cross_entropy)
+                ds = _ToyDS(64, seed=3)
+                model.fit(ds, batch_size=16, epochs=2, shuffle=False,
+                          verbose=0)
+                return model.evaluate(ds, batch_size=16,
+                                      verbose=0)["loss"]
+            finally:
+                if static:
+                    paddle.disable_static()
+
+        loss_dy = run(static=False)
+        loss_st = run(static=True)
+        assert abs(loss_dy - loss_st) < max(0.15, 0.5 * loss_dy), \
+            (loss_dy, loss_st)
+
+    def test_requires_input_spec(self, static_mode):
+        model = hapi.Model(LeNet())
+        with pytest.raises(ValueError, match="InputSpec"):
+            model.prepare(optimizer.SGD(0.1), loss=F.cross_entropy)
+
+    def test_save_load_static(self, static_mode, tmp_path):
+        paddle.seed(2)
+        inputs, labels = _specs()
+        net = LeNet()
+        model = hapi.Model(net, inputs, labels)
+        opt = optimizer.SGD(0.05, parameters=net.parameters())
+        model.prepare(opt, loss=F.cross_entropy)
+        ds = _ToyDS(32, seed=4)
+        model.fit(ds, batch_size=16, epochs=1, verbose=0)
+        want = model.predict_batch([ds.x[:4]])[0]
+        model.save(str(tmp_path / "ckpt"))
+
+        paddle.seed(99)
+        net2 = LeNet()
+        m2 = hapi.Model(net2, inputs, labels)
+        m2.prepare(optimizer.SGD(0.05, parameters=net2.parameters()),
+                   loss=F.cross_entropy)
+        m2.load(str(tmp_path / "ckpt"))
+        got = m2.predict_batch([ds.x[:4]])[0]
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
