@@ -1,0 +1,69 @@
+/* C smoke client for the Predictor C API — the e2e proof the reference
+ * gets from inference/capi tests.  Usage:
+ *   capi_demo <model_prefix> <input_bin> <n> <c> <h> <w>
+ * Reads n*c*h*w float32s from input_bin, runs the predictor, prints each
+ * output as "name shape: v0 v1 ..." for the test harness to diff against
+ * the Python Predictor. */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "paddle_c_api.h"
+
+int main(int argc, char** argv) {
+  if (argc != 7) {
+    fprintf(stderr, "usage: %s prefix input.bin n c h w\n", argv[0]);
+    return 2;
+  }
+  const char* prefix = argv[1];
+  int64_t shape[4];
+  size_t count = 1;
+  for (int i = 0; i < 4; ++i) {
+    shape[i] = atoll(argv[3 + i]);
+    count *= (size_t)shape[i];
+  }
+  float* buf = (float*)malloc(count * sizeof(float));
+  FILE* f = fopen(argv[2], "rb");
+  if (!f || fread(buf, sizeof(float), count, f) != count) {
+    fprintf(stderr, "bad input file\n");
+    return 2;
+  }
+  fclose(f);
+
+  if (PD_Init("cpu") != 0) {
+    fprintf(stderr, "init failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  PD_Predictor* pred = PD_NewPredictor(prefix);
+  if (!pred) {
+    fprintf(stderr, "new predictor failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  printf("inputs=%d outputs=%d first_input=%s\n", PD_GetInputNum(pred),
+         PD_GetOutputNum(pred), PD_GetInputName(pred, 0));
+
+  PD_Tensor in = {PD_FLOAT32, 4, shape, buf};
+  if (PD_PredictorRun(pred, &in, 1) != 0) {
+    fprintf(stderr, "run failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  for (int i = 0; i < PD_GetOutputNum(pred); ++i) {
+    PD_Tensor out;
+    if (PD_GetOutputTensor(pred, i, &out) != 0) {
+      fprintf(stderr, "get output failed: %s\n", PD_GetLastError());
+      return 1;
+    }
+    size_t n = 1;
+    printf("out%d shape", i);
+    for (int d = 0; d < out.ndim; ++d) {
+      n *= (size_t)out.shape[d];
+      printf(" %lld", (long long)out.shape[d]);
+    }
+    printf(":");
+    const float* vals = (const float*)out.data;
+    for (size_t j = 0; j < n; ++j) printf(" %.6f", vals[j]);
+    printf("\n");
+  }
+  PD_DeletePredictor(pred);
+  free(buf);
+  return 0;
+}

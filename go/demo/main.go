@@ -1,0 +1,47 @@
+// Minimal Go serving example (reference go/demo/mobilenet.go shape):
+// load a saved LeNet artifact and classify one batch.
+//
+//	PYTHONPATH=/root/repo PD_CAPI_PLATFORM=cpu \
+//	LD_LIBRARY_PATH=/root/repo/csrc go run ./go/demo lenet_prefix
+package main
+
+import (
+	"fmt"
+	"math/rand"
+	"os"
+
+	"paddle_tpu/go/paddle"
+)
+
+func main() {
+	if len(os.Args) != 2 {
+		fmt.Fprintln(os.Stderr, "usage: demo <model_prefix>")
+		os.Exit(2)
+	}
+	pred, err := paddle.NewPredictor(os.Args[1])
+	if err != nil {
+		panic(err)
+	}
+	fmt.Printf("inputs=%d (%s) outputs=%d\n", pred.GetInputNum(),
+		pred.GetInputName(0), pred.GetOutputNum())
+
+	data := make([]float32, 1*1*28*28)
+	for i := range data {
+		data[i] = rand.Float32()
+	}
+	outs, err := pred.Run([]paddle.Tensor{{
+		Dtype:     paddle.Float32,
+		Shape:     []int64{1, 1, 28, 28},
+		FloatData: data,
+	}})
+	if err != nil {
+		panic(err)
+	}
+	best, bestV := 0, outs[0].FloatData[0]
+	for i, v := range outs[0].FloatData {
+		if v > bestV {
+			best, bestV = i, v
+		}
+	}
+	fmt.Printf("logits shape %v argmax=%d\n", outs[0].Shape, best)
+}

@@ -1,0 +1,156 @@
+// Go client for the paddle_tpu inference C API.
+//
+// Reference analog: go/paddle/predictor.go (cgo over
+// inference/capi/paddle_c_api.h) — the same contract, bound to
+// csrc/libptpu_capi.so: NewPredictor(prefix), GetInput/OutputNum/Name,
+// Run([]Tensor) -> []Tensor.
+//
+// Build (cgo):
+//   CGO_CFLAGS="-I${REPO}/csrc" \
+//   CGO_LDFLAGS="-L${REPO}/csrc -lptpu_capi" go build ./...
+// Run with LD_LIBRARY_PATH=${REPO}/csrc and PYTHONPATH=${REPO} (the
+// library embeds CPython; PD_CAPI_PLATFORM=cpu forces the XLA platform).
+package paddle
+
+// #cgo CFLAGS: -I${SRCDIR}/../../csrc
+// #cgo LDFLAGS: -L${SRCDIR}/../../csrc -lptpu_capi
+// #include <stdlib.h>
+// #include "paddle_c_api.h"
+import "C"
+
+import (
+	"fmt"
+	"runtime"
+	"unsafe"
+)
+
+// DataType mirrors PD_DataType.
+type DataType int
+
+const (
+	Float32 DataType = iota
+	Int32
+	Int64
+	Uint8
+)
+
+// Tensor is a host tensor crossing the C boundary (the reference's
+// ZeroCopyTensor analog: shape + contiguous data).
+type Tensor struct {
+	Dtype DataType
+	Shape []int64
+	// Float32 data for Float32 tensors; raw bytes otherwise.
+	FloatData []float32
+	RawData   []byte
+}
+
+// Predictor wraps PD_Predictor (reference predictor.go:27).
+type Predictor struct {
+	c *C.PD_Predictor
+}
+
+// Init forces the embedded runtime up with the given XLA platform
+// ("cpu", "" for default). Optional — NewPredictor calls it lazily.
+func Init(platform string) error {
+	cs := C.CString(platform)
+	defer C.free(unsafe.Pointer(cs))
+	if C.PD_Init(cs) != 0 {
+		return fmt.Errorf("paddle: init failed: %s", lastError())
+	}
+	return nil
+}
+
+func NewPredictor(modelPrefix string) (*Predictor, error) {
+	cs := C.CString(modelPrefix)
+	defer C.free(unsafe.Pointer(cs))
+	cp := C.PD_NewPredictor(cs)
+	if cp == nil {
+		return nil, fmt.Errorf("paddle: %s", lastError())
+	}
+	p := &Predictor{c: cp}
+	runtime.SetFinalizer(p, (*Predictor).finalize)
+	return p, nil
+}
+
+func (p *Predictor) finalize() { C.PD_DeletePredictor(p.c) }
+
+func (p *Predictor) GetInputNum() int  { return int(C.PD_GetInputNum(p.c)) }
+func (p *Predictor) GetOutputNum() int { return int(C.PD_GetOutputNum(p.c)) }
+
+func (p *Predictor) GetInputName(i int) string {
+	return C.GoString(C.PD_GetInputName(p.c, C.int(i)))
+}
+
+func (p *Predictor) GetOutputName(i int) string {
+	return C.GoString(C.PD_GetOutputName(p.c, C.int(i)))
+}
+
+// Run feeds the inputs in declared order and returns all outputs
+// (reference ZeroCopyRun + get output tensors).
+func (p *Predictor) Run(inputs []Tensor) ([]Tensor, error) {
+	cIn := make([]C.PD_Tensor, len(inputs))
+	keep := make([]unsafe.Pointer, 0, len(inputs)) // pin Go buffers
+	for i, t := range inputs {
+		shape := make([]C.int64_t, len(t.Shape))
+		for d, s := range t.Shape {
+			shape[d] = C.int64_t(s)
+		}
+		var data unsafe.Pointer
+		switch t.Dtype {
+		case Float32:
+			if len(t.FloatData) == 0 {
+				return nil, fmt.Errorf("paddle: input %d has no data", i)
+			}
+			data = unsafe.Pointer(&t.FloatData[0])
+		default:
+			if len(t.RawData) == 0 {
+				return nil, fmt.Errorf("paddle: input %d has no data", i)
+			}
+			data = unsafe.Pointer(&t.RawData[0])
+		}
+		keep = append(keep, data)
+		cIn[i] = C.PD_Tensor{
+			dtype: C.PD_DataType(t.Dtype),
+			ndim:  C.int(len(t.Shape)),
+			shape: &shape[0],
+			data:  data,
+		}
+	}
+	var first *C.PD_Tensor
+	if len(cIn) > 0 {
+		first = &cIn[0]
+	}
+	if C.PD_PredictorRun(p.c, first, C.int(len(cIn))) != 0 {
+		return nil, fmt.Errorf("paddle: run failed: %s", lastError())
+	}
+	runtime.KeepAlive(keep)
+
+	nOut := p.GetOutputNum()
+	outs := make([]Tensor, nOut)
+	for i := 0; i < nOut; i++ {
+		var ct C.PD_Tensor
+		if C.PD_GetOutputTensor(p.c, C.int(i), &ct) != 0 {
+			return nil, fmt.Errorf("paddle: get output %d: %s", i, lastError())
+		}
+		shape := make([]int64, int(ct.ndim))
+		count := 1
+		cshape := unsafe.Slice(ct.shape, int(ct.ndim))
+		for d := range shape {
+			shape[d] = int64(cshape[d])
+			count *= int(shape[d])
+		}
+		out := Tensor{Dtype: DataType(ct.dtype), Shape: shape}
+		if out.Dtype == Float32 {
+			src := unsafe.Slice((*float32)(ct.data), count)
+			out.FloatData = append([]float32(nil), src...)
+		} else {
+			itemsize := map[DataType]int{Int32: 4, Int64: 8, Uint8: 1}[out.Dtype]
+			src := unsafe.Slice((*byte)(ct.data), count*itemsize)
+			out.RawData = append([]byte(nil), src...)
+		}
+		outs[i] = out
+	}
+	return outs, nil
+}
+
+func lastError() string { return C.GoString(C.PD_GetLastError()) }

@@ -105,6 +105,12 @@ class StaticGraphAdapter:
         return feed
 
     def train_batch(self, inputs, labels=None, update=True):
+        if not update:
+            raise ValueError(
+                "update=False (gradient accumulation) is not supported in "
+                "static mode — the train program records the optimizer "
+                "update; use gradient_merge in the strategy, or dygraph "
+                "mode")
         self.model.network.train()
         self._build("train")
         res = self._exe.run(self._progs["train"],

@@ -52,19 +52,29 @@ def traced_cond(pred, true_fn, false_fn, *operands):
         from ..static.program import suspend_recording
 
         o = jax.tree_util.tree_unflatten(treedef, op_vals)
+
+        def branch(fn):
+            def run(oo):
+                res = _unwrap_tree(fn(*_wrap_tree(oo)))
+                # flatten: dispatch.apply handles flat tuples only; the
+                # caller unflattens via f._out_def (dict/nested outputs)
+                leaves, out_def = jax.tree_util.tree_flatten(res)
+                f._out_def = out_def
+                return tuple(leaves)
+
+            return run
+
         with suspend_recording():
             # the cond op records as ONE unit; branch bodies must not
             # append their own records (tracer outputs would escape)
             return jax.lax.cond(
-                jnp.reshape(pred_v, ()),
-                lambda oo: _unwrap_tree(true_fn(*_wrap_tree(oo))),
-                lambda oo: _unwrap_tree(false_fn(*_wrap_tree(oo))),
-                o,
-            )
+                jnp.reshape(jnp.asarray(pred_v), ()),
+                branch(true_fn), branch(false_fn), o)
 
     out = apply("cond", f, to_tensor_like(pred),
                 *[to_tensor_like(x) for x in flat_ops])
-    return out
+    leaves = list(out) if isinstance(out, (tuple, list)) else [out]
+    return jax.tree_util.tree_unflatten(f._out_def, leaves)
 
 
 def cond(pred, true_fn, false_fn, name=None):
@@ -83,7 +93,7 @@ def cond(pred, true_fn, false_fn, name=None):
             "true_fn, false_fn, *operands) with every tensor dependency "
             "passed as an operand.")
     out = jax.lax.cond(
-        _unwrap(pred).reshape(()),
+        jnp.reshape(jnp.asarray(_unwrap(pred)), ()),
         lambda _: _unwrap_tree(true_fn()),
         lambda _: _unwrap_tree(false_fn()),
         0,
@@ -98,25 +108,35 @@ def while_loop(cond_fn, body_fn, loop_vars):
     from ..ops._helpers import to_tensor_like
     from ..ops.dispatch import apply
 
+    flat_vars, var_def = jax.tree_util.tree_flatten(
+        tuple(loop_vars), is_leaf=lambda x: isinstance(x, Tensor))
+
     def f(*init_vals):
         from ..static.program import suspend_recording
 
         def cond_(c):
-            r = cond_fn(*_wrap_tree(c))
-            return _unwrap(r).reshape(())
+            args = jax.tree_util.tree_unflatten(var_def, c)
+            r = cond_fn(*_wrap_tree(args))
+            return jnp.reshape(jnp.asarray(_unwrap(r)), ())
 
         def body(c):
-            r = body_fn(*_wrap_tree(c))
+            args = jax.tree_util.tree_unflatten(var_def, c)
+            r = body_fn(*_wrap_tree(args))
             if not isinstance(r, tuple):
                 r = (r,)
-            return _unwrap_tree(r)
+            leaves, out_def = jax.tree_util.tree_flatten(_unwrap_tree(r))
+            if out_def != var_def:
+                raise ValueError(
+                    "while_loop body must return loop_vars' structure")
+            return tuple(leaves)
 
         with suspend_recording():
-            return jax.lax.while_loop(cond_, body, init_vals)
+            return jax.lax.while_loop(cond_, body, tuple(init_vals))
 
     out = apply("while_loop", f,
-                *[to_tensor_like(v) for v in loop_vars])
-    return list(out) if isinstance(out, (tuple, list)) else [out]
+                *[to_tensor_like(v) for v in flat_vars])
+    leaves = list(out) if isinstance(out, (tuple, list)) else [out]
+    return list(jax.tree_util.tree_unflatten(var_def, leaves))
 
 
 def scan(f, init, xs, length=None, reverse=False, unroll=1):

@@ -63,6 +63,16 @@ def cond(pred, true_fn, false_fn, name=None):
     paddle_tpu.static.nn.cond which lowers to lax.cond."""
     import jax
 
+    from .dispatch import _recording_program
+
+    if _recording_program() is not None:
+        # unwrapping to ._value would sidestep the Tensor.__bool__ loud
+        # guard and bake the build-time branch into the program
+        raise TypeError(
+            "cond(no-operand closures) is not recordable into a static "
+            "Program: only the build-time branch would be captured. Use "
+            "paddle_tpu.jit.control_flow.traced_cond(pred, true_fn, "
+            "false_fn, *operands) with explicit tensor operands.")
     p = to_tensor_like(pred)._value
     try:
         concrete = bool(p)

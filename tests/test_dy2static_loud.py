@@ -145,6 +145,23 @@ class TestControlFlowMapping:
         np.testing.assert_allclose(f(_t(pos)).numpy(), pos * 2, rtol=1e-6)
         np.testing.assert_allclose(f(_t(neg)).numpy(), -neg, rtol=1e-6)
 
+    def test_cond_plain_bool_pred(self):
+        x = _t([1.0, 2.0])
+        got = control_flow.cond(True, lambda: x * 2.0, lambda: -x)
+        np.testing.assert_allclose(got.numpy(), [2.0, 4.0])
+
+    def test_traced_cond_dict_outputs(self):
+        # review r4: pytree (dict) branch outputs must survive dispatch
+        x = _t([1.0, -2.0])
+        out = control_flow.traced_cond(
+            x.sum() < 0,
+            lambda v: {"a": v * 2.0, "b": v + 1.0},
+            lambda v: {"a": -v, "b": v},
+            x)
+        # sum = -1 < 0 -> true branch: a = v*2, b = v+1
+        np.testing.assert_allclose(out["a"].numpy(), [2.0, -4.0])
+        np.testing.assert_allclose(out["b"].numpy(), [2.0, -1.0])
+
     def test_while_loop_inside_to_static(self):
         def count_up(x):
             def cond(v):
