@@ -1,0 +1,203 @@
+"""JPEG decode + augment input pipeline.
+
+Reference analog: the reference feeds ImageNet through
+operators/reader/buffered_reader.cc (async host staging) with decode/
+augment done by cv2/PIL in DataLoader workers (vision/transforms).  This
+module is the TPU-side equivalent, built for bench-speed:
+
+- decode + RandomResizedCrop + RandomHorizontalFlip per image, PIL-backed
+  (libjpeg C decode releases the GIL, so THREADS scale — no process
+  fork/pickle tax like the reference's multiprocess workers)
+- each batch lands in a page-aligned HostArena buffer as HWC uint8;
+  normalization happens ON DEVICE (4x less host->device traffic)
+- a background stager keeps `prefetch` batches in flight (buffered_reader
+  double-buffering)."""
+from __future__ import annotations
+
+import io as _io
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..io.arena import HostArena
+
+
+def encode_jpeg(arr: np.ndarray, quality: int = 85) -> bytes:
+    """HWC uint8 -> JPEG bytes (test/bench data generation)."""
+    from PIL import Image
+
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def decode_jpeg(data: bytes) -> np.ndarray:
+    """JPEG bytes -> HWC uint8 (reference decode_jpeg op analog)."""
+    from PIL import Image
+
+    img = Image.open(_io.BytesIO(data))
+    if img.mode != "RGB":
+        img = img.convert("RGB")
+    return np.asarray(img)
+
+
+def _random_resized_crop_flip(img, out_size: int, rng: np.random.RandomState,
+                              train: bool):
+    """RandomResizedCrop(scale 0.08-1.0, ratio 3/4-4/3) + hflip — the
+    standard ImageNet train augmentation (vision/transforms
+    RandomResizedCrop); eval: resize short side + center crop."""
+    from PIL import Image
+
+    W, H = img.size
+    if train:
+        area = W * H
+        for _ in range(10):
+            target = rng.uniform(0.08, 1.0) * area
+            ratio = np.exp(rng.uniform(np.log(3 / 4), np.log(4 / 3)))
+            w = int(round(np.sqrt(target * ratio)))
+            h = int(round(np.sqrt(target / ratio)))
+            if 0 < w <= W and 0 < h <= H:
+                x0 = rng.randint(0, W - w + 1)
+                y0 = rng.randint(0, H - h + 1)
+                img = img.resize((out_size, out_size), Image.BILINEAR,
+                                 box=(x0, y0, x0 + w, y0 + h))
+                break
+        else:
+            img = img.resize((out_size, out_size), Image.BILINEAR)
+        if rng.rand() < 0.5:
+            img = img.transpose(Image.FLIP_LEFT_RIGHT)
+    else:
+        short = min(W, H)
+        scale = 256 / short
+        img = img.resize((max(out_size, int(W * scale)),
+                          max(out_size, int(H * scale))), Image.BILINEAR)
+        W2, H2 = img.size
+        x0 = (W2 - out_size) // 2
+        y0 = (H2 - out_size) // 2
+        img = img.crop((x0, y0, x0 + out_size, y0 + out_size))
+    return img
+
+
+class JpegPipeline:
+    """Threaded decode+augment engine over in-memory JPEG samples.
+
+    next_batch() -> (images [B, S, S, 3] uint8 in an arena buffer,
+    labels [B] int32, release_fn).  Call release_fn once the batch has
+    been shipped (jax.device_put returns after copy, so immediately
+    after device_put is safe)."""
+
+    def __init__(self, samples: Sequence[bytes], labels: Sequence[int],
+                 batch_size: int, out_size: int = 224, train: bool = True,
+                 num_threads: int = 8, prefetch: int = 2, seed: int = 0,
+                 arena: Optional[HostArena] = None):
+        self.samples = list(samples)
+        self.labels = np.asarray(labels, np.int32)
+        self.batch = batch_size
+        self.out_size = out_size
+        self.train = train
+        self.seed = seed
+        self._pool = ThreadPoolExecutor(max_workers=num_threads,
+                                        thread_name_prefix="jpeg-decode")
+        nbytes = batch_size * out_size * out_size * 3
+        self.arena = arena or HostArena(nbytes, n_buffers=prefetch + 1)
+        self._q: "queue.Queue" = queue.Queue(maxsize=prefetch)
+        self._stop = False
+        self._err: Optional[BaseException] = None
+        self._stager = threading.Thread(target=self._stage_loop,
+                                        daemon=True)
+        self._stager.start()
+
+    # -- staging ------------------------------------------------------------
+
+    def _assemble(self, idxs: np.ndarray, batch_seed: int) -> Tuple:
+        out = self.arena.acquire(
+            (len(idxs), self.out_size, self.out_size, 3), np.uint8)
+
+        def work(slot):
+            from PIL import Image
+
+            rng = np.random.RandomState(
+                (batch_seed * 9176 + slot) % (2 ** 31))
+            img = Image.open(_io.BytesIO(self.samples[idxs[slot]]))
+            if img.mode != "RGB":
+                img = img.convert("RGB")
+            img = _random_resized_crop_flip(img, self.out_size, rng,
+                                            self.train)
+            out[slot] = np.asarray(img)
+
+        list(self._pool.map(work, range(len(idxs))))
+        return out, self.labels[idxs]
+
+    def _stage_loop(self):
+        rng = np.random.RandomState(self.seed)
+        n = len(self.samples)
+        epoch = 0
+        try:
+            while not self._stop:
+                order = rng.permutation(n) if self.train else np.arange(n)
+                for i in range(0, n - self.batch + 1, self.batch):
+                    if self._stop:
+                        return
+                    idxs = order[i:i + self.batch]
+                    item = self._assemble(idxs, epoch * 100003 + i)
+                    self._q.put(item)
+                epoch += 1
+        except BaseException as e:  # noqa: BLE001 — surfaced in next_batch
+            self._err = e
+            self._q.put(None)
+
+    # -- consumption --------------------------------------------------------
+
+    def next_batch(self):
+        item = self._q.get()
+        if item is None:
+            raise RuntimeError("jpeg pipeline failed") from self._err
+        imgs, labels = item
+        return imgs, labels, (lambda: self.arena.release(imgs))
+
+    def stop(self):
+        self._stop = True
+        # drain so the stager unblocks from a full queue
+        try:
+            while True:
+                item = self._q.get_nowait()
+                if item is not None:
+                    self.arena.release(item[0])
+        except queue.Empty:
+            pass
+        self._pool.shutdown(wait=False)
+
+    def measure_rate(self, n_batches: int = 20) -> float:
+        """Decode+augment throughput (imgs/s) of the full pipeline."""
+        import time
+
+        imgs, _, rel = self.next_batch()   # warm
+        rel()
+        t0 = time.perf_counter()
+        for _ in range(n_batches):
+            imgs, _, rel = self.next_batch()
+            rel()
+        dt = time.perf_counter() - t0
+        return n_batches * self.batch / dt
+
+
+def synthetic_jpeg_dataset(n: int, size: int = 256, seed: int = 0,
+                           classes: int = 1000):
+    """Generate n in-memory JPEG samples (bench/test corpus — real decode
+    work without shipping ImageNet)."""
+    rng = np.random.RandomState(seed)
+    samples = []
+    for _ in range(n):
+        # structured image (gradients+noise) so JPEG decode cost is real
+        base = rng.randint(0, 256, (8, 8, 3), np.uint8)
+        img = np.kron(base, np.ones((size // 8, size // 8, 1),
+                                    np.uint8))
+        noise = rng.randint(0, 40, img.shape, np.uint8)
+        samples.append(encode_jpeg(
+            np.clip(img.astype(np.int32) + noise, 0, 255)
+            .astype(np.uint8)))
+    labels = rng.randint(0, classes, (n,)).astype(np.int32)
+    return samples, labels

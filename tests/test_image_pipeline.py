@@ -1,0 +1,123 @@
+"""JPEG decode+augment pipeline + host arena (VERDICT r3 next-round #7).
+
+Reference: operators/reader/buffered_reader.cc (async host staging),
+memory/allocation/pinned_allocator.cc (recycled aligned host buffers),
+vision/transforms RandomResizedCrop."""
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io.arena import HostArena
+from paddle_tpu.vision.image_pipeline import (JpegPipeline, decode_jpeg,
+                                              encode_jpeg,
+                                              synthetic_jpeg_dataset)
+
+
+class TestHostArena:
+    def test_acquire_release_reuses_buffers(self):
+        a = HostArena(1024, n_buffers=2)
+        b1 = a.acquire((16, 16), np.float32)
+        ptr1 = b1.ctypes.data
+        a.release(b1)
+        b2 = a.acquire((16, 16), np.float32)
+        assert b2.ctypes.data == ptr1        # same backing buffer reused
+        a.release(b2)
+
+    def test_page_aligned(self):
+        a = HostArena(4096, n_buffers=1)
+        b = a.acquire((1024,), np.float32)
+        assert b.ctypes.data % 4096 == 0
+        a.release(b)
+
+    def test_blocks_until_release(self):
+        a = HostArena(64, n_buffers=1)
+        b = a.acquire((8,), np.float32)
+        got = []
+
+        def taker():
+            got.append(a.acquire((8,), np.float32))
+
+        t = threading.Thread(target=taker, daemon=True)
+        t.start()
+        t.join(timeout=0.2)
+        assert t.is_alive() and not got       # backpressure
+        a.release(b)
+        t.join(timeout=5)
+        assert got
+
+    def test_oversize_raises(self):
+        a = HostArena(64)
+        with pytest.raises(ValueError):
+            a.acquire((1024,), np.float32)
+
+
+class TestJpegCodec:
+    def test_roundtrip_close(self):
+        rng = np.random.RandomState(0)
+        img = np.kron(rng.randint(0, 256, (8, 8, 3), np.uint8),
+                      np.ones((16, 16, 1), np.uint8))
+        back = decode_jpeg(encode_jpeg(img, quality=95))
+        assert back.shape == img.shape
+        assert np.abs(back.astype(int) - img.astype(int)).mean() < 12
+
+
+class TestJpegPipeline:
+    def test_batches_shapes_and_labels(self):
+        samples, labels = synthetic_jpeg_dataset(32, size=64, seed=1)
+        p = JpegPipeline(samples, labels, batch_size=8, out_size=32,
+                         num_threads=4, seed=3)
+        try:
+            seen = 0
+            for _ in range(4):
+                imgs, lbls, rel = p.next_batch()
+                assert imgs.shape == (8, 32, 32, 3)
+                assert imgs.dtype == np.uint8
+                assert lbls.shape == (8,)
+                assert imgs.max() > 0       # real decoded content
+                seen += 8
+                rel()
+            assert seen == 32
+        finally:
+            p.stop()
+
+    def test_train_augmentation_varies(self):
+        samples, labels = synthetic_jpeg_dataset(8, size=64, seed=2)
+        p = JpegPipeline(samples, labels, batch_size=8, out_size=32,
+                         train=True, num_threads=2, seed=4)
+        try:
+            a, _, rel_a = p.next_batch()
+            a = a.copy()
+            rel_a()
+            b, _, rel_b = p.next_batch()
+            b = b.copy()
+            rel_b()
+            assert not np.array_equal(a, b)  # epoch 2: new crops/flips
+        finally:
+            p.stop()
+
+    def test_eval_deterministic(self):
+        samples, labels = synthetic_jpeg_dataset(8, size=64, seed=5)
+
+        def run():
+            p = JpegPipeline(samples, labels, batch_size=8, out_size=32,
+                             train=False, num_threads=2)
+            try:
+                imgs, _, rel = p.next_batch()
+                out = imgs.copy()
+                rel()
+                return out
+            finally:
+                p.stop()
+
+        np.testing.assert_array_equal(run(), run())
+
+    def test_measure_rate_positive(self):
+        samples, labels = synthetic_jpeg_dataset(64, size=128, seed=6)
+        p = JpegPipeline(samples, labels, batch_size=16, out_size=64,
+                         num_threads=4)
+        try:
+            rate = p.measure_rate(n_batches=6)
+            assert rate > 50                  # imgs/s, sanity floor
+        finally:
+            p.stop()
