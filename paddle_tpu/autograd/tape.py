@@ -60,14 +60,43 @@ class GradNode:
 
 
 class Edge:
-    """Connects a node input slot back to the tensor that produced it."""
+    """Connects a node input slot back to the tensor that produced it.
 
-    __slots__ = ("tensor", "node", "out_index")
+    ``version`` snapshots the producer's inplace counter at record time
+    (reference TensorInplaceVersion, tensor.h:77 + the basic_engine.cc
+    check; r3 aux §5.2 gap).  Scope note vs the reference: jax arrays
+    are immutable, so a leaf's in-place update (optimizer.step,
+    set_value on a param) cannot corrupt an already-recorded vjp — the
+    closure holds the old array.  What the check guards is INTERMEDIATE
+    tensors rebound by in-place ops after being consumed: their autograd
+    identity (node/out_index) changed, so the recorded graph no longer
+    describes the value the user sees — the reference raises there and
+    so do we."""
+
+    __slots__ = ("tensor", "node", "out_index", "version")
 
     def __init__(self, tensor):
         self.tensor = tensor
         self.node = tensor._grad_node
         self.out_index = tensor._out_index
+        self.version = getattr(tensor, "_inplace_version", 0)
+
+    def check_version(self, op_name):
+        if self.node is None:
+            # leaf: immutable arrays make post-record writes safe (see
+            # class docstring) — optimizer.step between recording and
+            # backward is the GAN/meta-learning pattern and stays legal
+            return
+        cur = getattr(self.tensor, "_inplace_version", 0)
+        if cur != self.version:
+            raise RuntimeError(
+                f"intermediate tensor used by operator < {op_name} > was "
+                f"modified in-place after being recorded for backward "
+                f"(inplace version {cur} != recorded {self.version}): its "
+                "autograd identity changed, so the recorded graph no "
+                "longer matches the tensor you hold (reference "
+                "TensorInplaceVersion check). Clone it before the "
+                "in-place write.")
 
 
 class _GradMode(threading.local):
@@ -244,6 +273,9 @@ def run_backward(
                 "trying to backward through the graph a second time after it "
                 "was freed; pass retain_graph=True to backward()"
             )
+        for edge in node.edges:
+            if edge is not None:
+                edge.check_version(node.name)
         in_cts = _dispatch.apply_vjp(node, flat_cts, create_graph)
         for edge, ct in zip(node.edges, in_cts):
             if edge is None or ct is None:

@@ -1,0 +1,205 @@
+"""Device-resident embedding cache over a host SparseTable.
+
+Reference analog: framework/fleet/heter_ps/ (hashtable.h GPU hash table,
+heter_comm.h) — the reference keeps hot embedding rows in GPU memory and
+falls back to the CPU parameter server for the long tail.  TPU-native
+re-design: the cache is a fixed [cache_rows, dim] device array; a host
+dict maps id->slot with second-chance eviction.  A training step's pull
+becomes ONE device gather over the cache (misses are fetched from the
+host/remote table in a single batched pull and scattered into evicted
+slots); pushes apply the rowwise optimizer on the host table and refresh
+the cached copies in one scatter."""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DeviceCachedTable:
+    """SparseTable-shaped adapter: same pull/push surface, device-cached.
+
+    Thread-safe like SparseTable (hogwild workers / PS connection threads
+    share it).  `hit_rate` exposes cache effectiveness; with CTR skew
+    (zipfian ids) steady-state hit rates are high and the per-step host
+    traffic drops to the miss tail — the heter_ps design point."""
+
+    def __init__(self, table, cache_rows: int = 1 << 16,
+                 dtype=jnp.float32):
+        self.table = table
+        self.dim = table.dim
+        self.rule = getattr(table, "rule", "sgd")
+        self.cache_rows = int(cache_rows)
+        self._cache = jnp.zeros((self.cache_rows, self.dim), dtype)
+        self._slot_of: Dict[int, int] = {}
+        self._id_at = np.full((self.cache_rows,), -1, np.int64)
+        self._ref = np.zeros((self.cache_rows,), bool)  # second chance
+        self._hand = 0
+        self._hits = 0
+        self._lookups = 0
+        self._lock = threading.RLock()
+
+    # -- eviction ------------------------------------------------------------
+
+    def _grab_slot(self, pinned) -> int:
+        """Second-chance (clock) eviction over the slot ring.  `pinned`
+        slots belong to the in-flight batch and must not be evicted
+        (evicting a row pulled moments ago in the SAME batch would hand
+        its slot to another id and corrupt the gather).  Returns -1 when
+        every slot is pinned — the caller serves the row uncached."""
+        scanned = 0
+        limit = 2 * self.cache_rows
+        while True:
+            s = self._hand
+            self._hand = (self._hand + 1) % self.cache_rows
+            scanned += 1
+            if s in pinned:
+                if scanned > limit:
+                    return -1
+                continue
+            if self._ref[s]:
+                self._ref[s] = False
+                continue
+            old = self._id_at[s]
+            if old >= 0:
+                self._slot_of.pop(int(old), None)
+            return s
+
+    # -- residency (the shared bookkeeping core) -----------------------------
+
+    def _ensure_resident(self, ids: np.ndarray, create: bool) \
+            -> Tuple[np.ndarray, Optional[np.ndarray], dict]:
+        """Make `ids` cache-resident where capacity allows.
+
+        Returns (slots [N] with -1 for uncached overflow rows,
+        overflow_rows_by_unique_index or None, seen: id -> unique idx).
+        Caller must hold the lock."""
+        slots = np.empty(len(ids), np.int64)
+        pinned = set()
+        miss_idx = []
+        for i, gid in enumerate(ids):
+            s = self._slot_of.get(int(gid), -1)
+            if s < 0:
+                miss_idx.append(i)
+            else:
+                self._ref[s] = True
+                pinned.add(s)
+                slots[i] = s
+        self._lookups += len(ids)
+        self._hits += len(ids) - len(miss_idx)
+        if not miss_idx:
+            return slots, None, {}
+        # dedupe: one slot per unique missing id
+        uniq_ids = []
+        seen: Dict[int, int] = {}
+        for i in miss_idx:
+            gid = int(ids[i])
+            if gid not in seen:
+                seen[gid] = len(uniq_ids)
+                uniq_ids.append(gid)
+        rows = self.table.pull(np.asarray(uniq_ids, np.int64),
+                               create=create)
+        uniq_slots = np.empty(len(uniq_ids), np.int64)
+        for j, gid in enumerate(uniq_ids):
+            s = self._grab_slot(pinned)
+            if s >= 0:
+                self._slot_of[gid] = s
+                self._id_at[s] = gid
+                self._ref[s] = True
+                pinned.add(s)
+            uniq_slots[j] = s
+        cacheable = uniq_slots >= 0
+        if cacheable.any():
+            self._cache = self._cache.at[
+                jnp.asarray(uniq_slots[cacheable])].set(
+                jnp.asarray(rows[cacheable], self._cache.dtype))
+        for i in miss_idx:
+            slots[i] = uniq_slots[seen[int(ids[i])]]
+        overflow = rows if (~cacheable).any() else None
+        return slots, overflow, seen
+
+    # -- pull/push -----------------------------------------------------------
+
+    def pull(self, ids, create: bool = True) -> np.ndarray:
+        """Rows for `ids` as a HOST array (SparseTable-compatible)."""
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        with self._lock:
+            slots, overflow, seen = self._ensure_resident(ids, create)
+            out = np.array(self._cache[jnp.asarray(np.maximum(slots, 0))])
+            if overflow is not None:
+                for i in np.nonzero(slots < 0)[0]:
+                    out[i] = overflow[seen[int(ids[i])]]
+            return out
+
+    def pull_device(self, ids):
+        """Rows for `ids` as the DEVICE gather over the cache — no host
+        copy on the all-resident fast path (the embedding layer's per-
+        step read).  Falls back to a host assemble only when the batch's
+        unique ids overflow the cache."""
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        with self._lock:
+            slots, overflow, seen = self._ensure_resident(ids,
+                                                          create=True)
+            if overflow is None:
+                return self._cache[jnp.asarray(slots)]
+            out = np.array(self._cache[jnp.asarray(np.maximum(slots, 0))])
+            for i in np.nonzero(slots < 0)[0]:
+                out[i] = overflow[seen[int(ids[i])]]
+            return jnp.asarray(out)
+
+    def _refresh(self, ids: np.ndarray) -> None:
+        """Re-sync cached copies of `ids` from the backing table — ONE
+        batched pull of only the ids actually resident (a cold-cache push
+        of 16k ids refreshes nothing and costs no extra RPC).  Caller
+        holds the lock."""
+        live = [(i, self._slot_of[int(g)]) for i, g in enumerate(ids)
+                if int(g) in self._slot_of]
+        if not live:
+            return
+        live_ids = np.asarray([int(ids[i]) for i, _ in live], np.int64)
+        fresh = self.table.pull(live_ids, create=False)
+        ss = jnp.asarray(np.asarray([s for _, s in live], np.int64))
+        self._cache = self._cache.at[ss].set(
+            jnp.asarray(fresh, self._cache.dtype))
+
+    def push(self, ids, grads, lr: float = 0.01) -> None:
+        """Host-table rowwise update, then refresh the cached copies (the
+        cache must never serve stale rows)."""
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        with self._lock:
+            self.table.push(ids, grads, lr=lr)
+            self._refresh(ids)
+
+    def apply_deltas(self, ids, deltas) -> None:
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        with self._lock:
+            self.table.apply_deltas(ids, deltas)
+            self._refresh(ids)
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        return self.table.size
+
+    @property
+    def cached_rows(self) -> int:
+        return len(self._slot_of)
+
+    @property
+    def hit_rate(self) -> float:
+        return self._hits / self._lookups if self._lookups else 0.0
+
+    def state_dict(self):
+        return self.table.state_dict()
+
+    def set_state_dict(self, d):
+        with self._lock:
+            self.table.set_state_dict(d)
+            # drop the cache: cached copies may be stale vs loaded state
+            self._slot_of.clear()
+            self._id_at[:] = -1
+            self._ref[:] = False

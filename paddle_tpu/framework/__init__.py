@@ -35,6 +35,13 @@ from .errors import (  # noqa: F401
     enforce_eq,
 )
 from .flags import define_flag, flag_value, get_flags, set_flags  # noqa: F401
+# NOTE: the module is reachable as framework.init; re-exporting its
+# `init` function here would shadow the submodule name
+from .init import (  # noqa: F401
+    init_devices,
+    init_signal_handlers,
+    register_shutdown_hook,
+)
 from .monitor import stat_add, stat_get, stat_registry, stat_reset  # noqa: F401
 from .op_version import op_version_registry  # noqa: F401
 from .place import (  # noqa: F401

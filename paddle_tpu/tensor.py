@@ -200,6 +200,14 @@ class Tensor:
         self._out_index = other._out_index
         self.stop_gradient = other.stop_gradient
         self._inplace_version += 1
+        # the bump above is made BY the op whose node we just adopted: its
+        # own edges into this tensor captured the pre-op value correctly
+        # (vjp closed over it), so refresh their snapshots — only LATER
+        # writes should trip the backward version check
+        if self._grad_node is not None:
+            for edge in getattr(self._grad_node, "edges", []):
+                if edge is not None and edge.tensor is self:
+                    edge.version = self._inplace_version
         return self
 
     # --- casting / movement ------------------------------------------------

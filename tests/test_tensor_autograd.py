@@ -308,3 +308,46 @@ class TestOps:
         b = paddle.to_tensor(np.random.randn(3, 4).astype(np.float32))
         out = paddle.einsum("ij,jk->ik", a, b)
         np.testing.assert_allclose(out.numpy(), a.numpy() @ b.numpy(), rtol=1e-5)
+
+
+class TestInplaceVersionGuard:
+    def test_intermediate_inplace_after_record_raises(self):
+        """reference TensorInplaceVersion (tensor.h:77) + basic_engine
+        check: rebinding an INTERMEDIATE in-place after it was consumed
+        must fail loudly at backward (r3 aux 5.2 gap)."""
+        import numpy as np
+        import pytest
+        import paddle_tpu as paddle
+
+        x = paddle.to_tensor(np.ones((3,), np.float32))
+        x.stop_gradient = False
+        h = x * 2.0                  # intermediate (has a grad node)
+        y = h * h                    # consumes h
+        h[0] = 9.0                   # in-place rebind AFTER consumption
+        with pytest.raises(RuntimeError, match="in-place"):
+            y.sum().backward()
+
+    def test_leaf_step_between_record_and_backward_is_legal(self):
+        """jax arrays are immutable, so optimizer-style leaf writes after
+        recording stay correct (documented delta vs the reference) —
+        grads come from the recorded (pre-write) value."""
+        import numpy as np
+        import paddle_tpu as paddle
+
+        x = paddle.to_tensor(np.full((3,), 2.0, np.float32))
+        x.stop_gradient = False
+        y = x * x                     # records x's value (2.0)
+        x.set_value(paddle.to_tensor(np.full((3,), 5.0, np.float32)))
+        y.sum().backward()            # must NOT raise
+        np.testing.assert_allclose(x.grad.numpy(), 4.0)  # 2*old value
+
+    def test_inplace_before_record_is_fine(self):
+        import numpy as np
+        import paddle_tpu as paddle
+
+        x = paddle.to_tensor(np.ones((3,), np.float32))
+        x.stop_gradient = False
+        x.set_value(paddle.to_tensor(np.full((3,), 2.0, np.float32)))
+        y = x * x
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 4.0)
