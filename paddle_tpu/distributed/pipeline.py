@@ -44,21 +44,28 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_microbatches,
       stage's section program holds the pre-pipeline layers).
     tail_fn(tail_params, activation) -> out: OPTIONAL shape-changing final
       projection applied on the last stage as each microbatch finishes.
-    schedule: '1f1b' (default) wraps the stage in jax.checkpoint — under
+    schedule: 'remat' (default; the name '1f1b' is accepted as an alias
+      for reference-knob parity) wraps the stage in jax.checkpoint — under
       autodiff-of-scan only the O(M) stage-BOUNDARY activations are stashed
-      and per-stage intermediates are recomputed during the reverse sweep,
-      the same peak-memory class as the reference's 1F1B interleave
-      (fluid/optimizer.py:4351); 'f-then-b' stashes every intermediate
-      (reference F-then-B :4324 — faster backward, more memory).
+      and per-stage intermediates are recomputed during the reverse sweep.
+      PEAK-MEMORY class matches the reference's 1F1B interleave
+      (fluid/optimizer.py:4351), but the BUBBLE PROFILE is still
+      forward-then-backward — XLA schedules the compiled scan, so the
+      true interleaved 1F1B issue order is not expressible here (r3 weak
+      #6: the old name alone overstated this).  'f-then-b' stashes every
+      intermediate (reference F-then-B :4324 — faster backward, more
+      memory).
     Returns [M, mb, ...] outputs (valid on the last stage; replicated out by
     caller via ppermute/psum as needed).
     """
-    if schedule not in ("1f1b", "f-then-b"):
+    if schedule == "1f1b":      # reference knob name -> honest alias
+        schedule = "remat"
+    if schedule not in ("remat", "f-then-b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
-    # remat is DERIVED from the schedule ('1f1b' = remat on, 'f-then-b' =
+    # remat is DERIVED from the schedule ('remat' = remat on, 'f-then-b' =
     # full stash); an explicit contradictory remat is an error, not a
     # silent override
-    want_remat = schedule == "1f1b"
+    want_remat = schedule == "remat"
     if remat is None:
         remat = want_remat
     elif remat != want_remat:
