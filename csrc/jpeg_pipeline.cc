@@ -1,0 +1,182 @@
+// Native JPEG decode + crop/flip/resize batch engine.
+//
+// Reference analog: the decode/augment half of the reference's data path
+// (operators/reader/buffered_reader.cc staging + the cv2/PIL transform
+// workers the DataLoader forks).  Python threads already parallelize
+// PIL's C decode, but each worker still pays Python-object and
+// GIL-window costs per image; this engine decodes a whole batch with
+// raw pthreads — zero Python between images — writing RGB u8 rows
+// straight into the caller's (arena) buffer.
+//
+// Build: make -C csrc libptpu_jpeg.so      (links -ljpeg)
+// Load:  paddle_tpu/vision/image_pipeline.py (ctypes, PIL fallback).
+
+#include <cstddef>
+#include <cstdio>
+
+#include <jpeglib.h>
+
+#include <csetjmp>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct ErrMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jump;
+};
+
+void on_error(j_common_ptr cinfo) {
+  ErrMgr* err = reinterpret_cast<ErrMgr*>(cinfo->err);
+  longjmp(err->jump, 1);
+}
+
+// decode `data` into a temporary RGB buffer; returns true on success
+bool decode_rgb(const uint8_t* data, size_t len, std::vector<uint8_t>* out,
+                int* w, int* h) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = on_error;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data),
+               static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *w = cinfo.output_width;
+  *h = cinfo.output_height;
+  out->resize(static_cast<size_t>(*w) * *h * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out->data() +
+                   static_cast<size_t>(cinfo.output_scanline) * *w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// bilinear crop-resize (+ optional hflip) from src RGB into dst
+// [out_size, out_size, 3]
+void crop_resize(const uint8_t* src, int W, int H, float x0, float y0,
+                 float cw, float ch, int out_size, int flip, uint8_t* dst) {
+  for (int oy = 0; oy < out_size; ++oy) {
+    float sy = y0 + (oy + 0.5f) * ch / out_size - 0.5f;
+    if (sy < 0) sy = 0;
+    if (sy > H - 1) sy = static_cast<float>(H - 1);
+    int iy = static_cast<int>(sy);
+    int iy1 = iy + 1 < H ? iy + 1 : H - 1;
+    float fy = sy - iy;
+    for (int ox = 0; ox < out_size; ++ox) {
+      int oxx = flip ? (out_size - 1 - ox) : ox;
+      float sx = x0 + (ox + 0.5f) * cw / out_size - 0.5f;
+      if (sx < 0) sx = 0;
+      if (sx > W - 1) sx = static_cast<float>(W - 1);
+      int ix = static_cast<int>(sx);
+      int ix1 = ix + 1 < W ? ix + 1 : W - 1;
+      float fx = sx - ix;
+      const uint8_t* p00 = src + (static_cast<size_t>(iy) * W + ix) * 3;
+      const uint8_t* p01 = src + (static_cast<size_t>(iy) * W + ix1) * 3;
+      const uint8_t* p10 = src + (static_cast<size_t>(iy1) * W + ix) * 3;
+      const uint8_t* p11 = src + (static_cast<size_t>(iy1) * W + ix1) * 3;
+      uint8_t* d = dst + (static_cast<size_t>(oy) * out_size + oxx) * 3;
+      for (int c = 0; c < 3; ++c) {
+        float v = (1 - fy) * ((1 - fx) * p00[c] + fx * p01[c]) +
+                  fy * ((1 - fx) * p10[c] + fx * p11[c]);
+        d[c] = static_cast<uint8_t>(v + 0.5f);
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode one JPEG, crop (x0,y0,cw,ch in source pixels; cw/ch<=0 = full
+// frame), bilinear-resize to [out_size,out_size,3], optional hflip.
+// Returns 0 ok, -1 decode error.
+int ptpu_decode_one(const uint8_t* data, int64_t len, uint8_t* dst,
+                    int out_size, float x0, float y0, float cw, float ch,
+                    int flip) {
+  std::vector<uint8_t> rgb;
+  int W = 0, H = 0;
+  if (!decode_rgb(data, static_cast<size_t>(len), &rgb, &W, &H)) return -1;
+  if (cw <= 0 || ch <= 0) {
+    x0 = 0; y0 = 0; cw = static_cast<float>(W); ch = static_cast<float>(H);
+  }
+  crop_resize(rgb.data(), W, H, x0, y0, cw, ch, out_size, flip, dst);
+  return 0;
+}
+
+// Batch form: n images, pthread-parallel across `threads` workers.
+// datas/lens: per-image jpeg bytes; crops: [n,4] (x0,y0,cw,ch) or NULL;
+// flips: [n] or NULL; dst: [n,out_size,out_size,3] u8. Returns count of
+// decode FAILURES (their dst rows are zeroed).
+int ptpu_decode_batch(const uint8_t** datas, const int64_t* lens, int n,
+                      uint8_t* dst, int out_size, const float* crops,
+                      const int32_t* flips, int threads) {
+  if (threads < 1) threads = 1;
+  std::vector<int> fails(threads, 0);
+  size_t row_bytes = static_cast<size_t>(out_size) * out_size * 3;
+  auto work = [&](int tid) {
+    for (int i = tid; i < n; i += threads) {
+      float x0 = 0, y0 = 0, cw = -1, ch = -1;
+      if (crops != nullptr) {
+        x0 = crops[i * 4 + 0];
+        y0 = crops[i * 4 + 1];
+        cw = crops[i * 4 + 2];
+        ch = crops[i * 4 + 3];
+      }
+      int flip = flips != nullptr ? flips[i] : 0;
+      uint8_t* d = dst + row_bytes * i;
+      if (ptpu_decode_one(datas[i], lens[i], d, out_size, x0, y0, cw, ch,
+                          flip) != 0) {
+        std::memset(d, 0, row_bytes);
+        fails[tid]++;
+      }
+    }
+  };
+  if (threads == 1) {
+    work(0);
+  } else {
+    std::vector<std::thread> ts;
+    ts.reserve(threads);
+    for (int t = 0; t < threads; ++t) ts.emplace_back(work, t);
+    for (auto& t : ts) t.join();
+  }
+  int total = 0;
+  for (int f : fails) total += f;
+  return total;
+}
+
+// Probe dimensions without a full decode (header only).
+int ptpu_jpeg_dims(const uint8_t* data, int64_t len, int32_t* w,
+                   int32_t* h) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = on_error;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data),
+               static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  *w = cinfo.image_width;
+  *h = cinfo.image_height;
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+}  // extern "C"

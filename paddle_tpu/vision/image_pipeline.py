@@ -44,14 +44,14 @@ def decode_jpeg(data: bytes) -> np.ndarray:
     return np.asarray(img)
 
 
-def _random_resized_crop_flip(img, out_size: int, rng: np.random.RandomState,
-                              train: bool):
-    """RandomResizedCrop(scale 0.08-1.0, ratio 3/4-4/3) + hflip — the
-    standard ImageNet train augmentation (vision/transforms
-    RandomResizedCrop); eval: resize short side + center crop."""
-    from PIL import Image
-
-    W, H = img.size
+def sample_crop_box(W: int, H: int, out_size: int,
+                    rng: np.random.RandomState, train: bool):
+    """Crop box (x0, y0, cw, ch) in source pixels — ONE implementation
+    shared by the PIL and native engines so their augmentation
+    distributions cannot drift.  Train: RandomResizedCrop(scale
+    0.08-1.0, ratio 3/4-4/3), the standard ImageNet augmentation
+    (vision/transforms RandomResizedCrop).  Eval: the resize-short-
+    side-256 + center-crop composition expressed as one centered box."""
     if train:
         area = W * H
         for _ in range(10):
@@ -60,24 +60,27 @@ def _random_resized_crop_flip(img, out_size: int, rng: np.random.RandomState,
             w = int(round(np.sqrt(target * ratio)))
             h = int(round(np.sqrt(target / ratio)))
             if 0 < w <= W and 0 < h <= H:
-                x0 = rng.randint(0, W - w + 1)
-                y0 = rng.randint(0, H - h + 1)
-                img = img.resize((out_size, out_size), Image.BILINEAR,
-                                 box=(x0, y0, x0 + w, y0 + h))
-                break
-        else:
-            img = img.resize((out_size, out_size), Image.BILINEAR)
-        if rng.rand() < 0.5:
-            img = img.transpose(Image.FLIP_LEFT_RIGHT)
-    else:
-        short = min(W, H)
-        scale = 256 / short
-        img = img.resize((max(out_size, int(W * scale)),
-                          max(out_size, int(H * scale))), Image.BILINEAR)
-        W2, H2 = img.size
-        x0 = (W2 - out_size) // 2
-        y0 = (H2 - out_size) // 2
-        img = img.crop((x0, y0, x0 + out_size, y0 + out_size))
+                return (float(rng.randint(0, W - w + 1)),
+                        float(rng.randint(0, H - h + 1)),
+                        float(w), float(h))
+        return (0.0, 0.0, float(W), float(H))
+    short = min(W, H)
+    c = short * out_size / 256.0
+    return ((W - c) / 2.0, (H - c) / 2.0, c, c)
+
+
+def _random_resized_crop_flip(img, out_size: int, rng: np.random.RandomState,
+                              train: bool):
+    """PIL-engine augmentation: crop box from sample_crop_box (shared with
+    the native engine) + bilinear resize + hflip."""
+    from PIL import Image
+
+    W, H = img.size
+    x0, y0, cw, ch = sample_crop_box(W, H, out_size, rng, train)
+    img = img.resize((out_size, out_size), Image.BILINEAR,
+                     box=(x0, y0, x0 + cw, y0 + ch))
+    if train and rng.rand() < 0.5:
+        img = img.transpose(Image.FLIP_LEFT_RIGHT)
     return img
 
 
@@ -92,13 +95,41 @@ class JpegPipeline:
     def __init__(self, samples: Sequence[bytes], labels: Sequence[int],
                  batch_size: int, out_size: int = 224, train: bool = True,
                  num_threads: int = 8, prefetch: int = 2, seed: int = 0,
-                 arena: Optional[HostArena] = None):
+                 arena: Optional[HostArena] = None, engine: str = "auto"):
         self.samples = list(samples)
         self.labels = np.asarray(labels, np.int32)
         self.batch = batch_size
         self.out_size = out_size
         self.train = train
         self.seed = seed
+        self.num_threads = num_threads
+        # native csrc engine (libjpeg + pthreads — zero Python between
+        # images) when built; PIL threads otherwise
+        if engine not in ("auto", "native", "pil"):
+            raise ValueError(
+                f"engine must be 'auto', 'native' or 'pil', got {engine!r}")
+        from . import native_jpeg
+
+        self._native = engine != "pil" and native_jpeg.available()
+        if engine == "native" and not self._native:
+            raise RuntimeError("native jpeg engine requested but not built")
+        self._dims = None
+        if self._native:
+            self._dims = [native_jpeg.jpeg_dims(s) for s in self.samples]
+            bad = [i for i, d in enumerate(self._dims) if d is None]
+            if bad:
+                if engine == "native":
+                    # an explicit native request must not silently run PIL
+                    raise ValueError(
+                        f"native jpeg engine: samples {bad[:5]} have "
+                        "unreadable headers")
+                import warnings
+
+                warnings.warn(
+                    f"jpeg pipeline: {len(bad)} sample(s) have unreadable "
+                    "headers; falling back to the PIL engine",
+                    stacklevel=2)
+                self._native = False
         self._pool = ThreadPoolExecutor(max_workers=num_threads,
                                         thread_name_prefix="jpeg-decode")
         nbytes = batch_size * out_size * out_size * 3
@@ -112,7 +143,36 @@ class JpegPipeline:
 
     # -- staging ------------------------------------------------------------
 
+    def _assemble_native(self, idxs: np.ndarray, batch_seed: int) -> Tuple:
+        from . import native_jpeg
+
+        out = self.arena.acquire(
+            (len(idxs), self.out_size, self.out_size, 3), np.uint8)
+        crops = np.empty((len(idxs), 4), np.float32)
+        flips = np.zeros((len(idxs),), np.int32)
+        for slot, i in enumerate(idxs):
+            rng = np.random.RandomState(
+                (batch_seed * 9176 + slot) % (2 ** 31))
+            W, H = self._dims[i]
+            crops[slot] = sample_crop_box(W, H, self.out_size, rng,
+                                          self.train)
+            if self.train:
+                flips[slot] = int(rng.rand() < 0.5)
+        fails = native_jpeg.decode_batch(
+            [self.samples[i] for i in idxs], out, crops, flips,
+            threads=self.num_threads)
+        if fails:
+            # the PIL path raises on corrupt samples; the native path must
+            # be as loud — black images with real labels train on garbage
+            self.arena.release(out)
+            raise RuntimeError(
+                f"native jpeg engine: {fails} sample(s) in the batch "
+                "failed to decode")
+        return out, self.labels[idxs]
+
     def _assemble(self, idxs: np.ndarray, batch_seed: int) -> Tuple:
+        if self._native:
+            return self._assemble_native(idxs, batch_seed)
         out = self.arena.acquire(
             (len(idxs), self.out_size, self.out_size, 3), np.uint8)
 

@@ -1,0 +1,119 @@
+"""ctypes binding for the native JPEG decode engine (csrc/
+jpeg_pipeline.cc).  Gracefully degrades: callers check available() and
+fall back to PIL."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Sequence
+
+import numpy as np
+
+_CSRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_SO_PATH = os.path.join(_CSRC_DIR, "libptpu_jpeg.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def ensure_built(rebuild: bool = False) -> bool:
+    """Compile the native library if missing (explicit — a predicate like
+    available() must not shell out to a compiler as a side effect).
+    Returns availability."""
+    global _tried, _lib
+    if rebuild:
+        _tried = False
+        _lib = None
+    if not os.path.exists(_SO_PATH) or rebuild:
+        try:
+            subprocess.run(["make", "-C", _CSRC_DIR, "libptpu_jpeg.so"],
+                           capture_output=True, timeout=120, check=True)
+        except Exception:
+            return False
+    return _load() is not None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_SO_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.ptpu_decode_batch.restype = ctypes.c_int
+    lib.ptpu_decode_batch.argtypes = [
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int, u8p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int,
+    ]
+    lib.ptpu_jpeg_dims.restype = ctypes.c_int
+    lib.ptpu_jpeg_dims.argtypes = [u8p, ctypes.c_int64,
+                                   ctypes.POINTER(ctypes.c_int32),
+                                   ctypes.POINTER(ctypes.c_int32)]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _bytes_ptr(data: bytes, u8p):
+    """Zero-copy pointer into an immutable bytes object (the C side only
+    reads; the caller keeps `data` alive across the call)."""
+    return ctypes.cast(ctypes.c_char_p(data), u8p)
+
+
+def jpeg_dims(data: bytes):
+    lib = _load()
+    if lib is None:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    w = ctypes.c_int32()
+    h = ctypes.c_int32()
+    if lib.ptpu_jpeg_dims(_bytes_ptr(data, u8p), len(data),
+                          ctypes.byref(w), ctypes.byref(h)) != 0:
+        return None
+    return int(w.value), int(h.value)
+
+
+def decode_batch(samples: Sequence[bytes], out: np.ndarray,
+                 crops: Optional[np.ndarray] = None,
+                 flips: Optional[np.ndarray] = None,
+                 threads: int = 4) -> int:
+    """Decode+crop+resize `samples` into `out` [n, S, S, 3] u8 (e.g. an
+    arena buffer).  crops [n,4] f32 (x0,y0,cw,ch; cw<=0 = full frame),
+    flips [n] i32.  Returns the number of decode failures (their rows
+    zeroed).  Raises RuntimeError when the native engine is missing."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native jpeg engine unavailable")
+    n = len(samples)
+    assert out.dtype == np.uint8 and out.ndim == 4 and out.shape[0] == n
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    # zero-copy: point straight into the (immutable, caller-held) bytes —
+    # a from_buffer_copy here would re-copy the whole compressed batch on
+    # every staging call
+    datas = (u8p * n)(*[_bytes_ptr(s, u8p) for s in samples])
+    lens = (ctypes.c_int64 * n)(*[len(s) for s in samples])
+    crop_p = None
+    if crops is not None:
+        crops = np.ascontiguousarray(crops, np.float32)
+        assert crops.shape == (n, 4)
+        crop_p = crops.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+    flip_p = None
+    if flips is not None:
+        flips = np.ascontiguousarray(flips, np.int32)
+        flip_p = flips.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    fails = lib.ptpu_decode_batch(
+        datas, lens, n, out.ctypes.data_as(u8p), out.shape[1],
+        crop_p, flip_p, max(1, int(threads)))
+    return int(fails)
