@@ -45,28 +45,32 @@ class Variable(Tensor):
 
 class OpRecord:
     """One recorded op: fn + which env slots feed it + which slots it fills
-    (OpDesc analog, framework.proto:43)."""
+    (OpDesc analog, framework.proto:43).
+
+    Slots are STABLE integers assigned at record time (r3 weak #7: the
+    env used to be keyed by ``id()`` of live tensors, which made program
+    transforms structurally awkward and forced keep-alives for
+    correctness).  ``in_slots[i] is None`` means input i is a late-bound
+    external constant — its ``_value`` is read at replay time from the
+    Tensor kept in ``inputs``."""
 
     __slots__ = ("name", "fn", "inputs", "kwargs", "out_tensors", "treedef",
-                 "single", "cast_to")
+                 "single", "cast_to", "in_slots", "out_slots")
 
     def __init__(self, name, fn, inputs, kwargs, out_tensors, treedef, single,
-                 cast_to):
+                 cast_to, in_slots, out_slots):
         self.name = name
         self.fn = fn
         self.inputs = inputs          # list of Tensor | raw value
         self.kwargs = kwargs
-        # the actual output Tensor objects are kept alive: env slots are keyed
-        # by id(), and a gc'd build-time tensor would let Python recycle its
-        # id into a colliding slot
+        # out tensors kept for fetch-by-name/identity resolution (the env
+        # itself no longer depends on their lifetime)
         self.out_tensors = out_tensors
         self.treedef = treedef
         self.single = single
         self.cast_to = cast_to
-
-    @property
-    def out_ids(self):
-        return [id(t) for t in self.out_tensors]
+        self.in_slots = in_slots      # per input: slot int | None
+        self.out_slots = out_slots    # per flat output: slot int
 
 
 class Program:
@@ -76,12 +80,30 @@ class Program:
         self.feed_vars: List[Variable] = []
         self.records: List[OpRecord] = []
         self.random_seed = 0
-        self._params: Dict[int, Parameter] = {}      # id -> Parameter
-        self._state_writeback = {}                   # id -> (tensor, setter)
-        self._state_updates: Dict[int, int] = {}     # state id -> new tensor id
-        self._param_updates: Dict[int, int] = {}     # param id -> new tensor id
+        # named-slot env (r3 weak #7): every program variable gets a
+        # stable int slot at record time; id() is only used as the
+        # BUILD-time lookup key from live tensor objects to their slots
+        self._slot_of: Dict[int, int] = {}
+        self._nslots = 0
+        self._params: Dict[int, Parameter] = {}      # slot -> Parameter
+        self._state_writeback = {}                   # slot -> (tensor, ...)
+        self._state_updates: Dict[int, int] = {}     # state slot -> new slot
+        self._param_updates: Dict[int, int] = {}     # param slot -> new slot
         self._version = 0
         self.builders = []  # legacy round-1 field kept for compat
+
+    def _slot(self, t) -> int:
+        """Slot of tensor `t`, assigning a fresh one on first sight."""
+        s = self._slot_of.get(id(t))
+        if s is None:
+            s = self._nslots
+            self._nslots += 1
+            self._slot_of[id(t)] = s
+        return s
+
+    def slot_of(self, t):
+        """Public: slot for a build-time tensor, or None (IR tooling)."""
+        return self._slot_of.get(id(t))
 
     # --- recording ---------------------------------------------------------
     def add_record(self, name, fn, args, kwargs, result, cast_to):
@@ -89,20 +111,36 @@ class Program:
             result, is_leaf=lambda x: isinstance(x, Tensor))
         single = isinstance(result, Tensor)
         inputs = list(args)
+        in_slots = []
         for a in inputs:
             if isinstance(a, Parameter):
-                self._params[id(a)] = a
+                self._params[self._slot(a)] = a
+            if isinstance(a, Tensor):
+                # slot EVERY tensor input eagerly: a later note_state()
+                # on it must link to the same slot these records read.
+                # Slots never written into the env (plain externals) fall
+                # back to the live a._value at replay.
+                in_slots.append(self._slot(a))
+            else:
+                in_slots.append(None)
+        out_slots = [self._slot(t) for t in flat]
         self.records.append(OpRecord(name, fn, inputs, dict(kwargs),
-                                     list(flat), treedef, single, cast_to))
+                                     list(flat), treedef, single, cast_to,
+                                     in_slots, out_slots))
         self._version += 1
 
     def note_param_update(self, param, new_tensor):
-        """Optimizer hook: after replay, env[new_tensor] is written back into
-        param (the static update-op, fluid/optimizer.py minimize analog)."""
-        self._params[id(param)] = param
-        self._param_updates[id(param)] = id(new_tensor)
-        self._kept = getattr(self, "_kept", [])
-        self._kept.append(new_tensor)  # keep alive: id() keys the env
+        """Optimizer hook: after replay, the new tensor's slot is written
+        back into param (the static update-op, fluid/optimizer.py minimize
+        analog)."""
+        pslot = self._slot(param)
+        new_slot = self._slot_of.get(id(new_tensor))
+        if new_slot is None:
+            raise ValueError(
+                "note_param_update: the updated tensor was not produced by "
+                "a recorded op")
+        self._params[pslot] = param
+        self._param_updates[pslot] = new_slot
         self._version += 1
 
     def note_state(self, tensor, setter=None, updated=None, refresh=None,
@@ -121,11 +159,15 @@ class Program:
           ("rng", None)       — PRNG key, refreshed per run
           ("lr", lr_or_sched) — learning rate from a float/LRScheduler
         """
-        self._state_writeback[id(tensor)] = (tensor, setter, refresh, spec)
+        tslot = self._slot(tensor)
+        self._state_writeback[tslot] = (tensor, setter, refresh, spec)
         if updated is not None:
-            self._state_updates[id(tensor)] = id(updated)
-            self._kept = getattr(self, "_kept", [])
-            self._kept.append(updated)
+            uslot = self._slot_of.get(id(updated))
+            if uslot is None:
+                raise ValueError(
+                    "note_state: the updated tensor was not produced by a "
+                    "recorded op")
+            self._state_updates[tslot] = uslot
         self._version += 1
 
     # --- introspection -----------------------------------------------------
@@ -146,27 +188,28 @@ class Program:
                 f"ops={len(self.records)})")
 
     # --- replay ------------------------------------------------------------
-    def _replay_fn(self, fetch_ids):
+    def _replay_fn(self, fetch_slots):
         """Build the pure replay function:
         (feed_arrays, param_arrays, state_arrays) -> (fetches, new_params,
-        new_states)."""
-        feed_ids = [id(v) for v in self.feed_vars]
+        new_states).  The env is a slot->value dict over the program's
+        stable integer slots."""
+        feed_slots = [self._slot(v) for v in self.feed_vars]
         param_items = sorted(self._params.items())
         state_items = sorted(self._state_writeback.items())
 
         def run(feed_vals, param_vals, state_vals):
             env: Dict[int, Any] = {}
-            for fid, val in zip(feed_ids, feed_vals):
-                env[fid] = val
-            for (pid, _), val in zip(param_items, param_vals):
-                env[pid] = val
-            for (sid, _), val in zip(state_items, state_vals):
-                env[sid] = val
+            for fs, val in zip(feed_slots, feed_vals):
+                env[fs] = val
+            for (ps, _), val in zip(param_items, param_vals):
+                env[ps] = val
+            for (ss, _), val in zip(state_items, state_vals):
+                env[ss] = val
             for rec in self.records:
                 call = []
-                for a in rec.inputs:
+                for a, slot in zip(rec.inputs, rec.in_slots):
                     if isinstance(a, Tensor):
-                        v = env.get(id(a), a._value)
+                        v = env.get(slot, a._value)
                         if rec.cast_to is not None and hasattr(v, "dtype") \
                                 and jnp.issubdtype(v.dtype, jnp.floating) \
                                 and v.dtype != rec.cast_to:
@@ -177,25 +220,34 @@ class Program:
                 out = rec.fn(*call, **rec.kwargs)
                 flat = [out] if rec.single else \
                     jax.tree_util.tree_flatten(out)[0]
-                for oid, val in zip(rec.out_ids, flat):
-                    env[oid] = val
-            fetches = [env[i] for i in fetch_ids]
-            new_params = [env.get(self._param_updates.get(pid, pid),
-                                  env.get(pid))
-                          for pid, _ in param_items]
-            new_states = [env.get(self._state_updates.get(sid, sid))
-                          for sid, _ in state_items]
+                for oslot, val in zip(rec.out_slots, flat):
+                    env[oslot] = val
+            fetches = [env[s] for s in fetch_slots]
+            new_params = [env.get(self._param_updates.get(ps, ps),
+                                  env.get(ps))
+                          for ps, _ in param_items]
+            new_states = [env.get(self._state_updates.get(ss, ss))
+                          for ss, _ in state_items]
             return fetches, new_params, new_states
 
         return run, param_items, state_items
+
+    def _fetch_slot(self, t):
+        """Resolve a fetch target (build-time tensor) to its slot."""
+        s = self._slot_of.get(id(t))
+        if s is None:
+            raise KeyError(
+                "fetch target was not produced by this program "
+                f"(known feeds: {[v.name for v in self.feed_vars]})")
+        return s
 
     # --- serialization (jax.export → StableHLO, framework.proto analog) ----
     def save(self, path, fetch_list):
         """Serialize the inference replay (feeds → fetches, params baked as
         inputs) + parameter values.  Reloadable in a fresh process without
         any model class via ``load_inference_program``."""
-        fetch_ids = [id(f) for f in fetch_list]
-        run, param_items, state_items = self._replay_fn(fetch_ids)
+        fetch_slots = [self._fetch_slot(f) for f in fetch_list]
+        run, param_items, state_items = self._replay_fn(fetch_slots)
 
         def infer(feed_vals, param_vals):
             fetches, _, _ = run(feed_vals, list(param_vals),
@@ -226,8 +278,8 @@ class Program:
 
         Artifacts: ``<path>.trainprogram`` (StableHLO of one train step) and
         ``<path>.trainstate`` (params, accumulators, step/LR/RNG specs)."""
-        fetch_ids = [id(f) for f in fetch_list]
-        run, param_items, state_items = self._replay_fn(fetch_ids)
+        fetch_slots = [self._fetch_slot(f) for f in fetch_list]
+        run, param_items, state_items = self._replay_fn(fetch_slots)
         specs = [spec for _, (_t, _s, _r, spec) in state_items]
 
         def train_step(feed_vals, param_vals, state_vals):
@@ -448,6 +500,7 @@ def data(name, shape, dtype="float32", lod_level=0):
     """Declare a feed placeholder (reference static/input.py data)."""
     v = Variable(shape, dtype, name)
     _default_main.feed_vars.append(v)
+    _default_main._slot(v)      # slot BEFORE any op consumes it
     return v
 
 
@@ -523,12 +576,13 @@ class Executor:
                     f"(known feeds: {[v.name for v in program.feed_vars]})")
             resolved.append(found)
         fetch_list = resolved
-        fetch_ids = tuple(id(f) for f in fetch_list)
-        sig = (id(program), program._version, fetch_ids,
+        fetch_slots = tuple(program._fetch_slot(f) for f in fetch_list)
+        sig = (id(program), program._version, fetch_slots,
                tuple((tuple(a.shape), str(a.dtype)) for a in feed_vals))
         entry = self._cache.get(sig)
         if entry is None:
-            run, param_items, state_items = program._replay_fn(list(fetch_ids))
+            run, param_items, state_items = program._replay_fn(
+                list(fetch_slots))
             jitted = jax.jit(run)
             entry = (jitted, param_items, state_items)
             self._cache[sig] = entry
