@@ -101,6 +101,18 @@ class Program:
             self._slot_of[id(t)] = s
         return s
 
+    def _require_slot(self, t, what: str) -> int:
+        """Slot of `t`, or a uniform error naming the context (shared by
+        note_param_update / note_state / fetch resolution)."""
+        s = self._slot_of.get(id(t))
+        if s is None:
+            raise KeyError(
+                f"{what}: tensor is unknown to this program "
+                f"(feeds: {[v.name for v in self.feed_vars]}; "
+                f"recorded outputs: "
+                f"{[t2.name for r in self.records for t2 in r.out_tensors if getattr(t2, 'name', None)][:10]})")
+        return s
+
     def slot_of(self, t):
         """Public: slot for a build-time tensor, or None (IR tooling)."""
         return self._slot_of.get(id(t))
@@ -134,11 +146,8 @@ class Program:
         back into param (the static update-op, fluid/optimizer.py minimize
         analog)."""
         pslot = self._slot(param)
-        new_slot = self._slot_of.get(id(new_tensor))
-        if new_slot is None:
-            raise ValueError(
-                "note_param_update: the updated tensor was not produced by "
-                "a recorded op")
+        new_slot = self._require_slot(
+            new_tensor, "note_param_update (updated tensor)")
         self._params[pslot] = param
         self._param_updates[pslot] = new_slot
         self._version += 1
@@ -162,12 +171,8 @@ class Program:
         tslot = self._slot(tensor)
         self._state_writeback[tslot] = (tensor, setter, refresh, spec)
         if updated is not None:
-            uslot = self._slot_of.get(id(updated))
-            if uslot is None:
-                raise ValueError(
-                    "note_state: the updated tensor was not produced by a "
-                    "recorded op")
-            self._state_updates[tslot] = uslot
+            self._state_updates[tslot] = self._require_slot(
+                updated, "note_state (updated tensor)")
         self._version += 1
 
     # --- introspection -----------------------------------------------------
@@ -232,13 +237,26 @@ class Program:
 
         return run, param_items, state_items
 
+    def _producible_slots(self):
+        """Slots the replay env actually fills: feeds, params, states and
+        record outputs — an external input has a slot but no env entry."""
+        out = {self._slot(v) for v in self.feed_vars}
+        out.update(self._params)
+        out.update(self._state_writeback)
+        for r in self.records:
+            out.update(r.out_slots)
+        return out
+
     def _fetch_slot(self, t):
-        """Resolve a fetch target (build-time tensor) to its slot."""
-        s = self._slot_of.get(id(t))
-        if s is None:
+        """Resolve a fetch target (build-time tensor) to its slot; the slot
+        must be one the replay env fills (a slotted EXTERNAL input would
+        otherwise KeyError mid-trace with no context)."""
+        s = self._require_slot(t, "fetch target")
+        if s not in self._producible_slots():
             raise KeyError(
-                "fetch target was not produced by this program "
-                f"(known feeds: {[v.name for v in self.feed_vars]})")
+                "fetch target is an external input of this program, not a "
+                "feed/parameter/state/op output — fetch its producer or "
+                "read its .numpy() directly")
         return s
 
     # --- serialization (jax.export → StableHLO, framework.proto analog) ----
