@@ -41,10 +41,15 @@ def _mode_from_strategy(strategy):
 
 
 def sparse_embedding(name: str, dim: int, rule: str = None, lr: float = None,
-                     strategy=None, **table_kw) -> SparseEmbedding:
+                     strategy=None, cache_rows: int = 0,
+                     **table_kw) -> SparseEmbedding:
     """Create or fetch the named embedding.  On fetch, any EXPLICITLY
     passed config (rule/lr) must match the original registration — a
-    silent mismatch would train with the wrong optimizer settings."""
+    silent mismatch would train with the wrong optimizer settings.
+
+    ``cache_rows > 0`` wraps the table in a DeviceCachedTable (heter_ps
+    analog): hot rows live in device HBM, the host/remote table serves
+    the tail — the right shape for zipf-skewed CTR vocabularies."""
     if name in _embeddings:
         emb = _embeddings[name]
         cm = emb.communicator
@@ -68,6 +73,10 @@ def sparse_embedding(name: str, dim: int, rule: str = None, lr: float = None,
                                       rule=rule or "sgd", **table_kw)
         else:
             table = SparseTable(dim, rule=rule or "sgd", **table_kw)
+        if cache_rows > 0:
+            from .device_cache import DeviceCachedTable
+
+            table = DeviceCachedTable(table, cache_rows=cache_rows)
         _tables[name] = table
     emb = SparseEmbedding(dim, table=table,
                           communicator=Communicator(

@@ -76,3 +76,38 @@ class TestDeviceCachedTable:
                                    plain.pull(probe, create=False),
                                    rtol=1e-5)
         assert c.hit_rate > 0.5
+
+
+class TestFleetCachedEmbedding:
+    def test_sparse_embedding_with_cache_trains(self):
+        """fleet.sparse_embedding(cache_rows=...) wires the heter_ps-style
+        cache under the normal embedding surface."""
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.distributed.ps import runtime as ps_runtime
+
+        ps_runtime.reset()
+        try:
+            paddle.seed(0)
+            emb = ps_runtime.sparse_embedding("cached_ctr", 8, rule="sgd",
+                                              lr=0.2, cache_rows=64)
+            head = nn.Linear(8, 1)
+            opt = optimizer.SGD(0.1, parameters=head.parameters())
+            rng = np.random.RandomState(0)
+            losses = []
+            for _ in range(30):
+                ids = np.minimum(rng.zipf(1.5, (8, 3)), 120).astype(np.int64)
+                y = (ids.min(axis=1, keepdims=True) < 10).astype(np.float32)
+                e = emb(paddle.to_tensor(ids)).sum(axis=1)
+                loss = F.binary_cross_entropy_with_logits(
+                    head(e), paddle.to_tensor(y))
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                emb.step()
+                losses.append(float(loss._value))
+            assert np.mean(losses[-5:]) < np.mean(losses[:5])
+            assert emb.table.hit_rate > 0.3
+        finally:
+            ps_runtime.reset()
