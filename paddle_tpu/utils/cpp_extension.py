@@ -1,0 +1,105 @@
+"""JIT-build toolchain for user native extensions.
+
+Reference: python/paddle/utils/cpp_extension/cpp_extension.py — setup
+(:50), CppExtension (:206), CUDAExtension (:256), load (:678): users
+compile C++ ops at import time and the framework loads them.
+
+TPU-native shape: the accelerator side of a custom op is Pallas (Python-
+authored, Mosaic-compiled — see utils/custom_op.register_op); what native
+user code still covers is HOST compute — data transforms, samplers,
+tokenizers — reached from ops via jax.pure_callback or called directly.
+``load`` compiles sources with the system toolchain into a cached shared
+library and returns a ctypes CDLL.  The in-tree csrc/ engines use the
+same mechanism (Makefile form)."""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import List, Optional, Sequence
+
+__all__ = ["CppExtension", "CUDAExtension", "load", "get_build_directory",
+           "setup"]
+
+
+def get_build_directory() -> str:
+    """Reference get_build_directory: env override or a home cache dir."""
+    d = os.environ.get("PADDLE_EXTENSION_DIR")
+    if not d:
+        d = os.path.join(os.path.expanduser("~"), ".cache",
+                         "paddle_tpu_extensions")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class CppExtension:
+    """Parity container (reference :206): named sources + flags, consumed
+    by setup()/load()."""
+
+    def __init__(self, sources: Sequence[str], *args, **kwargs):
+        self.sources = list(sources)
+        self.extra_compile_args = kwargs.get("extra_compile_args", [])
+        self.extra_link_args = kwargs.get("extra_link_args", [])
+
+
+def CUDAExtension(*args, **kwargs):
+    """The reference builds .cu kernels (reference :256); TPU kernels are
+    Pallas (Python-authored) — there is no CUDA toolchain here by design."""
+    raise NotImplementedError(
+        "CUDAExtension does not exist on TPU: write device kernels with "
+        "Pallas (paddle_tpu.utils.custom_op.register_op) and host native "
+        "code with CppExtension/load")
+
+
+def load(name: str, sources: Sequence[str],
+         extra_cxx_cflags: Optional[List[str]] = None,
+         extra_ldflags: Optional[List[str]] = None,
+         extra_include_paths: Optional[List[str]] = None,
+         build_directory: Optional[str] = None,
+         verbose: bool = False, **unused) -> ctypes.CDLL:
+    """Compile `sources` into <name>.so (content-hash cached) and return
+    the loaded library (reference load :678 — there it returns a python
+    module of registered ops; here the C ABI is the contract and ops are
+    registered explicitly via custom_op/pure_callback)."""
+    build_dir = build_directory or get_build_directory()
+    srcs = [os.path.abspath(s) for s in sources]
+    for s in srcs:
+        if not os.path.exists(s):
+            raise FileNotFoundError(s)
+    cflags = list(extra_cxx_cflags or [])
+    ldflags = list(extra_ldflags or [])
+    includes = [f"-I{p}" for p in (extra_include_paths or [])]
+    # cache key: source contents + flags (a rebuild on any change, reuse
+    # otherwise — the reference's version check analog)
+    h = hashlib.sha256()
+    for s in srcs:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(cflags + ldflags + includes).encode())
+    tag = h.hexdigest()[:16]
+    out = os.path.join(build_dir, f"{name}-{tag}.so")
+    if not os.path.exists(out):
+        cmd = (["g++", "-O3", "-std=c++17", "-fPIC", "-shared"]
+               + includes + cflags + ["-o", out] + srcs + ldflags)
+        if verbose:
+            print(" ".join(cmd))
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"cpp_extension build failed:\n{r.stderr}")
+    return ctypes.CDLL(out)
+
+
+def setup(name: str = None, ext_modules=None, **kwargs):
+    """Eager analog of the reference setup() (reference :50): builds every
+    extension NOW and returns the loaded libraries (no setuptools
+    lifecycle — the jit `load` path is the norm on TPU hosts)."""
+    exts = ext_modules or []
+    out = []
+    for i, ext in enumerate(exts):
+        ext_name = name or f"ext{i}"
+        out.append(load(ext_name, ext.sources,
+                        extra_cxx_cflags=ext.extra_compile_args,
+                        extra_ldflags=ext.extra_link_args))
+    return out

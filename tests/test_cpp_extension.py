@@ -1,0 +1,101 @@
+"""JIT-build toolchain for user native code (reference
+python/paddle/utils/cpp_extension — component #22's build half)."""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import cpp_extension
+
+SRC = r"""
+#include <cstdint>
+extern "C" {
+// toy host op: y = a*x + b over a float buffer
+void saxpb(const float* x, float* y, int64_t n, float a, float b) {
+  for (int64_t i = 0; i < n; ++i) y[i] = a * x[i] + b;
+}
+}
+"""
+
+
+@pytest.fixture
+def src_file(tmp_path):
+    p = tmp_path / "saxpb.cc"
+    p.write_text(SRC)
+    return str(p)
+
+
+class TestLoad:
+    def test_compile_load_call(self, src_file, tmp_path):
+        lib = cpp_extension.load("saxpb", [src_file],
+                                 build_directory=str(tmp_path))
+        lib.saxpb.argtypes = [ctypes.POINTER(ctypes.c_float),
+                              ctypes.POINTER(ctypes.c_float),
+                              ctypes.c_int64, ctypes.c_float,
+                              ctypes.c_float]
+        x = np.arange(5, dtype=np.float32)
+        y = np.empty_like(x)
+        lib.saxpb(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                  y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                  5, 2.0, 1.0)
+        np.testing.assert_allclose(y, 2 * x + 1)
+
+    def test_cache_reuses_artifact(self, src_file, tmp_path):
+        cpp_extension.load("c1", [src_file], build_directory=str(tmp_path))
+        sos = set(os.listdir(tmp_path))
+        cpp_extension.load("c1", [src_file], build_directory=str(tmp_path))
+        assert set(os.listdir(tmp_path)) == sos     # no rebuild
+
+    def test_build_error_surfaces(self, tmp_path):
+        bad = tmp_path / "bad.cc"
+        bad.write_text("this is not C++")
+        with pytest.raises(RuntimeError, match="build failed"):
+            cpp_extension.load("bad", [str(bad)],
+                               build_directory=str(tmp_path))
+
+    def test_cuda_extension_refuses(self):
+        with pytest.raises(NotImplementedError, match="Pallas"):
+            cpp_extension.CUDAExtension(sources=["x.cu"])
+
+    def test_host_op_through_pure_callback(self, src_file, tmp_path):
+        """The documented composition: native host code reached from a
+        registered op via jax.pure_callback, trained through dispatch."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.utils.custom_op import register_op, unregister_op
+
+        lib = cpp_extension.load("saxpb2", [src_file],
+                                 build_directory=str(tmp_path))
+        lib.saxpb.argtypes = [ctypes.POINTER(ctypes.c_float),
+                              ctypes.POINTER(ctypes.c_float),
+                              ctypes.c_int64, ctypes.c_float,
+                              ctypes.c_float]
+
+        def host_fn(xv):
+            xv = np.ascontiguousarray(xv, np.float32)
+            out = np.empty_like(xv)
+            lib.saxpb(xv.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                      out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                      xv.size, 3.0, 0.5)
+            return out.reshape(xv.shape)
+
+        def fwd(x):
+            return jax.pure_callback(
+                host_fn, jax.ShapeDtypeStruct(x.shape, jnp.float32), x)
+
+        register_op("saxpb_op", fwd,
+                    vjp=lambda g, x: (g * 3.0,))   # d/dx (3x+.5) = 3
+        try:
+            from paddle_tpu.utils.custom_op import get_op
+
+            op = get_op("saxpb_op")
+            x = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32))
+            x.stop_gradient = False
+            y = op(x)
+            np.testing.assert_allclose(y.numpy(), [3.5, 6.5])
+            y.sum().backward()
+            np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+        finally:
+            unregister_op("saxpb_op")
