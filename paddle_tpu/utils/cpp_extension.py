@@ -70,24 +70,42 @@ def load(name: str, sources: Sequence[str],
     cflags = list(extra_cxx_cflags or [])
     ldflags = list(extra_ldflags or [])
     includes = [f"-I{p}" for p in (extra_include_paths or [])]
-    # cache key: source contents + flags (a rebuild on any change, reuse
-    # otherwise — the reference's version check analog)
+    # cache key: source contents + the three flag lists kept DISTINCT
+    # (repr — '-lfoo' as a cflag vs ldflag must not collide) + any
+    # #included headers found under the include paths (editing a header
+    # must rebuild, the reference's version-check analog)
     h = hashlib.sha256()
     for s in srcs:
         with open(s, "rb") as f:
             h.update(f.read())
-    h.update(" ".join(cflags + ldflags + includes).encode())
+    for inc_dir in (extra_include_paths or []):
+        for root, _dirs, files in os.walk(inc_dir):
+            for fn in sorted(files):
+                if fn.endswith((".h", ".hpp", ".hh", ".cuh")):
+                    p = os.path.join(root, fn)
+                    h.update(p.encode())
+                    with open(p, "rb") as f:
+                        h.update(f.read())
+    h.update(repr((cflags, ldflags, includes)).encode())
     tag = h.hexdigest()[:16]
     out = os.path.join(build_dir, f"{name}-{tag}.so")
     if not os.path.exists(out):
+        # build to a temp path + atomic rename: a SIGKILLed or concurrent
+        # build must never leave a truncated .so that exists() then trusts
+        tmp = f"{out}.tmp.{os.getpid()}"
         cmd = (["g++", "-O3", "-std=c++17", "-fPIC", "-shared"]
-               + includes + cflags + ["-o", out] + srcs + ldflags)
+               + includes + cflags + ["-o", tmp] + srcs + ldflags)
         if verbose:
             print(" ".join(cmd))
         r = subprocess.run(cmd, capture_output=True, text=True)
         if r.returncode != 0:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
             raise RuntimeError(
                 f"cpp_extension build failed:\n{r.stderr}")
+        os.replace(tmp, out)
     return ctypes.CDLL(out)
 
 

@@ -43,10 +43,48 @@ class TestLoad:
         np.testing.assert_allclose(y, 2 * x + 1)
 
     def test_cache_reuses_artifact(self, src_file, tmp_path):
+        import subprocess as sp
+
         cpp_extension.load("c1", [src_file], build_directory=str(tmp_path))
-        sos = set(os.listdir(tmp_path))
-        cpp_extension.load("c1", [src_file], build_directory=str(tmp_path))
-        assert set(os.listdir(tmp_path)) == sos     # no rebuild
+        real_run = sp.run
+
+        def boom(*a, **k):
+            raise AssertionError("cache miss: compiler re-invoked")
+
+        sp.run = boom
+        try:
+            cpp_extension.load("c1", [src_file],
+                               build_directory=str(tmp_path))
+        finally:
+            sp.run = real_run
+
+    def test_flag_position_changes_cache_tag(self, src_file, tmp_path):
+        cpp_extension.load("c2", [src_file], build_directory=str(tmp_path),
+                           extra_cxx_cflags=["-DX=1"])
+        n1 = len(os.listdir(tmp_path))
+        # same token as an ldflag must NOT reuse the cflag artifact
+        cpp_extension.load("c2", [src_file], build_directory=str(tmp_path),
+                           extra_ldflags=["-DX=1"])
+        assert len(os.listdir(tmp_path)) == n1 + 1
+
+    def test_header_edit_rebuilds(self, tmp_path):
+        inc = tmp_path / "inc"
+        inc.mkdir()
+        (inc / "k.h").write_text("#define SCALE 2.0f\n")
+        src = tmp_path / "uses_header.cc"
+        src.write_text('#include "k.h"\nextern "C" float scale() '
+                       '{ return SCALE; }\n')
+        lib = cpp_extension.load("hdr", [str(src)],
+                                 build_directory=str(tmp_path / "b"),
+                                 extra_include_paths=[str(inc)])
+        lib.scale.restype = __import__("ctypes").c_float
+        assert lib.scale() == 2.0
+        (inc / "k.h").write_text("#define SCALE 3.0f\n")
+        lib2 = cpp_extension.load("hdr", [str(src)],
+                                  build_directory=str(tmp_path / "b"),
+                                  extra_include_paths=[str(inc)])
+        lib2.scale.restype = __import__("ctypes").c_float
+        assert lib2.scale() == 3.0          # header change -> rebuild
 
     def test_build_error_surfaces(self, tmp_path):
         bad = tmp_path / "bad.cc"
