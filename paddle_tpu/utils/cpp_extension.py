@@ -63,6 +63,7 @@ def load(name: str, sources: Sequence[str],
     module of registered ops; here the C ABI is the contract and ops are
     registered explicitly via custom_op/pure_callback)."""
     build_dir = build_directory or get_build_directory()
+    os.makedirs(build_dir, exist_ok=True)
     srcs = [os.path.abspath(s) for s in sources]
     for s in srcs:
         if not os.path.exists(s):
