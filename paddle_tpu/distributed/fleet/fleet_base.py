@@ -129,8 +129,22 @@ class Fleet:
             save(executor.state_dict(), dirname)
 
     def save_inference_model(self, executor, dirname, feeded_var_names,
-                             target_vars, main_program=None, export_for_deployment=True):
-        raise NotImplementedError("use paddle_tpu.jit.save for inference export")
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        """reference fleet_base.py:518 — export the inference slice of the
+        (static) program: feeds named by `feeded_var_names`, outputs
+        `target_vars`, params baked (serves via inference.Predictor)."""
+        from ...static import default_main_program
+
+        program = main_program or default_main_program()
+        names = set(feeded_var_names or [])
+        missing = names - {v.name for v in program.feed_vars}
+        if missing:
+            raise ValueError(
+                f"save_inference_model: feeds {sorted(missing)} are not "
+                "declared by the program")
+        program.save(dirname, list(target_vars))
+        return dirname
 
     @property
     def util(self):

@@ -265,3 +265,43 @@ class TestGeoAsyncTwoTrainersTwoServers:
         # rank 0 saw rank 1's rows on the servers after the final flush
         assert outs[0]["other_rows_nonzero"] is True
         assert outs[0]["table_size"] > 0
+
+
+class TestFleetSaveInferenceModel:
+    def test_static_export_roundtrip(self, tmp_path):
+        """fleet.save_inference_model (reference fleet_base.py:518) exports
+        the static program's inference slice; reloads via
+        load_inference_program."""
+        import paddle_tpu as paddle
+        from paddle_tpu import nn, static
+        from paddle_tpu.distributed import fleet
+
+        paddle.seed(0)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 4], "float32")
+            lin = nn.Linear(4, 3)
+            out = lin(x)
+        prefix = str(tmp_path / "fleet_export")
+        fleet.fleet.save_inference_model(None, prefix, ["x"], [out],
+                                         main_program=main)
+        loaded = static.load_inference_program(prefix)
+        xv = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        got, = loaded.run({"x": xv})
+        exe = static.Executor()
+        want, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_unknown_feed_rejected(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn, static
+        from paddle_tpu.distributed import fleet
+
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("inp", [2, 4], "float32")
+            out = nn.Linear(4, 2)(x)
+        with pytest.raises(ValueError, match="not declared"):
+            fleet.fleet.save_inference_model(
+                None, str(tmp_path / "e"), ["nope"], [out],
+                main_program=main)
