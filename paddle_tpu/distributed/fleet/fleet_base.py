@@ -153,7 +153,28 @@ class Fleet:
 
 class _UtilBase:
     def all_reduce(self, input, mode="sum"):
-        return input
+        """reference UtilBase.all_reduce (CPU-side, over Gloo): reduce a
+        host value across trainer processes.  World of one -> identity;
+        multi-process goes through the gloo backend (raises if absent —
+        a silent identity would skip synchronization, r4 collective
+        rule)."""
+        import numpy as np
+
+        from .. import gloo
+        from ..env import get_world_size
+
+        if get_world_size() <= 1:
+            return input
+        be = gloo.get_backend()
+        if be is None:
+            raise RuntimeError(
+                "fleet.util.all_reduce with PADDLE_TRAINERS_NUM > 1 needs "
+                "the gloo backend (init_parallel_env with "
+                "PADDLE_GLOO_ENDPOINT)")
+        arr = np.asarray(input)
+        out = be.all_reduce(arr, {"sum": "sum", "min": "min",
+                                  "max": "max"}[mode])
+        return out if arr.ndim else type(input)(out)
 
     def barrier(self):
         from ..collective import barrier
