@@ -523,15 +523,52 @@ def data(name, shape, dtype="float32", lod_level=0):
 
 
 class CompiledProgram:
-    """reference compiler.py:88 — a marker wrapper; XLA always compiles, and
-    data parallelism is a sharding of the same jitted replay."""
+    """reference compiler.py:88 — XLA always compiles; data parallelism is
+    a GSPMD sharding of the SAME jitted replay (the multi_devices_graph_
+    pass + ParallelExecutor pipeline collapses to in/out shardings)."""
 
     def __init__(self, program, build_strategy=None):
         self.program = program
+        self._dp = False
+        self._places = None
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, places=None):
+        """Mark the replay data-parallel: the Executor shards every feed's
+        BATCH (leading) dimension across the mesh's 'dp' axis (or all
+        devices when no mesh is installed) and lets GSPMD insert the
+        gradient/loss collectives — the reference's
+        ParallelExecutor-with-allreduce graph, expressed as shardings."""
+        self._dp = True
+        self._places = places
         return self
+
+    def _dp_mesh(self):
+        import numpy as _np
+
+        from ..distributed.mesh import get_mesh
+
+        mesh = get_mesh()
+        if mesh is not None and "dp" in mesh.axis_names:
+            return mesh
+        devs = self._places or jax.devices()
+        return jax.sharding.Mesh(_np.asarray(devs), ("dp",))
+
+    def feed_shardings(self, feed_vals):
+        """NamedShardings for the feeds: batch dim over 'dp', replicate
+        feeds whose leading dim doesn't divide (the reference pads or
+        errors; replication keeps them correct)."""
+        mesh = self._dp_mesh()
+        ndev = mesh.shape["dp"]
+        P = jax.sharding.PartitionSpec
+        out = []
+        for v in feed_vals:
+            if getattr(v, "ndim", 0) >= 1 and v.shape[0] % ndev == 0:
+                out.append(jax.sharding.NamedSharding(
+                    mesh, P("dp", *([None] * (v.ndim - 1)))))
+            else:
+                out.append(jax.sharding.NamedSharding(mesh, P()))
+        return out
 
 
 class Executor:
@@ -549,7 +586,9 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None, feed_var_names=None,
             return_numpy=True, scope=None, use_program_cache=True):
         program = program or default_main_program()
+        compiled = None
         if isinstance(program, CompiledProgram):
+            compiled = program
             program = program.program
         feed = feed or {}
         fetch_list = list(fetch_list or [])
@@ -572,6 +611,12 @@ class Executor:
             val = feed[v.name]
             arr = val.numpy() if isinstance(val, Tensor) else np.asarray(val)
             feed_vals.append(jnp.asarray(arr))
+        if compiled is not None and compiled._dp:
+            # data-parallel replay: feed batches sharded over 'dp'; GSPMD
+            # partitions the whole step and inserts the loss/grad
+            # collectives (ParallelExecutor + allreduce graph analog)
+            feed_vals = [jax.device_put(v, s) for v, s in
+                         zip(feed_vals, compiled.feed_shardings(feed_vals))]
 
         # resolve fetch-by-name (reference Executor accepts var names)
         resolved = []
