@@ -1,0 +1,153 @@
+"""Seq2seq integration: an encoder-decoder trained end-to-end, then
+decoded with greedy and beam search — the full capability the reference
+reaches with fluid seq2seq + BeamSearchDecoder (rnn.py:866), proving the
+decode stack on a REAL model rather than a toy transition table."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn, optimizer
+from paddle_tpu.nn.decode import beam_search_decode, greedy_search_decode
+
+VOCAB = 12          # 0=pad, 1=bos, 2=eos, 3..11 symbols
+BOS, EOS = 1, 2
+SEQ = 5
+HID = 48
+
+
+class CopyNet(nn.Layer):
+    """Encode a symbol sequence; decode it back (copy task)."""
+
+    def __init__(self):
+        super().__init__()
+        self.emb = nn.Embedding(VOCAB, HID)
+        self.enc = nn.GRUCell(HID, HID)
+        self.dec = nn.GRUCell(HID, HID)
+        self.proj = nn.Linear(HID, VOCAB)
+
+    def encode(self, src):
+        h = paddle.to_tensor(np.zeros((src.shape[0], HID), np.float32))
+        for t in range(src.shape[1]):
+            _, h = self.enc(self.emb(src[:, t]), h)
+        return h
+
+    def forward(self, src, tgt_in):
+        h = self.encode(src)
+        logits = []
+        for t in range(tgt_in.shape[1]):
+            out, h = self.dec(self.emb(tgt_in[:, t]), h)
+            logits.append(self.proj(out))
+        return paddle.stack(logits, axis=1)      # [B, T, V]
+
+
+def _batch(rng, n):
+    src = rng.randint(3, VOCAB, (n, SEQ)).astype(np.int64)
+    tgt_in = np.concatenate([np.full((n, 1), BOS, np.int64), src], 1)
+    tgt_out = np.concatenate([src, np.full((n, 1), EOS, np.int64)], 1)
+    return src, tgt_in, tgt_out
+
+
+@pytest.fixture(scope="module")
+def trained():
+    paddle.seed(3)
+    net = CopyNet()
+    opt = optimizer.Adam(5e-3, parameters=net.parameters())
+    rng = np.random.RandomState(0)
+    losses = []
+    for step in range(420):
+        src, tgt_in, tgt_out = _batch(rng, 32)
+        logits = net(paddle.to_tensor(src), paddle.to_tensor(tgt_in))
+        loss = F.cross_entropy(logits.reshape([-1, VOCAB]),
+                               paddle.to_tensor(tgt_out.reshape(-1)[:,
+                                                                   None]))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss._value))
+    assert losses[-1] < 0.3, losses[-1]     # the copy task is learned
+    return net
+
+
+def _step_fn(net):
+    """Single-step decoder form for the jittable beam decoder."""
+    from paddle_tpu.jit.functional import functional_call, get_state
+
+    params, buffers = get_state(net)
+
+    def step_fn(tokens, h):
+        def fwd(p, tok, hh):
+            out, _ = functional_call(
+                net, p, buffers, (tok, hh),
+                forward_fn=lambda t, s: net.proj(net.dec(net.emb(t),
+                                                         s)[1]))
+            return out
+
+        # functional_call routes params; the decoder cell returns (o, h)
+        # and we need BOTH logits and the new h — do it directly:
+        del fwd
+        emb_w = params["emb.weight"]
+        x = emb_w[tokens]
+        h_new = _gru(params, "dec.", x, h)
+        logits = h_new @ params["proj.weight"] + params["proj.bias"]
+        return logits, h_new
+
+    return step_fn
+
+
+def _gru(params, prefix, x, h):
+    w_ih = params[prefix + "weight_ih"]
+    w_hh = params[prefix + "weight_hh"]
+    b_ih = params.get(prefix + "bias_ih", 0)
+    b_hh = params.get(prefix + "bias_hh", 0)
+    gi = x @ w_ih.T + b_ih
+    gh = h @ w_hh.T + b_hh
+    ri, zi, ci = jnp.split(gi, 3, axis=-1)
+    rh, zh, ch = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ri + rh)
+    z = jax.nn.sigmoid(zi + zh)
+    c = jnp.tanh(ci + r * ch)
+    return (1 - z) * c + z * h
+
+
+def _encode_np(net, src):
+    h = paddle.to_tensor(np.zeros((src.shape[0], HID), np.float32))
+    for t in range(src.shape[1]):
+        _, h = net.enc(net.emb(paddle.to_tensor(src[:, t])), h)
+    return h._value
+
+
+class TestSeq2SeqDecode:
+    def test_greedy_reproduces_source(self, trained):
+        rng = np.random.RandomState(7)
+        src, _, _ = _batch(rng, 8)
+        h0 = _encode_np(trained, src)
+        ids, _ = greedy_search_decode(_step_fn(trained), h0,
+                                      batch_size=8, max_len=SEQ + 1,
+                                      bos_id=BOS, end_id=EOS)
+        ids = np.asarray(ids)
+        acc = (ids[:, :SEQ] == src).mean()
+        assert acc > 0.8, (acc, ids[:2], src[:2])
+
+    def test_beam_at_least_matches_greedy(self, trained):
+        rng = np.random.RandomState(8)
+        src, _, _ = _batch(rng, 6)
+        h0 = _encode_np(trained, src)
+        step_fn = _step_fn(trained)
+        _, greedy_score = greedy_search_decode(step_fn, h0, batch_size=6,
+                                               max_len=SEQ + 1,
+                                               bos_id=BOS, end_id=EOS)
+        K = 3
+        h0k = jnp.repeat(jnp.asarray(h0), K, axis=0)
+        res = beam_search_decode(step_fn, h0k, batch_size=6, beam_size=K,
+                                 max_len=SEQ + 1, bos_id=BOS, end_id=EOS)
+        # the best beam's cumulative log-prob >= greedy's (beam explores a
+        # superset of greedy's path)
+        assert (np.asarray(res.scores[:, 0])
+                >= np.asarray(greedy_score) - 1e-4).all()
+        # and the top beam still decodes the source
+        top = np.asarray(res.ids[:, 0, :SEQ])
+        assert (top == src).mean() > 0.8
