@@ -182,6 +182,33 @@ class InMemoryDataset(DatasetBase):
         perm = self._rng().permutation(len(self._records))
         self._records = [self._records[i] for i in perm]
 
+    def set_fea_eval(self, record_candidate_size: int, fea_eval: bool = True):
+        """fluid/dataset.py:113 — enable slots_shuffle (feature-importance
+        eval mode); candidate size bounds the shuffle pool."""
+        self._fea_eval = bool(fea_eval)
+        self._fea_candidate_size = int(record_candidate_size)
+
+    def slots_shuffle(self, slots):
+        """fluid/dataset.py:136 / data_set.h SlotsShuffle: permute the
+        VALUES of the named slots ACROSS records (labels and other slots
+        stay put) — evaluating a feature's importance by destroying its
+        alignment.  Requires set_fea_eval(..., True)."""
+        if not getattr(self, "_fea_eval", False):
+            raise RuntimeError(
+                "slots_shuffle requires set_fea_eval(record_candidate_size,"
+                " True) first (reference dataset.py:150)")
+        if not self._loaded:
+            raise RuntimeError("call load_into_memory() first")
+        rng = self._rng()
+        n = min(len(self._records),
+                getattr(self, "_fea_candidate_size", len(self._records)))
+        pool = list(range(n))
+        for slot_name in slots:
+            perm = rng.permutation(n)
+            vals = [self._records[i].slots[slot_name] for i in pool]
+            for dst, src in zip(pool, perm):
+                self._records[dst].slots[slot_name] = vals[src]
+
     def global_shuffle(self, fleet=None, thread_num: int = -1):
         """data_set.h:205 — shuffle records ACROSS trainers: every record is
         routed to a uniformly-random trainer (hash bucketing over the gloo

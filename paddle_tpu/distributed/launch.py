@@ -22,6 +22,9 @@ def _parse_args(argv=None):
     p.add_argument("--ips", type=str, default="127.0.0.1",
                    help="comma-separated node ips")
     p.add_argument("--started_port", type=int, default=36789)
+    p.add_argument("--gloo_port", type=int, default=0,
+                   help="rendezvous port for the host (gloo) collective "
+                        "backend; 0 = started_port + nproc_per_node")
     p.add_argument("--log_dir", type=str, default=None)
     p.add_argument("--node_rank", type=int,
                    default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
@@ -42,6 +45,8 @@ def start_local_trainers(args):
     procs = []
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
+    gloo_port = args.gloo_port or (args.started_port + nproc)
+    gloo_ep = f"{ips[0]}:{gloo_port}"
     for local_rank in range(nproc):
         rank = args.node_rank * nproc + local_rank
         env = dict(os.environ)
@@ -50,6 +55,9 @@ def start_local_trainers(args):
             "PADDLE_TRAINERS_NUM": str(world),
             "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
             "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            # host-side eager collectives (LocalSGD averaging, global
+            # shuffle, fleet.util) rendezvous here — rank 0 hosts
+            "PADDLE_GLOO_ENDPOINT": gloo_ep,
             "FLAGS_selected_tpus": str(local_rank),
         })
         log = (open(os.path.join(args.log_dir, f"workerlog.{local_rank}"), "w")

@@ -131,6 +131,30 @@ class TestInMemoryDataset:
         np.testing.assert_allclose(np.sort(after.ravel()),
                                    np.sort(before.ravel()))
 
+    def test_slots_shuffle_breaks_one_slot_only(self, tmp_path):
+        """reference dataset.py:136: shuffling a slot's values across
+        records destroys that feature's alignment, leaves others intact."""
+        paths = _gen_ctr_files(tmp_path, n_files=1, rows_per_file=20)
+        ds = InMemoryDataset()
+        ds.set_slots(SLOTS)
+        ds.set_filelist(paths)
+        ds.set_batch_size(20)
+        ds.load_into_memory()
+        before = next(iter(ds.iter_batches()))
+        with pytest.raises(RuntimeError, match="set_fea_eval"):
+            ds.slots_shuffle(["dense"])
+        ds.set_fea_eval(1000, True)
+        ds.set_shuffle_seed(4)
+        ds.slots_shuffle(["dense"])
+        after = next(iter(ds.iter_batches()))
+        # dense permuted across records (same multiset, new alignment)...
+        assert not np.array_equal(after["dense"], before["dense"])
+        np.testing.assert_allclose(np.sort(after["dense"].ravel()),
+                                   np.sort(before["dense"].ravel()))
+        # ...labels and the ids slot untouched
+        np.testing.assert_array_equal(after["label"], before["label"])
+        np.testing.assert_array_equal(after["ids"], before["ids"])
+
     def test_release_memory(self, tmp_path):
         paths = _gen_ctr_files(tmp_path, n_files=1, rows_per_file=5)
         ds = InMemoryDataset()

@@ -1,0 +1,54 @@
+"""`python -m paddle_tpu.distributed.launch` e2e (reference
+fleet/launch.py:334 + launch_utils env contract; r4: the launcher also
+provisions the gloo rendezvous for host collectives)."""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestLaunchCLI:
+    def test_two_proc_launch_env_and_gloo(self, tmp_path):
+        here = os.path.dirname(__file__)
+        repo = os.path.dirname(here)
+        env = dict(os.environ)
+        env.update({"LAUNCH_OUT_DIR": str(tmp_path),
+                    "PYTHONPATH": repo + os.pathsep +
+                    env.get("PYTHONPATH", "")})
+        env.pop("PADDLE_TRAINER_ENDPOINTS", None)
+        env.pop("PADDLE_TRAINERS_NUM", None)
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node=2", f"--started_port={_free_port()}",
+             f"--gloo_port={_free_port()}",
+             "--log_dir", str(tmp_path / "logs"),
+             os.path.join(here, "dist_launch_child.py")],
+            env=env, capture_output=True, text=True, timeout=300,
+            cwd=repo)
+        logs = ""
+        logdir = tmp_path / "logs"
+        if logdir.exists():
+            for f in sorted(logdir.iterdir()):
+                logs += f"\n--- {f.name}\n{f.read_text()[-2000:]}"
+        assert r.returncode == 0, f"{r.stdout}\n{r.stderr}\n{logs}"
+        outs = []
+        for rank in range(2):
+            with open(tmp_path / f"rank{rank}.json") as f:
+                outs.append(json.load(f))
+        assert [o["world"] for o in outs] == [2, 2]
+        # rank sum proves a REAL cross-process collective ran: 1 + 2
+        assert [o["sum"] for o in outs] == [3, 3]
+        # env contract: distinct endpoints, shared gloo rendezvous
+        assert outs[0]["endpoint"] != outs[1]["endpoint"]
+        assert outs[0]["gloo"] == outs[1]["gloo"]
