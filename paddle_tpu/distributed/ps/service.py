@@ -53,7 +53,7 @@ class PSServer:
     (server.h:64; start :80, stop :81)."""
 
     def __init__(self, endpoint: str, server_id: int = 0,
-                 num_servers: int = 1):
+                 num_servers: int = 1, dead_after: float = 60.0):
         self.endpoint = endpoint
         self.server_id = server_id
         self.num_servers = num_servers
@@ -62,6 +62,10 @@ class PSServer:
         self._lock = threading.Lock()
         self._stop_evt = threading.Event()
         self._srv: Optional[socket.socket] = None
+        # heartbeat monitor (heart_beat_monitor.cc analog): last-seen per
+        # client id; workers past `dead_after` report as dead in `health`
+        self.dead_after = dead_after
+        self._last_seen: Dict[str, float] = {}
 
     def start(self) -> int:
         """Bind + serve in background threads; returns the bound port."""
@@ -120,6 +124,18 @@ class PSServer:
 
     def _handle(self, req: dict) -> dict:
         op = req["op"]
+        client = req.get("client")
+        if client is not None:
+            with self._lock:
+                self._last_seen[client] = time.time()
+        if op == "health":
+            now = time.time()
+            with self._lock:
+                ages = {c: round(now - t, 3)
+                        for c, t in self._last_seen.items()}
+            return {"ok": True, "workers": ages,
+                    "dead": sorted(c for c, age in ages.items()
+                                   if age > self.dead_after)}
         if op == "create_table":
             spec = dict(req["spec"])
             kind = spec.pop("kind", "sparse")
@@ -204,11 +220,31 @@ class _ServerConn:
 class PSClient:
     """Client half (ps_client.h:60): batched pull/push routed id%n_servers."""
 
-    def __init__(self, server_endpoints: Sequence[str]):
+    def __init__(self, server_endpoints: Sequence[str],
+                 client_id: Optional[str] = None,
+                 heartbeat_interval: float = 0.0):
         if not server_endpoints:
             raise ValueError("PSClient needs at least one server endpoint")
         self._conns = [_ServerConn(ep) for ep in server_endpoints]
         self.num_servers = len(self._conns)
+        import os as _os
+
+        self.client_id = client_id or \
+            f"worker-{_os.environ.get('PADDLE_TRAINER_ID', _os.getpid())}"
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
+        if heartbeat_interval > 0:
+            # heart_beat_monitor analog: keep last-seen fresh even while
+            # the trainer is busy between pulls
+            def beat():
+                while not self._hb_stop.wait(heartbeat_interval):
+                    try:
+                        self.barrier_ping()
+                    except Exception:  # noqa: BLE001 — monitor only
+                        return
+
+            self._hb_thread = threading.Thread(target=beat, daemon=True)
+            self._hb_thread.start()
         # the reference client batches futures across servers
         # (ps_client.h pull_sparse); here: concurrent calls, one worker per
         # server, so a step's pull/push costs ~1 RTT instead of N
@@ -217,7 +253,10 @@ class PSClient:
             thread_name_prefix="ps-client") if self.num_servers > 1 else None
 
     def _fanout(self, requests):
-        """[(server_idx, req)] -> [resp] in order, issued concurrently."""
+        """[(server_idx, req)] -> [resp] in order, issued concurrently.
+        Every request carries the client id (heartbeat last-seen)."""
+        for _, r in requests:
+            r.setdefault("client", self.client_id)
         if self._pool is None or len(requests) <= 1:
             return [self._conns[s].call(r) for s, r in requests]
         futs = [self._pool.submit(self._conns[s].call, r)
@@ -265,12 +304,13 @@ class PSClient:
             for s in range(self.num_servers) if (srv == s).any()])
 
     def pull_dense(self, name: str) -> np.ndarray:
-        return self._conns[0].call({"op": "pull_dense",
-                                    "name": name})["values"]
+        return self._conns[0].call({"op": "pull_dense", "name": name,
+                                    "client": self.client_id})["values"]
 
     def push_dense(self, name: str, grad, lr=None) -> None:
         self._conns[0].call({"op": "push_dense", "name": name,
-                             "grad": np.asarray(grad), "lr": lr})
+                             "grad": np.asarray(grad), "lr": lr,
+                             "client": self.client_id})
 
     def save(self, name: str) -> dict:
         """Merged state across all server shards."""
@@ -297,12 +337,21 @@ class PSClient:
         self._fanout(reqs)
 
     def table_size(self, name: str) -> int:
-        return sum(c.call({"op": "size", "name": name})["size"]
+        return sum(c.call({"op": "size", "name": name,
+                           "client": self.client_id})["size"]
                    for c in self._conns)
 
     def barrier_ping(self) -> None:
         for c in self._conns:
-            c.call({"op": "ping"})
+            c.call({"op": "ping", "client": self.client_id})
+
+    def health(self) -> list:
+        """Per-server worker liveness (heart_beat_monitor analog):
+        [{'workers': {client: age_s}, 'dead': [...]}] per server."""
+        return [{k: r[k] for k in ("workers", "dead")}
+                for r in self._fanout(
+                    [(s, {"op": "health"})
+                     for s in range(self.num_servers)])]
 
     def stop_servers(self) -> None:
         for c in self._conns:
@@ -312,6 +361,9 @@ class PSClient:
                 pass
 
     def close(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
         if self._pool is not None:
             self._pool.shutdown(wait=False)
         for c in self._conns:

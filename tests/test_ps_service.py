@@ -305,3 +305,44 @@ class TestFleetSaveInferenceModel:
             fleet.fleet.save_inference_model(
                 None, str(tmp_path / "e"), ["nope"], [out],
                 main_program=main)
+
+
+class TestHeartbeatMonitor:
+    def test_health_tracks_and_flags_workers(self, cluster):
+        """heart_beat_monitor.cc analog: servers track per-client
+        last-seen; stale workers appear in `dead`."""
+        import time as _time
+
+        client, servers = cluster
+        client.barrier_ping()
+        h = client.health()
+        assert client.client_id in h[0]["workers"]
+        assert h[0]["dead"] == []
+        # shrink the liveness window: the worker goes stale
+        for s in servers:
+            s.dead_after = 0.05
+        _time.sleep(0.1)
+        h = client.health()          # the health call itself refreshes...
+        # ...so probe with a SECOND client that then stays silent
+        c2 = PSClient([s.endpoint for s in servers], client_id="lazy")
+        c2.barrier_ping()
+        _time.sleep(0.1)
+        h = client.health()
+        assert "lazy" in h[0]["dead"]
+        c2.close()
+
+    def test_background_heartbeat_keeps_alive(self, cluster):
+        import time as _time
+
+        client, servers = cluster
+        for s in servers:
+            s.dead_after = 0.3
+        hb = PSClient([s.endpoint for s in servers], client_id="beater",
+                      heartbeat_interval=0.05)
+        try:
+            _time.sleep(0.5)         # silent except for heartbeats
+            h = client.health()
+            assert "beater" not in h[0]["dead"]
+            assert h[0]["workers"]["beater"] < 0.3
+        finally:
+            hb.close()
