@@ -125,9 +125,19 @@ class PSServer:
     def _handle(self, req: dict) -> dict:
         op = req["op"]
         client = req.get("client")
-        if client is not None:
+        # a monitoring client must not register itself as a worker: only
+        # WORK ops refresh liveness (review r4 finding: a status-page
+        # poller would otherwise show up, then report "dead" on exit)
+        if client is not None and op not in ("health", "bye"):
             with self._lock:
                 self._last_seen[client] = time.time()
+        if op == "bye":
+            # clean worker shutdown: deregister so "dead" keeps meaning
+            # CRASHED (heart_beat_monitor distinguishes completed workers)
+            if client is not None:
+                with self._lock:
+                    self._last_seen.pop(client, None)
+            return {"ok": True}
         if op == "health":
             now = time.time()
             with self._lock:
@@ -235,13 +245,32 @@ class PSClient:
         self._hb_thread = None
         if heartbeat_interval > 0:
             # heart_beat_monitor analog: keep last-seen fresh even while
-            # the trainer is busy between pulls
+            # the trainer is busy between pulls.  weakref: the thread must
+            # not keep a dropped client alive; transient RPC failures are
+            # retried (one warning), not fatal — a single blip must not
+            # let a healthy worker go "dead".
+            import weakref
+
+            ref = weakref.ref(self)
+            stop = self._hb_stop
+
             def beat():
-                while not self._hb_stop.wait(heartbeat_interval):
-                    try:
-                        self.barrier_ping()
-                    except Exception:  # noqa: BLE001 — monitor only
+                import warnings
+
+                warned = False
+                while not stop.wait(heartbeat_interval):
+                    c = ref()
+                    if c is None:
                         return
+                    try:
+                        c.barrier_ping()
+                    except Exception as e:  # noqa: BLE001 — monitor only
+                        if not warned:
+                            warned = True
+                            warnings.warn(
+                                f"PS heartbeat ping failed ({e}); "
+                                "retrying", stacklevel=2)
+                    del c
 
             self._hb_thread = threading.Thread(target=beat, daemon=True)
             self._hb_thread.start()
@@ -252,13 +281,18 @@ class PSClient:
             max_workers=self.num_servers,
             thread_name_prefix="ps-client") if self.num_servers > 1 else None
 
+    def _call(self, server_idx: int, req: dict) -> dict:
+        """Single-server RPC; stamps the client id (heartbeat last-seen)
+        in ONE place so no call site can forget it."""
+        req.setdefault("client", self.client_id)
+        return self._conns[server_idx].call(req)
+
     def _fanout(self, requests):
-        """[(server_idx, req)] -> [resp] in order, issued concurrently.
-        Every request carries the client id (heartbeat last-seen)."""
+        """[(server_idx, req)] -> [resp] in order, issued concurrently."""
+        if self._pool is None or len(requests) <= 1:
+            return [self._call(s, r) for s, r in requests]
         for _, r in requests:
             r.setdefault("client", self.client_id)
-        if self._pool is None or len(requests) <= 1:
-            return [self._conns[s].call(r) for s, r in requests]
         futs = [self._pool.submit(self._conns[s].call, r)
                 for s, r in requests]
         return [f.result() for f in futs]
@@ -304,13 +338,12 @@ class PSClient:
             for s in range(self.num_servers) if (srv == s).any()])
 
     def pull_dense(self, name: str) -> np.ndarray:
-        return self._conns[0].call({"op": "pull_dense", "name": name,
-                                    "client": self.client_id})["values"]
+        return self._call(0, {"op": "pull_dense",
+                              "name": name})["values"]
 
     def push_dense(self, name: str, grad, lr=None) -> None:
-        self._conns[0].call({"op": "push_dense", "name": name,
-                             "grad": np.asarray(grad), "lr": lr,
-                             "client": self.client_id})
+        self._call(0, {"op": "push_dense", "name": name,
+                       "grad": np.asarray(grad), "lr": lr})
 
     def save(self, name: str) -> dict:
         """Merged state across all server shards."""
@@ -337,13 +370,12 @@ class PSClient:
         self._fanout(reqs)
 
     def table_size(self, name: str) -> int:
-        return sum(c.call({"op": "size", "name": name,
-                           "client": self.client_id})["size"]
-                   for c in self._conns)
+        return sum(self._call(s, {"op": "size", "name": name})["size"]
+                   for s in range(self.num_servers))
 
     def barrier_ping(self) -> None:
-        for c in self._conns:
-            c.call({"op": "ping", "client": self.client_id})
+        for s in range(self.num_servers):
+            self._call(s, {"op": "ping"})
 
     def health(self) -> list:
         """Per-server worker liveness (heart_beat_monitor analog):
@@ -361,6 +393,11 @@ class PSClient:
                 pass
 
     def close(self) -> None:
+        for s in range(self.num_servers):
+            try:
+                self._call(s, {"op": "bye"})
+            except Exception:  # noqa: BLE001 — best-effort deregister
+                pass
         self._hb_stop.set()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=2.0)

@@ -318,18 +318,21 @@ class TestHeartbeatMonitor:
         h = client.health()
         assert client.client_id in h[0]["workers"]
         assert h[0]["dead"] == []
-        # shrink the liveness window: the worker goes stale
+        # shrink the liveness window: the worker goes stale — and the
+        # health poll itself must NOT refresh it (review r4)
         for s in servers:
             s.dead_after = 0.05
-        _time.sleep(0.1)
-        h = client.health()          # the health call itself refreshes...
-        # ...so probe with a SECOND client that then stays silent
-        c2 = PSClient([s.endpoint for s in servers], client_id="lazy")
-        c2.barrier_ping()
-        _time.sleep(0.1)
+        _time.sleep(0.12)
         h = client.health()
-        assert "lazy" in h[0]["dead"]
+        assert client.client_id in h[0]["dead"]
+        # a clean shutdown DEREGISTERS: "dead" keeps meaning crashed
+        c2 = PSClient([s.endpoint for s in servers], client_id="done")
+        c2.barrier_ping()
         c2.close()
+        _time.sleep(0.12)
+        h = client.health()
+        assert "done" not in h[0]["workers"]
+        assert "done" not in h[0]["dead"]
 
     def test_background_heartbeat_keeps_alive(self, cluster):
         import time as _time
