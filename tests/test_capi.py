@@ -104,6 +104,53 @@ class TestCAPI:
         assert r.returncode == 1
         assert "new predictor failed" in r.stderr
 
+    def test_c_program_serves_quantized_model(self, tmp_path):
+        """Cross-feature proof: a slim-quantized (int8) conv model saved
+        via jit.save serves through the C ABI bit-identically to the
+        Python Predictor (the reference's capi + slim deployment
+        combination)."""
+        assert _build_lib()
+        from paddle_tpu import nn as _nn
+        from paddle_tpu.slim import quantize_for_inference
+
+        paddle.seed(5)
+        net = _nn.Sequential(_nn.Conv2D(1, 4, 3, padding=1), _nn.ReLU(),
+                             _nn.Flatten(), _nn.Linear(4 * 8 * 8, 4))
+        net.eval()
+        rng = np.random.RandomState(1)
+        calib = [paddle.to_tensor(rng.rand(1, 1, 8, 8).astype(np.float32))
+                 for _ in range(4)]
+        qnet = quantize_for_inference(net, calib, algo="abs_max")
+        prefix = str(tmp_path / "qconv")
+        jit.save(qnet, prefix,
+                 input_spec=[InputSpec([1, 1, 8, 8], "float32",
+                                       name="img")])
+
+        demo = str(tmp_path / "capi_q")
+        r = subprocess.run(
+            ["gcc", "-O2", "-o", demo,
+             os.path.join(CSRC, "capi_demo.c"),
+             f"-I{CSRC}", f"-L{CSRC}", "-lptpu_capi",
+             f"-Wl,-rpath,{CSRC}"],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        x = rng.rand(1, 1, 8, 8).astype(np.float32)
+        xbin = str(tmp_path / "xq.bin")
+        x.tofile(xbin)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.path.dirname(CSRC)
+        env["PD_CAPI_PLATFORM"] = "cpu"
+        r = subprocess.run([demo, prefix, xbin, "1", "1", "8", "8"],
+                           capture_output=True, text=True, env=env,
+                           timeout=300)
+        assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+        _, vals = r.stdout.strip().splitlines()[1].split(":")
+        got = np.asarray([float(v) for v in vals.split()], np.float32)
+        pred = inference.create_predictor(inference.Config(prefix))
+        want, = pred.run([x])
+        np.testing.assert_allclose(got, want.reshape(-1), rtol=1e-4,
+                                   atol=1e-5)
+
     @pytest.mark.skipif(shutil.which("go") is None,
                         reason="no Go toolchain in this image")
     def test_go_client_builds_and_runs(self, saved_lenet):
