@@ -128,7 +128,10 @@ def beam_search_decode(step_fn: Callable, init_state, batch_size: int,
             | (token == end_id)
         return (token, new_scores, finished, state), (token, parent)
 
-    tokens0 = jnp.full((B, K), bos_id, jnp.int32)
+    # bos_id: an int (shared start) or an array broadcastable to [B, K]
+    # (per-sequence starts — continuing from a prompt's last token)
+    tokens0 = jnp.broadcast_to(
+        jnp.asarray(bos_id, jnp.int32), (B, K)).astype(jnp.int32)
     # only beam 0 live at t=0 (identical beams would collapse the top-k)
     scores0 = jnp.tile(
         jnp.asarray([0.0] + [_NEG_INF] * (K - 1), jnp.float32)[None, :],
@@ -164,7 +167,8 @@ def greedy_search_decode(step_fn, init_state, batch_size: int,
         return (nxt, score, finished, state), nxt
 
     B = batch_size
-    init = (jnp.full((B,), bos_id, jnp.int32), jnp.zeros((B,)),
+    init = (jnp.broadcast_to(jnp.asarray(bos_id, jnp.int32),
+                             (B,)).astype(jnp.int32), jnp.zeros((B,)),
             jnp.zeros((B,), bool), init_state)
     (_, score, _, _), toks = jax.lax.scan(scan_body, init,
                                           jnp.arange(max_len))

@@ -3,6 +3,7 @@
 Zero-egress: datasets read local cache files or generate synthetic stand-ins.
 """
 from .datasets import Imdb, UCIHousing  # noqa: F401
+from .generation import generate, make_gpt_decode_step, prefill  # noqa: F401
 from .models import (  # noqa: F401
     BertForQuestionAnswering,
     BertForSequenceClassification,

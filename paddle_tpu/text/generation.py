@@ -1,0 +1,162 @@
+"""Incremental (KV-cache) decoding for the GPT flagship.
+
+Reference analog: the reference decodes seq2seq with BeamSearchDecoder +
+per-step Cache (nn/layer/transformer.py MultiHeadAttention.Cache /
+gen_cache — concat-grown, dynamic shapes).  TPU-native re-design: the
+cache is a FIXED [B, max_len, H, D] ring per layer written with one
+``.at[pos].set`` scatter per step; attention masks positions > pos.
+Everything is static-shaped, so the whole decode jits into one lax.scan
+(nn/decode.py) and the MXU sees batched [B*K] matmuls.
+
+The functional step math mirrors GPTModel.forward exactly — a parity
+test (tests/test_gpt_generation.py) pins incremental logits to the full
+forward's."""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..jit.functional import get_state
+
+__all__ = ["make_gpt_decode_step", "prefill", "generate"]
+
+
+def _ln(x, w, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, axis=-1, keepdims=True)
+    v = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - m) * jax.lax.rsqrt(v + eps)
+    return (out * w + b).astype(x.dtype)
+
+
+def _gelu(x):
+    # exact form (functional/activation.py gelu approximate=False)
+    from jax.scipy.stats import norm
+
+    return x * norm.cdf(x)
+
+
+def make_gpt_decode_step(model, max_len: int):
+    """Build (step_fn, init_state) for a GPTModel.
+
+    step_fn(tokens [N], state) -> (logits [N, vocab], state) — one decode
+    position per call, cache-backed; the state's leaves all have leading
+    dim N so nn.decode's beam reordering (s[parent]) works unchanged.
+    """
+    params, _ = get_state(model)
+    L = len(model.layers)
+    H = model.layers[0].attn.num_heads
+    hidden = model.wte.weight.shape[1]
+    D = hidden // H
+    scale = 1.0 / np.sqrt(D)
+    wte = params["wte.weight"]          # [V, hidden]
+    wpe = params["wpe.weight"]          # [max_pos, hidden]
+
+    def lp(i, name):
+        return params[f"layers.{i}.{name}"]
+
+    def init_state(batch: int):
+        z = jnp.zeros((batch, max_len, H, D), wte.dtype)
+        return {
+            "k": [z for _ in range(L)],
+            "v": [z for _ in range(L)],
+            # per-lane position: decode.py reorders every leaf by the
+            # parent beam via s[idx], so even this scalar-ish field rides
+            # with leading dim N
+            "pos": jnp.zeros((batch,), jnp.int32),
+        }
+
+    def step_fn(tokens, state):
+        pos = state["pos"]                                   # [N]
+        N = tokens.shape[0]
+        x = wte[tokens] + wpe[pos]                           # [N, hidden]
+        ks, vs = [], []
+        for i in range(L):
+            h = _ln(x, lp(i, "ln1.weight"), lp(i, "ln1.bias"))
+            q = (h @ lp(i, "attn.q_proj.weight")
+                 + lp(i, "attn.q_proj.bias")).reshape(N, H, D)
+            k1 = (h @ lp(i, "attn.k_proj.weight")
+                  + lp(i, "attn.k_proj.bias")).reshape(N, H, D)
+            v1 = (h @ lp(i, "attn.v_proj.weight")
+                  + lp(i, "attn.v_proj.bias")).reshape(N, H, D)
+            kc = state["k"][i].at[jnp.arange(N), pos].set(k1)
+            vc = state["v"][i].at[jnp.arange(N), pos].set(v1)
+            ks.append(kc)
+            vs.append(vc)
+            # attend over the cache's valid prefix (<= pos)
+            logits = jnp.einsum("nhd,nshd->nhs", q, kc) * scale
+            valid = (jnp.arange(max_len)[None, :]
+                     <= pos[:, None])[:, None, :]            # [N,1,S]
+            logits = jnp.where(valid, logits, -1e9)
+            probs = jax.nn.softmax(logits, axis=-1)
+            ctx = jnp.einsum("nhs,nshd->nhd", probs, vc).reshape(N, hidden)
+            x = x + (ctx @ lp(i, "attn.out_proj.weight")
+                     + lp(i, "attn.out_proj.bias"))
+            h2 = _ln(x, lp(i, "ln2.weight"), lp(i, "ln2.bias"))
+            ff = _gelu(h2 @ lp(i, "fc1.weight") + lp(i, "fc1.bias"))
+            x = x + ff @ lp(i, "fc2.weight") + lp(i, "fc2.bias")
+        x = _ln(x, params["ln_f.weight"], params["ln_f.bias"])
+        out = x @ wte.T                                      # tied head
+        return out, {"k": ks, "v": vs, "pos": pos + 1}
+
+    return step_fn, init_state
+
+
+def prefill(step_fn, state, prompt: jnp.ndarray):
+    """Feed the prompt through the cache (teacher-forced scan); returns
+    (state_after_prompt, logits_of_last_position [B, V])."""
+
+    def body(st, tok):
+        logits, st = step_fn(tok, st)
+        return st, logits
+
+    state, logits_seq = jax.lax.scan(body, state,
+                                     jnp.moveaxis(prompt, 1, 0))
+    return state, logits_seq[-1]
+
+
+def generate(model, input_ids, max_new_tokens: int = 32, end_id: int = 0,
+             decode_strategy: str = "greedy", num_beams: int = 4,
+             length_penalty: float = 0.0):
+    """GPTModel text generation (the serving decode path).
+
+    input_ids: [B, P] prompt (np/jnp int).  Returns [B, T] (greedy) or
+    [B, K, T] (beam_search) continuations, T = max_new_tokens."""
+    from ..nn.decode import beam_search_decode, greedy_search_decode
+    from ..tensor import Tensor
+
+    ids = input_ids._value if isinstance(input_ids, Tensor) \
+        else jnp.asarray(np.asarray(input_ids))
+    ids = ids.astype(jnp.int32)
+    B, P = ids.shape
+    max_len = P + max_new_tokens + 1
+    step_fn, init_state = make_gpt_decode_step(model, max_len)
+
+    if decode_strategy == "greedy":
+        state = init_state(B)
+        # prefill all but the last prompt token; the decode loop's first
+        # step consumes the last one and emits new token #1
+        if P > 1:
+            state, _ = prefill(step_fn, state, ids[:, :-1])
+        out_ids, scores = greedy_search_decode(
+            step_fn, state, batch_size=B, max_len=max_new_tokens,
+            bos_id=ids[:, -1], end_id=end_id)
+        return Tensor(out_ids), Tensor(scores)
+    if decode_strategy == "beam_search":
+        K = num_beams
+        state = init_state(B * K)
+        lanes = jnp.repeat(ids, K, axis=0)                   # [B*K, P]
+        if P > 1:
+            state, _ = prefill(step_fn, state, lanes[:, :-1])
+        res = beam_search_decode(
+            step_fn, state, batch_size=B, beam_size=K,
+            max_len=max_new_tokens,
+            bos_id=lanes[:, -1].reshape(B, K), end_id=end_id,
+            length_penalty=length_penalty)
+        return Tensor(res.ids), Tensor(res.scores)
+    raise ValueError(
+        f"decode_strategy must be 'greedy' or 'beam_search', "
+        f"got {decode_strategy!r}")

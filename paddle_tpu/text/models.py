@@ -179,3 +179,16 @@ class GPTModel(nn.Layer):
         x = self.ln_f(x)
         # weight-tied LM head
         return F.linear(x, self.wte.weight.t())
+
+    def generate(self, input_ids, max_new_tokens=32, end_id=0,
+                 decode_strategy="greedy", num_beams=4,
+                 length_penalty=0.0):
+        """KV-cache incremental decoding (text/generation.py — the
+        fixed-shape TPU redesign of the reference's Cache +
+        dynamic_decode serving path)."""
+        from .generation import generate as _generate
+
+        return _generate(self, input_ids, max_new_tokens=max_new_tokens,
+                         end_id=end_id, decode_strategy=decode_strategy,
+                         num_beams=num_beams,
+                         length_penalty=length_penalty)
