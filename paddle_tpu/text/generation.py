@@ -133,6 +133,13 @@ def generate(model, input_ids, max_new_tokens: int = 32, end_id: int = 0,
     ids = ids.astype(jnp.int32)
     B, P = ids.shape
     max_len = P + max_new_tokens + 1
+    max_pos = model.wpe.weight.shape[0]
+    if P + max_new_tokens > max_pos:
+        # past the wpe table the gather would silently clamp positions —
+        # degraded text with no error (review r4)
+        raise ValueError(
+            f"prompt ({P}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"the model's max_seq_len ({max_pos})")
     step_fn, init_state = make_gpt_decode_step(model, max_len)
 
     if decode_strategy == "greedy":
@@ -147,10 +154,15 @@ def generate(model, input_ids, max_new_tokens: int = 32, end_id: int = 0,
         return Tensor(out_ids), Tensor(scores)
     if decode_strategy == "beam_search":
         K = num_beams
-        state = init_state(B * K)
-        lanes = jnp.repeat(ids, K, axis=0)                   # [B*K, P]
+        # prefill ONCE per sequence (batch B), then expand the cache to
+        # the B*K beam lanes — K identical prompt forwards would be pure
+        # waste (review r4)
+        state_b = init_state(B)
         if P > 1:
-            state, _ = prefill(step_fn, state, lanes[:, :-1])
+            state_b, _ = prefill(step_fn, state_b, ids[:, :-1])
+        state = jax.tree_util.tree_map(
+            lambda s: jnp.repeat(s, K, axis=0), state_b)
+        lanes = jnp.repeat(ids, K, axis=0)                   # [B*K, P]
         res = beam_search_decode(
             step_fn, state, batch_size=B, beam_size=K,
             max_len=max_new_tokens,

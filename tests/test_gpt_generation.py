@@ -121,3 +121,10 @@ class TestGenerate:
 
         ids = run(prompt)
         assert ids.shape == (2, 8)
+
+
+class TestGenerateGuards:
+    def test_overlong_generation_rejected(self, gpt):
+        prompt = np.ones((1, 60), np.int32)      # max_seq_len=64
+        with pytest.raises(ValueError, match="max_seq_len"):
+            generate(gpt, prompt, max_new_tokens=10)
