@@ -134,11 +134,17 @@ def _loader_feed(batch):
     pipe = JpegPipeline(samples, labels, batch_size=batch, out_size=224,
                         train=True, num_threads=8, prefetch=2, seed=0)
 
+    on_cpu = jax.default_backend() == "cpu"
+
     def device_batch():
         imgs, lbls, release = pipe.next_batch()
+        if on_cpu:
+            # cpu-backend device_put can alias the numpy buffer zero-copy;
+            # the arena would then overwrite the "device" array on reuse.
+            imgs = imgs.copy()
         xb = jax.device_put(imgs)
         yb = jax.device_put(lbls.astype("int32"))
-        release()                 # device_put copied; recycle the buffer
+        release()                 # device data owned; recycle the buffer
         return xb, yb
 
     buf = [device_batch()]

@@ -199,6 +199,9 @@ int PD_PredictorRun(PD_Predictor* pred, const PD_Tensor* inputs,
   PyGILState_STATE gil = PyGILState_Ensure();
   int rc = -1;
   PyObject *np = nullptr, *arg_list = nullptr, *result = nullptr;
+  // Any failure below must not leave a previous run's tensors served by
+  // PD_GetOutputTensor as if they were this run's.
+  pred->outputs.clear();
   do {
     np = PyImport_ImportModule("numpy");
     if (!np) { set_py_error("import numpy"); break; }
@@ -230,15 +233,21 @@ int PD_PredictorRun(PD_Predictor* pred, const PD_Tensor* inputs,
     result = PyObject_CallMethod(pred->predictor, "run", "O", arg_list);
     if (!result) { set_py_error("Predictor.run"); break; }
     Py_ssize_t n_out = PySequence_Size(result);
-    pred->outputs.clear();
-    pred->outputs.resize(n_out);
+    if (n_out < 0) {  // non-sequence: report, don't throw across the C ABI
+      set_py_error("Predictor.run returned a non-sequence");
+      break;
+    }
+    // Convert into a local vector and swap in only on full success:
+    // a mid-loop failure must not leave PD_GetOutputTensor serving
+    // partially-built (empty-shape / garbage-dtype) tensors with rc 0.
+    std::vector<OwnedTensor> converted(n_out);
     for (Py_ssize_t i = 0; i < n_out; ++i) {
       PyObject* o = PySequence_GetItem(result, i);
       PyObject* arr = PyObject_CallMethod(
           np, "ascontiguousarray", "O", o);
       Py_XDECREF(o);
       if (!arr) { set_py_error("ascontiguousarray"); ok = false; break; }
-      OwnedTensor& ot = pred->outputs[i];
+      OwnedTensor& ot = converted[i];
       PyObject* dt = PyObject_GetAttrString(arr, "dtype");
       PyObject* dts = PyObject_Str(dt);
       std::string dtype_s = PyUnicode_AsUTF8(dts);
@@ -277,7 +286,8 @@ int PD_PredictorRun(PD_Predictor* pred, const PD_Tensor* inputs,
       if (i < static_cast<Py_ssize_t>(pred->output_names.size()))
         ot.name = pred->output_names[i];
     }
-    if (!ok) break;
+    if (!ok) break;  // outputs already cleared above
+    pred->outputs.swap(converted);
     rc = 0;
   } while (false);
   Py_XDECREF(result);

@@ -15,6 +15,7 @@ package paddle
 // #cgo CFLAGS: -I${SRCDIR}/../../csrc
 // #cgo LDFLAGS: -L${SRCDIR}/../../csrc -lptpu_capi
 // #include <stdlib.h>
+// #include <string.h>
 // #include "paddle_c_api.h"
 import "C"
 
@@ -87,43 +88,85 @@ func (p *Predictor) GetOutputName(i int) string {
 
 // Run feeds the inputs in declared order and returns all outputs
 // (reference ZeroCopyRun + get output tensors).
+//
+// All tensor descriptors, shape arrays, and input data are marshalled
+// into C-allocated memory: the PD_Tensor array itself crosses the cgo
+// boundary, so it must not contain Go pointers (cgo pointer-passing
+// rules — a Go-allocated struct holding &goSlice[0] trips the runtime's
+// cgocheck with "cgo argument has Go pointer to Go pointer").
 func (p *Predictor) Run(inputs []Tensor) ([]Tensor, error) {
-	cIn := make([]C.PD_Tensor, len(inputs))
-	keep := make([]unsafe.Pointer, 0, len(inputs)) // pin Go buffers
-	for i, t := range inputs {
-		shape := make([]C.int64_t, len(t.Shape))
-		for d, s := range t.Shape {
-			shape[d] = C.int64_t(s)
+	var cAllocs []unsafe.Pointer
+	defer func() {
+		for _, a := range cAllocs {
+			C.free(a)
 		}
-		var data unsafe.Pointer
-		switch t.Dtype {
-		case Float32:
-			if len(t.FloatData) == 0 {
-				return nil, fmt.Errorf("paddle: input %d has no data", i)
-			}
-			data = unsafe.Pointer(&t.FloatData[0])
-		default:
-			if len(t.RawData) == 0 {
-				return nil, fmt.Errorf("paddle: input %d has no data", i)
-			}
-			data = unsafe.Pointer(&t.RawData[0])
-		}
-		keep = append(keep, data)
-		cIn[i] = C.PD_Tensor{
-			dtype: C.PD_DataType(t.Dtype),
-			ndim:  C.int(len(t.Shape)),
-			shape: &shape[0],
-			data:  data,
-		}
+	}()
+	cmalloc := func(n int) unsafe.Pointer {
+		ptr := C.malloc(C.size_t(n))
+		cAllocs = append(cAllocs, ptr)
+		return ptr
 	}
+
 	var first *C.PD_Tensor
-	if len(cIn) > 0 {
+	if len(inputs) > 0 {
+		arr := cmalloc(len(inputs) * C.sizeof_PD_Tensor)
+		cIn := unsafe.Slice((*C.PD_Tensor)(arr), len(inputs))
+		for i, t := range inputs {
+			ndim := len(t.Shape)
+			if ndim == 0 {
+				ndim = 1 // scalar: keep a valid (unused) shape allocation
+			}
+			shapePtr := cmalloc(ndim * 8)
+			cshape := unsafe.Slice((*C.int64_t)(shapePtr), ndim)
+			for d, s := range t.Shape {
+				cshape[d] = C.int64_t(s)
+			}
+			count := int64(1)
+			for _, s := range t.Shape {
+				count *= s
+			}
+			var src unsafe.Pointer
+			var nbytes int
+			switch t.Dtype {
+			case Float32:
+				if len(t.FloatData) == 0 {
+					return nil, fmt.Errorf("paddle: input %d has no data", i)
+				}
+				src = unsafe.Pointer(&t.FloatData[0])
+				nbytes = len(t.FloatData) * 4
+			default:
+				if len(t.RawData) == 0 {
+					return nil, fmt.Errorf("paddle: input %d has no data", i)
+				}
+				src = unsafe.Pointer(&t.RawData[0])
+				nbytes = len(t.RawData)
+			}
+			// The C side reads product(shape)*itemsize bytes — a mismatch
+			// here would be a heap overread inside PD_PredictorRun.
+			itemsize := map[DataType]int{Float32: 4, Int32: 4, Int64: 8, Uint8: 1}[t.Dtype]
+			if int64(nbytes) != count*int64(itemsize) {
+				return nil, fmt.Errorf(
+					"paddle: input %d data length %d bytes != shape product %d x itemsize %d",
+					i, nbytes, count, itemsize)
+			}
+			// Copying into C memory (vs runtime.Pinner) keeps the cgo
+			// contract trivially correct; descriptors must live in C
+			// memory regardless.
+			dataPtr := cmalloc(nbytes)
+			C.memcpy(dataPtr, src, C.size_t(nbytes))
+			cIn[i] = C.PD_Tensor{
+				dtype: C.PD_DataType(t.Dtype),
+				ndim:  C.int(len(t.Shape)),
+				shape: (*C.int64_t)(shapePtr),
+				data:  dataPtr,
+			}
+		}
 		first = &cIn[0]
 	}
-	if C.PD_PredictorRun(p.c, first, C.int(len(cIn))) != 0 {
+	if C.PD_PredictorRun(p.c, first, C.int(len(inputs))) != 0 {
 		return nil, fmt.Errorf("paddle: run failed: %s", lastError())
 	}
-	runtime.KeepAlive(keep)
+	runtime.KeepAlive(inputs)
 
 	nOut := p.GetOutputNum()
 	outs := make([]Tensor, nOut)

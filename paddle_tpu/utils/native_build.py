@@ -1,0 +1,55 @@
+"""Shared build-freshness helper for the csrc ctypes bindings.
+
+The native engines (io/native_feed.py, vision/native_jpeg.py) delegate
+staleness to make — the Makefile targets depend on their sources, so a
+pre-existing .so never masks newer .cc.  Binaries are never committed.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Tuple
+
+CSRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+
+
+def so_path(name: str) -> str:
+    return os.path.join(CSRC_DIR, name)
+
+
+def ensure_built_for(mod, so: str, target: str, rebuild: bool = False) -> bool:
+    """Shared ensure_built body for the ctypes binding modules.
+
+    `mod` holds the per-library load state (`_tried` failed-load latch,
+    `_lib` handle, `_load()`).  A fresh build invalidates the latch — or
+    the just-built engine would be reported unavailable forever.
+    """
+    if rebuild:
+        mod._tried = False
+        mod._lib = None
+    changed, exists = make_fresh(so, target)
+    if not exists:
+        return False
+    if changed and mod._lib is None:
+        mod._tried = False
+    return mod._load() is not None
+
+
+def make_fresh(so_path: str, target: str,
+               timeout: float = 120.0) -> Tuple[bool, bool]:
+    """Run `make <target>` in csrc (mtime-aware: a no-op when fresh).
+
+    Returns (changed, exists): whether the .so mtime changed (a build
+    happened — any failed-load latch must be invalidated) and whether
+    the .so exists afterwards.  A make failure with a pre-existing .so
+    keeps the existing binary usable.
+    """
+    before = os.path.getmtime(so_path) if os.path.exists(so_path) else None
+    try:
+        subprocess.run(["make", "-C", CSRC_DIR, target],
+                       capture_output=True, timeout=timeout, check=True)
+    except Exception:
+        return False, before is not None
+    after = os.path.getmtime(so_path) if os.path.exists(so_path) else None
+    return after != before, after is not None

@@ -5,14 +5,14 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
+import sys
 from typing import Optional, Sequence
+
+from paddle_tpu.utils import native_build
 
 import numpy as np
 
-_CSRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))), "csrc")
-_SO_PATH = os.path.join(_CSRC_DIR, "libptpu_jpeg.so")
+_SO_PATH = native_build.so_path("libptpu_jpeg.so")
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
@@ -22,17 +22,8 @@ def ensure_built(rebuild: bool = False) -> bool:
     """Compile the native library if missing (explicit — a predicate like
     available() must not shell out to a compiler as a side effect).
     Returns availability."""
-    global _tried, _lib
-    if rebuild:
-        _tried = False
-        _lib = None
-    if not os.path.exists(_SO_PATH) or rebuild:
-        try:
-            subprocess.run(["make", "-C", _CSRC_DIR, "libptpu_jpeg.so"],
-                           capture_output=True, timeout=120, check=True)
-        except Exception:
-            return False
-    return _load() is not None
+    return native_build.ensure_built_for(
+        sys.modules[__name__], _SO_PATH, "libptpu_jpeg.so", rebuild)
 
 
 def _load() -> Optional[ctypes.CDLL]:
