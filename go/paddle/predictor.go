@@ -101,22 +101,31 @@ func (p *Predictor) Run(inputs []Tensor) ([]Tensor, error) {
 			C.free(a)
 		}
 	}()
-	cmalloc := func(n int) unsafe.Pointer {
+	cmalloc := func(n int) (unsafe.Pointer, error) {
 		ptr := C.malloc(C.size_t(n))
+		if ptr == nil {
+			return nil, fmt.Errorf("paddle: C.malloc(%d) failed", n)
+		}
 		cAllocs = append(cAllocs, ptr)
-		return ptr
+		return ptr, nil
 	}
 
 	var first *C.PD_Tensor
 	if len(inputs) > 0 {
-		arr := cmalloc(len(inputs) * C.sizeof_PD_Tensor)
+		arr, err := cmalloc(len(inputs) * C.sizeof_PD_Tensor)
+		if err != nil {
+			return nil, err
+		}
 		cIn := unsafe.Slice((*C.PD_Tensor)(arr), len(inputs))
 		for i, t := range inputs {
 			ndim := len(t.Shape)
 			if ndim == 0 {
 				ndim = 1 // scalar: keep a valid (unused) shape allocation
 			}
-			shapePtr := cmalloc(ndim * 8)
+			shapePtr, err := cmalloc(ndim * 8)
+			if err != nil {
+				return nil, err
+			}
 			cshape := unsafe.Slice((*C.int64_t)(shapePtr), ndim)
 			for d, s := range t.Shape {
 				cshape[d] = C.int64_t(s)
@@ -152,7 +161,10 @@ func (p *Predictor) Run(inputs []Tensor) ([]Tensor, error) {
 			// Copying into C memory (vs runtime.Pinner) keeps the cgo
 			// contract trivially correct; descriptors must live in C
 			// memory regardless.
-			dataPtr := cmalloc(nbytes)
+			dataPtr, err := cmalloc(nbytes)
+			if err != nil {
+				return nil, err
+			}
 			C.memcpy(dataPtr, src, C.size_t(nbytes))
 			cIn[i] = C.PD_Tensor{
 				dtype: C.PD_DataType(t.Dtype),

@@ -3,6 +3,7 @@
 Reference analog: paddle.jit (fluid/dygraph/jit.py) + dygraph_to_static.
 """
 from . import control_flow  # noqa: F401
+from . import dy2static  # noqa: F401
 from .functional import functional_call, get_state, tree_unwrap, tree_wrap  # noqa: F401
 from .to_static import InputSpec, StaticFunction, declarative, not_to_static, to_static  # noqa: F401
 from .save_load import load, save, TranslatedLayer  # noqa: F401

@@ -48,6 +48,17 @@ def _sig_of(args):
     return tuple(sig)
 
 
+_ARRAYLIKE = (Tensor, np.ndarray, jax.Array)
+
+
+def _array_positions(args):
+    """Indices of array-like args.  Everything else (Python scalars,
+    strings, None) is a compile-time STATIC — the signature cache already
+    keys on its repr, so passing it through jit would only turn concrete
+    values (loop bounds, flags) into tracers for no reuse benefit."""
+    return [i for i, a in enumerate(args) if isinstance(a, _ARRAYLIKE)]
+
+
 class StaticFunction:
     """Compiled callable over a Layer's forward or a free function."""
 
@@ -62,13 +73,16 @@ class StaticFunction:
     def concrete_programs(self):
         return list(self._cache.values())
 
-    def _compile_layer(self, sig, training):
+    def _compile_layer(self, sig, training, arr_idx, template):
         layer = self._layer
         fwd = self._fn
 
         def pure(key, params, buffers, *arr_args):
+            full = list(template)
+            for i, v in zip(arr_idx, arr_args):
+                full[i] = v
             with rng_scope(key):
-                out, new_bufs = functional_call(layer, params, buffers, arr_args,
+                out, new_bufs = functional_call(layer, params, buffers, full,
                                                 training=training,
                                                 forward_fn=fwd)
             return out, new_bufs
@@ -78,9 +92,14 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if self._layer is not None:
             training = self._layer.training
+            arr_idx = _array_positions(args)
             sig = (_sig_of(args), training)
             if sig not in self._cache:
-                self._cache[sig] = self._compile_layer(sig, training)
+                template = list(args)
+                for i in arr_idx:
+                    template[i] = None  # don't pin the first call's arrays
+                self._cache[sig] = self._compile_layer(
+                    sig, training, arr_idx, template)
             jitted = self._cache[sig]
             params, buffers = get_state(self._layer)
             key = next_rng_key()
@@ -99,7 +118,7 @@ class StaticFunction:
                 run._n_out = len(flat_out)
                 return tuple(flat_out) + tuple(flat_bufs)
 
-            tensor_args = [a for a in args]
+            tensor_args = [args[i] for i in arr_idx]
             all_args = [Tensor(key)] + [param_tensors[n] for n in param_names] + tensor_args
             results = apply("jit_program", run, *all_args)
             if not isinstance(results, tuple):
@@ -115,15 +134,23 @@ class StaticFunction:
             out = jax.tree_util.tree_unflatten(run._treedef, out_flat)
             return out
 
-        # free function: jit over unwrapped args
+        # free function: jit over unwrapped array args; other args are
+        # compile-time statics closed over per signature
+        arr_idx = _array_positions(args)
         sig = _sig_of(args)
         if sig not in self._cache:
             fn = self._fn
+            template = list(args)
+            for i in arr_idx:
+                template[i] = None  # don't pin the first call's arrays
 
             def pure(key, *arr_args):
+                full = list(template)
+                for i, v in zip(arr_idx, arr_args):
+                    full[i] = v
                 with rng_scope(key):
                     wrapped = [Tensor(a) if isinstance(a, jax.Array) else a
-                               for a in arr_args]
+                               for a in full]
                     from ..autograd.tape import no_grad
 
                     with no_grad():
@@ -140,22 +167,41 @@ class StaticFunction:
             run._treedef = treedef
             return tuple(flat)
 
-        results = apply("jit_function", run, Tensor(key), *args)
+        results = apply("jit_function", run, Tensor(key),
+                        *[args[i] for i in arr_idx])
         if not isinstance(results, tuple):
             results = (results,)
         return jax.tree_util.tree_unflatten(run._treedef, list(results))
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None):
-    """Decorator / wrapper converting dygraph callables to compiled ones."""
+    """Decorator / wrapper converting dygraph callables to compiled ones.
+
+    Before tracing, the callable goes through the dy2static AST pass
+    (jit/dy2static.py — reference program_translator.py:233): Python
+    ``if``/``while``/``for range()`` over tensor values is rewritten onto
+    lax.cond/while_loop converters; out-of-subset code is left as-is and
+    keeps the loud trace-time error."""
+    import inspect
+    import types
+
     from ..nn.layer import Layer
+    from .dy2static import convert_function
 
     def decorate(obj):
         if isinstance(obj, Layer):
-            static = StaticFunction(obj.forward, input_spec, layer=obj)
+            fwd = obj.forward
+            func = fwd.__func__ if inspect.ismethod(fwd) else fwd
+            conv, did = convert_function(func)
+            if did and inspect.ismethod(fwd):
+                fwd = types.MethodType(conv, obj)
+            elif did:
+                fwd = conv
+            static = StaticFunction(fwd, input_spec, layer=obj)
             obj.forward = static
             return obj
-        return StaticFunction(obj, input_spec)
+        conv, _ = convert_function(obj)
+        return StaticFunction(conv, input_spec)
 
     if function is not None:
         return decorate(function)

@@ -31,8 +31,12 @@ def ensure_built_for(mod, so: str, target: str, rebuild: bool = False) -> bool:
     changed, exists = make_fresh(so, target)
     if not exists:
         return False
-    if changed and mod._lib is None:
+    if changed:
+        # the rebuild produced a new file (new inode): drop the stale
+        # handle so _load dlopens the fresh code; the old handle leaks
+        # harmlessly for any caller still holding its functions
         mod._tried = False
+        mod._lib = None
     return mod._load() is not None
 
 

@@ -18,21 +18,31 @@ def _t(a):
 
 
 class TestTracedCoercionRaises:
-    def test_if_on_tensor_in_to_static_raises(self):
+    # NOTE r5: plain `if`/`while` over tensor values now CONVERT via the
+    # dy2static AST pass (tests/test_dy2static_ast.py).  The loud error
+    # remains the contract for out-of-subset code, exercised here.
+
+    def test_unconvertible_if_still_raises(self):
+        import types
+
         @jit.to_static
         def f(x):
-            if (x.sum() > 0):           # Python bool on a traced tensor
-                return x * 2.0
-            return -x
+            state = types.SimpleNamespace(v=0.0)
+            if (x.sum() > 0):
+                state.v = 1.0        # attribute store: out of the subset
+                x = x + state.v
+            return x
 
         with pytest.raises(TypeError, match="control_flow.cond"):
             f(_t([1.0, 2.0]))
 
-    def test_while_on_tensor_in_to_static_raises(self):
+    def test_unconvertible_while_still_raises(self):
         @jit.to_static
         def f(x):
             while (x.sum() < 10.0):
                 x = x + 1.0
+                if x.max() > 100.0:
+                    break            # owns a break: out of the subset
             return x
 
         with pytest.raises(TypeError, match="control_flow"):
