@@ -35,7 +35,10 @@ from .parallel_layers import (  # noqa: F401
     RowParallelLinear,
     VocabParallelEmbedding,
 )
-from .pipeline import pipeline_apply, pipeline_forward, stack_stage_params  # noqa: F401
+from .pipeline import (pipeline_apply, pipeline_forward,  # noqa: F401
+                       pipeline_train_1f1b, pipeline_train_step,
+                       build_1f1b_schedule, schedule_peak_in_flight,
+                       stack_stage_params)
 from .ring_attention import (  # noqa: F401
     ring_attention,
     sequence_parallel_attention,
