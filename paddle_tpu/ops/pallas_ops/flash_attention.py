@@ -133,9 +133,9 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
         lse_ref[0] = m_sc[:, :1] + jnp.log(l_safe)
 
 
-def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    mask_ref, dk_ref, dv_ref, dk_sc, dv_sc, *, scale, causal,
-                    dropout_p, block_q, block_k, nq):
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, mask_ref, dk_ref, dv_ref, dk_sc, dv_sc, *,
+                    scale, causal, dropout_p, block_q, block_k, nq):
     """Grid (BH, nk, nq): fixed KV block, stream q/do blocks, accumulate
     dk/dv in VMEM scratch."""
     b = pl.program_id(0)
@@ -190,9 +190,9 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   mask_ref, dq_ref, dq_sc, *, scale, causal, dropout_p,
-                   block_q, block_k, nk):
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, mask_ref, dq_ref, dq_sc, *, scale, causal,
+                   dropout_p, block_q, block_k, nk):
     """Grid (BH, nq, nk): fixed q block, stream KV blocks, accumulate dq."""
     b = pl.program_id(0)
     qi = pl.program_id(1)
@@ -251,6 +251,18 @@ def _compiler_params():
         return None
 
 
+def _sds(shape, dtype, ref):
+    """ShapeDtypeStruct inheriting `ref`'s shard_map varying axes (vma) —
+    required when the kernel runs inside shard_map (ring attention)."""
+    vma = getattr(jax.typeof(ref), "vma", None)
+    if vma:
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        except TypeError:  # older jax without vma kwarg
+            pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _flash_fwd_bhsd(q, k, v, mask, seed, scale, causal, dropout_p,
                     block_q, block_k):
     B, H, S, D = q.shape
@@ -280,8 +292,8 @@ def _flash_fwd_bhsd(q, k, v, mask, seed, scale, causal, dropout_p,
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, S, 1), jnp.float32),
+            _sds((B * H, S, D), q.dtype, q3),
+            _sds((B * H, S, 1), jnp.float32, q3),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
@@ -294,23 +306,22 @@ def _flash_fwd_bhsd(q, k, v, mask, seed, scale, causal, dropout_p,
     return out.reshape(B, H, S, D), lse
 
 
-def _flash_bwd_bhsd(q, k, v, o, lse, g, mask, seed, scale, causal, dropout_p,
-                    block_q, block_k):
-    B, H, S, D = q.shape
-    q3 = q.reshape(B * H, S, D)
-    k3 = k.reshape(B * H, S, D)
-    v3 = v.reshape(B * H, S, D)
-    g3 = g.reshape(B * H, S, D)
-    # delta = rowsum(dO ⊙ O): O(S·D), precomputed once in XLA
-    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1).reshape(B * H, S, 1)
-
-    nq, nk = S // block_q, S // block_k
-    common = dict(scale=scale, causal=causal, dropout_p=dropout_p,
-                  block_q=block_q, block_k=block_k)
-
+def _flash_dkv_bhsd(q, k, v, g, lse, delta, mask, seed, scale, causal,
+                    dropout_p, block_q, block_k):
+    """dk/dv for one (q-block set, kv chunk) pair.  lse/delta are the
+    GLOBAL per-row stats of the visiting queries — summing chunk results
+    over all visiting q sets gives the exact global dk/dv."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    q3 = q.reshape(B * H, Sq, D)
+    k3 = k.reshape(B * H, Sk, D)
+    v3 = v.reshape(B * H, Sk, D)
+    g3 = g.reshape(B * H, Sq, D)
+    nq, nk = Sq // block_q, Sk // block_k
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, nq=nq, **common),
+        functools.partial(_bwd_dkv_kernel, nq=nq, scale=scale, causal=causal,
+                          dropout_p=dropout_p, block_q=block_q,
+                          block_k=block_k),
         grid=(B * H, nk, nq),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -327,8 +338,8 @@ def _flash_bwd_bhsd(q, k, v, o, lse, g, mask, seed, scale, causal, dropout_p,
             pl.BlockSpec((1, block_k, D), lambda b, jj, ii: (b, jj, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
-            jax.ShapeDtypeStruct((B * H, S, D), v.dtype),
+            _sds((B * H, Sk, D), k.dtype, k3),
+            _sds((B * H, Sk, D), v.dtype, k3),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
@@ -337,9 +348,23 @@ def _flash_bwd_bhsd(q, k, v, o, lse, g, mask, seed, scale, causal, dropout_p,
         compiler_params=_compiler_params(),
         interpret=_interpret_mode(),
     )(seed, q3, k3, v3, g3, lse, delta, mask)
+    return dk.reshape(B, H, Sk, D), dv.reshape(B, H, Sk, D)
 
+
+def _flash_dq_bhsd(q, k, v, g, lse, delta, mask, seed, scale, causal,
+                   dropout_p, block_q, block_k):
+    """dq for the local queries against one kv chunk (global lse/delta)."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    q3 = q.reshape(B * H, Sq, D)
+    k3 = k.reshape(B * H, Sk, D)
+    v3 = v.reshape(B * H, Sk, D)
+    g3 = g.reshape(B * H, Sq, D)
+    nq, nk = Sq // block_q, Sk // block_k
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, nk=nk, **common),
+        functools.partial(_bwd_dq_kernel, nk=nk, scale=scale, causal=causal,
+                          dropout_p=dropout_p, block_q=block_q,
+                          block_k=block_k),
         grid=(B * H, nq, nk),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -354,14 +379,25 @@ def _flash_bwd_bhsd(q, k, v, o, lse, g, mask, seed, scale, causal, dropout_p,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
         ],
-        out_shape=[jax.ShapeDtypeStruct((B * H, S, D), q.dtype)],
+        out_shape=[_sds((B * H, Sq, D), q.dtype, q3)],
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         compiler_params=_compiler_params(),
         interpret=_interpret_mode(),
     )(seed, q3, k3, v3, g3, lse, delta, mask)[0]
+    return dq.reshape(B, H, Sq, D)
 
-    return (dq.reshape(B, H, S, D), dk.reshape(B, H, S, D),
-            dv.reshape(B, H, S, D))
+
+def _flash_bwd_bhsd(q, k, v, o, lse, g, mask, seed, scale, causal, dropout_p,
+                    block_q, block_k):
+    B, H, S, D = q.shape
+    # delta = rowsum(dO ⊙ O): O(S·D), precomputed once in XLA
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1).reshape(B * H, S, 1)
+    dk, dv = _flash_dkv_bhsd(q, k, v, g, lse, delta, mask, seed, scale,
+                             causal, dropout_p, block_q, block_k)
+    dq = _flash_dq_bhsd(q, k, v, g, lse, delta, mask, seed, scale, causal,
+                        dropout_p, block_q, block_k)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))

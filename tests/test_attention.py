@@ -322,3 +322,106 @@ class TestFlashRouting:
             self._without()
         np.testing.assert_allclose(seq_flash.numpy(), seq_xla.numpy(),
                                    rtol=5e-3, atol=5e-3)
+
+
+class TestRingFlash:
+    """Ring attention routed through the Pallas flash kernel (VERDICT r4
+    next-round #3): per-chunk flash fwd with lse merged across ring steps,
+    custom backward through the flash dq/dkv kernels — no S_local×S_local
+    score matrix at any point."""
+
+    def _run(self, S, causal, seed=0):
+        from paddle_tpu.distributed import init_mesh
+        from paddle_tpu.distributed.ring_attention import (
+            sequence_parallel_attention)
+
+        init_mesh({"sp": 4})
+        q, k, v = make_qkv(B=1, S=S, H=2, D=32, seed=seed)
+        out = sequence_parallel_attention(q, k, v, axis_name="sp",
+                                          causal=causal)
+        ref = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_flash_path_engaged(self, monkeypatch):
+        # S_local = 512/4 = 128: kernel-shaped -> must route to the flash
+        # ring, not the einsum fallback
+        import importlib
+
+        # the package re-exports the ring_attention FUNCTION; get the module
+        ra = importlib.import_module(
+            "paddle_tpu.distributed.ring_attention")
+
+        calls = {"flash": 0, "naive": 0}
+        real_flash = ra._ring_attention_flash
+        real_naive = ra._ring_attention_naive
+
+        def spy_flash(*a, **kw):
+            calls["flash"] += 1
+            return real_flash(*a, **kw)
+
+        def spy_naive(*a, **kw):
+            calls["naive"] += 1
+            return real_naive(*a, **kw)
+
+        monkeypatch.setattr(ra, "_ring_attention_flash", spy_flash)
+        monkeypatch.setattr(ra, "_ring_attention_naive", spy_naive)
+        self._run(512, causal=False)
+        assert calls["flash"] >= 1 and calls["naive"] == 0
+        # short shards keep the fallback
+        self._run(128, causal=False)  # S_local = 32
+        assert calls["naive"] >= 1
+
+    def test_flash_causal_matches(self):
+        self._run(512, causal=True, seed=7)
+
+    def test_flash_grads_match_reference(self):
+        from paddle_tpu.distributed import init_mesh
+        from paddle_tpu.distributed.ring_attention import (
+            sequence_parallel_attention)
+
+        init_mesh({"sp": 4})
+        q, k, v = make_qkv(B=1, S=512, H=2, D=32, seed=11)
+
+        def loss_ring(q, k, v):
+            o = sequence_parallel_attention(q, k, v, axis_name="sp",
+                                            causal=True)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        def loss_ref(q, k, v):
+            o = reference_attention(q, k, v, causal=True)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, r in zip(g_ring, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                       rtol=5e-3, atol=5e-3)
+
+    def test_no_quadratic_score_buffer(self):
+        """Peak temp memory must stay (near-)flat in S_local per ring
+        step: the compiled HLO may not allocate an S_local×S_local f32
+        score matrix (the kernel streams KV blocks instead)."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from paddle_tpu.distributed import init_mesh
+        from paddle_tpu.distributed.ring_attention import ring_attention
+
+        mesh = init_mesh({"sp": 4})
+        spec = P(None, "sp", None, None)
+
+        def temp_bytes(S):
+            q, k, v = make_qkv(B=1, S=S, H=1, D=64, seed=1)
+            fn = shard_map(
+                lambda a, b, c: ring_attention(a, b, c, "sp", causal=True),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+            lowered = jax.jit(fn).lower(q, k, v)
+            compiled = lowered.compile()
+            ma = compiled.memory_analysis()
+            return int(getattr(ma, "temp_size_in_bytes", 0))
+
+        t1 = temp_bytes(2048)    # S_local 512
+        t2 = temp_bytes(4096)    # S_local 1024
+        # quadratic would be 4x; linear (plus constants) stays under ~2.6x
+        assert t2 <= t1 * 2.6 + (1 << 20), (t1, t2)
