@@ -254,7 +254,8 @@ def _compiler_params():
 def _sds(shape, dtype, ref):
     """ShapeDtypeStruct inheriting `ref`'s shard_map varying axes (vma) —
     required when the kernel runs inside shard_map (ring attention)."""
-    vma = getattr(jax.typeof(ref), "vma", None)
+    typeof = getattr(jax, "typeof", None)
+    vma = getattr(typeof(ref), "vma", None) if typeof is not None else None
     if vma:
         try:
             return jax.ShapeDtypeStruct(shape, dtype, vma=vma)

@@ -2,7 +2,8 @@
 
 Zero-egress: datasets read local cache files or generate synthetic stand-ins.
 """
-from .datasets import Imdb, UCIHousing  # noqa: F401
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens,  # noqa: F401
+                       UCIHousing, WMT14, WMT16)
 from .generation import generate, make_gpt_decode_step, prefill  # noqa: F401
 from .models import (  # noqa: F401
     BertForQuestionAnswering,

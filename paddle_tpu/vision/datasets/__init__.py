@@ -9,3 +9,5 @@ shapes/classes, so training pipelines and tests run anywhere.
 from .mnist import MNIST, FashionMNIST  # noqa: F401
 from .cifar import Cifar10, Cifar100  # noqa: F401
 from .flowers import Flowers  # noqa: F401
+from .voc2012 import VOC2012  # noqa: F401
+from .folder import DatasetFolder, ImageFolder  # noqa: F401

@@ -1,0 +1,134 @@
+"""DatasetFolder / ImageFolder (reference: vision/datasets/folder.py —
+class-per-subdirectory layout and flat image-list layout)."""
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+
+def has_valid_extension(filename: str, extensions=IMG_EXTENSIONS) -> bool:
+    """reference folder.py:36 is_valid_file check."""
+    return filename.lower().endswith(tuple(extensions))
+
+
+def _pil_loader(path):
+    from PIL import Image
+
+    with open(path, "rb") as f:
+        img = Image.open(f)
+        return img.convert("RGB")
+
+
+def default_loader(path):
+    """PIL loader returning an RGB numpy array (cv2 is not a dependency
+    here; the reference prefers cv2 when its backend flag says so)."""
+    return np.asarray(_pil_loader(path))
+
+
+def make_dataset(directory, class_to_idx, extensions=IMG_EXTENSIONS,
+                 is_valid_file: Optional[Callable] = None) -> List[Tuple]:
+    """Walk `directory`/<class>/**, collecting (path, class_index)
+    (reference folder.py:49 make_dataset)."""
+    if is_valid_file is None:
+        def is_valid_file(p):
+            return has_valid_extension(p, extensions)
+    samples = []
+    for target in sorted(class_to_idx.keys()):
+        d = os.path.join(directory, target)
+        if not os.path.isdir(d):
+            continue
+        for root, _, fnames in sorted(os.walk(d, followlinks=True)):
+            for fname in sorted(fnames):
+                path = os.path.join(root, fname)
+                if is_valid_file(path):
+                    samples.append((path, class_to_idx[target]))
+    return samples
+
+
+class DatasetFolder(Dataset):
+    """Generic class-per-subdirectory image dataset:
+
+        root/class_a/xxx.png
+        root/class_b/yyy.png
+
+    Reference: vision/datasets/folder.py:62 (classes, class_to_idx,
+    samples; __getitem__ -> (sample, target))."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or default_loader
+        self.extensions = tuple(extensions or IMG_EXTENSIONS)
+        classes, class_to_idx = self._find_classes(root)
+        samples = make_dataset(root, class_to_idx, self.extensions,
+                               is_valid_file)
+        if not samples:
+            raise RuntimeError(
+                f"Found 0 files in subfolders of {root} with extensions "
+                f"{','.join(self.extensions)}")
+        self.classes = classes
+        self.class_to_idx = class_to_idx
+        self.samples = samples
+        self.targets = [s[1] for s in samples]
+
+    @staticmethod
+    def _find_classes(directory):
+        classes = sorted(e.name for e in os.scandir(directory) if e.is_dir())
+        return classes, {c: i for i, c in enumerate(classes)}
+
+    def __getitem__(self, index):
+        path, target = self.samples[index]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat image list (no labels) for inference feeds:
+
+        root/xxx.png
+        root/sub/yyy.jpg
+
+    Reference: vision/datasets/folder.py:219 (__getitem__ -> [sample])."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or default_loader
+        self.extensions = tuple(extensions or IMG_EXTENSIONS)
+        if is_valid_file is None:
+            def is_valid_file(p):
+                return has_valid_extension(p, self.extensions)
+        samples = []
+        for r, _, fnames in sorted(os.walk(root, followlinks=True)):
+            for fname in sorted(fnames):
+                path = os.path.join(r, fname)
+                if is_valid_file(path):
+                    samples.append(path)
+        if not samples:
+            raise RuntimeError(
+                f"Found 0 files in {root} with extensions "
+                f"{','.join(self.extensions)}")
+        self.samples = samples
+
+    def __getitem__(self, index):
+        sample = self.loader(self.samples[index])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

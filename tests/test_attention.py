@@ -423,5 +423,7 @@ class TestRingFlash:
 
         t1 = temp_bytes(2048)    # S_local 512
         t2 = temp_bytes(4096)    # S_local 1024
+        if t1 == 0:
+            pytest.skip("memory_analysis lacks temp_size_in_bytes here")
         # quadratic would be 4x; linear (plus constants) stays under ~2.6x
         assert t2 <= t1 * 2.6 + (1 << 20), (t1, t2)
