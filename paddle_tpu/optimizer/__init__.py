@@ -7,8 +7,15 @@ from .optimizer import (  # noqa: F401
     Adam,
     AdamW,
     Adamax,
+    Dpsgd,
+    DpsgdOptimizer,
+    Ftrl,
+    FtrlOptimizer,
     Lamb,
     Lars,
+    Lookahead,
+    LookaheadOptimizer,
+    ModelAverage,
     Momentum,
     Optimizer,
     RMSProp,

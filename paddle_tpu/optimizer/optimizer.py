@@ -15,6 +15,7 @@ from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..autograd.tape import no_grad
 from ..framework.flags import flag_value
@@ -606,3 +607,295 @@ class Lars(Momentum):
         p._value = (pf - new_v).astype(p._value.dtype)
         self._set_acc("velocity", p, new_v)
         p._inplace_version += 1
+
+
+class Ftrl(Optimizer):
+    """FTRL-proximal (reference fluid/optimizer.py FtrlOptimizer +
+    operators/optimizers/ftrl_op.h — squared/linear accumulators, the
+    lr_power=-0.5 fast path, and l1 soft-threshold shrink)."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, regularization=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, regularization,
+                         grad_clip, name)
+        # the reference adds 1e-10 so sign/compare never sees exact zero
+        self._l1 = float(l1) + 1e-10
+        self._l2 = float(l2) + 1e-10
+        self._lr_power = float(lr_power)
+
+    def _acc_kinds(self):
+        return ["squared", "linear"]
+
+    def _rule(self, p, g, accs, lr, step):
+        sq, lin = accs["squared"], accs["linear"]
+        new_sq = sq + g * g
+        if self._lr_power == -0.5:
+            sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+            y = jnp.sqrt(new_sq) / lr + 2.0 * self._l2
+        else:
+            sigma = (new_sq ** -self._lr_power - sq ** -self._lr_power) / lr
+            y = new_sq ** -self._lr_power / lr + 2.0 * self._l2
+        new_lin = lin + g - sigma * p
+        x = self._l1 * jnp.sign(new_lin) - new_lin
+        pre_shrink = x / y
+        new_p = jnp.where(jnp.abs(new_lin) > self._l1, pre_shrink, 0.0)
+        return new_p, {"squared": new_sq, "linear": new_lin}
+
+    def _update_param(self, p, g, lr):
+        accs = {k: self._acc(k, p) for k in self._acc_kinds()}
+        new_p, new_accs = self._rule(p._value, g, accs, lr,
+                                     self._step_count)
+        p._value = new_p.astype(p._value.dtype)
+        for k, v in new_accs.items():
+            self._set_acc(k, p, v)
+        p._inplace_version += 1
+
+
+FtrlOptimizer = Ftrl
+
+
+class Dpsgd(Optimizer):
+    """Differentially-private SGD (reference fluid/optimizer.py
+    DpsgdOptimizer + operators/optimizers/dpsgd_op.h — per-tensor l2
+    clip to `clip`, one gaussian noise scalar scaled by 1/batch_size;
+    CCS'16 "Deep Learning with Differential Privacy")."""
+
+    def __init__(self, learning_rate=0.001, clip=0.9, batch_size=0.999,
+                 sigma=1e-8, parameters=None, seed=0, name=None):
+        super().__init__(learning_rate, parameters, None, None, name)
+        self._clip = float(clip)
+        self._batch_size = float(batch_size)
+        self._sigma = float(sigma)
+        # seed=0 means "draw one" (the reference uses time(NULL); a fixed
+        # draw keeps runs reproducible under jit)
+        self._seed = int(seed) or int(np.random.RandomState().randint(1 << 30))
+
+    def _rule(self, p, g, accs, lr, step):
+        import zlib
+
+        l2 = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+        scale = jnp.where(l2 > self._clip, l2 / self._clip, 1.0)
+        key = jax.random.fold_in(jax.random.PRNGKey(self._seed),
+                                 jnp.asarray(step, jnp.uint32))
+        # per-tensor salt from the (static) shape so different parameters
+        # draw independent noise within a step (the reference's per-op
+        # time seeds are independent; tensors with IDENTICAL shapes share
+        # a draw here — the price of jit-reproducibility)
+        salt = zlib.crc32(repr(jnp.shape(p)).encode()) & 0x7FFFFFFF
+        key = jax.random.fold_in(key, salt)
+        noise = jax.random.normal(key, ()) * self._sigma
+        new_p = p - lr * (g / scale + noise / self._batch_size)
+        return new_p, {}
+
+    def _update_param(self, p, g, lr):
+        new_p, _ = self._rule(p._value, g, {}, lr, self._step_count)
+        p._value = new_p.astype(p._value.dtype)
+        p._inplace_version += 1
+
+
+DpsgdOptimizer = Dpsgd
+
+
+class ModelAverage(Optimizer):
+    """Sliding-window parameter averaging (reference fluid/optimizer.py
+    ModelAverage:3157 + operators/average_accumulates_op.h).  Runs
+    BESIDE the training optimizer: call ``step()`` after each update to
+    accumulate, then ``apply()`` to swap in the averaged weights for
+    evaluation and ``restore()`` (or the context manager) to swap back.
+    """
+
+    _MAX_NUM_ACCUMULATES = 16384  # reference kMaxNumAccumulates
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 regularization=None, name=None):
+        super().__init__(0.0, parameters, regularization, None, name)
+        self._avg_rate = float(average_window_rate)
+        self._min_window = int(min_average_window)
+        self._max_window = int(max_average_window)
+        self._num_updates = 0
+        self._num_accumulates = 0
+        self._old_num_accumulates = 0
+        self._backup = None
+
+    def _acc_kinds(self):
+        return ["sum_1", "sum_2", "sum_3"]
+
+    def state_dict(self):
+        out = super().state_dict()
+        out["ma_num_updates"] = self._num_updates
+        out["ma_num_accumulates"] = self._num_accumulates
+        out["ma_old_num_accumulates"] = self._old_num_accumulates
+        return out
+
+    def set_state_dict(self, state_dict):
+        super().set_state_dict(state_dict)
+        self._num_updates = int(state_dict.get("ma_num_updates", 0))
+        self._num_accumulates = int(state_dict.get("ma_num_accumulates", 0))
+        self._old_num_accumulates = int(
+            state_dict.get("ma_old_num_accumulates", 0))
+
+    @no_grad()
+    def step(self):
+        """Accumulate the CURRENT parameter values (reference
+        average_accumulates op: sum_1 += param; rotate windows)."""
+        self._num_updates += 1
+        self._num_accumulates += 1
+        rotate = (self._num_accumulates >= self._min_window
+                  and self._num_accumulates >= min(
+                      self._max_window,
+                      self._num_updates * self._avg_rate))
+        for p in self._param_list():
+            s1 = self._acc("sum_1", p) + p._value
+            s2 = self._acc("sum_2", p)
+            s3 = self._acc("sum_3", p)
+            if self._num_updates % self._MAX_NUM_ACCUMULATES == 0:
+                s2 = s2 + s1
+                s1 = jnp.zeros_like(s1)
+            if rotate:
+                s3 = s1 + s2
+                s1 = jnp.zeros_like(s1)
+                s2 = jnp.zeros_like(s2)
+            self._set_acc("sum_1", p, s1)
+            self._set_acc("sum_2", p, s2)
+            self._set_acc("sum_3", p, s3)
+        if rotate:
+            self._old_num_accumulates = self._num_accumulates
+            self._num_accumulates = 0
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
+
+    @no_grad()
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged weights in; context-manager restores on exit
+        when need_restore (reference ModelAverage.apply)."""
+        total = self._num_accumulates + self._old_num_accumulates
+        if total == 0:
+            raise RuntimeError("ModelAverage.apply before any step()")
+        self._backup = {}
+        for p in self._param_list():
+            self._backup[id(p)] = p._value
+            avg = (self._acc("sum_1", p) + self._acc("sum_2", p)
+                   + self._acc("sum_3", p)) / float(total)
+            p._value = avg.astype(p._value.dtype)
+            p._inplace_version += 1
+        return _RestoreGuard(self, need_restore)
+
+    @no_grad()
+    def restore(self, executor=None):
+        if not self._backup:
+            return
+        for p in self._param_list():
+            if id(p) in self._backup:
+                p._value = self._backup[id(p)]
+                p._inplace_version += 1
+        self._backup = None
+
+
+class _RestoreGuard:
+    def __init__(self, ma, need_restore):
+        self._ma = ma
+        self._need_restore = need_restore
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if self._need_restore:
+            self._ma.restore()
+        return False
+
+
+class Lookahead(Optimizer):
+    """Lookahead wrapper (reference fluid/optimizer.py
+    LookaheadOptimizer:5499, arXiv:1907.08610): the inner optimizer
+    advances the fast weights every step; every k steps the slow weights
+    move toward them and the fast weights reset onto the slow ones:
+
+        slow = slow + alpha * (fast - slow);  fast = slow
+    """
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        assert inner_optimizer is not None, "inner optimizer can not be None"
+        assert 0.0 <= alpha <= 1.0, "alpha should be in [0, 1]"
+        assert isinstance(k, int) and k > 0, "k should be a positive integer"
+        # base init so inherited entry points (fused_step, _param_list,
+        # clip/regularization attrs) see a fully-formed Optimizer
+        super().__init__(inner_optimizer._lr, inner_optimizer._parameters)
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._slow = None
+        self._k_count = 0
+
+    def _params(self):
+        return self.inner_optimizer._param_list()
+
+    @no_grad()
+    def step(self):
+        if self._slow is None:
+            self._slow = {id(p): p._value for p in self._params()}
+        self.inner_optimizer.step()
+        self._k_count += 1
+        if self._k_count % self.k == 0:
+            for p in self._params():
+                slow = self._slow[id(p)]
+                new_slow = slow + self.alpha * (p._value - slow)
+                self._slow[id(p)] = new_slow
+                p._value = new_slow
+                p._inplace_version += 1
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def set_lr(self, value):
+        self.inner_optimizer.set_lr(value)
+        self._lr = self.inner_optimizer._lr
+
+    def state_dict(self):
+        out = {"inner": self.inner_optimizer.state_dict(),
+               "k_count": self._k_count}
+        if self._slow is not None:
+            for i, p in enumerate(self._params()):
+                out[f"slow_{i}"] = Tensor(self._slow[id(p)])
+        return out
+
+    def set_state_dict(self, state):
+        self.inner_optimizer.set_state_dict(state.get("inner", {}))
+        self._k_count = int(state.get("k_count", 0))
+        params = self._params()
+        slow = {}
+        for i, p in enumerate(params):
+            key = f"slow_{i}"
+            if key in state:
+                v = state[key]
+                slow[id(p)] = v._value if isinstance(v, Tensor) else \
+                    jnp.asarray(v)
+        if slow and len(slow) != len(params):
+            raise ValueError(
+                f"Lookahead state holds {len(slow)} slow weights for "
+                f"{len(params)} parameters; refusing a partial restore")
+        if slow:
+            self._slow = slow
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """Same contract as Optimizer.minimize: only re-run backward when
+        the loss's grad graph is still alive (the canonical pattern is
+        ``loss.backward(); opt.minimize(loss)``)."""
+        node = getattr(loss, "_grad_node", None)
+        if node is not None and getattr(node, "vjp_fn", None) is not None:
+            loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+LookaheadOptimizer = Lookahead

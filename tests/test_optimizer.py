@@ -209,3 +209,188 @@ class TestRegularizer:
         opt.step()
         # grad = 0 + 0.5*1.0 → p = 1 - 0.1*0.5
         np.testing.assert_allclose(p.numpy(), [0.95], rtol=1e-6)
+
+
+class TestOptimizerTail:
+    """Ftrl / Dpsgd / ModelAverage / Lookahead (VERDICT r4 next-round #7).
+
+    Reference: fluid/optimizer.py FtrlOptimizer, DpsgdOptimizer,
+    ModelAverage:3157, LookaheadOptimizer:5499;
+    operators/optimizers/ftrl_op.h, dpsgd_op.h,
+    operators/average_accumulates_op.h."""
+
+    def test_ftrl_matches_reference_formula(self):
+        lr, l1, l2 = 0.1, 0.01, 0.01
+        p0, g = 1.0, 2.0
+        p = paddle.Parameter(np.array([p0], np.float32))
+        opt = optimizer.Ftrl(learning_rate=lr, l1=l1, l2=l2, parameters=[p])
+        (p * g).backward()
+        opt.step()
+        # hand-computed ftrl_op.h dense update (lr_power=-0.5 fast path)
+        l1e, l2e = l1 + 1e-10, l2 + 1e-10
+        new_sq = g * g
+        lin = g - (np.sqrt(new_sq) - 0.0) / lr * p0
+        x = l1e * np.sign(lin) - lin
+        y = np.sqrt(new_sq) / lr + 2 * l2e
+        expect = x / y if abs(lin) > l1e else 0.0
+        np.testing.assert_allclose(p.numpy(), [expect], rtol=1e-5)
+
+    def test_ftrl_l1_shrinks_to_zero(self):
+        # a tiny linear accumulator inside the l1 ball -> exact zero
+        p = paddle.Parameter(np.array([0.001], np.float32))
+        opt = optimizer.Ftrl(learning_rate=1.0, l1=10.0, l2=0.0,
+                             parameters=[p])
+        (p * 0.01).backward()
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [0.0])
+
+    def test_ftrl_trains(self):
+        p = quad_problem()
+        opt = optimizer.Ftrl(learning_rate=0.5, l1=0.0, l2=0.0,
+                             parameters=[p])
+        losses = []
+        for _ in range(60):
+            losses.append(loss_and_backward(p))
+            opt.step()
+            opt.clear_grad()
+        assert losses[-1] < losses[0] * 0.1
+
+    def test_dpsgd_clips_and_trains(self):
+        paddle.seed(0)
+        p = quad_problem()
+        opt = optimizer.Dpsgd(learning_rate=0.05, clip=1.0, batch_size=64.0,
+                              sigma=1e-4, parameters=[p], seed=7)
+        losses = []
+        for _ in range(200):
+            losses.append(loss_and_backward(p))
+            opt.step()
+            opt.clear_grad()
+        assert losses[-1] < losses[0] * 0.1
+
+    def test_dpsgd_clip_scale(self):
+        # grad norm 10 with clip 1 -> effective grad = g/10 (+ tiny noise)
+        p = paddle.Parameter(np.array([0.0], np.float32))
+        opt = optimizer.Dpsgd(learning_rate=1.0, clip=1.0, batch_size=1e9,
+                              sigma=0.0, parameters=[p], seed=3)
+        (p * 10.0).backward()
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [-1.0], atol=1e-5)
+
+    def test_model_average_hand_math(self):
+        p = paddle.Parameter(np.array([0.0], np.float32))
+        sgd = optimizer.SGD(learning_rate=1.0, parameters=[p])
+        ma = optimizer.ModelAverage(0.5, parameters=[p],
+                                    min_average_window=2,
+                                    max_average_window=100)
+        seen = []
+        for _ in range(4):
+            (p * 1.0).backward()   # grad 1 -> p decreases by 1 each step
+            sgd.step()
+            sgd.clear_grad()
+            seen.append(float(p.numpy()[0]))
+            ma.step()
+        # window never rotated before apply? rotation occurs when
+        # num_accumulates >= 2 and >= num_updates*0.5 -> at step 2 (sum
+        # moves to sum_3) and step 4; averaged over the last window
+        with ma.apply():
+            applied = float(p.numpy()[0])
+        restored = float(p.numpy()[0])
+        assert restored == seen[-1]          # restore() brought fast back
+        # accumulated sums always hold a mean of a suffix of `seen`
+        candidates = [np.mean(seen[i:]) for i in range(len(seen))]
+        assert any(abs(applied - c) < 1e-6 for c in candidates), (
+            applied, candidates)
+
+    def test_model_average_restore_without_ctx(self):
+        p = paddle.Parameter(np.array([3.0], np.float32))
+        sgd = optimizer.SGD(learning_rate=0.5, parameters=[p])
+        ma = optimizer.ModelAverage(1.0, parameters=[p],
+                                    min_average_window=1,
+                                    max_average_window=1)
+        (p * 2.0).backward()
+        sgd.step()
+        ma.step()
+        before = float(p.numpy()[0])
+        ma.apply(need_restore=False)
+        ma.restore()
+        assert float(p.numpy()[0]) == before
+
+    def test_lookahead_slow_weight_math(self):
+        # fast: SGD lr=1 on grad=1 -> decreases by 1/step; k=2, alpha=0.5
+        p = paddle.Parameter(np.array([0.0], np.float32))
+        inner = optimizer.SGD(learning_rate=1.0, parameters=[p])
+        look = optimizer.Lookahead(inner, alpha=0.5, k=2)
+        vals = []
+        for _ in range(4):
+            (p * 1.0).backward()
+            look.step()
+            look.clear_grad()
+            vals.append(float(p.numpy()[0]))
+        # step1: fast=-1. step2: fast=-2 -> sync: slow=0+0.5*(-2-0)=-1,
+        # fast=-1. step3: fast=-2. step4: fast=-3 -> slow=-1+0.5*(-3+1)=-2
+        np.testing.assert_allclose(vals, [-1.0, -1.0, -2.0, -2.0])
+
+    def test_lookahead_trains_and_state_roundtrip(self):
+        paddle.seed(0)
+        p = quad_problem()
+        look = optimizer.Lookahead(
+            optimizer.SGD(learning_rate=0.2, parameters=[p]), alpha=0.8, k=3)
+        for _ in range(40):
+            loss_and_backward(p)
+            look.step()
+            look.clear_grad()
+        assert np.abs(p.numpy()).max() < 0.05
+        state = look.state_dict()
+        p2 = paddle.Parameter(np.array([5.0, -3.0], np.float32))
+        look2 = optimizer.Lookahead(
+            optimizer.SGD(learning_rate=0.2, parameters=[p2]), alpha=0.8, k=3)
+        look2.set_state_dict(state)
+        assert look2._k_count == look._k_count
+        np.testing.assert_allclose(
+            np.asarray(look2._slow[id(p2)]), np.asarray(look._slow[id(p)]))
+
+    def test_lookahead_validation(self):
+        p = quad_problem()
+        sgd = optimizer.SGD(0.1, parameters=[p])
+        with pytest.raises(AssertionError):
+            optimizer.Lookahead(sgd, alpha=2.0)
+        with pytest.raises(AssertionError):
+            optimizer.Lookahead(sgd, k=0)
+        with pytest.raises(AssertionError):
+            optimizer.Lookahead(None)
+
+    def test_tail_optimizers_train_a_model(self):
+        from paddle_tpu import nn
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.RandomState(0)
+        X = rng.randn(64, 4).astype(np.float32)
+        Y = (X.sum(1) > 0).astype(np.int64)
+
+        def train(make_opt):
+            paddle.seed(0)
+            net = nn.Linear(4, 2)
+            opt = make_opt(net.parameters())
+            first = last = None
+            for _ in range(60):
+                x = paddle.to_tensor(X)
+                y = paddle.to_tensor(Y)
+                loss = F.cross_entropy(net(x), y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                if first is None:
+                    first = float(loss.numpy())
+                last = float(loss.numpy())
+            return first, last
+
+        for make in (
+            lambda ps: optimizer.Ftrl(0.5, parameters=list(ps)),
+            lambda ps: optimizer.Dpsgd(0.1, clip=5.0, batch_size=64.0,
+                                       sigma=1e-5, parameters=list(ps),
+                                       seed=1),
+            lambda ps: optimizer.Lookahead(
+                optimizer.SGD(0.5, parameters=list(ps)), alpha=0.5, k=5),
+        ):
+            first, last = train(make)
+            assert last < first * 0.7, (make, first, last)
