@@ -768,6 +768,16 @@ class ModelAverage(Optimizer):
                  no_grad_set=None):
         self.step()
 
+    def fused_step(self, params, grads, opt_state, step, lr=None,
+                   master_params=None):
+        raise TypeError(
+            "ModelAverage is not a training optimizer — it accumulates "
+            "BESIDE one (call ma.step() after the trainer's step(), then "
+            "apply()/restore() around evaluation); it has no fused "
+            "update rule.")
+
+    _rule = fused_step
+
     @no_grad()
     def apply(self, executor=None, need_restore=True):
         """Swap averaged weights in; context-manager restores on exit
@@ -775,6 +785,11 @@ class ModelAverage(Optimizer):
         total = self._num_accumulates + self._old_num_accumulates
         if total == 0:
             raise RuntimeError("ModelAverage.apply before any step()")
+        if self._backup:
+            raise RuntimeError(
+                "ModelAverage.apply while averaged weights are already "
+                "applied — restore() first (a second apply would back up "
+                "the averaged values and lose the training weights)")
         self._backup = {}
         for p in self._param_list():
             self._backup[id(p)] = p._value
@@ -822,14 +837,42 @@ class Lookahead(Optimizer):
         assert inner_optimizer is not None, "inner optimizer can not be None"
         assert 0.0 <= alpha <= 1.0, "alpha should be in [0, 1]"
         assert isinstance(k, int) and k > 0, "k should be a positive integer"
-        # base init so inherited entry points (fused_step, _param_list,
-        # clip/regularization attrs) see a fully-formed Optimizer
+        # base init so inherited entry points (minimize incl. the static-
+        # recording branch, fused_step, _param_list) see a fully-formed
+        # Optimizer; the update math delegates to the inner optimizer
         super().__init__(inner_optimizer._lr, inner_optimizer._parameters)
         self.inner_optimizer = inner_optimizer
         self.alpha = float(alpha)
         self.k = int(k)
         self._slow = None
         self._k_count = 0
+
+    # -- functional/static paths: slow weights ride as an accumulator ----
+    def _acc_kinds(self):
+        return (["inner_" + k for k in self.inner_optimizer._acc_kinds()]
+                + ["slow"])
+
+    def init_opt_state(self, params):
+        state = super().init_opt_state(params)
+        # slow weights start AT the params — as COPIES, or a donating jit
+        # (hapi train step) would see the same buffer twice
+        state["slow"] = {k: jnp.array(v, copy=True)
+                         for k, v in params.items()}
+        return state
+
+    def _rule(self, p, g, accs, lr, step):
+        inner_accs = {k[len("inner_"):]: v for k, v in accs.items()
+                      if k != "slow"}
+        fast, new_inner = self.inner_optimizer._rule(p, g, inner_accs, lr,
+                                                     step)
+        # zero-initialized accumulator stores (eager/static) hold 0, not
+        # the initial params; at step 1 the slow weights ARE the params
+        slow = jnp.where(step == 1, p, accs["slow"])
+        sync = (step % self.k) == 0
+        synced = slow + self.alpha * (fast - slow)
+        out = {"inner_" + k: v for k, v in new_inner.items()}
+        out["slow"] = jnp.where(sync, synced, slow)
+        return jnp.where(sync, synced, fast), out
 
     def _params(self):
         return self.inner_optimizer._param_list()
@@ -885,17 +928,9 @@ class Lookahead(Optimizer):
                 f"{len(params)} parameters; refusing a partial restore")
         if slow:
             self._slow = slow
-
-    def minimize(self, loss, startup_program=None, parameters=None,
-                 no_grad_set=None):
-        """Same contract as Optimizer.minimize: only re-run backward when
-        the loss's grad graph is still alive (the canonical pattern is
-        ``loss.backward(); opt.minimize(loss)``)."""
-        node = getattr(loss, "_grad_node", None)
-        if node is not None and getattr(node, "vjp_fn", None) is not None:
-            loss.backward()
-        self.step()
-        self.clear_grad()
+    # minimize() is inherited: the dygraph branch routes through the
+    # overridden step() above; the static-recording branch records the
+    # combined _rule (inner update + k-step slow sync) into the Program.
 
 
 LookaheadOptimizer = Lookahead

@@ -1,0 +1,14 @@
+"""Produce the LeNet inference artifact the R example loads."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import jit
+from paddle_tpu.jit import InputSpec
+from paddle_tpu.vision.models import LeNet
+
+paddle.seed(0)
+net = LeNet()
+net.eval()
+jit.save(net, "/tmp/lenet_r_demo/lenet",
+         input_spec=[InputSpec([1, 1, 28, 28], "float32", name="img")])
+print("saved /tmp/lenet_r_demo/lenet")

@@ -1,0 +1,25 @@
+#!/usr/bin/env Rscript
+# R client over the paddle_tpu inference API (reference r/example/
+# mobilenet.r uses the same reticulate pattern against paddle.fluid.core).
+
+library(reticulate)
+
+np <- import("numpy")
+inference <- import("paddle_tpu.inference")
+
+config <- inference$Config("/tmp/lenet_r_demo/lenet")
+predictor <- inference$create_predictor(config)
+
+input_names <- predictor$get_input_names()
+cat("inputs:", unlist(input_names), "\n")
+
+img <- np$zeros(as.integer(c(1, 1, 28, 28)), dtype = "float32")
+handle <- predictor$get_input_handle(input_names[[1]])
+handle$copy_from_cpu(img)
+
+predictor$run()
+
+output_names <- predictor$get_output_names()
+out <- predictor$get_output_handle(output_names[[1]])$copy_to_cpu()
+cat("logits:", np$asarray(out)$reshape(-1L), "\n")
+cat("argmax class:", which.max(py_to_r(np$asarray(out))) - 1, "\n")

@@ -394,3 +394,51 @@ class TestOptimizerTail:
         ):
             first, last = train(make)
             assert last < first * 0.7, (make, first, last)
+
+    def test_lookahead_fused_matches_eager(self):
+        # functional fused_step (hapi/jit path) must track the eager
+        # wrapper trajectory exactly
+        import jax.numpy as jnp
+
+        p = paddle.Parameter(np.array([0.0, 2.0], np.float32))
+        inner = optimizer.SGD(learning_rate=1.0, parameters=[p])
+        look = optimizer.Lookahead(inner, alpha=0.5, k=2)
+
+        params = {"w": jnp.asarray([0.0, 2.0], jnp.float32)}
+        state = look.init_opt_state(params)
+        for step in range(1, 5):
+            grads = {"w": jnp.ones(2, jnp.float32)}
+            params, state = look.fused_step(params, grads, state, step)
+            (p * 1.0).sum().backward()
+            look.step()
+            look.clear_grad()
+            np.testing.assert_allclose(np.asarray(params["w"]), p.numpy(),
+                                       rtol=1e-6)
+
+    def test_lookahead_through_hapi_model(self):
+        from paddle_tpu import hapi, nn
+
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        model = hapi.Model(net)
+        look = optimizer.Lookahead(
+            optimizer.SGD(0.1, parameters=net.parameters()), alpha=0.5, k=2)
+        model.prepare(optimizer=look, loss=nn.CrossEntropyLoss())
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 4).astype(np.float32)
+        y = (x.sum(1) > 0).astype(np.int64)
+        out1 = model.train_batch([x], [y])
+        out2 = model.train_batch([x], [y])
+        assert np.isfinite(out1[0]).all() and np.isfinite(out2[0]).all()
+
+    def test_model_average_double_apply_guarded(self):
+        p = paddle.Parameter(np.array([1.0], np.float32))
+        ma = optimizer.ModelAverage(1.0, parameters=[p],
+                                    min_average_window=1,
+                                    max_average_window=1)
+        ma.step()
+        ma.apply(need_restore=False)
+        with pytest.raises(RuntimeError, match="restore"):
+            ma.apply()
+        ma.restore()
+        ma.apply(need_restore=False)  # legal again after restore
