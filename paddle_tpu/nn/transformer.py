@@ -72,7 +72,13 @@ class MultiHeadAttention(Layer):
             )
         return self.Cache(key, value)
 
-    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None,
+                is_causal=False):
+        """`is_causal=True` with no attn_mask expresses causal masking
+        WITHOUT materializing an S×S mask — the condition for the Pallas
+        flash route at long sequence lengths (ops/attention.py); the
+        reference builds tril matrices instead (nn/layer/transformer.py)
+        because its fused kernels take dense masks."""
         key = query if key is None else key
         value = query if value is None else value
         q = self._split_heads(self.q_proj(query))
@@ -91,11 +97,14 @@ class MultiHeadAttention(Layer):
         mask = _convert_attn_mask(attn_mask)
         if self.need_weights:
             # explicit path returning attention probabilities
-            out, weights = self._attention_with_weights(q, k, v, mask)
+            out, weights = self._attention_with_weights(q, k, v, mask,
+                                                        is_causal=is_causal)
         else:
+            # is_causal COMBINES with a padding mask (both the flash
+            # kernel and the XLA core apply causal + kv-validity together)
             out = scaled_dot_product_attention(
                 q, k, v, attn_mask=mask, dropout_p=self.dropout,
-                training=self.training)
+                is_causal=is_causal, training=self.training)
             weights = None
         from ..ops.manipulation import reshape
 
@@ -108,7 +117,7 @@ class MultiHeadAttention(Layer):
             outs.append(cache)
         return out if len(outs) == 1 else tuple(outs)
 
-    def _attention_with_weights(self, q, k, v, mask):
+    def _attention_with_weights(self, q, k, v, mask, is_causal=False):
         import jax
 
         scale = self.head_dim**-0.5
@@ -122,6 +131,10 @@ class MultiHeadAttention(Layer):
             kt = jnp.swapaxes(kk, 1, 2)
             vt = jnp.swapaxes(vv, 1, 2)
             logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt).astype(jnp.float32) * scale
+            if is_causal:
+                Sq, Sk = logits.shape[-2], logits.shape[-1]
+                tri = jnp.tril(jnp.ones((Sq, Sk), bool), k=Sk - Sq)
+                logits = jnp.where(tri[None, None], logits, -1e30)
             if mm:
                 m = mm[0]
                 if m.ndim == 2:  # [B, S] validity mask

@@ -28,6 +28,11 @@ from ..tensor import Tensor
 from ._helpers import to_tensor_like
 from .dispatch import apply
 
+# trace-time routing telemetry: which attention path each call took
+# (bench asserts the flash route is ENGAGED for the long-context
+# flagship instead of trusting preconditions — VERDICT r4 next #2)
+ROUTE_STATS = {"pallas": 0, "xla": 0}
+
 
 def _sdpa_core(q, k, v, mask, dropout_p, is_causal, key, scale=None):
     # q,k,v: [B, H, S, D]
@@ -98,8 +103,10 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
             kv_mask = _as_kv_mask(mv, B, S)
             routable = kv_mask is not None
         if routable:
+            ROUTE_STATS["pallas"] += 1
             return flash_attention(query, key, value, dropout=drop,
                                    causal=is_causal, kv_mask=kv_mask)
+    ROUTE_STATS["xla"] += 1
 
     rng = next_rng_key() if drop > 0.0 else None
 

@@ -839,8 +839,11 @@ class Lookahead(Optimizer):
         assert isinstance(k, int) and k > 0, "k should be a positive integer"
         # base init so inherited entry points (minimize incl. the static-
         # recording branch, fused_step, _param_list) see a fully-formed
-        # Optimizer; the update math delegates to the inner optimizer
-        super().__init__(inner_optimizer._lr, inner_optimizer._parameters)
+        # Optimizer; regularization/clip mirror the INNER optimizer so the
+        # fused/static paths apply the same decay the eager path does
+        super().__init__(inner_optimizer._lr, inner_optimizer._parameters,
+                         weight_decay=inner_optimizer._regularization,
+                         grad_clip=inner_optimizer._grad_clip)
         self.inner_optimizer = inner_optimizer
         self.alpha = float(alpha)
         self.k = int(k)

@@ -132,9 +132,10 @@ class GPTDecoderLayer(nn.Layer):
         self.fc2 = nn.Linear(ffn, hidden)
         self.dropout = nn.Dropout(dropout)
 
-    def forward(self, x, mask=None):
+    def forward(self, x, mask=None, causal=False):
         h = self.ln1(x)
-        x = x + self.attn(h, h, h, attn_mask=mask)
+        x = x + self.attn(h, h, h, attn_mask=mask,
+                          is_causal=causal and mask is None)
         h = self.ln2(x)
         x = x + self.dropout(self.fc2(F.gelu(self.fc1(h))))
         return x
@@ -166,16 +167,15 @@ class GPTModel(nn.Layer):
             layer.fc2.weight.partition_spec = ("mp", None)
 
     def forward(self, input_ids):
-        import jax.numpy as jnp
-
         from ..ops.creation import arange
 
         B, S = input_ids.shape
         pos = arange(S, dtype="int64")
         x = self.wte(input_ids) + self.wpe(pos)
-        causal = Tensor(jnp.tril(jnp.ones((1, 1, S, S), bool)))
+        # causal masking rides the attention op (is_causal -> the Pallas
+        # flash route at S>=128), never a materialized S×S tril
         for layer in self.layers:
-            x = layer(x, mask=causal)
+            x = layer(x, causal=True)
         x = self.ln_f(x)
         # weight-tied LM head
         return F.linear(x, self.wte.weight.t())

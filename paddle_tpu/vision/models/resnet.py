@@ -52,6 +52,11 @@ class BasicBlock(nn.Layer):
         self.stride = stride
 
     def forward(self, x):
+        if getattr(self, "_remat", False):
+            return _remat_block(self, x)
+        return self._body(x)
+
+    def _body(self, x):
         identity = x
         out = self.bn1(self.conv1(x))
         if not self._fused1:
@@ -60,6 +65,41 @@ class BasicBlock(nn.Layer):
         if self.downsample is not None:
             identity = self.downsample(x)
         return self.relu(out + identity)
+
+
+def _remat_block(layer, x):
+    """Rematerialize a residual block: the backward recomputes the block's
+    interior conv/BN activations from the block INPUT instead of round-
+    tripping them through HBM.  On an HBM-bandwidth-bound step (the v5e
+    ResNet-50 profile) this trades idle MXU flops for the scarce resource.
+    Weights captured by closure are saved, not recomputed; BN running
+    stats are threaded through as explicit inputs/outputs (a side-effect
+    write inside jax.checkpoint would leak tracers)."""
+    import jax
+
+    from ...ops.dispatch import apply
+    from ...tensor import Tensor as _T
+
+    bufs = list(layer.named_buffers())
+
+    def pure(xv, *bufvals):
+        old = [b._value for _, b in bufs]
+        for (_, b), v in zip(bufs, bufvals):
+            b._value = v
+        out = layer._body(_T(xv))._value
+        new = tuple(b._value for _, b in bufs)
+        for (_, b), v in zip(bufs, old):
+            b._value = v
+        return (out,) + new
+
+    res = apply("remat_block", jax.checkpoint(pure), x,
+                *[b for _, b in bufs])
+    if not isinstance(res, tuple):
+        return res
+    out = res[0]
+    for (_, b), v in zip(bufs, res[1:]):
+        b._value = v._value
+    return out
 
 
 class BottleneckBlock(nn.Layer):
@@ -85,6 +125,11 @@ class BottleneckBlock(nn.Layer):
         self.stride = stride
 
     def forward(self, x):
+        if getattr(self, "_remat", False):
+            return _remat_block(self, x)
+        return self._body(x)
+
+    def _body(self, x):
         identity = x
         out = self.bn1(self.conv1(x))
         if not self._fused1:
@@ -104,8 +149,10 @@ class ResNet(nn.Layer):
     wants — with a single input transpose handled by the caller."""
 
     def __init__(self, block, depth=50, width=64, num_classes=1000,
-                 with_pool=True, groups=1, data_format="NCHW"):
+                 with_pool=True, groups=1, data_format="NCHW",
+                 remat=False):
         super().__init__()
+        self._remat = remat
         layer_cfg = {
             18: [2, 2, 2, 2],
             34: [3, 4, 6, 3],
@@ -135,6 +182,10 @@ class ResNet(nn.Layer):
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
+        if remat:
+            for blk in self.sublayers():
+                if isinstance(blk, (BasicBlock, BottleneckBlock)):
+                    blk._remat = True
         if with_pool:
             self.avgpool = nn.AdaptiveAvgPool2D((1, 1), data_format=df)
         if num_classes > 0:

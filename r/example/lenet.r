@@ -1,6 +1,8 @@
 #!/usr/bin/env Rscript
 # R client over the paddle_tpu inference API (reference r/example/
 # mobilenet.r uses the same reticulate pattern against paddle.fluid.core).
+# With reticulate's default convert=TRUE, copy_to_cpu() comes back as an
+# R array — plain R vector ops from there.
 
 library(reticulate)
 
@@ -21,5 +23,6 @@ predictor$run()
 
 output_names <- predictor$get_output_names()
 out <- predictor$get_output_handle(output_names[[1]])$copy_to_cpu()
-cat("logits:", np$asarray(out)$reshape(-1L), "\n")
-cat("argmax class:", which.max(py_to_r(np$asarray(out))) - 1, "\n")
+logits <- as.vector(out)
+cat("logits:", logits, "\n")
+cat("argmax class:", which.max(logits) - 1, "\n")

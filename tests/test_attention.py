@@ -427,3 +427,68 @@ class TestRingFlash:
             pytest.skip("memory_analysis lacks temp_size_in_bytes here")
         # quadratic would be 4x; linear (plus constants) stays under ~2.6x
         assert t2 <= t1 * 2.6 + (1 << 20), (t1, t2)
+
+
+class TestMHACausalFlag:
+    """MultiHeadAttention is_causal: expresses causal masking without an
+    S×S mask tensor (the flash-route condition); must equal the
+    materialized-tril path exactly."""
+
+    def test_is_causal_matches_tril_mask(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+
+        paddle.seed(0)
+        mha = nn.MultiHeadAttention(32, 4)
+        mha.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 16, 32).astype(np.float32))
+        tril = paddle.to_tensor(np.tril(np.ones((1, 1, 16, 16), bool)))
+        out_flag = mha(x, x, x, is_causal=True)
+        out_mask = mha(x, x, x, attn_mask=tril)
+        np.testing.assert_allclose(out_flag.numpy(), out_mask.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_gpt_forward_uses_no_quadratic_mask(self):
+        # the GPT forward must not materialize tril masks anymore
+        import inspect
+
+        from paddle_tpu.text import models
+
+        src = inspect.getsource(models.GPTModel.forward)
+        assert "jnp.tril" not in src and "ones((1, 1, S, S)" not in src
+        src_layer = inspect.getsource(models.GPTDecoderLayer.forward)
+        assert "is_causal" in src_layer
+
+    def test_is_causal_combines_with_padding_mask(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+
+        paddle.seed(0)
+        mha = nn.MultiHeadAttention(32, 4)
+        mha.eval()
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(2, 12, 32).astype(np.float32))
+        valid = np.ones((2, 12), np.float32)
+        valid[:, 9:] = 0.0
+        # reference: tril AND padding applied together
+        tril = np.tril(np.ones((12, 12), bool))[None, None]
+        both = tril & (valid[:, None, None, :] > 0)
+        out_ref = mha(x, x, x, attn_mask=paddle.to_tensor(both))
+        out = mha(x, x, x, attn_mask=paddle.to_tensor(valid), is_causal=True)
+        np.testing.assert_allclose(out.numpy()[:, :9], out_ref.numpy()[:, :9],
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_is_causal_with_need_weights(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+
+        paddle.seed(0)
+        mha = nn.MultiHeadAttention(16, 2, need_weights=True)
+        mha.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(1, 8, 16).astype(np.float32))
+        out, w = mha(x, x, x, is_causal=True)
+        probs = w.numpy()  # [B, H, S, S]
+        upper = np.triu(np.ones((8, 8), bool), k=1)
+        assert np.abs(probs[:, :, upper]).max() < 1e-6  # no future mass

@@ -442,3 +442,21 @@ class TestOptimizerTail:
             ma.apply()
         ma.restore()
         ma.apply(need_restore=False)  # legal again after restore
+
+    def test_lookahead_fused_applies_inner_weight_decay(self):
+        import jax.numpy as jnp
+
+        p = paddle.Parameter(np.array([1.0], np.float32))
+        inner = optimizer.Momentum(0.1, 0.9, parameters=[p],
+                                   weight_decay=1e-2)
+        look = optimizer.Lookahead(inner, alpha=0.5, k=2)
+        params = {"w": jnp.asarray([1.0], jnp.float32)}
+        state = look.init_opt_state(params)
+        for step in range(1, 5):
+            grads = {"w": jnp.ones(1, jnp.float32)}
+            params, state = look.fused_step(params, grads, state, step)
+            (p * 1.0).sum().backward()
+            look.step()
+            look.clear_grad()
+            np.testing.assert_allclose(np.asarray(params["w"]), p.numpy(),
+                                       rtol=1e-6)
