@@ -76,10 +76,15 @@ class auto_cast:
 amp_guard = auto_cast
 
 
+# observation ops must see their input VERBATIM — an AMP cast on a debug
+# probe would change both the printed values and the downstream graph
+_passthrough = {"print"}
+
+
 def should_cast(op_name: str) -> Optional[object]:
     """Called by the dispatcher: returns the target dtype for this op's float
     inputs, or None (imperative/amp_auto_cast.cc:130 AutoCastInputs analog)."""
-    if not _state.enabled:
+    if not _state.enabled or op_name in _passthrough:
         return None
     wl = (white_list | _state.custom_white) - _state.custom_black
     if _state.level == "O2":

@@ -79,6 +79,10 @@ def flag_value(name: str):
 
 # --- declared flags (subset of reference flags.cc with TPU-relevant semantics) ---
 define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf after each eager op")
+define_flag("enable_unused_var_check", False,
+            "warn when optimizer.step() sees trainable parameters with no "
+            "gradient (reference unused_var_check.cc — unused inputs waste "
+            "memory and usually signal a detached subgraph)")
 define_flag("benchmark", False, "block on each op for timing")
 define_flag("eager_delete_tensor_gb", 0.0, "inert on TPU: XLA owns deallocation")
 define_flag("allocator_strategy", "auto_growth", "inert on TPU: XLA owns device memory")
@@ -88,7 +92,6 @@ define_flag("seed", 0, "global random seed (0 = nondeterministic)")
 define_flag("max_inplace_grad_add", 0, "grad accumulation chunking hint")
 define_flag("tpu_matmul_precision", "default",
             "jax matmul precision: default|high|highest")
-define_flag("enable_unused_var_check", False, "warn on ops with unused inputs")
 define_flag("call_stack_level", 1, "error report verbosity")
 define_flag("use_mkldnn", False, "inert: XLA:CPU subsumes oneDNN")
 define_flag("sync_nccl_allreduce", False, "inert: XLA schedules collectives")

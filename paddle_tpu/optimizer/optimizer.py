@@ -187,6 +187,21 @@ class Optimizer:
     def step(self):
         from ..sparse_grad import IndexedSlices
 
+        if flag_value("enable_unused_var_check"):
+            # reference unused_var_check.cc analog: a trainable parameter
+            # with no gradient at step time is dead weight (detached
+            # subgraph / forgotten in the forward)
+            unused = [getattr(p, "name", f"param_{i}")
+                      for i, p in enumerate(self._param_list())
+                      if p._grad is None and getattr(p, "trainable", True)]
+            if unused:
+                import warnings
+
+                warnings.warn(
+                    f"{len(unused)} trainable parameter(s) received no "
+                    f"gradient this step (first few: {unused[:5]}); they "
+                    "are not reached by the loss graph",
+                    stacklevel=2)
         params = [p for p in self._param_list() if p._grad is not None
                   and getattr(p, "trainable", True)]
         grads = [p._grad for p in params]

@@ -25,6 +25,7 @@ from .program import (  # noqa: F401
     scope_guard,
 )
 from ..jit.to_static import InputSpec  # noqa: F401
+from .debugging import Print  # noqa: F401
 from ..framework_io import load, save  # noqa: F401
 
 

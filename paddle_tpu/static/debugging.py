@@ -1,0 +1,83 @@
+"""Print op + tensor printing (reference: operators/print_op.cc +
+lodtensor_printer.cc — an identity op that dumps tensor contents at
+execution time, forward and/or backward).
+
+TPU-native: jax.debug.callback rides the compiled computation, so the
+print fires on every execution — eagerly, under jit, and on every
+Executor.run replay of a recorded Program (the reference's RunImpl
+printing) — not just at trace time.
+"""
+from __future__ import annotations
+
+import sys
+import threading
+
+import numpy as np
+
+
+def _format(value, name, message, summarize, show_name, show_dtype,
+            show_shape, phase):
+    arr = np.asarray(value)
+    parts = []
+    if message:
+        parts.append(str(message))
+    if phase:
+        parts.append(f"[{phase}]")
+    if show_name and name:
+        parts.append(f"Variable: {name}")
+    if show_dtype:
+        parts.append(f"dtype: {arr.dtype}")
+    if show_shape:
+        parts.append(f"shape: {list(arr.shape)}")
+    # summarize=-1: print EVERYTHING (reference print_op semantics)
+    threshold = arr.size + 1 if summarize <= 0 else summarize
+    edge = arr.size if summarize <= 0 else max(1, summarize // 2)
+    with np.printoptions(threshold=threshold, edgeitems=edge):
+        parts.append(f"data: {arr}")
+    return "  ".join(parts)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=False,
+          print_tensor_lod=False, print_phase="both"):
+    """paddle.static.Print parity: identity op printing `input` when the
+    computation RUNS.  `first_n` caps the number of prints; `print_phase`
+    chooses forward values, backward cotangents, or both."""
+    import jax
+
+    from ..ops._helpers import to_tensor_like
+    from ..ops.dispatch import apply
+
+    input = to_tensor_like(input)
+    assert print_phase in ("forward", "backward", "both"), print_phase
+    name = getattr(input, "name", None)
+    lock = threading.Lock()
+    counts = {"forward": 0, "backward": 0}
+
+    def emit(value, phase):
+        with lock:
+            if 0 <= first_n <= counts[phase]:
+                return
+            counts[phase] += 1
+        sys.stderr.write(_format(value, name, message, summarize,
+                                 print_tensor_name, print_tensor_type,
+                                 print_tensor_shape, phase) + "\n")
+        sys.stderr.flush()
+
+    @jax.custom_vjp
+    def print_op(v):
+        if print_phase in ("forward", "both"):
+            jax.debug.callback(emit, v, "forward")
+        return v
+
+    def fwd(v):
+        return print_op(v), None
+
+    def bwd(_, g):
+        if print_phase in ("backward", "both"):
+            jax.debug.callback(emit, g, "backward")
+        return (g,)
+
+    print_op.defvjp(fwd, bwd)
+    return apply("print", print_op, input)

@@ -1,0 +1,182 @@
+"""Print op + unused-parameter detection (VERDICT r4 missing #5).
+
+Reference: operators/print_op.cc + lodtensor_printer.cc (execution-time
+tensor dumps, fwd and bwd phases); framework/unused_var_check.cc
+(FLAGS_enable_unused_var_check)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, static
+
+
+class TestPrintOp:
+    def test_identity_and_forward_print(self, capfd):
+        x = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32))
+        y = static.Print(x, message="probe", print_phase="forward")
+        np.testing.assert_allclose(y.numpy(), [1.0, 2.0])
+        err = capfd.readouterr().err
+        assert "probe" in err and "[forward]" in err
+        assert "shape: [2]" in err and "float32" in err
+
+    def test_backward_phase_prints_cotangent(self, capfd):
+        x = paddle.to_tensor(np.asarray([3.0], np.float32),
+                             stop_gradient=False)
+        y = static.Print(x * 2.0, message="bp", print_phase="backward")
+        (y * 5.0).sum().backward()
+        err = capfd.readouterr().err
+        assert "bp" in err and "[backward]" in err and "5." in err
+        np.testing.assert_allclose(x.grad.numpy(), [10.0])
+
+    def test_first_n_caps_prints_per_site(self, capfd):
+        # first_n caps REPEATS of one Print op (reference print_op
+        # first_n attr), e.g. across Program replays — not distinct sites
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [1], "float32")
+            out = static.Print(x, message="capped", first_n=2,
+                               print_phase="forward")
+        exe = static.Executor()
+        for i in range(5):
+            exe.run(main, feed={"x": np.asarray([1.0], np.float32)},
+                    fetch_list=[out])
+        err = capfd.readouterr().err
+        assert err.count("capped") == 2
+
+    def test_prints_on_every_program_replay(self, capfd):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            out = static.Print(x * 3.0, message="replayed",
+                               print_phase="forward")
+        exe = static.Executor()
+        for i in range(3):
+            exe.run(main, feed={"x": np.asarray([float(i), 0.0],
+                                                np.float32)},
+                    fetch_list=[out])
+        err = capfd.readouterr().err
+        # trace-time print + one per replayed run
+        assert err.count("replayed") >= 3
+
+    def test_bad_phase_rejected(self):
+        x = paddle.to_tensor(np.asarray([1.0], np.float32))
+        with pytest.raises(AssertionError):
+            static.Print(x, print_phase="sideways")
+
+
+class TestUnusedVarCheck:
+    def test_warns_on_detached_parameter(self):
+        from paddle_tpu.framework import flags
+
+        net = nn.Linear(2, 2)
+        dead = paddle.Parameter(np.zeros((3,), np.float32))
+        opt = optimizer.SGD(0.1, parameters=list(net.parameters()) + [dead])
+        x = paddle.to_tensor(np.ones((1, 2), np.float32))
+        loss = net(x).sum()
+        loss.backward()
+        flags.set_flags({"FLAGS_enable_unused_var_check": True})
+        try:
+            with pytest.warns(UserWarning, match="no gradient"):
+                opt.step()
+        finally:
+            flags.set_flags({"FLAGS_enable_unused_var_check": False})
+        opt.clear_grad()
+
+    def test_silent_when_flag_off_or_all_used(self):
+        import warnings
+
+        from paddle_tpu.framework import flags
+
+        net = nn.Linear(2, 2)
+        opt = optimizer.SGD(0.1, parameters=net.parameters())
+        x = paddle.to_tensor(np.ones((1, 2), np.float32))
+        net(x).sum().backward()
+        flags.set_flags({"FLAGS_enable_unused_var_check": True})
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("error")
+                opt.step()  # every param has a grad: no warning
+        finally:
+            flags.set_flags({"FLAGS_enable_unused_var_check": False})
+
+
+class TestCTCAgainstTorch:
+    """ctc_loss parity vs torch's reference CPU implementation (the
+    VERDICT r4 op-breadth row named CTC as the canonical long-tail
+    example — lock it to an external oracle, fwd AND grad)."""
+
+    def _case(self, reduction, seed=0):
+        import torch
+
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.RandomState(seed)
+        T, B, C, L = 12, 3, 6, 4
+        logits = rng.randn(T, B, C).astype(np.float32)
+        labels = rng.randint(1, C, (B, L)).astype(np.int32)
+        in_len = np.asarray([12, 10, 7], np.int64)
+        lab_len = np.asarray([4, 3, 2], np.int64)
+
+        got = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                         paddle.to_tensor(in_len), paddle.to_tensor(lab_len),
+                         blank=0, reduction=reduction)
+
+        t_logits = torch.tensor(logits, requires_grad=True)
+        t_loss = torch.nn.functional.ctc_loss(
+            torch.log_softmax(t_logits, dim=-1),
+            torch.tensor(labels.astype(np.int64)),
+            torch.tensor(in_len), torch.tensor(lab_len),
+            blank=0, reduction="none")
+        if reduction == "mean":
+            ref = (t_loss / torch.tensor(lab_len, dtype=torch.float32)).mean()
+        elif reduction == "sum":
+            ref = t_loss.sum()
+        else:
+            ref = t_loss
+        return got, ref, t_logits, logits, labels, in_len, lab_len
+
+    def test_forward_matches_torch(self):
+        for reduction in ("none", "mean", "sum"):
+            got, ref, *_ = self._case(reduction)
+            np.testing.assert_allclose(got.numpy(),
+                                       ref.detach().numpy(),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_grad_matches_torch(self):
+        import torch
+
+        import paddle_tpu.nn.functional as F
+
+        got, ref, t_logits, logits, labels, in_len, lab_len = \
+            self._case("mean", seed=3)
+        ref.backward()
+        x = paddle.to_tensor(logits, stop_gradient=False)
+        loss = F.ctc_loss(x, paddle.to_tensor(labels),
+                          paddle.to_tensor(in_len),
+                          paddle.to_tensor(lab_len), blank=0,
+                          reduction="mean")
+        loss.backward()
+        np.testing.assert_allclose(x.grad.numpy(), t_logits.grad.numpy(),
+                                   rtol=1e-3, atol=1e-4)
+
+
+class TestPrintEdgeCases:
+    def test_summarize_minus_one_prints_all(self, capfd):
+        x = paddle.to_tensor(np.arange(64, dtype=np.float32))
+        static.Print(x, message="full", summarize=-1, print_phase="forward")
+        err = capfd.readouterr().err
+        assert "..." not in err.split("data:")[1]
+        assert "63." in err
+
+    def test_amp_does_not_cast_probe(self, capfd):
+        import paddle_tpu as p
+
+        x = paddle.to_tensor(np.asarray([1.000244140625], np.float32))
+        with p.amp.auto_cast(dtype="bfloat16", level="O2"):
+            y = static.Print(x, message="amped", print_phase="forward")
+        assert y.numpy().dtype == np.float32
+        err = capfd.readouterr().err
+        # bf16 would round to 1.0; the probe must show the f32 value
+        assert "1.0002" in err
