@@ -105,6 +105,24 @@ void ptpu_gather_i64(const int64_t* src, const int64_t* rows, int64_t n_rows,
   });
 }
 
+// Hogwild scatter-accumulate: table[slots[i]] += alpha * grads[i].
+// Deliberately NO locks and NO atomics — the reference HogwildWorker's
+// contract (device_worker.h:240): concurrent workers race on shared rows
+// and the occasional lost update is accepted for wait-free throughput.
+// ctypes releases the GIL for the duration of this call, so Python
+// worker THREADS genuinely update the table in parallel.
+void ptpu_scatter_axpy(float* table, int64_t stride, const int64_t* slots,
+                       int64_t n, int64_t dim, const float* grads,
+                       float alpha) {
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t row = slots[i];
+    if (row < 0) continue;
+    float* t = table + row * stride;
+    const float* g = grads + i * dim;
+    for (int64_t d = 0; d < dim; ++d) t[d] += alpha * g[d];
+  }
+}
+
 int ptpu_version() { return 1; }
 
 }  // extern "C"

@@ -57,9 +57,13 @@ class DeviceWorker:
 
 
 class HogwildWorker(DeviceWorker):
-    """device_worker.h:240 — the plain lock-free worker (the base loop IS
-    hogwild here; the subclass exists for reference-name parity and as the
-    hook point for Downpour-style specializations)."""
+    """device_worker.h:240 — the lock-free worker.  Pair with a
+    ``SparseTable(hogwild=True)``: its push path resolves slots under the
+    structure lock only and then updates rows through the native scatter
+    kernel (csrc ptpu_scatter_axpy) with the GIL RELEASED — so these
+    worker threads genuinely race on shared rows, last-writer-wins, the
+    reference's hogwild contract rather than a name-parity shell.  Dense
+    math inside train_func releases the GIL in jax's compiled compute."""
 
 
 class MultiTrainer:

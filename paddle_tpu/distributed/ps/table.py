@@ -114,7 +114,15 @@ class SparseTable:
 
     def __init__(self, dim: int, rule: str = "sgd", num_shards: int = 8,
                  initializer: Optional[str] = "uniform", init_scale=0.01,
-                 seed: int = 0, dtype=np.float32, **hyperparams):
+                 seed: int = 0, dtype=np.float32, hogwild: bool = False,
+                 **hyperparams):
+        if hogwild and rule != "sgd":
+            raise ValueError(
+                f"hogwild=True requires rule='sgd' (got {rule!r}): "
+                "stateful rowwise rules (adagrad/adam accumulators) need "
+                "read-modify-write on optimizer state, which the "
+                "lock-free path cannot provide")
+        self.hogwild = hogwild
         if rule not in _RULES:
             raise ValueError(f"rule must be one of {_RULES}")
         rng = np.random.RandomState(seed)
@@ -160,9 +168,37 @@ class SparseTable:
 
     def push(self, ids, grads, lr: float = 0.01) -> None:
         """Apply rowwise-optimizer updates for `grads` [N, dim] at `ids`
-        (duplicates merged by summation — PushSparse)."""
+        (duplicates merged by summation — PushSparse).
+
+        With ``hogwild=True`` and the sgd rule, the row math runs
+        LOCK-FREE through the native scatter kernel with the GIL released
+        (reference HogwildWorker, device_worker.h:240): worker threads
+        update shared rows concurrently, duplicates accumulate in
+        arrival order, races on a row are last-writer-wins, and a write
+        that lands on a just-reallocated arena is lost — the hogwild
+        contract.  Only slot allocation stays serialized (a torn index
+        would be corruption, not a stale read)."""
         ids, shard_of = self._route(ids)
-        grads = np.asarray(grads).reshape(len(ids), self.dim)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        if self.hogwild and self.rule == "sgd":
+            from ...io import native_feed
+
+            per_shard = []
+            with self._lock:  # structure only: id->slot + arena growth
+                for s in range(self.num_shards):
+                    mask = shard_of == s
+                    if mask.any():
+                        slots = self._shards[s].slots_for(ids[mask],
+                                                          create=True)
+                        per_shard.append((s, mask, slots))
+            for s, mask, slots in per_shard:
+                sh = self._shards[s]
+                vals = sh.values  # keep the arena alive across the call
+                if vals.dtype != np.float32 or not native_feed.scatter_axpy(
+                        vals, slots, grads[mask], -lr):
+                    np.add.at(vals, slots, (-lr * grads[mask]).astype(
+                        vals.dtype))
+            return
         with self._lock:
             for s in range(self.num_shards):
                 mask = shard_of == s

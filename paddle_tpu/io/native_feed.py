@@ -50,6 +50,9 @@ def _load() -> Optional[ctypes.CDLL]:
                                           ctypes.c_float]
     lib.ptpu_gather_i64.argtypes = [i64p, i64p, ctypes.c_int64,
                                     ctypes.c_int64, i64p]
+    lib.ptpu_scatter_axpy.argtypes = [f32p, ctypes.c_int64, i64p,
+                                      ctypes.c_int64, ctypes.c_int64,
+                                      f32p, ctypes.c_float]
     lib.ptpu_version.restype = ctypes.c_int
     _lib = lib
     return lib
@@ -123,3 +126,37 @@ def gather_rows(src: np.ndarray, rows: np.ndarray,
         out[...] = batch
         return out
     return batch
+
+
+def scatter_axpy(values: np.ndarray, slots: np.ndarray, grads: np.ndarray,
+                 alpha: float) -> bool:
+    """Lock-free ``values[slots[i]] += alpha * grads[i]`` through the
+    native engine with the GIL RELEASED (ctypes drops it for the call) —
+    the hogwild push kernel.  Returns False when the engine is absent
+    (caller falls back to numpy).  Negative slots are skipped."""
+    lib = _load()
+    if lib is None:
+        return False
+    # hard validation (not asserts): a shape mismatch here would be
+    # silent native heap corruption, not a python error
+    if values.dtype != np.float32 or not values.flags.c_contiguous:
+        raise ValueError("scatter_axpy: values must be C-contiguous f32")
+    grads = np.ascontiguousarray(grads, np.float32)
+    slots = np.ascontiguousarray(slots, np.int64)
+    dim = values.shape[1] if values.ndim > 1 else 1
+    if grads.reshape(-1).shape[0] != len(slots) * dim:
+        raise ValueError(
+            f"scatter_axpy: grads size {grads.size} != "
+            f"len(slots) {len(slots)} x row dim {dim}")
+    n_rows = values.shape[0] if values.ndim > 1 else values.shape[0] // dim
+    if len(slots) and int(slots.max(initial=-1)) >= n_rows:
+        raise ValueError(
+            f"scatter_axpy: slot {int(slots.max())} out of range "
+            f"({n_rows} rows)")
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.ptpu_scatter_axpy(
+        values.ctypes.data_as(f32p), dim, slots.ctypes.data_as(i64p),
+        len(slots), dim, grads.ctypes.data_as(f32p),
+        ctypes.c_float(alpha))
+    return True
