@@ -82,6 +82,21 @@ from . import text  # noqa: F401
 from . import utils  # noqa: F401
 from . import vision  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
+from .hapi import callbacks  # noqa: F401
+from .param_attr import ParamAttr  # noqa: F401
+from .distributed import DataParallel  # noqa: F401
+from .static import data  # noqa: F401
+from .compat import (  # noqa: F401
+    LoDTensor, LoDTensorArray, VarBase, addmm, cast, create_global_var,
+    crop_tensor, disable_dygraph, elementwise_add, elementwise_div,
+    elementwise_floordiv, elementwise_mod, elementwise_pow,
+    elementwise_sub, enable_dygraph, fill_constant, flops,
+    get_cuda_rng_state, get_cudnn_version,
+    get_tensor_from_selected_rows, has_inf, has_nan,
+    in_dygraph_mode, monkey_patch_math_varbase, monkey_patch_variable,
+    mv, rank, reduce_max, reduce_mean, reduce_min, reduce_prod,
+    reduce_sum, scatter_, set_cuda_rng_state, set_printoptions, shape,
+    tanh_)
 from .jit import to_static  # noqa: F401
 
 __version__ = "0.1.0"

@@ -1,0 +1,302 @@
+"""fluid-era top-level API compat (reference python/paddle/__init__.py —
+the 2.x surface still re-exports these legacy names, and user scripts
+written against them must run unmodified).
+
+Everything here is a thin, REAL implementation over the modern ops —
+fluid arg conventions (``dim``/``keep_dim``), legacy type names, mode
+shims — not stubs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .tensor import Tensor
+
+
+def _t(x):
+    from .ops._helpers import to_tensor_like
+
+    return to_tensor_like(x)
+
+
+# -- tensor fns with fluid spellings ----------------------------------------
+
+def cast(x, dtype):
+    """paddle.cast (fluid layers.cast)."""
+    return _t(x).astype(dtype)
+
+
+def mv(x, vec, name=None):
+    """Matrix-vector product (tensor/linalg.py mv)."""
+    from .ops import linalg
+
+    return linalg.matmul(_t(x), _t(vec))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) (tensor/math.py addmm)."""
+    from .ops import linalg, math
+
+    return math.add(math.scale(_t(input), beta),
+                    math.scale(linalg.matmul(_t(x), _t(y)), alpha))
+
+
+def rank(input):
+    """Tensor of the input's ndim (fluid layers.rank)."""
+    import jax.numpy as jnp
+
+    return Tensor(jnp.asarray(_t(input).ndim, jnp.int32))
+
+
+def shape(input):
+    """int32 tensor holding the runtime shape (fluid layers.shape)."""
+    import jax.numpy as jnp
+
+    return Tensor(jnp.asarray(_t(input).shape, jnp.int32))
+
+
+def has_inf(x):
+    import jax.numpy as jnp
+
+    return Tensor(jnp.isinf(_t(x)._value).any())
+
+
+def has_nan(x):
+    import jax.numpy as jnp
+
+    return Tensor(jnp.isnan(_t(x)._value).any())
+
+
+def tanh_(x):
+    """In-place tanh (tensor/ops tanh_) — routed through the dispatcher
+    and adopted via _replace_from so the op enters the autograd graph
+    (the repo's in-place convention, e.g. ops/manipulation.py reshape_)."""
+    from .ops import math
+
+    x = _t(x)
+    x._replace_from(math.tanh(x))
+    return x
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    """In-place scatter (tensor/manipulation scatter_)."""
+    from .ops import manipulation
+
+    x = _t(x)
+    out = manipulation.scatter(x, _t(index), _t(updates),
+                               overwrite=overwrite)
+    x._replace_from(out)
+    return x
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    """fluid layers.fill_constant."""
+    from .ops import creation
+
+    res = creation.full(shape, value, dtype=dtype)
+    if out is not None:
+        out._replace_from(res)
+        return out
+    return res
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    """fluid layers.crop_tensor: slice a window of `shape` at `offsets`."""
+    x = _t(x)
+    if shape is None:
+        shape = list(x.shape)
+    shape = [int(s) for s in np.asarray(shape).reshape(-1)]
+    offsets = ([0] * len(shape) if offsets is None
+               else [int(o) for o in np.asarray(offsets).reshape(-1)])
+    # -1: crop from the offset to the end of that dimension (reference
+    # fluid/layers/nn.py crop_tensor case 2)
+    shape = [x.shape[i] - offsets[i] if s == -1 else s
+             for i, s in enumerate(shape)]
+    slices = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[slices]
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """fluid layers.create_global_var: a persistent (non-parameter)
+    value tensor; inside static recording it registers as Program state
+    so replays see updates."""
+    from .ops import creation
+
+    t = creation.full(shape, value, dtype=dtype)
+    t.stop_gradient = True
+    if name:
+        t.name = name
+    t.persistable = persistable
+    return t
+
+
+# -- fluid reduce_*/elementwise_* spellings ---------------------------------
+
+def _fluid_reduce(op_name):
+    def f(input, dim=None, keep_dim=False, name=None):
+        from .ops import math
+
+        return getattr(math, op_name)(_t(input), axis=dim,
+                                      keepdim=keep_dim)
+
+    f.__name__ = "reduce_" + op_name
+    f.__doc__ = f"fluid layers.reduce_{op_name} (dim/keep_dim spelling)."
+    return f
+
+
+reduce_sum = _fluid_reduce("sum")
+reduce_mean = _fluid_reduce("mean")
+reduce_max = _fluid_reduce("max")
+reduce_min = _fluid_reduce("min")
+reduce_prod = _fluid_reduce("prod")
+
+
+def _fluid_elementwise(op_name):
+    def f(x, y, axis=-1, act=None, name=None):
+        from .ops import math
+
+        x, y = _t(x), _t(y)
+        if 0 <= axis and y.ndim < x.ndim:
+            # fluid mid-axis broadcast: y aligns at `axis`, trailing
+            # singleton dims appended (classic NCHW bias-add)
+            from .ops import manipulation
+
+            new_shape = list(y.shape) + [1] * (x.ndim - axis - y.ndim)
+            y = manipulation.reshape(y, new_shape)
+        out = getattr(math, op_name)(x, y)
+        if act is not None:
+            import paddle_tpu.nn.functional as F
+
+            out = getattr(F, act)(out)
+        return out
+
+    f.__name__ = "elementwise_" + op_name
+    return f
+
+
+elementwise_add = _fluid_elementwise("add")
+elementwise_sub = _fluid_elementwise("subtract")
+elementwise_div = _fluid_elementwise("divide")
+elementwise_mod = _fluid_elementwise("mod")
+elementwise_pow = _fluid_elementwise("pow")
+elementwise_floordiv = _fluid_elementwise("floor_divide")
+
+
+# -- printing ---------------------------------------------------------------
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """paddle.set_printoptions — maps onto numpy's print options (Tensor
+    repr renders through numpy here)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+# -- mode shims -------------------------------------------------------------
+
+def enable_dygraph(place=None):
+    from .static import disable_static
+
+    disable_static()
+
+
+def disable_dygraph():
+    from .static import enable_static
+
+    enable_static()
+
+
+def in_dygraph_mode():
+    from .static import static_mode_enabled
+
+    return not static_mode_enabled()
+
+
+def get_cuda_rng_state():
+    """Device RNG state (CUDA name kept for script compat; this is the
+    framework generator's state on TPU)."""
+    from .framework import random as _r
+
+    return _r.get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    from .framework import random as _r
+
+    _r.set_rng_state(state)
+
+
+def get_cudnn_version():
+    """None on TPU: there is no cuDNN (reference returns None when CUDA
+    is absent — same contract)."""
+    return None
+
+
+# -- legacy types -----------------------------------------------------------
+
+VarBase = Tensor          # dygraph VarBase IS the Tensor here
+LoDTensor = Tensor        # LoD metadata maps to padded+lengths tensors
+
+
+class LoDTensorArray(list):
+    """fluid LoDTensorArray: an append-only tensor list (the dygraph
+    implementation in the reference is also a Python list)."""
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """SelectedRows -> dense rows (reference get_tensor_from_selected_rows);
+    the IndexedSlices analog densifies through its own helper."""
+    from .sparse_grad import IndexedSlices
+
+    if isinstance(x, IndexedSlices):
+        return Tensor(x.to_dense())
+    return _t(x)
+
+
+def monkey_patch_math_varbase():
+    """No-op: Tensor operators are bound at import (tensor.py); kept so
+    reference scripts that invoke the patch hooks still run."""
+
+
+def monkey_patch_variable():
+    """No-op: see monkey_patch_math_varbase."""
+
+
+# -- model profiling --------------------------------------------------------
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """paddle.flops: FLOPs of one forward pass (reference hapi
+    dynamic_flops.py counts per-layer; here XLA's cost model counts the
+    COMPILED forward — fusion-accurate, covers custom ops for free)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .jit.functional import functional_call, get_state
+
+    params, buffers = get_state(net)
+    x = jnp.zeros(tuple(input_size), jnp.float32)
+
+    def fwd(p, xv):
+        out, _ = functional_call(net, p, buffers, (xv,), training=False)
+        return out
+
+    compiled = jax.jit(fwd).lower(params, x).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    total = int(ca.get("flops", 0.0))
+    if print_detail:
+        print(f"Total Flops: {total}  (XLA cost model, compiled forward, "
+              f"input {list(input_size)})")
+    return total
