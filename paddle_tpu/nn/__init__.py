@@ -41,3 +41,20 @@ from .transformer import (  # noqa: F401
     TransformerEncoderLayer,
 )
 from .clip_grad import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+
+# remaining reference nn/__init__.py surface (round 5)
+from . import functional as common  # noqa: F401  (reference re-exports the
+#   functional submodules under these names)
+from .functional import conv, extension, loss, norm  # noqa: F401
+from .functional import common as _fcommon  # noqa: F401
+vision = extension  # image_resize/space_to_depth/... live there
+weight_norm_hook = norm
+from .rnn import RNNCellBase  # noqa: F401
+from .decode import BeamSearchDecoder as Decoder  # noqa: F401 — abstract
+#   Decoder's only concrete reference subclass
+from ..jit.control_flow import cond, while_loop  # noqa: F401
+from ..static import InputSpec as Input  # noqa: F401
+from .layers_extra import (  # noqa: F401
+    DynamicRNN, HSigmoidLoss, NCELoss, PairwiseDistance, StaticRNN,
+    TreeConv, ctc_greedy_decoder)
+from .functional.extension import crf_decoding  # noqa: F401

@@ -41,3 +41,38 @@ def ring_attention(query, key, value, axis_name="sp", causal=False, name=None):
                                       to_tensor_like(value)._value,
                                       axis_name=axis_name, causal=causal)
     return Tensor(out)
+
+# fluid.layers functional surface (reference nn/functional/__init__.py
+# re-exports) — implementations in extension.py plus the sequence /
+# detection ops that live in ops/ and vision/
+from .extension import (  # noqa: F401
+    add_position_encoding, affine_channel, array_length, array_read,
+    array_write, autoincreased_step_counter, bilinear_tensor_product,
+    birnn, bpr_loss, center_loss, continuous_value_model, create_array,
+    crf_decoding, data_norm, diag_embed, dynamic_gru, dynamic_lstm,
+    dynamic_lstmp, elu_, fc, filter_by_instag, fsp_matrix, gather_tree,
+    gru_unit, hash, hsigmoid_loss, im2sequence, image_resize,
+    image_resize_short, linear_chain_crf, lod_append, lod_reset, lstm,
+    lstm_unit, merge_selected_rows, nce, pad2d, pad_constant_like,
+    pool2d, pool3d, random_crop, relu_, reorder_lod_tensor_by_rank,
+    resize_bilinear, resize_nearest, resize_trilinear, rnn, roi_pool,
+    shuffle_channel, similarity_focus, smooth_l1, soft_relu, softmax_,
+    multi_box_head, space_to_depth, spectral_norm, tanh_,
+    teacher_student_sigmoid_loss, tensor_array_to_tensor, warpctc)
+from ...ops.math import erf  # noqa: F401
+from ...ops.sequence import (  # noqa: F401
+    sequence_concat, sequence_conv, sequence_enumerate, sequence_expand,
+    sequence_expand_as, sequence_first_step, sequence_last_step,
+    sequence_mask, sequence_pad, sequence_pool, sequence_reshape,
+    sequence_reverse, sequence_scatter, sequence_slice,
+    sequence_softmax, sequence_unpad)
+from ...ops.detection import (  # noqa: F401
+    anchor_generator, bipartite_match, box_clip, box_coder,
+    box_decoder_and_assign, collect_fpn_proposals, density_prior_box,
+    detection_output, distribute_fpn_proposals, generate_proposals,
+    multiclass_nms, polygon_box_transform, prior_box, psroi_pool,
+    deformable_roi_pooling, generate_proposal_labels, prroi_pool,
+    retinanet_detection_output, retinanet_target_assign, roi_align,
+    roi_perspective_transform, rpn_target_assign, target_assign,
+    yolo_box, yolov3_loss)
+from .conv import deformable_conv  # noqa: F401

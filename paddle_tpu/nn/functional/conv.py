@@ -219,3 +219,92 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
     return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
                               dilation, groups, data_format == "NDHWC", 3,
                               output_size, "conv3d_transpose")
+
+
+def deformable_conv(x, offset, mask, weight, bias=None, stride=1,
+                    padding=0, dilation=1, deformable_groups=1, groups=1,
+                    im2col_step=1, name=None):
+    """Deformable conv v1/v2 (deformable_conv_op.cc): each kernel tap
+    samples at its grid position PLUS a learned offset (bilinear), then
+    an ordinary matmul with the weights; `mask` (v2 modulation) scales
+    each sampled value.  x [N,C,H,W]; offset [N, 2*dg*kh*kw, oh, ow];
+    mask [N, dg*kh*kw, oh, ow] or None; weight [M, C//groups, kh, kw]."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...ops._helpers import to_tensor_like
+    from ...ops.dispatch import apply
+
+    xt = to_tensor_like(x)
+    off = to_tensor_like(offset)
+    w = to_tensor_like(weight)
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    dg = int(deformable_groups)
+    G = int(groups)
+
+    def f(v, ofs, wv, *rest):
+        mk = rest[0] if (mask is not None) else None
+        bv = rest[-1] if (bias is not None) else None
+        N, C, H, W = v.shape
+        M, Cg, kh, kw = wv.shape
+        oh = (H + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        ow = (W + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        base_y = (jnp.arange(oh) * s[0] - p[0])[:, None]     # [oh, 1]
+        base_x = (jnp.arange(ow) * s[1] - p[1])[None, :]     # [1, ow]
+        cpg = C // dg                       # channels per deformable group
+        cols = []
+        for ky in range(kh):
+            for kx in range(kw):
+                t = ky * kw + kx
+                samps = []
+                for gd in range(dg):        # per-group offsets/modulation
+                    tt = gd * kh * kw + t
+                    oy = ofs[:, 2 * tt]                       # [N, oh, ow]
+                    ox = ofs[:, 2 * tt + 1]
+                    sy = base_y[None] + ky * d[0] + oy
+                    sx = base_x[None] + kx * d[1] + ox
+                    y0 = jnp.floor(sy).astype(jnp.int32)
+                    x0 = jnp.floor(sx).astype(jnp.int32)
+                    fy = sy - y0
+                    fx = sx - x0
+                    vc = v[:, gd * cpg:(gd + 1) * cpg]
+
+                    def g(yy, xx):
+                        ok = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+                        val = vc[jnp.arange(N)[:, None, None, None],
+                                 jnp.arange(cpg)[None, :, None, None],
+                                 jnp.clip(yy, 0, H - 1)[:, None],
+                                 jnp.clip(xx, 0, W - 1)[:, None]]
+                        return jnp.where(ok[:, None], val, 0.0)
+
+                    samp = (g(y0, x0) * ((1 - fy) * (1 - fx))[:, None]
+                            + g(y0, x0 + 1) * ((1 - fy) * fx)[:, None]
+                            + g(y0 + 1, x0) * (fy * (1 - fx))[:, None]
+                            + g(y0 + 1, x0 + 1) * (fy * fx)[:, None])
+                    if mk is not None:
+                        samp = samp * mk[:, tt][:, None]
+                    samps.append(samp)
+                cols.append(jnp.concatenate(samps, axis=1))   # [N, C, oh, ow]
+        colmat = jnp.stack(cols, axis=2)          # [N, C, kh*kw, oh, ow]
+        # grouped matmul: weight group g consumes input channel block g
+        mpg = M // G
+        outs = []
+        for gg in range(G):
+            cm = colmat[:, gg * Cg:(gg + 1) * Cg]
+            wg = wv[gg * mpg:(gg + 1) * mpg]
+            outs.append(jnp.einsum("nckhw,mck->nmhw", cm,
+                                   wg.reshape(mpg, Cg, kh * kw)))
+        out = jnp.concatenate(outs, axis=1)
+        if bv is not None:
+            out = out + bv.reshape(1, M, 1, 1)
+        return out
+
+    args = [xt, off, w]
+    if mask is not None:
+        args.append(to_tensor_like(mask))
+    if bias is not None:
+        args.append(to_tensor_like(bias))
+    return apply("deformable_conv", f, *args)

@@ -1,0 +1,1185 @@
+"""fluid.layers functional surface (reference nn/functional/__init__.py
+re-exports these from fluid.layers / extension.py).  Real implementations
+over the modern ops — the param-creating static-graph forms delegate to
+static.nn where that is their only meaning.
+"""
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops._helpers import to_tensor_like
+from ...ops.dispatch import apply
+from ...tensor import Tensor
+
+# --------------------------------------------------------------------------
+# resize family (fluid/layers/nn.py image_resize:7800)
+# --------------------------------------------------------------------------
+
+_RESAMPLE = {"BILINEAR": "bilinear", "NEAREST": "nearest",
+             "TRILINEAR": "trilinear", "BICUBIC": "bicubic",
+             "LINEAR": "linear"}
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", actual_shape=None, align_corners=True,
+                 align_mode=1, data_format="NCHW"):
+    from .common import interpolate
+
+    mode = _RESAMPLE.get(str(resample).upper(), str(resample).lower())
+    return interpolate(input, size=out_shape, scale_factor=scale, mode=mode,
+                       align_corners=align_corners, align_mode=align_mode,
+                       data_format=data_format)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1,
+                    data_format="NCHW"):
+    return image_resize(input, out_shape, scale, name, "BILINEAR",
+                        actual_shape, align_corners, align_mode, data_format)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True,
+                   data_format="NCHW"):
+    return image_resize(input, out_shape, scale, name, "NEAREST",
+                        actual_shape, align_corners, 1, data_format)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    return image_resize(input, out_shape, scale, name, "TRILINEAR",
+                        actual_shape, align_corners, align_mode, data_format)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize so the SHORTER spatial side equals out_short_len."""
+    x = to_tensor_like(input)
+    h, w = x.shape[-2], x.shape[-1]
+    short, long_ = (h, w) if h <= w else (w, h)
+    new_long = int(round(long_ * out_short_len / short))
+    out = (out_short_len, new_long) if h <= w else (new_long, out_short_len)
+    return image_resize(x, out_shape=out, resample=resample)
+
+
+def random_crop(x, shape, seed=None):
+    """Random spatial crop to `shape` (trailing dims; fluid random_crop)."""
+    from ...framework.random import next_rng_key
+
+    x = to_tensor_like(x)
+    shape = [int(s) for s in shape]
+    lead = x.ndim - len(shape)
+
+    def f(v, key):
+        keys = jax.random.split(key, len(shape))
+        starts = [jax.random.randint(keys[i], (), 0,
+                                     v.shape[lead + i] - shape[i] + 1)
+                  for i in range(len(shape))]
+        idx = tuple([slice(None)] * lead)
+        return jax.lax.dynamic_slice(
+            v, [0] * lead + [s for s in starts],
+            list(v.shape[:lead]) + shape)
+
+    return apply("random_crop", f, x, Tensor(next_rng_key()))
+
+
+# --------------------------------------------------------------------------
+# pooling / padding fluid spellings
+# --------------------------------------------------------------------------
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True, data_format="NCHW"):
+    from .pooling import avg_pool2d, max_pool2d
+
+    x = to_tensor_like(input)
+    if global_pooling:
+        hw = (x.shape[2], x.shape[3]) if data_format == "NCHW" else \
+            (x.shape[1], x.shape[2])
+        pool_size, pool_stride, pool_padding = hw, hw, 0
+    fn = max_pool2d if pool_type == "max" else avg_pool2d
+    kw = {} if pool_type == "max" else {"exclusive": exclusive}
+    return fn(x, kernel_size=pool_size, stride=pool_stride,
+              padding=pool_padding, ceil_mode=ceil_mode,
+              data_format=data_format, **kw)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True, data_format="NCDHW"):
+    from .pooling import avg_pool3d, max_pool3d
+
+    x = to_tensor_like(input)
+    if global_pooling:
+        d = (x.shape[2], x.shape[3], x.shape[4]) if data_format == "NCDHW" \
+            else (x.shape[1], x.shape[2], x.shape[3])
+        pool_size, pool_stride, pool_padding = d, d, 0
+    fn = max_pool3d if pool_type == "max" else avg_pool3d
+    kw = {} if pool_type == "max" else {"exclusive": exclusive}
+    return fn(x, kernel_size=pool_size, stride=pool_stride,
+              padding=pool_padding, ceil_mode=ceil_mode,
+              data_format=data_format, **kw)
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    """fluid pad2d: paddings = [top, bottom, left, right]."""
+    from .common import pad as _pad
+
+    t, b, l, r = [int(p) for p in paddings]
+    if mode == "edge":          # fluid spelling of replicate
+        mode = "replicate"
+    # F.pad takes [left, right, top, bottom] for 4-D
+    return _pad(to_tensor_like(input), [l, r, t, b], mode=mode,
+                value=pad_value, data_format=data_format)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Pad y at the END of each dim up to x's shape (pad_constant_like_op)."""
+    x, y = to_tensor_like(x), to_tensor_like(y)
+    pads = [(0, int(xs) - int(ys)) for xs, ys in zip(x.shape, y.shape)]
+
+    def f(v):
+        return jnp.pad(v, pads, constant_values=pad_value)
+
+    return apply("pad_constant_like", f, y)
+
+
+# --------------------------------------------------------------------------
+# misc layer math
+# --------------------------------------------------------------------------
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """Param-creating fc (fluid layers.fc) — static.nn.fc is the real
+    implementation; usable in dygraph too (params cached per call site
+    would be surprising there, so it requires an active name or program —
+    static.nn handles both)."""
+    from ...static import nn as static_nn
+
+    return static_nn.fc(input, size, num_flatten_dims=num_flatten_dims,
+                        param_attr=param_attr, bias_attr=bias_attr,
+                        act=act, name=name)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    """tensor/creation diag_embed: last dim -> diagonal plane."""
+    x = to_tensor_like(input)
+
+    def f(v):
+        out = jnp.zeros(v.shape + (v.shape[-1] + abs(offset),), v.dtype)
+        n = v.shape[-1]
+        rows = jnp.arange(n) + max(-offset, 0)
+        cols = jnp.arange(n) + max(offset, 0)
+        pad_n = n + abs(offset)
+        eye = jnp.zeros((n, pad_n, pad_n), v.dtype)
+        eye = eye.at[jnp.arange(n), rows, cols].set(1.0)
+        out = jnp.einsum("...i,ijk->...jk", v, eye)
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        # place the two new axes at dim1/dim2
+        order = []
+        src = iter(perm)
+        for i in range(nd):
+            if i == d1:
+                order.append(nd - 2)
+            elif i == d2:
+                order.append(nd - 1)
+            else:
+                order.append(next(src))
+        return jnp.transpose(out, order)
+
+    return apply("diag_embed", f, x)
+
+
+def space_to_depth(x, blocksize, name=None):
+    """[N,C,H,W] -> [N, C*bs*bs, H/bs, W/bs] (space_to_depth_op)."""
+    x = to_tensor_like(x)
+    bs = int(blocksize)
+
+    def f(v):
+        N, C, H, W = v.shape
+        v = v.reshape(N, C, H // bs, bs, W // bs, bs)
+        v = v.transpose(0, 3, 5, 1, 2, 4)
+        return v.reshape(N, C * bs * bs, H // bs, W // bs)
+
+    return apply("space_to_depth", f, x)
+
+
+def shuffle_channel(x, group, name=None):
+    """ShuffleNet channel shuffle (shuffle_channel_op)."""
+    x = to_tensor_like(x)
+    g = int(group)
+
+    def f(v):
+        N, C, H, W = v.shape
+        return v.reshape(N, g, C // g, H, W).swapaxes(1, 2).reshape(
+            N, C, H, W)
+
+    return apply("shuffle_channel", f, x)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    """log(1 + exp(clip(x, -t, t))) (fluid soft_relu)."""
+    x = to_tensor_like(x)
+
+    def f(v):
+        return jnp.log1p(jnp.exp(jnp.clip(v, -threshold, threshold)))
+
+    return apply("soft_relu", f, x)
+
+
+def affine_channel(x, scale=None, bias=None, data_format="NCHW",
+                   act=None, name=None):
+    """Per-channel scale + bias (affine_channel_op — frozen-BN form)."""
+    x = to_tensor_like(x)
+    scale = to_tensor_like(scale)
+    bias = to_tensor_like(bias)
+    axis = 1 if data_format in ("NCHW", "NCDHW") else x.ndim - 1
+
+    def f(v, s, b):
+        shape = [1] * v.ndim
+        shape[axis] = v.shape[axis]
+        return v * s.reshape(shape) + b.reshape(shape)
+
+    out = apply("affine_channel", f, x, scale, bias)
+    if act is not None:
+        from . import activation
+
+        out = getattr(activation, act)(out)
+    return out
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    """out = alpha*x + beta*sinusoid_position_encoding
+    (add_position_encoding_op: interleaved sin/cos over channels)."""
+    x = to_tensor_like(input)
+
+    def f(v):
+        B, S, C = v.shape
+        half = C // 2
+        pos = jnp.arange(S, dtype=jnp.float32)[:, None]
+        div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+        enc = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)],
+                              axis=1)
+        if enc.shape[1] < C:
+            enc = jnp.pad(enc, ((0, 0), (0, C - enc.shape[1])))
+        return (alpha * v + beta * enc[None].astype(v.dtype)).astype(v.dtype)
+
+    return apply("add_position_encoding", f, x)
+
+
+def bilinear_tensor_product(x, y, weight, bias=None, act=None, name=None,
+                            size=None, param_attr=None, bias_attr=None):
+    """out[:, k] = x W_k y^T + b (bilinear_tensor_product_op).  The
+    param-creating fluid form became explicit-weight here (dygraph
+    convention — same as paddle.nn.Bilinear)."""
+    x, y, weight = (to_tensor_like(x), to_tensor_like(y),
+                    to_tensor_like(weight))
+
+    def f(xv, yv, w, *maybe_b):
+        out = jnp.einsum("bi,kij,bj->bk", xv, w, yv)
+        if maybe_b:
+            out = out + maybe_b[0]
+        return out
+
+    if bias is not None:
+        out = apply("bilinear_tensor_product", f, x, y, weight,
+                    to_tensor_like(bias))
+    else:
+        out = apply("bilinear_tensor_product", f, x, y, weight)
+    if act is not None:
+        from . import activation
+
+        out = getattr(activation, act)(out)
+    return out
+
+
+def hash(input, hash_size, num_hash=1, name=None):  # noqa: A001 — ref name
+    """Deterministic multi-hash of int ids into [0, hash_size)
+    (hash_op.cc: xxhash mod hash_size per hash seed)."""
+    x = to_tensor_like(input)
+    hs = int(hash_size)
+    nh = int(num_hash)
+
+    def f(v):
+        iv = v.astype(jnp.uint32)
+        outs = []
+        for k in range(nh):
+            h = iv * jnp.uint32(0x9E3779B1) ^ jnp.uint32(0x85EBCA77 * (k + 1))
+            h = h ^ (h >> 15)
+            h = h * jnp.uint32(0x2C1B3C6D)
+            h = h ^ (h >> 13)
+            outs.append((h % jnp.uint32(hs)).astype(jnp.int64))
+        return jnp.stack(outs, axis=-1)
+
+    return apply("hash", f, x)
+
+
+def fsp_matrix(x, y):
+    """Flow-of-solution-procedure matrix (fsp_op — distillation):
+    [N, C1, H, W] x [N, C2, H, W] -> [N, C1, C2]."""
+    x, y = to_tensor_like(x), to_tensor_like(y)
+
+    def f(a, b):
+        N, C1, H, W = a.shape
+        return jnp.einsum("nchw,ndhw->ncd", a, b) / (H * W)
+
+    return apply("fsp_matrix", f, x, y)
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """similarity_focus_op: build a focus mask by winner rows/cols of the
+    selected channel slices."""
+    x = to_tensor_like(input)
+    idxs = list(indexes)
+
+    if axis not in (1, 2, 3):
+        raise ValueError(f"similarity_focus: axis must be 1, 2 or 3, "
+                         f"got {axis}")
+
+    def f(v):
+        mask = jnp.zeros_like(v)
+        for ind in idxs:
+            sl = jnp.abs(jnp.take(v, ind, axis=axis))  # 3-D slice
+            # winners along each of the two remaining dims
+            rmax = sl.max(axis=2, keepdims=True)
+            cmax = sl.max(axis=1, keepdims=True)
+            m = ((sl == rmax) | (sl == cmax)).astype(v.dtype)
+            mask = jnp.maximum(mask, jnp.expand_dims(m, axis))
+        return v * mask
+
+    return apply("similarity_focus", f, x)
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    """fluid smooth_l1: rowwise-summed huber with sigma^2 transition and
+    inside/outside weights (smooth_l1_loss_op.cc)."""
+    x, y = to_tensor_like(x), to_tensor_like(y)
+    sigma2 = float(sigma if sigma is not None else 1.0) ** 2
+
+    has_iw = inside_weight is not None
+    has_ow = outside_weight is not None
+
+    def f(a, b, *w):
+        iw = w[0] if has_iw else jnp.ones_like(a)
+        ow = w[-1] if has_ow else jnp.ones_like(a)
+        d = (a - b) * iw
+        ad = jnp.abs(d)
+        val = jnp.where(ad < 1.0 / sigma2, 0.5 * d * d * sigma2,
+                        ad - 0.5 / sigma2)
+        val = val * ow
+        return val.reshape(val.shape[0], -1).sum(axis=1, keepdims=True)
+
+    args = [x, y]
+    if inside_weight is not None:
+        args.append(to_tensor_like(inside_weight))
+    if outside_weight is not None:
+        args.append(to_tensor_like(outside_weight))
+    return apply("smooth_l1", f, *args)
+
+
+# --------------------------------------------------------------------------
+# in-place activations
+# --------------------------------------------------------------------------
+
+def _inplace(fn_name):
+    def f(x, *args, **kwargs):
+        from . import activation
+
+        x = to_tensor_like(x)
+        x._replace_from(getattr(activation, fn_name)(x, *args, **kwargs))
+        return x
+
+    f.__name__ = fn_name + "_"
+    f.__doc__ = f"In-place {fn_name} (dispatcher-routed; autograd-visible)."
+    return f
+
+
+relu_ = _inplace("relu")
+elu_ = _inplace("elu")
+tanh_ = _inplace("tanh")
+
+
+def softmax_(x, axis=-1, name=None):
+    from . import activation
+
+    x = to_tensor_like(x)
+    x._replace_from(activation.softmax(x, axis=axis))
+    return x
+
+
+# --------------------------------------------------------------------------
+# tensor-array ops (fluid control-flow arrays — the dygraph reference
+# implements these over Python lists too)
+# --------------------------------------------------------------------------
+
+def create_array(dtype="float32"):
+    from ...compat import LoDTensorArray
+
+    return LoDTensorArray()
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = create_array()
+    i = int(np.asarray(to_tensor_like(i).numpy()).reshape(()))
+    while len(array) <= i:
+        array.append(None)
+    array[i] = to_tensor_like(x)
+    return array
+
+
+def array_read(array, i):
+    i = int(np.asarray(to_tensor_like(i).numpy()).reshape(()))
+    return array[i]
+
+
+def array_length(array):
+    return Tensor(jnp.asarray(len(array), jnp.int64))
+
+
+def tensor_array_to_tensor(input, axis=0, name=None, use_stack=False):
+    from ...ops import manipulation
+
+    items = [to_tensor_like(t) for t in input if t is not None]
+    if use_stack:
+        out = manipulation.stack(items, axis=axis)
+    else:
+        out = manipulation.concat(items, axis=axis)
+    sizes = Tensor(jnp.asarray([t.shape[axis] if not use_stack else 1
+                                for t in items], jnp.int32))
+    return out, sizes
+
+
+_step_counters = {}
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Global step counter (fluid autoincreased_step_counter): returns
+    the CURRENT step tensor and advances by `step` per call."""
+    key = counter_name or "@STEP_COUNTER@"
+    val = _step_counters.get(key, int(begin))
+    _step_counters[key] = val + int(step)
+    return Tensor(jnp.asarray(val, jnp.int64))
+
+
+def merge_selected_rows(x, name=None):
+    """Merge duplicate rows of a SelectedRows grad (IndexedSlices here) by
+    summation (merge_selected_rows op)."""
+    from ...sparse_grad import IndexedSlices
+
+    if not isinstance(x, IndexedSlices):
+        return to_tensor_like(x)
+    rows = np.asarray(x.rows)
+    uniq, inv = np.unique(rows, return_inverse=True)
+    vals = jnp.zeros((len(uniq),) + tuple(x.values.shape[1:]),
+                     x.values.dtype).at[inv].add(x.values)
+    return IndexedSlices(jnp.asarray(uniq), vals, x.dense_shape)
+
+
+# --------------------------------------------------------------------------
+# ROI max pooling (roi_pool_op.cc — the max-pool sibling of roi_align)
+# --------------------------------------------------------------------------
+
+def roi_pool(input, boxes, boxes_num=None, output_size=1,
+             spatial_scale=1.0, rois=None, pooled_height=None,
+             pooled_width=None, name=None):
+    """Max-pool each ROI into a [ph, pw] grid with integer bin edges
+    (roi_pool_op.cc).  Computed as a masked max over the full feature
+    map per bin — O(HW) per bin, exact, jit-able with static shapes."""
+    x = to_tensor_like(input)
+    r = to_tensor_like(boxes if rois is None else rois)
+    if pooled_height is not None:
+        ph, pw = int(pooled_height), int(pooled_width)
+    elif isinstance(output_size, (tuple, list)):
+        ph, pw = int(output_size[0]), int(output_size[1])
+    else:
+        ph = pw = int(output_size)
+    scale = float(spatial_scale)
+
+    def f(v, rr):
+        N, C, H, W = v.shape
+        R = rr.shape[0]
+        x1 = jnp.round(rr[:, 0] * scale).astype(jnp.int32)
+        y1 = jnp.round(rr[:, 1] * scale).astype(jnp.int32)
+        x2 = jnp.maximum(jnp.round(rr[:, 2] * scale).astype(jnp.int32),
+                         x1 + 1)
+        y2 = jnp.maximum(jnp.round(rr[:, 3] * scale).astype(jnp.int32),
+                         y1 + 1)
+        bh = (y2 - y1).astype(jnp.float32) / ph
+        bw = (x2 - x1).astype(jnp.float32) / pw
+        ys = jnp.arange(H)[None, None, :]      # [1,1,H]
+        xs = jnp.arange(W)[None, None, :]
+        iy = jnp.arange(ph)[None, :, None]     # [1,ph,1]
+        ix = jnp.arange(pw)[None, :, None]
+        y_lo = y1[:, None, None] + jnp.floor(iy * bh[:, None, None]).astype(jnp.int32)
+        y_hi = y1[:, None, None] + jnp.ceil((iy + 1) * bh[:, None, None]).astype(jnp.int32)
+        x_lo = x1[:, None, None] + jnp.floor(ix * bw[:, None, None]).astype(jnp.int32)
+        x_hi = x1[:, None, None] + jnp.ceil((ix + 1) * bw[:, None, None]).astype(jnp.int32)
+        ymask = (ys >= y_lo) & (ys < y_hi)     # [R,ph,H]
+        xmask = (xs >= x_lo) & (xs < x_hi)     # [R,pw,W]
+        # [R, 1, ph, pw, H, W] bin mask against [1, C, 1, 1, H, W] feature
+        # (all rois on image 0 — pass per-image crops for batched inputs,
+        # the reference's LoD roi batching maps to a caller-side split)
+        m = (ymask[:, :, None, :, None] &
+             xmask[:, None, :, None, :])[:, None]
+        big = jnp.where(m, v[0][None, :, None, None, :, :], -jnp.inf)
+        out = big.max(axis=(-1, -2))           # [R, C, ph, pw]
+        return jnp.where(jnp.isfinite(out), out, 0.0).astype(v.dtype)
+
+    return apply("roi_pool", f, x, r)
+
+
+# --------------------------------------------------------------------------
+# linear-chain CRF (linear_chain_crf_op.cc + crf_decoding_op.cc)
+# --------------------------------------------------------------------------
+
+def linear_chain_crf(input, label, transition, length, name=None):
+    """Negative log-likelihood of a linear-chain CRF over padded batches.
+
+    input [B, T, K] emission scores; label [B, T] int; transition
+    [K+2, K]: row 0 = start scores, row 1 = stop scores, rows 2.. =
+    transition[from, to] (the reference's parameter layout).  `length`
+    [B] valid steps.  The param-creating fluid form takes the transition
+    explicitly here (dygraph convention).  Returns [B] NLL."""
+    x = to_tensor_like(input)
+    y = to_tensor_like(label)
+    w = to_tensor_like(transition)
+    ln = to_tensor_like(length)
+
+    def f(emit, lab, trans, lens):
+        B, T, K = emit.shape
+        start, stop, A = trans[0], trans[1], trans[2:]
+        emit = emit.astype(jnp.float32)
+        lab = lab.astype(jnp.int32)
+        t_idx = jnp.arange(T)
+        valid = t_idx[None, :] < lens[:, None]                 # [B, T]
+
+        # ---- gold path score
+        e_score = jnp.take_along_axis(emit, lab[..., None],
+                                      axis=2)[..., 0]          # [B, T]
+        e_score = jnp.where(valid, e_score, 0.0).sum(axis=1)
+        trans_score = A[lab[:, :-1], lab[:, 1:]]               # [B, T-1]
+        pair_valid = valid[:, 1:]
+        trans_score = jnp.where(pair_valid, trans_score, 0.0).sum(axis=1)
+        first = lab[:, 0]
+        last = jnp.take_along_axis(
+            lab, jnp.maximum(lens - 1, 0)[:, None].astype(jnp.int32),
+            axis=1)[:, 0]
+        gold = e_score + trans_score + start[first] + stop[last]
+
+        # ---- log partition (forward algorithm)
+        alpha0 = start[None, :] + emit[:, 0]                   # [B, K]
+
+        def step(alpha, t):
+            nxt = jax.nn.logsumexp(alpha[:, :, None] + A[None], axis=1) \
+                + emit[:, t]
+            return jnp.where((t < lens)[:, None], nxt, alpha), None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        logz = jax.nn.logsumexp(alpha + stop[None, :], axis=1)
+        return logz - gold
+
+    return apply("linear_chain_crf", f, x, y, w, ln)
+
+
+def crf_decoding(input, transition, length, label=None, name=None):
+    """Viterbi decode (crf_decoding_op.cc): best path per sequence.
+    Returns [B, T] int64 paths (positions past `length` hold 0); with
+    `label`, returns a correctness mask like the reference."""
+    x = to_tensor_like(input)
+    w = to_tensor_like(transition)
+    ln = to_tensor_like(length)
+
+    def f(emit, trans, lens):
+        B, T, K = emit.shape
+        start, stop, A = trans[0], trans[1], trans[2:]
+        emit = emit.astype(jnp.float32)
+        delta0 = start[None, :] + emit[:, 0]
+
+        def fwd(delta, t):
+            scores = delta[:, :, None] + A[None]               # [B, K, K]
+            best = scores.max(axis=1) + emit[:, t]
+            arg = scores.argmax(axis=1)
+            live = (t < lens)[:, None]
+            return jnp.where(live, best, delta), jnp.where(
+                live, arg, jnp.arange(K)[None, :])
+
+        delta, back = jax.lax.scan(fwd, delta0, jnp.arange(1, T))
+        # stop scores only apply at each sequence's true end
+        lastk = (delta + stop[None, :]).argmax(axis=1)          # [B]
+
+        def bwd(k, t):
+            # t runs T-2 .. 0; backptr index t corresponds to step t+1
+            prev = back[t][jnp.arange(B), k]
+            use = (t + 1) < lens
+            return jnp.where(use, prev, k), k
+
+        ks, path_rev = jax.lax.scan(bwd, lastk,
+                                    jnp.arange(T - 2, -1, -1))
+        path = jnp.concatenate([ks[:, None],
+                                jnp.flip(path_rev.T, axis=1)[:, :-1],
+                                lastk[:, None]], axis=1) \
+            if T > 1 else lastk[:, None]
+        valid = jnp.arange(T)[None, :] < lens[:, None]
+        return jnp.where(valid, path, 0).astype(jnp.int64)
+
+    path = apply("crf_decoding", f, x, w, ln)
+    if label is not None:
+        from ...ops import logic
+
+        return logic.equal(path, to_tensor_like(label))
+    return path
+
+
+# --------------------------------------------------------------------------
+# losses
+# --------------------------------------------------------------------------
+
+def bpr_loss(input, label, name=None):
+    """Bayesian personalized ranking (bpr_loss_op.cc): mean over
+    non-target classes of -log sigmoid(x_y - x_j).  Returns [N, 1]."""
+    x = to_tensor_like(input)
+    y = to_tensor_like(label)
+
+    def f(v, lab):
+        N, C = v.shape
+        pos = jnp.take_along_axis(v, lab.reshape(N, 1).astype(jnp.int32),
+                                  axis=1)
+        diff = pos - v
+        lse = jnp.log1p(jnp.exp(-diff))
+        mask = jnp.ones((N, C)).at[jnp.arange(N),
+                                   lab.reshape(-1).astype(jnp.int32)].set(0)
+        return (lse * mask).sum(axis=1, keepdims=True) / (C - 1)
+
+    return apply("bpr_loss", f, x, y)
+
+
+def center_loss(input, label, num_classes, alpha=0.1, centers=None,
+                update_center=True, param_attr=None, name=None):
+    """Center loss (center_loss_op.cc): 0.5||x - c_y||^2, with running
+    center updates.  `centers` is an explicit [num_classes, D] Tensor
+    here (the fluid form creates it as a parameter); updates mutate it
+    in place when update_center."""
+    x = to_tensor_like(input)
+    y = to_tensor_like(label)
+    if centers is None:
+        centers = Tensor(jnp.zeros((int(num_classes), x.shape[-1]),
+                                   jnp.float32))
+    c = to_tensor_like(centers)
+
+    def f(v, lab, cen):
+        lab = lab.reshape(-1).astype(jnp.int32)
+        diff = v - cen[lab]
+        return 0.5 * (diff ** 2).sum(axis=1, keepdims=True)
+
+    loss = apply("center_loss", f, x, y, c)
+    if update_center:
+        lab = np.asarray(y.numpy()).reshape(-1).astype(np.int64)
+        vx = x._value
+        cv = c._value
+        diff = cv[lab] - vx
+        counts = jnp.zeros((cv.shape[0], 1)).at[lab].add(1.0) + 1.0
+        upd = jnp.zeros_like(cv).at[lab].add(diff)
+        c._value = cv - alpha * upd / counts
+        c._inplace_version += 1
+    return loss, c
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """teacher_student_sigmoid_loss_op.cc: CTR distillation loss —
+    log(1+exp(x)) - x*z  (+ teacher soft-label term when z not in {0,1})."""
+    x = to_tensor_like(input)
+    y = to_tensor_like(label)
+
+    def f(v, z):
+        v = jnp.clip(v, soft_max_lower_bound, soft_max_up_bound)
+        return jnp.log1p(jnp.exp(v)) - v * z
+
+    return apply("teacher_student_sigmoid_loss", f, x, y)
+
+
+def continuous_value_model(input, show, click):
+    """continuous_value_model op (CTR calibration): first embedding slot
+    becomes log(show), second log(click) - log(show)."""
+    x = to_tensor_like(input)
+    s = to_tensor_like(show)
+    c = to_tensor_like(click)
+
+    def f(v, sh, ck):
+        log_show = jnp.log(jnp.maximum(sh, 1.0))
+        log_ctr = jnp.log(jnp.maximum(ck, 1.0)) - log_show
+        return jnp.concatenate([log_show.reshape(-1, 1),
+                                log_ctr.reshape(-1, 1), v[:, 2:]], axis=1)
+
+    return apply("continuous_value_model", f, x, s, c)
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0):
+    """filter_by_instag_op: keep rows whose tag intersects filter_tag.
+    Fixed-shape TPU form: returns (rows zeroed where filtered, keep mask,
+    index map) instead of a compacted LoD."""
+    x = to_tensor_like(ins)
+    tags = to_tensor_like(ins_tag)
+    want = to_tensor_like(filter_tag)
+
+    def f(v, t, w):
+        keep = (t[:, None] == w[None, :]).any(axis=1)
+        kept = jnp.where(keep.reshape((-1,) + (1,) * (v.ndim - 1)), v,
+                         out_val_if_empty)
+        return kept, keep, jnp.where(keep, jnp.arange(t.shape[0]), -1)
+
+    return apply("filter_by_instag", f, x, tags, want)
+
+
+# --------------------------------------------------------------------------
+# functional RNN (fluid rnn/birnn + the unit/dynamic spellings)
+# --------------------------------------------------------------------------
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """paddle.nn.functional rnn: scan `cell` over the time axis
+    (fluid/layers/rnn.py rnn)."""
+    from ..rnn import RNN
+
+    runner = RNN(cell, is_reverse=is_reverse, time_major=time_major)
+    return runner(to_tensor_like(inputs), initial_states=initial_states,
+                  sequence_length=sequence_length, **kwargs)
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None,
+          sequence_length=None, time_major=False, **kwargs):
+    """Bidirectional functional rnn (fluid birnn): concat fw/bw outputs."""
+    from ...ops import manipulation
+
+    states_fw, states_bw = (initial_states if initial_states is not None
+                            else (None, None))
+    out_fw, st_fw = rnn(cell_fw, inputs, states_fw, sequence_length,
+                        time_major=time_major)
+    out_bw, st_bw = rnn(cell_bw, inputs, states_bw, sequence_length,
+                        time_major=time_major, is_reverse=True)
+    return manipulation.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None, weight=None,
+              bias=None):
+    """One LSTM step (lstm_unit_op.cc).  Explicit `weight`
+    [D+H, 4H] / `bias` [4H] (the fluid form creates them)."""
+    x = to_tensor_like(x_t)
+    h = to_tensor_like(hidden_t_prev)
+    c = to_tensor_like(cell_t_prev)
+    if weight is None:
+        raise ValueError(
+            "lstm_unit: pass weight=[D+H, 4H] (and bias=[4H]) explicitly "
+            "— the param-creating fluid form maps to nn.LSTMCell here")
+    w = to_tensor_like(weight)
+
+    def f(xv, hv, cv, wv, *maybe_b):
+        z = jnp.concatenate([xv, hv], axis=-1) @ wv
+        if maybe_b:
+            z = z + maybe_b[0]
+        i, fgt, cc, o = jnp.split(z, 4, axis=-1)
+        new_c = (jax.nn.sigmoid(fgt + forget_bias) * cv
+                 + jax.nn.sigmoid(i) * jnp.tanh(cc))
+        new_h = jax.nn.sigmoid(o) * jnp.tanh(new_c)
+        return new_h, new_c
+
+    args = [x, h, c, w]
+    if bias is not None:
+        args.append(to_tensor_like(bias))
+    return apply("lstm_unit", f, *args)
+
+
+def gru_unit(input, hidden, size=None, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False, weight=None, bias=None):
+    """One GRU step (gru_unit_op.cc) with explicit weight [D+H, 3H]."""
+    x = to_tensor_like(input)
+    h = to_tensor_like(hidden)
+    if weight is None:
+        raise ValueError(
+            "gru_unit: pass weight=[D+H, 3H] (and bias=[3H]) explicitly "
+            "— the param-creating fluid form maps to nn.GRUCell here")
+    w = to_tensor_like(weight)
+
+    def f(xv, hv, wv, *maybe_b):
+        z = jnp.concatenate([xv, hv], axis=-1) @ wv
+        if maybe_b:
+            z = z + maybe_b[0]
+        u, r, cc = jnp.split(z, 3, axis=-1)
+        u = jax.nn.sigmoid(u)
+        r = jax.nn.sigmoid(r)
+        # candidate recomputed with the reset gate on h
+        H = hv.shape[-1]
+        w_c = wv[:, 2 * H:]
+        z_c = jnp.concatenate([xv, r * hv], axis=-1) @ w_c
+        if maybe_b:
+            z_c = z_c + maybe_b[0][2 * H:]
+        c = jnp.tanh(z_c)
+        if origin_mode:
+            new_h = u * hv + (1 - u) * c
+        else:
+            new_h = (1 - u) * hv + u * c
+        return new_h, u, c
+
+    args = [x, h, w]
+    if bias is not None:
+        args.append(to_tensor_like(bias))
+    return apply("gru_unit", f, *args)
+
+
+def _dynamic_rnn_factory(cell_cls, n_gates, name):
+    def f(input, size, h_0=None, c_0=None, param_attr=None, bias_attr=None,
+          use_peepholes=False, is_reverse=False, gate_activation="sigmoid",
+          cell_activation="tanh", candidate_activation="tanh",
+          dtype="float32", name=None, weight_ih=None, weight_hh=None,
+          bias_ih=None, bias_hh=None, sequence_length=None):
+        """fluid dynamic_{lstm,gru,lstmp} over padded [B, L, D] input with
+        EXPLICIT weights (weight_ih [D, nH], weight_hh [H, nH]); the
+        fluid form created them as parameters."""
+        from .. import rnn as rnn_mod
+
+        x = to_tensor_like(input)
+        H = int(size) // n_gates
+        if weight_ih is None:
+            raise ValueError(
+                f"{name}: pass weight_ih/weight_hh explicitly — the "
+                f"param-creating fluid form maps to nn.{cell_cls} here")
+        cell = getattr(rnn_mod, cell_cls)(x.shape[-1], H)
+        cell.weight_ih.set_value(to_tensor_like(weight_ih)._value.T)
+        cell.weight_hh.set_value(to_tensor_like(weight_hh)._value.T)
+        if bias_ih is not None:
+            cell.bias_ih.set_value(to_tensor_like(bias_ih)._value)
+        if bias_hh is not None:
+            cell.bias_hh.set_value(to_tensor_like(bias_hh)._value)
+        init = None
+        if h_0 is not None:
+            h0 = to_tensor_like(h_0)
+            init = (h0, to_tensor_like(c_0)) if c_0 is not None else h0
+        return rnn(cell, x, initial_states=init,
+                   sequence_length=sequence_length, is_reverse=is_reverse)
+
+    f.__name__ = name
+    return f
+
+
+dynamic_lstm = _dynamic_rnn_factory("LSTMCell", 4, "dynamic_lstm")
+dynamic_lstmp = _dynamic_rnn_factory("LSTMCell", 4, "dynamic_lstmp")
+dynamic_gru = _dynamic_rnn_factory("GRUCell", 3, "dynamic_gru")
+
+
+def lstm(input, init_h, init_c, max_len=None, hidden_size=None,
+         num_layers=1, dropout_prob=0.0, is_bidirec=False, is_test=False,
+         name=None, default_initializer=None, seed=-1):
+    """fluid layers.lstm (cudnn LSTM): multi-layer LSTM over [B, L, D];
+    maps to nn.LSTM with fresh parameters (the fluid form also creates
+    its weights internally)."""
+    from .. import rnn as rnn_mod
+
+    x = to_tensor_like(input)
+    H = int(hidden_size) if hidden_size else x.shape[-1]
+    net = rnn_mod.LSTM(x.shape[-1], H, num_layers=num_layers,
+                       direction="bidirect" if is_bidirec else "forward")
+    out, (h, c) = net(x, (to_tensor_like(init_h), to_tensor_like(init_c)))
+    return out, h, c
+
+
+# --------------------------------------------------------------------------
+# norm extras
+# --------------------------------------------------------------------------
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None,
+                  u=None, v=None):
+    """Power-iteration spectral normalization (spectral_norm_op.cc):
+    weight / sigma_max, sigma estimated with `power_iters` rounds."""
+    w = to_tensor_like(weight)
+    u0 = to_tensor_like(u)._value if u is not None else None
+    v0 = to_tensor_like(v)._value if v is not None else None
+
+    def f(wv):
+        mat = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+        uu = (u0.reshape(-1) if u0 is not None else
+              jnp.ones((mat.shape[0],), jnp.float32) / _math.sqrt(
+                  mat.shape[0]))
+        vv = (v0.reshape(-1) if v0 is not None else
+              jnp.ones((mat.shape[1],), jnp.float32) / _math.sqrt(
+                  mat.shape[1]))
+        for _ in range(max(1, int(power_iters))):
+            vv = mat.T @ uu
+            vv = vv / (jnp.linalg.norm(vv) + eps)
+            uu = mat @ vv
+            uu = uu / (jnp.linalg.norm(uu) + eps)
+        sigma = uu @ mat @ vv
+        return (wv / sigma).astype(wv.dtype)
+
+    return apply("spectral_norm", f, w)
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              summary_decay_0=0.9999999, batch_size=None, batch_sum=None,
+              batch_square_sum=None):
+    """data_norm_op.cc (CTR per-feature standardization): normalize by
+    running batch statistics carried as explicit (size, sum, square_sum)
+    tensors — out = (x - sum/size) / sqrt(square_sum/size - mean^2)."""
+    x = to_tensor_like(input)
+    if batch_size is None:
+        raise ValueError(
+            "data_norm: pass batch_size/batch_sum/batch_square_sum "
+            "explicitly (the fluid form creates them as parameters)")
+    n = to_tensor_like(batch_size)
+    s = to_tensor_like(batch_sum)
+    ss = to_tensor_like(batch_square_sum)
+
+    def f(v, nn_, sm, sq):
+        mean = sm / nn_
+        var = sq / nn_ - mean * mean
+        return (v - mean) / jnp.sqrt(jnp.maximum(var, epsilon))
+
+    out = apply("data_norm", f, x, n, s, ss)
+    if act is not None:
+        from . import activation
+
+        out = getattr(activation, act)(out)
+    return out
+
+
+# --------------------------------------------------------------------------
+# LoD compat (LoD -> padded+lengths mapping per SURVEY §7)
+# --------------------------------------------------------------------------
+
+def lod_reset(x, y=None, target_lod=None):
+    """lod_reset_op: re-interpret the batch with new sequence lengths.
+    Padded form: returns (x, new_lengths) — the data is unchanged, the
+    lengths vector IS the LoD here."""
+    x = to_tensor_like(x)
+    if y is not None:
+        lens = to_tensor_like(y)
+    elif target_lod is not None:
+        off = np.asarray(target_lod, np.int64)
+        lens = Tensor(jnp.asarray(np.diff(off), jnp.int64))
+    else:
+        raise ValueError("lod_reset: pass y (lengths) or target_lod")
+    return x, lens
+
+
+def lod_append(x, level):
+    """lod_append_op: append a finer LoD level — padded form returns the
+    extra per-row lengths alongside the data."""
+    x = to_tensor_like(x)
+    lens = to_tensor_like(np.asarray(level, np.int64)
+                          if not isinstance(level, Tensor) else level)
+    return x, lens
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """reorder_lod_tensor_by_rank_op: permute batch rows by the rank
+    table (descending-length order in the reference beam-search path)."""
+    from ...ops import manipulation
+
+    x = to_tensor_like(x)
+    order = to_tensor_like(rank_table)
+    return manipulation.gather(x, order, axis=0)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    """im2sequence_op: unfold conv patches into sequence rows —
+    [N, C, H, W] -> [N, out_h*out_w, C*fh*fw]."""
+    x = to_tensor_like(input)
+    fh, fw = ((filter_size, filter_size)
+              if isinstance(filter_size, int) else filter_size)
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    if isinstance(padding, int):
+        pu = pd = pl = pr = padding
+    else:
+        pu, pl, pd, pr = (padding if len(padding) == 4
+                          else (padding[0], padding[1]) * 2)
+
+    def f(v):
+        v = jnp.pad(v, ((0, 0), (0, 0), (pu, pd), (pl, pr)))
+        N, C, H, W = v.shape
+        oh = (H - fh) // sh + 1
+        ow = (W - fw) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            v, (fh, fw), (sh, sw), "VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # [N, C*fh*fw, oh, ow] -> [N, oh*ow, C*fh*fw]
+        return patches.reshape(N, C * fh * fw, oh * ow).transpose(0, 2, 1)
+
+    return apply("im2sequence", f, x)
+
+
+# --------------------------------------------------------------------------
+# sampled / hierarchical losses
+# --------------------------------------------------------------------------
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (hierarchical_sigmoid_op.cc).  Default
+    complete binary tree over num_classes (the reference's non-custom
+    path); explicit path_table/path_code override it."""
+    x = to_tensor_like(input)
+    y = to_tensor_like(label)
+    w = to_tensor_like(weight)
+    K = int(num_classes)
+    depth = max(1, int(_math.ceil(_math.log2(max(K, 2)))))
+
+    # complete-tree paths computed on host (labels static per batch is
+    # NOT required: codes derive arithmetically from the label value)
+    def f(v, lab, wv, *maybe_b):
+        lab = lab.reshape(-1).astype(jnp.int32)
+        node = lab + K  # leaves sit at [K, 2K) in a complete tree
+        loss = jnp.zeros((v.shape[0],), jnp.float32)
+        for _ in range(depth):
+            parent = node // 2
+            code = (node % 2).astype(jnp.float32)      # left/right bit
+            live = parent >= 1
+            idx = jnp.clip(parent - 1, 0, wv.shape[0] - 1)
+            logit = (v * wv[idx]).sum(axis=-1)
+            if maybe_b:
+                logit = logit + maybe_b[0].reshape(-1)[idx]
+            # sigmoid cross entropy against the path bit
+            step = jnp.log1p(jnp.exp(-jnp.abs(logit))) + \
+                jnp.maximum(logit, 0) - logit * code
+            loss = loss + jnp.where(live, step, 0.0)
+            node = parent
+        return loss.reshape(-1, 1)
+
+    args = [x, y, w]
+    if bias is not None:
+        args.append(to_tensor_like(bias))
+    return apply("hsigmoid_loss", f, *args)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False,
+        weight=None, bias=None):
+    """Noise-contrastive estimation loss (nce_op.cc): one positive +
+    num_neg_samples uniform negatives per row, logistic loss against the
+    noise distribution.  Explicit weight [K, D] / bias [K]."""
+    from ...framework.random import next_rng_key
+
+    x = to_tensor_like(input)
+    y = to_tensor_like(label)
+    if weight is None:
+        raise ValueError(
+            "nce: pass weight=[num_total_classes, D] (and bias) "
+            "explicitly — the param-creating fluid form")
+    w = to_tensor_like(weight)
+    K = int(num_total_classes)
+    S = int(num_neg_samples)
+
+    def f(v, lab, wv, key, *maybe_b):
+        B = v.shape[0]
+        lab = lab.reshape(-1).astype(jnp.int32)
+        neg = jax.random.randint(key, (B, S), 0, K)
+        ids = jnp.concatenate([lab[:, None], neg], axis=1)   # [B, 1+S]
+        logits = jnp.einsum("bd,bsd->bs", v, wv[ids])
+        if maybe_b:
+            logits = logits + maybe_b[0].reshape(-1)[ids]
+        # logistic vs noise: log q = log(1/K) for the uniform sampler
+        logits = logits - jnp.log(S / K)
+        labels01 = jnp.concatenate(
+            [jnp.ones((B, 1)), jnp.zeros((B, S))], axis=1)
+        ce = jnp.log1p(jnp.exp(-jnp.abs(logits))) + \
+            jnp.maximum(logits, 0) - logits * labels01
+        return ce.sum(axis=1, keepdims=True)
+
+    args = [x, y, w, Tensor(next_rng_key())]
+    if bias is not None:
+        args.append(to_tensor_like(bias))
+    return apply("nce", f, *args)
+
+
+def gather_tree(ids, parents):
+    """Beam-search ancestry walk (gather_tree_op) — re-export of the
+    decode implementation."""
+    from ..decode import gather_tree as _gt
+
+    return _gt(ids, parents)
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    """fluid warpctc spelling over ctc_loss (reference integrates
+    warp-ctc; ops/ctc here is the same math on XLA)."""
+    from .loss import ctc_loss
+
+    return ctc_loss(input, label, input_length, label_length, blank=blank,
+                    reduction="none", norm_by_times=norm_by_times)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD multi_box_head (fluid/layers/detection.py:multi_box_head):
+    per feature map, conv heads for loc (4/prior) + conf
+    (num_classes/prior) plus prior_box generation; outputs concatenated
+    across maps.  Param-creating convs go through static.nn.conv2d."""
+    from ...ops import detection as det
+    from ...ops import manipulation
+    from ...static import nn as static_nn
+
+    n_maps = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule
+        min_sizes, max_sizes = [], []
+        step = int(_math.floor((max_ratio - min_ratio) / (n_maps - 2)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+
+    locs, confs, boxes, variances = [], [], [], []
+    for i, x in enumerate(inputs):
+        x = to_tensor_like(x)
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[0],
+                                            (list, tuple)) else aspect_ratios
+        mn = min_sizes[i] if isinstance(min_sizes, (list, tuple)) else min_sizes
+        mx = max_sizes[i] if max_sizes else None
+        box, var = det.prior_box(
+            x, image, min_sizes=[mn] if np.isscalar(mn) else mn,
+            max_sizes=[mx] if (mx and np.isscalar(mx)) else mx,
+            aspect_ratios=ar, variances=list(variance), flip=flip,
+            clip=clip, steps=([steps[i]] * 2 if steps else
+                              [step_w[i] if step_w else 0.0,
+                               step_h[i] if step_h else 0.0]),
+            offset=offset)
+        n_priors = box.shape[-2] if box.ndim >= 2 else box.shape[0]
+        per_cell = int(np.prod(box.shape[:-1])) // (x.shape[2] * x.shape[3])
+        loc = static_nn.conv2d(x, per_cell * 4, kernel_size, stride=stride,
+                               padding=pad, name=f"{name or 'mbox'}_loc{i}")
+        conf = static_nn.conv2d(x, per_cell * num_classes, kernel_size,
+                                stride=stride, padding=pad,
+                                name=f"{name or 'mbox'}_conf{i}")
+        B = loc.shape[0]
+        locs.append(manipulation.reshape(
+            manipulation.transpose(loc, [0, 2, 3, 1]), [B, -1, 4]))
+        confs.append(manipulation.reshape(
+            manipulation.transpose(conf, [0, 2, 3, 1]),
+            [B, -1, num_classes]))
+        boxes.append(manipulation.reshape(box, [-1, 4]))
+        variances.append(manipulation.reshape(var, [-1, 4]))
+    from ...ops.manipulation import concat
+
+    return (concat(locs, axis=1), concat(confs, axis=1),
+            concat(boxes, axis=0), concat(variances, axis=0))

@@ -1,0 +1,196 @@
+"""Remaining nn layer surface (reference nn/__init__.py re-exports):
+PairwiseDistance, HSigmoidLoss, NCELoss, TreeConv, DynamicRNN/StaticRNN,
+Decoder, ctc_greedy_decoder, crf_decoding layer forms."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops._helpers import to_tensor_like
+from ..tensor import Tensor
+from .layer import Layer
+
+
+class PairwiseDistance(Layer):
+    """p-norm distance between row pairs (nn/layer/distance.py)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        import jax.numpy as jnp
+
+        from ..ops.dispatch import apply
+
+        def f(a, b):
+            d = a - b + self.epsilon
+            out = jnp.power(jnp.sum(jnp.power(jnp.abs(d), self.p), axis=-1),
+                            1.0 / self.p)
+            return out[..., None] if self.keepdim else out
+
+        return apply("pairwise_distance", f, to_tensor_like(x),
+                     to_tensor_like(y))
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss layer (nn/layer/loss.py HSigmoidLoss) —
+    owns the tree weights; math in functional.hsigmoid_loss."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        n_nodes = max(num_classes - 1, 1)
+        self.weight = self.create_parameter(
+            [n_nodes * 2, feature_size], attr=weight_attr)
+        self.bias = (self.create_parameter([n_nodes * 2], attr=bias_attr,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, input, label):
+        from .functional.extension import hsigmoid_loss
+
+        return hsigmoid_loss(input, label, self.num_classes, self.weight,
+                             bias=self.bias)
+
+
+class NCELoss(Layer):
+    """NCE loss layer owning the class embedding (paddle.nn doesn't ship
+    one in 2.x dygraph; the fluid layer creates the same params)."""
+
+    def __init__(self, feature_size, num_total_classes, num_neg_samples=10,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.num_total_classes = num_total_classes
+        self.num_neg_samples = num_neg_samples
+        self.weight = self.create_parameter(
+            [num_total_classes, feature_size], attr=weight_attr)
+        self.bias = (self.create_parameter([num_total_classes],
+                                           attr=bias_attr, is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, input, label):
+        from .functional.extension import nce
+
+        return nce(input, label, self.num_total_classes,
+                   num_neg_samples=self.num_neg_samples,
+                   weight=self.weight, bias=self.bias)
+
+
+class TreeConv(Layer):
+    """Tree-based conv (tree_conv_op.cc): node features [B, N, D] and an
+    adjacency EdgeSet [B, E, 2]; each node aggregates its children
+    through `num_filters` filters of `max_depth` hops."""
+
+    def __init__(self, feature_size, output_size, num_filters=1,
+                 max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        self.max_depth = max_depth
+        self.weight = self.create_parameter(
+            [feature_size, max_depth, output_size * num_filters],
+            attr=param_attr)
+        self.bias = (self.create_parameter(
+            [1, 1, output_size * num_filters], attr=bias_attr,
+            is_bias=True) if bias_attr is not False else None)
+        self.act = act
+        self.output_size = output_size
+        self.num_filters = num_filters
+
+    def forward(self, nodes_vector, edge_set):
+        import jax.numpy as jnp
+
+        from ..ops.dispatch import apply
+
+        depth = self.max_depth
+
+        def f(feat, edges, w, *maybe_b):
+            B, N, D = feat.shape
+            adj = jnp.zeros((B, N, N), feat.dtype)
+            src = edges[..., 0].astype(jnp.int32)
+            dst = edges[..., 1].astype(jnp.int32)
+            b_idx = jnp.repeat(jnp.arange(B)[:, None], edges.shape[1], 1)
+            adj = adj.at[b_idx, dst, src].set(1.0)
+            hops = [feat]
+            cur = feat
+            for _ in range(depth - 1):
+                cur = jnp.einsum("bnm,bmd->bnd", adj, cur)
+                hops.append(cur)
+            out = sum(jnp.einsum("bnd,do->bno", h, w[:, k])
+                      for k, h in enumerate(hops))
+            if maybe_b:
+                out = out + maybe_b[0]
+            return out
+
+        args = [to_tensor_like(nodes_vector), to_tensor_like(edge_set),
+                self.weight]
+        if self.bias is not None:
+            args.append(self.bias)
+        out = apply("tree_conv", f, *args)
+        if self.act:
+            import paddle_tpu.nn.functional as F
+
+            out = getattr(F, self.act)(out)
+        return out
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    """ctc_greedy_decoder (ctc_align_op.cc): argmax per step, collapse
+    repeats, drop blanks.  Fixed-shape form: left-aligned [B, T] ids
+    padded with padding_value + per-row output lengths."""
+    import jax.numpy as jnp
+
+    from ..ops.dispatch import apply
+
+    x = to_tensor_like(input)
+    args = [x]
+    if input_length is not None:
+        args.append(to_tensor_like(input_length))
+
+    def f(v, *maybe_len):
+        B, T = v.shape[0], v.shape[1]
+        ids = v.argmax(axis=-1)                         # [B, T]
+        prev = jnp.concatenate([jnp.full((B, 1), -1, ids.dtype),
+                                ids[:, :-1]], axis=1)
+        keep = (ids != blank) & (ids != prev)
+        if maybe_len:
+            keep = keep & (jnp.arange(T)[None] < maybe_len[0][:, None])
+        # left-align kept ids: stable sort by ~keep
+        order = jnp.argsort(~keep, axis=1, stable=True)
+        packed = jnp.take_along_axis(ids, order, axis=1)
+        n = keep.sum(axis=1)
+        packed = jnp.where(jnp.arange(T)[None] < n[:, None], packed,
+                           padding_value)
+        return packed.astype(jnp.int64), n.astype(jnp.int64)
+
+    return apply("ctc_greedy_decoder", f, *args)
+
+
+class _FluidRNNBase:
+    """DynamicRNN / StaticRNN name parity.  These are STATIC-GRAPH
+    program builders in the reference (the `with rnn.block():` body is
+    captured into a sub-block, fluid/layers/control_flow.py) and are
+    deprecated there in favor of paddle.nn.RNN.  A trace-based framework
+    cannot re-execute a with-block per timestep, so block() raises with
+    the mapping instead of silently collecting dead state."""
+
+    def __init__(self, name=None):
+        pass
+
+    def block(self):
+        raise NotImplementedError(
+            f"{type(self).__name__} is the fluid static-graph RNN "
+            "builder; write the cell as a function/Layer and run it "
+            "with paddle.nn.RNN, nn.functional.rnn, or a Python loop "
+            "under @jit.to_static (the dy2static pass converts "
+            "`for i in range(...)` over tensors).")
+
+    step = block
+
+
+DynamicRNN = _FluidRNNBase
+StaticRNN = _FluidRNNBase

@@ -22,7 +22,13 @@ from .dispatch import apply
 __all__ = [
     "iou_similarity", "box_coder", "box_clip", "prior_box",
     "anchor_generator", "yolo_box", "nms", "multiclass_nms", "roi_align",
-    "bipartite_match", "generate_proposals",
+    "bipartite_match", "generate_proposals", "density_prior_box",
+    "detection_output", "target_assign", "polygon_box_transform",
+    "box_decoder_and_assign", "distribute_fpn_proposals",
+    "collect_fpn_proposals", "psroi_pool", "prroi_pool",
+    "retinanet_detection_output", "rpn_target_assign",
+    "retinanet_target_assign", "yolov3_loss", "deformable_roi_pooling",
+    "generate_proposal_labels", "roi_perspective_transform",
 ]
 
 
@@ -501,3 +507,684 @@ def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
     return apply("generate_proposals", f, to_tensor_like(scores),
                  to_tensor_like(bbox_deltas), to_tensor_like(im_info),
                  to_tensor_like(anchors), to_tensor_like(variances))
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False, steps=None,
+                      offset=0.5, flatten_to_2d=False, name=None):
+    """density_prior_box_op.cc (SSD face-detection priors): per feature
+    cell, for each (fixed_size, density) a density x density sub-grid of
+    centers with fixed_ratio aspect boxes."""
+    x = to_tensor_like(input)
+    img = to_tensor_like(image)
+    H, W = x.shape[2], x.shape[3]
+    img_h, img_w = img.shape[2], img.shape[3]
+    step_h = steps[1] if steps else img_h / H
+    step_w = steps[0] if steps else img_w / W
+
+    boxes = []
+    for fs, den in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = fs * np.sqrt(ratio)
+            bh = fs / np.sqrt(ratio)
+            shift = 1.0 / den
+            for dy in range(den):
+                for dx in range(den):
+                    cxo = (dx + 0.5) * shift - 0.5 + offset
+                    cyo = (dy + 0.5) * shift - 0.5 + offset
+                    boxes.append((cxo, cyo, bw, bh))
+
+    ys, xs = np.meshgrid(np.arange(H), np.arange(W), indexing="ij")
+    out = np.zeros((H, W, len(boxes), 4), np.float32)
+    for k, (cxo, cyo, bw, bh) in enumerate(boxes):
+        cx = (xs + cxo) * step_w
+        cy = (ys + cyo) * step_h
+        out[..., k, 0] = (cx - bw / 2) / img_w
+        out[..., k, 1] = (cy - bh / 2) / img_h
+        out[..., k, 2] = (cx + bw / 2) / img_w
+        out[..., k, 3] = (cy + bh / 2) / img_h
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    from ..tensor import Tensor
+
+    if flatten_to_2d:
+        out = out.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False):
+    """SSD detection_output (detection_output_op.cc): decode loc deltas
+    against priors, then multiclass NMS — a composition of box_coder +
+    multiclass_nms."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size", axis=0)
+    from .manipulation import reshape
+
+    # single-image SSD head: loc [M, 4] deltas (or [1, M, 4]) against M
+    # priors; multiclass_nms takes boxes [M, 4] + scores [C, M]
+    if decoded.ndim == 3:
+        decoded = reshape(decoded, [-1, 4])
+    return multiclass_nms(decoded, scores,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold,
+                          background_label=background_label)
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    """target_assign_op.cc: out[i, j] = input[matched_indices[i, j]] with
+    mismatch rows (-1) filled by mismatch_value; weights 1 on matches."""
+    x = to_tensor_like(input)
+    mi = to_tensor_like(matched_indices)
+
+    def f(v, m):
+        m = m.astype(jnp.int32)
+        ok = m >= 0
+        safe = jnp.clip(m, 0, v.shape[0] - 1)
+        gathered = v[safe]                      # [B, P, ...]
+        mask = ok.reshape(ok.shape + (1,) * (gathered.ndim - m.ndim))
+        out = jnp.where(mask, gathered, mismatch_value)
+        w = ok.astype(jnp.float32)
+        return out, w
+
+    return apply("target_assign", f, x, mi)
+
+
+def polygon_box_transform(input, name=None):
+    """polygon_box_transform_op.cu (EAST text detection): channel 2k is
+    x-offset, 2k+1 is y-offset; convert offsets to absolute coords."""
+    x = to_tensor_like(input)
+
+    def f(v):
+        N, C, H, W = v.shape
+        xs = jnp.arange(W, dtype=v.dtype)[None, None, None, :]
+        ys = jnp.arange(H, dtype=v.dtype)[None, None, :, None]
+        idx = jnp.arange(C) % 2
+        grid = jnp.where(idx.reshape(1, C, 1, 1) == 0, xs * 4, ys * 4)
+        return grid - v
+
+    return apply("polygon_box_transform", f, x)
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box,
+                           box_score, box_clip_value, name=None):
+    """box_decoder_and_assign_op.cc: decode per-class deltas then pick
+    each box's best-scoring class decode."""
+    pb = to_tensor_like(prior_box)
+    pbv = to_tensor_like(prior_box_var)
+    tb = to_tensor_like(target_box)
+    sc = to_tensor_like(box_score)
+
+    def f(p, pv, t, s):
+        N = p.shape[0]
+        C = s.shape[1]
+        t = t.reshape(N, C, 4)
+        pw = p[:, 2] - p[:, 0] + 1.0
+        ph = p[:, 3] - p[:, 1] + 1.0
+        pcx = p[:, 0] + 0.5 * pw
+        pcy = p[:, 1] + 0.5 * ph
+        dx = jnp.clip(t[..., 0] * pv[:, None, 0], -box_clip_value,
+                      box_clip_value)
+        dy = jnp.clip(t[..., 1] * pv[:, None, 1], -box_clip_value,
+                      box_clip_value)
+        dw = jnp.clip(t[..., 2] * pv[:, None, 2], -box_clip_value,
+                      box_clip_value)
+        dh = jnp.clip(t[..., 3] * pv[:, None, 3], -box_clip_value,
+                      box_clip_value)
+        cx = dx * pw[:, None] + pcx[:, None]
+        cy = dy * ph[:, None] + pcy[:, None]
+        w = jnp.exp(dw) * pw[:, None]
+        h = jnp.exp(dh) * ph[:, None]
+        decoded = jnp.stack([cx - w / 2, cy - h / 2,
+                             cx + w / 2, cy + h / 2], axis=-1)  # [N,C,4]
+        best = s.argmax(axis=1)
+        assigned = decoded[jnp.arange(N), best]
+        return decoded.reshape(N, C * 4), assigned
+
+    return apply("box_decoder_and_assign", f, pb, pbv, tb, sc)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    """distribute_fpn_proposals_op.cc: route each roi to a pyramid level
+    by scale.  Fixed-shape TPU form: per-level roi tensors with invalid
+    rows zeroed + a validity mask per level + restore index."""
+    rois = to_tensor_like(fpn_rois)
+    n_levels = max_level - min_level + 1
+
+    def f(r):
+        w = r[:, 2] - r[:, 0]
+        h = r[:, 3] - r[:, 1]
+        scale = jnp.sqrt(jnp.maximum(w * h, 1e-6))
+        lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
+        lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+        outs = []
+        for L in range(min_level, max_level + 1):
+            m = lvl == L
+            outs.append(jnp.where(m[:, None], r, 0.0))
+            outs.append(m)
+        order = jnp.argsort(lvl, stable=True)
+        restore = jnp.argsort(order, stable=True)
+        return tuple(outs) + (restore,)
+
+    res = apply("distribute_fpn_proposals", f, rois)
+    per_level = [(res[2 * i], res[2 * i + 1]) for i in range(n_levels)]
+    return per_level, res[-1]
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """collect_fpn_proposals_op.cc: merge per-level rois, keep the
+    post_nms_top_n highest-scoring (fixed-shape top-k)."""
+    from .manipulation import concat
+
+    rois = concat([to_tensor_like(r) for r in multi_rois], axis=0)
+    scores = concat([to_tensor_like(s) for s in multi_scores], axis=0)
+
+    def f(r, s):
+        k = min(int(post_nms_top_n), r.shape[0])
+        s = s.reshape(-1)
+        top = jnp.argsort(-s)[:k]
+        return r[top], s[top]
+
+    return apply("collect_fpn_proposals", f, rois, scores)
+
+
+def psroi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+               output_channels=None, pooled_height=None, pooled_width=None,
+               rois=None, name=None):
+    """Position-sensitive ROI average pooling (psroi_pool_op.cc): output
+    bin (i, j) of output-channel c averages INPUT channel
+    c*ph*pw + i*pw + j over that bin."""
+    xt = to_tensor_like(x)
+    r = to_tensor_like(boxes if rois is None else rois)
+    if pooled_height is not None:
+        ph, pw = int(pooled_height), int(pooled_width)
+    elif isinstance(output_size, (tuple, list)):
+        ph, pw = int(output_size[0]), int(output_size[1])
+    else:
+        ph = pw = int(output_size)
+    scale = float(spatial_scale)
+
+    def f(v, rr):
+        N, C, H, W = v.shape
+        oc = output_channels or C // (ph * pw)
+        R = rr.shape[0]
+        x1 = rr[:, 0] * scale
+        y1 = rr[:, 1] * scale
+        x2 = rr[:, 2] * scale
+        y2 = rr[:, 3] * scale
+        bh = jnp.maximum(y2 - y1, 0.1) / ph
+        bw = jnp.maximum(x2 - x1, 0.1) / pw
+        ys = jnp.arange(H, dtype=jnp.float32)[None, None, :]
+        xs = jnp.arange(W, dtype=jnp.float32)[None, None, :]
+        iy = jnp.arange(ph, dtype=jnp.float32)[None, :, None]
+        ix = jnp.arange(pw, dtype=jnp.float32)[None, :, None]
+        y_lo = y1[:, None, None] + iy * bh[:, None, None]
+        y_hi = y1[:, None, None] + (iy + 1) * bh[:, None, None]
+        x_lo = x1[:, None, None] + ix * bw[:, None, None]
+        x_hi = x1[:, None, None] + (ix + 1) * bw[:, None, None]
+        ymask = (ys >= jnp.floor(y_lo)) & (ys < jnp.ceil(y_hi))
+        xmask = (xs >= jnp.floor(x_lo)) & (xs < jnp.ceil(x_hi))
+        m = (ymask[:, :, None, :, None] &
+             xmask[:, None, :, None, :]).astype(jnp.float32)  # [R,ph,pw,H,W]
+        # channel map: out channel c, bin (i,j) -> in channel c*ph*pw+i*pw+j
+        vmap = v[0].reshape(oc, ph, pw, H, W)                 # single image
+        summed = jnp.einsum("rijhw,cijhw->rcij", m, vmap)
+        area = jnp.maximum(m.sum(axis=(-1, -2)), 1.0)         # [R,ph,pw]
+        return (summed / area[:, None]).astype(v.dtype)
+
+    return apply("psroi_pool", f, xt, r)
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """retinanet_detection_output_op.cc: decode per-FPN-level deltas
+    against anchors, merge, multiclass-NMS (composition form)."""
+    from .manipulation import concat
+
+    decoded = []
+    score_list = []
+    for delta, sc, anc in zip(bboxes, scores, anchors):
+        d = box_coder(anc, [0.1, 0.1, 0.2, 0.2], delta,
+                      code_type="decode_center_size", axis=0)
+        decoded.append(d)
+        score_list.append(to_tensor_like(sc))
+    all_boxes = concat(decoded, axis=0)
+    all_scores = concat(score_list, axis=0)
+    return multiclass_nms(all_boxes, all_scores,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold,
+                          background_label=-1)
+
+
+def _anchor_match_labels(anchors, gt, pos_overlap, neg_overlap):
+    """Shared RPN/RetinaNet anchor labeling: IoU match each anchor to its
+    best gt; label 1 above pos_overlap (plus each gt's best anchor),
+    0 below neg_overlap, -1 in between (ignore)."""
+    iou = _pairwise_iou(anchors, gt)            # [A, G]
+    best_gt = iou.argmax(axis=1)
+    best_iou = iou.max(axis=1)
+    labels = jnp.full((anchors.shape[0],), -1, jnp.int32)
+    labels = jnp.where(best_iou < neg_overlap, 0, labels)
+    labels = jnp.where(best_iou >= pos_overlap, 1, labels)
+    # every gt's best anchor is positive (rpn_target_assign_op.cc rule)
+    best_anchor = iou.argmax(axis=0)            # [G]
+    labels = labels.at[best_anchor].set(1)
+    return labels, best_gt, best_iou
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """rpn_target_assign_op.cc, fixed-shape TPU form: instead of gathered
+    fg/bg index lists (dynamic sizes), returns per-anchor `labels`
+    [A] (1 fg / 0 bg / -1 ignore, capped to the batch-size budget by
+    score order) and encoded `bbox_targets` [A, 4] with a fg mask."""
+    a = to_tensor_like(anchor_box)
+    g = to_tensor_like(gt_boxes)
+
+    def f(anchors, gt):
+        labels, best_gt, iou = _anchor_match_labels(
+            anchors, gt, rpn_positive_overlap, rpn_negative_overlap)
+        # budget: at most fg_fraction*batch positives, rest negatives —
+        # deterministic by IoU order (use_random's shuffle is host-side
+        # in the reference; fixed shapes prefer determinism)
+        n_fg = int(rpn_batch_size_per_im * rpn_fg_fraction)
+        fg_rank = jnp.argsort(jnp.argsort(-jnp.where(labels == 1, iou,
+                                                     -jnp.inf)))
+        labels = jnp.where((labels == 1) & (fg_rank >= n_fg), -1, labels)
+        n_bg = rpn_batch_size_per_im - jnp.minimum(
+            (labels == 1).sum(), n_fg)
+        bg_rank = jnp.argsort(jnp.argsort(-jnp.where(labels == 0, -iou,
+                                                     -jnp.inf)))
+        labels = jnp.where((labels == 0) & (bg_rank >= n_bg), -1, labels)
+        # encode targets against matched gt (center-size deltas)
+        mg = gt[best_gt]
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = anchors[:, 0] + aw / 2
+        acy = anchors[:, 1] + ah / 2
+        gw = mg[:, 2] - mg[:, 0]
+        gh = mg[:, 3] - mg[:, 1]
+        gcx = mg[:, 0] + gw / 2
+        gcy = mg[:, 1] + gh / 2
+        t = jnp.stack([(gcx - acx) / jnp.maximum(aw, 1e-6),
+                       (gcy - acy) / jnp.maximum(ah, 1e-6),
+                       jnp.log(jnp.maximum(gw, 1e-6)
+                               / jnp.maximum(aw, 1e-6)),
+                       jnp.log(jnp.maximum(gh, 1e-6)
+                               / jnp.maximum(ah, 1e-6))], axis=1)
+        fg = (labels == 1)
+        return labels, jnp.where(fg[:, None], t, 0.0), fg
+
+    return apply("rpn_target_assign", f, a, g)
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd=None,
+                            im_info=None, num_classes=1,
+                            positive_overlap=0.5, negative_overlap=0.4):
+    """retinanet_target_assign_op.cc, fixed-shape form: per-anchor class
+    labels (gt class for positives, 0 background, -1 ignore) + encoded
+    box targets + fg mask (focal loss consumes all anchors anyway)."""
+    a = to_tensor_like(anchor_box)
+    g = to_tensor_like(gt_boxes)
+    gl = to_tensor_like(gt_labels)
+
+    def f(anchors, gt, glab):
+        match, best_gt, _ = _anchor_match_labels(
+            anchors, gt, positive_overlap, negative_overlap)
+        cls = jnp.where(match == 1,
+                        glab.reshape(-1)[best_gt].astype(jnp.int32),
+                        match)
+        mg = gt[best_gt]
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        t = jnp.stack([
+            (mg[:, 0] + (mg[:, 2] - mg[:, 0]) / 2
+             - anchors[:, 0] - aw / 2) / jnp.maximum(aw, 1e-6),
+            (mg[:, 1] + (mg[:, 3] - mg[:, 1]) / 2
+             - anchors[:, 1] - ah / 2) / jnp.maximum(ah, 1e-6),
+            jnp.log(jnp.maximum(mg[:, 2] - mg[:, 0], 1e-6)
+                    / jnp.maximum(aw, 1e-6)),
+            jnp.log(jnp.maximum(mg[:, 3] - mg[:, 1], 1e-6)
+                    / jnp.maximum(ah, 1e-6))], axis=1)
+        fg = match == 1
+        return cls, jnp.where(fg[:, None], t, 0.0), fg
+
+    return apply("retinanet_target_assign", f, a, g, gl)
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=False, name=None, scale_x_y=1.0):
+    """yolov3_loss_op.cc: per-cell YOLOv3 training loss — xy/wh terms for
+    the responsible anchor of each gt, objectness BCE with the
+    ignore-region rule, class BCE."""
+    xt = to_tensor_like(x)
+    gb = to_tensor_like(gt_box)
+    glb = to_tensor_like(gt_label)
+    anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask = list(anchor_mask)
+    am = anchors[mask]                                 # [M, 2]
+    M, K = len(mask), int(class_num)
+
+    def f(v, gtb, gtl):
+        N, C, H, W = v.shape
+        v = v.reshape(N, M, 5 + K, H, W)
+        tx, ty = v[:, :, 0], v[:, :, 1]
+        tw, th = v[:, :, 2], v[:, :, 3]
+        tobj = v[:, :, 4]
+        tcls = v[:, :, 5:]
+        stride = downsample_ratio
+        img = W * stride
+
+        # predicted boxes (normalized) for the ignore rule
+        gx = (jax.nn.sigmoid(tx) + jnp.arange(W)[None, None, None, :]) / W
+        gy = (jax.nn.sigmoid(ty) + jnp.arange(H)[None, None, :, None]) / H
+        gw = jnp.exp(tw) * am[None, :, 0, None, None] / img
+        gh = jnp.exp(th) * am[None, :, 1, None, None] / img
+        pred = jnp.stack([gx - gw / 2, gy - gh / 2,
+                          gx + gw / 2, gy + gh / 2], axis=-1)
+
+        B = gtb.shape[1]
+        gxyxy = jnp.stack([gtb[..., 0] - gtb[..., 2] / 2,
+                           gtb[..., 1] - gtb[..., 3] / 2,
+                           gtb[..., 0] + gtb[..., 2] / 2,
+                           gtb[..., 1] + gtb[..., 3] / 2], axis=-1)
+        valid_gt = (gtb[..., 2] > 0)                   # [N, B]
+
+        total = jnp.zeros((), jnp.float32)
+        obj_mask = jnp.zeros((N, M, H, W), bool)
+        ignore = jnp.zeros((N, M, H, W), bool)
+        for n in range(N):
+            ious = _pairwise_iou(pred[n].reshape(-1, 4), gxyxy[n])
+            ious = jnp.where(valid_gt[n][None, :], ious, 0.0)
+            ignore = ignore.at[n].set(
+                (ious.max(axis=1) > ignore_thresh).reshape(M, H, W))
+        for b in range(B):
+            cx, cy, w_, h_ = (gtb[:, b, 0], gtb[:, b, 1],
+                              gtb[:, b, 2], gtb[:, b, 3])
+            gi = jnp.clip((cx * W).astype(jnp.int32), 0, W - 1)
+            gj = jnp.clip((cy * H).astype(jnp.int32), 0, H - 1)
+            # responsible anchor: best wh IoU at origin
+            inter = (jnp.minimum(w_[:, None] * img, am[None, :, 0])
+                     * jnp.minimum(h_[:, None] * img, am[None, :, 1]))
+            union = (w_[:, None] * img * h_[:, None] * img
+                     + am[None, :, 0] * am[None, :, 1] - inter)
+            best = (inter / jnp.maximum(union, 1e-6)).argmax(axis=1)
+            ns = jnp.arange(N)
+            vm = valid_gt[:, b]
+            scale = 2.0 - w_ * h_                      # small-box boost
+            sx = jax.nn.sigmoid(tx[ns, best, gj, gi])
+            sy = jax.nn.sigmoid(ty[ns, best, gj, gi])
+            lx = (sx - (cx * W - jnp.floor(cx * W))) ** 2
+            ly = (sy - (cy * H - jnp.floor(cy * H))) ** 2
+            lw = (tw[ns, best, gj, gi]
+                  - jnp.log(jnp.maximum(w_ * img, 1e-6)
+                            / am[best][:, 0])) ** 2
+            lh = (th[ns, best, gj, gi]
+                  - jnp.log(jnp.maximum(h_ * img, 1e-6)
+                            / am[best][:, 1])) ** 2
+            cls_logit = tcls[ns, best, :, gj, gi]
+            onehot = jax.nn.one_hot(gtl[:, b], K)
+            lcls = (jnp.log1p(jnp.exp(-jnp.abs(cls_logit)))
+                    + jnp.maximum(cls_logit, 0)
+                    - cls_logit * onehot).sum(axis=1)
+            total = total + jnp.where(
+                vm, scale * (lx + ly + lw + lh) + lcls, 0.0).sum()
+            obj_mask = obj_mask.at[ns, best, gj, gi].set(
+                obj_mask[ns, best, gj, gi] | vm)
+        # objectness: BCE 1 at responsible cells, 0 elsewhere except the
+        # ignore region
+        zobj = (jnp.log1p(jnp.exp(-jnp.abs(tobj)))
+                + jnp.maximum(tobj, 0)
+                - tobj * obj_mask.astype(jnp.float32))
+        use = obj_mask | ~ignore
+        total = total + jnp.where(use, zobj, 0.0).sum()
+        return total.reshape(1)
+
+    return apply("yolov3_loss", f, xt, gb, glb)
+
+
+def prroi_pool(input, rois, output_size=None, spatial_scale=1.0,
+               pooled_height=None, pooled_width=None, batch_roi_nums=None,
+               name=None):
+    """Precise ROI pooling (prroi_pool_op.cc, arXiv:1807.11590): the
+    EXACT integral of the bilinearly-interpolated feature over each bin
+    (no sampling-point quantization).  The bilinear basis around pixel p
+    is a tent, so the 2-D integral factorizes into per-axis tent
+    integrals computed in closed form."""
+    xt = to_tensor_like(input)
+    r = to_tensor_like(rois)
+    if pooled_height is not None:
+        ph, pw = int(pooled_height), int(pooled_width)
+    elif isinstance(output_size, (tuple, list)):
+        ph, pw = int(output_size[0]), int(output_size[1])
+    else:
+        ph = pw = int(output_size)
+    scale = float(spatial_scale)
+
+    def tent_integral(lo, hi, n):
+        """∫ tent_p(t) dt over [lo, hi] for pixel centers p = 0..n-1;
+        lo/hi [..., 1] broadcast against p [n]."""
+        p = jnp.arange(n, dtype=jnp.float32)
+        # tent(t) = max(0, 1 - |t - p|); integral via antiderivative
+        def F(t):
+            u = jnp.clip(t - p, -1.0, 1.0)
+            return jnp.where(u <= 0, u + 0.5 * u * u,
+                             u - 0.5 * u * u) + 0.5
+        return F(hi) - F(lo)
+
+    def f(v, rr):
+        N, C, H, W = v.shape
+        x1 = rr[:, 0] * scale
+        y1 = rr[:, 1] * scale
+        x2 = rr[:, 2] * scale
+        y2 = rr[:, 3] * scale
+        bh = jnp.maximum(y2 - y1, 1e-6)[:, None] / ph
+        bw = jnp.maximum(x2 - x1, 1e-6)[:, None] / pw
+        iy = jnp.arange(ph, dtype=jnp.float32)[None, :]
+        ix = jnp.arange(pw, dtype=jnp.float32)[None, :]
+        y_lo = (y1[:, None] + iy * bh)[..., None]        # [R, ph, 1]
+        y_hi = (y1[:, None] + (iy + 1) * bh)[..., None]
+        x_lo = (x1[:, None] + ix * bw)[..., None]
+        x_hi = (x1[:, None] + (ix + 1) * bw)[..., None]
+        Iy = tent_integral(y_lo, y_hi, H)                # [R, ph, H]
+        Ix = tent_integral(x_lo, x_hi, W)                # [R, pw, W]
+        # bin integral / bin area (single-image rois, like roi_pool here)
+        val = jnp.einsum("rih,rjw,chw->rcij", Iy, Ix, v[0])
+        area = bh[:, :, None] * bw[:, None, :]           # [R, 1, 1]
+        return (val / jnp.maximum(area[:, None], 1e-6)).astype(v.dtype)
+
+    return apply("prroi_pool", f, xt, r)
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              name=None):
+    """roi_perspective_transform_op.cc (OCR east): warp each quad ROI
+    [x1..y4] to a [th, tw] rectangle via its homography, bilinear
+    sampling."""
+    xt = to_tensor_like(input)
+    r = to_tensor_like(rois)
+    th, tw = int(transformed_height), int(transformed_width)
+
+    def homography(quad):
+        # map (0,0),(tw-1,0),(tw-1,th-1),(0,th-1) -> quad corners
+        src = jnp.asarray([[0, 0], [tw - 1, 0], [tw - 1, th - 1],
+                           [0, th - 1]], jnp.float32)
+        dst = quad.reshape(4, 2)
+        rows = []
+        for k in range(4):
+            sx, sy = src[k, 0], src[k, 1]
+            dx, dy = dst[k, 0], dst[k, 1]
+            rows.append(jnp.asarray(
+                [sx, sy, 1, 0, 0, 0, -dx * sx, -dx * sy]))
+            rows.append(jnp.asarray(
+                [0, 0, 0, sx, sy, 1, -dy * sx, -dy * sy]))
+        A = jnp.stack(rows)
+        b = dst.reshape(-1)
+        h = jnp.linalg.solve(A + 1e-6 * jnp.eye(8), b)
+        return jnp.concatenate([h, jnp.ones((1,))]).reshape(3, 3)
+
+    def f(v, rr):
+        N, C, H, W = v.shape
+        quads = rr * scale_ if (scale_ := spatial_scale) else rr
+        ys = jnp.arange(th, dtype=jnp.float32)
+        xs = jnp.arange(tw, dtype=jnp.float32)
+        gx, gy = jnp.meshgrid(xs, ys)                    # [th, tw]
+        ones = jnp.ones_like(gx)
+        pts = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)
+
+        def warp_one(quad):
+            Hm = homography(quad)
+            uvw = Hm @ pts
+            u = uvw[0] / jnp.maximum(uvw[2], 1e-6)
+            w_ = uvw[1] / jnp.maximum(uvw[2], 1e-6)
+            x0 = jnp.floor(u).astype(jnp.int32)
+            y0 = jnp.floor(w_).astype(jnp.int32)
+            fx = u - x0
+            fy = w_ - y0
+            def g(yy, xx):
+                ok = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+                val = v[0][:, jnp.clip(yy, 0, H - 1),
+                           jnp.clip(xx, 0, W - 1)]
+                return jnp.where(ok[None], val, 0.0)
+            out = (g(y0, x0) * (1 - fx) * (1 - fy)
+                   + g(y0, x0 + 1) * fx * (1 - fy)
+                   + g(y0 + 1, x0) * (1 - fx) * fy
+                   + g(y0 + 1, x0 + 1) * fx * fy)
+            return out.reshape(C, th, tw)
+
+        return jax.vmap(warp_one)(quads)
+
+    return apply("roi_perspective_transform", f, xt, r)
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1, position_sensitive=False,
+                           name=None):
+    """deformable_psroi_pooling_op.cc: (position-sensitive) ROI average
+    pooling where each output bin's window is TRANSLATED by a learned
+    offset (trans), scaled by trans_std and the roi size.  Computed with
+    the prroi tent-integral over the shifted fractional windows."""
+    xt = to_tensor_like(input)
+    r = to_tensor_like(rois)
+    tr = to_tensor_like(trans)
+    ph, pw = int(pooled_height), int(pooled_width)
+    scale = float(spatial_scale)
+
+    def tent_integral(lo, hi, n):
+        p = jnp.arange(n, dtype=jnp.float32)
+
+        def F(t):
+            u = jnp.clip(t - p, -1.0, 1.0)
+            return jnp.where(u <= 0, u + 0.5 * u * u,
+                             u - 0.5 * u * u) + 0.5
+
+        return F(hi) - F(lo)
+
+    def f(v, rr, tv):
+        N, C, H, W = v.shape
+        R = rr.shape[0]
+        x1 = rr[:, 0] * scale
+        y1 = rr[:, 1] * scale
+        x2 = rr[:, 2] * scale
+        y2 = rr[:, 3] * scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bh = (rh / ph)[:, None, None]
+        bw = (rw / pw)[:, None, None]
+        iy = jnp.arange(ph, dtype=jnp.float32)[None, :, None]
+        ix = jnp.arange(pw, dtype=jnp.float32)[None, None, :]
+        if no_trans:
+            dy = dx = jnp.zeros((R, ph, pw), jnp.float32)
+        else:
+            dy = tv[:, 0, :ph, :pw] * trans_std * rh[:, None, None]
+            dx = tv[:, 1, :ph, :pw] * trans_std * rw[:, None, None]
+        y_lo = y1[:, None, None] + iy * bh + dy
+        y_hi = y_lo + bh
+        x_lo = x1[:, None, None] + ix * bw + dx
+        x_hi = x_lo + bw
+        Iy = tent_integral(y_lo[..., None], y_hi[..., None], H)  # [R,ph,pw,H]
+        Ix = tent_integral(x_lo[..., None], x_hi[..., None], W)  # [R,ph,pw,W]
+        if position_sensitive:
+            oc = C // (ph * pw)
+            vm = v[0].reshape(oc, ph, pw, H, W)
+            val = jnp.einsum("rijh,rijw,cijhw->rcij", Iy, Ix, vm)
+        else:
+            val = jnp.einsum("rijh,rijw,chw->rcij", Iy, Ix, v[0])
+        area = jnp.maximum(bh * bw, 1e-6)
+        return (val / area[:, None]).astype(v.dtype)
+
+    return apply("deformable_roi_pooling", f, xt, r, tr)
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info=None, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.5,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=81, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    """generate_proposal_labels_op.cc, fixed-shape TPU form: label each
+    proposal by IoU against gt (fg >= fg_thresh gets the matched class,
+    bg in [bg_thresh_lo, bg_thresh_hi) gets 0, else -1/ignored), capped
+    to the fg/bg budget deterministically by IoU order; returns
+    (labels [R], bbox_targets [R, 4], fg_mask, bg_mask) instead of
+    compacted sampled lists."""
+    rois = to_tensor_like(rpn_rois)
+    gcls = to_tensor_like(gt_classes)
+    gbox = to_tensor_like(gt_boxes)
+    ww = np.asarray(bbox_reg_weights, np.float32)
+
+    def f(r, gc, gb):
+        iou = _pairwise_iou(r, gb)
+        best = iou.argmax(axis=1)
+        best_iou = iou.max(axis=1)
+        fg = best_iou >= fg_thresh
+        bg = (best_iou < bg_thresh_hi) & (best_iou >= bg_thresh_lo)
+        n_fg = int(batch_size_per_im * fg_fraction)
+        fg_rank = jnp.argsort(jnp.argsort(
+            -jnp.where(fg, best_iou, -jnp.inf)))
+        fg = fg & (fg_rank < n_fg)
+        n_bg = batch_size_per_im - jnp.minimum(fg.sum(), n_fg)
+        bg_rank = jnp.argsort(jnp.argsort(
+            -jnp.where(bg, best_iou, -jnp.inf)))
+        bg = bg & (bg_rank < n_bg)
+        labels = jnp.where(fg, gc.reshape(-1)[best].astype(jnp.int32),
+                           jnp.where(bg, 0, -1))
+        mg = gb[best]
+        rw_ = r[:, 2] - r[:, 0]
+        rh_ = r[:, 3] - r[:, 1]
+        rcx = r[:, 0] + rw_ / 2
+        rcy = r[:, 1] + rh_ / 2
+        gw_ = mg[:, 2] - mg[:, 0]
+        gh_ = mg[:, 3] - mg[:, 1]
+        t = jnp.stack([
+            ((mg[:, 0] + gw_ / 2) - rcx) / jnp.maximum(rw_, 1e-6) / ww[0],
+            ((mg[:, 1] + gh_ / 2) - rcy) / jnp.maximum(rh_, 1e-6) / ww[1],
+            jnp.log(jnp.maximum(gw_, 1e-6)
+                    / jnp.maximum(rw_, 1e-6)) / ww[2],
+            jnp.log(jnp.maximum(gh_, 1e-6)
+                    / jnp.maximum(rh_, 1e-6)) / ww[3]], axis=1)
+        return labels, jnp.where(fg[:, None], t, 0.0), fg, bg
+
+    return apply("generate_proposal_labels", f, rois, gcls, gbox)

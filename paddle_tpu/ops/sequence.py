@@ -290,3 +290,155 @@ def sequence_concat(input, lengths_list=None, name=None):
         return out, total
 
     return apply("sequence_concat", f, *ts, *lens)
+
+
+def sequence_expand(x, y_lengths, ref_level=0, x_lengths=None, name=None):
+    """sequence_expand_op: repeat each of x's B sequences y_lengths[b]
+    times along a new repeat axis.  Padded form: x [B, ...] (one row per
+    sequence, the common use) -> [B, R, ...] with R = max(y_lengths) and
+    a validity mask implied by y_lengths; rows past a sequence's repeat
+    count are zero."""
+    t = to_tensor_like(x)
+    ly = to_tensor_like(y_lengths)
+    R = int(_host_lengths(ly, "sequence_expand", "repeat counts").max())
+
+    def f(v, ln):
+        reps = jnp.arange(R)[None, :] < ln[:, None]           # [B, R]
+        out = jnp.repeat(v[:, None], R, axis=1)
+        mask = reps.reshape(reps.shape + (1,) * (v.ndim - 1))
+        return jnp.where(mask, out, 0)
+
+    return apply("sequence_expand", f, t, ly)
+
+
+def sequence_reshape(input, new_dim, lengths=None, name=None):
+    """sequence_reshape_op: re-chunk the feature dim — [B, L, D] ->
+    [B, L*D//new_dim, new_dim]; lengths scale by D/new_dim."""
+    t = to_tensor_like(input)
+    nd = int(new_dim)
+
+    def f(v):
+        B, L, D = v.shape
+        return v.reshape(B, L * D // nd, nd)
+
+    out = apply("sequence_reshape", f, t)
+    if lengths is None:
+        return out
+    ln = to_tensor_like(lengths)
+    D = t.shape[-1]
+
+    def g(l):
+        return (l * D) // nd
+
+    return out, apply("sequence_reshape_len", g, ln)
+
+
+def sequence_scatter(input, index, updates, lengths=None, name=None):
+    """sequence_scatter_op: out[b, index[b, i]] += updates[b, i] for the
+    valid prefix of each sequence (padded index/updates + lengths)."""
+    t = to_tensor_like(input)
+    ix = to_tensor_like(index)
+    up = to_tensor_like(updates)
+    args = [t, ix, up]
+    if lengths is not None:
+        args.append(to_tensor_like(lengths))
+
+    def f(v, idx, u, *maybe_len):
+        B, L = idx.shape[:2]
+        if maybe_len:
+            valid = jnp.arange(L)[None, :] < maybe_len[0][:, None]
+            u = jnp.where(valid.reshape(valid.shape + (1,) *
+                                        (u.ndim - 2)), u, 0)
+        b_idx = jnp.repeat(jnp.arange(B)[:, None], L, axis=1)
+        return v.at[b_idx.reshape(-1),
+                    idx.reshape(-1).astype(jnp.int32)].add(
+            u.reshape((-1,) + u.shape[2:]))
+
+    return apply("sequence_scatter", f, *args)
+
+
+def sequence_slice(input, offset, length, name=None):
+    """sequence_slice_op: per-sequence window [offset[b], offset[b]+
+    length[b]) gathered left-aligned into [B, max(length), ...]."""
+    t = to_tensor_like(input)
+    off = to_tensor_like(offset)
+    ln = to_tensor_like(length)
+    Lmax = int(_host_lengths(ln, "sequence_slice", "window sizes").max())
+
+    def f(v, o, l):
+        B = v.shape[0]
+        pos = o.reshape(B, 1) + jnp.arange(Lmax)[None, :]
+        valid = jnp.arange(Lmax)[None, :] < l.reshape(B, 1)
+        pos = jnp.clip(pos, 0, v.shape[1] - 1).astype(jnp.int32)
+        gathered = jnp.take_along_axis(
+            v, pos.reshape(B, Lmax, *([1] * (v.ndim - 2))), axis=1)
+        return jnp.where(valid.reshape(B, Lmax,
+                                       *([1] * (v.ndim - 2))),
+                         gathered, 0)
+
+    return apply("sequence_slice", f, t, off, ln)
+
+
+def sequence_conv(input, filter, lengths=None, context_length=3,
+                  context_start=None, padding_data=None, bias=None,
+                  act=None, name=None):
+    """sequence_conv_op: context-window conv over the time axis —
+    [B, L, D] x filter [context_length*D, M] -> [B, L, M], windows
+    zero-padded at sequence edges (and past `lengths`)."""
+    t = to_tensor_like(input)
+    w = to_tensor_like(filter)
+    cl = int(context_length)
+    cs = int(context_start if context_start is not None else -(cl // 2))
+    args = [t, w]
+    if lengths is not None:
+        args.append(to_tensor_like(lengths))
+
+    pad_rows = (to_tensor_like(padding_data)
+                if padding_data is not None else None)
+    if pad_rows is not None:
+        args.append(pad_rows)
+    has_len = lengths is not None
+
+    def f(v, wf, *rest):
+        B, L, D = v.shape
+        pd = rest[-1] if pad_rows is not None else None
+        if has_len:
+            valid = jnp.arange(L)[None, :] < rest[0][:, None]
+            v = jnp.where(valid[..., None], v, 0)
+        cols = []
+        up = max(0, -cs)          # rows of padding_data used on the left
+        for k in range(cl):
+            shift = cs + k
+            rolled = jnp.roll(v, -shift, axis=1)
+            idx = jnp.arange(L) + shift
+            ok = (idx >= 0) & (idx < L)
+            if pd is None:
+                fill = jnp.zeros((1, 1, D), v.dtype)
+            else:
+                # out-of-range windows read the trainable padding rows
+                # (sequence_conv_op PaddingData: top rows pad the start,
+                # bottom rows pad the end)
+                row = jnp.where(idx < 0, jnp.clip(idx + up, 0,
+                                                  pd.shape[0] - 1),
+                                jnp.clip(up + (idx - L), 0,
+                                         pd.shape[0] - 1))
+                fill = pd[row][None]
+            cols.append(jnp.where(ok[None, :, None], rolled, fill))
+        ctx = jnp.concatenate(cols, axis=-1)          # [B, L, cl*D]
+        out = ctx @ wf
+        return out
+
+    out = apply("sequence_conv", f, *args)
+    if bias is not None:
+        from .math import add
+
+        out = add(out, to_tensor_like(bias))
+    if act is not None:
+        import paddle_tpu.nn.functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+__all__ += ["sequence_expand", "sequence_reshape", "sequence_scatter",
+            "sequence_slice", "sequence_conv"]
