@@ -97,6 +97,9 @@ from .compat import (  # noqa: F401
     mv, rank, reduce_max, reduce_mean, reduce_min, reduce_prod,
     reduce_sum, scatter_, set_cuda_rng_state, set_printoptions, shape,
     tanh_)
+from .nn.functional.extension import (  # noqa: F401
+    array_length, array_read, array_write, create_array)
+from .compat import elementwise_mul  # noqa: F401
 from .jit import to_static  # noqa: F401
 
 __version__ = "0.1.0"

@@ -52,3 +52,6 @@ def grad(
         inputs=list(inputs),
         allow_unused=allow_unused,
     )
+
+from . import tape as backward_mode  # noqa: F401 — reference exposes the
+#   backward-mode engine module under this name

@@ -181,6 +181,7 @@ elementwise_div = _fluid_elementwise("divide")
 elementwise_mod = _fluid_elementwise("mod")
 elementwise_pow = _fluid_elementwise("pow")
 elementwise_floordiv = _fluid_elementwise("floor_divide")
+elementwise_mul = _fluid_elementwise("multiply")
 
 
 # -- printing ---------------------------------------------------------------

@@ -46,3 +46,4 @@ from .ring_attention import (  # noqa: F401
 )
 from .spawn import spawn  # noqa: F401
 from . import launch  # noqa: F401
+from .entry import CountFilterEntry, ProbabilityEntry  # noqa: F401

@@ -43,3 +43,7 @@ distributed_model = _fleet_instance.distributed_model
 minimize = _fleet_instance.minimize
 save_persistables = _fleet_instance.save_persistables
 fleet = _fleet_instance
+from . import metrics  # noqa: F401
+from .dataset import MultiSlotDataGenerator  # noqa: F401
+from .role_maker import Role  # noqa: F401
+from .fleet_base import _UtilBase as UtilBase  # noqa: F401

@@ -261,3 +261,40 @@ class InMemoryDataset(DatasetBase):
             raise RuntimeError("call load_into_memory() first")
         n = num_threads or self.thread_num
         yield from self._batches_from_records(self._records[thread_id::n])
+
+
+class MultiSlotDataGenerator:
+    """reference fleet MultiSlotDataGenerator (data_generator.py): user
+    subclasses implement generate_sample(line); run() streams the
+    MultiSlot text protocol to stdout for dataset pipes."""
+
+    def __init__(self):
+        self._proto_info = None
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "subclass MultiSlotDataGenerator and implement "
+            "generate_sample(line) -> iterator of (name, values) lists")
+
+    def generate_batch(self, samples):
+        for s in samples:
+            yield s
+
+    def _format(self, sample):
+        parts = []
+        for name, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts)
+
+    def run_from_stdin(self):
+        import sys
+
+        for line in sys.stdin:
+            for sample in self.generate_sample(line):
+                sys.stdout.write(self._format(sample) + "\n")
+
+    run = run_from_stdin

@@ -1,3 +1,31 @@
 """paddle_tpu.incubate — experimental subsystems (reference: fluid/incubate/).
 """
 from . import checkpoint  # noqa: F401
+
+from . import optimizer, reader  # noqa: F401
+
+
+class LayerHelper:
+    """fluid LayerHelper shim (reference layer_helper.py): fluid layers
+    used it to create parameters inside op functions; static.nn here
+    instantiates real Layers instead, so the helper only carries the
+    name/attr plumbing old custom layers expect."""
+
+    def __init__(self, layer_type, **kwargs):
+        self.layer_type = layer_type
+        self.kwargs = kwargs
+
+    def create_parameter(self, attr=None, shape=None, dtype="float32",
+                         is_bias=False, default_initializer=None):
+        from ..static.compat import create_parameter as _cp
+
+        return _cp(shape, dtype, attr=attr, is_bias=is_bias,
+                   default_initializer=default_initializer)
+
+    def append_activation(self, out, act=None):
+        act = act or self.kwargs.get("act")
+        if not act:
+            return out
+        import paddle_tpu.nn.functional as F
+
+        return getattr(F, act)(out)

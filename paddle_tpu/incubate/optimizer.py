@@ -1,0 +1,5 @@
+"""paddle.incubate.optimizer (reference incubate/optimizer/__init__.py):
+LookAhead + ModelAverage live here in 2.x."""
+from ..optimizer import Lookahead as LookAhead  # noqa: F401
+from ..optimizer import Lookahead  # noqa: F401
+from ..optimizer import ModelAverage  # noqa: F401

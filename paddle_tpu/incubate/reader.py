@@ -1,0 +1,25 @@
+"""paddle.incubate.reader: the reference ships PipeReader/multiprocess
+readers here; the io.DataLoader worker pool is the modern equivalent."""
+from ..io import DataLoader  # noqa: F401
+
+
+class PipeReader:
+    """Line reader over a shell pipe (reference pipe_reader)."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        self.command = command
+        self.bufsize = bufsize
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        import subprocess
+
+        proc = subprocess.Popen(self.command, shell=True,
+                                stdout=subprocess.PIPE,
+                                bufsize=self.bufsize)
+        try:
+            for raw in proc.stdout:
+                line = raw.decode("utf-8", "replace")
+                yield line.rstrip(line_break) if cut_lines else line
+        finally:
+            proc.stdout.close()
+            proc.wait()

@@ -7,3 +7,29 @@ from . import dy2static  # noqa: F401
 from .functional import functional_call, get_state, tree_unwrap, tree_wrap  # noqa: F401
 from .to_static import InputSpec, StaticFunction, declarative, not_to_static, to_static  # noqa: F401
 from .save_load import load, save, TranslatedLayer  # noqa: F401
+from .dy2static import ProgramTranslator, set_code_level, set_verbosity  # noqa: F401
+
+
+class TracedLayer:
+    """reference TracedLayer (fluid/dygraph/jit.py:40): trace a dygraph
+    Layer into a replayable static artifact.  Here the artifact is the
+    jitted StaticFunction; save_inference_model delegates to jit.save."""
+
+    def __init__(self, layer, static_fn):
+        self._layer = layer
+        self._fn = static_fn
+
+    @staticmethod
+    def trace(layer, inputs):
+        fn = to_static(layer)
+        outs = fn.forward(*inputs) if hasattr(fn, "forward") else fn(*inputs)
+        return outs, TracedLayer(layer, fn)
+
+    def __call__(self, *args):
+        return self._layer(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        from .save_load import save as _save
+
+        _save(self._layer, path)
+        return path

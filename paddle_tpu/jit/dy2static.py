@@ -624,3 +624,80 @@ def convert_function(fn) -> Tuple[types.FunctionType, bool]:
     except (AttributeError, TypeError):
         pass
     return result
+
+
+# --------------------------------------------------------------------------
+# ProgramTranslator surface (reference program_translator.py:756) + the
+# logging knobs (dygraph_to_static/logging_utils.py)
+# --------------------------------------------------------------------------
+
+_verbosity = 0
+_code_level = 0
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """dy2static transform logging verbosity (reference logging_utils)."""
+    global _verbosity
+    _verbosity = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """How much transformed code to show (reference logging_utils)."""
+    global _code_level
+    _code_level = int(level)
+
+
+class ProgramTranslator:
+    """Singleton managing dy2static conversion (reference
+    program_translator.py:756): enable/disable the AST pass globally,
+    fetch converted code for inspection."""
+
+    _instance = None
+    _enabled = True
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    @classmethod
+    def get_instance(cls):
+        return cls()
+
+    def enable(self, enable_to_static=True):
+        type(self)._enabled = bool(enable_to_static)
+
+    @property
+    def enable_to_static(self):
+        return type(self)._enabled
+
+    def get_code(self, dygraph_func):
+        """Transformed source of `dygraph_func` (reference get_code)."""
+        import ast as _ast
+        import inspect as _inspect
+        import textwrap as _textwrap
+
+        fn = getattr(dygraph_func, "__func__", dygraph_func)
+        conv, did = convert_function(fn)
+        if not did:
+            return _textwrap.dedent(_inspect.getsource(fn))
+        src = _textwrap.dedent(_inspect.getsource(fn))
+        tree = _ast.parse(src)
+        fdef = tree.body[0]
+        fdef.decorator_list = []
+        c = _Converter(_collect_locals(fdef))
+        a = fdef.args
+        params = {x.arg for x in a.posonlyargs + a.args + a.kwonlyargs}
+        fdef.body = c.transform_body(fdef.body, set(params))
+        _ast.fix_missing_locations(tree)
+        return _ast.unparse(tree)
+
+    def get_func(self, dygraph_func):
+        fn = getattr(dygraph_func, "__func__", dygraph_func)
+        conv, _ = convert_function(fn)
+        return conv
+
+    def get_program(self, dygraph_func, *args, **kwargs):
+        raise NotImplementedError(
+            "get_program: record through static.Program/program_guard — "
+            "the trace-based capture replaces ProgramDesc extraction")

@@ -312,7 +312,7 @@ def hash(input, hash_size, num_hash=1, name=None):  # noqa: A001 — ref name
         iv = v.astype(jnp.uint32)
         outs = []
         for k in range(nh):
-            h = iv * jnp.uint32(0x9E3779B1) ^ jnp.uint32(0x85EBCA77 * (k + 1))
+            h = iv * jnp.uint32(0x9E3779B1) ^ jnp.uint32((0x85EBCA77 * (k + 1)) & 0xFFFFFFFF)
             h = h ^ (h >> 15)
             h = h * jnp.uint32(0x2C1B3C6D)
             h = h ^ (h >> 13)
@@ -838,7 +838,7 @@ def gru_unit(input, hidden, size=None, param_attr=None, bias_attr=None,
     return apply("gru_unit", f, *args)
 
 
-def _dynamic_rnn_factory(cell_cls, n_gates, name):
+def _dynamic_rnn_factory(cell_cls, size_divisor, name):
     def f(input, size, h_0=None, c_0=None, param_attr=None, bias_attr=None,
           use_peepholes=False, is_reverse=False, gate_activation="sigmoid",
           cell_activation="tanh", candidate_activation="tanh",
@@ -850,7 +850,9 @@ def _dynamic_rnn_factory(cell_cls, n_gates, name):
         from .. import rnn as rnn_mod
 
         x = to_tensor_like(input)
-        H = int(size) // n_gates
+        # fluid conventions: dynamic_lstm's size = 4*hidden; dynamic_gru's
+        # size IS the hidden width
+        H = int(size) // size_divisor
         if weight_ih is None:
             raise ValueError(
                 f"{name}: pass weight_ih/weight_hh explicitly — the "
@@ -875,7 +877,7 @@ def _dynamic_rnn_factory(cell_cls, n_gates, name):
 
 dynamic_lstm = _dynamic_rnn_factory("LSTMCell", 4, "dynamic_lstm")
 dynamic_lstmp = _dynamic_rnn_factory("LSTMCell", 4, "dynamic_lstmp")
-dynamic_gru = _dynamic_rnn_factory("GRUCell", 3, "dynamic_gru")
+dynamic_gru = _dynamic_rnn_factory("GRUCell", 1, "dynamic_gru")
 
 
 def lstm(input, init_h, init_c, max_len=None, hidden_size=None,

@@ -43,10 +43,12 @@ class HSigmoidLoss(Layer):
                  name=None):
         super().__init__()
         self.num_classes = num_classes
+        # reference shapes (nn/layer/loss.py HSigmoidLoss): K-1 internal
+        # tree nodes
         n_nodes = max(num_classes - 1, 1)
         self.weight = self.create_parameter(
-            [n_nodes * 2, feature_size], attr=weight_attr)
-        self.bias = (self.create_parameter([n_nodes * 2], attr=bias_attr,
+            [n_nodes, feature_size], attr=weight_attr)
+        self.bias = (self.create_parameter([n_nodes], attr=bias_attr,
                                            is_bias=True)
                      if bias_attr is not False else None)
 

@@ -961,6 +961,20 @@ def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     return apply("yolov3_loss", f, xt, gb, glb)
 
 
+def _tent_integral(lo, hi, n):
+    """Closed-form integral of the bilinear tent basis around each pixel
+    center p = 0..n-1 over [lo, hi] (shared by prroi_pool and
+    deformable_roi_pooling)."""
+    p = jnp.arange(n, dtype=jnp.float32)
+
+    def F(t):
+        u = jnp.clip(t - p, -1.0, 1.0)
+        return jnp.where(u <= 0, u + 0.5 * u * u,
+                         u - 0.5 * u * u) + 0.5
+
+    return F(hi) - F(lo)
+
+
 def prroi_pool(input, rois, output_size=None, spatial_scale=1.0,
                pooled_height=None, pooled_width=None, batch_roi_nums=None,
                name=None):
@@ -979,17 +993,6 @@ def prroi_pool(input, rois, output_size=None, spatial_scale=1.0,
         ph = pw = int(output_size)
     scale = float(spatial_scale)
 
-    def tent_integral(lo, hi, n):
-        """∫ tent_p(t) dt over [lo, hi] for pixel centers p = 0..n-1;
-        lo/hi [..., 1] broadcast against p [n]."""
-        p = jnp.arange(n, dtype=jnp.float32)
-        # tent(t) = max(0, 1 - |t - p|); integral via antiderivative
-        def F(t):
-            u = jnp.clip(t - p, -1.0, 1.0)
-            return jnp.where(u <= 0, u + 0.5 * u * u,
-                             u - 0.5 * u * u) + 0.5
-        return F(hi) - F(lo)
-
     def f(v, rr):
         N, C, H, W = v.shape
         x1 = rr[:, 0] * scale
@@ -1004,8 +1007,8 @@ def prroi_pool(input, rois, output_size=None, spatial_scale=1.0,
         y_hi = (y1[:, None] + (iy + 1) * bh)[..., None]
         x_lo = (x1[:, None] + ix * bw)[..., None]
         x_hi = (x1[:, None] + (ix + 1) * bw)[..., None]
-        Iy = tent_integral(y_lo, y_hi, H)                # [R, ph, H]
-        Ix = tent_integral(x_lo, x_hi, W)                # [R, pw, W]
+        Iy = _tent_integral(y_lo, y_hi, H)                # [R, ph, H]
+        Ix = _tent_integral(x_lo, x_hi, W)                # [R, pw, W]
         # bin integral / bin area (single-image rois, like roi_pool here)
         val = jnp.einsum("rih,rjw,chw->rcij", Iy, Ix, v[0])
         area = bh[:, :, None] * bw[:, None, :]           # [R, 1, 1]
@@ -1091,16 +1094,6 @@ def deformable_roi_pooling(input, rois, trans, no_trans=False,
     ph, pw = int(pooled_height), int(pooled_width)
     scale = float(spatial_scale)
 
-    def tent_integral(lo, hi, n):
-        p = jnp.arange(n, dtype=jnp.float32)
-
-        def F(t):
-            u = jnp.clip(t - p, -1.0, 1.0)
-            return jnp.where(u <= 0, u + 0.5 * u * u,
-                             u - 0.5 * u * u) + 0.5
-
-        return F(hi) - F(lo)
-
     def f(v, rr, tv):
         N, C, H, W = v.shape
         R = rr.shape[0]
@@ -1123,8 +1116,8 @@ def deformable_roi_pooling(input, rois, trans, no_trans=False,
         y_hi = y_lo + bh
         x_lo = x1[:, None, None] + ix * bw + dx
         x_hi = x_lo + bw
-        Iy = tent_integral(y_lo[..., None], y_hi[..., None], H)  # [R,ph,pw,H]
-        Ix = tent_integral(x_lo[..., None], x_hi[..., None], W)  # [R,ph,pw,W]
+        Iy = _tent_integral(y_lo[..., None], y_hi[..., None], H)  # [R,ph,pw,H]
+        Ix = _tent_integral(x_lo[..., None], x_hi[..., None], W)  # [R,ph,pw,W]
         if position_sensitive:
             oc = C // (ph * pw)
             vm = v[0].reshape(oc, ph, pw, H, W)

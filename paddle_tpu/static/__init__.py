@@ -37,3 +37,15 @@ def name_scope(name):
         yield
 
     return _scope()
+from .compat import (  # noqa: F401
+    BuildStrategy, ExecutionStrategy, ParallelExecutor, Variable,
+    WeightNormParamAttr, accuracy, append_backward, auc, cpu_places,
+    create_parameter, cuda_places, deserialize_persistables,
+    deserialize_program, device_guard, gradients, load_from_file,
+    load_inference_model, load_program_state, load_vars,
+    normalize_program, py_func, save_inference_model, save_to_file,
+    save_vars, serialize_persistables, serialize_program,
+    set_program_state, xpu_places)
+from ..compat import create_global_var  # noqa: F401
+from .program import Scope  # noqa: F401
+from .. import amp  # noqa: F401  (reference static re-exports amp)

@@ -1,0 +1,435 @@
+"""Remaining paddle.static surface (reference static/__init__.py):
+executors/strategies, program (de)serialization, var save/load, device
+places, py_func.  Real implementations over the Program machinery."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..tensor import Parameter, Tensor
+
+# -- legacy types over the modern machinery ---------------------------------
+
+Variable = Tensor  # static Variables ARE placeholder Tensors here
+
+
+class BuildStrategy:
+    """CompiledProgram knobs (reference build_strategy.cc).  XLA owns
+    fusion/memory planning, so the fields are accepted and recorded; the
+    ones with TPU meaning (gradient scale, sequential run) are consumed
+    by CompiledProgram."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = self.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = self.GradientScaleStrategy.CoeffNumDevice
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.fuse_all_optimizer_ops = False
+        self.fuse_all_reduce_ops = False
+        self.fuse_broadcast_ops = False
+        self.fuse_elewise_add_act_ops = False
+        self.sync_batch_norm = False
+        self.debug_graphviz_path = ""
+
+
+class ExecutionStrategy:
+    """Executor knobs (reference execution_strategy).  num_threads etc.
+    are inert under XLA's own scheduler but kept for script compat."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.num_iteration_per_run = 1
+        self.use_thread_barrier = False
+
+
+class WeightNormParamAttr:
+    """ParamAttr requesting weight normalization (reference
+    WeightNormParamAttr): carried through create_parameter; the norm is
+    applied functionally (nn.utils.weight_norm / F.spectral_norm family)."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+class ParallelExecutor:
+    """Legacy ParallelExecutor (reference parallel_executor.py): a thin
+    front over CompiledProgram.with_data_parallel + Executor."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        from .program import CompiledProgram, Executor, default_main_program
+
+        self._program = main_program or default_main_program()
+        self._compiled = CompiledProgram(self._program).with_data_parallel(
+            loss_name=loss_name, build_strategy=build_strategy,
+            exec_strategy=exec_strategy)
+        self._exe = Executor()
+
+    def run(self, fetch_list=None, feed=None, feed_dict=None,
+            return_numpy=True):
+        return self._exe.run(self._compiled, feed=feed or feed_dict,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+# -- places -----------------------------------------------------------------
+
+def cpu_places(device_count=None):
+    from ..framework.place import CPUPlace
+
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """On TPU hosts this returns the TPU places (scripts asking for
+    'the accelerators' get them)."""
+    from ..framework.place import TPUPlace
+
+    ids = device_ids if device_ids is not None else [0]
+    return [TPUPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+class device_guard:
+    """reference static.device_guard: pins ops to a device in the
+    program.  XLA owns placement on TPU — the guard is a documented
+    no-op context (ops stay where the mesh/sharding puts them)."""
+
+    def __init__(self, device=None):
+        self.device = device
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+# -- backward / gradients ---------------------------------------------------
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """reference backward.py:append_backward — record the backward ops
+    into the active Program and return (param, grad) pairs."""
+    from ..autograd.tape import run_backward
+
+    run_backward([loss], retain_graph=True, create_graph=True)
+    params = parameter_list
+    if params is None:
+        from .program import _active_recorder
+
+        prog = _active_recorder()
+        params = [p for p in (prog.parameters() if prog is not None
+                              else []) if isinstance(p, Parameter)]
+    return [(p, p._grad) for p in params if p._grad is not None]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference static.gradients: grads of targets w.r.t. inputs."""
+    from ..autograd.tape import run_backward
+
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return run_backward(list(targets), grad_tensors=target_gradients,
+                        retain_graph=True, create_graph=True,
+                        inputs=list(inputs), allow_unused=True)
+
+
+# -- parameters / global vars ----------------------------------------------
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """reference static.create_parameter — a trainable Parameter
+    registered with the active Program when recording."""
+    import jax.numpy as jnp
+
+    from ..framework import dtype as _dt
+    from ..framework.random import next_rng_key
+    import jax
+
+    d = _dt.convert_dtype(dtype)
+    if default_initializer is not None:
+        val = default_initializer(shape, d)
+        if isinstance(val, Tensor):
+            val = val._value
+    elif is_bias:
+        val = jnp.zeros(shape, d)
+    else:
+        fan_in = shape[0] if shape else 1
+        bound = float(np.sqrt(6.0 / max(fan_in, 1)))
+        val = jax.random.uniform(next_rng_key(), tuple(shape), d,
+                                 -bound, bound)
+    p = Parameter(val)
+    if name:
+        p.name = name
+    # recording Programs register parameters on first USE (dispatch
+    # notes Tensors with trainable=True), so no explicit registration
+    return p
+
+
+# -- py_func ---------------------------------------------------------------
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference py_func_op.cc: run an arbitrary HOST python function as
+    an op.  TPU-native: jax.pure_callback carries the call through jit
+    and Program replay; `out` supplies the (shape, dtype) contract like
+    the reference's out template vars; backward_func becomes the custom
+    VJP (also a host callback).  Like the reference (py_func ops cannot
+    ride save_inference_model there either), a program containing
+    py_func executes and replays in-process but cannot be SERIALIZED —
+    jax.export has no host-callback story yet."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops._helpers import to_tensor_like
+    from ..ops.dispatch import apply
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    xs = [to_tensor_like(v) for v in xs]
+    def _is_spec(o):
+        return (isinstance(o, (list, tuple)) and len(o) == 2
+                and isinstance(o[0], (list, tuple)))
+
+    single = not isinstance(out, (list, tuple)) or _is_spec(out)
+    outs = [out] if single else list(out)
+    def _spec(o):
+        if _is_spec(o):                 # ((shape), dtype) pair
+            return jax.ShapeDtypeStruct(tuple(o[0]), np.dtype(o[1]))
+        if isinstance(o, Tensor):
+            return jax.ShapeDtypeStruct(tuple(o.shape), o._value.dtype)
+        return jax.ShapeDtypeStruct(tuple(o.shape), np.dtype(o.dtype))
+    out_specs = [_spec(o) for o in outs]
+
+    def host(*arrays):
+        res = func(*[np.asarray(a) for a in arrays])
+        if not isinstance(res, (list, tuple)):
+            res = [res]
+        return tuple(np.asarray(r, s.dtype).reshape(s.shape)
+                     for r, s in zip(res, out_specs))
+
+    @jax.custom_vjp
+    def op(*vals):
+        res = jax.pure_callback(host, tuple(out_specs), *vals)
+        return res if len(res) > 1 else res[0]
+
+    def fwd(*vals):
+        return op(*vals), vals
+
+    def bwd(vals, g):
+        if backward_func is None:
+            return tuple(jnp.zeros_like(v) for v in vals)
+
+        gs = g if isinstance(g, tuple) else (g,)
+        in_specs = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                         for v in vals)
+
+        def host_bwd(*arrs):
+            res = backward_func(*[np.asarray(a) for a in arrs])
+            if not isinstance(res, (list, tuple)):
+                res = [res]
+            return tuple(np.asarray(r, s.dtype).reshape(s.shape)
+                         for r, s in zip(res, in_specs))
+
+        return jax.pure_callback(host_bwd, in_specs, *vals, *gs)
+
+    op.defvjp(fwd, bwd)
+    res = apply("py_func", op, *xs)
+    return res if not single else res
+
+
+# -- program / var persistence ---------------------------------------------
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    """Serialized program bytes (the reference returns protobuf bytes;
+    here the StableHLO inference artifact of Program.save, bundled)."""
+    import tempfile
+
+    from .program import default_main_program
+
+    program = program or default_main_program()
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "prog")
+        program.save(prefix, list(fetch_vars))
+        with open(prefix + ".program", "rb") as f:
+            hlo = f.read()
+        with open(prefix + ".params", "rb") as f:
+            params = f.read()
+    return pickle.dumps({"program": hlo, "params": params})
+
+
+def deserialize_program(data):
+    import tempfile
+
+    from .program import load_inference_program
+
+    blob = pickle.loads(data)
+    d = tempfile.mkdtemp()
+    prefix = os.path.join(d, "prog")
+    with open(prefix + ".program", "wb") as f:
+        f.write(blob["program"])
+    with open(prefix + ".params", "wb") as f:
+        f.write(blob["params"])
+    return load_inference_program(prefix)
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    from .program import default_main_program
+
+    program = program or default_main_program()
+    state = {p.name: np.asarray(p.numpy())
+             for p in program.parameters()}
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data, executor=None):
+    state = pickle.loads(data)
+    for p in program.parameters():
+        if p.name in state:
+            p.set_value(state[p.name])
+    return program
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Prune to the inference slice (reference normalize_program) — the
+    Program's save() already prunes to fetches; this records them."""
+    program._inference_io = (list(feed_vars), list(fetch_vars))
+    return program
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    from .program import default_main_program
+
+    program = program or default_main_program()
+    program.save(path_prefix, list(fetch_vars))
+    return path_prefix
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    from .program import load_inference_program
+
+    loaded = load_inference_program(path_prefix)
+    return loaded, loaded.feed_names, list(range(loaded._n_fetch))
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    from .program import default_main_program
+
+    program = main_program or default_main_program()
+    ps = vars or program.parameters()
+    if predicate is not None:
+        ps = [p for p in ps if predicate(p)]
+    state = {p.name: np.asarray(p.numpy()) for p in ps}
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, filename or "__all__.pdvars"),
+              "wb") as f:
+        pickle.dump(state, f)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    from .program import default_main_program
+
+    program = main_program or default_main_program()
+    with open(os.path.join(dirname, filename or "__all__.pdvars"),
+              "rb") as f:
+        state = pickle.load(f)
+    ps = vars or program.parameters()
+    if predicate is not None:
+        ps = [p for p in ps if predicate(p)]
+    for p in ps:
+        if p.name in state:
+            p.set_value(state[p.name])
+
+
+def load_program_state(model_path, var_list=None):
+    with open(model_path + ".pdvars" if not model_path.endswith(".pdvars")
+              else model_path, "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    for p in program.parameters():
+        if p.name in state_dict:
+            p.set_value(np.asarray(state_dict[p.name]))
+
+
+# -- static metrics ---------------------------------------------------------
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """reference static accuracy op: top-k accuracy as a tensor."""
+    import jax.numpy as jnp
+
+    from ..ops._helpers import to_tensor_like
+    from ..ops.dispatch import apply
+
+    def f(logits, y):
+        topk = jnp.argsort(-logits, axis=-1)[:, :k]
+        hit = (topk == y.reshape(-1, 1)).any(axis=1)
+        return hit.mean(dtype=jnp.float32)
+
+    return apply("accuracy", f, to_tensor_like(input),
+                 to_tensor_like(label))
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """reference static auc op (single-batch form): ROC-AUC over the
+    positive-class scores, trapezoid over thresholds."""
+    import jax.numpy as jnp
+
+    from ..ops._helpers import to_tensor_like
+    from ..ops.dispatch import apply
+
+    def f(probs, y):
+        pos = probs[:, 1] if probs.ndim == 2 else probs.reshape(-1)
+        y = y.reshape(-1)
+        thresh = jnp.linspace(0, 1, num_thresholds + 1)
+        pred_pos = pos[None, :] >= thresh[:, None]
+        tp = (pred_pos & (y[None] == 1)).sum(axis=1)
+        fp = (pred_pos & (y[None] == 0)).sum(axis=1)
+        P = jnp.maximum((y == 1).sum(), 1)
+        N = jnp.maximum((y == 0).sum(), 1)
+        tpr = tp / P
+        fpr = fp / N
+        return jnp.trapezoid(tpr[::-1], fpr[::-1]).astype(jnp.float32)
+
+    out = apply("auc", f, to_tensor_like(input), to_tensor_like(label))
+    return out, out, [out]

@@ -70,3 +70,318 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     if act:
         out = getattr(F, act)(out)
     return out
+
+
+# --- round-5 remainder of the static.nn surface ---------------------------
+
+def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCHW", name=None):
+    from ..nn import Conv2DTranspose
+    from ..nn import functional as F
+
+    in_c = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    layer = Conv2DTranspose(in_c, num_filters, filter_size, stride, padding,
+                            dilation=dilation, groups=groups,
+                            weight_attr=param_attr, bias_attr=bias_attr,
+                            data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCDHW", name=None):
+    from ..nn import Conv3D
+    from ..nn import functional as F
+
+    in_c = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    layer = Conv3D(in_c, num_filters, filter_size, stride, padding, dilation,
+                   groups, weight_attr=param_attr, bias_attr=bias_attr,
+                   data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCDHW", name=None):
+    from ..nn import Conv3DTranspose
+    from ..nn import functional as F
+
+    in_c = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    layer = Conv3DTranspose(in_c, num_filters, filter_size, stride, padding,
+                            dilation=dilation, groups=groups,
+                            weight_attr=param_attr, bias_attr=bias_attr,
+                            data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def _norm_like(layer_cls, ch_arg, input, act, **kw):
+    from ..nn import functional as F
+
+    layer = layer_cls(ch_arg, **kw)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from ..nn import GroupNorm
+
+    ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    layer = GroupNorm(groups, ch, epsilon=epsilon, weight_attr=param_attr,
+                      bias_attr=bias_attr)
+    out = layer(input)
+    if act:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..nn import InstanceNorm2D
+
+    return InstanceNorm2D(input.shape[1], epsilon=epsilon,
+                          weight_attr=param_attr,
+                          bias_attr=bias_attr)(input)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from ..nn import LayerNorm
+    from ..nn import functional as F
+
+    shape = list(input.shape[begin_norm_axis:])
+    layer = LayerNorm(shape, epsilon=epsilon,
+                      weight_attr=param_attr if scale else False,
+                      bias_attr=bias_attr if shift else False)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from ..nn import PReLU
+
+    n = 1
+    if mode == "channel":
+        n = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    elif mode == "element":
+        import numpy as _np
+
+        n = int(_np.prod(x.shape[1:]))
+    return PReLU(num_parameters=n, weight_attr=param_attr)(x)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    from .compat import create_parameter
+    from ..nn.functional.extension import bilinear_tensor_product as _btp
+
+    w = create_parameter([size, x.shape[-1], y.shape[-1]], "float32",
+                         name=name)
+    b = (create_parameter([size], "float32", is_bias=True)
+         if bias_attr is not False else None)
+    return _btp(x, y, w, bias=b, act=act)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """row_conv_op.cc (lookahead conv for streaming ASR):
+    out[t] = sum_{i=0..k} w[i] * x[t+i]."""
+    import jax.numpy as jnp
+
+    from .compat import create_parameter
+    from ..ops._helpers import to_tensor_like
+    from ..ops.dispatch import apply
+    from ..nn import functional as F
+
+    x = to_tensor_like(input)
+    k = int(future_context_size) + 1
+    w = create_parameter([k, x.shape[-1]], "float32")
+
+    def f(v, wv):
+        outs = jnp.zeros_like(v)
+        T = v.shape[1]
+        for i in range(k):
+            rolled = jnp.roll(v, -i, axis=1)
+            ok = (jnp.arange(T) + i) < T
+            outs = outs + jnp.where(ok[None, :, None], rolled, 0) * wv[i]
+        return outs
+
+    out = apply("row_conv", f, x, w)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, weight_attr=None, bias_attr=None,
+                  name=None):
+    from .compat import create_parameter
+    from ..nn.functional.conv import deformable_conv
+
+    in_c = x.shape[1]
+    fs = (filter_size, filter_size) if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    w = create_parameter([num_filters, in_c // groups, fs[0], fs[1]],
+                         "float32")
+    b = (create_parameter([num_filters], "float32", is_bias=True)
+         if bias_attr is not False else None)
+    return deformable_conv(x, offset, mask, w, bias=b, stride=stride,
+                           padding=padding, dilation=dilation,
+                           deformable_groups=deformable_groups,
+                           groups=groups)
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None, **kw):
+    from .compat import create_parameter
+    from ..nn.functional.extension import data_norm as _dn
+    from ..ops import creation
+
+    D = input.shape[-1]
+    size = create_parameter([D], "float32",
+                            default_initializer=lambda s, d: creation.full(
+                                s, 1.0, dtype="float32"))
+    summ = create_parameter([D], "float32", is_bias=True)
+    sqsum = create_parameter([D], "float32",
+                             default_initializer=lambda s, d: creation.full(
+                                 s, 1.0, dtype="float32"))
+    return _dn(input, act=act, epsilon=epsilon, batch_size=size,
+               batch_sum=summ, batch_square_sum=sqsum)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    from .compat import create_parameter
+    from ..nn.functional.extension import nce as _nce
+
+    w = create_parameter([num_total_classes, input.shape[-1]], "float32")
+    b = (create_parameter([num_total_classes], "float32", is_bias=True)
+         if bias_attr is not False else None)
+    return _nce(input, label, num_total_classes,
+                num_neg_samples=num_neg_samples, weight=w, bias=b)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ..nn.functional.extension import spectral_norm as _sn
+
+    return _sn(weight, dim=dim, power_iters=power_iters, eps=eps)
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None,
+                 transition=None):
+    from ..nn.functional.extension import crf_decoding as _crf
+
+    if transition is None:
+        raise ValueError(
+            "static.nn.crf_decoding: pass transition= explicitly (the "
+            "linear_chain_crf parameter)")
+    return _crf(input, transition, length, label=label)
+
+
+def multi_box_head(*args, **kwargs):
+    from ..nn.functional.extension import multi_box_head as _mbh
+
+    return _mbh(*args, **kwargs)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, param_attr=None, dtype="float32",
+                     name=None, table=None):
+    """static.nn.sparse_embedding (reference: PS-backed large-scale
+    embedding).  Routes through the fleet sparse embedding table."""
+    from ..distributed.ps.embedding import SparseEmbedding
+
+    emb = SparseEmbedding(size[1], name=name or "sparse_emb",
+                          table=table)
+    return emb(input)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """static.nn.case (fluid case op): first true predicate wins —
+    lowered to a chain of traced_cond."""
+    if not pred_fn_pairs:
+        raise ValueError("case: pred_fn_pairs must be non-empty")
+
+    def build(pairs):
+        (pred, fn) = pairs[0]
+        rest = pairs[1:]
+        if not rest:
+            if default is None:
+                return fn()
+            from ..ops.logic import cond as _cond
+
+            return _cond(pred, fn, default)
+        from ..ops.logic import cond as _cond
+
+        return _cond(pred, fn, lambda: build(rest))
+
+    return build(list(pred_fn_pairs))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """static.nn.switch_case → lax.switch over the branch table."""
+    import jax
+
+    from ..jit.control_flow import _unwrap_tree, _wrap_tree
+    from ..ops._helpers import to_tensor_like
+
+    import jax.numpy as jnp
+
+    idx = to_tensor_like(branch_index)._value.reshape(())
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+        kmap = jnp.asarray(keys)
+        match = kmap == idx
+        hit = match.any()
+        dense = jnp.argmax(match)
+    else:
+        fns = list(branch_fns)
+        hit = (idx >= 0) & (idx < len(fns))
+        dense = idx
+    if default is not None:
+        # mismatched index runs `default` (reference switch_case contract)
+        fns = fns + [default]
+        dense = jnp.where(hit, dense, len(fns) - 1)
+    else:
+        # without a default the LAST branch handles mismatches
+        dense = jnp.where(hit, dense, len(fns) - 1)
+    dense = jnp.clip(dense, 0, len(fns) - 1)
+    branches = [lambda _, f=f: _unwrap_tree(f()) for f in fns]
+    out = jax.lax.switch(dense, branches, 0)
+    return _wrap_tree(out)
+
+
+py_func = None  # bound below to avoid a circular import at module load
+
+
+def _bind_late():
+    global py_func, create_parameter
+    from .compat import create_parameter as _cp
+    from .compat import py_func as _pf
+
+    globals()["py_func"] = _pf
+    globals()["create_parameter"] = _cp
+
+
+_bind_late()
+del _bind_late

@@ -38,3 +38,56 @@ def try_import(module_name):
         return importlib.import_module(module_name)
     except ImportError:
         return None
+
+from .profiler import profiler as get_profiler  # noqa: F401
+from . import profiler as Profiler  # noqa: F401
+
+
+class ProfilerOptions:
+    """reference utils/profiler.py ProfilerOptions: knob holder consumed
+    by get_profiler."""
+
+    def __init__(self, options=None):
+        self.options = {
+            "state": "All", "sorted_key": "default", "tracer_level": "Default",
+            "batch_range": [0, 100], "output_thread_detail": False,
+            "profile_path": "none", "timeline_path": "none",
+            "op_summary_path": "none",
+        }
+        if options is not None:
+            self.options.update(options)
+
+    def __getitem__(self, name):
+        return self.options[name]
+
+
+class OpLastCheckpointChecker:
+    """reference utils/op_version.py checker: query op-version
+    checkpoints from the registry (framework/op_version.py here)."""
+
+    def __init__(self):
+        from ..framework import op_version
+
+        self._registry = op_version
+
+    def get_op_attrs(self, op_name):
+        info = self._registry.get_op_version(op_name) \
+            if hasattr(self._registry, "get_op_version") else None
+        return info or []
+
+
+def require_version(min_version, max_version=None):
+    """reference utils/install_check require_version: compare against the
+    installed framework version."""
+    from .. import __version__
+
+    def parse(v):
+        return [int(x) for x in str(v).split(".") if x.isdigit()]
+
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")

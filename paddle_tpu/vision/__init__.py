@@ -1,3 +1,4 @@
 """paddle_tpu.vision (reference: python/paddle/vision/)."""
-from . import datasets, models, ops, transforms  # noqa: F401
+from . import datasets, image, models, ops, transforms  # noqa: F401
+from .image import get_image_backend, image_load, set_image_backend  # noqa: F401
 from .models import LeNet, ResNet, resnet18, resnet50  # noqa: F401

@@ -1,0 +1,35 @@
+"""paddle.vision.image (reference vision/image.py): image backend
+selection + image_load."""
+from __future__ import annotations
+
+_backend = None
+
+
+def set_image_backend(backend):
+    global _backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"expected backend are one of ['pil', 'cv2', 'tensor'], "
+            f"but got {backend}")
+    _backend = backend
+
+
+def get_image_backend():
+    return _backend or "pil"
+
+
+def image_load(path, backend=None):
+    """Load an image file; PIL backend returns a PIL.Image (reference
+    contract), cv2/tensor return arrays."""
+    import numpy as np
+
+    backend = backend or get_image_backend()
+    from PIL import Image
+
+    img = Image.open(path)
+    if backend == "pil":
+        return img
+    arr = np.asarray(img.convert("RGB"))
+    if backend == "cv2":
+        return arr[..., ::-1]  # BGR like cv2.imread
+    return arr

@@ -17,3 +17,7 @@ from .mobilenet import (  # noqa: F401
     mobilenet_v1,
     mobilenet_v2,
 )
+
+from . import mobilenet as mobilenetv1  # noqa: F401
+from . import mobilenet as mobilenetv2  # noqa: F401
+from . import lenet, resnet, vgg  # noqa: F401
