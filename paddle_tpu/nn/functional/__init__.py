@@ -71,7 +71,8 @@ from ...ops.detection import (  # noqa: F401
     box_decoder_and_assign, collect_fpn_proposals, density_prior_box,
     detection_output, distribute_fpn_proposals, generate_proposals,
     multiclass_nms, polygon_box_transform, prior_box, psroi_pool,
-    deformable_roi_pooling, generate_proposal_labels, prroi_pool,
+    deformable_roi_pooling, generate_mask_labels, generate_proposal_labels,
+    prroi_pool,
     retinanet_detection_output, retinanet_target_assign, roi_align,
     roi_perspective_transform, rpn_target_assign, target_assign,
     yolo_box, yolov3_loss)

@@ -29,6 +29,7 @@ __all__ = [
     "retinanet_detection_output", "rpn_target_assign",
     "retinanet_target_assign", "yolov3_loss", "deformable_roi_pooling",
     "generate_proposal_labels", "roi_perspective_transform",
+    "generate_mask_labels",
 ]
 
 
@@ -1181,3 +1182,154 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
         return labels, jnp.where(fg[:, None], t, 0.0), fg, bg
 
     return apply("generate_proposal_labels", f, rois, gcls, gbox)
+
+
+# ---------------------------------------------------------------------------
+# Mask-RCNN mask targets (host-side, like the reference CPU-only op:
+# generate_mask_labels_op.cc; python surface fluid/layers/detection.py:2748).
+# Polygon rasterization over ragged per-image ground truth is inherently
+# host work in the reference too -- this is numpy, not jax, by design.
+# ---------------------------------------------------------------------------
+
+def _rasterize_polys_in_box(polys, box, M):
+    """Rasterize COCO-style flat-coordinate polygons, clipped/scaled to
+    `box` (xyxy), onto an M x M grid.  Even-odd (crossing-number) test at
+    pixel centers, vectorized over the grid; union over polygons.  Returns
+    int32 [M, M] in {0, 1}."""
+    x0, y0, x1, y1 = float(box[0]), float(box[1]), float(box[2]), float(box[3])
+    w = max(x1 - x0, 1.0)
+    h = max(y1 - y0, 1.0)
+    # pixel-center sample points in box-normalized M-grid coordinates
+    cx = (np.arange(M, dtype=np.float64) + 0.5)[None, :]   # [1, M]
+    cy = (np.arange(M, dtype=np.float64) + 0.5)[:, None]   # [M, 1]
+    out = np.zeros((M, M), np.bool_)
+    for poly in polys:
+        p = np.asarray(poly, np.float64).reshape(-1, 2)
+        if p.shape[0] < 3:
+            continue
+        px = (p[:, 0] - x0) * M / w
+        py = (p[:, 1] - y0) * M / h
+        qx = np.roll(px, -1)
+        qy = np.roll(py, -1)
+        # edge (px,py)->(qx,qy) crosses the horizontal ray from (cx,cy)
+        # going +x iff cy is within the edge's y-span (half-open to handle
+        # vertices) and the intersection x is right of cx
+        py_e = py[:, None, None]
+        qy_e = qy[:, None, None]
+        px_e = px[:, None, None]
+        qx_e = qx[:, None, None]
+        spans = (py_e <= cy[None]) != (qy_e <= cy[None])     # [E, M, M]
+        dy = qy_e - py_e
+        with np.errstate(divide="ignore", invalid="ignore"):
+            t = np.where(spans, (cy[None] - py_e) / np.where(dy == 0, 1, dy),
+                         0.0)
+        ix = px_e + t * (qx_e - px_e)
+        crossings = (spans & (ix > cx[None])).sum(axis=0)
+        out |= (crossings % 2).astype(np.bool_)
+    return out.astype(np.int32)
+
+
+def _polys_to_boxes(polys):
+    """Tight xyxy bounding box of each instance's polygon list."""
+    boxes = np.zeros((len(polys), 4), np.float32)
+    for i, poly in enumerate(polys):
+        pts = np.concatenate([np.asarray(p, np.float32).reshape(-1, 2)
+                              for p in poly], axis=0)
+        boxes[i] = [pts[:, 0].min(), pts[:, 1].min(),
+                    pts[:, 0].max(), pts[:, 1].max()]
+    return boxes
+
+
+def _overlaps_plus1(boxes, query):
+    """Pairwise IoU with the reference's +1 pixel-area convention
+    (test_generate_mask_labels_op.py bbox_overlaps)."""
+    bw = np.maximum(boxes[:, 2] - boxes[:, 0] + 1, 0)
+    bh = np.maximum(boxes[:, 3] - boxes[:, 1] + 1, 0)
+    qw = np.maximum(query[:, 2] - query[:, 0] + 1, 0)
+    qh = np.maximum(query[:, 3] - query[:, 1] + 1, 0)
+    iw = (np.minimum(boxes[:, None, 2], query[None, :, 2])
+          - np.maximum(boxes[:, None, 0], query[None, :, 0]) + 1)
+    ih = (np.minimum(boxes[:, None, 3], query[None, :, 3])
+          - np.maximum(boxes[:, None, 1], query[None, :, 1]) + 1)
+    inter = np.maximum(iw, 0) * np.maximum(ih, 0)
+    union = bw[:, None] * bh[:, None] + qw[None] * qh[None] - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution):
+    """Mask-RCNN mask targets for sampled foreground RoIs.
+
+    Host-side op (numpy): the reference computes this on CPU as well
+    (generate_mask_labels_op.cc), because the inputs are ragged per-image
+    polygon lists.  LoD inputs become per-image python lists here (the
+    framework's documented LoD->lists/padding mapping):
+
+    - ``im_info``: [N, 3] (h, w, scale per image).
+    - ``gt_classes`` / ``is_crowd``: list of [Mi] int arrays.
+    - ``gt_segms``: list (image) of list (gt instance) of list (polygon)
+      of flat [x0, y0, x1, y1, ...] coordinates in the ORIGINAL image.
+    - ``rois``: list of [Ri, 4] float arrays (scaled image coords);
+      ``labels_int32``: list of [Ri] int arrays from
+      ``generate_proposal_labels``.
+
+    Returns ``(mask_rois [F,4], roi_has_mask_int32 [F], mask_int32
+    [F, num_classes*resolution**2], lod)`` -- concatenated over images with
+    per-image lengths in ``lod``; mask targets are -1 ("don't care")
+    outside the RoI's class slot, matching the reference layout.
+    """
+    im_info = np.asarray(getattr(im_info, "numpy", lambda: im_info)(),
+                         np.float32).reshape(-1, 3)
+    M = int(resolution)
+    out_rois, out_has, out_mask, lod = [], [], [], []
+    for i in range(im_info.shape[0]):
+        gcls = np.asarray(gt_classes[i], np.int64).reshape(-1)
+        crowd = np.asarray(is_crowd[i], np.int64).reshape(-1)
+        labels = np.asarray(labels_int32[i], np.int64).reshape(-1)
+        boxes = np.asarray(rois[i], np.float32).reshape(-1, 4)
+        im_scale = float(im_info[i, 2])
+
+        keep = np.where((gcls > 0) & (crowd == 0))[0]
+        polys_gt = [gt_segms[i][j] for j in keep
+                    if len(gt_segms[i][j]) > 0
+                    and any(len(p) >= 6 for p in gt_segms[i][j])]
+        fg_inds = np.where(labels > 0)[0]
+        roi_has_mask = fg_inds.copy()
+
+        if fg_inds.size > 0 and len(polys_gt) > 0:
+            mask_cls = labels[fg_inds]
+            rois_fg = boxes[fg_inds] / im_scale  # back to original coords
+            gt_boxes = _polys_to_boxes(polys_gt)
+            match = _overlaps_plus1(rois_fg, gt_boxes).argmax(axis=1)
+            masks = np.zeros((fg_inds.size, M * M), np.int32)
+            for k in range(fg_inds.size):
+                m = _rasterize_polys_in_box(polys_gt[match[k]], rois_fg[k], M)
+                masks[k] = m.reshape(-1)
+        else:
+            # no usable foreground (no fg roi, or every gt crowd/degenerate):
+            # emit ONE ignore-everything row on a bg roi so downstream shapes
+            # stay non-empty (reference behavior); all three outputs and lod
+            # must stay aligned at exactly one row
+            bg = np.where(labels == 0)[0]
+            pick = int(bg[0]) if bg.size else 0
+            if boxes.shape[0] > 0:
+                rois_fg = boxes[pick:pick + 1] / im_scale
+            else:
+                rois_fg = np.zeros((1, 4), np.float32)
+            masks = -np.ones((1, M * M), np.int32)
+            mask_cls = np.zeros((1,), np.int64)
+            roi_has_mask = np.zeros((1,), np.int64)
+
+        expanded = -np.ones((masks.shape[0], num_classes * M * M), np.int32)
+        for k in range(masks.shape[0]):
+            c = int(mask_cls[k])
+            if c > 0:
+                expanded[k, c * M * M:(c + 1) * M * M] = masks[k]
+        out_rois.append(rois_fg * im_scale)
+        out_has.append(roi_has_mask.astype(np.int32))
+        out_mask.append(expanded)
+        lod.append(out_rois[-1].shape[0])
+
+    return (np.concatenate(out_rois, axis=0),
+            np.concatenate(out_has, axis=0),
+            np.concatenate(out_mask, axis=0), lod)

@@ -260,3 +260,107 @@ class TestGenerateProposals:
         assert (v >= 0).all() and (v <= 15).all()
         assert (np.diff(rs[:cnt]) <= 1e-6).all()
         assert (rois[cnt:] == -1).all()
+
+
+class TestGenerateMaskLabels:
+    """Host-side Mask-RCNN mask targets (ops/detection.py
+    generate_mask_labels; reference generate_mask_labels_op.cc)."""
+
+    def test_square_polygon_rasterizes_to_block(self):
+        from paddle_tpu.ops.detection import _rasterize_polys_in_box
+        # polygon covering the left half of the box -> left half of the grid
+        box = [0.0, 0.0, 16.0, 16.0]
+        poly = [0.0, 0.0, 8.0, 0.0, 8.0, 16.0, 0.0, 16.0]
+        m = _rasterize_polys_in_box([poly], box, 8)
+        assert m.shape == (8, 8)
+        np.testing.assert_array_equal(m[:, :4], 1)
+        np.testing.assert_array_equal(m[:, 4:], 0)
+
+    def test_union_and_hole_free_even_odd(self):
+        from paddle_tpu.ops.detection import _rasterize_polys_in_box
+        box = [0.0, 0.0, 8.0, 8.0]
+        left = [0.0, 0.0, 4.0, 0.0, 4.0, 8.0, 0.0, 8.0]
+        right = [4.0, 0.0, 8.0, 0.0, 8.0, 8.0, 4.0, 8.0]
+        m = _rasterize_polys_in_box([left, right], box, 8)
+        np.testing.assert_array_equal(m, 1)
+
+    def test_end_to_end_targets(self):
+        import paddle_tpu.nn.functional as F
+        im_info = np.array([[32.0, 32.0, 2.0]], np.float32)  # scale 2x
+        gt_classes = [np.array([3, 5])]
+        is_crowd = [np.array([0, 0])]
+        # gt 0: square [2,2]-[10,10]; gt 1: square [10,10]-[14,14]
+        gt_segms = [[
+            [[2.0, 2.0, 10.0, 2.0, 10.0, 10.0, 2.0, 10.0]],
+            [[10.0, 10.0, 14.0, 10.0, 14.0, 14.0, 10.0, 14.0]],
+        ]]
+        # rois in SCALED coords (x2): roi 0 over gt 0, roi 1 background
+        rois = [np.array([[4.0, 4.0, 20.0, 20.0],
+                          [24.0, 24.0, 30.0, 30.0]], np.float32)]
+        labels_int32 = [np.array([3, 0], np.int32)]
+        mask_rois, has_mask, mask_int32, lod = F.generate_mask_labels(
+            im_info, gt_classes, is_crowd, gt_segms, rois, labels_int32,
+            num_classes=8, resolution=4)
+        assert lod == [1]
+        np.testing.assert_allclose(mask_rois, rois[0][:1])
+        np.testing.assert_array_equal(has_mask, [0])
+        assert mask_int32.shape == (1, 8 * 16)
+        cls_slot = mask_int32[0, 3 * 16:4 * 16].reshape(4, 4)
+        other = np.delete(mask_int32[0].reshape(8, 16), 3, axis=0)
+        np.testing.assert_array_equal(other, -1)
+        # roi unscaled is [2,2]-[10,10] == gt 0 exactly: mask is all ones
+        np.testing.assert_array_equal(cls_slot, 1)
+
+    def test_no_foreground_fallback(self):
+        import paddle_tpu.nn.functional as F
+        im_info = np.array([[32.0, 32.0, 1.0]], np.float32)
+        gt_segms = [[[[2.0, 2.0, 6.0, 2.0, 6.0, 6.0, 2.0, 6.0]]]]
+        mask_rois, has_mask, mask_int32, lod = F.generate_mask_labels(
+            im_info, [np.array([1])], [np.array([0])], gt_segms,
+            [np.array([[1.0, 1.0, 5.0, 5.0]], np.float32)],
+            [np.array([0], np.int32)], num_classes=4, resolution=4)
+        assert lod == [1]
+        np.testing.assert_array_equal(mask_int32, -1)
+        np.testing.assert_array_equal(has_mask, [0])
+
+    def test_all_crowd_gts_with_fg_rois_stays_aligned(self):
+        # fg rois present but every gt is crowd: one ignore row, outputs
+        # and lod aligned (review regression)
+        import paddle_tpu.nn.functional as F
+        im_info = np.array([[32.0, 32.0, 1.0]], np.float32)
+        gt_segms = [[[[2.0, 2.0, 6.0, 2.0, 6.0, 6.0, 2.0, 6.0]]]]
+        mask_rois, has_mask, mask_int32, lod = F.generate_mask_labels(
+            im_info, [np.array([3])], [np.array([1])], gt_segms,
+            [np.array([[1.0, 1.0, 5.0, 5.0], [8.0, 8.0, 12.0, 12.0]],
+                      np.float32)],
+            [np.array([3, 0], np.int32)], num_classes=4, resolution=4)
+        assert lod == [1]
+        assert mask_rois.shape == (1, 4)
+        assert has_mask.shape == (1,) and has_mask[0] == 0
+        np.testing.assert_array_equal(mask_int32, -1)
+
+    def test_zero_roi_image_stays_aligned(self):
+        import paddle_tpu.nn.functional as F
+        im_info = np.array([[32.0, 32.0, 1.0]], np.float32)
+        gt_segms = [[[[2.0, 2.0, 6.0, 2.0, 6.0, 6.0, 2.0, 6.0]]]]
+        mask_rois, has_mask, mask_int32, lod = F.generate_mask_labels(
+            im_info, [np.array([3])], [np.array([0])], gt_segms,
+            [np.zeros((0, 4), np.float32)], [np.zeros((0,), np.int32)],
+            num_classes=4, resolution=4)
+        assert lod == [1]
+        assert mask_rois.shape == (1, 4)
+        assert has_mask.shape == (1,)
+        assert mask_int32.shape == (1, 4 * 16)
+
+    def test_empty_segmentation_instance_skipped(self):
+        import paddle_tpu.nn.functional as F
+        im_info = np.array([[32.0, 32.0, 1.0]], np.float32)
+        # first gt has an empty polygon list, second is valid
+        gt_segms = [[[], [[2.0, 2.0, 6.0, 2.0, 6.0, 6.0, 2.0, 6.0]]]]
+        mask_rois, has_mask, mask_int32, lod = F.generate_mask_labels(
+            im_info, [np.array([1, 3])], [np.array([0, 0])], gt_segms,
+            [np.array([[2.0, 2.0, 6.0, 6.0]], np.float32)],
+            [np.array([3], np.int32)], num_classes=4, resolution=4)
+        assert lod == [1]
+        slot = mask_int32[0].reshape(4, 16)[3]
+        assert (slot >= 0).all() and slot.sum() > 0

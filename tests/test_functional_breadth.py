@@ -26,9 +26,7 @@ def test_functional_parity_with_reference():
     want = sorted(set(re.findall(r"from \.\S+ import (\w+)", ref)))
     missing = [n for n in want if not n.startswith("_")
                and not hasattr(F, n)]
-    # generate_mask_labels needs polygon rasterization (host-side in the
-    # reference too) — the single accepted absence
-    assert missing == ["generate_mask_labels"], missing
+    assert missing == [], missing
 
 
 @pytest.mark.skipif(
