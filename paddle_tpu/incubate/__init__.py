@@ -2,7 +2,9 @@
 """
 from . import checkpoint  # noqa: F401
 
-from . import optimizer, reader  # noqa: F401
+from . import optimizer, reader, segment  # noqa: F401
+from .segment import (segment_max, segment_mean, segment_min,  # noqa: F401
+                      segment_sum)
 
 
 class LayerHelper:

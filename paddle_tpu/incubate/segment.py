@@ -1,0 +1,53 @@
+"""Segment pooling (reference segment_pool_op.cc, python surface
+paddle.incubate.segment_* in test_segment_ops.py): reduce rows of
+``data`` grouped by monotonically non-decreasing ``segment_ids``.  Pure
+``jax.ops.segment_*`` — XLA lowers these to a single sorted-scatter, and
+they are differentiable, so graph-pooling models train end-to-end."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops._helpers import to_tensor_like
+from ..ops.dispatch import apply
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min"]
+
+
+def _segment(op_name, jop, data, segment_ids):
+    d = to_tensor_like(data)
+    ids = to_tensor_like(segment_ids)
+    n = int(jnp.max(ids._value)) + 1 if ids._value.size else 0
+
+    def f(v, i):
+        return jop(v, i.astype(jnp.int32), num_segments=n)
+
+    return apply(op_name, f, d, ids)
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment("segment_sum", jax.ops.segment_sum, data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    d = to_tensor_like(data)
+    ids = to_tensor_like(segment_ids)
+    n = int(jnp.max(ids._value)) + 1 if ids._value.size else 0
+
+    def f(v, i):
+        i = i.astype(jnp.int32)
+        s = jax.ops.segment_sum(v, i, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((v.shape[0],), v.dtype), i,
+                                  num_segments=n)
+        shape = (n,) + (1,) * (v.ndim - 1)
+        return s / jnp.maximum(cnt.reshape(shape), 1)
+
+    return apply("segment_mean", f, d, ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment("segment_max", jax.ops.segment_max, data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment("segment_min", jax.ops.segment_min, data, segment_ids)

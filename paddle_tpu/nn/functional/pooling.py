@@ -86,7 +86,10 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
             )
             return idxs.astype(jnp.int32)
 
-        idx = apply("max_pool2d_index", idx_f, x_t)
+        # indices are integral (no gradient); the paired-operand
+        # reduce_window cannot be vjp-traced, so compute on a detached
+        # input — gradients flow through `out`, as in the reference
+        idx = apply("max_pool2d_index", idx_f, x_t.detach())
         return out, idx
     return out
 
@@ -173,3 +176,35 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive_pool(x, output_size, 3, False, "max", "adaptive_max_pool3d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Inverse of max_pool2d(return_mask=True) (unpool_op.cc): scatter each
+    pooled value back to the spatial position its flattened index points
+    at, zeros elsewhere.  One .at[].set scatter — XLA lowers it to a
+    single scatter kernel."""
+    if data_format != "NCHW":
+        raise ValueError("max_unpool2d: only NCHW is supported")
+    xt = to_tensor_like(x)
+    it = to_tensor_like(indices)
+    ks = _norm_tuple(kernel_size, 2)
+    st = _norm_tuple(stride if stride is not None else kernel_size, 2)
+    pd = _norm_tuple(padding, 2)
+
+    def f(v, idx):
+        N, C, h, w = v.shape
+        if output_size is not None:
+            H, W = int(output_size[-2]), int(output_size[-1])
+        else:
+            H = (h - 1) * st[0] - 2 * pd[0] + ks[0]
+            W = (w - 1) * st[1] - 2 * pd[1] + ks[1]
+        flat = jnp.zeros((N, C, H * W), v.dtype)
+        lin = idx.reshape(N, C, h * w).astype(jnp.int32)
+        out = flat.at[
+            jnp.arange(N)[:, None, None],
+            jnp.arange(C)[None, :, None],
+            lin].set(v.reshape(N, C, h * w))
+        return out.reshape(N, C, H, W)
+
+    return apply("max_unpool2d", f, xt, it)

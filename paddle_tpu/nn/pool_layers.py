@@ -105,3 +105,20 @@ class AdaptiveMaxPool3D(Layer):
 
     def forward(self, x):
         return F.adaptive_max_pool3d(x, self.output_size, self.return_mask)
+
+
+class MaxUnPool2D(Layer):
+    """Inverse of MaxPool2D(return_mask=True) (reference
+    python/paddle/nn/layer/pooling.py MaxUnPool2D / unpool_op.cc)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = (kernel_size, stride,
+                                                       padding)
+        self.data_format, self.output_size = data_format, output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
+                              self.padding, output_size=self.output_size,
+                              data_format=self.data_format)

@@ -5,5 +5,5 @@ is a jax-traceable function routed through dispatch.apply; there are no
 per-device kernels to register — XLA compiles them for TPU (MXU/VPU) and CPU
 alike. Pallas kernels for the genuinely hot paths live in ops/pallas_ops/.
 """
-from . import creation, detection, dispatch, linalg, logic, manipulation, math, random_ops, search, sequence  # noqa: F401
+from . import creation, detection, dispatch, linalg, logic, manipulation, math, misc, random_ops, search, sequence  # noqa: F401
 from .dispatch import apply  # noqa: F401

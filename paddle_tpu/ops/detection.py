@@ -29,7 +29,7 @@ __all__ = [
     "retinanet_detection_output", "rpn_target_assign",
     "retinanet_target_assign", "yolov3_loss", "deformable_roi_pooling",
     "generate_proposal_labels", "roi_perspective_transform",
-    "generate_mask_labels",
+    "generate_mask_labels", "matrix_nms",
 ]
 
 
@@ -754,12 +754,18 @@ def retinanet_detection_output(bboxes, scores, anchors, im_info,
     against anchors, merge, multiclass-NMS (composition form)."""
     from .manipulation import concat
 
+    from .manipulation import reshape
+
     decoded = []
     score_list = []
     for delta, sc, anc in zip(bboxes, scores, anchors):
-        d = box_coder(anc, [0.1, 0.1, 0.2, 0.2], delta,
+        dt = to_tensor_like(delta)
+        A = dt.shape[0]
+        # per-anchor decode: pair delta i with prior i (target [A, 1, 4]
+        # against priors [A] broadcasts elementwise), not the [N, M] cross
+        d = box_coder(anc, [0.1, 0.1, 0.2, 0.2], reshape(dt, [A, 1, 4]),
                       code_type="decode_center_size", axis=0)
-        decoded.append(d)
+        decoded.append(reshape(d, [A, 4]))
         score_list.append(to_tensor_like(sc))
     all_boxes = concat(decoded, axis=0)
     all_scores = concat(score_list, axis=0)
@@ -1333,3 +1339,75 @@ def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
     return (np.concatenate(out_rois, axis=0),
             np.concatenate(out_has, axis=0),
             np.concatenate(out_mask, axis=0), lod)
+
+
+def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=64, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, name=None):
+    """Matrix NMS (matrix_nms_op.cc / SOLOv2): score decay from the full
+    IoU matrix instead of iterative suppression — no sequential loop, so
+    it maps onto the MXU/VPU as pure matmul/elementwise work, a much
+    better TPU fit than greedy NMS.  Single image: bboxes [N, 4], scores
+    [C, N].  Returns a fixed slate (out [keep_top_k, 6] rows
+    [label, score, x1, y1, x2, y2] padded with -1, count) and, with
+    ``return_index``, the flat candidate indices."""
+    b = to_tensor_like(bboxes)
+    s = to_tensor_like(scores)
+
+    def f(boxes, sc):
+        C, N = sc.shape
+        top = min(nms_top_k, N)
+
+        def per_class(c_scores):
+            masked = jnp.where(c_scores >= score_threshold, c_scores,
+                               -jnp.inf)
+            vals, idx = jax.lax.top_k(masked, top)   # sorted desc
+            cand = boxes[idx]
+            iou = _pairwise_iou(cand, cand)
+            # upper triangle: row i = suppressor, col j = suppressed
+            tri = jnp.triu(iou, k=1)
+            max_iou = tri.max(axis=0)   # each candidate's own worst overlap
+            # compensate by the SUPPRESSOR's max IoU (matrix_nms_op.cc):
+            # decay_ij = f(iou_ij) / f(max_iou_i)
+            if use_gaussian:
+                decay = jnp.exp(-(tri ** 2 - max_iou[:, None] ** 2)
+                                / gaussian_sigma)
+            else:
+                decay = (1.0 - tri) / jnp.maximum(1.0 - max_iou[:, None],
+                                                  1e-10)
+            # min over higher-scored rows only; pad rows below diag with 1
+            mask = jnp.triu(jnp.ones((top, top), bool), k=1)
+            decay = jnp.where(mask, decay, 1.0).min(axis=0)
+            new_scores = jnp.where(jnp.isfinite(vals), vals * decay,
+                                   -jnp.inf)
+            new_scores = jnp.where(new_scores >= post_threshold, new_scores,
+                                   -jnp.inf)
+            return new_scores, cand, idx
+
+        ks, kb, kidx = jax.vmap(per_class)(sc)
+        labels = jnp.broadcast_to(jnp.arange(C)[:, None], (C, top))
+        if background_label >= 0:
+            ks = jnp.where(labels == background_label, -jnp.inf, ks)
+        flat_s = ks.reshape(-1)
+        flat_b = kb.reshape(-1, 4)
+        flat_l = labels.reshape(-1)
+        flat_i = kidx.reshape(-1)
+        k = min(keep_top_k, flat_s.shape[0])
+        vals, idx = jax.lax.top_k(flat_s, k)
+        valid = vals > -jnp.inf
+        rows = jnp.concatenate(
+            [jnp.where(valid, flat_l[idx], -1)[:, None].astype(jnp.float32),
+             jnp.where(valid, vals, -1)[:, None],
+             jnp.where(valid[:, None], flat_b[idx], -1)], axis=1)
+        sel = jnp.where(valid, flat_i[idx], -1).astype(jnp.int32)
+        if k < keep_top_k:
+            rows = jnp.pad(rows, ((0, keep_top_k - k), (0, 0)),
+                           constant_values=-1)
+            sel = jnp.pad(sel, (0, keep_top_k - k), constant_values=-1)
+        count = valid.sum().astype(jnp.int32)
+        if return_index:
+            return rows, count, sel
+        return rows, count
+
+    return apply("matrix_nms", f, b, s)

@@ -1,0 +1,422 @@
+"""Long-tail ops from the reference registry (operators/*.cc) without a
+prior analog here — CTR transforms, ranking losses, speech ops, distill
+helpers, eval metrics.  Jax-traceable unless noted host-side (the
+reference computes those CPU-only too).  See docs/OP_COVERAGE.md for the
+full registry map this closes."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.random import next_rng_key
+from ._helpers import to_tensor_like
+from .dispatch import apply
+
+__all__ = [
+    "mean_iou", "cvm", "shuffle_batch", "partial_concat", "partial_sum",
+    "batch_fc", "row_conv", "hinge_loss", "rank_loss", "huber_loss",
+    "l1_norm", "squared_l2_norm", "sampling_id", "fsp_matrix", "conv_shift",
+    "ctc_align", "chunk_eval", "positive_negative_pair",
+    "sampled_softmax_with_cross_entropy",
+]
+
+
+def mean_iou(input, label, num_classes):
+    """Mean IoU over a segmentation prediction (mean_iou_op.cc): returns
+    (mean_iou scalar, out_wrong [C], out_correct [C])."""
+    p = to_tensor_like(input)
+    t = to_tensor_like(label)
+
+    def f(pred, lab):
+        pred = pred.reshape(-1).astype(jnp.int32)
+        lab = lab.reshape(-1).astype(jnp.int32)
+        hit = pred == lab
+        correct = jnp.zeros(num_classes, jnp.int32).at[lab].add(
+            hit.astype(jnp.int32))
+        pred_cnt = jnp.zeros(num_classes, jnp.int32).at[pred].add(1)
+        lab_cnt = jnp.zeros(num_classes, jnp.int32).at[lab].add(1)
+        union = pred_cnt + lab_cnt - correct
+        present = union > 0
+        iou = jnp.where(present, correct / jnp.maximum(union, 1), 0.0)
+        miou = iou.sum() / jnp.maximum(present.sum(), 1)
+        wrong = (union - correct).astype(jnp.int32)
+        return miou.astype(jnp.float32), wrong, correct
+
+    return apply("mean_iou", f, p, t, n_outputs=3)
+
+
+def cvm(input, cvm_offset, use_cvm=True):
+    """CTR show/click (CVM) feature transform (cvm_op.h:74): the first two
+    columns of each row are (show, click); with ``use_cvm`` they become
+    (log(show+1), log(click+1)-log(show+1)) and the rest pass through;
+    without, they are dropped.  Gradients never flow into the cvm columns
+    (the reference writes them from the CVM input in the grad kernel)."""
+    x = to_tensor_like(input)
+
+    def f(v):
+        show = jnp.log(v[:, :1] + 1.0)
+        click = jnp.log(v[:, 1:2] + 1.0) - show
+        head = jax.lax.stop_gradient(jnp.concatenate([show, click], axis=1))
+        if use_cvm:
+            return jnp.concatenate([head, v[:, 2:]], axis=1)
+        return v[:, 2:]
+
+    return apply("cvm", f, x)
+
+
+def shuffle_batch(x, seed=0):
+    """Random batch permutation (shuffle_batch_op.cc) — returns
+    (shuffled, permutation) so CTR negative sampling can realign.
+    ``seed=0`` (the default) draws from the framework RNG stream, so each
+    call gets a fresh permutation (reference: seed 0 = reseed)."""
+    t = to_tensor_like(x)
+
+    key = jax.random.PRNGKey(seed) if seed else next_rng_key()
+
+    def f(v):
+        perm = jax.random.permutation(key, v.shape[0])
+        return v[perm], perm.astype(jnp.int64)
+
+    return apply("shuffle_batch", f, t, n_outputs=2)
+
+
+def partial_concat(inputs, start_index=0, length=-1):
+    """Concat the [start:start+length] column slice of each input
+    (partial_concat_op.cc, CTR slot-feature assembly)."""
+    ts = [to_tensor_like(i) for i in inputs]
+
+    def f(*vs):
+        outs = []
+        for v in vs:
+            stop = v.shape[1] if length < 0 else start_index + length
+            outs.append(v[:, start_index:stop])
+        return jnp.concatenate(outs, axis=1)
+
+    return apply("partial_concat", f, *ts)
+
+
+def partial_sum(inputs, start_index=0, length=-1):
+    """Sum the same column slice of each input (partial_sum_op.cc)."""
+    ts = [to_tensor_like(i) for i in inputs]
+
+    def f(*vs):
+        stop = vs[0].shape[1] if length < 0 else start_index + length
+        acc = vs[0][:, start_index:stop]
+        for v in vs[1:]:
+            acc = acc + v[:, start_index:stop]
+        return acc
+
+    return apply("partial_sum", f, *ts)
+
+
+def batch_fc(input, w, bias=None):
+    """Per-slot batched FC (batch_fc_op.cc): input [S, N, in], w
+    [S, in, out], bias [S, out] -> [S, N, out] on the MXU via one bmm."""
+    x = to_tensor_like(input)
+    wt = to_tensor_like(w)
+    bt = None if bias is None else to_tensor_like(bias)
+
+    if bt is None:
+        return apply("batch_fc", lambda v, ww: jnp.einsum(
+            "sni,sio->sno", v, ww), x, wt)
+    return apply("batch_fc", lambda v, ww, bb: jnp.einsum(
+        "sni,sio->sno", v, ww) + bb[:, None, :], x, wt, bt)
+
+
+def row_conv(x, weight):
+    """Lookahead (row) convolution from DeepSpeech2 (row_conv_op.cc):
+    x [B, T, D], weight [future_context, D];
+    out[b, t] = sum_k x[b, t+k] * weight[k].  Shifted-slice sum — k is
+    static and small, XLA fuses the adds."""
+    xt = to_tensor_like(x)
+    wt = to_tensor_like(weight)
+
+    def f(v, w):
+        k = w.shape[0]
+        padded = jnp.pad(v, ((0, 0), (0, k - 1), (0, 0)))
+        out = jnp.zeros_like(v)
+        for j in range(k):
+            out = out + padded[:, j:j + v.shape[1], :] * w[j][None, None, :]
+        return out
+
+    return apply("row_conv", f, xt, wt)
+
+
+def hinge_loss(logits, labels):
+    """max(0, 1 - (2*label - 1) * logits) (hinge_loss_op.cc)."""
+    x = to_tensor_like(logits)
+    y = to_tensor_like(labels)
+    return apply("hinge_loss", lambda a, b: jnp.maximum(
+        0.0, 1.0 - (2.0 * b - 1.0) * a), x, y)
+
+
+def rank_loss(label, left, right):
+    """RankNet pairwise loss (rank_loss_op.h:40):
+    log(1 + exp(o)) - label*o with o = left - right (softplus form,
+    numerically stable via logaddexp)."""
+    lt = to_tensor_like(label)
+    le = to_tensor_like(left)
+    ri = to_tensor_like(right)
+
+    def f(lab, l, r):
+        o = l - r
+        return jnp.logaddexp(0.0, o) - lab * o
+
+    return apply("rank_loss", f, lt, le, ri)
+
+
+def huber_loss(input, label, delta=1.0):
+    """Huber loss with explicit delta (huber_loss_op.cc) — distinct from
+    smooth_l1 (which fixes delta=1 and scales)."""
+    x = to_tensor_like(input)
+    y = to_tensor_like(label)
+
+    def f(a, b):
+        r = b - a
+        ar = jnp.abs(r)
+        return jnp.where(ar <= delta, 0.5 * r * r,
+                         delta * (ar - 0.5 * delta))
+
+    return apply("huber_loss", f, x, y)
+
+
+def l1_norm(x):
+    """sum(|x|) scalar (l1_norm_op.cc)."""
+    return apply("l1_norm", lambda v: jnp.abs(v).sum(), to_tensor_like(x))
+
+
+def squared_l2_norm(x):
+    """sum(x^2) scalar (squared_l2_norm_op.cc) — the grad-clip workhorse."""
+    return apply("squared_l2_norm", lambda v: (v * v).sum(),
+                 to_tensor_like(x))
+
+
+def sampling_id(x, min=0, max=None, seed=0):  # noqa: A002
+    """Sample one column index per row of a probability matrix
+    (sampling_id_op.cc).  ``x`` [B, C] rows need not be normalized."""
+    t = to_tensor_like(x)
+    key = jax.random.PRNGKey(seed) if seed else next_rng_key()
+
+    def f(p):
+        logits = jnp.log(jnp.maximum(p, 1e-20))
+        idx = jax.random.categorical(key, logits, axis=-1)
+        return idx.astype(jnp.int64)
+
+    return apply("sampling_id", f, t)
+
+
+def fsp_matrix(x, y):
+    """Flow-of-Solution-Procedure matrix for distillation (fsp_op.cc) —
+    the canonical implementation lives in nn.functional.extension; this
+    re-export keeps the registry op name importable from ops.misc."""
+    from ..nn.functional.extension import fsp_matrix as _fsp
+
+    return _fsp(x, y)
+
+
+def conv_shift(x, y):
+    """Circular correlation (conv_shift_op.cc, NTM addressing):
+    x [B, N], y [B, M] (M odd, M <= N);
+    out[b, i] = sum_j x[b, (i + j - M//2) mod N] * y[b, j]."""
+    a = to_tensor_like(x)
+    b = to_tensor_like(y)
+
+    def f(u, v):
+        N = u.shape[1]
+        M = v.shape[1]
+        half = M // 2
+        cols = []
+        for j in range(M):
+            cols.append(jnp.roll(u, shift=half - j, axis=1) * v[:, j:j + 1])
+        out = cols[0]
+        for c in cols[1:]:
+            out = out + c
+        assert out.shape[1] == N
+        return out
+
+    return apply("conv_shift", f, a, b)
+
+
+def ctc_align(input, blank=0, merge_repeated=True, padding_value=0):
+    """CTC greedy decode alignment (ctc_align_op.cc, padded form):
+    input [B, T] int labels -> [B, T] with repeats merged and blanks
+    removed, left-compacted and padded with ``padding_value``; also
+    returns lengths [B].  Jittable: compaction via stable argsort on the
+    drop mask instead of ragged writes."""
+    t = to_tensor_like(input)
+
+    def f(v):
+        v = v.astype(jnp.int32)
+        prev = jnp.concatenate([jnp.full_like(v[:, :1], -1), v[:, :-1]],
+                               axis=1)
+        keep = v != blank
+        if merge_repeated:
+            keep = keep & (v != prev)
+        # stable sort: kept entries (key 0) first, in original order
+        order = jnp.argsort(jnp.where(keep, 0, 1), axis=1)  # stable sort
+        gathered = jnp.take_along_axis(v, order, axis=1)
+        kcnt = keep.sum(axis=1, keepdims=True)
+        pos = jnp.arange(v.shape[1])[None, :]
+        out = jnp.where(pos < kcnt, gathered, padding_value)
+        return out.astype(jnp.int64), kcnt.reshape(-1).astype(jnp.int64)
+
+    return apply("ctc_align", f, t, n_outputs=2)
+
+
+def sampled_softmax_with_cross_entropy(logits_fn, labels, num_classes,
+                                       num_samples, seed=0,
+                                       remove_accidental_hits=True):
+    """Sampled-softmax helper (sample_logits_op.cc): draw ``num_samples``
+    negatives from a log-uniform (Zipf) proposal, evaluate ``logits_fn``
+    on [true | sampled] class ids only, apply the log-q correction, and
+    return softmax-CE against position-0 (the true class).
+
+    ``logits_fn(ids [B, 1+S]) -> [B, 1+S]`` computes the class scores
+    (e.g. rows of the output embedding) — only 1+S columns ever touch the
+    MXU, which is the op's whole point for huge vocabularies."""
+    y = to_tensor_like(labels)
+
+    key = jax.random.PRNGKey(seed) if seed else next_rng_key()
+
+    def f(lab):
+        lab = lab.reshape(-1, 1).astype(jnp.int32)
+        B = lab.shape[0]
+        # log-uniform proposal over [0, num_classes)
+        u = jax.random.uniform(key, (B, num_samples))
+        sampled = (jnp.exp(u * jnp.log(float(num_classes + 1))) - 1.0)
+        sampled = jnp.clip(sampled.astype(jnp.int32), 0, num_classes - 1)
+        ids = jnp.concatenate([lab, sampled], axis=1)
+        logq = jnp.log(jnp.log1p(1.0 / (ids + 1.0))
+                       / jnp.log(float(num_classes + 1)))
+        return ids, logq
+
+    ids_t, logq_t = apply("sample_logits", f, y, n_outputs=2)
+    logits = to_tensor_like(logits_fn(ids_t))
+    ids2 = to_tensor_like(ids_t)
+    lq = to_tensor_like(logq_t)
+
+    def ce(lg, ids, logq):
+        adj = lg - logq
+        if remove_accidental_hits:
+            dup = (ids[:, 1:] == ids[:, :1])
+            adj = adj.at[:, 1:].add(jnp.where(dup, -1e9, 0.0))
+        return -jax.nn.log_softmax(adj, axis=-1)[:, 0]
+
+    return apply("sampled_softmax_ce", ce, logits, ids2, lq)
+
+
+# ---------------------------------------------------------------------------
+# Host-side eval metrics (CPU-only ops in the reference too).
+# ---------------------------------------------------------------------------
+
+_CHUNK_SCHEMES = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}
+
+
+def _extract_chunks(tags, scheme, num_chunk_types, excluded=()):
+    """Decode (type, begin, end) chunks from an int tag sequence.  Tag
+    layout matches chunk_eval_op.cc: for a scheme with k tag kinds, tag =
+    chunk_type * k + kind, with kind order B,I / I,E / B,I,E,S; 'plain'
+    uses tag == chunk_type directly."""
+    k = _CHUNK_SCHEMES[scheme]
+    chunks = []
+    start = None
+    cur_type = None
+
+    def close(end):
+        nonlocal start, cur_type
+        if start is not None and cur_type not in excluded:
+            chunks.append((cur_type, start, end))
+        start, cur_type = None, None
+
+    for i, tag in enumerate(tags):
+        tag = int(tag)
+        if tag < 0:
+            close(i)
+            continue
+        ctype, kind = divmod(tag, k) if scheme != "plain" else (tag, 0)
+        if scheme == "plain":
+            if cur_type != ctype:
+                close(i)
+                start, cur_type = i, ctype
+        elif scheme == "IOB":
+            if kind == 0 or cur_type != ctype:
+                close(i)
+                start, cur_type = i, ctype
+        elif scheme == "IOE":
+            if cur_type != ctype:
+                close(i)
+                start, cur_type = i, ctype
+            if kind == 1:  # E closes inclusive of i
+                close(i + 1)
+        else:  # IOBES
+            if kind == 0:          # B
+                close(i)
+                start, cur_type = i, ctype
+            elif kind == 3:        # S
+                close(i)
+                start, cur_type = i, ctype
+                close(i + 1)
+            elif cur_type != ctype:
+                close(i)
+                start, cur_type = i, ctype
+    close(len(tags))
+    return set(chunks)
+
+
+def chunk_eval(inference, label, chunk_scheme, num_chunk_types,
+               seq_lengths=None, excluded_chunk_types=()):
+    """Chunk-level precision/recall/F1 (chunk_eval_op.cc), host-side.
+
+    ``inference``/``label``: [B, T] int tag arrays (padded);
+    ``seq_lengths`` [B] limits each row.  Returns (precision, recall, f1,
+    num_infer_chunks, num_label_chunks, num_correct_chunks)."""
+    inf = np.asarray(getattr(inference, "numpy", lambda: inference)())
+    lab = np.asarray(getattr(label, "numpy", lambda: label)())
+    inf = inf.reshape(lab.shape)
+    B = lab.shape[0]
+    lens = (np.asarray(seq_lengths).reshape(-1) if seq_lengths is not None
+            else np.full(B, lab.shape[1]))
+    n_inf = n_lab = n_cor = 0
+    ex = set(excluded_chunk_types)
+    for b in range(B):
+        L = int(lens[b])
+        ci = _extract_chunks(inf[b, :L], chunk_scheme, num_chunk_types, ex)
+        cl = _extract_chunks(lab[b, :L], chunk_scheme, num_chunk_types, ex)
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_cor += len(ci & cl)
+    prec = n_cor / n_inf if n_inf else 0.0
+    rec = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+    return (np.float32(prec), np.float32(rec), np.float32(f1),
+            np.int64(n_inf), np.int64(n_lab), np.int64(n_cor))
+
+
+def positive_negative_pair(score, label, query_ids):
+    """Learning-to-rank pair statistics (positive_negative_pair_op.cc),
+    host-side: within each query group, count (pos, neg, neutral) pairs
+    by whether score order agrees with label order.  Returns
+    (positive, negative, neutral) float32 scalars."""
+    s = np.asarray(getattr(score, "numpy", lambda: score)()).reshape(-1)
+    l = np.asarray(getattr(label, "numpy", lambda: label)()).reshape(-1)
+    q = np.asarray(getattr(query_ids, "numpy", lambda: query_ids)()
+                   ).reshape(-1)
+    pos = neg = neu = 0
+    for qid in np.unique(q):
+        idx = np.where(q == qid)[0]
+        for i in range(len(idx)):
+            for j in range(i + 1, len(idx)):
+                a, b = idx[i], idx[j]
+                if l[a] == l[b]:
+                    continue
+                ds = s[a] - s[b]
+                dl = l[a] - l[b]
+                if ds * dl > 0:
+                    pos += 1
+                elif ds * dl < 0:
+                    neg += 1
+                else:
+                    neu += 1
+    return (np.float32(pos), np.float32(neg), np.float32(neu))

@@ -215,16 +215,9 @@ def row_conv(input, future_context_size, param_attr=None, act=None):
     k = int(future_context_size) + 1
     w = create_parameter([k, x.shape[-1]], "float32")
 
-    def f(v, wv):
-        outs = jnp.zeros_like(v)
-        T = v.shape[1]
-        for i in range(k):
-            rolled = jnp.roll(v, -i, axis=1)
-            ok = (jnp.arange(T) + i) < T
-            outs = outs + jnp.where(ok[None, :, None], rolled, 0) * wv[i]
-        return outs
+    from ..ops.misc import row_conv as _row_conv
 
-    out = apply("row_conv", f, x, w)
+    out = _row_conv(x, w)
     if act:
         out = getattr(F, act)(out)
     return out

@@ -31,6 +31,8 @@ OP_MODULES = [
     "paddle_tpu.ops.attention",
     "paddle_tpu.ops.detection",
     "paddle_tpu.ops.sequence",
+    "paddle_tpu.ops.misc",
+    "paddle_tpu.incubate.segment",
     "paddle_tpu.nn.functional.activation",
     "paddle_tpu.nn.functional.common",
     "paddle_tpu.nn.functional.conv",
