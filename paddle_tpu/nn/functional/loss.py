@@ -27,39 +27,67 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
     input, label = to_tensor_like(input), to_tensor_like(label)
 
     def f(logits, lab, *maybe_w):
-        if use_softmax:
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
-        else:
-            logp = jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
         n_classes = logits.shape[axis]
-        if soft_label:
-            soft = lab.astype(jnp.float32)
-            if label_smoothing > 0.0:
-                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
-            loss = -jnp.sum(soft * logp, axis=axis)
-            valid = jnp.ones_like(loss, dtype=jnp.bool_)
-        else:
+        if use_softmax and not soft_label:
+            # hard-label softmax-CE without materializing log_softmax:
+            # loss_i = lse(logits_i) - logits_i[label]  (and with smoothing,
+            # mean_logp_i = mean(logits_i) - lse_i) — only [.., 1]-shaped
+            # reductions ever hit HBM, not an f32 [.., C] logp tensor.  At
+            # GPT vocab (8192×50304 tokens/step) the old path wrote+read a
+            # 1.65 GB f32 intermediate on an HBM-bound step.
             idx = lab.astype(jnp.int32)
-            if idx.ndim == logp.ndim:
+            if idx.ndim == logits.ndim:
                 idx = jnp.squeeze(idx, axis=axis)
             valid = idx != ignore_index
             safe = jnp.where(valid, idx, 0)
+            x32 = logits.astype(jnp.float32)
+            lse = jax.scipy.special.logsumexp(x32, axis=axis)
+            picked = jnp.take_along_axis(
+                x32, jnp.expand_dims(safe, axis), axis=axis).squeeze(axis)
+            loss = lse - picked
             if label_smoothing > 0.0:
-                one_hot = jax.nn.one_hot(safe, n_classes, axis=axis, dtype=jnp.float32)
-                soft = one_hot * (1 - label_smoothing) + label_smoothing / n_classes
-                loss = -jnp.sum(soft * logp, axis=axis)
-            else:
-                loss = -jnp.take_along_axis(
-                    logp, jnp.expand_dims(safe, axis), axis=axis
-                ).squeeze(axis)
+                mean_nll = lse - jnp.mean(x32, axis=axis)
+                loss = (1 - label_smoothing) * loss \
+                    + label_smoothing * mean_nll
             loss = jnp.where(valid, loss, 0.0)
+        else:
+            if use_softmax:
+                logp = jax.nn.log_softmax(logits.astype(jnp.float32),
+                                          axis=axis)
+            else:
+                logp = jnp.log(jnp.maximum(logits.astype(jnp.float32),
+                                           1e-30))
+            if soft_label:
+                soft = lab.astype(jnp.float32)
+                if label_smoothing > 0.0:
+                    soft = soft * (1 - label_smoothing) \
+                        + label_smoothing / n_classes
+                loss = -jnp.sum(soft * logp, axis=axis)
+                valid = jnp.ones_like(loss, dtype=jnp.bool_)
+            else:
+                idx = lab.astype(jnp.int32)
+                if idx.ndim == logp.ndim:
+                    idx = jnp.squeeze(idx, axis=axis)
+                valid = idx != ignore_index
+                safe = jnp.where(valid, idx, 0)
+                if label_smoothing > 0.0:
+                    one_hot = jax.nn.one_hot(safe, n_classes, axis=axis,
+                                             dtype=jnp.float32)
+                    soft = one_hot * (1 - label_smoothing) \
+                        + label_smoothing / n_classes
+                    loss = -jnp.sum(soft * logp, axis=axis)
+                else:
+                    loss = -jnp.take_along_axis(
+                        logp, jnp.expand_dims(safe, axis), axis=axis
+                    ).squeeze(axis)
+                loss = jnp.where(valid, loss, 0.0)
+        # shared weight + reduction tail (both paths feed loss/valid/safe)
         if maybe_w:
             w = maybe_w[0].astype(jnp.float32)
             if soft_label:
                 wl = jnp.sum(lab.astype(jnp.float32) * w, axis=axis)
             else:
-                wl = jnp.take(w, safe)
-                wl = jnp.where(valid, wl, 0.0)
+                wl = jnp.where(valid, jnp.take(w, safe), 0.0)
             loss = loss * wl
             if reduction == "mean":
                 return jnp.sum(loss) / jnp.maximum(jnp.sum(wl), 1e-12)
