@@ -526,6 +526,23 @@ def main():
                 "gpt_long",
                 lambda: bench_gpt_long(
                     int(os.environ.get("BENCH_GPT_BATCH", "4")), steps))
+            if os.environ.get("BENCH_GPT_8K", "1") != "0":
+                # sequence-scaling point: MFU must HOLD as S grows 4x —
+                # the property the flash kernel exists for (a full QK^T
+                # materialization is 3.2 GB/layer here and falls over)
+                try:
+                    s8k = _with_retries(
+                        "gpt_8k", lambda: bench_gpt_long(1, max(steps // 3, 8),
+                                                         seq_len=8192))
+                    gpt_long["detail"]["seq8192"] = {
+                        "tokens_per_sec": s8k["value"],
+                        "mfu_vs_197tf_peak":
+                            s8k["detail"]["mfu_vs_197tf_peak"],
+                        "flash_route_hits_per_trace":
+                            s8k["detail"]["flash_route_hits_per_trace"],
+                    }
+                except Exception as e:  # noqa: BLE001
+                    sys.stderr.write(f"gpt 8k segment skipped: {e}\n")
         except Exception as e:
             sys.stderr.write(
                 f"gpt_long bench failed after retries "
