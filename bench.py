@@ -494,6 +494,27 @@ def _bench_resnet_guarded(steps):
                              lambda: bench_resnet50(32, steps))
 
 
+def _attach_seq8192(gpt_result, steps):
+    """Sequence-scaling point: MFU must HOLD as S grows 4x — the property
+    the flash kernel exists for (a full QK^T materialization is
+    3.2 GB/layer at s8192 and falls over).  Recorded on every run that
+    benches GPT (BENCH_GPT_8K=0 skips)."""
+    if os.environ.get("BENCH_GPT_8K", "1") == "0":
+        return
+    try:
+        s8k = _with_retries(
+            "gpt_8k", lambda: bench_gpt_long(1, max(steps // 3, 8),
+                                             seq_len=8192))
+        gpt_result["detail"]["seq8192"] = {
+            "tokens_per_sec": s8k["value"],
+            "mfu_vs_197tf_peak": s8k["detail"]["mfu_vs_197tf_peak"],
+            "flash_route_hits_per_trace":
+                s8k["detail"]["flash_route_hits_per_trace"],
+        }
+    except Exception as e:  # noqa: BLE001
+        sys.stderr.write(f"gpt 8k segment skipped: {e}\n")
+
+
 def main():
     which = os.environ.get("BENCH_MODEL", "all")
     steps = int(os.environ.get("BENCH_STEPS", "30"))
@@ -505,6 +526,7 @@ def main():
             "gpt_long",
             lambda: bench_gpt_long(
                 int(os.environ.get("BENCH_GPT_BATCH", "4")), steps))
+        _attach_seq8192(result, steps)
     elif which == "resnet50":
         result = _bench_resnet_guarded(steps)
     else:
@@ -526,23 +548,7 @@ def main():
                 "gpt_long",
                 lambda: bench_gpt_long(
                     int(os.environ.get("BENCH_GPT_BATCH", "4")), steps))
-            if os.environ.get("BENCH_GPT_8K", "1") != "0":
-                # sequence-scaling point: MFU must HOLD as S grows 4x —
-                # the property the flash kernel exists for (a full QK^T
-                # materialization is 3.2 GB/layer here and falls over)
-                try:
-                    s8k = _with_retries(
-                        "gpt_8k", lambda: bench_gpt_long(1, max(steps // 3, 8),
-                                                         seq_len=8192))
-                    gpt_long["detail"]["seq8192"] = {
-                        "tokens_per_sec": s8k["value"],
-                        "mfu_vs_197tf_peak":
-                            s8k["detail"]["mfu_vs_197tf_peak"],
-                        "flash_route_hits_per_trace":
-                            s8k["detail"]["flash_route_hits_per_trace"],
-                    }
-                except Exception as e:  # noqa: BLE001
-                    sys.stderr.write(f"gpt 8k segment skipped: {e}\n")
+            _attach_seq8192(gpt_long, steps)
         except Exception as e:
             sys.stderr.write(
                 f"gpt_long bench failed after retries "

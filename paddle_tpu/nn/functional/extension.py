@@ -162,8 +162,8 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     from ...static import nn as static_nn
 
     return static_nn.fc(input, size, num_flatten_dims=num_flatten_dims,
-                        param_attr=param_attr, bias_attr=bias_attr,
-                        act=act, name=name)
+                        weight_attr=param_attr, bias_attr=bias_attr,
+                        activation=act, name=name)
 
 
 def diag_embed(input, offset=0, dim1=-2, dim2=-1):
