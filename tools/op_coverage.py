@@ -45,6 +45,7 @@ PROBE_MODULES = [
     "paddle_tpu.static",
     "paddle_tpu.metric",
     "paddle_tpu.incubate.segment",
+    "paddle_tpu.nn.functional.extension",
 ]
 
 # Explicit map for everything the probe can't see through a rename.
@@ -154,7 +155,8 @@ _o("paddle_tpu.all", "reduce_all")
 _o("paddle_tpu.any", "reduce_any")
 _o("paddle_tpu.flip", "reverse")
 _o("paddle_tpu.nn.ClipGradByNorm", "clip_by_norm")
-_n("pad + shape-like: F.pad(x, target.shape mismatch)", "pad_constant_like")
+_o("paddle_tpu.nn.functional.extension.pad_constant_like",
+   "pad_constant_like")
 
 # --- losses / nn renames ------------------------------------------------
 _o("paddle_tpu.nn.functional.binary_cross_entropy", "bce_loss")
@@ -294,8 +296,8 @@ _n("FlowNet correlation (contrib): shifted-window einsum over pads",
    "correlation")
 _n("CTR rank-block attention (CUDA contrib): gather per-rank W + "
    "misc.batch_fc", "rank_attention")
-_n("tag-filtered instance selection (contrib host op): boolean-mask "
-   "gather on the host", "filter_by_instag")
+_o("paddle_tpu.nn.functional.extension.filter_by_instag",
+   "filter_by_instag")
 _n("tree-based GCN (contrib): adjacency matmul composition",
    "tree_conv")
 _n("hash-embedding text matcher (contrib)", "pyramid_hash")
@@ -303,9 +305,9 @@ _n("text-match similarity grid (contrib): einsum('bld,dk,brk->blr')",
    "match_matrix_tensor")
 _n("ragged-width conv (contrib): conv2d over sequence_pad",
    "var_conv_2d")
-_n("distillation sigmoid loss variant: BCE composition",
+_o("paddle_tpu.nn.functional.extension.teacher_student_sigmoid_loss",
    "teacher_student_sigmoid_loss")
-_n("DIN/DeepFM helper (contrib)", "shuffle_channel")
+_o("paddle_tpu.nn.functional.extension.shuffle_channel", "shuffle_channel")
 
 
 def _resolve(dotted):
