@@ -1185,3 +1185,33 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
 
     return (concat(locs, axis=1), concat(confs, axis=1),
             concat(boxes, axis=0), concat(variances, axis=0))
+
+
+def uniform_random_batch_size_like(input, shape, input_dim_idx=0,
+                                   output_dim_idx=0, min=-1.0, max=1.0,  # noqa: A002
+                                   seed=0, dtype="float32"):
+    """uniform_random_batch_size_like_op.cc: a uniform tensor whose
+    ``output_dim_idx`` dim copies ``input``'s ``input_dim_idx`` dim."""
+    from ...ops.random_ops import uniform
+
+    x = to_tensor_like(input)
+    out_shape = list(shape)
+    out_shape[output_dim_idx] = x.shape[input_dim_idx]
+    return uniform(out_shape, dtype=dtype, min=min, max=max, seed=seed)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    """gaussian_random_batch_size_like_op.cc analog of the uniform form.
+    ``seed=0`` draws from the framework stream; an explicit seed is
+    reproducible (same convention as the uniform sibling)."""
+    from ...framework.random import next_rng_key
+
+    x = to_tensor_like(input)
+    out_shape = list(shape)
+    out_shape[output_dim_idx] = x.shape[input_dim_idx]
+    key = jax.random.PRNGKey(seed) if seed else next_rng_key()
+    out = Tensor(mean + std * jax.random.normal(
+        key, tuple(int(s) for s in out_shape)))
+    return out.astype(dtype) if dtype != "float32" else out

@@ -29,19 +29,20 @@ __all__ = [
     "retinanet_detection_output", "rpn_target_assign",
     "retinanet_target_assign", "yolov3_loss", "deformable_roi_pooling",
     "generate_proposal_labels", "roi_perspective_transform",
-    "generate_mask_labels", "matrix_nms",
+    "generate_mask_labels", "matrix_nms", "locality_aware_nms",
 ]
 
 
-def _pairwise_iou(a, b):
-    """a [N,4], b [M,4] (xyxy) -> [N,M] IoU."""
-    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * \
-        jnp.maximum(a[:, 3] - a[:, 1], 0)
-    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * \
-        jnp.maximum(b[:, 3] - b[:, 1], 0)
+def _pairwise_iou(a, b, offset=0.0):
+    """a [N,4], b [M,4] (xyxy) -> [N,M] IoU.  ``offset=1`` is the
+    unnormalized pixel-coordinate convention (+1 on widths/heights)."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0] + offset, 0) * \
+        jnp.maximum(a[:, 3] - a[:, 1] + offset, 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0] + offset, 0) * \
+        jnp.maximum(b[:, 3] - b[:, 1] + offset, 0)
     lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
     rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
-    wh = jnp.maximum(rb - lt, 0)
+    wh = jnp.maximum(rb - lt + offset, 0)
     inter = wh[..., 0] * wh[..., 1]
     union = area_a[:, None] + area_b[None, :] - inter
     return jnp.where(union > 0, inter / union, 0.0)
@@ -49,8 +50,10 @@ def _pairwise_iou(a, b):
 
 def iou_similarity(x, y, box_normalized=True, name=None):
     """Pairwise IoU (iou_similarity_op.cc)."""
-    return apply("iou_similarity", _pairwise_iou, to_tensor_like(x),
-                 to_tensor_like(y))
+    off = 0.0 if box_normalized else 1.0
+    return apply("iou_similarity",
+                 lambda a, b: _pairwise_iou(a, b, offset=off),
+                 to_tensor_like(x), to_tensor_like(y))
 
 
 def box_clip(input, im_info, name=None):
@@ -243,12 +246,12 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
     return apply("yolo_box", f, xt, to_tensor_like(img_size))
 
 
-def _nms_fixed(boxes, scores, iou_threshold, max_out):
+def _nms_fixed(boxes, scores, iou_threshold, max_out, offset=0.0):
     """Jittable greedy NMS with a FIXED output slate: returns
     (indices [max_out] int32, count) — TPU has no dynamic shapes, so the
     slate is padded with -1 (multiclass_nms_op.cc NMSFast analog)."""
     n = boxes.shape[0]
-    iou = _pairwise_iou(boxes, boxes)
+    iou = _pairwise_iou(boxes, boxes, offset=offset)
 
     def body(carry, _):
         alive, out, k = carry
@@ -298,7 +301,8 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=64,
                                -jnp.inf)
             vals, idx = jax.lax.top_k(masked, top)
             cand = boxes[idx]
-            keep, cnt = _nms_fixed(cand, vals, nms_threshold, top)
+            keep, cnt = _nms_fixed(cand, vals, nms_threshold, top,
+                                   offset=0.0 if normalized else 1.0)
             kept_scores = jnp.where(keep >= 0, vals[jnp.maximum(keep, 0)],
                                     -jnp.inf)
             kept_boxes = cand[jnp.maximum(keep, 0)]
@@ -1411,3 +1415,47 @@ def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
         return rows, count
 
     return apply("matrix_nms", f, b, s)
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """Locality-aware NMS (EAST text detection;
+    fluid/layers/detection.py:3416, locality_aware_nms_op.cc): first
+    score-weighted-MERGE mutually-overlapping boxes, then standard NMS.
+    TPU form: the merge is one IoU matmul + masked weighted average (no
+    sequential scan over rows); single class (like the reference).
+    bboxes [M, 4], scores [1, M] or [M]; returns the multiclass_nms
+    fixed slate ([keep_top_k, 6], count).  Merged scores accumulate
+    member evidence UNCAPPED (EAST ranks clusters by total support).
+    ``nms_eta`` adaptive thresholding is not expressed in the fixed-slate
+    NMS — pass 1.0 (the reference default)."""
+    if nms_eta != 1.0:
+        raise NotImplementedError(
+            "locality_aware_nms: nms_eta != 1.0 (adaptive threshold decay) "
+            "is not supported by the fixed-slate NMS; use nms_eta=1.0 or "
+            "lower nms_threshold directly")
+    b = to_tensor_like(bboxes)
+    s = to_tensor_like(scores)
+    off = 0.0 if normalized else 1.0
+
+    def merge(boxes, sc):
+        sc = sc.reshape(-1)
+        iou = _pairwise_iou(boxes, boxes, offset=off)
+        near = (iou >= nms_threshold) & (sc[None, :] >= score_threshold)
+        w = jnp.where(near, sc[None, :], 0.0)            # [M, M]
+        denom = jnp.maximum(w.sum(axis=1, keepdims=True), 1e-12)
+        merged = (w @ boxes) / denom
+        # accumulate evidence like EAST: sum of merged member scores
+        msc = jnp.where(sc >= score_threshold, w.sum(axis=1), 0.0)
+        return merged, msc
+
+    merged_t, msc_t = apply("lanms_merge", merge, b, s, n_outputs=2)
+    from .manipulation import reshape
+
+    return multiclass_nms(merged_t, reshape(msc_t, [1, -1]),
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold,
+                          normalized=normalized,
+                          background_label=background_label)

@@ -15,6 +15,7 @@ from ._helpers import to_tensor_like
 from .dispatch import apply
 
 __all__ = [
+    "correlation",
     "mean_iou", "cvm", "shuffle_batch", "partial_concat", "partial_sum",
     "batch_fc", "row_conv", "hinge_loss", "rank_loss", "huber_loss",
     "l1_norm", "squared_l2_norm", "sampling_id", "fsp_matrix", "conv_shift",
@@ -420,3 +421,44 @@ def positive_negative_pair(score, label, query_ids):
                 else:
                     neu += 1
     return (np.float32(pos), np.float32(neg), np.float32(neu))
+
+
+def correlation(x1, x2, pad_size=0, kernel_size=1, max_displacement=1,
+                stride1=1, stride2=1, corr_type_multiply=1):
+    """FlowNet correlation layer (correlation_op.cc): cost volume between
+    two feature maps.  out[b, (dy, dx), y, x] = mean_c x1[b, c, y, x] *
+    x2[b, c, y+dy, x+dx] over displacements |dy|,|dx| <= max_displacement
+    sampled every ``stride2``.  TPU form: one jnp.roll + multiply per
+    displacement (a static (2d/s2+1)^2 loop XLA fuses), no im2col buffer.
+    kernel_size=1, stride1=1 (the FlowNet-C config) is supported."""
+    if kernel_size != 1 or stride1 != 1:
+        raise NotImplementedError(
+            "correlation: kernel_size=1, stride1=1 (the FlowNet-C "
+            "configuration) is supported; larger kernels = average-pool "
+            "the inputs first")
+    a = to_tensor_like(x1)
+    b = to_tensor_like(x2)
+    d = int(max_displacement)
+
+    def f(u, v):
+        if pad_size:
+            v = jnp.pad(v, ((0, 0), (0, 0), (pad_size, pad_size),
+                            (pad_size, pad_size)))
+            u = jnp.pad(u, ((0, 0), (0, 0), (pad_size, pad_size),
+                            (pad_size, pad_size)))
+        C, H, W = u.shape[1], u.shape[2], u.shape[3]
+        # zero apron for displaced reads: out-of-bounds correlates to 0
+        # (the reference zero-pads; jnp.roll would wrap opposite edges in)
+        vp = jnp.pad(v, ((0, 0), (0, 0), (d, d), (d, d)))
+        outs = []
+        disps = range(-d, d + 1, stride2)
+        for dy in disps:
+            for dx in disps:
+                shifted = vp[:, :, d + dy:d + dy + H, d + dx:d + dx + W]
+                outs.append((u * shifted).sum(axis=1) / C)
+        out = jnp.stack(outs, axis=1)
+        # reference output crops the displacement border:
+        # H_out = H + 2*pad_size - 2*max_displacement
+        return out[:, :, d:H - d, d:W - d]
+
+    return apply("correlation", f, a, b)

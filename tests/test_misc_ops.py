@@ -262,3 +262,92 @@ class TestMatrixNMSDecay:
     def test_incubate_namespace_exports_segment(self):
         import paddle_tpu.incubate as inc
         assert callable(inc.segment_sum) and callable(inc.segment_mean)
+
+
+class TestCorrelation:
+    def test_zero_displacement_is_channel_mean_product(self):
+        # pad_size == max_displacement (FlowNet-C config): output keeps H, W
+        rng = np.random.RandomState(1)
+        a = rng.rand(1, 4, 5, 5).astype(np.float32)
+        b = rng.rand(1, 4, 5, 5).astype(np.float32)
+        out = misc.correlation(t(a), t(b), pad_size=1,
+                               max_displacement=1).numpy()
+        assert out.shape == (1, 9, 5, 5)
+        np.testing.assert_allclose(out[:, 4], (a * b).mean(1), rtol=1e-5)
+
+    def test_output_crops_displacement_border(self):
+        # reference: H_out = H + 2*pad - 2*max_displacement (review fix)
+        a = np.ones((1, 2, 8, 8), np.float32)
+        out = misc.correlation(t(a), t(a), pad_size=0,
+                               max_displacement=2, stride2=2).numpy()
+        assert out.shape == (1, 9, 4, 4)
+
+    def test_displacement_shifts(self):
+        a = np.zeros((1, 1, 4, 4), np.float32); a[0, 0, 1, 1] = 1.0
+        b = np.zeros((1, 1, 4, 4), np.float32); b[0, 0, 1, 2] = 1.0
+        out = misc.correlation(t(a), t(b), pad_size=1,
+                               max_displacement=1).numpy()
+        # dx=+1 plane (dy=0, dx=1 -> index 5) correlates at (1,1)
+        assert out[0, 5, 1, 1] == 1.0
+
+    def test_no_wraparound_at_edges(self):
+        # spike at top row of x1, bottom row of x2: no displacement plane
+        # may connect them through the edge (reference zero-pads; review fix)
+        a = np.zeros((1, 1, 4, 4), np.float32); a[0, 0, 0, 0] = 1.0
+        b = np.zeros((1, 1, 4, 4), np.float32); b[0, 0, 3, 0] = 1.0
+        out = misc.correlation(t(a), t(b), pad_size=1,
+                               max_displacement=1).numpy()
+        assert out.max() == 0.0
+
+
+class TestLocalityAwareNMS:
+    def test_overlapping_boxes_merge(self):
+        from paddle_tpu.ops.detection import locality_aware_nms
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                          [20, 20, 30, 30]], np.float32)
+        sc = np.array([[0.9, 0.7, 0.6]], np.float32)
+        out, cnt = locality_aware_nms(t(boxes), t(sc), 0.05, 4, 4,
+                                      nms_threshold=0.5)
+        rows = out.numpy()
+        kept = rows[rows[:, 1] > 0]
+        # the two overlapping boxes collapse to ONE merged box between them
+        assert len(kept) == 2
+        x0 = kept[np.argmax(kept[:, 1]), 2]
+        assert 0.0 < x0 < 1.0  # weighted mean of 0 and 1
+
+    def test_evidence_accumulates_uncapped(self):
+        # EAST ranks clusters by total member support (review fix: the
+        # 10-member cluster must outrank the 2-member one at keep_top_k=1)
+        from paddle_tpu.ops.detection import locality_aware_nms
+        boxes = [[0.0, 0.0, 10.0, 10.0]] * 10 + [[30.0, 30.0, 40.0, 40.0]] * 2
+        sc = np.full((1, 12), 0.5, np.float32)
+        out, cnt = locality_aware_nms(
+            t(np.array(boxes, np.float32)), t(sc), 0.1, 12, 1,
+            nms_threshold=0.5)
+        rows = out.numpy()
+        assert rows[0, 2] < 15.0  # the strong cluster won
+        assert rows[0, 1] == pytest.approx(5.0)  # 10 x 0.5, uncapped
+
+    def test_nms_eta_is_loud(self):
+        from paddle_tpu.ops.detection import locality_aware_nms
+        with pytest.raises(NotImplementedError):
+            locality_aware_nms(t(np.zeros((2, 4), np.float32)),
+                               t(np.zeros((1, 2), np.float32)),
+                               0.1, 2, 2, nms_eta=0.9)
+
+
+class TestBatchSizeLikeFactories:
+    def test_shapes_and_ranges(self):
+        import paddle_tpu.nn.functional.extension as E
+        ref = t(np.zeros((6, 2), np.float32))
+        u = E.uniform_random_batch_size_like(ref, [0, 3], min=2.0, max=3.0)
+        assert u.shape == [6, 3]
+        assert (u.numpy() >= 2.0).all() and (u.numpy() <= 3.0).all()
+        g = E.gaussian_random_batch_size_like(ref, [0, 4], mean=5.0,
+                                              std=0.01)
+        assert g.shape == [6, 4]
+        assert abs(g.numpy().mean() - 5.0) < 0.1
+        # explicit seed reproducible; default draws fresh (review fix)
+        g1 = E.gaussian_random_batch_size_like(ref, [0, 4], seed=9)
+        g2 = E.gaussian_random_batch_size_like(ref, [0, 4], seed=9)
+        np.testing.assert_array_equal(g1.numpy(), g2.numpy())

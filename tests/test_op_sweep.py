@@ -887,3 +887,12 @@ OVERRIDES.update({
         lambda rng: [t((rng.permutation(12).astype(np.float32) * 0.1
                         + 0.2).reshape(3, 4))], rtol=8e-2),
 })
+
+OVERRIDES.update({
+    "misc.correlation": Spec(
+        lambda rng: [t(fmat(rng, 1, 2, 5, 5)), t(fmat(rng, 1, 2, 5, 5))],
+        kwargs={"max_displacement": 1, "pad_size": 1}, rtol=8e-2),
+    "detection.locality_aware_nms": Spec(
+        lambda rng: [t(_boxes(rng, 6)), t(fmat(rng, 1, 6)), 0.05, 4, 4],
+        **NOGRAD),
+})

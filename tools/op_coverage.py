@@ -146,8 +146,10 @@ _o("paddle_tpu.add_n", "sum")
 _o("paddle_tpu.nn.initializer.TruncatedNormal", "truncated_gaussian_random")
 _o("paddle_tpu.ops.misc.l1_norm", "l1_norm")
 _o("paddle_tpu.ops.misc.squared_l2_norm", "squared_l2_norm")
-_n("batch-size-like factories: full(x.shape[0], ...) composition",
-   "uniform_random_batch_size_like", "gaussian_random_batch_size_like")
+_o("paddle_tpu.nn.functional.extension.uniform_random_batch_size_like",
+   "uniform_random_batch_size_like")
+_o("paddle_tpu.nn.functional.extension.gaussian_random_batch_size_like",
+   "gaussian_random_batch_size_like")
 _o("paddle_tpu.nn.functional.pad", "pad", "pad2d", "pad3d")
 _o("paddle_tpu.maximum", "elementwise_max")
 _o("paddle_tpu.minimum", "elementwise_min")
@@ -217,8 +219,7 @@ _o("paddle_tpu.ops.detection.generate_proposals", "generate_proposals_v2")
 _o("paddle_tpu.ops.detection.multiclass_nms", "multiclass_nms2",
    "multiclass_nms3")
 _o("paddle_tpu.ops.detection.matrix_nms", "matrix_nms")
-_n("EAST text NMS: nms + IoU-weighted box merge over detection.py "
-   "primitives", "locality_aware_nms")
+_o("paddle_tpu.ops.detection.locality_aware_nms", "locality_aware_nms")
 
 # --- static/control-flow/LoD runtime -----------------------------------
 _r("paddle_tpu.static.Print", "print")
@@ -292,8 +293,7 @@ _t("reference-test fixture op",
 # --- contrib niche (deprecated, no public 2.x surface) -----------------
 _n("HDRNet bilateral-grid slice (contrib): grid_sample composition",
    "bilateral_slice")
-_n("FlowNet correlation (contrib): shifted-window einsum over pads",
-   "correlation")
+_o("paddle_tpu.ops.misc.correlation", "correlation")
 _n("CTR rank-block attention (CUDA contrib): gather per-rank W + "
    "misc.batch_fc", "rank_attention")
 _o("paddle_tpu.nn.functional.extension.filter_by_instag",
