@@ -15,7 +15,7 @@ from ._helpers import to_tensor_like
 from .dispatch import apply
 
 __all__ = [
-    "correlation",
+    "correlation", "tree_conv",
     "mean_iou", "cvm", "shuffle_batch", "partial_concat", "partial_sum",
     "batch_fc", "row_conv", "hinge_loss", "rank_loss", "huber_loss",
     "l1_norm", "squared_l2_norm", "sampling_id", "fsp_matrix", "conv_shift",
@@ -450,15 +450,90 @@ def correlation(x1, x2, pad_size=0, kernel_size=1, max_displacement=1,
         # zero apron for displaced reads: out-of-bounds correlates to 0
         # (the reference zero-pads; jnp.roll would wrap opposite edges in)
         vp = jnp.pad(v, ((0, 0), (0, 0), (d, d), (d, d)))
+        # displacements are MULTIPLES of stride2 centered at 0
+        # (correlation_op.cc:36: (max_displacement/stride2)*2+1 per axis)
+        steps = d // stride2
+        disps = [i * stride2 for i in range(-steps, steps + 1)]
+        # compute only the kept window (reference output crops the
+        # displacement border: H_out = H + 2*pad_size - 2*max_displacement)
+        u_c = u[:, :, d:H - d, d:W - d]
         outs = []
-        disps = range(-d, d + 1, stride2)
         for dy in disps:
             for dx in disps:
-                shifted = vp[:, :, d + dy:d + dy + H, d + dx:d + dx + W]
-                outs.append((u * shifted).sum(axis=1) / C)
-        out = jnp.stack(outs, axis=1)
-        # reference output crops the displacement border:
-        # H_out = H + 2*pad_size - 2*max_displacement
-        return out[:, :, d:H - d, d:W - d]
+                shifted = vp[:, :, 2 * d + dy:H + dy, 2 * d + dx:W + dx]
+                outs.append((u_c * shifted).sum(axis=1) / C)
+        return jnp.stack(outs, axis=1)
 
     return apply("correlation", f, a, b)
+
+
+def _tree_patches(edges, n_nodes, max_depth):
+    """tree2col.cc host side: adjacency from a 1-indexed edge list
+    (0-terminated), then per-root DFS patches with TBCNN eta weights.
+    Returns coef [3, N+1, N+1] float32 — coef[k, u, v] is the
+    eta_{l,r,t} weight (THE REFERENCE SLOT ORDER, tree2col.cc:124-129:
+    patch slots are [eta_l, eta_r, eta_t]) of node v in u's patch."""
+    adj = [[] for _ in range(n_nodes + 2)]
+    for u, v in edges:
+        u, v = int(u), int(v)
+        if u == 0 or v == 0:
+            break
+        adj[u].append(v)
+    coef = np.zeros((3, n_nodes + 1, n_nodes + 1), np.float32)
+    fd = float(max_depth)
+    for root in range(1, n_nodes + 1):
+        # iterative DFS mirroring Tree2ColUtil::construct_patch
+        patch = [(root, 1, 1, 0)]
+        stack = [(root, 0)]
+        visited = {root}
+        while stack:
+            node, depth = stack[-1]
+            advanced = False
+            kids = adj[node]
+            for i, v in enumerate(kids):
+                if v not in visited and depth + 1 < max_depth:
+                    visited.add(v)
+                    stack.append((v, depth + 1))
+                    patch.append((v, i + 1, len(kids), depth + 1))
+                    advanced = True
+            if not advanced:
+                stack.pop()
+        for v, index, pclen, depth in patch:
+            eta_t = (fd - depth) / fd
+            frac = 0.5 if pclen == 1 else (index - 1.0) / (pclen - 1.0)
+            eta_l = (1.0 - eta_t) * frac
+            eta_r = (1.0 - eta_t) * (1.0 - frac)
+            coef[0, root, v] += eta_l
+            coef[1, root, v] += eta_r
+            coef[2, root, v] += eta_t
+    return coef
+
+
+def tree_conv(nodes_vector, edge_set, filter, max_depth=2, act=None):
+    """Tree-based convolution (TBCNN; tree_conv_op.cc / math/tree2col.cc,
+    python surface fluid/contrib/layers/nn.py:401).
+
+    ``nodes_vector`` [B, N, F] (node 0 is the padding slot — edges are
+    1-indexed, 0-terminated like the reference), ``edge_set`` [B, E, 2]
+    int, ``filter`` [F, 3, output_size, num_filters].  Tree traversal
+    (data-dependent structure) runs on the host exactly like the
+    reference CPU kernel; the compute is one einsum on the MXU.
+    Returns [B, N, output_size, num_filters]."""
+    nv = to_tensor_like(nodes_vector)
+    flt = to_tensor_like(filter)
+    edges = np.asarray(getattr(edge_set, "numpy", lambda: edge_set)())
+    B, N, F = nv.shape
+    coefs = np.stack([_tree_patches(edges[b], N, max_depth)[:, 1:, 1:]
+                      for b in range(B)])        # [B, 3, N, N]
+
+    def f(feat, w):
+        c = jnp.asarray(coefs)
+        patches = jnp.einsum("bknm,bmf->bnkf", c, feat)   # [B, N, 3, F]
+        out = jnp.einsum("bnkf,fkod->bnod", patches, w)
+        if act == "tanh":
+            out = jnp.tanh(out)
+        elif act == "relu":
+            out = jax.nn.relu(out)
+        return out
+
+    return apply("tree_conv", f, nv, flt)

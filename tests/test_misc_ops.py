@@ -282,6 +282,17 @@ class TestCorrelation:
                                max_displacement=2, stride2=2).numpy()
         assert out.shape == (1, 9, 4, 4)
 
+    def test_stride2_nondivisible_keeps_center_plane(self):
+        # correlation_op.cc:36 — (d//s2)*2+1 planes per axis, multiples of
+        # s2 centered at 0 (review fix: d=1, s2=2 is ONE plane, dy=dx=0)
+        rng = np.random.RandomState(2)
+        a = rng.rand(1, 2, 6, 6).astype(np.float32)
+        b = rng.rand(1, 2, 6, 6).astype(np.float32)
+        out = misc.correlation(t(a), t(b), pad_size=1, max_displacement=1,
+                               stride2=2).numpy()
+        assert out.shape == (1, 1, 6, 6)
+        np.testing.assert_allclose(out[:, 0], (a * b).mean(1), rtol=1e-5)
+
     def test_displacement_shifts(self):
         a = np.zeros((1, 1, 4, 4), np.float32); a[0, 0, 1, 1] = 1.0
         b = np.zeros((1, 1, 4, 4), np.float32); b[0, 0, 1, 2] = 1.0
@@ -351,3 +362,35 @@ class TestBatchSizeLikeFactories:
         g1 = E.gaussian_random_batch_size_like(ref, [0, 4], seed=9)
         g2 = E.gaussian_random_batch_size_like(ref, [0, 4], seed=9)
         np.testing.assert_array_equal(g1.numpy(), g2.numpy())
+
+
+class TestTreeConv:
+    def test_matches_hand_tbcnn_math(self):
+        rng = np.random.RandomState(0)
+        feats = rng.rand(1, 3, 4).astype(np.float32)
+        edges = np.array([[[1, 2], [1, 3], [0, 0]]], np.int32)
+        W = rng.rand(4, 3, 5, 2).astype(np.float32)
+        out = misc.tree_conv(t(feats), edges, t(W), max_depth=2,
+                             act="tanh").numpy()
+        assert out.shape == (1, 3, 5, 2)
+        f = feats[0]
+        # root patch: root (eta_t=1) + two children at depth 1 (eta_t=.5);
+        # left child frac 0, right child frac 1 (tree2col.h eta formulas)
+        pt = f[0] + 0.5 * f[1] + 0.5 * f[2]
+        pl = 0.5 * 1.0 * f[2]
+        pr = 0.5 * 1.0 * f[1]
+        # reference slot order (tree2col.cc): [eta_l, eta_r, eta_t]
+        ref = np.tanh(np.einsum("f,fod->od", pl, W[:, 0])
+                      + np.einsum("f,fod->od", pr, W[:, 1])
+                      + np.einsum("f,fod->od", pt, W[:, 2]))
+        np.testing.assert_allclose(out[0, 0], ref, rtol=1e-4)
+
+    def test_leaf_patch_is_self_only(self):
+        rng = np.random.RandomState(1)
+        feats = rng.rand(1, 3, 4).astype(np.float32)
+        edges = np.array([[[1, 2], [1, 3], [0, 0]]], np.int32)
+        W = rng.rand(4, 3, 2, 1).astype(np.float32)
+        out = misc.tree_conv(t(feats), edges, t(W), max_depth=2).numpy()
+        # node 2 has no children: patch = itself with eta_t=1 (slot 2)
+        ref = np.einsum("f,fo->o", feats[0, 1], W[:, 2, :, 0])
+        np.testing.assert_allclose(out[0, 1, :, 0], ref, rtol=1e-4)

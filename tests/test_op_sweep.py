@@ -896,3 +896,11 @@ OVERRIDES.update({
         lambda rng: [t(_boxes(rng, 6)), t(fmat(rng, 1, 6)), 0.05, 4, 4],
         **NOGRAD),
 })
+
+OVERRIDES.update({
+    "misc.tree_conv": Spec(
+        lambda rng: [t(fmat(rng, 1, 3, 4)),
+                     np.asarray([[[1, 2], [1, 3], [0, 0]]], np.int32),
+                     t(fmat(rng, 4, 3, 5, 2))],
+        kwargs={"max_depth": 2}, grad_args=[0, 2], rtol=8e-2),
+})

@@ -298,8 +298,7 @@ _n("CTR rank-block attention (CUDA contrib): gather per-rank W + "
    "misc.batch_fc", "rank_attention")
 _o("paddle_tpu.nn.functional.extension.filter_by_instag",
    "filter_by_instag")
-_n("tree-based GCN (contrib): adjacency matmul composition",
-   "tree_conv")
+_o("paddle_tpu.ops.misc.tree_conv", "tree_conv")
 _n("hash-embedding text matcher (contrib)", "pyramid_hash")
 _n("text-match similarity grid (contrib): einsum('bld,dk,brk->blr')",
    "match_matrix_tensor")
