@@ -15,7 +15,8 @@ from ._helpers import to_tensor_like
 from .dispatch import apply
 
 __all__ = [
-    "correlation", "tree_conv",
+    "correlation", "tree_conv", "match_matrix_tensor",
+    "sequence_topk_avg_pooling", "var_conv_2d",
     "mean_iou", "cvm", "shuffle_batch", "partial_concat", "partial_sum",
     "batch_fc", "row_conv", "hinge_loss", "rank_loss", "huber_loss",
     "l1_norm", "squared_l2_norm", "sampling_id", "fsp_matrix", "conv_shift",
@@ -530,10 +531,120 @@ def tree_conv(nodes_vector, edge_set, filter, max_depth=2, act=None):
         c = jnp.asarray(coefs)
         patches = jnp.einsum("bknm,bmf->bnkf", c, feat)   # [B, N, 3, F]
         out = jnp.einsum("bnkf,fkod->bnod", patches, w)
-        if act == "tanh":
-            out = jnp.tanh(out)
-        elif act == "relu":
-            out = jax.nn.relu(out)
-        return out
+        return _act(out, act, "tree_conv")
 
     return apply("tree_conv", f, nv, flt)
+
+
+def _act(out, act, op):
+    """Shared activation tail — unknown act strings are LOUD (norm.py
+    precedent), never a silent pass-through."""
+    if act is None:
+        return out
+    if act == "relu":
+        return jax.nn.relu(out)
+    if act == "tanh":
+        return jnp.tanh(out)
+    if act == "sigmoid":
+        return jax.nn.sigmoid(out)
+    raise ValueError(f"{op}: unsupported act {act!r} "
+                     "(one of None/relu/tanh/sigmoid)")
+
+
+def match_matrix_tensor(x, y, w, x_lengths=None, y_lengths=None, act=None):
+    """Text-match similarity grid (match_matrix_tensor_op.cc, contrib
+    surface fluid/contrib/layers/nn.py:248): out[b, t, i, j] =
+    x_i^T W_t y_j.  Padded form: x [B, Lx, h], y [B, Ly, h],
+    w [h, dim_t, h]; positions beyond the per-sample lengths are zeroed.
+    One einsum — the whole op is MXU work."""
+    xt = to_tensor_like(x)
+    yt = to_tensor_like(y)
+    wt = to_tensor_like(w)
+    xl = None if x_lengths is None else to_tensor_like(x_lengths)
+    yl = None if y_lengths is None else to_tensor_like(y_lengths)
+
+    def f(xv, yv, wv, *lens):
+        out = jnp.einsum("bih,htg,bjg->btij", xv, wv, yv)
+        i = 0
+        if xl is not None:
+            lx = lens[i]; i += 1
+            mask = jnp.arange(xv.shape[1])[None, :] < lx[:, None]
+            out = out * mask[:, None, :, None]
+        if yl is not None:
+            ly = lens[i]
+            mask = jnp.arange(yv.shape[1])[None, :] < ly[:, None]
+            out = out * mask[:, None, None, :]
+        return _act(out, act, "match_matrix_tensor")
+
+    args = [xt, yt, wt] + [a for a in (xl, yl) if a is not None]
+    return apply("match_matrix_tensor", f, *args)
+
+
+def sequence_topk_avg_pooling(x, row_lengths, col_lengths, topks,
+                              channel_num=None):
+    """Top-k average pooling over the column axis of a match grid
+    (sequence_topk_avg_pooling_op.h).  Padded form: x [B, C, R, Cc] with
+    per-sample valid (row_lengths[b], col_lengths[b]).  For each
+    (b, c, r): out[.., c*K + k] = sum(top-topks[k] valid cols) / topks[k]
+    — the divisor is ALWAYS topks[k] even when fewer columns exist
+    (reference :163-165).  Returns [B, R, C*len(topks)]."""
+    xt = to_tensor_like(x)
+    rl = to_tensor_like(row_lengths)
+    cl = to_tensor_like(col_lengths)
+    topks = [int(k) for k in topks]
+
+    def f(v, rlen, clen):
+        B, C, R, Cc = v.shape
+        col_valid = jnp.arange(Cc)[None, None, None, :] < \
+            clen[:, None, None, None]
+        masked = jnp.where(col_valid, v, -jnp.inf)
+        s = -jnp.sort(-masked, axis=-1)          # desc per row
+        s = jnp.where(jnp.isfinite(s), s, 0.0)   # absent cols add 0
+        csum = jnp.cumsum(s, axis=-1)
+        outs = [csum[..., k - 1] / k for k in topks]    # [B, C, R] each
+        out = jnp.stack(outs, axis=-1)           # [B, C, R, K]
+        out = jnp.transpose(out, (0, 2, 1, 3)).reshape(B, R, -1)
+        row_valid = jnp.arange(R)[None, :] < rlen[:, None]
+        return out * row_valid[:, :, None]
+
+    return apply("sequence_topk_avg_pooling", f, xt, rl, cl)
+
+
+def var_conv_2d(x, row_lengths, col_lengths, weight, stride=1, act=None):
+    """Variable-size 2D conv over per-sample (rows, cols) regions
+    (var_conv_2d_op.cc).  Padded form: x [B, C_in, H, W] with the valid
+    region per sample; the region is zero-masked, convolved with SAME
+    padding at ``stride`` (out dim (n-1)//stride + 1, reference doc),
+    and outputs beyond the per-sample output dims are zeroed — identical
+    math to the reference's within-region im2col with zero borders."""
+    from ..nn.functional.conv import conv2d
+
+    xt = to_tensor_like(x)
+    wt = to_tensor_like(weight)
+    rl = to_tensor_like(row_lengths)
+    cl = to_tensor_like(col_lengths)
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    kh, kw = int(wt.shape[2]), int(wt.shape[3])
+
+    def mask_in(v, rlen, clen):
+        H, W = v.shape[2], v.shape[3]
+        rm = jnp.arange(H)[None, :] < rlen[:, None]
+        cm = jnp.arange(W)[None, :] < clen[:, None]
+        return v * (rm[:, None, :, None] & cm[:, None, None, :])
+
+    masked = apply("var_conv_2d_mask", mask_in, xt, rl, cl)
+    # asymmetric SAME padding so out dim is (n-1)//stride + 1 for ANY
+    # kernel parity (even kernels pad one more at hi)
+    out = conv2d(masked, wt, stride=st,
+                 padding=[(kh - 1) // 2, kh // 2, (kw - 1) // 2, kw // 2])
+
+    def mask_out(v, rlen, clen):
+        H, W = v.shape[2], v.shape[3]
+        orl = (rlen - 1) // st[0] + 1
+        ocl = (clen - 1) // st[1] + 1
+        rm = jnp.arange(H)[None, :] < orl[:, None]
+        cm = jnp.arange(W)[None, :] < ocl[:, None]
+        o = v * (rm[:, None, :, None] & cm[:, None, None, :])
+        return _act(o, act, "var_conv_2d")
+
+    return apply("var_conv_2d_out", mask_out, out, rl, cl)

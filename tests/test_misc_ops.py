@@ -394,3 +394,89 @@ class TestTreeConv:
         # node 2 has no children: patch = itself with eta_t=1 (slot 2)
         ref = np.einsum("f,fo->o", feats[0, 1], W[:, 2, :, 0])
         np.testing.assert_allclose(out[0, 1, :, 0], ref, rtol=1e-4)
+
+
+class TestMatchMatrixTensor:
+    def test_matches_einsum_and_masks(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 4, 3).astype(np.float32)
+        y = rng.rand(2, 5, 3).astype(np.float32)
+        w = rng.rand(3, 2, 3).astype(np.float32)
+        out = misc.match_matrix_tensor(
+            t(x), t(y), t(w), t(np.array([4, 2])),
+            t(np.array([5, 3]))).numpy()
+        ref = np.einsum("bih,htg,bjg->btij", x, w, y)
+        np.testing.assert_allclose(out[0], ref[0], rtol=5e-3)
+        assert (out[1, :, 2:, :] == 0).all()
+        assert (out[1, :, :, 3:] == 0).all()
+
+
+class TestSequenceTopkAvgPooling:
+    def test_topk_sums_divided_by_k(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(1, 2, 3, 6).astype(np.float32)
+        out = misc.sequence_topk_avg_pooling(
+            t(x), t(np.array([3])), t(np.array([4])), [1, 3]).numpy()
+        v = np.sort(x[0, 0, 0, :4])[::-1]
+        assert out[0, 0, 0] == pytest.approx(v[0], rel=1e-5)
+        assert out[0, 0, 1] == pytest.approx(v[:3].sum() / 3, rel=1e-5)
+
+    def test_short_columns_keep_full_divisor(self):
+        # reference :163-165: divisor is topks[k] even when cols < k
+        x = np.full((1, 1, 1, 5), 2.0, np.float32)
+        out = misc.sequence_topk_avg_pooling(
+            t(x), t(np.array([1])), t(np.array([2])), [4]).numpy()
+        assert out[0, 0, 0] == pytest.approx(2.0 * 2 / 4)
+
+    def test_rows_beyond_length_zeroed(self):
+        x = np.ones((1, 1, 4, 4), np.float32)
+        out = misc.sequence_topk_avg_pooling(
+            t(x), t(np.array([2])), t(np.array([4])), [1]).numpy()
+        assert (out[0, 2:] == 0).all()
+
+
+class TestVarConv2D:
+    def test_valid_region_matches_cropped_conv(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(0)
+        x = rng.rand(1, 2, 6, 6).astype(np.float32)
+        w = rng.rand(3, 2, 3, 3).astype(np.float32)
+        out = misc.var_conv_2d(t(x), t(np.array([4])), t(np.array([5])),
+                               t(w)).numpy()
+        crop = np.zeros_like(x)
+        crop[:, :, :4, :5] = x[:, :, :4, :5]
+        ref = F.conv2d(t(crop), t(w), padding=1).numpy()
+        np.testing.assert_allclose(out[:, :, :4, :5], ref[:, :, :4, :5],
+                                   rtol=5e-3)
+        assert (out[:, :, 4:, :] == 0).all()
+        assert (out[:, :, :, 5:] == 0).all()
+
+    def test_stride_output_dims(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 1, 8, 8).astype(np.float32)
+        w = rng.rand(2, 1, 3, 3).astype(np.float32)
+        out = misc.var_conv_2d(t(x), t(np.array([5, 8])),
+                               t(np.array([6, 8])), t(w), stride=2).numpy()
+        # sample 0: out dims (5-1)//2+1 = 3, (6-1)//2+1 = 3
+        assert (out[0, :, 3:, :] == 0).all()
+        assert (out[0, :, :, 3:] == 0).all()
+        assert np.abs(out[1]).sum() > 0
+
+    def test_even_kernel_keeps_reference_out_dims(self):
+        # review regression: even kernels pad asymmetrically so
+        # H_out = (n-1)//stride + 1 holds for any parity
+        rng = np.random.RandomState(0)
+        x = rng.rand(1, 1, 6, 6).astype(np.float32)
+        w = rng.rand(1, 1, 2, 2).astype(np.float32)
+        out = misc.var_conv_2d(t(x), t(np.array([6])), t(np.array([6])),
+                               t(w)).numpy()
+        assert out.shape == (1, 1, 6, 6)
+        assert np.abs(out[0, 0, 5]).sum() > 0  # last row present
+
+    def test_unknown_act_is_loud(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(1, 1, 4, 4).astype(np.float32)
+        w = rng.rand(1, 1, 3, 3).astype(np.float32)
+        with pytest.raises(ValueError):
+            misc.var_conv_2d(t(x), t(np.array([4])), t(np.array([4])),
+                             t(w), act="gelu")

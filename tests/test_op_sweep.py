@@ -904,3 +904,23 @@ OVERRIDES.update({
                      t(fmat(rng, 4, 3, 5, 2))],
         kwargs={"max_depth": 2}, grad_args=[0, 2], rtol=8e-2),
 })
+
+OVERRIDES.update({
+    "misc.match_matrix_tensor": Spec(
+        lambda rng: [t(fmat(rng, 2, 3, 4)), t(fmat(rng, 2, 4, 4)),
+                     t(fmat(rng, 4, 2, 4)),
+                     t(np.asarray([3, 2], np.int64)),
+                     t(np.asarray([4, 3], np.int64))],
+        grad_args=[0, 1, 2], rtol=8e-2),
+    "misc.sequence_topk_avg_pooling": Spec(
+        lambda rng: [t(fmat(rng, 1, 2, 3, 5)),
+                     t(np.asarray([3], np.int64)),
+                     t(np.asarray([4], np.int64)), [1, 2]],
+        grad_args=[0], rtol=9e-2),
+    "misc.var_conv_2d": Spec(
+        lambda rng: [t(fmat(rng, 1, 2, 6, 6)),
+                     t(np.asarray([4], np.int64)),
+                     t(np.asarray([5], np.int64)),
+                     t(fmat(rng, 2, 2, 3, 3))],
+        grad_args=[0, 3], rtol=9e-2),
+})

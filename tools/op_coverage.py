@@ -232,8 +232,8 @@ _r("paddle_tpu.array_write",
 _r("paddle_tpu.save", "save", "save_combine", "load_combine",
    "sparse_tensor_load")
 _o("paddle_tpu.ops.sequence.sequence_pad", "sequence_erase")
-_n("text-matching contrib (PaddleRec): top-k mean over sequence_pool "
-   "windows", "sequence_topk_avg_pooling")
+_o("paddle_tpu.ops.misc.sequence_topk_avg_pooling",
+   "sequence_topk_avg_pooling")
 
 # --- AMP ---------------------------------------------------------------
 _r("paddle_tpu.amp.GradScaler",
@@ -300,10 +300,8 @@ _o("paddle_tpu.nn.functional.extension.filter_by_instag",
    "filter_by_instag")
 _o("paddle_tpu.ops.misc.tree_conv", "tree_conv")
 _n("hash-embedding text matcher (contrib)", "pyramid_hash")
-_n("text-match similarity grid (contrib): einsum('bld,dk,brk->blr')",
-   "match_matrix_tensor")
-_n("ragged-width conv (contrib): conv2d over sequence_pad",
-   "var_conv_2d")
+_o("paddle_tpu.ops.misc.match_matrix_tensor", "match_matrix_tensor")
+_o("paddle_tpu.ops.misc.var_conv_2d", "var_conv_2d")
 _o("paddle_tpu.nn.functional.extension.teacher_student_sigmoid_loss",
    "teacher_student_sigmoid_loss")
 _o("paddle_tpu.nn.functional.extension.shuffle_channel", "shuffle_channel")
