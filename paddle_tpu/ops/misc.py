@@ -16,7 +16,8 @@ from .dispatch import apply
 
 __all__ = [
     "correlation", "tree_conv", "match_matrix_tensor",
-    "sequence_topk_avg_pooling", "var_conv_2d",
+    "sequence_topk_avg_pooling", "var_conv_2d", "rank_attention",
+    "pyramid_hash", "bilateral_slice",
     "mean_iou", "cvm", "shuffle_batch", "partial_concat", "partial_sum",
     "batch_fc", "row_conv", "hinge_loss", "rank_loss", "huber_loss",
     "l1_norm", "squared_l2_norm", "sampling_id", "fsp_matrix", "conv_shift",
@@ -648,3 +649,217 @@ def var_conv_2d(x, row_lengths, col_lengths, weight, stride=1, act=None):
         return _act(o, act, "var_conv_2d")
 
     return apply("var_conv_2d_out", mask_out, out, rl, cl)
+
+
+def rank_attention(input, rank_offset, rank_param, max_rank=3,
+                   max_size=0):
+    """CTR rank attention (rank_attention_op.cu / rank_attention.cu.h):
+    every instance carries its own rank and up to ``max_rank`` neighbor
+    (rank, row-index) pairs; the op gathers each neighbor's feature row
+    and contracts it with the parameter block selected by the
+    (own_rank, neighbor_rank) pair.
+
+    ``input`` [ins, D]; ``rank_offset`` [ins, 1 + 2*max_rank] int —
+    col 0 own rank (1-indexed, 0 = invalid), col 2k+1 neighbor rank,
+    col 2k+2 neighbor row index; ``rank_param``
+    [max_rank*max_rank*D, C] viewed as [R_own, R_other, D, C]
+    (expand_rank_attention_param_kernel index math).  Returns [ins, C].
+    TPU form: two gathers + one einsum — no per-instance GEMM list."""
+    x = to_tensor_like(input)
+    param = to_tensor_like(rank_param)
+    ro = np.asarray(getattr(rank_offset, "numpy", lambda: rank_offset)(),
+                    np.int64)
+    R = int(max_rank)
+
+    def f(v, p):
+        D = v.shape[1]
+        C = p.shape[1]
+        pv = p.reshape(R, R, D, C)
+        own = jnp.asarray(ro[:, 0] - 1)                      # [ins]
+        faster = jnp.asarray(ro[:, 1::2] - 1)                # [ins, K]
+        idx = jnp.asarray(ro[:, 2::2])                       # [ins, K]
+        valid = (own[:, None] >= 0) & (faster >= 0)
+        xg = v[jnp.clip(idx, 0, v.shape[0] - 1)]             # [ins, K, D]
+        xg = jnp.where(valid[..., None], xg, 0.0)
+        pg = pv[jnp.clip(own[:, None], 0, R - 1),
+                jnp.clip(faster, 0, R - 1)]                  # [ins, K, D, C]
+        pg = jnp.where(valid[..., None, None], pg, 0.0)
+        return jnp.einsum("ikd,ikdc->ic", xg, pg)
+
+    return apply("rank_attention", f, x, param)
+
+
+# --- pyramid hash (search_pyramid_hash, pyramid_hash_op.cc) ---------------
+
+_XXP1, _XXP2, _XXP3, _XXP4, _XXP5 = (2654435761, 2246822519, 3266489917,
+                                     668265263, 374761393)
+_M32 = 0xFFFFFFFF
+
+
+def _rotl(x, r):
+    return ((x << r) | (x >> (32 - r))) & _M32
+
+
+def _xxh32(data: bytes, seed: int) -> int:
+    """Reference XXH32 (pyramid_hash_op.cc hashes n-gram bytes with it)."""
+    n = len(data)
+    i = 0
+    if n >= 16:
+        v1 = (seed + _XXP1 + _XXP2) & _M32
+        v2 = (seed + _XXP2) & _M32
+        v3 = seed & _M32
+        v4 = (seed - _XXP1) & _M32
+        while i <= n - 16:
+            for j, v in enumerate((v1, v2, v3, v4)):
+                lane = int.from_bytes(data[i + 4 * j:i + 4 * j + 4],
+                                      "little")
+                v = (v + lane * _XXP2) & _M32
+                v = (_rotl(v, 13) * _XXP1) & _M32
+                if j == 0:
+                    v1 = v
+                elif j == 1:
+                    v2 = v
+                elif j == 2:
+                    v3 = v
+                else:
+                    v4 = v
+            i += 16
+        acc = (_rotl(v1, 1) + _rotl(v2, 7) + _rotl(v3, 12)
+               + _rotl(v4, 18)) & _M32
+    else:
+        acc = (seed + _XXP5) & _M32
+    acc = (acc + n) & _M32
+    while i <= n - 4:
+        lane = int.from_bytes(data[i:i + 4], "little")
+        acc = (acc + lane * _XXP3) & _M32
+        acc = (_rotl(acc, 17) * _XXP4) & _M32
+        i += 4
+    while i < n:
+        acc = (acc + data[i] * _XXP5) & _M32
+        acc = (_rotl(acc, 11) * _XXP1) & _M32
+        i += 1
+    acc ^= acc >> 15
+    acc = (acc * _XXP2) & _M32
+    acc ^= acc >> 13
+    acc = (acc * _XXP3) & _M32
+    acc ^= acc >> 16
+    return acc
+
+
+def pyramid_hash(ids, lengths, weight, num_emb, space_len, pyramid_layer,
+                 rand_len, white_list=None, black_list=None):
+    """Hashed n-gram embedding (search_pyramid_hash,
+    pyramid_hash_op.cc:226 hash_embedding_ff): for every n-gram of length
+    2..pyramid_layer, XXH32(ngram_bytes, seed=m*rand_len) % space_len
+    picks the start row of chunk m in ``weight``
+    [space_len + rand_len, 1]; the num_emb-dim embedding is the
+    concatenation of num_emb//rand_len such chunks.
+
+    Padded form: ``ids`` [B, L] (float32 ids, hashed by their BYTES like
+    the reference), ``lengths`` [B]; returns
+    (out [B, G, num_emb], ngram_counts [B]) with G = the max n-gram
+    count; rows beyond a sample's count are zero.  White/black lists are
+    explicit id-tuple sets (the reference stores the same membership in
+    bloom filters)."""
+    assert num_emb % rand_len == 0, "num_emb must be a multiple of rand_len"
+    w = to_tensor_like(weight)
+    ids_np = np.asarray(getattr(ids, "numpy", lambda: ids)(), np.float32)
+    lens = np.asarray(getattr(lengths, "numpy", lambda: lengths)(),
+                      np.int64).reshape(-1)
+    B, L = ids_np.shape
+    chunks = num_emb // rand_len
+
+    per_sample = []
+    counts = []
+    for b in range(B):
+        wlen = int(lens[b])
+        rows = []
+        for ilayer in range(1, pyramid_layer):
+            for l in range(wlen - ilayer):
+                gram = ids_np[b, l:l + ilayer + 1]
+                key = tuple(gram.astype(np.int64).tolist())
+                if white_list is not None and key not in white_list:
+                    continue
+                if black_list is not None and key in black_list:
+                    continue
+                data = gram.tobytes()
+                rows.append([_xxh32(data, m * rand_len) % space_len
+                             for m in range(chunks)])
+        counts.append(len(rows))
+        per_sample.append(rows)
+    G = max(counts) if counts else 0
+    G = max(G, 1)
+    pos = np.zeros((B, G, chunks), np.int32)
+    mask = np.zeros((B, G), np.float32)
+    for b, rows in enumerate(per_sample):
+        for g, r in enumerate(rows):
+            pos[b, g] = r
+            mask[b, g] = 1.0
+
+    def f(wv):
+        wv = wv.reshape(-1)
+        # chunk m of gram g = weight[pos : pos + rand_len]
+        offs = jnp.arange(rand_len)[None, None, None, :]
+        gathered = wv[jnp.asarray(pos)[..., None] + offs]  # [B,G,chunks,rand]
+        out = gathered.reshape(B, G, num_emb)
+        return out * jnp.asarray(mask)[..., None]
+
+    out = apply("pyramid_hash", f, w)
+    return out, np.asarray(counts, np.int64)
+
+
+def bilateral_slice(x, guide, grid, has_offset=False):
+    """HDRNet bilateral-grid slice-and-apply (bilateral_slice_op.cu:54):
+    per pixel, trilinearly sample the affine-coefficient grid at
+    ((x+.5)/W*gw, (y+.5)/H*gh, guide*gd) — the z tent uses the smoothed
+    |.| (sqrt(d^2+1e-8), DiffAbs) exactly like the reference — and apply
+    the sampled affine transform to the input channels.
+
+    x [B, C, H, W]; guide [B, H, W] in [0, 1];
+    grid [B, Cg, gd, gh, gw] with Cg = out_c*(C+1) when ``has_offset``
+    else out_c*C.  Returns [B, out_c, H, W].  Fully vectorized: 8 static
+    corner gathers + one einsum, differentiable through x, guide, grid."""
+    xt = to_tensor_like(x)
+    gt = to_tensor_like(guide)
+    bg = to_tensor_like(grid)
+
+    def f(v, gd_, g):
+        B, C, H, W = v.shape
+        Cg, D, GH, GW = g.shape[1], g.shape[2], g.shape[3], g.shape[4]
+        stride = C + 1 if has_offset else C
+        out_c = Cg // stride
+        gx = (jnp.arange(W) + 0.5) * GW / W                  # [W]
+        gy = (jnp.arange(H) + 0.5) * GH / H                  # [H]
+        gz = gd_ * D                                         # [B, H, W]
+        gxb = jnp.broadcast_to(gx[None, None, :], (B, H, W))
+        gyb = jnp.broadcast_to(gy[None, :, None], (B, H, W))
+        fx = jnp.floor(gxb - 0.5).astype(jnp.int32)
+        fy = jnp.floor(gyb - 0.5).astype(jnp.int32)
+        fz = jnp.floor(gz - 0.5).astype(jnp.int32)
+        gT = jnp.transpose(g, (0, 2, 3, 4, 1))               # [B,D,GH,GW,Cg]
+        bidx = jnp.arange(B)[:, None, None]
+        coeff = jnp.zeros((B, H, W, Cg), v.dtype)
+        for dx in (0, 1):
+            xx = fx + dx
+            x_ = jnp.clip(xx, 0, GW - 1)
+            wx = jnp.maximum(1.0 - jnp.abs(xx + 0.5 - gxb), 0.0)
+            for dy in (0, 1):
+                yy = fy + dy
+                y_ = jnp.clip(yy, 0, GH - 1)
+                wy = jnp.maximum(1.0 - jnp.abs(yy + 0.5 - gyb), 0.0)
+                for dz in (0, 1):
+                    zz = fz + dz
+                    z_ = jnp.clip(zz, 0, D - 1)
+                    dzc = zz + 0.5 - gz
+                    wz = jnp.maximum(
+                        1.0 - jnp.sqrt(dzc * dzc + 1e-8), 0.0)
+                    w8 = (wx * wy * wz)[..., None]
+                    coeff = coeff + gT[bidx, z_, y_, x_] * w8
+        coeff = coeff.reshape(B, H, W, out_c, stride)
+        vin = jnp.transpose(v, (0, 2, 3, 1))                 # [B,H,W,C]
+        out = jnp.einsum("bhwoc,bhwc->bhwo", coeff[..., :C], vin)
+        if has_offset:
+            out = out + coeff[..., C]
+        return jnp.transpose(out, (0, 3, 1, 2))
+
+    return apply("bilateral_slice", f, xt, gt, bg)

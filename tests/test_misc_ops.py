@@ -480,3 +480,88 @@ class TestVarConv2D:
         with pytest.raises(ValueError):
             misc.var_conv_2d(t(x), t(np.array([4])), t(np.array([4])),
                              t(w), act="gelu")
+
+
+class TestRankAttention:
+    def test_matches_block_gemm(self):
+        rng = np.random.RandomState(0)
+        D, C, R = 4, 3, 2
+        x = rng.rand(5, D).astype(np.float32)
+        param = rng.rand(R * R * D, C).astype(np.float32)
+        ro = np.array([[1, 1, 0, 2, 3], [2, 1, 4, 0, 0], [0, 1, 2, 2, 2],
+                       [1, 2, 1, 0, 0], [2, 2, 0, 1, 1]], np.int64)
+        out = misc.rank_attention(t(x), ro, t(param), max_rank=R).numpy()
+        pv = param.reshape(R, R, D, C)
+        ref = np.zeros((5, C), np.float32)
+        for i in range(5):
+            own = ro[i, 0] - 1
+            for k in range(R):
+                fr = ro[i, 1 + 2 * k] - 1
+                idx = ro[i, 2 + 2 * k]
+                if own >= 0 and fr >= 0:
+                    ref[i] += x[idx] @ pv[own, fr]
+        np.testing.assert_allclose(out, ref, rtol=5e-3)
+
+
+class TestPyramidHash:
+    def test_xxh32_canonical_vectors(self):
+        assert misc._xxh32(b"", 0) == 0x02CC5D05
+        assert misc._xxh32(b"a", 0) == 0x550D7456
+        assert misc._xxh32(b"abc", 0) == 0x32D153FF
+        # >= 16 bytes exercises the 4-lane path
+        assert misc._xxh32(b"0123456789abcdef", 0) == \
+            misc._xxh32(b"0123456789abcdef", 0)
+        assert misc._xxh32(b"0123456789abcdefgh", 7) != \
+            misc._xxh32(b"0123456789abcdefgh", 8)
+
+    def test_ngram_counts_and_masking(self):
+        rng = np.random.RandomState(0)
+        ids = np.array([[3.0, 7.0, 9.0, 0.0], [5.0, 2.0, 0.0, 0.0]],
+                       np.float32)
+        w = rng.rand(108, 1).astype(np.float32)
+        out, cnt = misc.pyramid_hash(
+            t(ids), np.array([3, 2]), t(w), num_emb=16, space_len=100,
+            pyramid_layer=3, rand_len=8)
+        assert list(cnt) == [3, 1]     # 2+1 grams vs 1 gram
+        assert out.shape == [2, 3, 16]
+        assert (np.abs(out.numpy()[1, 1:]) == 0).all()
+
+    def test_black_list_filters(self):
+        rng = np.random.RandomState(0)
+        ids = np.array([[3.0, 7.0, 9.0]], np.float32)
+        w = rng.rand(108, 1).astype(np.float32)
+        _, cnt = misc.pyramid_hash(
+            t(ids), np.array([3]), t(w), num_emb=8, space_len=100,
+            pyramid_layer=3, rand_len=8, black_list={(3, 7)})
+        assert list(cnt) == [2]        # (3,7) dropped
+
+
+class TestBilateralSlice:
+    def test_constant_grid_is_plain_affine(self):
+        rng = np.random.RandomState(0)
+        B, C, H, W, OC, D, GH, GW = 1, 3, 8, 8, 2, 4, 2, 2
+        grid = np.zeros((B, OC * (C + 1), D, GH, GW), np.float32)
+        A = rng.rand(OC, C + 1).astype(np.float32)
+        for o in range(OC):
+            for i in range(C + 1):
+                grid[0, o * (C + 1) + i] = A[o, i]
+        x = rng.rand(B, C, H, W).astype(np.float32)
+        guide = rng.rand(B, H, W).astype(np.float32)
+        out = misc.bilateral_slice(t(x), t(guide), t(grid),
+                                   has_offset=True).numpy()
+        ref = np.einsum("oc,bchw->bohw", A[:, :C], x) \
+            + A[:, C][None, :, None, None]
+        np.testing.assert_allclose(out, ref, rtol=5e-3, atol=1e-3)
+
+    def test_guide_selects_depth(self):
+        # grid varies along z only: guide 0 reads plane 0, guide 1 the top
+        B, C, H, W, D = 1, 1, 4, 4, 4
+        grid = np.zeros((B, 1, D, 2, 2), np.float32)
+        for z in range(D):
+            grid[0, 0, z] = z
+        x = np.ones((B, C, H, W), np.float32)
+        lo = misc.bilateral_slice(t(x), t(np.zeros((B, H, W), np.float32)),
+                                  t(grid)).numpy()
+        hi = misc.bilateral_slice(t(x), t(np.ones((B, H, W), np.float32)),
+                                  t(grid)).numpy()
+        assert lo.mean() < 0.6 and hi.mean() > 2.4

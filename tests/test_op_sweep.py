@@ -924,3 +924,23 @@ OVERRIDES.update({
                      t(fmat(rng, 2, 2, 3, 3))],
         grad_args=[0, 3], rtol=9e-2),
 })
+
+OVERRIDES.update({
+    "misc.rank_attention": Spec(
+        lambda rng: [t(fmat(rng, 4, 3)),
+                     np.asarray([[1, 1, 0, 2, 3], [2, 1, 2, 0, 0],
+                                 [1, 2, 1, 1, 3], [2, 2, 0, 1, 1]],
+                                np.int64),
+                     t(fmat(rng, 2 * 2 * 3, 2))],
+        kwargs={"max_rank": 2}, grad_args=[0, 2], rtol=8e-2),
+    "misc.pyramid_hash": Spec(
+        lambda rng: [t(np.asarray([[3.0, 7.0, 9.0, 0.0]], np.float32)),
+                     np.asarray([3], np.int64),
+                     t(fmat(rng, 108, 1))],
+        kwargs={"num_emb": 16, "space_len": 100, "pyramid_layer": 3,
+                "rand_len": 8}, **NOGRAD),
+    "misc.bilateral_slice": Spec(
+        lambda rng: [t(fmat(rng, 1, 2, 6, 6)), t(fmat(rng, 1, 6, 6)),
+                     t(fmat(rng, 1, 2 * 3, 3, 2, 2))],
+        kwargs={"has_offset": True}, grad_args=[0, 2], rtol=9e-2),
+})

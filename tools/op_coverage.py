@@ -17,8 +17,9 @@ Categories:
   vendor    — CUDA/TensorRT/Lite/NCCL/BKCL/Ascend-specific; no TPU
               meaning (XLA/libtpu own the corresponding concern)
   test-only — fixture ops registered by the reference's own unit tests
-  niche     — deprecated contrib op with no public 2.x python surface;
-              the recipe column says how to compose it if ever needed
+
+The former "niche" category (contrib ops kept as recipes) was emptied
+in round 5: every one of those ops is now implemented ("ours").
 """
 from __future__ import annotations
 
@@ -77,11 +78,6 @@ def _v(reason, *names):
 def _t(reason, *names):
     for n in names:
         M[n] = ("test-only", reason)
-
-
-def _n(recipe, *names):
-    for n in names:
-        M[n] = ("niche", recipe)
 
 
 # --- optimizers (optimizer/optimizer.py applies the update rule; no
@@ -291,15 +287,13 @@ _t("reference-test fixture op",
    "indicate_selected_rows_data_type_test", "sum_without_infer_var_type")
 
 # --- contrib niche (deprecated, no public 2.x surface) -----------------
-_n("HDRNet bilateral-grid slice (contrib): grid_sample composition",
-   "bilateral_slice")
+_o("paddle_tpu.ops.misc.bilateral_slice", "bilateral_slice")
 _o("paddle_tpu.ops.misc.correlation", "correlation")
-_n("CTR rank-block attention (CUDA contrib): gather per-rank W + "
-   "misc.batch_fc", "rank_attention")
+_o("paddle_tpu.ops.misc.rank_attention", "rank_attention")
 _o("paddle_tpu.nn.functional.extension.filter_by_instag",
    "filter_by_instag")
 _o("paddle_tpu.ops.misc.tree_conv", "tree_conv")
-_n("hash-embedding text matcher (contrib)", "pyramid_hash")
+_o("paddle_tpu.ops.misc.pyramid_hash", "pyramid_hash")
 _o("paddle_tpu.ops.misc.match_matrix_tensor", "match_matrix_tensor")
 _o("paddle_tpu.ops.misc.var_conv_2d", "var_conv_2d")
 _o("paddle_tpu.nn.functional.extension.teacher_student_sigmoid_loss",
