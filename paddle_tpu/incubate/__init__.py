@@ -5,6 +5,14 @@ from . import checkpoint  # noqa: F401
 from . import optimizer, reader, segment  # noqa: F401
 from .segment import (segment_max, segment_mean, segment_min,  # noqa: F401
                       segment_sum)
+# contrib-layer analogs (reference fluid/contrib/layers/nn.py exposes
+# these op surfaces; here they live on ops.misc / ops.detection)
+from ..ops.detection import locality_aware_nms, matrix_nms  # noqa: F401
+from ..ops.misc import (batch_fc, bilateral_slice,  # noqa: F401
+                        correlation, match_matrix_tensor, partial_concat,
+                        partial_sum, pyramid_hash, rank_attention,
+                        sequence_topk_avg_pooling, shuffle_batch,
+                        tree_conv, var_conv_2d)
 
 
 class LayerHelper:
