@@ -1,8 +1,11 @@
 """Registry-level op coverage audit (SURVEY §2 row 29).
 
-The reference registers 406 distinct forward op types in C++
-(REGISTER_OPERATOR / REGISTER_OP_*_KERNEL across paddle/fluid — snapshot
-in tools/ref_op_registry.txt).  This tool maps EVERY one of them to its
+The reference registers 640 distinct op type names in C++
+(REGISTER_OPERATOR / REGISTER_OP_*_KERNEL across paddle/fluid); 234 of
+those are `*_grad`/`*_grad_grad` pairs — hand-written backward kernels
+that need no analog here because every forward op is jax-differentiable
+(the op sweep checks numeric-vs-analytic grads directly).  The 406
+FORWARD op types are the snapshot in tools/ref_op_registry.txt.  This tool maps EVERY one of them to its
 analog here and emits docs/OP_COVERAGE.md; tests/test_op_coverage.py
 asserts the map is total and that every claimed target actually resolves.
 
