@@ -16,6 +16,10 @@ The supported subset (the reference's common cases):
   normalized by absorbing the trailing statements into the else branch.
 - ``while`` with tensor-carried locals (no break/continue/return inside).
 - ``for <name> in range(...)`` (converted to a counted while).
+- ``and`` / ``or`` / ``not`` over tensor conditions (reference
+  logical_transformer.py): concrete operands keep Python's exact
+  short-circuit and value-returning semantics; traced operands lower to
+  logical_and/or/not.
 
 Traced (tensor-bound) loops are forward/inference constructs: XLA cannot
 reverse-differentiate a dynamic trip count (lax.while_loop), the same
@@ -194,6 +198,42 @@ def convert_for_range(range_args, body_fn, args, names=None):
     outs = convert_while(wcond, wbody, (i0,) + tuple(args),
                          names=("<range index>",) + tuple(names or ()))
     return tuple(outs[1:])
+
+
+def _convert_logical(fx, fy, short_circuit_on, jop_name):
+    """Shared body of the rewritten ``and``/``or`` (reference
+    convert_operators.py _run_py_logical_*).  Concrete left operand keeps
+    exact Python semantics: short-circuit included, the OPERAND VALUE
+    returned (never a bool cast) — so `cfg or x` still yields x itself.
+    Only a traced LEFT operand lowers to the elementwise logical op
+    (both sides evaluate: XLA has no short circuit)."""
+    x = fx()
+    if not _needs_trace(x):
+        if bool(x) == short_circuit_on:
+            return x
+        return fy()
+    from ..ops import logic
+
+    return getattr(logic, jop_name)(x, fy())
+
+
+def convert_logical_and(fx, fy):
+    """Rewritten ``a and b``."""
+    return _convert_logical(fx, fy, False, "logical_and")
+
+
+def convert_logical_or(fx, fy):
+    """Rewritten ``a or b``."""
+    return _convert_logical(fx, fy, True, "logical_or")
+
+
+def convert_logical_not(x):
+    """Rewritten ``not a`` (reference convert_logical_not)."""
+    if not _needs_trace(x):
+        return not x
+    from ..ops.logic import logical_not
+
+    return logical_not(x)
 
 
 def _unwrap(x):
@@ -380,6 +420,63 @@ def _guards(operands, assigned) -> list:
         out.extend(_stmts(
             "{n} = {n} if {n!r} in dir() else {u}", n=n, u=_UNDEF_NAME))
     return out
+
+
+def _lambda0(body):
+    return ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                           kwonlyargs=[], kw_defaults=[], kwarg=None,
+                           defaults=[]),
+        body=body)
+
+
+_LAMBDA_UNSAFE = (ast.NamedExpr, ast.Yield, ast.YieldFrom, ast.Await)
+
+
+def _lambda_safe(node):
+    """Wrapping an operand in a zero-arg lambda re-scopes `:=` bindings
+    and strands `yield`s — leave such expressions untouched (they keep
+    the loud traced-bool error instead of silently misbehaving)."""
+    return not any(isinstance(n, _LAMBDA_UNSAFE) for n in ast.walk(node))
+
+
+class _BoolOpRewriter(ast.NodeTransformer):
+    """Expression pass: ``and``/``or``/``not`` over potentially-traced
+    values become runtime-dispatched converter calls (reference
+    logical_transformer.py).  Operands ride zero-arg lambdas so the
+    concrete path keeps Python's exact short-circuit + value semantics."""
+
+    def __init__(self):
+        self.count = 0
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        if not all(_lambda_safe(v) for v in node.values):
+            return node
+        name = ("convert_logical_and" if isinstance(node.op, ast.And)
+                else "convert_logical_or")
+        expr = node.values[0]
+        for v in node.values[1:]:
+            expr = ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id=_HELPER, ctx=ast.Load()),
+                    attr=name, ctx=ast.Load()),
+                args=[_lambda0(expr), _lambda0(v)], keywords=[])
+            self.count += 1
+        return ast.copy_location(expr, node)
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if not isinstance(node.op, ast.Not):
+            return node
+        if not _lambda_safe(node.operand):
+            return node
+        self.count += 1
+        return ast.copy_location(ast.Call(
+            func=ast.Attribute(
+                value=ast.Name(id=_HELPER, ctx=ast.Load()),
+                attr="convert_logical_not", ctx=ast.Load()),
+            args=[node.operand], keywords=[]), node)
 
 
 class _Converter:
@@ -579,6 +676,24 @@ class _Converter:
         return [*_guards(vs, assigned), r_assign, bfn, call]
 
 
+def _transform_fdef(fdef: ast.FunctionDef) -> int:
+    """The ONE transform pipeline (convert_function and
+    ProgramTranslator.get_code must agree): strip decorators, rewrite
+    bool ops, convert statements.  Returns the transform count."""
+    fdef.decorator_list = []
+    boolops = _BoolOpRewriter()
+    boolops.visit(fdef)
+    conv = _Converter(_collect_locals(fdef))
+    a = fdef.args
+    params = {x.arg for x in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        params.add(a.vararg.arg)
+    if a.kwarg:
+        params.add(a.kwarg.arg)
+    fdef.body = conv.transform_body(fdef.body, set(params))
+    return conv.count + boolops.count
+
+
 def convert_function(fn) -> Tuple[types.FunctionType, bool]:
     """AST-convert `fn` (reference ProgramTranslator.get_func).  Returns
     (converted, True) on success or (fn, False) when the function is out
@@ -597,16 +712,8 @@ def convert_function(fn) -> Tuple[types.FunctionType, bool]:
         fdef = tree.body[0]
         if not isinstance(fdef, ast.FunctionDef):
             raise TypeError("not a plain function")
-        fdef.decorator_list = []
-        conv = _Converter(_collect_locals(fdef))
-        a = fdef.args
-        params = {x.arg for x in a.posonlyargs + a.args + a.kwonlyargs}
-        if a.vararg:
-            params.add(a.vararg.arg)
-        if a.kwarg:
-            params.add(a.kwarg.arg)
-        fdef.body = conv.transform_body(fdef.body, set(params))
-        if conv.count:
+        n_transforms = _transform_fdef(fdef)
+        if n_transforms:
             ast.fix_missing_locations(tree)
             code = compile(tree, f"<dy2static:{fn.__qualname__}>", "exec")
             g = dict(fn.__globals__)
@@ -684,11 +791,7 @@ class ProgramTranslator:
         src = _textwrap.dedent(_inspect.getsource(fn))
         tree = _ast.parse(src)
         fdef = tree.body[0]
-        fdef.decorator_list = []
-        c = _Converter(_collect_locals(fdef))
-        a = fdef.args
-        params = {x.arg for x in a.posonlyargs + a.args + a.kwonlyargs}
-        fdef.body = c.transform_body(fdef.body, set(params))
+        _transform_fdef(fdef)
         _ast.fix_missing_locations(tree)
         return _ast.unparse(tree)
 

@@ -200,6 +200,14 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None)
             static = StaticFunction(fwd, input_spec, layer=obj)
             obj.forward = static
             return obj
+        if inspect.ismethod(obj):
+            # keep the instance binding: convert the underlying function
+            # and re-bind (to_static(model.forward) reference form)
+            conv, did = convert_function(obj.__func__)
+            bound = types.MethodType(conv, obj.__self__) if did else obj
+            return StaticFunction(bound, input_spec,
+                                  layer=obj.__self__ if isinstance(
+                                      obj.__self__, Layer) else None)
         conv, _ = convert_function(obj)
         return StaticFunction(conv, input_spec)
 

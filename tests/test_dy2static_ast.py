@@ -258,3 +258,115 @@ class TestConvertFunction:
         conv, _ = convert_function(f)
         x = np.asarray([1.0, 2.0, 3.0], np.float32)
         assert conv(x, 3) == f(x, 3) == 1.0 - 2.0 + 3.0
+
+
+class TestBoolOpConversion:
+    """``and``/``or``/``not`` over traced tensors rewrite onto
+    logical_and/or/not (reference logical_transformer.py +
+    convert_operators.convert_logical_*); concrete operands keep
+    Python's exact short-circuit + value-returning semantics."""
+
+    def test_traced_and_or_not_in_if(self):
+        @jit.to_static
+        def f(x, y):
+            if (x > 0 and y > 0) or not (x < 10):
+                return x + y
+            return x - y
+
+        assert float(f(_t(2.0), _t(3.0)).numpy()) == 5.0
+        assert float(f(_t(-2.0), _t(3.0)).numpy()) == -5.0
+        assert float(f(_t(11.0), _t(3.0)).numpy()) == 14.0
+
+    def test_concrete_value_semantics_preserved(self):
+        def g(flag):
+            calls = []
+
+            def boom():
+                calls.append(1)
+                return True
+
+            r1 = 0 and boom()      # short-circuit: boom never runs
+            r2 = 3 and 5           # returns the VALUE, not a bool
+            r3 = 0 or "x"
+            r4 = not flag
+            return r1, r2, r3, r4, calls
+
+        conv, did = convert_function(g)
+        assert did
+        assert conv(True) == (0, 5, "x", False, [])
+
+    def test_not_on_traced_while_condition(self):
+        @jit.to_static
+        def f(x):
+            i = paddle.to_tensor(0.0)
+            while not (i >= x):
+                i = i + 1.0
+            return i
+
+        assert float(f(_t(4.0)).numpy()) == 4.0
+
+    def test_mixed_concrete_tensor_and(self):
+        @jit.to_static
+        def f(x, use_gate):
+            if use_gate and x.sum() > 0:
+                return x * 2.0
+            return x
+
+        v = np.asarray([1.0, 2.0], np.float32)
+        np.testing.assert_allclose(f(_t(v), True).numpy(), v * 2,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(f(_t(v), False).numpy(), v, rtol=1e-6)
+
+    def test_to_static_on_bound_method(self):
+        # to_static(model.forward) must keep the instance binding
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 2)
+
+            def forward(self, x):
+                if x.mean() > 0 and not (x.std() < 1e-6):
+                    return self.fc(x) * 2.0
+                return self.fc(x)
+
+        net = Net()
+        f = jit.to_static(net.forward)
+        v = np.ones((3, 4), np.float32)
+        out = f(_t(v))
+        assert out.shape == [3, 2]
+        ref = net.fc(_t(v)).numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)  # std==0
+
+    def test_or_returns_operand_value_not_bool(self):
+        # review regression: `cfg or x` must yield x ITSELF when cfg is
+        # falsy-concrete and x is traced (never a bool cast)
+        @jit.to_static
+        def f(x):
+            cfg = None
+            w = cfg or x
+            return x * w
+
+        v = np.asarray([2.0, 3.0], np.float32)
+        np.testing.assert_allclose(f(_t(v)).numpy(), v * v, rtol=1e-6)
+
+    def test_and_returns_operand_value_not_bool(self):
+        @jit.to_static
+        def f(x):
+            scale = 2.0
+            s = scale and x
+            if x.sum() > 0 and not (x.sum() > 100):
+                return s + 1.0
+            return s
+
+        v = np.asarray([2.0, 3.0], np.float32)
+        np.testing.assert_allclose(f(_t(v)).numpy(), v + 1.0, rtol=1e-6)
+
+    def test_walrus_operand_left_untouched(self):
+        # review regression: := inside a bool op must not be re-scoped
+        def h(x):
+            if (n := x + 1) and n > 1:
+                return n
+            return 0
+
+        conv, did = convert_function(h)
+        assert conv(5) == 6
