@@ -1,0 +1,140 @@
+"""Focused coverage for two previously indirectly-tested surfaces:
+paddle_tpu.metric (Accuracy/Precision/Recall/Auc vs hand-computed
+values — reference python/paddle/metric/metrics.py) and
+paddle_tpu.onnx.export (export -> Predictor round trip incl.
+output_spec pruning — reference python/paddle/onnx/export.py)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import metric, nn
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        m = metric.Accuracy(topk=(1, 2))
+        pred = np.array([[0.1, 0.7, 0.2],
+                         [0.6, 0.3, 0.1],
+                         [0.2, 0.3, 0.5]], np.float32)
+        label = np.array([[1], [2], [2]], np.int64)
+        m.update(m.compute(t(pred), t(label)))
+        top1, top2 = m.accumulate()
+        assert top1 == pytest.approx(2 / 3)
+        assert top2 == pytest.approx(2 / 3)   # sample 1: label 2 ranks 3rd
+
+    def test_precision_recall_hand_values(self):
+        p = metric.Precision()
+        r = metric.Recall()
+        preds = np.array([0.9, 0.8, 0.2, 0.7], np.float32)
+        labels = np.array([1, 0, 1, 1], np.int64)
+        p.update(preds, labels)
+        r.update(preds, labels)
+        # predicted positive: 3 (0.9, 0.8, 0.7); tp = 2 -> P = 2/3
+        assert p.accumulate() == pytest.approx(2 / 3)
+        # actual positive: 3; fn = 1 (the 0.2) -> R = 2/3
+        assert r.accumulate() == pytest.approx(2 / 3)
+
+    def test_precision_recall_accumulate_across_batches(self):
+        p = metric.Precision()
+        p.update(np.array([0.9]), np.array([1]))
+        p.update(np.array([0.9]), np.array([0]))
+        assert p.accumulate() == pytest.approx(0.5)
+        p.reset()
+        assert p.accumulate() == 0.0
+
+    def test_auc_perfect_and_random(self):
+        m = metric.Auc()
+        pos = np.linspace(0.6, 0.99, 50)
+        neg = np.linspace(0.01, 0.4, 50)
+        m.update(np.concatenate([pos, neg]),
+                 np.concatenate([np.ones(50), np.zeros(50)]))
+        assert m.accumulate() == pytest.approx(1.0, abs=1e-3)
+        m.reset()
+        # identical score distributions -> AUC ~ 0.5
+        rng = np.random.RandomState(0)
+        s = rng.rand(2000)
+        m.update(s, (np.arange(2000) % 2))
+        assert m.accumulate() == pytest.approx(0.5, abs=0.05)
+
+
+class TestOnnxExport:
+    def _small_net(self):
+        paddle.seed(0)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 3)
+
+            def forward(self, x):
+                h = self.fc(x)
+                return h, paddle.nn.functional.softmax(h, axis=-1)
+
+        return Net()
+
+    def test_export_predictor_round_trip(self):
+        from paddle_tpu import inference, onnx
+        from paddle_tpu.static import InputSpec
+
+        net = self._small_net()
+        x = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+        want = net(t(x))[0].numpy()
+        with tempfile.TemporaryDirectory() as d:
+            prefix = onnx.export(
+                net, os.path.join(d, "m.onnx"),
+                input_spec=[InputSpec([2, 4], "float32", "x")])
+            assert os.path.exists(prefix + ".pdmodel")
+            cfg = inference.Config(prefix + ".pdmodel",
+                                   prefix + ".pdiparams")
+            pred = inference.create_predictor(cfg)
+            inp = pred.get_input_handle(pred.get_input_names()[0])
+            inp.copy_from_cpu(x)
+            pred.run()
+            outs = [pred.get_output_handle(n).copy_to_cpu()
+                    for n in pred.get_output_names()]
+            assert len(outs) == 2
+            np.testing.assert_allclose(outs[0], want, rtol=2e-3,
+                                       atol=1e-4)
+
+    def test_output_spec_prunes(self):
+        from paddle_tpu import inference, onnx
+        from paddle_tpu.static import InputSpec
+
+        net = self._small_net()
+        x = np.random.RandomState(1).rand(2, 4).astype(np.float32)
+        want_soft = net(t(x))[1].numpy()
+        with tempfile.TemporaryDirectory() as d:
+            prefix = onnx.export(
+                net, os.path.join(d, "m"),
+                input_spec=[InputSpec([2, 4], "float32", "x")],
+                output_spec=[1])            # keep only the softmax output
+            cfg = inference.Config(prefix + ".pdmodel",
+                                   prefix + ".pdiparams")
+            pred = inference.create_predictor(cfg)
+            inp = pred.get_input_handle(pred.get_input_names()[0])
+            inp.copy_from_cpu(x)
+            pred.run()
+            names = pred.get_output_names()
+            assert len(names) == 1
+            got = pred.get_output_handle(names[0]).copy_to_cpu()
+            np.testing.assert_allclose(got, want_soft, rtol=2e-3,
+                                       atol=1e-4)
+
+    def test_bad_output_spec_is_loud(self):
+        from paddle_tpu import onnx
+        from paddle_tpu.static import InputSpec
+
+        net = self._small_net()
+        with tempfile.TemporaryDirectory() as d:
+            with pytest.raises(ValueError):
+                onnx.export(net, os.path.join(d, "m"),
+                            input_spec=[InputSpec([2, 4], "float32",
+                                                  "x")],
+                            output_spec=["nonexistent_output"])
