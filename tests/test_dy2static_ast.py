@@ -370,3 +370,40 @@ class TestBoolOpConversion:
 
         conv, did = convert_function(h)
         assert conv(5) == 6
+
+
+class TestGetCodeParity:
+    """ProgramTranslator.get_code must show EXACTLY what executes —
+    both paths run the one shared _transform_fdef pipeline (review
+    regression: the two pipelines had drifted)."""
+
+    def test_get_code_shows_boolop_converters(self):
+        from paddle_tpu.jit.dy2static import ProgramTranslator
+
+        def g(a, b):
+            return (a and b) or not a
+
+        code = ProgramTranslator.get_instance().get_code(g)
+        assert "convert_logical_and" in code
+        assert "convert_logical_or" in code
+        assert "convert_logical_not" in code
+
+    def test_get_code_matches_executed_transforms(self):
+        from paddle_tpu.jit.dy2static import (ProgramTranslator,
+                                              convert_function)
+
+        def h(x, *rest):
+            if x.sum() > 0:
+                y = x * 2.0
+            else:
+                y = -x
+            return y
+
+        conv, did = convert_function(h)
+        assert did
+        code = ProgramTranslator.get_instance().get_code(h)
+        # the displayed code carries the same converter the executed
+        # function was compiled with
+        assert "convert_ifelse" in code
+        out = conv(_t(np.asarray([1.0, 2.0], np.float32)))
+        np.testing.assert_allclose(out.numpy(), [2.0, 4.0], rtol=1e-6)
