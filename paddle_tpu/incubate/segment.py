@@ -14,13 +14,23 @@ from ..ops.dispatch import apply
 __all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min"]
 
 
-def _segment(op_name, jop, data, segment_ids):
+def _segment(op_name, jop, data, segment_ids, mask_empty=False):
     d = to_tensor_like(data)
     ids = to_tensor_like(segment_ids)
     n = int(jnp.max(ids._value)) + 1 if ids._value.size else 0
 
     def f(v, i):
-        return jop(v, i.astype(jnp.int32), num_segments=n)
+        i = i.astype(jnp.int32)
+        out = jop(v, i, num_segments=n)
+        if mask_empty:
+            # ids with gaps (e.g. [0, 0, 2, 2]): jax.ops.segment_max/min
+            # fill absent segments with -inf/+inf; the reference emits 0
+            cnt = jax.ops.segment_sum(jnp.ones((i.shape[0],), jnp.int32),
+                                      i, num_segments=n)
+            shape = (n,) + (1,) * (v.ndim - 1)
+            out = jnp.where(cnt.reshape(shape) > 0, out,
+                            jnp.zeros((), out.dtype))
+        return out
 
     return apply(op_name, f, d, ids)
 
@@ -46,8 +56,10 @@ def segment_mean(data, segment_ids, name=None):
 
 
 def segment_max(data, segment_ids, name=None):
-    return _segment("segment_max", jax.ops.segment_max, data, segment_ids)
+    return _segment("segment_max", jax.ops.segment_max, data, segment_ids,
+                    mask_empty=True)
 
 
 def segment_min(data, segment_ids, name=None):
-    return _segment("segment_min", jax.ops.segment_min, data, segment_ids)
+    return _segment("segment_min", jax.ops.segment_min, data, segment_ids,
+                    mask_empty=True)

@@ -59,6 +59,30 @@ class Config:
     def gpu_device_id(self):
         return self._device_id
 
+    # --- serving (paddle_tpu.serving continuous-batching engine) ------------
+    def enable_serving(self, max_batch_size=8, page_size=16, num_pages=None,
+                       max_seq_len=None, eos_id=0):
+        """Opt in to the continuous-batching serving engine
+        (docs/SERVING.md).  Stores the paged-KV / scheduler knobs; build
+        the engine with ``paddle_tpu.serving.create_serving_engine(model,
+        config)``.  Not reference API — the reference's serving story
+        stops at AnalysisPredictor; this is the TPU-native extension."""
+        self._serving = {
+            "max_batch_size": int(max_batch_size),
+            "page_size": int(page_size),
+            "num_pages": None if num_pages is None else int(num_pages),
+            "max_seq_len": None if max_seq_len is None else int(max_seq_len),
+            "eos_id": int(eos_id),
+        }
+
+    def serving_enabled(self) -> bool:
+        return getattr(self, "_serving", None) is not None
+
+    def serving_config(self) -> dict:
+        if not self.serving_enabled():
+            raise ValueError("serving not enabled — call enable_serving()")
+        return dict(self._serving)
+
     # --- optimization knobs (XLA-subsumed, kept for parity) -----------------
     def switch_ir_optim(self, flag=True):
         self._ir_optim = flag
@@ -90,4 +114,5 @@ class Config:
             "device": self._device,
             "ir_optim": self._ir_optim,
             "warmup": self._warmup,
+            "serving": getattr(self, "_serving", None),
         }

@@ -29,6 +29,16 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
                return_softmax=return_softmax)
 
 
+def paged_attention(query, key_pages, value_pages, page_tables, seq_lens,
+                    name=None):
+    """Decode-time ragged paged attention over a block-paged KV cache —
+    the serving engine's primitive (docs/SERVING.md); see
+    ops/attention.py for the full contract."""
+    from ...ops.attention import paged_attention as _pa
+
+    return _pa(query, key_pages, value_pages, page_tables, seq_lens)
+
+
 def ring_attention(query, key, value, axis_name="sp", causal=False, name=None):
     """Context-parallel attention over a mesh axis (sequence sharded).  New
     capability vs the reference — see distributed/ring_attention.py."""

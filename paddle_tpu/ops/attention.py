@@ -168,6 +168,34 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out
 
 
+def paged_attention(query, key_pages, value_pages, page_tables, seq_lens,
+                    name=None):
+    """Decode-time ragged paged attention over a block-paged KV cache
+    (the serving engine's attention primitive; see docs/SERVING.md).
+
+    query       [B, H, D]    one decode query per in-flight sequence
+    key_pages   [N, P, H, D] global K page pool (P = page size)
+    value_pages [N, P, H, D] global V page pool
+    page_tables [B, M] int32 per-sequence page ids (pad with 0, the
+                             reserved trash page)
+    seq_lens    [B] int32    valid KV length per sequence (0 = inactive)
+
+    Returns [B, H, D]; scale 1/sqrt(D) applied internally.  Routes to the
+    Pallas ragged paged-attention kernel on TPU
+    (ops/pallas_ops/paged_attention.py) and to the exact XLA gather
+    reference elsewhere; PADDLE_TPU_FORCE_PAGED=1 forces the kernel in
+    interpret mode for testing.
+    """
+    from .pallas_ops.paged_attention import paged_attention as _core
+
+    q = to_tensor_like(query)
+    kp = to_tensor_like(key_pages)
+    vp = to_tensor_like(value_pages)
+    pt = to_tensor_like(page_tables)
+    sl = to_tensor_like(seq_lens)
+    return apply("paged_attention", _core, q, kp, vp, pt, sl)
+
+
 def _pallas_ok(q, k=None) -> bool:
     """Route to the Pallas kernel: on TPU (or when forced for testing), with
     self-attention-shaped inputs and an MXU-representable head_dim.  Sequence

@@ -1358,6 +1358,9 @@ def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
     ``return_index``, the flat candidate indices."""
     b = to_tensor_like(bboxes)
     s = to_tensor_like(scores)
+    # pixel-coordinate (+1) convention when not normalized, matching
+    # multiclass_nms / iou_similarity
+    off = 0.0 if normalized else 1.0
 
     def f(boxes, sc):
         C, N = sc.shape
@@ -1368,7 +1371,7 @@ def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
                                -jnp.inf)
             vals, idx = jax.lax.top_k(masked, top)   # sorted desc
             cand = boxes[idx]
-            iou = _pairwise_iou(cand, cand)
+            iou = _pairwise_iou(cand, cand, offset=off)
             # upper triangle: row i = suppressor, col j = suppressed
             tri = jnp.triu(iou, k=1)
             max_iou = tri.max(axis=0)   # each candidate's own worst overlap
@@ -1428,6 +1431,19 @@ def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
     bboxes [M, 4], scores [1, M] or [M]; returns the multiclass_nms
     fixed slate ([keep_top_k, 6], count).  Merged scores accumulate
     member evidence UNCAPPED (EAST ranks clusters by total support).
+
+    .. warning:: **Score-scale divergence from the reference op.**  The
+       reference merges mutually-overlapping boxes sequentially
+       (adjacent, order-dependent) and its output scores stay in the
+       input score scale.  This global IoU-matrix formulation instead
+       emits, for every member of an overlapping cluster, a merged box
+       carrying the cluster's SUMMED member score — so output scores can
+       exceed 1.0 and grow with cluster size.  Rankings are preserved
+       (more support == higher score), but any downstream logic that
+       applies an absolute ``score_threshold`` to the OUTPUT must be
+       recalibrated.  Divide by the per-cluster member count if you need
+       input-scale scores.
+
     ``nms_eta`` adaptive thresholding is not expressed in the fixed-slate
     NMS — pass 1.0 (the reference default)."""
     if nms_eta != 1.0:

@@ -602,7 +602,11 @@ def sequence_topk_avg_pooling(x, row_lengths, col_lengths, topks,
         s = -jnp.sort(-masked, axis=-1)          # desc per row
         s = jnp.where(jnp.isfinite(s), s, 0.0)   # absent cols add 0
         csum = jnp.cumsum(s, axis=-1)
-        outs = [csum[..., k - 1] / k for k in topks]    # [B, C, R] each
+        # a top-k beyond the padded width would index out of bounds at
+        # trace time; clamp the cumsum index — absent columns already
+        # contribute 0, and the divisor stays the full k (reference
+        # :163-165 semantics)
+        outs = [csum[..., min(k, Cc) - 1] / k for k in topks]  # [B, C, R]
         out = jnp.stack(outs, axis=-1)           # [B, C, R, K]
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(B, R, -1)
         row_valid = jnp.arange(R)[None, :] < rlen[:, None]

@@ -1,0 +1,211 @@
+"""Ragged paged-attention Pallas kernel (TPU) — decode-time attention over
+a block-paged KV cache.
+
+Kernel recipe after "Ragged Paged Attention: A High-Performance and
+Flexible LLM Inference Kernel for TPU" (PAPERS.md): each in-flight
+sequence owns a *page table* — a row of page ids into a global pool of
+fixed-size KV pages — and attention streams exactly the pages a sequence
+owns, masked to its true (ragged) length.  Kept deliberately small and
+composable (Tensor Processing Primitives style) next to
+``flash_attention.py``: one decode query per sequence, online-softmax
+accumulation page by page.
+
+TPU mechanics: ``pltpu.PrefetchScalarGridSpec`` prefetches the page
+tables + sequence lengths into SMEM so the BlockSpec ``index_map`` can
+pick which physical KV page to DMA for grid cell (b, i) — the kernel
+never materializes a gathered [B, S, H, D] KV copy (the XLA fallback
+below does exactly that, which is why it loses at scale).  Pages past a
+sequence's length are skipped with ``pl.when`` (ragged early-out), so
+decode cost is proportional to real tokens, not to the padded page
+count.
+
+Page-table convention (shared with serving/kv_cache.py): page id 0 is a
+reserved trash page — padding entries point at it and masked/inactive
+lanes scatter into it — so every page-table entry is always a valid
+index and the kernel needs no bounds checks.
+
+CPU story: interpret mode runs the very same kernel under
+``JAX_PLATFORMS=cpu`` (tier-1 tests); the default CPU *routing* choice
+is the exact XLA gather reference, the kernel is forced with
+``PADDLE_TPU_FORCE_PAGED=1``.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+# trace-time routing telemetry, mirroring ops/attention.py ROUTE_STATS
+PAGED_ROUTE_STATS = {"pallas": 0, "xla": 0}
+
+
+def _interpret_mode() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _compiler_params():
+    try:
+        return pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"))
+    except Exception:  # param name drift across jax versions
+        return None
+
+
+def _decode_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_sc, m_sc, l_sc, *, scale, page_size, num_pages_grid):
+    """Grid (B, max_pages_per_seq), pages innermost: per sequence b the
+    kernel visits its pages in order, keeping flash-style running
+    max/denominator in VMEM scratch; the page to DMA was chosen by the
+    index_map from the prefetched page table."""
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    seq_len = sl_ref[b]
+
+    # ragged early-out: pages entirely past the sequence length do no work
+    @pl.when(i * page_size < seq_len)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale          # [H, D]
+        k = k_ref[0].astype(jnp.float32)                  # [P, H, D]
+        v = v_ref[0].astype(jnp.float32)
+        # per-head q·k over the page: batch H, contract D -> [H, P]
+        s = jax.lax.dot_general(q, k, (((1,), (2,)), ((0,), (1,))),
+                                preferred_element_type=jnp.float32)
+        H = q.shape[0]
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (H, page_size), 1)
+        s = jnp.where(pos < seq_len, s, NEG_INF)
+        m_prev = m_sc[:, :1]                              # [H, 1]
+        l_prev = l_sc[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # p [H, P] @ v [P, H, D]: batch H, contract P -> [H, D]
+        acc_sc[:] = acc_sc[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
+
+    @pl.when(i == num_pages_grid - 1)
+    def _write():
+        # empty sequences (seq_len == 0, e.g. padded batch lanes) have
+        # l == 0 and write exact zeros — the engine masks those lanes
+        l_safe = jnp.maximum(l_sc[:, :1], 1e-30)
+        o_ref[0] = (acc_sc[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_attention_kernel(q, k_pages, v_pages, page_tables, seq_lens,
+                           *, interpret=None):
+    """The Pallas kernel proper (interpret mode off-TPU unless forced).
+
+    q           [B, H, D]   one decode query per sequence
+    k_pages     [N, P, H, D] global K page pool (page_size = P)
+    v_pages     [N, P, H, D] global V page pool
+    page_tables [B, M] int32 page ids per sequence (pad with 0)
+    seq_lens    [B] int32    valid KV length per sequence (0 = inactive)
+
+    Returns [B, H, D]; softmax scale 1/sqrt(D) is applied internally.
+    """
+    B, H, D = q.shape
+    page_size = k_pages.shape[1]
+    max_pages = page_tables.shape[1]
+    # the softmax temperature comes from the REAL head_dim — computed
+    # before any tile padding so the padded kernel is numerically
+    # identical to the unpadded one (zero-padded D lanes add 0 to q·k)
+    scale = 1.0 / math.sqrt(D)
+    page_tables = page_tables.astype(jnp.int32)
+    seq_lens = seq_lens.astype(jnp.int32)
+
+    # mosaic wants the trailing block dims (H, D) tile-aligned on real
+    # TPU; pad unconditionally (cheap — decode arrays are small) so the
+    # CPU interpret tests exercise the exact same padded path as TPU
+    Hp = ((H + 7) // 8) * 8
+    Dp = 128 if D <= 128 else ((D + 127) // 128) * 128
+    if Hp != H or Dp != D:
+        q = jnp.pad(q, ((0, 0), (0, Hp - H), (0, Dp - D)))
+        k_pages = jnp.pad(k_pages,
+                          ((0, 0), (0, 0), (0, Hp - H), (0, Dp - D)))
+        v_pages = jnp.pad(v_pages,
+                          ((0, 0), (0, 0), (0, Hp - H), (0, Dp - D)))
+    Bq, Hq, Dq = q.shape
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,        # page_tables, seq_lens
+        grid=(B, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, Hq, Dq), lambda b, i, pt, sl: (b, 0, 0)),
+            pl.BlockSpec((1, page_size, Hq, Dq),
+                         lambda b, i, pt, sl: (pt[b, i], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, Hq, Dq),
+                         lambda b, i, pt, sl: (pt[b, i], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, Dq), lambda b, i, pt, sl: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Hq, Dq), jnp.float32),
+            pltpu.VMEM((Hq, 128), jnp.float32),
+            pltpu.VMEM((Hq, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, page_size=page_size,
+                          num_pages_grid=max_pages),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hq, Dq), q.dtype),
+        compiler_params=_compiler_params(),
+        interpret=_interpret_mode() if interpret is None else interpret,
+    )(page_tables, seq_lens, q, k_pages, v_pages)
+    if Hq != H or Dq != D:
+        out = out[:, :H, :D]
+    return out
+
+
+def paged_attention_xla(q, k_pages, v_pages, page_tables, seq_lens):
+    """Exact XLA reference: gather the sequence's pages into a dense
+    [B, M*P, H, D] view and run masked attention.  O(B·M·P·H·D) memory
+    traffic per decode step — the thing the kernel exists to avoid — but
+    bit-exact f32 softmax math, so it is the default CPU route."""
+    B, H, D = q.shape
+    page_size = k_pages.shape[1]
+    M = page_tables.shape[1]
+    S = M * page_size
+    k = k_pages[page_tables].reshape(B, S, H, D)
+    v = v_pages[page_tables].reshape(B, S, H, D)
+    scale = 1.0 / math.sqrt(D)
+    s = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    valid = jnp.arange(S)[None, None, :] < seq_lens[:, None, None]
+    s = jnp.where(valid, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    ctx = jnp.einsum("bhs,bshd->bhd", p, v.astype(jnp.float32))
+    # empty lanes: all-masked softmax is uniform garbage -> pin to 0 to
+    # match the kernel's zero-initialised accumulator
+    ctx = jnp.where(seq_lens[:, None, None] > 0, ctx, 0.0)
+    return ctx.astype(q.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, page_tables, seq_lens):
+    """Routing entry (the serving decode step calls this): Pallas kernel
+    on TPU (or when PADDLE_TPU_FORCE_PAGED=1 forces interpret mode for
+    tests), exact XLA gather reference elsewhere."""
+    forced = os.environ.get("PADDLE_TPU_FORCE_PAGED") == "1"
+    if forced or jax.default_backend() == "tpu":
+        PAGED_ROUTE_STATS["pallas"] += 1
+        return paged_attention_kernel(q, k_pages, v_pages, page_tables,
+                                      seq_lens)
+    PAGED_ROUTE_STATS["xla"] += 1
+    return paged_attention_xla(q, k_pages, v_pages, page_tables, seq_lens)

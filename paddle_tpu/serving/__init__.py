@@ -1,0 +1,28 @@
+"""paddle_tpu.serving — continuous-batching LLM serving engine.
+
+The ROADMAP's "serve heavy traffic" subsystem: requests arrive over
+time, share TPU compute through a single continuously-batched decode
+step, and share KV memory through a block-paged cache (see
+docs/SERVING.md).
+
+Components
+----------
+- ``kv_cache.PagedKVCache``     host-side page-table manager over the
+                                global device page pools
+- ``scheduler.Scheduler``       admission / prefill-decode mixing /
+                                preemption / retirement policy
+- ``engine.ServingEngine``      synchronous core: add_request / step /
+                                drain driving the paged GPT decode step
+- ``metrics.ServingMetrics``    per-step observability through
+                                framework.monitor's StatRegistry
+
+The attention primitive lives with the other Pallas kernels
+(ops/pallas_ops/paged_attention.py, routed via ops/attention.py).
+"""
+from .engine import ServingEngine, create_serving_engine
+from .kv_cache import PagedKVCache
+from .metrics import ServingMetrics
+from .scheduler import Request, Scheduler, Sequence
+
+__all__ = ["ServingEngine", "create_serving_engine", "PagedKVCache",
+           "ServingMetrics", "Request", "Scheduler", "Sequence"]

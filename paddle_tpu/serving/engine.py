@@ -1,0 +1,291 @@
+"""ServingEngine — the synchronous continuous-batching core.
+
+``add_request`` enqueues, ``step`` runs one scheduler iteration
+(admission + prefill, then one decode position for every running
+sequence), ``drain`` steps until idle.  Synchronous by design: each step
+issues one jitted device program and one small host transfer (the next
+token per lane); an async server front-end can drive ``step`` from its
+own loop without this module growing threads.
+
+Execution model
+---------------
+- The paged GPT decode step comes from
+  ``text.generation.make_gpt_paged_decode_step`` — same math as the
+  dense ``make_gpt_decode_step`` (the parity anchor), but KV lives in
+  the global page pools and attention goes through
+  ``ops.attention.paged_attention``.
+- The decode batch is padded to the scheduler's bucket, so jax.jit
+  RETRACES ONLY ON BUCKET CHANGE — admissions and retirements inside a
+  bucket reuse the compiled program.  Prefill is likewise bucketed by
+  prompt length (next power of two).
+- Inactive lanes carry pos=0 and an all-zero page table: their scatter
+  lands in the reserved trash page 0 and their logits are discarded on
+  host, so no per-lane branching exists on device.
+- Greedy decoding only (argmax happens on device; only [bucket] int32
+  next-tokens cross to host per step).  Output is token-identical to
+  ``text.generation.generate(decode_strategy="greedy")``.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.profiler import RecordEvent
+from .kv_cache import PagedKVCache
+from .metrics import ServingMetrics
+from .scheduler import Request, Scheduler, Sequence
+
+__all__ = ["ServingEngine", "create_serving_engine"]
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class ServingEngine:
+    """Continuous-batching serving over a paged KV cache."""
+
+    def __init__(self, model, *, page_size: int = 16,
+                 num_pages: Optional[int] = None,
+                 max_batch_size: int = 8,
+                 max_seq_len: Optional[int] = None,
+                 bucket_sizes: Optional[List[int]] = None,
+                 eos_id: int = 0,
+                 metrics: Optional[ServingMetrics] = None):
+        from ..text.generation import make_gpt_paged_decode_step
+
+        self.model = model
+        self.page_size = int(page_size)
+        model_max = int(model.wpe.weight.shape[0])
+        self.max_seq_len = int(max_seq_len) if max_seq_len else model_max
+        if self.max_seq_len > model_max:
+            raise ValueError(
+                f"max_seq_len ({self.max_seq_len}) exceeds the model's "
+                f"position table ({model_max})")
+        self.pages_per_seq = -(-self.max_seq_len // self.page_size)
+        if num_pages is None:
+            # roomy default: every slot can hold a full-length sequence
+            num_pages = max_batch_size * self.pages_per_seq + 1
+        self.cache = PagedKVCache(num_pages, self.page_size,
+                                  self.pages_per_seq)
+        self.scheduler = Scheduler(self.cache, max_batch_size,
+                                   bucket_sizes=bucket_sizes)
+        self.metrics = metrics or ServingMetrics()
+        self.eos_id = int(eos_id)
+        self.outputs: Dict[str, np.ndarray] = {}
+        self._ttft_recorded = set()      # per REQUEST, preemption-proof
+
+        step_fn, init_pages = make_gpt_paged_decode_step(
+            model, self.page_size, self.pages_per_seq)
+        self._kv = init_pages(num_pages)
+
+        def _decode(tokens, pos, page_tables, kv):
+            logits, kv = step_fn(tokens, pos, page_tables, kv)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv
+
+        def _prefill(tokens, positions, page_table_row, kv):
+            def body(carry, tp):
+                tok, p = tp
+                _, carry = step_fn(tok[None], p[None], page_table_row[None],
+                                   carry)
+                return carry, None
+
+            kv, _ = jax.lax.scan(body, kv, (tokens, positions))
+            return kv
+
+        # jit caches per shape: decode retraces per batch bucket, prefill
+        # per prompt-length bucket — both change rarely by construction.
+        # The kv pools are donated: self._kv is reassigned from the result
+        # right after each call, letting XLA alias the .at[].set update
+        # in place instead of copying every layer's page pool per token
+        # (platforms without donation support just warn and copy).
+        self._decode_jit = jax.jit(_decode, donate_argnums=(3,))
+        self._prefill_jit = jax.jit(_prefill, donate_argnums=(3,))
+
+    # --- request intake ---------------------------------------------------
+    def add_request(self, prompt, max_new_tokens: int = 32,
+                    request_id: Optional[str] = None) -> str:
+        """Enqueue a generation request; returns its id.  Non-blocking —
+        admission happens inside step() when a slot and pages are free."""
+        if hasattr(prompt, "numpy"):
+            prompt = prompt.numpy()
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.size + max_new_tokens > self.max_seq_len:
+            # mirror generate()'s guard: past the wpe table the position
+            # gather would silently clamp — degraded text with no error
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_seq_len "
+                f"({self.max_seq_len})")
+        # a request that could never fit even running ALONE would sit in
+        # the admission queue forever (nothing to preempt) — reject loudly
+        need = self.cache.pages_needed(prompt.size + max_new_tokens - 1)
+        cap = min(self.cache.num_pages - 1, self.pages_per_seq)
+        if need > cap:
+            raise ValueError(
+                f"request needs {need} KV pages (prompt {prompt.size} + "
+                f"{max_new_tokens} new tokens @ page_size "
+                f"{self.page_size}) but the cache caps a sequence at "
+                f"{cap} pages — raise num_pages or lower max_new_tokens")
+        req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
+                      request_id=request_id or "")
+        # a duplicate id would alias two live sequences onto one KV page
+        # table (cross-contaminated attention, double-free) — reject it
+        live = (req.request_id in self.outputs
+                or any(r.request_id == req.request_id
+                       for r in self.scheduler.waiting)
+                or any(s.seq_id == req.request_id
+                       for s in self.scheduler.running))
+        if live:
+            raise ValueError(
+                f"request_id {req.request_id!r} is already in flight or "
+                "has an unconsumed output")
+        self.scheduler.add(req)
+        return req.request_id
+
+    # --- prefill ----------------------------------------------------------
+    def _prefill_seq(self, seq: Sequence):
+        """Teacher-force prompt[:-1] through the paged cache.  The scan
+        length is bucketed (next pow2, capped at max_seq_len) so prompt
+        lengths share traces; padded steps write junk into the trash page
+        / to-be-overwritten slots and are never attended to."""
+        prompt = seq.request.prompt
+        n = prompt.size - 1
+        if n == 0:
+            return
+        bucket = min(_next_pow2(n), self.max_seq_len)
+        tokens = np.zeros((bucket,), np.int32)
+        tokens[:n] = prompt[:-1]
+        positions = np.arange(bucket, dtype=np.int32)
+        row = self.cache.page_table_row(seq.seq_id)
+        with RecordEvent("serving/prefill"):
+            self._kv = self._prefill_jit(jnp.asarray(tokens),
+                                         jnp.asarray(positions),
+                                         jnp.asarray(row), self._kv)
+
+    # --- one scheduler iteration -----------------------------------------
+    def step(self) -> dict:
+        """Admit + prefill waiting requests, then decode one token for
+        every running sequence.  Returns the step's stats."""
+        sched = self.scheduler
+        admitted = sched.admit()
+        for seq in admitted:
+            self._prefill_seq(seq)
+        self.metrics.on_admission(len(admitted))
+
+        tokens_emitted = 0
+        bucket = 0
+        decoded = 0
+        if sched.running:
+            preempted = sched.ensure_decode_pages()
+            if preempted:
+                self.metrics.on_preemption(len(preempted))
+            active = list(sched.running)
+            if active:
+                bucket = sched.bucket()
+                tokens = np.zeros((bucket,), np.int32)
+                pos = np.zeros((bucket,), np.int32)
+                tables = np.zeros((bucket, self.pages_per_seq), np.int32)
+                for i, seq in enumerate(active):
+                    tokens[i] = seq.next_token
+                    pos[i] = seq.pos
+                    tables[i] = self.cache.page_table_row(seq.seq_id)
+                with RecordEvent("serving/decode_step"):
+                    nxt, self._kv = self._decode_jit(
+                        jnp.asarray(tokens), jnp.asarray(pos),
+                        jnp.asarray(tables), self._kv)
+                    nxt = np.asarray(nxt)    # the step's one host sync
+                now = time.monotonic()
+                decoded = len(active)    # occupancy measured pre-retirement
+                for i, seq in enumerate(active):
+                    tok = int(nxt[i])
+                    if seq.first_token_time is None:
+                        seq.first_token_time = now
+                        if seq.seq_id not in self._ttft_recorded:
+                            self._ttft_recorded.add(seq.seq_id)
+                            self.metrics.on_first_token(
+                                seq.request.arrival_time, now)
+                    seq.generated.append(tok)
+                    seq.pos += 1
+                    seq.next_token = tok
+                    tokens_emitted += 1
+                    if (tok == self.eos_id
+                            or seq.num_generated
+                            >= seq.request.max_new_tokens):
+                        self.outputs[seq.seq_id] = np.asarray(
+                            seq.generated, np.int32)
+                        sched.finish(seq)
+                        # retirement is final: the id never reappears
+                        self._ttft_recorded.discard(seq.seq_id)
+                        self.metrics.on_completion()
+
+        self.metrics.on_step(
+            queue_depth=sched.queue_depth(),
+            # lanes actually decoded this step (pre-retirement), so a
+            # fully-occupied step whose sequences all finish still
+            # records occupancy 1.0, not 0
+            running=decoded if bucket else len(sched.running),
+            bucket=bucket, pages_in_use=self.cache.pages_in_use,
+            tokens_emitted=tokens_emitted)
+        return {
+            "admitted": len(admitted),
+            "running": len(sched.running),
+            "queue_depth": sched.queue_depth(),
+            "bucket": bucket,
+            "tokens_emitted": tokens_emitted,
+            "pages_in_use": self.cache.pages_in_use,
+        }
+
+    # --- run to completion ------------------------------------------------
+    def drain(self, max_steps: int = 100_000) -> Dict[str, np.ndarray]:
+        """Step until queue and batch are empty; returns (and takes
+        ownership of) all accumulated {request_id: generated tokens} —
+        a long-lived server must consume outputs (here or via
+        ``take_output``) or ``self.outputs`` grows without bound."""
+        steps = 0
+        while self.scheduler.has_work():
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"drain did not converge within {max_steps} steps")
+        out, self.outputs = self.outputs, {}
+        return out
+
+    def take_output(self, request_id: str):
+        """Pop one finished request's tokens (None if not finished) —
+        the streaming-server consumption path that keeps ``outputs``
+        bounded."""
+        return self.outputs.pop(request_id, None)
+
+    def stats(self) -> dict:
+        """Engine + cache + metrics snapshot."""
+        return {
+            "metrics": self.metrics.snapshot(),
+            "cache": self.cache.stats(self.scheduler.seq_lens()),
+            "preemptions": self.scheduler.num_preemptions,
+        }
+
+
+def create_serving_engine(model, config=None, **overrides) -> ServingEngine:
+    """Build a ServingEngine from an ``inference.Config`` on which
+    ``enable_serving()`` was called (the reference-style entry point);
+    kwargs override config values."""
+    kwargs = {}
+    if config is not None:
+        if not getattr(config, "serving_enabled", lambda: False)():
+            raise ValueError(
+                "config has serving disabled — call "
+                "Config.enable_serving(...) first")
+        kwargs.update(config.serving_config())
+    kwargs.update(overrides)
+    return ServingEngine(model, **kwargs)

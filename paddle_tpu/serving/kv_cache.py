@@ -1,0 +1,136 @@
+"""Block-paged KV-cache manager (host side).
+
+vLLM-style paging re-cut for the TPU execution model: the *device* side
+is a pair of global page pools per layer ([num_pages, page_size, H, D]
+jax arrays, owned by the engine and threaded functionally through the
+jitted decode step); this module owns the *host* bookkeeping — which
+physical page belongs to which sequence — as plain python/numpy so
+allocation never touches the device or triggers a retrace.
+
+Page id 0 is RESERVED as the trash page: it is never allocated, padding
+entries of every page-table row point at it, and masked/inactive batch
+lanes scatter into it.  Every page-table entry is therefore always a
+valid index — the kernel (ops/pallas_ops/paged_attention.py) needs no
+bounds checks, and the decode step needs no per-lane branching.
+
+Allocation is a LIFO free list (O(1) alloc/free, recently-freed pages
+are reused first which keeps the working set dense).  ``stats()``
+reports alloc/free counters, high-water mark, and internal
+fragmentation (allocated-but-unused tail slots), the only fragmentation
+kind paging admits — there is no external fragmentation to defrag, which
+is the point of fixed-size pages.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["PagedKVCache"]
+
+
+class PagedKVCache:
+    """Free-list page allocator + per-sequence page tables."""
+
+    def __init__(self, num_pages: int, page_size: int, pages_per_seq: int):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is the "
+                             "reserved trash page)")
+        if page_size < 1 or pages_per_seq < 1:
+            raise ValueError("page_size and pages_per_seq must be >= 1")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.pages_per_seq = int(pages_per_seq)
+        # LIFO free list; page 0 excluded (trash page)
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self._tables: Dict[str, List[int]] = {}
+        self.total_allocs = 0
+        self.total_frees = 0
+        self.peak_pages_in_use = 0
+
+    # --- capacity ---------------------------------------------------------
+    def pages_needed(self, num_tokens: int) -> int:
+        """Pages covering ``num_tokens`` KV positions."""
+        return max(0, -(-int(num_tokens) // self.page_size))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def num_seqs(self) -> int:
+        return len(self._tables)
+
+    def seq_pages(self, seq_id: str) -> int:
+        return len(self._tables.get(seq_id, ()))
+
+    # --- allocation -------------------------------------------------------
+    def allocate(self, seq_id: str, num_tokens: int) -> bool:
+        """Grow ``seq_id``'s page table to cover ``num_tokens`` positions.
+
+        All-or-nothing: returns False (no state change) when the free
+        list cannot supply the growth or the sequence would exceed
+        pages_per_seq — the scheduler then preempts or queues.
+        """
+        table = self._tables.get(seq_id)
+        have = len(table) if table is not None else 0
+        need = self.pages_needed(num_tokens) - have
+        if need <= 0:
+            return True
+        if have + need > self.pages_per_seq:
+            return False
+        if need > len(self._free):
+            # no phantom registration on failure: a rejected first
+            # allocation must leave no trace in num_seqs()/stats()
+            return False
+        if table is None:
+            table = self._tables[seq_id] = []
+        for _ in range(need):
+            table.append(self._free.pop())
+        self.total_allocs += need
+        self.peak_pages_in_use = max(self.peak_pages_in_use,
+                                     self.pages_in_use)
+        return True
+
+    def free(self, seq_id: str) -> int:
+        """Release all of ``seq_id``'s pages; returns the count."""
+        table = self._tables.pop(seq_id, None)
+        if not table:
+            return 0
+        self._free.extend(reversed(table))
+        self.total_frees += len(table)
+        return len(table)
+
+    # --- page-table export ------------------------------------------------
+    def page_table_row(self, seq_id: str) -> np.ndarray:
+        """[pages_per_seq] int32 row, padded with the trash page (0)."""
+        row = np.zeros((self.pages_per_seq,), np.int32)
+        table = self._tables.get(seq_id, ())
+        row[: len(table)] = table
+        return row
+
+    # --- observability ----------------------------------------------------
+    def stats(self, seq_lens: Optional[Dict[str, int]] = None) -> dict:
+        """Allocator stats; pass live ``{seq_id: valid_len}`` to also get
+        internal fragmentation (allocated slots minus used slots)."""
+        out = {
+            "num_pages": self.num_pages - 1,      # allocatable (sans trash)
+            "page_size": self.page_size,
+            "pages_in_use": self.pages_in_use,
+            "pages_free": self.free_pages,
+            "num_seqs": self.num_seqs(),
+            "total_allocs": self.total_allocs,
+            "total_frees": self.total_frees,
+            "peak_pages_in_use": self.peak_pages_in_use,
+            "utilization": self.pages_in_use / max(self.num_pages - 1, 1),
+        }
+        if seq_lens is not None:
+            frag = 0
+            for sid, table in self._tables.items():
+                used = int(seq_lens.get(sid, 0))
+                frag += len(table) * self.page_size - used
+            out["internal_fragmentation_slots"] = frag
+        return out

@@ -1,0 +1,92 @@
+"""Serving observability.
+
+Every engine step publishes gauges/counters into
+``framework.monitor.stat_registry`` (the reference's StatRegistry /
+STAT_ADD surface, so existing monitoring tooling sees serving stats with
+no new plumbing) under the ``serving.*`` namespace, and keeps float
+accumulators host-side for the derived rates ``snapshot()`` reports
+(tokens/sec, mean TTFT, mean batch occupancy).  Time-critical spans
+(prefill, decode step) are wrapped in ``utils.profiler.RecordEvent`` by
+the engine, so they show up in the profiler summary table and as XPlane
+trace scopes.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..framework.monitor import stat_registry
+
+__all__ = ["ServingMetrics"]
+
+
+class ServingMetrics:
+    """Aggregates per-step serving stats; ints mirror into StatRegistry."""
+
+    GAUGES = ("serving.queue_depth", "serving.running_seqs",
+              "serving.kv_pages_in_use", "serving.batch_bucket")
+    COUNTERS = ("serving.steps", "serving.tokens_generated",
+                "serving.requests_admitted", "serving.requests_completed",
+                "serving.preemptions")
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._start: Optional[float] = None
+        self._steps = 0
+        self._tokens = 0
+        self._occupancy_sum = 0.0
+        self._ttft_sum = 0.0
+        self._ttft_count = 0
+        self._completed = 0
+        for name in self.GAUGES + self.COUNTERS:
+            stat_registry.get(name).reset()
+
+    # --- event hooks (called by the engine) --------------------------------
+    def on_admission(self, n: int):
+        if n:
+            stat_registry.get("serving.requests_admitted").add(n)
+
+    def on_first_token(self, arrival_time: float, now: float):
+        self._ttft_sum += now - arrival_time
+        self._ttft_count += 1
+
+    def on_completion(self, n: int = 1):
+        self._completed += n
+        stat_registry.get("serving.requests_completed").add(n)
+
+    def on_preemption(self, n: int = 1):
+        stat_registry.get("serving.preemptions").add(n)
+
+    def on_step(self, *, queue_depth: int, running: int, bucket: int,
+                pages_in_use: int, tokens_emitted: int):
+        now = time.monotonic()
+        if self._start is None:
+            self._start = now
+        self._steps += 1
+        self._tokens += tokens_emitted
+        if bucket:
+            self._occupancy_sum += running / bucket
+        stat_registry.get("serving.queue_depth").set(queue_depth)
+        stat_registry.get("serving.running_seqs").set(running)
+        stat_registry.get("serving.kv_pages_in_use").set(pages_in_use)
+        stat_registry.get("serving.batch_bucket").set(bucket)
+        stat_registry.get("serving.steps").add(1)
+        if tokens_emitted:
+            stat_registry.get("serving.tokens_generated").add(tokens_emitted)
+
+    # --- derived ----------------------------------------------------------
+    def snapshot(self) -> dict:
+        elapsed = (time.monotonic() - self._start) if self._start else 0.0
+        return {
+            "steps": self._steps,
+            "tokens_generated": self._tokens,
+            "requests_completed": self._completed,
+            "elapsed_s": elapsed,
+            "tokens_per_sec": self._tokens / elapsed if elapsed > 0 else 0.0,
+            "mean_batch_occupancy": (self._occupancy_sum / self._steps
+                                     if self._steps else 0.0),
+            "mean_ttft_ms": (self._ttft_sum / self._ttft_count * 1e3
+                             if self._ttft_count else 0.0),
+        }

@@ -1,0 +1,194 @@
+"""Continuous-batching scheduler (policy only — no device compute).
+
+Orca/vLLM-style iteration-level scheduling on a synchronous core: every
+engine step first ADMITS waiting requests into free batch slots (each
+admission costs one bucketed prefill), then runs ONE decode step for all
+running sequences.  New arrivals therefore join the decode batch between
+steps — continuous batching — instead of waiting for the whole batch to
+drain (the static-batch `text.generation.generate` path).
+
+Policies
+--------
+admission    FIFO; a request enters when a batch slot is free AND the
+             paged KV cache can supply pages covering its prompt.
+batching     decode batch is padded up to the smallest configured bucket
+             ≥ len(running); the jitted step retraces only when the
+             bucket changes, not per admission/retirement.
+preemption   on page exhaustion mid-decode the YOUNGEST other running
+             sequence is evicted (recompute-style: its pages are freed
+             and the original request returns to the queue FRONT; greedy
+             decode is deterministic, so its final output is unchanged).
+retirement   EOS or max_new_tokens; pages return to the free list.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, List, Optional
+
+import numpy as np
+
+from .kv_cache import PagedKVCache
+
+__all__ = ["Request", "Sequence", "Scheduler"]
+
+_req_counter = itertools.count()
+
+
+@dataclass
+class Request:
+    """One generation request as admitted by the engine."""
+    prompt: np.ndarray                  # [P] int32 token ids
+    max_new_tokens: int = 32
+    request_id: str = ""
+    arrival_time: float = field(default_factory=time.monotonic)
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        if not self.request_id:
+            self.request_id = f"req-{next(_req_counter)}"
+
+
+class Sequence:
+    """In-flight decode state for one admitted request (host side)."""
+
+    def __init__(self, request: Request):
+        self.request = request
+        # pos = the KV position the NEXT decode step writes; after
+        # prefilling prompt[:-1] that is P-1 (the last prompt token is
+        # consumed by the first decode step, mirroring generate())
+        self.pos = 0
+        self.next_token = int(request.prompt[-1])
+        self.generated: List[int] = []
+        self.preemptions = 0
+        self.first_token_time: Optional[float] = None
+
+    @property
+    def seq_id(self) -> str:
+        return self.request.request_id
+
+    @property
+    def num_generated(self) -> int:
+        return len(self.generated)
+
+    def reset(self):
+        """Recompute-preemption: back to the unprefilled state."""
+        self.pos = 0
+        self.next_token = int(self.request.prompt[-1])
+        self.generated = []
+        self.preemptions += 1
+
+
+class Scheduler:
+    """Admission queue + running set over a PagedKVCache."""
+
+    def __init__(self, kv_cache: PagedKVCache, max_batch_size: int,
+                 bucket_sizes: Optional[List[int]] = None,
+                 max_admissions_per_step: Optional[int] = None):
+        self.cache = kv_cache
+        self.max_batch_size = int(max_batch_size)
+        if bucket_sizes is None:
+            bucket_sizes = []
+            b = 1
+            while b < self.max_batch_size:
+                bucket_sizes.append(b)
+                b *= 2
+            bucket_sizes.append(self.max_batch_size)
+        self.bucket_sizes = sorted(set(int(b) for b in bucket_sizes))
+        if self.bucket_sizes[-1] < self.max_batch_size:
+            raise ValueError("largest bucket must cover max_batch_size")
+        self.max_admissions_per_step = max_admissions_per_step
+        self.waiting: Deque[Request] = deque()
+        self.running: List[Sequence] = []
+        self.num_preemptions = 0
+
+    # --- queue ------------------------------------------------------------
+    def add(self, request: Request):
+        self.waiting.append(request)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    # --- admission --------------------------------------------------------
+    def admit(self) -> List[Sequence]:
+        """Move waiting requests into the running set while a batch slot
+        is free and the cache can cover the prompt; FIFO order, so a big
+        stuck request head-of-line blocks (documented policy — no
+        out-of-order admission that could starve it)."""
+        admitted: List[Sequence] = []
+        limit = self.max_admissions_per_step
+        while self.waiting and len(self.running) < self.max_batch_size:
+            if limit is not None and len(admitted) >= limit:
+                break
+            req = self.waiting[0]
+            if not self.cache.allocate(req.request_id, len(req.prompt)):
+                break
+            self.waiting.popleft()
+            seq = Sequence(req)
+            seq.pos = len(req.prompt) - 1
+            self.running.append(seq)
+            admitted.append(seq)
+        return admitted
+
+    # --- decode-time page growth -----------------------------------------
+    def ensure_decode_pages(self) -> List[Sequence]:
+        """Guarantee every running sequence has a page for the position it
+        writes this step (pos), preempting the youngest other sequence on
+        exhaustion.  Returns the preempted sequences."""
+        preempted: List[Sequence] = []
+        for seq in list(self.running):
+            if seq not in self.running:
+                continue    # became a victim earlier in this very loop
+            while not self.cache.allocate(seq.seq_id, seq.pos + 1):
+                victim = self._pick_victim(exclude=seq)
+                if victim is None:
+                    raise RuntimeError(
+                        f"KV cache exhausted: sequence {seq.seq_id} needs "
+                        f"{self.cache.pages_needed(seq.pos + 1)} pages but "
+                        f"only {self.cache.free_pages} free and no other "
+                        "sequence to preempt — size num_pages/pages_per_seq "
+                        "for the workload")
+                self.preempt(victim)
+                preempted.append(victim)
+        return preempted
+
+    def _pick_victim(self, exclude: Sequence) -> Optional[Sequence]:
+        for seq in reversed(self.running):      # youngest first
+            if seq is not exclude:
+                return seq
+        return None
+
+    def preempt(self, seq: Sequence):
+        """Recompute-style eviction: free pages, reset, requeue at FRONT
+        (it was admitted before everything still waiting)."""
+        self.cache.free(seq.seq_id)
+        self.running.remove(seq)
+        seq.reset()
+        self.waiting.appendleft(seq.request)
+        self.num_preemptions += 1
+
+    # --- retirement -------------------------------------------------------
+    def finish(self, seq: Sequence):
+        self.cache.free(seq.seq_id)
+        self.running.remove(seq)
+
+    # --- batching ---------------------------------------------------------
+    def bucket(self) -> int:
+        """Smallest configured bucket covering the running set (the jit
+        trace key of the decode step)."""
+        n = max(1, len(self.running))
+        for b in self.bucket_sizes:
+            if b >= n:
+                return b
+        return self.bucket_sizes[-1]
+
+    def seq_lens(self) -> dict:
+        """{seq_id: valid KV length} for cache fragmentation stats."""
+        return {s.seq_id: s.pos for s in self.running}

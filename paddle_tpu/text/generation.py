@@ -21,7 +21,8 @@ import numpy as np
 
 from ..jit.functional import get_state
 
-__all__ = ["make_gpt_decode_step", "prefill", "generate"]
+__all__ = ["make_gpt_decode_step", "make_gpt_paged_decode_step", "prefill",
+           "generate"]
 
 
 def _ln(x, w, b, eps=1e-5):
@@ -103,6 +104,92 @@ def make_gpt_decode_step(model, max_len: int):
         return out, {"k": ks, "v": vs, "pos": pos + 1}
 
     return step_fn, init_state
+
+
+def make_gpt_paged_decode_step(model, page_size: int, pages_per_seq: int):
+    """Paged-KV variant of ``make_gpt_decode_step`` — the serving engine's
+    decode step (paddle_tpu/serving/engine.py).
+
+    Instead of a dense per-sequence [B, max_len, H, D] ring, KV lives in a
+    GLOBAL pool of fixed-size pages shared by all in-flight sequences; each
+    sequence owns a page-table row of page ids.  Builds
+    (step_fn, init_pages):
+
+    ``init_pages(num_pages)`` -> {"k": [L x [N, P, H, D]], "v": ...}
+
+    ``step_fn(tokens [B], pos [B], page_tables [B, M], kv)`` ->
+    (logits [B, V], kv') — one decode position per call: the new k/v is
+    scattered into page ``page_tables[b, pos // P]`` slot ``pos % P`` and
+    attention runs over the sequence's pages masked to length pos+1 via
+    ``ops.attention`` paged attention (Pallas kernel on TPU, XLA gather
+    reference on CPU).
+
+    Page-id 0 is the reserved trash page: inactive batch lanes (pos 0,
+    all-zero page table) and positions past a sequence's allocation
+    scatter there harmlessly and are never attended to (seq_len masks
+    them), so the step needs no per-lane branching and its shape — hence
+    its trace — depends only on the batch bucket.
+    """
+    from ..ops.pallas_ops.paged_attention import paged_attention as paged_attn
+
+    params, _ = get_state(model)
+    L = len(model.layers)
+    H = model.layers[0].attn.num_heads
+    hidden = model.wte.weight.shape[1]
+    D = hidden // H
+    wte = params["wte.weight"]
+    wpe = params["wpe.weight"]
+    max_pos = wpe.shape[0]
+
+    def lp(i, name):
+        return params[f"layers.{i}.{name}"]
+
+    def init_pages(num_pages: int):
+        # one DISTINCT buffer per layer/side: the engine donates the
+        # pools to the jitted step, and XLA rejects donating one buffer
+        # twice (a shared zeros array would alias all 2L entries)
+        def z():
+            return jnp.zeros((num_pages, page_size, H, D), wte.dtype)
+
+        return {"k": [z() for _ in range(L)], "v": [z() for _ in range(L)]}
+
+    def step_fn(tokens, pos, page_tables, kv):
+        N = tokens.shape[0]
+        # clamp junk lanes (prefill bucket padding) instead of relying on
+        # gather clipping: positions past the wpe table or the page table
+        # width belong to masked lanes whose output is discarded
+        pos_c = jnp.minimum(pos, max_pos - 1)
+        x = wte[tokens] + wpe[pos_c]
+        page_of = jnp.minimum(pos // page_size, pages_per_seq - 1)
+        page_idx = jnp.take_along_axis(page_tables, page_of[:, None],
+                                       axis=1)[:, 0]
+        slot = pos % page_size
+        seq_lens = pos + 1
+        ks, vs = [], []
+        for i in range(L):
+            h = _ln(x, lp(i, "ln1.weight"), lp(i, "ln1.bias"))
+            q = (h @ lp(i, "attn.q_proj.weight")
+                 + lp(i, "attn.q_proj.bias")).reshape(N, H, D)
+            k1 = (h @ lp(i, "attn.k_proj.weight")
+                  + lp(i, "attn.k_proj.bias")).reshape(N, H, D)
+            v1 = (h @ lp(i, "attn.v_proj.weight")
+                  + lp(i, "attn.v_proj.bias")).reshape(N, H, D)
+            kc = kv["k"][i].at[page_idx, slot].set(k1)
+            vc = kv["v"][i].at[page_idx, slot].set(v1)
+            ks.append(kc)
+            vs.append(vc)
+            ctx = paged_attn(q, kc, vc, page_tables,
+                             seq_lens).reshape(N, hidden)
+            x = x + (ctx @ lp(i, "attn.out_proj.weight")
+                     + lp(i, "attn.out_proj.bias"))
+            h2 = _ln(x, lp(i, "ln2.weight"), lp(i, "ln2.bias"))
+            ff = _gelu(h2 @ lp(i, "fc1.weight") + lp(i, "fc1.bias"))
+            x = x + ff @ lp(i, "fc2.weight") + lp(i, "fc2.bias")
+        x = _ln(x, params["ln_f.weight"], params["ln_f.bias"])
+        out = x @ wte.T
+        return out, {"k": ks, "v": vs}
+
+    return step_fn, init_pages
 
 
 def prefill(step_fn, state, prompt: jnp.ndarray):

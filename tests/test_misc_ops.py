@@ -565,3 +565,67 @@ class TestBilateralSlice:
         hi = misc.bilateral_slice(t(x), t(np.ones((B, H, W), np.float32)),
                                   t(grid)).numpy()
         assert lo.mean() < 0.6 and hi.mean() > 2.4
+
+
+class TestSegmentGapIds:
+    def test_empty_segments_masked_to_zero(self):
+        # regression (ISSUE 1 satellite): ids [0,0,2,2] leave segment 1
+        # empty — jax.ops.segment_max/min fill with -inf/+inf; the
+        # reference emits 0 for absent segments
+        ids = np.array([0, 0, 2, 2], np.int64)
+        x = np.array([[1.0], [2.0], [-3.0], [-4.0]], np.float32)
+        mx = segment_max(t(x), t(ids)).numpy()
+        mn = segment_min(t(x), t(ids)).numpy()
+        assert np.isfinite(mx).all() and np.isfinite(mn).all()
+        np.testing.assert_allclose(mx, [[2.0], [0.0], [-3.0]])
+        np.testing.assert_allclose(mn, [[1.0], [0.0], [-4.0]])
+
+    def test_grad_still_flows_through_masking(self):
+        ids = np.array([0, 0, 2], np.int64)
+        x = t(np.array([[1.0], [5.0], [2.0]], np.float32))
+        x.stop_gradient = False
+        segment_max(x, t(ids)).sum().backward()
+        # max picks rows 1 and 2; the empty segment contributes nothing
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   [[0.0], [1.0], [1.0]])
+
+
+class TestMatrixNMSNormalized:
+    def test_normalized_flag_threads_pixel_offset_into_iou(self):
+        # satellite fix: matrix_nms ignored `normalized`; offset=1 (the
+        # +1 pixel convention multiclass_nms already uses) changes the
+        # IoU and hence the decayed score
+        from paddle_tpu.ops.detection import matrix_nms
+        boxes = np.array([[0.0, 0.0, 10.0, 10.0],
+                          [0.0, 0.0, 10.0, 15.0]], np.float32)
+        scores = np.array([[0.9, 0.8]], np.float32)
+        kw = dict(nms_top_k=2, keep_top_k=2, background_label=-1,
+                  score_threshold=0.0)
+        out_n, _ = matrix_nms(t(boxes), t(scores), normalized=True, **kw)
+        out_p, _ = matrix_nms(t(boxes), t(scores), normalized=False, **kw)
+        iou_n = 100.0 / 150.0                  # offset 0
+        iou_p = (11.0 * 11.0) / (11.0 * 11.0 + 11.0 * 16.0 - 11.0 * 11.0)
+        assert out_n.numpy()[1, 1] == pytest.approx(0.8 * (1 - iou_n),
+                                                    abs=1e-4)
+        assert out_p.numpy()[1, 1] == pytest.approx(0.8 * (1 - iou_p),
+                                                    abs=1e-4)
+        assert abs(out_n.numpy()[1, 1] - out_p.numpy()[1, 1]) > 1e-3
+
+
+class TestSequenceTopkBeyondWidth:
+    def test_topk_larger_than_padded_width(self):
+        # satellite fix: a topks entry beyond the padded column width
+        # used to raise IndexError at trace time; absent columns add 0
+        # and the divisor stays the full k (reference :163-165)
+        x = np.array([[[[3.0, 1.0, 2.0]]]], np.float32)   # [1,1,1,3]
+        out = misc.sequence_topk_avg_pooling(
+            t(x), t(np.array([1])), t(np.array([2])), [5]).numpy()
+        # 2 valid cols (3.0, 1.0), k=5 > width 3: sum(valid)/5
+        assert out[0, 0, 0] == pytest.approx((3.0 + 1.0) / 5.0)
+
+    def test_mixed_ks_straddling_width(self):
+        x = np.array([[[[4.0, 2.0]]]], np.float32)        # width 2
+        out = misc.sequence_topk_avg_pooling(
+            t(x), t(np.array([1])), t(np.array([2])), [1, 3]).numpy()
+        assert out[0, 0, 0] == pytest.approx(4.0)
+        assert out[0, 0, 1] == pytest.approx((4.0 + 2.0) / 3.0)

@@ -187,6 +187,13 @@ OVERRIDES.update({
     "attention.scaled_dot_product_attention": Spec(lambda rng: [
         t(fmat(rng, 2, 8, 4, 16)), t(fmat(rng, 2, 8, 4, 16)),
         t(fmat(rng, 2, 8, 4, 16))], rtol=8e-2),
+    # decode-time paged attention: q [B,H,D], k/v page pools [N,P,H,D],
+    # page tables (page 0 = reserved trash page), ragged seq lens
+    "attention.paged_attention": Spec(lambda rng: [
+        t(fmat(rng, 2, 2, 8)),
+        t(fmat(rng, 6, 4, 2, 8)), t(fmat(rng, 6, 4, 2, 8)),
+        t(np.asarray([[1, 2, 0], [3, 4, 5]], np.int32)),
+        t(np.asarray([6, 10], np.int64))], **NOGRAD),
     "common.affine_grid": Spec(lambda rng: [t(fmat(rng, 2, 2, 3))],
                                kwargs={"out_shape": [2, 3, 4, 4]}),
     "common.bilinear": Spec(lambda rng: [t(fmat(rng, 3, 4)), t(fmat(rng, 3, 5)),
