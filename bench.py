@@ -608,9 +608,32 @@ def _attach_seq8192(gpt_result, steps):
         sys.stderr.write(f"gpt 8k segment skipped: {e}\n")
 
 
+def _dump_observability(trace_dir):
+    """BENCH_TRACE=<dir>: write the Chrome-trace timeline + the full
+    metrics snapshot (counters, histogram percentiles, span aggregates,
+    per-jit FLOPs/bytes attribution, device memory) next to the BENCH
+    JSON line — the observability artifact every perf PR reports
+    through."""
+    from paddle_tpu import profiler
+
+    os.makedirs(trace_dir, exist_ok=True)
+    trace_path = os.path.join(trace_dir, "trace.json")
+    profiler.export_chrome_trace(trace_path)
+    metrics_path = os.path.join(trace_dir, "metrics.json")
+    with open(metrics_path, "w") as f:
+        json.dump(profiler.metrics_snapshot(), f, indent=1)
+    sys.stderr.write(f"BENCH_TRACE: wrote {trace_path} and "
+                     f"{metrics_path}\n")
+
+
 def main():
     which = os.environ.get("BENCH_MODEL", "all")
     steps = int(os.environ.get("BENCH_STEPS", "30"))
+    trace_dir = os.environ.get("BENCH_TRACE")
+    if trace_dir:
+        from paddle_tpu import profiler
+
+        profiler.enable_tracing()
     if which == "bert":
         batch = int(os.environ.get("BENCH_BATCH", "32"))
         result = _with_retries("bert", lambda: bench_bert(batch, steps))
@@ -682,6 +705,8 @@ def main():
             sys.stderr.write(
                 f"serving bench failed after retries "
                 f"({type(e).__name__}: {e})\n")
+    if trace_dir:
+        _dump_observability(trace_dir)
     print(json.dumps(result))
 
 

@@ -76,6 +76,7 @@ from . import metric  # noqa: F401
 from . import nn  # noqa: F401
 from . import onnx  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import profiler  # noqa: F401
 from . import slim  # noqa: F401
 from . import static  # noqa: F401
 from . import text  # noqa: F401

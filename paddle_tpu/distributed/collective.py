@@ -15,6 +15,7 @@ subsuming c_sync_*/c_wait_* stream ops).
 """
 from __future__ import annotations
 
+import functools
 from typing import List, Optional
 
 import jax
@@ -26,6 +27,22 @@ from ..ops.dispatch import apply
 from ..tensor import Tensor
 from .env import get_rank, get_world_size
 from .mesh import get_mesh, mesh_axis_size
+
+
+def _traced_span(fn):
+    """Profiler span around each collective entry (the jax.named_scope
+    inside RecordEvent also annotates the lowered HLO when the
+    collective is hit inside a trace)."""
+    name = f"dist/{fn.__name__}"
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        from ..utils.profiler import RecordEvent
+
+        with RecordEvent(name):
+            return fn(*args, **kwargs)
+
+    return wrapper
 
 
 class ReduceOp:
@@ -218,6 +235,7 @@ def _reduce_fn(op, group: Group):
     return fn
 
 
+@_traced_span
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place allreduce (reference c_allreduce_sum, collective.py:365)."""
     group = group or _default_group
@@ -269,6 +287,7 @@ def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
     return tensor
 
 
+@_traced_span
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     """reference c_allgather: gather shards from every rank."""
     group = group or _default_group
@@ -312,6 +331,7 @@ def all_gather_object(object_list, obj, group=None):
     object_list.append(obj)
 
 
+@_traced_span
 def broadcast(tensor, src, group=None, sync_op=True):
     group = group or _default_group
     t = to_tensor_like(tensor)
@@ -342,6 +362,7 @@ def broadcast(tensor, src, group=None, sync_op=True):
     return tensor
 
 
+@_traced_span
 def reduce_scatter(tensor, tensor_list_or_input, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     group = group or _default_group
@@ -509,6 +530,7 @@ def _p2p(t, src, dst, group):
     )
 
 
+@_traced_span
 def send(tensor, dst=0, group=None, sync_op=True, src=None):
     """p2p send (reference send_v2).
 
@@ -531,6 +553,7 @@ def send(tensor, dst=0, group=None, sync_op=True, src=None):
     return None
 
 
+@_traced_span
 def recv(tensor, src=0, group=None, sync_op=True, dst=None):
     """p2p recv (reference recv_v2): the other half of the matched
     single-edge ppermute. ``dst`` defaults to this process's rank."""
@@ -569,6 +592,7 @@ def p2p_shift(tensor, group=None, shift=1):
     return t
 
 
+@_traced_span
 def barrier(group=None):
     """reference barrier_op: cross-process rendezvous when running
     multi-process (host gloo backend or jax.distributed), local device sync

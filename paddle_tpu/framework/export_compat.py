@@ -1,0 +1,39 @@
+"""jax.export resolution shim (framework.proto / StableHLO serialization
+dependency of static.Program, jit.save, inference.Predictor, onnx.export).
+
+``jax.export`` ships as a LAZY submodule: ``import jax`` alone does not
+bind the attribute (on jax 0.4.3x, ``jax.export.export`` raises
+AttributeError until someone runs ``import jax.export``).  Older
+releases carried it as ``jax.experimental.export``.  This module is the
+one place that resolves whichever spelling the installed jax has — every
+serialization call site goes through ``jax_export()`` and gets either
+the module or one clear actionable error instead of four different
+AttributeErrors."""
+from __future__ import annotations
+
+_export_mod = None
+
+
+def jax_export():
+    """Return the jax export module (jax.export, falling back to
+    jax.experimental.export).  Raises ImportError with a clear message
+    when the installed jax has neither."""
+    global _export_mod
+    if _export_mod is None:
+        import jax
+
+        try:
+            import jax.export as m          # jax >= 0.4.30 (lazy submodule)
+        except ImportError:
+            try:
+                from jax.experimental import export as m  # older jax
+            except ImportError as e:
+                raise ImportError(
+                    "StableHLO serialization needs jax.export (jax >= "
+                    "0.4.30) or jax.experimental.export, but installed "
+                    f"jax {jax.__version__} provides neither — "
+                    "model save/load, inference.Predictor and "
+                    "onnx.export are unavailable on this jax"
+                ) from e
+        _export_mod = m
+    return _export_mod

@@ -1,10 +1,15 @@
-"""Global stat counters (reference: platform/monitor.h:77 StatRegistry +
-STAT_ADD/STAT_RESET macros :130 — process-wide named counters exposed to
-Python for observability, e.g. GPU memory stats)."""
+"""Global stat counters + distributions (reference: platform/monitor.h:77
+StatRegistry + STAT_ADD/STAT_RESET macros :130 — process-wide named
+counters exposed to Python for observability, e.g. GPU memory stats —
+extended with log-bucketed histograms and a labeled-gauge surface, the
+latency-distribution layer the reference keeps in its benchmark/monitor
+tooling)."""
 from __future__ import annotations
 
+import bisect
+import math
 import threading
-from typing import Dict
+from typing import Dict, Optional, Tuple
 
 
 class _Stat:
@@ -30,11 +35,163 @@ class _Stat:
         return self.value
 
 
+# 20 log-spaced buckets per decade over [1e-6, 1e6): ratio 10**(1/20)
+# ~= 1.122 between bounds, so a geometric-midpoint percentile estimate is
+# within ~6% relative error of the exact sample percentile across 12
+# decades — wide enough for microsecond latencies and token counts alike.
+_BUCKETS_PER_DECADE = 20
+_MIN_EXP, _MAX_EXP = -6, 6
+_BOUNDS = [10.0 ** (e / _BUCKETS_PER_DECADE)
+           for e in range(_MIN_EXP * _BUCKETS_PER_DECADE,
+                          _MAX_EXP * _BUCKETS_PER_DECADE + 1)]
+
+
+class Histogram:
+    """Log-bucketed distribution (thread-safe).
+
+    ``observe`` is O(log n_buckets) (bisect over the fixed bounds);
+    percentiles are estimated by geometric interpolation inside the
+    covering bucket and clamped to the exact observed [min, max].
+    Values <= the smallest bound land in the underflow bucket, values
+    beyond the largest in the overflow bucket.
+    """
+
+    __slots__ = ("_counts", "_sum", "_count", "_min", "_max", "_lock")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._reset_locked()
+
+    def _reset_locked(self):
+        self._counts = [0] * (len(_BOUNDS) + 1)  # +1 overflow bucket
+        self._sum = 0.0
+        self._count = 0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def observe(self, value: float):
+        v = float(value)
+        idx = bisect.bisect_left(_BOUNDS, v)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += v
+            self._count += 1
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+
+    def reset(self):
+        with self._lock:
+            self._reset_locked()
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def percentile(self, p: float) -> float:
+        """Estimate the p-th percentile (p in [0, 100])."""
+        with self._lock:
+            return self._percentile_locked(p)
+
+    def _percentile_locked(self, p: float) -> float:
+        if self._count == 0:
+            return 0.0
+        rank = (p / 100.0) * self._count
+        cum = 0
+        for i, c in enumerate(self._counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                frac = (rank - cum) / c
+                lo = _BOUNDS[i - 1] if i > 0 else self._min
+                hi = _BOUNDS[i] if i < len(_BOUNDS) else self._max
+                if lo <= 0 or hi <= 0:
+                    est = lo + (hi - lo) * frac       # linear fallback
+                else:
+                    est = lo * (hi / lo) ** frac      # geometric interp
+                return min(max(est, self._min), self._max)
+            cum += c
+        return self._max
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            if self._count == 0:
+                return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
+                        "mean": 0.0, "p50": 0.0, "p95": 0.0, "p99": 0.0}
+            return {
+                "count": self._count,
+                "sum": self._sum,
+                "min": self._min,
+                "max": self._max,
+                "mean": self._sum / self._count,
+                "p50": self._percentile_locked(50),
+                "p95": self._percentile_locked(95),
+                "p99": self._percentile_locked(99),
+            }
+
+    def cumulative_buckets(self):
+        """[(upper_bound, cumulative_count), ..., (inf, total)] — the
+        Prometheus exposition shape.  Empty buckets are elided (except
+        the final +Inf) to keep the text small."""
+        return self.exposition_state()[0]
+
+    def exposition_state(self):
+        """(cumulative_buckets, sum, count) under ONE lock hold, so a
+        scrape concurrent with observe() cannot emit a _count that
+        disagrees with the +Inf bucket (the Prometheus histogram
+        invariant)."""
+        with self._lock:
+            out = []
+            cum = 0
+            for i, c in enumerate(self._counts):
+                cum += c
+                if c and i < len(_BOUNDS):
+                    out.append((_BOUNDS[i], cum))
+            out.append((math.inf, cum))
+            return out, self._sum, self._count
+
+
+class LabeledGauge:
+    """A gauge family: one float per label-set (thread-safe)."""
+
+    __slots__ = ("_values", "_lock")
+
+    def __init__(self):
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _key(labels: dict) -> Tuple[Tuple[str, str], ...]:
+        return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+    def set(self, value: float, **labels):
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def get(self, **labels) -> Optional[float]:
+        return self._values.get(self._key(labels))
+
+    def values(self) -> Dict[Tuple[Tuple[str, str], ...], float]:
+        with self._lock:
+            return dict(self._values)
+
+    def reset(self):
+        with self._lock:
+            self._values.clear()
+
+
 class StatRegistry:
-    """Named counters (monitor.h:77)."""
+    """Named counters (monitor.h:77) + histograms + labeled gauges."""
 
     def __init__(self):
         self._stats: Dict[str, _Stat] = {}
+        self._hists: Dict[str, Histogram] = {}
+        self._gauges: Dict[str, LabeledGauge] = {}
         self._lock = threading.Lock()
 
     def get(self, name: str) -> _Stat:
@@ -44,14 +201,45 @@ class StatRegistry:
                 s = self._stats[name] = _Stat()
             return s
 
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram()
+            return h
+
+    def labeled_gauge(self, name: str) -> LabeledGauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = LabeledGauge()
+            return g
+
     def stat_values(self) -> Dict[str, int]:
         with self._lock:
             return {n: s.get() for n, s in self._stats.items()}
+
+    def histogram_snapshots(self) -> Dict[str, dict]:
+        with self._lock:
+            hists = list(self._hists.items())
+        return {n: h.snapshot() for n, h in hists}
+
+    def histograms(self) -> Dict[str, Histogram]:
+        with self._lock:
+            return dict(self._hists)
+
+    def labeled_gauges(self) -> Dict[str, LabeledGauge]:
+        with self._lock:
+            return dict(self._gauges)
 
     def reset_all(self):
         with self._lock:
             for s in self._stats.values():
                 s.reset()
+            for h in self._hists.values():
+                h.reset()
+            for g in self._gauges.values():
+                g.reset()
 
 
 stat_registry = StatRegistry()
@@ -68,3 +256,18 @@ def stat_get(name: str):
 
 def stat_reset(name: str):
     stat_registry.get(name).reset()
+
+
+def histogram_observe(name: str, value: float):
+    """Record one sample into the named process-wide histogram."""
+    stat_registry.histogram(name).observe(value)
+
+
+def histogram_snapshot(name: str) -> dict:
+    """count/sum/min/max/mean/p50/p95/p99 of the named histogram."""
+    return stat_registry.histogram(name).snapshot()
+
+
+def gauge_set(name: str, value: float, **labels):
+    """Set the named (optionally labeled) gauge to ``value``."""
+    stat_registry.labeled_gauge(name).set(value, **labels)

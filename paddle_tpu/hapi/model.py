@@ -9,16 +9,19 @@ for debugging parity.
 """
 from __future__ import annotations
 
+import time as _time
 from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..framework.monitor import histogram_observe
 from ..framework.random import default_generator, rng_scope
 from ..jit.functional import functional_call, get_state
 from ..metric.metrics import Metric
 from ..tensor import Tensor
+from ..utils.profiler import RecordEvent
 from .callbacks import CallbackList, ProgBarLogger
 
 
@@ -354,27 +357,36 @@ class Model:
         cbks.on_begin("train")
         self.stop_training = False
         for epoch in range(epochs):
-            cbks.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            logs = {}
-            for step, batch in enumerate(train_loader):
-                if num_iters is not None and step >= num_iters:
-                    break
-                cbks.on_batch_begin("train", step, logs)
-                x, y = batch[0], batch[1] if len(batch) > 1 else None
-                outs = self.train_batch([x], [y])
-                logs = {"loss": outs[0], "batch_size": _batch_size_of(x)}
-                for name, val in zip(self._metric_names(), outs[1:]):
-                    logs[name] = val
-                cbks.on_batch_end("train", step, logs)
-                if self.stop_training:
-                    break
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self.evaluate(eval_loader, verbose=0,
-                                          _inside_fit=True)
-                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
-            cbks.on_epoch_end(epoch, logs)
+            # one span per epoch; per-batch spans + a latency histogram
+            # nest inside it (trace shows fit > epoch > train_batch)
+            with RecordEvent("hapi/fit.epoch", epoch=epoch):
+                cbks.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                logs = {}
+                for step, batch in enumerate(train_loader):
+                    if num_iters is not None and step >= num_iters:
+                        break
+                    cbks.on_batch_begin("train", step, logs)
+                    x, y = batch[0], batch[1] if len(batch) > 1 else None
+                    t0 = _time.perf_counter()
+                    with RecordEvent("hapi/train_batch"):
+                        outs = self.train_batch([x], [y])
+                    histogram_observe("hapi.train_batch_ms",
+                                      (_time.perf_counter() - t0) * 1e3)
+                    logs = {"loss": outs[0],
+                            "batch_size": _batch_size_of(x)}
+                    for name, val in zip(self._metric_names(), outs[1:]):
+                        logs[name] = val
+                    cbks.on_batch_end("train", step, logs)
+                    if self.stop_training:
+                        break
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self.evaluate(eval_loader, verbose=0,
+                                              _inside_fit=True)
+                    logs.update({f"eval_{k}": v
+                                 for k, v in eval_logs.items()})
+                cbks.on_epoch_end(epoch, logs)
             if save_dir and (epoch + 1) % save_freq == 0:
                 self.save(f"{save_dir}/{epoch}")
             if self.stop_training:

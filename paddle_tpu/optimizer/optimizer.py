@@ -185,6 +185,13 @@ class Optimizer:
 
     @no_grad()
     def step(self):
+        from ..utils.profiler import RecordEvent
+
+        with RecordEvent("optimizer/step"):
+            return self._step_impl()
+
+    @no_grad()
+    def _step_impl(self):
         from ..sparse_grad import IndexedSlices
 
         if flag_value("enable_unused_var_check"):

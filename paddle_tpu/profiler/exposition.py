@@ -1,0 +1,128 @@
+"""Prometheus text-exposition formatter + optional stdlib /metrics server.
+
+Renders ``framework.monitor.stat_registry`` (counters-as-gauges, labeled
+gauges, log-bucketed histograms) in the Prometheus text format
+(version 0.0.4), so a serving deployment can be scraped with zero new
+dependencies: ``start_metrics_server(port)`` runs a daemon-thread
+``http.server`` answering ``GET /metrics``.
+"""
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Optional
+
+from ..framework.monitor import StatRegistry, stat_registry
+
+__all__ = ["prometheus_text", "start_metrics_server", "MetricsServer"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _sanitize(name: str) -> str:
+    n = _NAME_RE.sub("_", name)
+    if n and n[0].isdigit():
+        n = "_" + n
+    return n
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    f = float(v)
+    return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def _escape_label(v: str) -> str:
+    # exposition format: backslash, double-quote and newline must be
+    # escaped in label values or the scraper rejects the whole page
+    return (str(v).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _labels_str(label_items) -> str:
+    if not label_items:
+        return ""
+    body = ",".join(f'{_sanitize(k)}="{_escape_label(v)}"'
+                    for k, v in label_items)
+    return "{" + body + "}"
+
+
+def prometheus_text(registry: Optional[StatRegistry] = None) -> str:
+    """Render every stat/gauge/histogram in ``registry`` (default: the
+    process-wide one) as Prometheus text exposition."""
+    reg = registry if registry is not None else stat_registry
+    lines = []
+    # plain stats: exposed as gauges (callers use both add() and set())
+    for name, value in sorted(reg.stat_values().items()):
+        pn = _sanitize(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {_fmt(value)}")
+    for name, gauge in sorted(reg.labeled_gauges().items()):
+        pn = _sanitize(name)
+        lines.append(f"# TYPE {pn} gauge")
+        for label_items, value in sorted(gauge.values().items()):
+            lines.append(f"{pn}{_labels_str(label_items)} {_fmt(value)}")
+    for name, hist in sorted(reg.histograms().items()):
+        pn = _sanitize(name)
+        lines.append(f"# TYPE {pn} histogram")
+        buckets, total, count = hist.exposition_state()
+        for le, cum in buckets:
+            lines.append(f'{pn}_bucket{{le="{_fmt(le)}"}} {cum}')
+        lines.append(f"{pn}_sum {_fmt(total)}")
+        lines.append(f"{pn}_count {count}")
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """Minimal /metrics endpoint over http.server (stdlib only)."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 registry: Optional[StatRegistry] = None):
+        import http.server
+
+        reg = registry
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server contract
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_error(404)
+                    return
+                body = prometheus_text(reg).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence per-request stderr spam
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), _Handler)
+        self.port = self._httpd.server_address[1]
+        self.host = host
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="paddle-tpu-metrics",
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def start_metrics_server(port: int = 0, host: str = "127.0.0.1",
+                         registry: Optional[StatRegistry] = None
+                         ) -> MetricsServer:
+    """Start the daemon /metrics server; ``port=0`` picks a free port
+    (read it back from ``.port``)."""
+    return MetricsServer(port=port, host=host, registry=registry)

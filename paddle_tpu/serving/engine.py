@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..profiler.jit_cost import cost_registry, profiled_jit
 from ..utils.profiler import RecordEvent
 from .kv_cache import PagedKVCache
 from .metrics import ServingMetrics
@@ -106,8 +107,12 @@ class ServingEngine:
         # right after each call, letting XLA alias the .at[].set update
         # in place instead of copying every layer's page pool per token
         # (platforms without donation support just warn and copy).
-        self._decode_jit = jax.jit(_decode, donate_argnums=(3,))
-        self._prefill_jit = jax.jit(_prefill, donate_argnums=(3,))
+        # profiled_jit attributes FLOPs/bytes + compile count/time to
+        # "serving.decode" / "serving.prefill" in profiler.cost_registry.
+        self._decode_jit = profiled_jit("serving.decode", _decode,
+                                        donate_argnums=(3,))
+        self._prefill_jit = profiled_jit("serving.prefill", _prefill,
+                                         donate_argnums=(3,))
 
     # --- request intake ---------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int = 32,
@@ -167,15 +172,27 @@ class ServingEngine:
         tokens[:n] = prompt[:-1]
         positions = np.arange(bucket, dtype=np.int32)
         row = self.cache.page_table_row(seq.seq_id)
-        with RecordEvent("serving/prefill"):
+        t0 = time.perf_counter()
+        with RecordEvent("serving/prefill", bucket=bucket,
+                         prompt_len=int(prompt.size)):
             self._kv = self._prefill_jit(jnp.asarray(tokens),
                                          jnp.asarray(positions),
                                          jnp.asarray(row), self._kv)
+            # sync inside the timed window: dispatch is async, and the
+            # decode that follows needs this kv anyway — without the
+            # block the histogram would record µs dispatch times
+            jax.block_until_ready(self._kv)
+        self.metrics.on_prefill(time.perf_counter() - t0)
 
     # --- one scheduler iteration -----------------------------------------
     def step(self) -> dict:
         """Admit + prefill waiting requests, then decode one token for
         every running sequence.  Returns the step's stats."""
+        t_step = time.perf_counter()
+        with RecordEvent("serving/step"):
+            return self._step_inner(t_step)
+
+    def _step_inner(self, t_step: float) -> dict:
         sched = self.scheduler
         admitted = sched.admit()
         for seq in admitted:
@@ -199,11 +216,13 @@ class ServingEngine:
                     tokens[i] = seq.next_token
                     pos[i] = seq.pos
                     tables[i] = self.cache.page_table_row(seq.seq_id)
-                with RecordEvent("serving/decode_step"):
+                t0 = time.perf_counter()
+                with RecordEvent("serving/decode_step", bucket=bucket):
                     nxt, self._kv = self._decode_jit(
                         jnp.asarray(tokens), jnp.asarray(pos),
                         jnp.asarray(tables), self._kv)
                     nxt = np.asarray(nxt)    # the step's one host sync
+                self.metrics.on_decode(time.perf_counter() - t0)
                 now = time.monotonic()
                 decoded = len(active)    # occupancy measured pre-retirement
                 for i, seq in enumerate(active):
@@ -235,7 +254,8 @@ class ServingEngine:
             # records occupancy 1.0, not 0
             running=decoded if bucket else len(sched.running),
             bucket=bucket, pages_in_use=self.cache.pages_in_use,
-            tokens_emitted=tokens_emitted)
+            tokens_emitted=tokens_emitted,
+            step_seconds=time.perf_counter() - t_step)
         return {
             "admitted": len(admitted),
             "running": len(sched.running),
@@ -268,11 +288,18 @@ class ServingEngine:
         return self.outputs.pop(request_id, None)
 
     def stats(self) -> dict:
-        """Engine + cache + metrics snapshot."""
+        """Engine + cache + metrics snapshot, incl. per-jit cost
+        attribution (FLOPs/bytes/compile counts) for the engine's
+        compiled programs.  ``jit_costs`` reads the process-global
+        cost_registry: with several engines in one process it is the
+        MERGED serving attribution, not per-engine."""
+        costs = cost_registry.snapshot()
         return {
             "metrics": self.metrics.snapshot(),
             "cache": self.cache.stats(self.scheduler.seq_lens()),
             "preemptions": self.scheduler.num_preemptions,
+            "jit_costs": {k: v for k, v in costs.items()
+                          if k.startswith("serving.")},
         }
 
 

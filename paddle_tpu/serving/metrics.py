@@ -3,12 +3,18 @@
 Every engine step publishes gauges/counters into
 ``framework.monitor.stat_registry`` (the reference's StatRegistry /
 STAT_ADD surface, so existing monitoring tooling sees serving stats with
-no new plumbing) under the ``serving.*`` namespace, and keeps float
-accumulators host-side for the derived rates ``snapshot()`` reports
-(tokens/sec, mean TTFT, mean batch occupancy).  Time-critical spans
-(prefill, decode step) are wrapped in ``utils.profiler.RecordEvent`` by
-the engine, so they show up in the profiler summary table and as XPlane
-trace scopes.
+no new plumbing) under the ``serving.*`` namespace, plus LATENCY
+HISTOGRAMS (log-bucketed, p50/p95/p99 in ``snapshot()`` and in the
+Prometheus exposition) for step, prefill, decode and TTFT, and keeps
+float accumulators host-side for the derived rates ``snapshot()``
+reports (tokens/sec, mean TTFT, mean batch occupancy).  Time-critical
+spans (step, prefill, decode) are wrapped in
+``utils.profiler.RecordEvent`` by the engine, so they show up nested in
+the profiler summary table and in the Chrome-trace timeline
+(``paddle_tpu.profiler.export_chrome_trace``); the jitted prefill/decode
+programs carry FLOPs/bytes attribution via
+``profiler.cost_registry`` (names ``serving.prefill`` /
+``serving.decode``).
 """
 from __future__ import annotations
 
@@ -21,13 +27,22 @@ __all__ = ["ServingMetrics"]
 
 
 class ServingMetrics:
-    """Aggregates per-step serving stats; ints mirror into StatRegistry."""
+    """Aggregates per-step serving stats; ints mirror into StatRegistry,
+    latency samples into its histograms.
+
+    The ``serving.*`` registry names are PROCESS-GLOBAL (Prometheus
+    semantics): engines in one process share them, and constructing a
+    new ServingMetrics resets them.  Run one engine per process (the
+    deployment shape) or pass each engine a metrics object only at
+    points where a shared reset is acceptable."""
 
     GAUGES = ("serving.queue_depth", "serving.running_seqs",
               "serving.kv_pages_in_use", "serving.batch_bucket")
     COUNTERS = ("serving.steps", "serving.tokens_generated",
                 "serving.requests_admitted", "serving.requests_completed",
                 "serving.preemptions")
+    HISTOGRAMS = ("serving.step_latency_ms", "serving.prefill_latency_ms",
+                  "serving.decode_latency_ms", "serving.ttft_ms")
 
     def __init__(self):
         self.reset()
@@ -42,6 +57,8 @@ class ServingMetrics:
         self._completed = 0
         for name in self.GAUGES + self.COUNTERS:
             stat_registry.get(name).reset()
+        for name in self.HISTOGRAMS:
+            stat_registry.histogram(name).reset()
 
     # --- event hooks (called by the engine) --------------------------------
     def on_admission(self, n: int):
@@ -49,8 +66,10 @@ class ServingMetrics:
             stat_registry.get("serving.requests_admitted").add(n)
 
     def on_first_token(self, arrival_time: float, now: float):
-        self._ttft_sum += now - arrival_time
+        ttft = now - arrival_time
+        self._ttft_sum += ttft
         self._ttft_count += 1
+        stat_registry.histogram("serving.ttft_ms").observe(ttft * 1e3)
 
     def on_completion(self, n: int = 1):
         self._completed += n
@@ -59,8 +78,17 @@ class ServingMetrics:
     def on_preemption(self, n: int = 1):
         stat_registry.get("serving.preemptions").add(n)
 
+    def on_prefill(self, seconds: float):
+        stat_registry.histogram("serving.prefill_latency_ms").observe(
+            seconds * 1e3)
+
+    def on_decode(self, seconds: float):
+        stat_registry.histogram("serving.decode_latency_ms").observe(
+            seconds * 1e3)
+
     def on_step(self, *, queue_depth: int, running: int, bucket: int,
-                pages_in_use: int, tokens_emitted: int):
+                pages_in_use: int, tokens_emitted: int,
+                step_seconds: Optional[float] = None):
         now = time.monotonic()
         if self._start is None:
             self._start = now
@@ -75,11 +103,14 @@ class ServingMetrics:
         stat_registry.get("serving.steps").add(1)
         if tokens_emitted:
             stat_registry.get("serving.tokens_generated").add(tokens_emitted)
+        if step_seconds is not None:
+            stat_registry.histogram("serving.step_latency_ms").observe(
+                step_seconds * 1e3)
 
     # --- derived ----------------------------------------------------------
     def snapshot(self) -> dict:
         elapsed = (time.monotonic() - self._start) if self._start else 0.0
-        return {
+        snap = {
             "steps": self._steps,
             "tokens_generated": self._tokens,
             "requests_completed": self._completed,
@@ -90,3 +121,9 @@ class ServingMetrics:
             "mean_ttft_ms": (self._ttft_sum / self._ttft_count * 1e3
                              if self._ttft_count else 0.0),
         }
+        for name in self.HISTOGRAMS:
+            h = stat_registry.histogram(name).snapshot()
+            key = name[len("serving."):]
+            snap[key] = {k: h[k] for k in
+                         ("count", "mean", "p50", "p95", "p99")}
+        return snap

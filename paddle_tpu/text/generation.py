@@ -214,6 +214,7 @@ def generate(model, input_ids, max_new_tokens: int = 32, end_id: int = 0,
     [B, K, T] (beam_search) continuations, T = max_new_tokens."""
     from ..nn.decode import beam_search_decode, greedy_search_decode
     from ..tensor import Tensor
+    from ..utils.profiler import RecordEvent
 
     ids = input_ids._value if isinstance(input_ids, Tensor) \
         else jnp.asarray(np.asarray(input_ids))
@@ -230,32 +231,40 @@ def generate(model, input_ids, max_new_tokens: int = 32, end_id: int = 0,
     step_fn, init_state = make_gpt_decode_step(model, max_len)
 
     if decode_strategy == "greedy":
-        state = init_state(B)
-        # prefill all but the last prompt token; the decode loop's first
-        # step consumes the last one and emits new token #1
-        if P > 1:
-            state, _ = prefill(step_fn, state, ids[:, :-1])
-        out_ids, scores = greedy_search_decode(
-            step_fn, state, batch_size=B, max_len=max_new_tokens,
-            bos_id=ids[:, -1], end_id=end_id)
-        return Tensor(out_ids), Tensor(scores)
+        with RecordEvent("text.generation", strategy="greedy",
+                         batch=B, prompt_len=P):
+            state = init_state(B)
+            # prefill all but the last prompt token; the decode loop's
+            # first step consumes the last one and emits new token #1
+            if P > 1:
+                with RecordEvent("text.generation/prefill"):
+                    state, _ = prefill(step_fn, state, ids[:, :-1])
+            with RecordEvent("text.generation/decode"):
+                out_ids, scores = greedy_search_decode(
+                    step_fn, state, batch_size=B, max_len=max_new_tokens,
+                    bos_id=ids[:, -1], end_id=end_id)
+            return Tensor(out_ids), Tensor(scores)
     if decode_strategy == "beam_search":
         K = num_beams
         # prefill ONCE per sequence (batch B), then expand the cache to
         # the B*K beam lanes — K identical prompt forwards would be pure
         # waste (review r4)
-        state_b = init_state(B)
-        if P > 1:
-            state_b, _ = prefill(step_fn, state_b, ids[:, :-1])
-        state = jax.tree_util.tree_map(
-            lambda s: jnp.repeat(s, K, axis=0), state_b)
-        lanes = jnp.repeat(ids, K, axis=0)                   # [B*K, P]
-        res = beam_search_decode(
-            step_fn, state, batch_size=B, beam_size=K,
-            max_len=max_new_tokens,
-            bos_id=lanes[:, -1].reshape(B, K), end_id=end_id,
-            length_penalty=length_penalty)
-        return Tensor(res.ids), Tensor(res.scores)
+        with RecordEvent("text.generation", strategy="beam_search",
+                         batch=B, prompt_len=P, num_beams=K):
+            state_b = init_state(B)
+            if P > 1:
+                with RecordEvent("text.generation/prefill"):
+                    state_b, _ = prefill(step_fn, state_b, ids[:, :-1])
+            state = jax.tree_util.tree_map(
+                lambda s: jnp.repeat(s, K, axis=0), state_b)
+            lanes = jnp.repeat(ids, K, axis=0)               # [B*K, P]
+            with RecordEvent("text.generation/decode"):
+                res = beam_search_decode(
+                    step_fn, state, batch_size=B, beam_size=K,
+                    max_len=max_new_tokens,
+                    bos_id=lanes[:, -1].reshape(B, K), end_id=end_id,
+                    length_penalty=length_penalty)
+            return Tensor(res.ids), Tensor(res.scores)
     raise ValueError(
         f"decode_strategy must be 'greedy' or 'beam_search', "
         f"got {decode_strategy!r}")

@@ -1,68 +1,101 @@
 """Profiler (reference: fluid/profiler.py:255 profiler context,
 platform/profiler.h:127 RecordEvent, device_tracer.h CUPTI timeline).
 
-TPU-native: jax.profiler (XPlane/TensorBoard trace — libtpu's tracer subsumes
-DeviceTracer) + named_scope RecordEvent analog + a host-side event aggregator
-for the reference's summary table.
+TPU-native: jax.profiler (XPlane/TensorBoard trace — libtpu's tracer
+subsumes DeviceTracer) + named_scope RecordEvent analog.  RecordEvent is
+rebased on ``paddle_tpu.profiler.tracer`` — every event is a span on the
+thread-local span stack (parent/child links, Chrome-trace exportable via
+``paddle_tpu.profiler.export_chrome_trace``) AND a jax.named_scope, so
+the same name shows up in the XPlane/device timeline.  The summary table
+reads the tracer's aggregate registry, which is lock-protected (the old
+module-level defaultdict dropped counts under concurrent ``__exit__``).
 """
 from __future__ import annotations
 
 import contextlib
-import time
-from collections import defaultdict
 
 import jax
 
-_events = defaultdict(lambda: [0, 0.0])  # name -> [calls, total_s]
+from ..profiler import chrome_trace as _chrome_trace
+from ..profiler.tracer import tracer as _tracer
+
 _active_trace_dir = None
 
 
 class RecordEvent:
-    """RAII op-scope timer (platform/profiler.h:127)."""
+    """RAII op-scope timer (platform/profiler.h:127): a hierarchical
+    tracer span + a jax.named_scope (device-timeline annotation)."""
 
-    def __init__(self, name):
+    def __init__(self, name, **args):
         self.name = name
+        self._args = args or None
 
     def __enter__(self):
         self._scope = jax.named_scope(self.name)
         self._scope.__enter__()
-        self._t0 = time.perf_counter()
+        self._span = _tracer.begin(self.name, self._args)
         return self
 
     def __exit__(self, *exc):
-        dt = time.perf_counter() - self._t0
-        ev = _events[self.name]
-        ev[0] += 1
-        ev[1] += dt
+        _tracer.end(self._span)
         self._scope.__exit__(*exc)
         return False
 
 
-def start_profiler(state="All", tracer_option="Default", log_dir="/tmp/paddle_tpu_prof"):
+def start_profiler(state="All", tracer_option="Default",
+                   log_dir="/tmp/paddle_tpu_prof"):
+    """Start the device trace (jax.profiler / XPlane) AND host-span
+    retention (Chrome-trace exportable)."""
     global _active_trace_dir
     _active_trace_dir = log_dir
+    _tracer.enable(clear=True)
     jax.profiler.start_trace(log_dir)
 
 
-def stop_profiler(sorted_key=None, profile_path=None):
+def stop_profiler(sorted_key=None, profile_path=None, timeline_path=None):
+    """Stop tracing.  ``profile_path`` receives the summary TABLE (the
+    reference wrote its profile proto there; the old code ignored it);
+    ``timeline_path`` receives the Chrome-trace JSON of the host spans."""
     global _active_trace_dir
     if _active_trace_dir is not None:
         jax.profiler.stop_trace()
         _active_trace_dir = None
+    # symmetric with start_profiler's enable(): stop retaining spans, or
+    # a long-lived process would buffer up to the 1M-span cap forever
+    # (retained spans stay readable/exportable until the next enable)
+    _tracer.disable()
+    if timeline_path:
+        _chrome_trace.export_chrome_trace(timeline_path)
+    if profile_path:
+        with open(profile_path, "w") as f:
+            f.write(summary(sorted_key or "total") + "\n")
     if sorted_key:
         print(summary(sorted_key))
 
 
 def reset_profiler():
-    _events.clear()
+    _tracer.reset_aggregates()
+    _tracer.clear()
 
 
 def summary(sorted_key="total"):
-    rows = sorted(_events.items(), key=lambda kv: -kv[1][1])
-    lines = [f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
-    for name, (calls, total) in rows:
-        lines.append(f"{name:<40}{calls:>8}{total * 1e3:>12.3f}"
-                     f"{total * 1e3 / max(calls, 1):>12.3f}")
+    aggs = _tracer.aggregates()
+    key_fns = {
+        "total": lambda kv: -kv[1]["total_s"],
+        "calls": lambda kv: -kv[1]["calls"],
+        "max": lambda kv: -kv[1]["max_s"],
+        "min": lambda kv: -kv[1]["min_s"],
+        "ave": lambda kv: -kv[1]["avg_s"],
+    }
+    rows = sorted(aggs.items(), key=key_fns.get(sorted_key,
+                                                key_fns["total"]))
+    lines = [f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"
+             f"{'Min(ms)':>12}{'Max(ms)':>12}"]
+    for name, a in rows:
+        lines.append(
+            f"{name:<40}{a['calls']:>8}{a['total_s'] * 1e3:>12.3f}"
+            f"{a['avg_s'] * 1e3:>12.3f}{a['min_s'] * 1e3:>12.3f}"
+            f"{a['max_s'] * 1e3:>12.3f}")
     return "\n".join(lines)
 
 

@@ -4,13 +4,29 @@ import os
 import subprocess
 import sys
 
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def test_api_spec_matches_golden():
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     res = subprocess.run(
-        [sys.executable, os.path.join(root, "tools", "gen_api_spec.py")],
+        [sys.executable, os.path.join(ROOT, "tools", "gen_api_spec.py")],
         capture_output=True, text=True, timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert res.returncode == 0, (
         "public API surface diverged from API.spec:\n" + res.stdout[-3000:]
         + "\nReview the change, then run tools/gen_api_spec.py --update")
+
+
+def test_check_api_spec_inprocess():
+    """tools/check_api_spec.py drift check — runs the same diff
+    IN-PROCESS (the package is already imported by the suite, so this is
+    fast) and must agree that the committed spec matches."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import check_api_spec
+    finally:
+        sys.path.pop(0)
+    removed, added = check_api_spec.check()
+    assert not removed and not added, (
+        f"API drift — removed: {removed[:10]}, added: {added[:10]}; "
+        "run tools/gen_api_spec.py --update after reviewing")

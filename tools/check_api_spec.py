@@ -1,0 +1,56 @@
+"""API.spec drift check (reference: tools/check_api_approvals.sh — CI
+fails when a PR changes a public signature without updating the spec).
+
+Importable (``check()`` -> (removed, added)) so the tier-1 test can run
+it IN-PROCESS — no subprocess re-import of the whole package — and
+runnable as a CLI (exit 1 on drift, like gen_api_spec.py without
+--update)."""
+from __future__ import annotations
+
+import os
+import sys
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_TOOLS_DIR)
+
+SPEC_PATH = os.path.join(_ROOT, "API.spec")
+
+
+def _gen_api_spec():
+    """Import the sibling generator without permanently mutating
+    sys.path (an import-time insert would leak into every process that
+    imports this module, e.g. the whole pytest session)."""
+    sys.path.insert(0, _TOOLS_DIR)
+    try:
+        import gen_api_spec  # noqa: PLC0415 (needs tools/ on the path)
+    finally:
+        sys.path.remove(_TOOLS_DIR)
+    return gen_api_spec
+
+
+def check():
+    """Regenerate the spec from the live package and diff against the
+    committed golden file; returns (removed, added) sorted line lists."""
+    cur = set(_gen_api_spec().collect())
+    with open(SPEC_PATH) as f:
+        gold = set(f.read().splitlines())
+    return sorted(gold - cur), sorted(cur - gold)
+
+
+def main() -> int:
+    removed, added = check()
+    if removed or added:
+        for r in removed[:20]:
+            print(f"- {r}")
+        for a in added[:20]:
+            print(f"+ {a}")
+        print(f"API surface drift: {len(removed)} removed, {len(added)} "
+              "added vs API.spec. Review, then run "
+              "tools/gen_api_spec.py --update")
+        return 1
+    print("API.spec is in sync.")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -35,6 +35,7 @@ PUBLIC_MODULES = [
     "paddle_tpu.onnx",
     "paddle_tpu.optimizer",
     "paddle_tpu.optimizer.lr",
+    "paddle_tpu.profiler",
     "paddle_tpu.serving",
     "paddle_tpu.slim",
     "paddle_tpu.static",
