@@ -61,18 +61,25 @@ class Config:
 
     # --- serving (paddle_tpu.serving continuous-batching engine) ------------
     def enable_serving(self, max_batch_size=8, page_size=16, num_pages=None,
-                       max_seq_len=None, eos_id=0):
+                       max_seq_len=None, eos_id=0, prefill_chunk=64,
+                       sync_mode=False, fused_steps=1):
         """Opt in to the continuous-batching serving engine
-        (docs/SERVING.md).  Stores the paged-KV / scheduler knobs; build
-        the engine with ``paddle_tpu.serving.create_serving_engine(model,
-        config)``.  Not reference API — the reference's serving story
-        stops at AnalysisPredictor; this is the TPU-native extension."""
+        (docs/SERVING.md).  Stores the paged-KV / scheduler knobs plus the
+        pipelining knobs (``prefill_chunk`` tokens per prefill program,
+        ``sync_mode`` consume-immediately escape hatch, ``fused_steps``
+        K-step fused decode); build the engine with
+        ``paddle_tpu.serving.create_serving_engine(model, config)``.  Not
+        reference API — the reference's serving story stops at
+        AnalysisPredictor; this is the TPU-native extension."""
         self._serving = {
             "max_batch_size": int(max_batch_size),
             "page_size": int(page_size),
             "num_pages": None if num_pages is None else int(num_pages),
             "max_seq_len": None if max_seq_len is None else int(max_seq_len),
             "eos_id": int(eos_id),
+            "prefill_chunk": int(prefill_chunk),
+            "sync_mode": bool(sync_mode),
+            "fused_steps": int(fused_steps),
         }
 
     def serving_enabled(self) -> bool:

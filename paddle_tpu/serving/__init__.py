@@ -11,8 +11,12 @@ Components
                                 global device page pools
 - ``scheduler.Scheduler``       admission / prefill-decode mixing /
                                 preemption / retirement policy
-- ``engine.ServingEngine``      synchronous core: add_request / step /
-                                drain driving the paged GPT decode step
+- ``engine.ServingEngine``      pipelined core: add_request / step /
+                                drain — chunked parallel prefill,
+                                device-resident decode state, and a
+                                dispatch-ahead decode loop over the
+                                paged GPT step (``sync_mode=True``
+                                restores the synchronous behavior)
 - ``metrics.ServingMetrics``    per-step observability through
                                 framework.monitor's StatRegistry
 

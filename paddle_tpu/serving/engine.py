@@ -1,40 +1,60 @@
-"""ServingEngine — the synchronous continuous-batching core.
+"""ServingEngine — the pipelined continuous-batching core.
 
-``add_request`` enqueues, ``step`` runs one scheduler iteration
-(admission + prefill, then one decode position for every running
-sequence), ``drain`` steps until idle.  Synchronous by design: each step
-issues one jitted device program and one small host transfer (the next
-token per lane); an async server front-end can drive ``step`` from its
-own loop without this module growing threads.
+``add_request`` enqueues, ``step`` runs one scheduler iteration,
+``drain`` steps until idle.  The hot path is ASYNCHRONOUS: decode state
+(tokens / positions / page tables) lives on device between steps,
+``step`` dispatches decode step N and only then consumes step N-1's
+tokens (double-buffered ``jax.device_get``), so host-side scheduling,
+EOS scanning and metrics hide behind device compute instead of adding to
+the critical path.  ``sync_mode=True`` restores the PR-1
+dispatch-then-consume-immediately behavior; either way the token stream
+is identical to ``text.generation.generate(decode_strategy="greedy")``.
 
 Execution model
 ---------------
-- The paged GPT decode step comes from
-  ``text.generation.make_gpt_paged_decode_step`` — same math as the
-  dense ``make_gpt_decode_step`` (the parity anchor), but KV lives in
-  the global page pools and attention goes through
-  ``ops.attention.paged_attention``.
-- The decode batch is padded to the scheduler's bucket, so jax.jit
-  RETRACES ONLY ON BUCKET CHANGE — admissions and retirements inside a
-  bucket reuse the compiled program.  Prefill is likewise bucketed by
-  prompt length (next power of two).
-- Inactive lanes carry pos=0 and an all-zero page table: their scatter
-  lands in the reserved trash page 0 and their logits are discarded on
-  host, so no per-lane branching exists on device.
-- Greedy decoding only (argmax happens on device; only [bucket] int32
-  next-tokens cross to host per step).  Output is token-identical to
-  ``text.generation.generate(decode_strategy="greedy")``.
+- **Chunked parallel prefill**: admission teacher-forces ``prompt[:-1]``
+  through ``text.generation.make_gpt_paged_prefill_step`` — a whole
+  chunk of up to ``prefill_chunk`` positions per device program (causal
+  within the chunk via per-query ragged seq_lens, paged-KV writes), so a
+  prompt costs O(P / C) dispatches instead of the former token-at-a-time
+  scan's O(P) sequential steps.  Chunk shapes come from
+  ``utils.bucketing.chunk_schedule`` (full chunks + one pow2 tail), so
+  the trace set stays {pow2 <= C}.
+- **Device-resident decode state**: tokens/pos/page-tables are jax
+  arrays reused across steps; the decode program itself advances them
+  (argmax feed-back, pos+1).  Host events touch only deltas: an
+  admission uploads one lane (token, pos, table row), retirement /
+  preemption zeroes one lane, page growth re-uploads one table row.
+  The per-step numpy rebuild + full H2D upload of the synchronous
+  engine is gone; in steady state a step performs no implicit host
+  transfer at all (``jax.transfer_guard``-clean, see
+  tests/test_serving_async.py).
+- **Dispatch-ahead decode**: one decode step stays in flight; EOS and
+  budget retirement decisions lag one step (the lagged lane decodes one
+  junk token into its still-allocated pages — harmless, dropped on
+  host), which is invisible in the emitted stream.  When no admissions
+  are pending and every lane has >= ``fused_steps`` budget left, a
+  fused K-step ``lax.fori_loop`` decode
+  (``make_gpt_paged_fused_decode_step``) amortizes K tokens per dispatch
+  and per host transfer (pages for pos+K are reserved up front;
+  exhaustion falls back to single steps).
+- The decode batch is padded to a pow2 lane bucket, so jax.jit RETRACES
+  ONLY ON BUCKET CHANGE; inactive lanes carry pos=0 and an all-zero page
+  table (their scatter lands in the reserved trash page 0), so no
+  per-lane branching exists on device.  Greedy decoding only.
 """
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..profiler.jit_cost import cost_registry, profiled_jit
+from ..utils.bucketing import chunk_schedule, smallest_bucket
 from ..utils.profiler import RecordEvent
 from .kv_cache import PagedKVCache
 from .metrics import ServingMetrics
@@ -43,11 +63,18 @@ from .scheduler import Request, Scheduler, Sequence
 __all__ = ["ServingEngine", "create_serving_engine"]
 
 
-def _next_pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return p
+class _Pending:
+    """One in-flight decode dispatch: the device token handle plus the
+    lane binding it was dispatched against (seq, epoch) — the epoch drops
+    results that a preemption has since invalidated."""
+
+    __slots__ = ("tokens", "steps", "lanes")
+
+    def __init__(self, tokens, steps: int,
+                 lanes: Tuple[Optional[Tuple[Sequence, int]], ...]):
+        self.tokens = tokens        # [B] (steps == 1) or [steps, B] int32
+        self.steps = steps
+        self.lanes = lanes
 
 
 class ServingEngine:
@@ -59,8 +86,13 @@ class ServingEngine:
                  max_seq_len: Optional[int] = None,
                  bucket_sizes: Optional[List[int]] = None,
                  eos_id: int = 0,
-                 metrics: Optional[ServingMetrics] = None):
-        from ..text.generation import make_gpt_paged_decode_step
+                 metrics: Optional[ServingMetrics] = None,
+                 prefill_chunk: int = 64,
+                 sync_mode: bool = False,
+                 fused_steps: int = 1):
+        from ..text.generation import (make_gpt_paged_decode_step,
+                                       make_gpt_paged_fused_decode_step,
+                                       make_gpt_paged_prefill_step)
 
         self.model = model
         self.page_size = int(page_size)
@@ -80,39 +112,76 @@ class ServingEngine:
                                    bucket_sizes=bucket_sizes)
         self.metrics = metrics or ServingMetrics()
         self.eos_id = int(eos_id)
+        self.prefill_chunk = max(1, int(prefill_chunk))
+        self.sync_mode = bool(sync_mode)
+        self.fused_steps = max(1, int(fused_steps))
         self.outputs: Dict[str, np.ndarray] = {}
         self._ttft_recorded = set()      # per REQUEST, preemption-proof
 
         step_fn, init_pages = make_gpt_paged_decode_step(
             model, self.page_size, self.pages_per_seq)
+        prefill_fn, _ = make_gpt_paged_prefill_step(
+            model, self.page_size, self.pages_per_seq)
         self._kv = init_pages(num_pages)
 
         def _decode(tokens, pos, page_tables, kv):
             logits, kv = step_fn(tokens, pos, page_tables, kv)
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), kv
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # the program advances its own state: argmax feeds back as
+            # the next input token, pos steps forward — nothing for the
+            # host to rebuild or upload between steady-state steps
+            return nxt, pos + 1, kv
 
-        def _prefill(tokens, positions, page_table_row, kv):
-            def body(carry, tp):
-                tok, p = tp
-                _, carry = step_fn(tok[None], p[None], page_table_row[None],
-                                   carry)
-                return carry, None
+        def _lane_set(tokens, pos, page_tables, lane, tok, p, row):
+            return (tokens.at[lane].set(tok), pos.at[lane].set(p),
+                    page_tables.at[lane].set(row))
 
-            kv, _ = jax.lax.scan(body, kv, (tokens, positions))
-            return kv
+        def _row_set(page_tables, lane, row):
+            return page_tables.at[lane].set(row)
 
-        # jit caches per shape: decode retraces per batch bucket, prefill
-        # per prompt-length bucket — both change rarely by construction.
-        # The kv pools are donated: self._kv is reassigned from the result
+        # jit caches per shape: decode retraces per lane bucket, prefill
+        # per chunk bucket — both change rarely by construction.  The kv
+        # pools are donated: self._kv is reassigned from the result
         # right after each call, letting XLA alias the .at[].set update
         # in place instead of copying every layer's page pool per token
         # (platforms without donation support just warn and copy).
         # profiled_jit attributes FLOPs/bytes + compile count/time to
-        # "serving.decode" / "serving.prefill" in profiler.cost_registry.
+        # "serving.*" names in profiler.cost_registry.
         self._decode_jit = profiled_jit("serving.decode", _decode,
                                         donate_argnums=(3,))
-        self._prefill_jit = profiled_jit("serving.prefill", _prefill,
-                                         donate_argnums=(3,))
+        self._prefill_jit = profiled_jit("serving.prefill", prefill_fn,
+                                         donate_argnums=(4,))
+        # NOT donated: self._tokens aliases the newest _Pending entry's
+        # handle (single-step dispatch returns one buffer for both), so
+        # donating it into a lane clear would delete tokens still
+        # awaiting consumption — the arrays are [bucket] ints, copying
+        # is nothing
+        self._lane_set_jit = profiled_jit("serving.lane_update", _lane_set)
+        self._row_set_jit = profiled_jit("serving.table_update", _row_set)
+        self._fused_jit = None
+        if self.fused_steps > 1:
+            fused_fn, _ = make_gpt_paged_fused_decode_step(
+                model, self.page_size, self.pages_per_seq, self.fused_steps)
+            self._fused_jit = profiled_jit("serving.decode_fused", fused_fn,
+                                           donate_argnums=(3,))
+
+        # device-resident decode state (grown/rebuilt lazily)
+        self._tokens = None              # [bucket] int32
+        self._pos = None                 # [bucket] int32
+        self._tables = None              # [bucket, pages_per_seq] int32
+        self._state_bucket = 0
+        self._lanes: List[Optional[Sequence]] = []
+        self._lane_ids: List = []        # device () int32 per lane index
+        self._zero_i32 = jax.device_put(np.int32(0))
+        self._zero_row = jax.device_put(
+            np.zeros((self.pages_per_seq,), np.int32))
+        self._pending: Deque[_Pending] = deque()
+        self._last_dispatch: Optional[float] = None
+        # page count per seq_id as last uploaded to the device table —
+        # ANY growth (ensure_decode_pages or the fused horizon reserve)
+        # must re-upload the row before the next dispatch, or writes
+        # past the stale row land in the trash page
+        self._uploaded_pages: Dict[str, int] = {}
 
     # --- request intake ---------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int = 32,
@@ -157,122 +226,318 @@ class ServingEngine:
         self.scheduler.add(req)
         return req.request_id
 
+    # --- device-resident lane state ---------------------------------------
+    def _grow_state(self, new_bucket: int):
+        """Pad the device state up to ``new_bucket`` lanes (device-side
+        pad — no host re-upload of live lanes).  Only called with the
+        pipeline drained: in-flight steps pin the lane layout."""
+        assert not self._pending
+        M = self.pages_per_seq
+        if self._state_bucket == 0:
+            self._tokens = jnp.zeros((new_bucket,), jnp.int32)
+            self._pos = jnp.zeros((new_bucket,), jnp.int32)
+            self._tables = jnp.zeros((new_bucket, M), jnp.int32)
+        else:
+            pad = new_bucket - self._state_bucket
+            self._tokens = jnp.pad(self._tokens, (0, pad))
+            self._pos = jnp.pad(self._pos, (0, pad))
+            self._tables = jnp.pad(self._tables, ((0, pad), (0, 0)))
+        self._lanes.extend([None] * (new_bucket - self._state_bucket))
+        self._state_bucket = new_bucket
+        self._lane_ids = [jax.device_put(np.int32(i))
+                          for i in range(new_bucket)]
+
+    def _bind_lane(self, seq: Sequence) -> int:
+        """Bind an admitted sequence to the lowest free lane, growing the
+        bucket when none is free; uploads ONLY that lane's delta."""
+        lane = next((i for i, s in enumerate(self._lanes) if s is None), -1)
+        if lane < 0:
+            self._grow_state(smallest_bucket(len(self._lanes) + 1,
+                                             self.scheduler.bucket_sizes))
+            lane = self._lanes.index(None)
+        self._lanes[lane] = seq
+        row = jax.device_put(self.cache.page_table_row(seq.seq_id))
+        self._tokens, self._pos, self._tables = self._lane_set_jit(
+            self._tokens, self._pos, self._tables, self._lane_ids[lane],
+            jax.device_put(np.int32(seq.next_token)),
+            jax.device_put(np.int32(seq.pos)), row)
+        self._uploaded_pages[seq.seq_id] = self.cache.seq_pages(seq.seq_id)
+        return lane
+
+    def _clear_lane(self, lane: int):
+        """Zero one lane on device (pos=0 + all-trash page table — the
+        inactive-lane convention the decode step relies on)."""
+        self._tokens, self._pos, self._tables = self._lane_set_jit(
+            self._tokens, self._pos, self._tables, self._lane_ids[lane],
+            self._zero_i32, self._zero_i32, self._zero_row)
+
+    def _refresh_row(self, lane: int, seq: Sequence):
+        """Page growth changed the sequence's table — re-upload one row."""
+        row = jax.device_put(self.cache.page_table_row(seq.seq_id))
+        self._tables = self._row_set_jit(self._tables,
+                                         self._lane_ids[lane], row)
+        self._uploaded_pages[seq.seq_id] = self.cache.seq_pages(seq.seq_id)
+
+    def _sync_rows(self, active: List[Tuple[int, "Sequence"]]):
+        """Re-upload every device table row whose host allocation grew
+        since its last upload — MUST run between any page allocation and
+        the dispatch that writes into the new pages."""
+        for lane, seq in active:
+            if (self.cache.seq_pages(seq.seq_id)
+                    != self._uploaded_pages.get(seq.seq_id)):
+                self._refresh_row(lane, seq)
+
+    def _maybe_shrink(self):
+        """With the pipeline drained, compact lanes down to the smallest
+        covering bucket (rebuild from the host mirror — every lane's
+        token/pos is known once nothing is in flight), or drop the state
+        entirely when no lane is live."""
+        if self._pending or not self._state_bucket:
+            return
+        active = [s for s in self._lanes if s is not None]
+        if not active:
+            self._tokens = self._pos = self._tables = None
+            self._state_bucket = 0
+            self._lanes = []
+            self._lane_ids = []
+            # idle boundary: the next burst's first dispatch must not
+            # record the idle period as a "gap" (it would own p99/max)
+            self._last_dispatch = None
+            return
+        desired = smallest_bucket(len(active), self.scheduler.bucket_sizes)
+        if desired >= self._state_bucket:
+            return
+        tokens = np.zeros((desired,), np.int32)
+        pos = np.zeros((desired,), np.int32)
+        tables = np.zeros((desired, self.pages_per_seq), np.int32)
+        for i, s in enumerate(active):
+            tokens[i] = s.next_token
+            pos[i] = s.pos
+            tables[i] = self.cache.page_table_row(s.seq_id)
+        self._tokens = jax.device_put(tokens)
+        self._pos = jax.device_put(pos)
+        self._tables = jax.device_put(tables)
+        self._lanes = active + [None] * (desired - len(active))
+        self._state_bucket = desired
+        self._lane_ids = [jax.device_put(np.int32(i))
+                          for i in range(desired)]
+
     # --- prefill ----------------------------------------------------------
     def _prefill_seq(self, seq: Sequence):
-        """Teacher-force prompt[:-1] through the paged cache.  The scan
-        length is bucketed (next pow2, capped at max_seq_len) so prompt
-        lengths share traces; padded steps write junk into the trash page
-        / to-be-overwritten slots and are never attended to."""
+        """Teacher-force prompt[:-1] through the paged cache in parallel
+        chunks of up to ``prefill_chunk`` positions — O(P/C) dispatches.
+        Padded tail positions scatter into the trash page (valid_len
+        mask), so chunk shapes are pow2 buckets shared across prompts."""
         prompt = seq.request.prompt
         n = prompt.size - 1
         if n == 0:
             return
-        bucket = min(_next_pow2(n), self.max_seq_len)
-        tokens = np.zeros((bucket,), np.int32)
-        tokens[:n] = prompt[:-1]
-        positions = np.arange(bucket, dtype=np.int32)
-        row = self.cache.page_table_row(seq.seq_id)
+        spans = chunk_schedule(n, self.prefill_chunk)
+        row = jax.device_put(self.cache.page_table_row(seq.seq_id))
+        n_dev = jax.device_put(np.int32(n))
         t0 = time.perf_counter()
-        with RecordEvent("serving/prefill", bucket=bucket,
+        with RecordEvent("serving/prefill", chunks=len(spans),
                          prompt_len=int(prompt.size)):
-            self._kv = self._prefill_jit(jnp.asarray(tokens),
-                                         jnp.asarray(positions),
-                                         jnp.asarray(row), self._kv)
+            for start, size in spans:
+                ctok = np.zeros((size,), np.int32)
+                valid = min(start + size, n) - start
+                ctok[:valid] = prompt[start:start + valid]
+                cpos = (start + np.arange(size)).astype(np.int32)
+                with RecordEvent("serving/prefill_chunk", size=size):
+                    self._kv = self._prefill_jit(
+                        jax.device_put(ctok), jax.device_put(cpos),
+                        row, n_dev, self._kv)
             # sync inside the timed window: dispatch is async, and the
             # decode that follows needs this kv anyway — without the
             # block the histogram would record µs dispatch times
             jax.block_until_ready(self._kv)
-        self.metrics.on_prefill(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self.metrics.on_prefill(dt)
+        self.metrics.on_prefill_chunks(len(spans), n, dt)
+
+    # --- pipelined decode -------------------------------------------------
+    def _remaining(self, seq: Sequence) -> int:
+        """Dispatch budget left: max_new_tokens minus tokens already
+        DISPATCHED (seq.pos advances at dispatch, ahead of consume)."""
+        return (seq.request.max_new_tokens
+                - (seq.pos - (seq.request.prompt.size - 1)))
+
+    def _dispatch(self, active: List[Tuple[int, Sequence]]) -> int:
+        """Issue one decode program (single or fused K-step) against the
+        device-resident state; returns the number of steps dispatched."""
+        k = 1
+        if (self._fused_jit is not None and not self.sync_mode
+                and not self.scheduler.waiting
+                and min(self._remaining(s) for _, s in active)
+                >= self.fused_steps):
+            # reserve pages covering pos+K for every lane WITHOUT
+            # preemption — speculative capacity must not evict anyone;
+            # partial reservations are kept (they're used within K steps)
+            if all(self.cache.allocate(s.seq_id, s.pos + self.fused_steps)
+                   for _, s in active):
+                k = self.fused_steps
+        # the reservation above (and any partial one) may have grown
+        # tables — the device rows must cover every position this
+        # program writes, or the writes fall into the trash page
+        self._sync_rows(active)
+        t = time.perf_counter()
+        if self._last_dispatch is not None:
+            self.metrics.on_dispatch_gap(t - self._last_dispatch)
+        self._last_dispatch = t
+        with RecordEvent("serving/decode_step", bucket=self._state_bucket,
+                         steps=k):
+            if k == 1:
+                out, self._pos, self._kv = self._decode_jit(
+                    self._tokens, self._pos, self._tables, self._kv)
+                self._tokens = out
+            else:
+                out, self._tokens, self._pos, self._kv = self._fused_jit(
+                    self._tokens, self._pos, self._tables, self._kv)
+        snapshot = tuple((s, s.epoch) if s is not None else None
+                         for s in self._lanes)
+        for _, s in active:
+            s.pos += k                   # host mirror: dispatch-advanced
+        self._pending.append(_Pending(out, k, snapshot))
+        return k
+
+    def _consume_one(self) -> int:
+        """Block on the OLDEST in-flight step's tokens (the newest keeps
+        running), apply them to the host mirror, retire finished lanes;
+        returns tokens emitted."""
+        ent = self._pending.popleft()
+        t0 = time.perf_counter()
+        toks = np.asarray(jax.device_get(ent.tokens))
+        self.metrics.on_decode(time.perf_counter() - t0)
+        rows = toks if ent.steps > 1 else toks[None, :]
+        now = time.monotonic()
+        emitted = 0
+        for krow in rows:
+            for lane, binding in enumerate(ent.lanes):
+                if binding is None:
+                    continue
+                seq, epoch = binding
+                # retired (one-step EOS lag) or preempted-since (epoch
+                # bump): the device token is junk — drop it
+                if seq.done or seq.epoch != epoch:
+                    continue
+                tok = int(krow[lane])
+                if seq.first_token_time is None:
+                    seq.first_token_time = now
+                    if seq.seq_id not in self._ttft_recorded:
+                        self._ttft_recorded.add(seq.seq_id)
+                        self.metrics.on_first_token(
+                            seq.request.arrival_time, now)
+                seq.generated.append(tok)
+                seq.next_token = tok
+                emitted += 1
+                if (tok == self.eos_id
+                        or seq.num_generated
+                        >= seq.request.max_new_tokens):
+                    self._retire(seq, lane)
+        return emitted
+
+    def _retire(self, seq: Sequence, lane: int):
+        """EOS / budget retirement: final — the id never reappears."""
+        self.outputs[seq.seq_id] = np.asarray(seq.generated, np.int32)
+        self.scheduler.finish(seq)
+        seq.done = True
+        self._ttft_recorded.discard(seq.seq_id)
+        self._uploaded_pages.pop(seq.seq_id, None)
+        self.metrics.on_completion()
+        if (lane < len(self._lanes)) and self._lanes[lane] is seq:
+            self._lanes[lane] = None
+            self._clear_lane(lane)
+
+    def _sync_pending(self) -> int:
+        """Collapse the pipeline: consume every in-flight step."""
+        emitted = 0
+        while self._pending:
+            emitted += self._consume_one()
+        return emitted
 
     # --- one scheduler iteration -----------------------------------------
     def step(self) -> dict:
-        """Admit + prefill waiting requests, then decode one token for
-        every running sequence.  Returns the step's stats."""
+        """Admit + prefill waiting requests, then dispatch one decode
+        program and consume the previous one.  Returns the step's stats."""
         t_step = time.perf_counter()
         with RecordEvent("serving/step"):
             return self._step_inner(t_step)
 
     def _step_inner(self, t_step: float) -> dict:
         sched = self.scheduler
-        admitted = sched.admit()
-        for seq in admitted:
-            self._prefill_seq(seq)
-        self.metrics.on_admission(len(admitted))
+        admitted: List[Sequence] = []
+        emitted = 0
+        # admission needs ground truth (free lanes/pages come from
+        # retirements hiding in the pipeline), so it collapses the
+        # pipeline first; a FULL batch skips the attempt entirely and
+        # stays pipelined under queue pressure
+        if sched.waiting and len(sched.running) < sched.max_batch_size:
+            emitted += self._sync_pending()
+            admitted = sched.admit()
+            for seq in admitted:
+                self._prefill_seq(seq)
+                self._bind_lane(seq)
+            self.metrics.on_admission(len(admitted))
 
-        tokens_emitted = 0
         bucket = 0
-        decoded = 0
-        if sched.running:
-            preempted = sched.ensure_decode_pages()
+        dispatched_lanes = 0
+        active = [(i, s) for i, s in enumerate(self._lanes) if s is not None]
+        if any(self._remaining(s) > 0 for _, s in active):
+            # pages for the positions this dispatch writes; preemption
+            # may strike lanes (including ones with results in flight —
+            # their epochs are bumped, pending tokens become no-ops)
+            preempted = sched.ensure_decode_pages(
+                [s for _, s in active if self._remaining(s) > 0])
             if preempted:
                 self.metrics.on_preemption(len(preempted))
-            active = list(sched.running)
-            if active:
-                bucket = sched.bucket()
-                tokens = np.zeros((bucket,), np.int32)
-                pos = np.zeros((bucket,), np.int32)
-                tables = np.zeros((bucket, self.pages_per_seq), np.int32)
-                for i, seq in enumerate(active):
-                    tokens[i] = seq.next_token
-                    pos[i] = seq.pos
-                    tables[i] = self.cache.page_table_row(seq.seq_id)
-                t0 = time.perf_counter()
-                with RecordEvent("serving/decode_step", bucket=bucket):
-                    nxt, self._kv = self._decode_jit(
-                        jnp.asarray(tokens), jnp.asarray(pos),
-                        jnp.asarray(tables), self._kv)
-                    nxt = np.asarray(nxt)    # the step's one host sync
-                self.metrics.on_decode(time.perf_counter() - t0)
-                now = time.monotonic()
-                decoded = len(active)    # occupancy measured pre-retirement
-                for i, seq in enumerate(active):
-                    tok = int(nxt[i])
-                    if seq.first_token_time is None:
-                        seq.first_token_time = now
-                        if seq.seq_id not in self._ttft_recorded:
-                            self._ttft_recorded.add(seq.seq_id)
-                            self.metrics.on_first_token(
-                                seq.request.arrival_time, now)
-                    seq.generated.append(tok)
-                    seq.pos += 1
-                    seq.next_token = tok
-                    tokens_emitted += 1
-                    if (tok == self.eos_id
-                            or seq.num_generated
-                            >= seq.request.max_new_tokens):
-                        self.outputs[seq.seq_id] = np.asarray(
-                            seq.generated, np.int32)
-                        sched.finish(seq)
-                        # retirement is final: the id never reappears
-                        self._ttft_recorded.discard(seq.seq_id)
-                        self.metrics.on_completion()
+                for victim in preempted:
+                    self._uploaded_pages.pop(victim.seq_id, None)
+                    for i, lane_seq in enumerate(self._lanes):
+                        if lane_seq is victim:
+                            self._lanes[i] = None
+                            self._clear_lane(i)
+            active = [(i, s) for i, s in enumerate(self._lanes)
+                      if s is not None]
+            if any(self._remaining(s) > 0 for _, s in active):
+                bucket = self._state_bucket
+                dispatched_lanes = len(active)
+                self._dispatch(active)
+
+        # dispatch-ahead: keep ONE step in flight (none in sync_mode or
+        # when nothing was dispatched — then drain fully so retirements
+        # and the final outputs land)
+        target_depth = 0 if (self.sync_mode or not bucket) else 1
+        while len(self._pending) > target_depth:
+            emitted += self._consume_one()
+        self._maybe_shrink()
 
         self.metrics.on_step(
             queue_depth=sched.queue_depth(),
-            # lanes actually decoded this step (pre-retirement), so a
+            # lanes actually dispatched this step (pre-retirement), so a
             # fully-occupied step whose sequences all finish still
             # records occupancy 1.0, not 0
-            running=decoded if bucket else len(sched.running),
+            running=dispatched_lanes if bucket else len(sched.running),
             bucket=bucket, pages_in_use=self.cache.pages_in_use,
-            tokens_emitted=tokens_emitted,
+            tokens_emitted=emitted,
             step_seconds=time.perf_counter() - t_step)
         return {
             "admitted": len(admitted),
             "running": len(sched.running),
             "queue_depth": sched.queue_depth(),
             "bucket": bucket,
-            "tokens_emitted": tokens_emitted,
+            "tokens_emitted": emitted,
             "pages_in_use": self.cache.pages_in_use,
+            "in_flight": len(self._pending),
         }
 
     # --- run to completion ------------------------------------------------
     def drain(self, max_steps: int = 100_000) -> Dict[str, np.ndarray]:
-        """Step until queue and batch are empty; returns (and takes
-        ownership of) all accumulated {request_id: generated tokens} —
-        a long-lived server must consume outputs (here or via
+        """Step until queue, batch and pipeline are empty; returns (and
+        takes ownership of) all accumulated {request_id: generated
+        tokens} — a long-lived server must consume outputs (here or via
         ``take_output``) or ``self.outputs`` grows without bound."""
         steps = 0
-        while self.scheduler.has_work():
+        while self.scheduler.has_work() or self._pending:
             self.step()
             steps += 1
             if steps > max_steps:
@@ -298,6 +563,13 @@ class ServingEngine:
             "metrics": self.metrics.snapshot(),
             "cache": self.cache.stats(self.scheduler.seq_lens()),
             "preemptions": self.scheduler.num_preemptions,
+            "pipeline": {
+                "sync_mode": self.sync_mode,
+                "fused_steps": self.fused_steps,
+                "prefill_chunk": self.prefill_chunk,
+                "in_flight": len(self._pending),
+                "state_bucket": self._state_bucket,
+            },
             "jit_costs": {k: v for k, v in costs.items()
                           if k.startswith("serving.")},
         }

@@ -40,9 +40,11 @@ class ServingMetrics:
               "serving.kv_pages_in_use", "serving.batch_bucket")
     COUNTERS = ("serving.steps", "serving.tokens_generated",
                 "serving.requests_admitted", "serving.requests_completed",
-                "serving.preemptions")
+                "serving.preemptions", "serving.prefill_chunks",
+                "serving.prefill_tokens")
     HISTOGRAMS = ("serving.step_latency_ms", "serving.prefill_latency_ms",
-                  "serving.decode_latency_ms", "serving.ttft_ms")
+                  "serving.decode_latency_ms", "serving.ttft_ms",
+                  "serving.dispatch_gap_ms")
 
     def __init__(self):
         self.reset()
@@ -52,9 +54,12 @@ class ServingMetrics:
         self._steps = 0
         self._tokens = 0
         self._occupancy_sum = 0.0
+        self._occupancy_count = 0
         self._ttft_sum = 0.0
         self._ttft_count = 0
         self._completed = 0
+        self._prefill_tokens = 0
+        self._prefill_seconds = 0.0
         for name in self.GAUGES + self.COUNTERS:
             stat_registry.get(name).reset()
         for name in self.HISTOGRAMS:
@@ -82,8 +87,28 @@ class ServingMetrics:
         stat_registry.histogram("serving.prefill_latency_ms").observe(
             seconds * 1e3)
 
+    def on_prefill_chunks(self, chunks: int, tokens: int, seconds: float):
+        """Chunked-prefill accounting: ``chunks`` device programs covered
+        ``tokens`` prompt positions in ``seconds`` (the dispatch-count
+        win of parallel prefill shows up as tokens/chunks >> 1)."""
+        stat_registry.get("serving.prefill_chunks").add(int(chunks))
+        stat_registry.get("serving.prefill_tokens").add(int(tokens))
+        self._prefill_tokens += int(tokens)
+        self._prefill_seconds += seconds
+
     def on_decode(self, seconds: float):
+        """Under the pipelined engine this is the CONSUME-side wait for
+        an in-flight step's tokens — near zero when dispatch-ahead hides
+        device latency, the full step time in sync_mode."""
         stat_registry.histogram("serving.decode_latency_ms").observe(
+            seconds * 1e3)
+
+    def on_dispatch_gap(self, seconds: float):
+        """Host-side gap between consecutive decode dispatches — the
+        pipelining headline: in steady state it tracks device step time
+        (host keeps the device fed); spikes are admission/prefill or
+        host-scheduling bubbles."""
+        stat_registry.histogram("serving.dispatch_gap_ms").observe(
             seconds * 1e3)
 
     def on_step(self, *, queue_depth: int, running: int, bucket: int,
@@ -95,7 +120,11 @@ class ServingMetrics:
         self._steps += 1
         self._tokens += tokens_emitted
         if bucket:
+            # occupancy is a property of DECODE steps: consume-only
+            # steps (the pipelined engine's trailing drains) and idle
+            # steps don't dilute the mean
             self._occupancy_sum += running / bucket
+            self._occupancy_count += 1
         stat_registry.get("serving.queue_depth").set(queue_depth)
         stat_registry.get("serving.running_seqs").set(running)
         stat_registry.get("serving.kv_pages_in_use").set(pages_in_use)
@@ -116,10 +145,15 @@ class ServingMetrics:
             "requests_completed": self._completed,
             "elapsed_s": elapsed,
             "tokens_per_sec": self._tokens / elapsed if elapsed > 0 else 0.0,
-            "mean_batch_occupancy": (self._occupancy_sum / self._steps
-                                     if self._steps else 0.0),
+            "mean_batch_occupancy": (
+                self._occupancy_sum / self._occupancy_count
+                if self._occupancy_count else 0.0),
             "mean_ttft_ms": (self._ttft_sum / self._ttft_count * 1e3
                              if self._ttft_count else 0.0),
+            "prefill_tokens": self._prefill_tokens,
+            "prefill_tokens_per_sec": (
+                self._prefill_tokens / self._prefill_seconds
+                if self._prefill_seconds > 0 else 0.0),
         }
         for name in self.HISTOGRAMS:
             h = stat_registry.histogram(name).snapshot()

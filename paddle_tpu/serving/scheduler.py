@@ -30,6 +30,7 @@ from typing import Deque, List, Optional
 
 import numpy as np
 
+from ..utils.bucketing import pow2_buckets, smallest_bucket
 from .kv_cache import PagedKVCache
 
 __all__ = ["Request", "Sequence", "Scheduler"]
@@ -60,12 +61,19 @@ class Sequence:
         self.request = request
         # pos = the KV position the NEXT decode step writes; after
         # prefilling prompt[:-1] that is P-1 (the last prompt token is
-        # consumed by the first decode step, mirroring generate())
+        # consumed by the first decode step, mirroring generate()).
+        # Under the pipelined engine pos advances at DISPATCH time, so it
+        # can run ahead of len(generated) by the in-flight steps.
         self.pos = 0
         self.next_token = int(request.prompt[-1])
         self.generated: List[int] = []
         self.preemptions = 0
         self.first_token_time: Optional[float] = None
+        # epoch stamps in-flight device results: a preemption bumps it,
+        # so tokens dispatched before the reset are dropped on consume
+        # (the recompute replays them deterministically)
+        self.epoch = 0
+        self.done = False
 
     @property
     def seq_id(self) -> str:
@@ -81,6 +89,7 @@ class Sequence:
         self.next_token = int(self.request.prompt[-1])
         self.generated = []
         self.preemptions += 1
+        self.epoch += 1
 
 
 class Scheduler:
@@ -92,12 +101,7 @@ class Scheduler:
         self.cache = kv_cache
         self.max_batch_size = int(max_batch_size)
         if bucket_sizes is None:
-            bucket_sizes = []
-            b = 1
-            while b < self.max_batch_size:
-                bucket_sizes.append(b)
-                b *= 2
-            bucket_sizes.append(self.max_batch_size)
+            bucket_sizes = pow2_buckets(self.max_batch_size)
         self.bucket_sizes = sorted(set(int(b) for b in bucket_sizes))
         if self.bucket_sizes[-1] < self.max_batch_size:
             raise ValueError("largest bucket must cover max_batch_size")
@@ -138,12 +142,17 @@ class Scheduler:
         return admitted
 
     # --- decode-time page growth -----------------------------------------
-    def ensure_decode_pages(self) -> List[Sequence]:
-        """Guarantee every running sequence has a page for the position it
-        writes this step (pos), preempting the youngest other sequence on
-        exhaustion.  Returns the preempted sequences."""
+    def ensure_decode_pages(self,
+                            seqs: Optional[List[Sequence]] = None
+                            ) -> List[Sequence]:
+        """Guarantee every sequence in ``seqs`` (default: all running)
+        has a page for the position it writes this step (pos), preempting
+        the youngest other running sequence on exhaustion.  Returns the
+        preempted sequences.  The pipelined engine passes only lanes with
+        dispatch budget left — lanes merely awaiting their lagged
+        retirement must not allocate pages for junk positions."""
         preempted: List[Sequence] = []
-        for seq in list(self.running):
+        for seq in list(seqs if seqs is not None else self.running):
             if seq not in self.running:
                 continue    # became a victim earlier in this very loop
             while not self.cache.allocate(seq.seq_id, seq.pos + 1):
@@ -183,11 +192,7 @@ class Scheduler:
     def bucket(self) -> int:
         """Smallest configured bucket covering the running set (the jit
         trace key of the decode step)."""
-        n = max(1, len(self.running))
-        for b in self.bucket_sizes:
-            if b >= n:
-                return b
-        return self.bucket_sizes[-1]
+        return smallest_bucket(len(self.running), self.bucket_sizes)
 
     def seq_lens(self) -> dict:
         """{seq_id: valid KV length} for cache fragmentation stats."""

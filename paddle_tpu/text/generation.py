@@ -21,8 +21,9 @@ import numpy as np
 
 from ..jit.functional import get_state
 
-__all__ = ["make_gpt_decode_step", "make_gpt_paged_decode_step", "prefill",
-           "generate"]
+__all__ = ["make_gpt_decode_step", "make_gpt_paged_decode_step",
+           "make_gpt_paged_prefill_step", "make_gpt_paged_fused_decode_step",
+           "prefill", "generate"]
 
 
 def _ln(x, w, b, eps=1e-5):
@@ -106,29 +107,28 @@ def make_gpt_decode_step(model, max_len: int):
     return step_fn, init_state
 
 
-def make_gpt_paged_decode_step(model, page_size: int, pages_per_seq: int):
-    """Paged-KV variant of ``make_gpt_decode_step`` — the serving engine's
-    decode step (paddle_tpu/serving/engine.py).
+def _make_gpt_paged_core(model, page_size: int, pages_per_seq: int):
+    """Shared paged-KV transformer core behind the serving step builders.
 
-    Instead of a dense per-sequence [B, max_len, H, D] ring, KV lives in a
-    GLOBAL pool of fixed-size pages shared by all in-flight sequences; each
-    sequence owns a page-table row of page ids.  Builds
-    (step_fn, init_pages):
+    Returns ``(core, init_pages)`` where ``core(tokens [N], pos [N],
+    page_tables [N, M], kv, valid_len=None, with_head=True)`` runs one
+    forward over N independent query positions: each lane's new k/v is
+    scattered into page ``page_tables[n, pos // P]`` slot ``pos % P`` and
+    its attention covers positions ``< pos + 1`` of its page table.  The
+    two serving shapes are both this one computation:
 
-    ``init_pages(num_pages)`` -> {"k": [L x [N, P, H, D]], "v": ...}
+    - decode: N = batch lanes, one position per in-flight sequence
+      (``page_tables`` differs per lane);
+    - chunked prefill: N = chunk positions of ONE sequence
+      (``page_tables`` is the same row broadcast N times, per-lane
+      ``seq_lens = pos + 1`` gives exact causal masking WITHIN the chunk
+      because the whole chunk is scattered before attention runs).
 
-    ``step_fn(tokens [B], pos [B], page_tables [B, M], kv)`` ->
-    (logits [B, V], kv') — one decode position per call: the new k/v is
-    scattered into page ``page_tables[b, pos // P]`` slot ``pos % P`` and
-    attention runs over the sequence's pages masked to length pos+1 via
-    ``ops.attention`` paged attention (Pallas kernel on TPU, XLA gather
-    reference on CPU).
-
-    Page-id 0 is the reserved trash page: inactive batch lanes (pos 0,
-    all-zero page table) and positions past a sequence's allocation
-    scatter there harmlessly and are never attended to (seq_len masks
-    them), so the step needs no per-lane branching and its shape — hence
-    its trace — depends only on the batch bucket.
+    ``valid_len`` (scalar, traced) masks bucket padding: lanes with
+    ``pos >= valid_len`` scatter into the reserved trash page 0 and clamp
+    their attention span, so padded lanes can never touch live pages.
+    ``with_head=False`` skips the [N, V] logits matmul (prefill discards
+    logits — the first decode step consumes the last prompt token).
     """
     from ..ops.pallas_ops.paged_attention import paged_attention as paged_attn
 
@@ -153,7 +153,7 @@ def make_gpt_paged_decode_step(model, page_size: int, pages_per_seq: int):
 
         return {"k": [z() for _ in range(L)], "v": [z() for _ in range(L)]}
 
-    def step_fn(tokens, pos, page_tables, kv):
+    def core(tokens, pos, page_tables, kv, valid_len=None, with_head=True):
         N = tokens.shape[0]
         # clamp junk lanes (prefill bucket padding) instead of relying on
         # gather clipping: positions past the wpe table or the page table
@@ -165,6 +165,11 @@ def make_gpt_paged_decode_step(model, page_size: int, pages_per_seq: int):
                                        axis=1)[:, 0]
         slot = pos % page_size
         seq_lens = pos + 1
+        if valid_len is not None:
+            # padded lanes write to the trash page and attend to nothing
+            # past the real prompt — live pages stay untouched
+            page_idx = jnp.where(pos < valid_len, page_idx, 0)
+            seq_lens = jnp.minimum(seq_lens, valid_len)
         ks, vs = [], []
         for i in range(L):
             h = _ln(x, lp(i, "ln1.weight"), lp(i, "ln1.bias"))
@@ -185,11 +190,118 @@ def make_gpt_paged_decode_step(model, page_size: int, pages_per_seq: int):
             h2 = _ln(x, lp(i, "ln2.weight"), lp(i, "ln2.bias"))
             ff = _gelu(h2 @ lp(i, "fc1.weight") + lp(i, "fc1.bias"))
             x = x + ff @ lp(i, "fc2.weight") + lp(i, "fc2.bias")
+        kv_out = {"k": ks, "v": vs}
+        if not with_head:
+            return None, kv_out
         x = _ln(x, params["ln_f.weight"], params["ln_f.bias"])
-        out = x @ wte.T
-        return out, {"k": ks, "v": vs}
+        return x @ wte.T, kv_out                             # tied head
+
+    return core, init_pages
+
+
+def make_gpt_paged_decode_step(model, page_size: int, pages_per_seq: int):
+    """Paged-KV variant of ``make_gpt_decode_step`` — the serving engine's
+    decode step (paddle_tpu/serving/engine.py).
+
+    Instead of a dense per-sequence [B, max_len, H, D] ring, KV lives in a
+    GLOBAL pool of fixed-size pages shared by all in-flight sequences; each
+    sequence owns a page-table row of page ids.  Builds
+    (step_fn, init_pages):
+
+    ``init_pages(num_pages)`` -> {"k": [L x [N, P, H, D]], "v": ...}
+
+    ``step_fn(tokens [B], pos [B], page_tables [B, M], kv)`` ->
+    (logits [B, V], kv') — one decode position per call: the new k/v is
+    scattered into page ``page_tables[b, pos // P]`` slot ``pos % P`` and
+    attention runs over the sequence's pages masked to length pos+1 via
+    ``ops.attention`` paged attention (Pallas kernel on TPU, XLA gather
+    reference on CPU).
+
+    Page-id 0 is the reserved trash page: inactive batch lanes (pos 0,
+    all-zero page table) and positions past a sequence's allocation
+    scatter there harmlessly and are never attended to (seq_len masks
+    them), so the step needs no per-lane branching and its shape — hence
+    its trace — depends only on the batch bucket.
+    """
+    core, init_pages = _make_gpt_paged_core(model, page_size, pages_per_seq)
+
+    def step_fn(tokens, pos, page_tables, kv):
+        return core(tokens, pos, page_tables, kv)
 
     return step_fn, init_pages
+
+
+def make_gpt_paged_prefill_step(model, page_size: int, pages_per_seq: int):
+    """Chunked parallel prefill over the paged KV cache — C prompt tokens
+    per device program instead of a token-at-a-time scan, so a prompt
+    costs O(P / C) dispatches instead of O(P) sequential steps.
+
+    Builds ``(chunk_fn, init_pages)``:
+
+    ``chunk_fn(tokens [C], positions [C], page_table_row [M],
+    valid_len (), kv) -> kv'`` teacher-forces one chunk: all C k/v pairs
+    are scattered into the sequence's pages first, then every position
+    attends over the pages with ``seq_lens = pos + 1`` — exact causal
+    attention within the chunk AND over all previously-prefilled chunks,
+    through the same ragged paged-attention primitive the decode step
+    uses (Pallas kernel on TPU, XLA gather reference on CPU).  No logits
+    head: prefill output is the KV state, the first decode step consumes
+    the last prompt token (mirroring ``generate``).
+
+    ``valid_len`` masks bucket padding (positions >= valid_len scatter to
+    the trash page and are never attended), so chunk sizes can be pow2
+    buckets (utils.bucketing.chunk_schedule) without junk escaping into
+    live pages.
+    """
+    core, init_pages = _make_gpt_paged_core(model, page_size, pages_per_seq)
+
+    def chunk_fn(tokens, positions, page_table_row, valid_len, kv):
+        C = tokens.shape[0]
+        tables = jnp.broadcast_to(page_table_row[None, :],
+                                  (C, page_table_row.shape[0]))
+        _, kv = core(tokens, positions, tables, kv,
+                     valid_len=valid_len, with_head=False)
+        return kv
+
+    return chunk_fn, init_pages
+
+
+def make_gpt_paged_fused_decode_step(model, page_size: int,
+                                     pages_per_seq: int, num_steps: int):
+    """Fused K-step greedy decode: one device program advances every lane
+    ``num_steps`` positions through a ``lax.fori_loop`` (KV pools carried
+    in-place through the loop), returning all K tokens in one [K, B]
+    transfer — K fewer dispatches and K fewer host round-trips per token
+    when the engine knows no admission can interleave.
+
+    Builds ``(fused_fn, init_pages)``:
+
+    ``fused_fn(tokens [B], pos [B], page_tables [B, M], kv) ->
+    (out_tokens [K, B], tokens' [B], pos' [B], kv')`` — greedy argmax is
+    fed back inside the loop, so the emitted stream is identical to K
+    single steps.  EOS cannot retire a lane mid-loop; the engine drops
+    post-EOS tokens on host (the one-step-lag rule, just K steps wide)
+    and must pre-reserve pages covering ``pos + K`` for every live lane.
+    """
+    if num_steps < 1:
+        raise ValueError("num_steps must be >= 1")
+    core, init_pages = _make_gpt_paged_core(model, page_size, pages_per_seq)
+
+    def fused_fn(tokens, pos, page_tables, kv):
+        B = tokens.shape[0]
+        out0 = jnp.zeros((num_steps, B), jnp.int32)
+
+        def body(j, carry):
+            tok, p, kv, out = carry
+            logits, kv = core(tok, p, page_tables, kv)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return nxt, p + 1, kv, out.at[j].set(nxt)
+
+        tok, p, kv, out = jax.lax.fori_loop(
+            0, num_steps, body, (tokens, pos, kv, out0))
+        return out, tok, p, kv
+
+    return fused_fn, init_pages
 
 
 def prefill(step_fn, state, prompt: jnp.ndarray):

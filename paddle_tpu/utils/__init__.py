@@ -1,4 +1,5 @@
 """paddle_tpu.utils (reference: python/paddle/utils/)."""
+from . import bucketing  # noqa: F401
 from . import download  # noqa: F401
 from . import profiler  # noqa: F401
 from . import unique_name  # noqa: F401

@@ -1,0 +1,76 @@
+"""Shared shape-bucketing helpers.
+
+jax.jit retraces per input shape, so every dynamic dimension that
+crosses a trace boundary is padded up to a BUCKET from a small fixed
+set — the decode batch (serving/scheduler.py), the prefill chunk
+(serving/engine.py), the predictor's exported batch.  The pow2 /
+smallest-cover arithmetic lived in per-module copies before; this module
+is the single source of truth.
+
+All helpers are host-side python on ints — never called inside a trace.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+__all__ = ["next_pow2", "pow2_buckets", "smallest_bucket",
+           "chunk_schedule"]
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (1 for n <= 1)."""
+    n = int(n)
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+def pow2_buckets(max_size: int) -> List[int]:
+    """Ascending power-of-two buckets up to and including ``max_size``
+    (which is kept even when it is not itself a power of two, so the
+    largest bucket always covers it): ``pow2_buckets(6) == [1, 2, 4, 6]``.
+    """
+    max_size = int(max_size)
+    if max_size < 1:
+        raise ValueError("max_size must be >= 1")
+    out: List[int] = []
+    b = 1
+    while b < max_size:
+        out.append(b)
+        b *= 2
+    out.append(max_size)
+    return out
+
+
+def smallest_bucket(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket covering ``n`` (the jit trace key); the largest
+    bucket when none covers.  ``buckets`` must be sorted ascending."""
+    n = max(1, int(n))
+    for b in buckets:
+        if b >= n:
+            return int(b)
+    return int(buckets[-1])
+
+
+def chunk_schedule(n: int, chunk: int) -> List[Tuple[int, int]]:
+    """Split ``n`` positions into dispatch chunks of at most ``chunk``:
+    full ``chunk``-sized spans, then one pow2-bucketed tail — so the
+    chunked-prefill trace set is {pow2 <= chunk} ∪ {chunk}, not one
+    trace per prompt length.
+
+    Returns ``[(start, padded_size), ...]``; every span covers
+    ``[start, min(start + padded_size, n))`` valid positions and pads the
+    rest (the caller masks them).  Empty for ``n <= 0``.
+    """
+    n, chunk = int(n), int(chunk)
+    if chunk < 1:
+        raise ValueError("chunk must be >= 1")
+    out: List[Tuple[int, int]] = []
+    start = 0
+    while n - start >= chunk:
+        out.append((start, chunk))
+        start += chunk
+    tail = n - start
+    if tail > 0:
+        out.append((start, min(next_pow2(tail), chunk)))
+    return out

@@ -1,0 +1,71 @@
+"""paddle_tpu.utils.bucketing — the shared pow2/bucket arithmetic that
+serving (decode batch, prefill chunks) and the scheduler key their jit
+traces on."""
+import pytest
+
+from paddle_tpu.utils.bucketing import (chunk_schedule, next_pow2,
+                                        pow2_buckets, smallest_bucket)
+
+
+class TestNextPow2:
+    def test_values(self):
+        assert [next_pow2(n) for n in (0, 1, 2, 3, 4, 5, 8, 9, 1023)] == \
+            [1, 1, 2, 4, 4, 8, 8, 16, 1024]
+
+    def test_pow2_fixed_points(self):
+        for k in range(11):
+            assert next_pow2(1 << k) == 1 << k
+
+
+class TestPow2Buckets:
+    def test_non_pow2_max_is_kept(self):
+        assert pow2_buckets(6) == [1, 2, 4, 6]
+
+    def test_pow2_max(self):
+        assert pow2_buckets(8) == [1, 2, 4, 8]
+        assert pow2_buckets(1) == [1]
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            pow2_buckets(0)
+
+
+class TestSmallestBucket:
+    def test_cover(self):
+        bks = [1, 2, 4, 8]
+        assert smallest_bucket(0, bks) == 1     # empty set still traces
+        assert smallest_bucket(3, bks) == 4
+        assert smallest_bucket(8, bks) == 8
+
+    def test_overflow_clamps_to_largest(self):
+        assert smallest_bucket(9, [1, 2, 4, 8]) == 8
+
+
+class TestChunkSchedule:
+    def test_exact_multiple(self):
+        assert chunk_schedule(128, 64) == [(0, 64), (64, 64)]
+
+    def test_pow2_bucketed_tail(self):
+        # 100 = 64 + tail 36 -> padded to 64
+        assert chunk_schedule(100, 64) == [(0, 64), (64, 64)]
+        # 70 = 64 + tail 6 -> padded to 8
+        assert chunk_schedule(70, 64) == [(0, 64), (64, 8)]
+
+    def test_short_prompt_single_bucketed_chunk(self):
+        assert chunk_schedule(5, 64) == [(0, 8)]
+        assert chunk_schedule(1, 64) == [(0, 1)]
+        assert chunk_schedule(0, 64) == []
+
+    def test_covers_every_position_exactly_once(self):
+        for n in (1, 3, 63, 64, 65, 200):
+            spans = chunk_schedule(n, 64)
+            covered = []
+            for start, size in spans:
+                assert size <= 64
+                covered.extend(range(start, min(start + size, n)))
+            assert covered == list(range(n))
+
+    def test_trace_set_is_bounded(self):
+        # every padded size is either the chunk or a pow2 below it
+        sizes = {s for n in range(1, 300) for _, s in chunk_schedule(n, 64)}
+        assert sizes <= {1, 2, 4, 8, 16, 32, 64}
