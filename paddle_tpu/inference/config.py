@@ -62,12 +62,18 @@ class Config:
     # --- serving (paddle_tpu.serving continuous-batching engine) ------------
     def enable_serving(self, max_batch_size=8, page_size=16, num_pages=None,
                        max_seq_len=None, eos_id=0, prefill_chunk=64,
-                       sync_mode=False, fused_steps=1):
+                       sync_mode=False, fused_steps=1,
+                       kv_cache_dtype=None, weight_dtype=None):
         """Opt in to the continuous-batching serving engine
         (docs/SERVING.md).  Stores the paged-KV / scheduler knobs plus the
         pipelining knobs (``prefill_chunk`` tokens per prefill program,
         ``sync_mode`` consume-immediately escape hatch, ``fused_steps``
-        K-step fused decode); build the engine with
+        K-step fused decode) and the quantization knobs
+        (``kv_cache_dtype="int8"`` int8 paged KV cache,
+        ``weight_dtype="int8"`` weight-only int8 matmuls — see
+        docs/SERVING.md "Quantized serving"; pass calibrated scales from
+        ``slim.export_serving_quant`` to ``create_serving_engine`` via
+        ``quant_scales=...``).  Build the engine with
         ``paddle_tpu.serving.create_serving_engine(model, config)``.  Not
         reference API — the reference's serving story stops at
         AnalysisPredictor; this is the TPU-native extension."""
@@ -80,6 +86,8 @@ class Config:
             "prefill_chunk": int(prefill_chunk),
             "sync_mode": bool(sync_mode),
             "fused_steps": int(fused_steps),
+            "kv_cache_dtype": kv_cache_dtype,
+            "weight_dtype": weight_dtype,
         }
 
     def serving_enabled(self) -> bool:

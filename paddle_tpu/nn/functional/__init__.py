@@ -30,13 +30,15 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
 
 
 def paged_attention(query, key_pages, value_pages, page_tables, seq_lens,
-                    name=None):
+                    key_scales=None, value_scales=None, name=None):
     """Decode-time ragged paged attention over a block-paged KV cache —
     the serving engine's primitive (docs/SERVING.md); see
-    ops/attention.py for the full contract."""
+    ops/attention.py for the full contract.  Pass per-page-per-head
+    ``key_scales``/``value_scales`` when the page pools are int8."""
     from ...ops.attention import paged_attention as _pa
 
-    return _pa(query, key_pages, value_pages, page_tables, seq_lens)
+    return _pa(query, key_pages, value_pages, page_tables, seq_lens,
+               key_scales=key_scales, value_scales=value_scales)
 
 
 def ring_attention(query, key, value, axis_name="sp", causal=False, name=None):

@@ -169,7 +169,7 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
 
 
 def paged_attention(query, key_pages, value_pages, page_tables, seq_lens,
-                    name=None):
+                    key_scales=None, value_scales=None, name=None):
     """Decode-time ragged paged attention over a block-paged KV cache
     (the serving engine's attention primitive; see docs/SERVING.md).
 
@@ -179,20 +179,31 @@ def paged_attention(query, key_pages, value_pages, page_tables, seq_lens,
     page_tables [B, M] int32 per-sequence page ids (pad with 0, the
                              reserved trash page)
     seq_lens    [B] int32    valid KV length per sequence (0 = inactive)
+    key_scales  [N, H] fp32  per-page-per-head dequant scales — required
+                             (with value_scales) when the pools are int8
+    value_scales [N, H] fp32
 
     Returns [B, H, D]; scale 1/sqrt(D) applied internally.  Routes to the
     Pallas ragged paged-attention kernel on TPU
     (ops/pallas_ops/paged_attention.py) and to the exact XLA gather
     reference elsewhere; PADDLE_TPU_FORCE_PAGED=1 forces the kernel in
-    interpret mode for testing.
+    interpret mode for testing.  Int8 pools are dequantized in-register
+    inside the kernel (docs/SERVING.md "Quantized serving").
     """
     from .pallas_ops.paged_attention import paged_attention as _core
 
+    if (key_scales is None) != (value_scales is None):
+        raise ValueError("key_scales and value_scales must be passed "
+                         "together (per-page-per-head [N, H] fp32)")
     q = to_tensor_like(query)
     kp = to_tensor_like(key_pages)
     vp = to_tensor_like(value_pages)
     pt = to_tensor_like(page_tables)
     sl = to_tensor_like(seq_lens)
+    if key_scales is not None:
+        return apply("paged_attention", _core, q, kp, vp, pt, sl,
+                     to_tensor_like(key_scales),
+                     to_tensor_like(value_scales))
     return apply("paged_attention", _core, q, kp, vp, pt, sl)
 
 

@@ -12,6 +12,19 @@ from ._helpers import norm_shape, resolve_dtype, to_tensor_like, value_of
 from .dispatch import apply
 
 
+def _x32_dtype(d):
+    """Under x32 an explicit int64/uint64 request is truncated to 32 bits
+    anyway — ask for the 32-bit dtype directly so jax doesn't emit the
+    truncation UserWarning on every creation call (the paddle default int
+    dtype is int64, so these calls are everywhere in ported code)."""
+    if d is not None and not jax.config.x64_enabled:
+        if d == np.dtype("int64"):
+            return np.dtype("int32")
+        if d == np.dtype("uint64"):
+            return np.dtype("uint32")
+    return d
+
+
 def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
     if isinstance(data, Tensor):
         arr = data._value
@@ -28,33 +41,37 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
 
 
 def zeros(shape, dtype=None, name=None) -> Tensor:
-    return Tensor(jnp.zeros(norm_shape(shape), resolve_dtype(dtype)))
+    return Tensor(jnp.zeros(norm_shape(shape), _x32_dtype(resolve_dtype(dtype))))
 
 
 def ones(shape, dtype=None, name=None) -> Tensor:
-    return Tensor(jnp.ones(norm_shape(shape), resolve_dtype(dtype)))
+    return Tensor(jnp.ones(norm_shape(shape), _x32_dtype(resolve_dtype(dtype))))
 
 
 def full(shape, fill_value, dtype=None, name=None) -> Tensor:
     fill_value = value_of(fill_value)
-    return Tensor(jnp.full(norm_shape(shape), fill_value, resolve_dtype(dtype)))
+    return Tensor(jnp.full(norm_shape(shape), fill_value,
+                           _x32_dtype(resolve_dtype(dtype))))
 
 
 def zeros_like(x, dtype=None, name=None) -> Tensor:
     x = to_tensor_like(x)
-    d = _dt.convert_dtype(dtype) if dtype is not None else x._value.dtype
+    d = _x32_dtype(_dt.convert_dtype(dtype)) if dtype is not None \
+        else x._value.dtype
     return Tensor(jnp.zeros(x._value.shape, d))
 
 
 def ones_like(x, dtype=None, name=None) -> Tensor:
     x = to_tensor_like(x)
-    d = _dt.convert_dtype(dtype) if dtype is not None else x._value.dtype
+    d = _x32_dtype(_dt.convert_dtype(dtype)) if dtype is not None \
+        else x._value.dtype
     return Tensor(jnp.ones(x._value.shape, d))
 
 
 def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
     x = to_tensor_like(x)
-    d = _dt.convert_dtype(dtype) if dtype is not None else x._value.dtype
+    d = _x32_dtype(_dt.convert_dtype(dtype)) if dtype is not None \
+        else x._value.dtype
     return Tensor(jnp.full(x._value.shape, value_of(fill_value), d))
 
 
@@ -79,7 +96,7 @@ def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
         )
     else:
         dtype = _dt.convert_dtype(dtype)
-    return Tensor(jnp.arange(start, end, step, dtype=dtype))
+    return Tensor(jnp.arange(start, end, step, dtype=_x32_dtype(dtype)))
 
 
 def linspace(start, stop, num, dtype=None, name=None) -> Tensor:

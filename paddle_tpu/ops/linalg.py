@@ -37,6 +37,29 @@ def mm(input, mat2, name=None):
     return matmul(input, mat2)
 
 
+def weight_only_matmul(x, w_q, w_scale, name=None):
+    """Weight-only int8 matmul: ``x @ (w_q * w_scale[None, :])`` with the
+    weights resident as int8 and one fp32 dequant scale per output
+    channel (the serving hot path's bytes-bound matmul; see
+    docs/SERVING.md "Quantized serving").
+
+    x        [..., K]  activations (float; accumulates in f32)
+    w_q      [K, N]    int8 weights
+    w_scale  [N]       fp32 per-output-channel scales
+
+    Routes to the Pallas kernel on TPU
+    (ops/pallas_ops/quantized_matmul.py) and to the exact XLA
+    dequant-matmul reference elsewhere; PADDLE_TPU_FORCE_QMM=1 forces
+    the kernel in interpret mode for testing.
+    """
+    from .pallas_ops.quantized_matmul import quantized_matmul as _core
+
+    x = to_tensor_like(x)
+    wq = to_tensor_like(w_q)
+    ws = to_tensor_like(w_scale)
+    return apply("weight_only_matmul", _core, x, wq, ws)
+
+
 def bmm(x, y, name=None):
     return matmul(x, y)
 

@@ -24,6 +24,16 @@ reserved trash page — padding entries point at it and masked/inactive
 lanes scatter into it — so every page-table entry is always a valid
 index and the kernel needs no bounds checks.
 
+Quantized KV (the int8 serving path): when the page pools are int8 the
+caller passes per-page-per-head fp32 scale arrays ``k_scales`` /
+``v_scales`` ([N, H]); the kernel DMAs the page's scale row alongside
+the page and dequantizes IN-REGISTER — the q·k logits pick up the K
+scale as a per-head multiply after the dot, the context accumulation
+picks up the V scale the same way, so HBM streams 1 byte per KV element
+instead of 2 and the f32 softmax math is unchanged.  Layout and the
+write-time quantization live in serving/kv_cache.py and
+text/generation.py.
+
 CPU story: interpret mode runs the very same kernel under
 ``JAX_PLATFORMS=cpu`` (tier-1 tests); the default CPU *routing* choice
 is the exact XLA gather reference, the kernel is forced with
@@ -109,8 +119,59 @@ def _decode_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_sc[:] / l_safe).astype(o_ref.dtype)
 
 
+def _decode_kernel_quant(pt_ref, sl_ref, q_ref, k_ref, v_ref, ks_ref,
+                         vs_ref, o_ref, acc_sc, m_sc, l_sc, *, scale,
+                         page_size, num_pages_grid):
+    """Int8-KV variant of ``_decode_kernel``: the DMA'd page blocks are
+    int8 and ride with their [H] fp32 scale rows; dequantization is a
+    per-head multiply folded into the logits (K) and the accumulated
+    context contribution (V) — everything after that is the same f32
+    online softmax."""
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    seq_len = sl_ref[b]
+
+    @pl.when(i * page_size < seq_len)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale          # [H, D]
+        k = k_ref[0].astype(jnp.float32)                  # [P, H, D] s8→f32
+        v = v_ref[0].astype(jnp.float32)
+        ks = ks_ref[0].astype(jnp.float32)                # [H] page K scale
+        vs = vs_ref[0].astype(jnp.float32)                # [H] page V scale
+        s = jax.lax.dot_general(q, k, (((1,), (2,)), ((0,), (1,))),
+                                preferred_element_type=jnp.float32)
+        s = s * ks[:, None]                               # dequant K
+        H = q.shape[0]
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (H, page_size), 1)
+        s = jnp.where(pos < seq_len, s, NEG_INF)
+        m_prev = m_sc[:, :1]
+        l_prev = l_sc[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        ctx = jax.lax.dot_general(p, v, (((1,), (0,)), ((0,), (1,))),
+                                  preferred_element_type=jnp.float32)
+        acc_sc[:] = acc_sc[:] * alpha + ctx * vs[:, None]  # dequant V
+        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
+
+    @pl.when(i == num_pages_grid - 1)
+    def _write():
+        l_safe = jnp.maximum(l_sc[:, :1], 1e-30)
+        o_ref[0] = (acc_sc[:] / l_safe).astype(o_ref.dtype)
+
+
 def paged_attention_kernel(q, k_pages, v_pages, page_tables, seq_lens,
-                           *, interpret=None):
+                           k_scales=None, v_scales=None, *, interpret=None):
     """The Pallas kernel proper (interpret mode off-TPU unless forced).
 
     q           [B, H, D]   one decode query per sequence
@@ -118,12 +179,18 @@ def paged_attention_kernel(q, k_pages, v_pages, page_tables, seq_lens,
     v_pages     [N, P, H, D] global V page pool
     page_tables [B, M] int32 page ids per sequence (pad with 0)
     seq_lens    [B] int32    valid KV length per sequence (0 = inactive)
+    k_scales    [N, H] fp32  per-page-per-head K dequant scales
+                             (required iff k_pages is int8)
+    v_scales    [N, H] fp32  per-page-per-head V dequant scales
 
     Returns [B, H, D]; softmax scale 1/sqrt(D) is applied internally.
     """
     B, H, D = q.shape
     page_size = k_pages.shape[1]
     max_pages = page_tables.shape[1]
+    quantized = k_pages.dtype == jnp.int8
+    if quantized and (k_scales is None or v_scales is None):
+        raise ValueError("int8 KV pages require k_scales/v_scales")
     # the softmax temperature comes from the REAL head_dim — computed
     # before any tile padding so the padded kernel is numerically
     # identical to the unpadded one (zero-padded D lanes add 0 to q·k)
@@ -142,18 +209,38 @@ def paged_attention_kernel(q, k_pages, v_pages, page_tables, seq_lens,
                           ((0, 0), (0, 0), (0, Hp - H), (0, Dp - D)))
         v_pages = jnp.pad(v_pages,
                           ((0, 0), (0, 0), (0, Hp - H), (0, Dp - D)))
+        if quantized:
+            # padded heads multiply garbage rows that are sliced off; 1.0
+            # keeps the arithmetic finite
+            k_scales = jnp.pad(k_scales, ((0, 0), (0, Hp - H)),
+                               constant_values=1.0)
+            v_scales = jnp.pad(v_scales, ((0, 0), (0, Hp - H)),
+                               constant_values=1.0)
     Bq, Hq, Dq = q.shape
+
+    in_specs = [
+        pl.BlockSpec((1, Hq, Dq), lambda b, i, pt, sl: (b, 0, 0)),
+        pl.BlockSpec((1, page_size, Hq, Dq),
+                     lambda b, i, pt, sl: (pt[b, i], 0, 0, 0)),
+        pl.BlockSpec((1, page_size, Hq, Dq),
+                     lambda b, i, pt, sl: (pt[b, i], 0, 0, 0)),
+    ]
+    operands = [q, k_pages, v_pages]
+    kern = _decode_kernel
+    if quantized:
+        # the scale rows ride the same page-table index_map as the pages
+        in_specs += [
+            pl.BlockSpec((1, Hq), lambda b, i, pt, sl: (pt[b, i], 0)),
+            pl.BlockSpec((1, Hq), lambda b, i, pt, sl: (pt[b, i], 0)),
+        ]
+        operands += [k_scales.astype(jnp.float32),
+                     v_scales.astype(jnp.float32)]
+        kern = _decode_kernel_quant
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,        # page_tables, seq_lens
         grid=(B, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, Hq, Dq), lambda b, i, pt, sl: (b, 0, 0)),
-            pl.BlockSpec((1, page_size, Hq, Dq),
-                         lambda b, i, pt, sl: (pt[b, i], 0, 0, 0)),
-            pl.BlockSpec((1, page_size, Hq, Dq),
-                         lambda b, i, pt, sl: (pt[b, i], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, Hq, Dq), lambda b, i, pt, sl: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((Hq, Dq), jnp.float32),
@@ -161,30 +248,43 @@ def paged_attention_kernel(q, k_pages, v_pages, page_tables, seq_lens,
             pltpu.VMEM((Hq, 128), jnp.float32),
         ],
     )
+    out_dtype = q.dtype
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, page_size=page_size,
+        functools.partial(kern, scale=scale, page_size=page_size,
                           num_pages_grid=max_pages),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Hq, Dq), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, Dq), out_dtype),
         compiler_params=_compiler_params(),
         interpret=_interpret_mode() if interpret is None else interpret,
-    )(page_tables, seq_lens, q, k_pages, v_pages)
+    )(page_tables, seq_lens, *operands)
     if Hq != H or Dq != D:
         out = out[:, :H, :D]
     return out
 
 
-def paged_attention_xla(q, k_pages, v_pages, page_tables, seq_lens):
+def paged_attention_xla(q, k_pages, v_pages, page_tables, seq_lens,
+                        k_scales=None, v_scales=None):
     """Exact XLA reference: gather the sequence's pages into a dense
     [B, M*P, H, D] view and run masked attention.  O(B·M·P·H·D) memory
     traffic per decode step — the thing the kernel exists to avoid — but
-    bit-exact f32 softmax math, so it is the default CPU route."""
+    bit-exact f32 softmax math, so it is the default CPU route.  Int8
+    pages are dequantized after the gather with their per-page-per-head
+    scales (same math as the kernel's in-register dequant)."""
     B, H, D = q.shape
     page_size = k_pages.shape[1]
     M = page_tables.shape[1]
     S = M * page_size
     k = k_pages[page_tables].reshape(B, S, H, D)
     v = v_pages[page_tables].reshape(B, S, H, D)
+    if k_pages.dtype == jnp.int8:
+        if k_scales is None or v_scales is None:
+            raise ValueError("int8 KV pages require k_scales/v_scales")
+        ks = k_scales[page_tables]                     # [B, M, H]
+        vs = v_scales[page_tables]
+        ks = jnp.repeat(ks, page_size, axis=1)         # [B, S, H]
+        vs = jnp.repeat(vs, page_size, axis=1)
+        k = k.astype(jnp.float32) * ks[..., None]
+        v = v.astype(jnp.float32) * vs[..., None]
     scale = 1.0 / math.sqrt(D)
     s = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
@@ -198,14 +298,17 @@ def paged_attention_xla(q, k_pages, v_pages, page_tables, seq_lens):
     return ctx.astype(q.dtype)
 
 
-def paged_attention(q, k_pages, v_pages, page_tables, seq_lens):
+def paged_attention(q, k_pages, v_pages, page_tables, seq_lens,
+                    k_scales=None, v_scales=None):
     """Routing entry (the serving decode step calls this): Pallas kernel
     on TPU (or when PADDLE_TPU_FORCE_PAGED=1 forces interpret mode for
-    tests), exact XLA gather reference elsewhere."""
+    tests), exact XLA gather reference elsewhere.  Pass per-page-per-head
+    ``k_scales``/``v_scales`` ([N, H] fp32) when the page pools are int8."""
     forced = os.environ.get("PADDLE_TPU_FORCE_PAGED") == "1"
     if forced or jax.default_backend() == "tpu":
         PAGED_ROUTE_STATS["pallas"] += 1
         return paged_attention_kernel(q, k_pages, v_pages, page_tables,
-                                      seq_lens)
+                                      seq_lens, k_scales, v_scales)
     PAGED_ROUTE_STATS["xla"] += 1
-    return paged_attention_xla(q, k_pages, v_pages, page_tables, seq_lens)
+    return paged_attention_xla(q, k_pages, v_pages, page_tables, seq_lens,
+                               k_scales, v_scales)

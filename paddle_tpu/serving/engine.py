@@ -54,7 +54,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..profiler.jit_cost import cost_registry, profiled_jit
-from ..utils.bucketing import chunk_schedule, smallest_bucket
+from ..utils.bucketing import chunk_schedule, next_pow2, smallest_bucket
 from ..utils.profiler import RecordEvent
 from .kv_cache import PagedKVCache
 from .metrics import ServingMetrics
@@ -89,7 +89,10 @@ class ServingEngine:
                  metrics: Optional[ServingMetrics] = None,
                  prefill_chunk: int = 64,
                  sync_mode: bool = False,
-                 fused_steps: int = 1):
+                 fused_steps: int = 1,
+                 kv_cache_dtype: Optional[str] = None,
+                 weight_dtype: Optional[str] = None,
+                 quant_scales: Optional[dict] = None):
         from ..text.generation import (make_gpt_paged_decode_step,
                                        make_gpt_paged_fused_decode_step,
                                        make_gpt_paged_prefill_step)
@@ -118,11 +121,61 @@ class ServingEngine:
         self.outputs: Dict[str, np.ndarray] = {}
         self._ttft_recorded = set()      # per REQUEST, preemption-proof
 
+        # --- int8 serving path (docs/SERVING.md "Quantized serving") ---
+        # kv_cache_dtype="int8": pages store int8 + per-page-per-head
+        # fp32 scales; with calibrated quant_scales["kv_scales"] (slim
+        # bridge) the scales are static, otherwise they grow per page at
+        # write time and are reset when a page is reallocated.
+        # weight_dtype="int8": projection/MLP matmuls stream int8
+        # weights through the weight-only kernel; scales come from the
+        # export or are derived data-free here (abs-max, exact recipe).
+        for d, knob in ((kv_cache_dtype, "kv_cache_dtype"),
+                        (weight_dtype, "weight_dtype")):
+            if d not in (None, "int8"):
+                # no silent degradation: the pools/weights stay in the
+                # model's native dtype unless int8 is asked for
+                raise ValueError(f"{knob} must be None or 'int8', "
+                                 f"got {d!r}")
+        self.kv_cache_dtype = kv_cache_dtype
+        self.weight_dtype = weight_dtype
+        if quant_scales is not None and kv_cache_dtype is None \
+                and weight_dtype is None:
+            # an export without the knobs would silently run native —
+            # an "int8 vs native" comparison measuring native vs native
+            raise ValueError(
+                "quant_scales was provided but kv_cache_dtype and "
+                "weight_dtype are both unset — pass kv_cache_dtype='int8' "
+                "and/or weight_dtype='int8' (e.g. via "
+                "Config.enable_serving) to activate the quantized path")
+        qs = quant_scales or {}
+        weight_quant = None
+        if self.weight_dtype == "int8":
+            weight_quant = qs.get("weights")
+            if weight_quant is None:
+                from ..slim.serving_export import quantize_gpt_weights
+
+                weight_quant = quantize_gpt_weights(model)
+            # ONE device copy shared by the decode/prefill/fused step
+            # builders (jnp.asarray is a no-op on jax arrays, so the
+            # builders' own conversion reuses these buffers)
+            weight_quant = {
+                name: (jnp.asarray(q), jnp.asarray(s, jnp.float32))
+                for name, (q, s) in weight_quant.items()}
+        kv_scales = (qs.get("kv_scales")
+                     if self.kv_cache_dtype == "int8" else None)
+        # dynamic per-page scales need resetting when pages are
+        # reallocated (results must not depend on page-reuse history)
+        self._kv_dynamic = self.kv_cache_dtype == "int8" and \
+            kv_scales is None
+        qkw = dict(kv_cache_dtype=self.kv_cache_dtype,
+                   kv_scales=kv_scales, weight_quant=weight_quant)
+
         step_fn, init_pages = make_gpt_paged_decode_step(
-            model, self.page_size, self.pages_per_seq)
+            model, self.page_size, self.pages_per_seq, **qkw)
         prefill_fn, _ = make_gpt_paged_prefill_step(
-            model, self.page_size, self.pages_per_seq)
+            model, self.page_size, self.pages_per_seq, **qkw)
         self._kv = init_pages(num_pages)
+        self._weight_quant = weight_quant
 
         def _decode(tokens, pos, page_tables, kv):
             logits, kv = step_fn(tokens, pos, page_tables, kv)
@@ -161,9 +214,28 @@ class ServingEngine:
         self._fused_jit = None
         if self.fused_steps > 1:
             fused_fn, _ = make_gpt_paged_fused_decode_step(
-                model, self.page_size, self.pages_per_seq, self.fused_steps)
+                model, self.page_size, self.pages_per_seq, self.fused_steps,
+                **qkw)
             self._fused_jit = profiled_jit("serving.decode_fused", fused_fn,
                                            donate_argnums=(3,))
+        self._scale_reset_jit = None
+        if self._kv_dynamic:
+            from .kv_cache import KV_SCALE_EPS
+
+            def _scale_reset(kv, rows):
+                # rows: [R] page ids (pow2-padded with the trash page 0 —
+                # resetting its scale is harmless); back to the eps floor
+                # so a reallocated page quantizes from scratch
+                out = dict(kv)
+                out["k_scale"] = [s.at[rows].set(KV_SCALE_EPS)
+                                  for s in kv["k_scale"]]
+                out["v_scale"] = [s.at[rows].set(KV_SCALE_EPS)
+                                  for s in kv["v_scale"]]
+                return out
+
+            self._scale_reset_jit = profiled_jit("serving.kv_scale_reset",
+                                                 _scale_reset,
+                                                 donate_argnums=(0,))
 
         # device-resident decode state (grown/rebuilt lazily)
         self._tokens = None              # [bucket] int32
@@ -272,11 +344,28 @@ class ServingEngine:
             self._zero_i32, self._zero_i32, self._zero_row)
 
     def _refresh_row(self, lane: int, seq: Sequence):
-        """Page growth changed the sequence's table — re-upload one row."""
+        """Page growth changed the sequence's table — re-upload one row
+        (and, in dynamic int8 mode, reset the grown pages' scales: they
+        may have been freed by another sequence with a larger scale)."""
+        table = self.cache.seq_page_ids(seq.seq_id)
+        self._reset_page_scales(
+            table[self._uploaded_pages.get(seq.seq_id, 0):])
         row = jax.device_put(self.cache.page_table_row(seq.seq_id))
         self._tables = self._row_set_jit(self._tables,
                                          self._lane_ids[lane], row)
-        self._uploaded_pages[seq.seq_id] = self.cache.seq_pages(seq.seq_id)
+        self._uploaded_pages[seq.seq_id] = len(table)
+
+    def _reset_page_scales(self, page_ids):
+        """Dynamic int8 KV only: return freshly (re)allocated pages'
+        scales to the eps floor BEFORE anything is written through them,
+        so quantization depends only on the owning sequence's tokens —
+        never on page-reuse history (which differs across engine modes
+        and would break the byte-identity guarantee)."""
+        if self._scale_reset_jit is None or not page_ids:
+            return
+        rows = np.zeros((next_pow2(len(page_ids)),), np.int32)
+        rows[: len(page_ids)] = page_ids
+        self._kv = self._scale_reset_jit(self._kv, jax.device_put(rows))
 
     def _sync_rows(self, active: List[Tuple[int, "Sequence"]]):
         """Re-upload every device table row whose host allocation grew
@@ -475,6 +564,9 @@ class ServingEngine:
             emitted += self._sync_pending()
             admitted = sched.admit()
             for seq in admitted:
+                # freshly allocated pages must quantize from scratch
+                # (dynamic int8 mode; no-op otherwise)
+                self._reset_page_scales(self.cache.seq_page_ids(seq.seq_id))
                 self._prefill_seq(seq)
                 self._bind_lane(seq)
             self.metrics.on_admission(len(admitted))
@@ -519,7 +611,8 @@ class ServingEngine:
             running=dispatched_lanes if bucket else len(sched.running),
             bucket=bucket, pages_in_use=self.cache.pages_in_use,
             tokens_emitted=emitted,
-            step_seconds=time.perf_counter() - t_step)
+            step_seconds=time.perf_counter() - t_step,
+            kv_cache_bytes=self.kv_cache_bytes())
         return {
             "admitted": len(admitted),
             "running": len(sched.running),
@@ -552,13 +645,35 @@ class ServingEngine:
         bounded."""
         return self.outputs.pop(request_id, None)
 
+    def kv_cache_bytes(self) -> int:
+        """Actual device bytes of the KV page pools, scales included —
+        the resident footprint AND (pool-proportionally) the bytes the
+        bytes-bound decode loop streams per step."""
+        return int(sum(leaf.nbytes for side in self._kv.values()
+                       for leaf in side))
+
+    def kv_bytes_per_token(self) -> float:
+        """K+V bytes one cached token costs across all layers (scale
+        rows amortized over their page) — the per-token form of the
+        int8-vs-bf16 reduction bench reports."""
+        return self.kv_cache_bytes() / (self.cache.num_pages
+                                        * self.page_size)
+
     def stats(self) -> dict:
         """Engine + cache + metrics snapshot, incl. per-jit cost
         attribution (FLOPs/bytes/compile counts) for the engine's
         compiled programs.  ``jit_costs`` reads the process-global
         cost_registry: with several engines in one process it is the
-        MERGED serving attribution, not per-engine."""
+        MERGED serving attribution, not per-engine (the quant
+        ``matmul_route`` trace counters are process-global the same
+        way)."""
+        from ..ops.pallas_ops.quantized_matmul import QMM_ROUTE_STATS
+
         costs = cost_registry.snapshot()
+        weight_bytes = None
+        if self._weight_quant is not None:
+            weight_bytes = int(sum(q.nbytes + s.nbytes
+                                   for q, s in self._weight_quant.values()))
         return {
             "metrics": self.metrics.snapshot(),
             "cache": self.cache.stats(self.scheduler.seq_lens()),
@@ -569,6 +684,17 @@ class ServingEngine:
                 "prefill_chunk": self.prefill_chunk,
                 "in_flight": len(self._pending),
                 "state_bucket": self._state_bucket,
+            },
+            "quant": {
+                "kv_cache_dtype": self.kv_cache_dtype or "native",
+                "weight_dtype": self.weight_dtype or "native",
+                "kv_scale_mode": ("dynamic" if self._kv_dynamic else
+                                  "static" if self.kv_cache_dtype
+                                  else None),
+                "kv_cache_bytes": self.kv_cache_bytes(),
+                "kv_bytes_per_token": self.kv_bytes_per_token(),
+                "quant_weight_bytes": weight_bytes,
+                "matmul_route": dict(QMM_ROUTE_STATS),
             },
             "jit_costs": {k: v for k, v in costs.items()
                           if k.startswith("serving.")},

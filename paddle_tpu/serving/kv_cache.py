@@ -19,6 +19,19 @@ reports alloc/free counters, high-water mark, and internal
 fragmentation (allocated-but-unused tail slots), the only fragmentation
 kind paging admits — there is no external fragmentation to defrag, which
 is the point of fixed-size pages.
+
+Quantized page layout (the int8 serving path)
+---------------------------------------------
+With ``kv_cache_dtype="int8"`` the device pools store each [P, H, D]
+page as int8 plus ONE fp32 dequant scale per (page, head) — a [N, H]
+scale array rides next to each [N, P, H, D] pool, so a page costs
+``P*H*D + 4*H`` bytes instead of ``2*P*H*D`` (bf16): a ~2x cut in the
+bytes the bytes-bound decode loop streams, and 2x the sequences per HBM
+byte.  ``quantize_kv_page`` / ``dequantize_kv_page`` below are the
+numpy REFERENCE for that layout (symmetric, zero-point-free, qmax 127);
+the jitted write path lives in ``text/generation.py`` and the
+in-register dequant in ``ops/pallas_ops/paged_attention.py`` — tests
+pin all three to each other.
 """
 from __future__ import annotations
 
@@ -26,7 +39,55 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["PagedKVCache"]
+__all__ = ["PagedKVCache", "KV_SCALE_EPS", "kv_page_bytes",
+           "quantize_kv_page", "dequantize_kv_page"]
+
+# floor for per-page scales: keeps ratio math finite on never-written
+# pages (dynamic mode initializes scales to this)
+KV_SCALE_EPS = 1e-8
+
+_KV_ITEMSIZE = {"int8": 1, "bfloat16": 2, "bf16": 2, "float16": 2,
+                "fp16": 2, "float32": 4, "fp32": 4}
+
+
+def kv_page_bytes(page_size: int, num_heads: int, head_dim: int,
+                  dtype: str = "bfloat16") -> int:
+    """Bytes one K **or** V page occupies on device, including its
+    per-page-per-head fp32 scale row when int8."""
+    try:
+        itemsize = _KV_ITEMSIZE[str(dtype)]
+    except KeyError:
+        raise ValueError(f"unknown KV cache dtype {dtype!r}; one of "
+                         f"{sorted(_KV_ITEMSIZE)}")
+    n = page_size * num_heads * head_dim * itemsize
+    if itemsize == 1:
+        n += num_heads * 4            # fp32 scale per head
+    return n
+
+
+def quantize_kv_page(page: np.ndarray, scales: Optional[np.ndarray] = None):
+    """Numpy reference for the device write path: quantize one [P, H, D]
+    float page to (int8 page, [H] fp32 scales).
+
+    ``scales=None`` derives per-head abs-max scales from the page itself
+    (what the dynamic write path converges to once every slot is
+    written); passing calibrated scales reproduces the static path
+    (values CLIP at ±127 instead of rescaling).
+    """
+    page = np.asarray(page, np.float32)
+    if scales is None:
+        amax = np.abs(page).max(axis=(0, 2))          # [H]
+        scales = np.maximum(amax / 127.0, KV_SCALE_EPS)
+    scales = np.asarray(scales, np.float32)
+    q = np.clip(np.round(page / scales[None, :, None]), -127, 127)
+    return q.astype(np.int8), scales
+
+
+def dequantize_kv_page(qpage: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    """Inverse of ``quantize_kv_page``: [P, H, D] int8 + [H] scales →
+    f32 (round-trip error ≤ scale/2 per element, tests pin it)."""
+    return qpage.astype(np.float32) * np.asarray(
+        scales, np.float32)[None, :, None]
 
 
 class PagedKVCache:
@@ -105,6 +166,10 @@ class PagedKVCache:
         return len(table)
 
     # --- page-table export ------------------------------------------------
+    def seq_page_ids(self, seq_id: str) -> List[int]:
+        """The physical page ids ``seq_id`` currently owns, in order."""
+        return list(self._tables.get(seq_id, ()))
+
     def page_table_row(self, seq_id: str) -> np.ndarray:
         """[pages_per_seq] int32 row, padded with the trash page (0)."""
         row = np.zeros((self.pages_per_seq,), np.int32)

@@ -37,7 +37,8 @@ class ServingMetrics:
     points where a shared reset is acceptable."""
 
     GAUGES = ("serving.queue_depth", "serving.running_seqs",
-              "serving.kv_pages_in_use", "serving.batch_bucket")
+              "serving.kv_pages_in_use", "serving.batch_bucket",
+              "serving.kv_cache_bytes", "serving.batch_occupancy")
     COUNTERS = ("serving.steps", "serving.tokens_generated",
                 "serving.requests_admitted", "serving.requests_completed",
                 "serving.preemptions", "serving.prefill_chunks",
@@ -113,7 +114,8 @@ class ServingMetrics:
 
     def on_step(self, *, queue_depth: int, running: int, bucket: int,
                 pages_in_use: int, tokens_emitted: int,
-                step_seconds: Optional[float] = None):
+                step_seconds: Optional[float] = None,
+                kv_cache_bytes: Optional[int] = None):
         now = time.monotonic()
         if self._start is None:
             self._start = now
@@ -125,6 +127,14 @@ class ServingMetrics:
             # steps don't dilute the mean
             self._occupancy_sum += running / bucket
             self._occupancy_count += 1
+            # exported per step (the registry/Prometheus view of what
+            # snapshot() reports as the mean) — previously derivable
+            # only from engine internals
+            stat_registry.get("serving.batch_occupancy").set(
+                running / bucket)
+        if kv_cache_bytes is not None:
+            stat_registry.get("serving.kv_cache_bytes").set(
+                int(kv_cache_bytes))
         stat_registry.get("serving.queue_depth").set(queue_depth)
         stat_registry.get("serving.running_seqs").set(running)
         stat_registry.get("serving.kv_pages_in_use").set(pages_in_use)

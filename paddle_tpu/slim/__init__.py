@@ -17,6 +17,8 @@ from .quant_layers import (FakeQuantAbsMax, FakeChannelWiseQuantAbsMax,
 from .qat import ImperativeQuantAware
 from .ptq import PostTrainingQuantization, quantize_for_inference
 from .int8_layers import Int8Linear, Int8Conv2D
+from .serving_export import (export_serving_quant, quantize_gpt_weights,
+                             calibrate_kv_scales)
 
 __all__ = [
     "ImperativeQuantAware", "PostTrainingQuantization",
@@ -24,4 +26,5 @@ __all__ = [
     "FakeChannelWiseQuantAbsMax", "FakeQuantMovingAverage",
     "MovingAverageAbsMaxScale", "QuantizedConv2D", "QuantizedLinear",
     "Int8Linear", "Int8Conv2D", "quant_dequant_abs_max",
+    "export_serving_quant", "quantize_gpt_weights", "calibrate_kv_scales",
 ]

@@ -1,0 +1,126 @@
+"""PTQ → serving bridge: export the scale pytree the int8 serving path
+consumes (docs/SERVING.md "Quantized serving").
+
+The slim stack quantizes *layers in place* (Int8Linear/Int8Conv2D) for
+the Predictor path; the serving engine instead runs a functional
+transformer core (text/generation.py) over raw param pytrees, so it
+needs quantization as DATA: int8 weights + scales keyed by param name,
+and calibrated per-layer-per-head KV scales.  ``export_serving_quant``
+produces exactly that:
+
+``{"weight_dtype", "kv_cache_dtype",
+   "weights":   {param_name: (int8 [K, N], fp32 [N])},   # per-out-channel
+   "kv_scales": {"k": [L x fp32 [H]], "v": [L x fp32 [H]]} | None}``
+
+Weight scales are data-free (per-output-channel abs-max — the same
+recipe Int8Linear uses, reference WeightQuantization
+post_training_quantization.py:919).  KV scales need calibration data:
+``calibrate_kv_scales`` teacher-forces a few prompts through the dense
+decode step and records per-layer-per-head abs-max of the K/V caches —
+the PTQ activation-collection idea (PostTrainingQuantization, algo
+abs_max) applied to the KV stream.  Without calibration prompts the
+export carries ``kv_scales=None`` and the engine falls back to dynamic
+per-page scales (no calibration needed, slight extra write cost).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["export_serving_quant", "quantize_gpt_weights",
+           "calibrate_kv_scales", "GPT_QUANT_WEIGHT_SUFFIXES"]
+
+# the serving hot path's matmuls: attention projections + MLP.  The tied
+# embedding/head (wte) stays float — it doubles as the token-embedding
+# gather and feeds the greedy argmax, where rounding bites hardest.
+GPT_QUANT_WEIGHT_SUFFIXES = (
+    "attn.q_proj.weight", "attn.k_proj.weight", "attn.v_proj.weight",
+    "attn.out_proj.weight", "fc1.weight", "fc2.weight",
+)
+
+
+def quantize_gpt_weights(model, weight_bits: int = 8
+                         ) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
+    """Per-output-channel abs-max quantization of every serving-path
+    matmul weight; data-free.  Returns {name: (int8 [K, N], fp32 [N])}
+    keyed by the functional param names text/generation.py uses."""
+    from ..jit.functional import get_state
+    from .int8_layers import _quantize_weight
+
+    params, _ = get_state(model)
+    out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    for name, w in params.items():
+        if not name.startswith("layers."):
+            continue
+        if not any(name.endswith(s) for s in GPT_QUANT_WEIGHT_SUFFIXES):
+            continue
+        # weights are [in, out] (x @ w): output channel axis is 1
+        q, scale = _quantize_weight(np.asarray(w), channel_axis=1,
+                                    bits=weight_bits)
+        out[name] = (q, scale)
+    if not out:
+        raise ValueError("model has no layers.*.{attn,fc}.weight params — "
+                         "not a text.models.GPTModel?")
+    return out
+
+
+def calibrate_kv_scales(model, calib_prompts, margin: float = 1.0,
+                        bits: int = 8) -> Dict[str, list]:
+    """Per-layer-per-head KV scales from teacher-forcing calibration
+    prompts ([B, P] int tokens) through the dense decode step.
+
+    ``margin`` multiplies the observed abs-max (>1.0 leaves headroom for
+    decode-time activations the calibration set missed; out-of-range
+    values CLIP at ±qmax rather than wrapping, so a tight margin costs
+    accuracy gracefully)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..text.generation import make_gpt_decode_step
+
+    prompts = np.asarray(calib_prompts, np.int64).astype(np.int32)
+    if prompts.ndim == 1:
+        prompts = prompts[None, :]
+    if prompts.ndim != 2 or prompts.size == 0:
+        raise ValueError("calib_prompts must be a non-empty [B, P] token "
+                         "array")
+    B, P = prompts.shape
+    step_fn, init_state = make_gpt_decode_step(model, max_len=P + 1)
+    step_jit = jax.jit(step_fn)   # one compile, P fast steps
+    state = init_state(B)
+    for t in range(P):
+        _, state = step_jit(jnp.asarray(prompts[:, t]), state)
+    qmax = float(2 ** (bits - 1) - 1)
+    scales = {"k": [], "v": []}
+    for side in ("k", "v"):
+        for cache in state[side]:                       # [B, max_len, H, D]
+            amax = np.abs(np.asarray(cache)[:, :P]).max(axis=(0, 1, 3))
+            scales[side].append(np.maximum(
+                amax * float(margin) / qmax, 1e-8).astype(np.float32))
+    return scales
+
+
+def export_serving_quant(model, calib_prompts=None,
+                         weight_dtype: Optional[str] = "int8",
+                         kv_cache_dtype: Optional[str] = "int8",
+                         margin: float = 1.0) -> dict:
+    """One-call export of everything the quantized serving path needs;
+    feed the result to ``ServingEngine(..., quant_scales=...)`` /
+    ``create_serving_engine`` or ``text.generation.generate(quant=...)``.
+
+    ``calib_prompts=None`` skips KV calibration: the engine then runs
+    dynamic per-page scales (generate() requires calibration for its
+    dense int8 cache and will reject such an export)."""
+    for d, knob in ((weight_dtype, "weight_dtype"),
+                    (kv_cache_dtype, "kv_cache_dtype")):
+        if d not in (None, "int8"):
+            raise ValueError(f"{knob} must be None or 'int8', got {d!r}")
+    out = {"weight_dtype": weight_dtype, "kv_cache_dtype": kv_cache_dtype,
+           "weights": None, "kv_scales": None}
+    if weight_dtype == "int8":
+        out["weights"] = quantize_gpt_weights(model)
+    if kv_cache_dtype == "int8" and calib_prompts is not None:
+        out["kv_scales"] = calibrate_kv_scales(model, calib_prompts,
+                                               margin=margin)
+    return out

@@ -41,12 +41,110 @@ def _gelu(x):
     return x * norm.cdf(x)
 
 
-def make_gpt_decode_step(model, max_len: int):
+# ---------------------------------------------------------------------------
+# int8 quantization plumbing shared by the dense and paged decode cores.
+#
+# Weight-only matmul: ``weight_quant`` maps a param name (e.g.
+# "layers.0.attn.q_proj.weight") to an (int8 [K, N], fp32 [N]) pair as
+# produced by slim.export_serving_quant; matmuls against a quantized name
+# route through ops/pallas_ops/quantized_matmul (in-register dequant on
+# TPU, exact XLA dequant-matmul on CPU).  Biases/LN/embeddings stay float.
+#
+# KV quantization: pages/caches store int8 with fp32 scales.  Two modes:
+#   static  — calibrated per-layer-per-head scales (slim bridge); writes
+#             CLIP at ±127, no scale state mutates, so results are
+#             layout-independent (paged engine == dense generate).
+#   dynamic — per-page scales grow via scatter-max at write time and the
+#             page's prior int8 content is requantized under the new
+#             scale (one page gather/scatter per write — bounded, N pages
+#             per step).  No calibration needed; scales are reset when a
+#             page is (re)allocated so results depend only on the tokens
+#             written since allocation, never on page-reuse history.
+# ---------------------------------------------------------------------------
+
+_KV_QMAX = 127.0
+
+
+def _make_mm(params, weight_quant):
+    """Returns ``mm(x, name)`` computing ``x @ params[name]`` — through
+    the weight-only int8 kernel when ``name`` is quantized."""
+    if not weight_quant:
+        return lambda x, name: x @ params[name]
+    from ..ops.pallas_ops.quantized_matmul import quantized_matmul
+
+    wq = {name: (jnp.asarray(q), jnp.asarray(s, jnp.float32))
+          for name, (q, s) in weight_quant.items()}
+
+    def mm(x, name):
+        ent = wq.get(name)
+        if ent is None:
+            return x @ params[name]
+        return quantized_matmul(x, ent[0], ent[1])
+
+    return mm
+
+
+def _quant_write_page(pages, scales, page_idx, slot, val, static_scale):
+    """Scatter one new [N, H, D] K or V slab into int8 pages.
+
+    static_scale is the calibrated [H] scale (static mode) or None
+    (dynamic mode: grow the written pages' [N, H] scales by abs-max and
+    requantize their prior content under the new scale).  Returns
+    (pages', scales').  Duplicate page indices (a prefill chunk writing
+    several slots of one page) are safe: the scale update is a
+    scatter-MAX and every duplicate computes identical rescaled content.
+    """
+    valf = val.astype(jnp.float32)
+    if static_scale is not None:
+        q = jnp.clip(jnp.round(valf / static_scale[None, :, None]),
+                     -_KV_QMAX, _KV_QMAX).astype(jnp.int8)
+        return pages.at[page_idx, slot].set(q), scales
+    amax = jnp.max(jnp.abs(valf), axis=-1)                   # [N, H]
+    cand = jnp.maximum(amax / _KV_QMAX, 1e-8)
+    s_old = scales[page_idx]                                 # [N, H]
+    scales = scales.at[page_idx].max(cand)
+    s_new = scales[page_idx]
+    old = pages[page_idx].astype(jnp.float32)                # [N, P, H, D]
+    resc = jnp.round(old * (s_old / s_new)[:, None, :, None])
+    pages = pages.at[page_idx].set(resc.astype(jnp.int8))
+    q = jnp.clip(jnp.round(valf / s_new[:, :, None]),
+                 -_KV_QMAX, _KV_QMAX).astype(jnp.int8)
+    return pages.at[page_idx, slot].set(q), scales
+
+
+def _as_layer_scales(kv_scales, L, H):
+    """Normalize a slim kv-scale export ({"k": [L x [H]], "v": ...}) to
+    per-layer jnp f32 arrays; None stays None (dynamic mode)."""
+    if kv_scales is None:
+        return None, None
+    ks = [jnp.asarray(np.asarray(kv_scales["k"][i], np.float32))
+          for i in range(L)]
+    vs = [jnp.asarray(np.asarray(kv_scales["v"][i], np.float32))
+          for i in range(L)]
+    for arr in ks + vs:
+        if arr.shape != (H,):
+            raise ValueError(
+                f"kv_scales entries must be [{H}] per layer, got "
+                f"{arr.shape}")
+    return ks, vs
+
+
+def make_gpt_decode_step(model, max_len: int, *, kv_cache_dtype=None,
+                         kv_scales=None, weight_quant=None):
     """Build (step_fn, init_state) for a GPTModel.
 
     step_fn(tokens [N], state) -> (logits [N, vocab], state) — one decode
     position per call, cache-backed; the state's leaves all have leading
     dim N so nn.decode's beam reordering (s[parent]) works unchanged.
+
+    Quantized variants (docs/SERVING.md "Quantized serving"):
+    ``kv_cache_dtype="int8"`` stores the ring cache as int8 with the
+    calibrated per-layer-per-head ``kv_scales`` (REQUIRED here — the
+    dense ring has no per-page scale state, so only the static mode
+    applies); new K/V is quantized at write time with the same scales
+    the paged serving path uses, so greedy tokens match the quantized
+    engine's.  ``weight_quant`` routes the projection/MLP matmuls
+    through the weight-only int8 kernel.
     """
     params, _ = get_state(model)
     L = len(model.layers)
@@ -56,12 +154,22 @@ def make_gpt_decode_step(model, max_len: int):
     scale = 1.0 / np.sqrt(D)
     wte = params["wte.weight"]          # [V, hidden]
     wpe = params["wpe.weight"]          # [max_pos, hidden]
+    quant_kv = kv_cache_dtype == "int8"
+    if kv_cache_dtype not in (None, "int8"):
+        raise ValueError(f"kv_cache_dtype must be None or 'int8', got "
+                         f"{kv_cache_dtype!r}")
+    if quant_kv and kv_scales is None:
+        raise ValueError("the dense decode cache supports int8 only with "
+                         "calibrated kv_scales (slim.export_serving_quant)")
+    k_sc, v_sc = _as_layer_scales(kv_scales, L, H)
+    mm = _make_mm(params, weight_quant)
 
     def lp(i, name):
         return params[f"layers.{i}.{name}"]
 
     def init_state(batch: int):
-        z = jnp.zeros((batch, max_len, H, D), wte.dtype)
+        cache_dtype = jnp.int8 if quant_kv else wte.dtype
+        z = jnp.zeros((batch, max_len, H, D), cache_dtype)
         return {
             "k": [z for _ in range(L)],
             "v": [z for _ in range(L)],
@@ -71,6 +179,19 @@ def make_gpt_decode_step(model, max_len: int):
             "pos": jnp.zeros((batch,), jnp.int32),
         }
 
+    def _store(val, i, sc):
+        """Cache-dtype conversion for one new [N, H, D] slab."""
+        if not quant_kv:
+            return val
+        return jnp.clip(jnp.round(val.astype(jnp.float32)
+                                  / sc[i][None, :, None]),
+                        -_KV_QMAX, _KV_QMAX).astype(jnp.int8)
+
+    def _load(cache, i, sc):
+        if not quant_kv:
+            return cache
+        return cache.astype(jnp.float32) * sc[i][None, None, :, None]
+
     def step_fn(tokens, state):
         pos = state["pos"]                                   # [N]
         N = tokens.shape[0]
@@ -78,28 +199,33 @@ def make_gpt_decode_step(model, max_len: int):
         ks, vs = [], []
         for i in range(L):
             h = _ln(x, lp(i, "ln1.weight"), lp(i, "ln1.bias"))
-            q = (h @ lp(i, "attn.q_proj.weight")
+            q = (mm(h, f"layers.{i}.attn.q_proj.weight")
                  + lp(i, "attn.q_proj.bias")).reshape(N, H, D)
-            k1 = (h @ lp(i, "attn.k_proj.weight")
+            k1 = (mm(h, f"layers.{i}.attn.k_proj.weight")
                   + lp(i, "attn.k_proj.bias")).reshape(N, H, D)
-            v1 = (h @ lp(i, "attn.v_proj.weight")
+            v1 = (mm(h, f"layers.{i}.attn.v_proj.weight")
                   + lp(i, "attn.v_proj.bias")).reshape(N, H, D)
-            kc = state["k"][i].at[jnp.arange(N), pos].set(k1)
-            vc = state["v"][i].at[jnp.arange(N), pos].set(v1)
+            kc = state["k"][i].at[jnp.arange(N), pos].set(
+                _store(k1, i, k_sc))
+            vc = state["v"][i].at[jnp.arange(N), pos].set(
+                _store(v1, i, v_sc))
             ks.append(kc)
             vs.append(vc)
             # attend over the cache's valid prefix (<= pos)
-            logits = jnp.einsum("nhd,nshd->nhs", q, kc) * scale
+            kcf = _load(kc, i, k_sc)
+            vcf = _load(vc, i, v_sc)
+            logits = jnp.einsum("nhd,nshd->nhs", q, kcf) * scale
             valid = (jnp.arange(max_len)[None, :]
                      <= pos[:, None])[:, None, :]            # [N,1,S]
             logits = jnp.where(valid, logits, -1e9)
             probs = jax.nn.softmax(logits, axis=-1)
-            ctx = jnp.einsum("nhs,nshd->nhd", probs, vc).reshape(N, hidden)
-            x = x + (ctx @ lp(i, "attn.out_proj.weight")
+            ctx = jnp.einsum("nhs,nshd->nhd", probs,
+                             vcf).reshape(N, hidden)
+            x = x + (mm(ctx, f"layers.{i}.attn.out_proj.weight")
                      + lp(i, "attn.out_proj.bias"))
             h2 = _ln(x, lp(i, "ln2.weight"), lp(i, "ln2.bias"))
-            ff = _gelu(h2 @ lp(i, "fc1.weight") + lp(i, "fc1.bias"))
-            x = x + ff @ lp(i, "fc2.weight") + lp(i, "fc2.bias")
+            ff = _gelu(mm(h2, f"layers.{i}.fc1.weight") + lp(i, "fc1.bias"))
+            x = x + mm(ff, f"layers.{i}.fc2.weight") + lp(i, "fc2.bias")
         x = _ln(x, params["ln_f.weight"], params["ln_f.bias"])
         out = x @ wte.T                                      # tied head
         return out, {"k": ks, "v": vs, "pos": pos + 1}
@@ -107,7 +233,9 @@ def make_gpt_decode_step(model, max_len: int):
     return step_fn, init_state
 
 
-def _make_gpt_paged_core(model, page_size: int, pages_per_seq: int):
+def _make_gpt_paged_core(model, page_size: int, pages_per_seq: int, *,
+                         kv_cache_dtype=None, kv_scales=None,
+                         weight_quant=None):
     """Shared paged-KV transformer core behind the serving step builders.
 
     Returns ``(core, init_pages)`` where ``core(tokens [N], pos [N],
@@ -129,6 +257,17 @@ def _make_gpt_paged_core(model, page_size: int, pages_per_seq: int):
     their attention span, so padded lanes can never touch live pages.
     ``with_head=False`` skips the [N, V] logits matmul (prefill discards
     logits — the first decode step consumes the last prompt token).
+
+    Quantization (docs/SERVING.md "Quantized serving"):
+    ``kv_cache_dtype="int8"`` makes ``init_pages`` return int8 pools
+    plus per-page-per-head fp32 scale arrays (``k_scale``/``v_scale``,
+    [N, H] per layer); writes quantize in the jitted step and attention
+    dequantizes in-register in the paged-attention kernel.  With
+    calibrated ``kv_scales`` the scale arrays are CONSTANT (static
+    mode); without, they grow per page by scatter-max and the page is
+    requantized on scale growth (dynamic mode — the engine resets a
+    page's scales when it is reallocated).  ``weight_quant`` routes the
+    projection/MLP matmuls through the weight-only int8 kernel.
     """
     from ..ops.pallas_ops.paged_attention import paged_attention as paged_attn
 
@@ -140,6 +279,12 @@ def _make_gpt_paged_core(model, page_size: int, pages_per_seq: int):
     wte = params["wte.weight"]
     wpe = params["wpe.weight"]
     max_pos = wpe.shape[0]
+    quant_kv = kv_cache_dtype == "int8"
+    if kv_cache_dtype not in (None, "int8"):
+        raise ValueError(f"kv_cache_dtype must be None or 'int8', got "
+                         f"{kv_cache_dtype!r}")
+    k_sc, v_sc = _as_layer_scales(kv_scales, L, H)
+    mm = _make_mm(params, weight_quant)
 
     def lp(i, name):
         return params[f"layers.{i}.{name}"]
@@ -149,9 +294,27 @@ def _make_gpt_paged_core(model, page_size: int, pages_per_seq: int):
         # pools to the jitted step, and XLA rejects donating one buffer
         # twice (a shared zeros array would alias all 2L entries)
         def z():
-            return jnp.zeros((num_pages, page_size, H, D), wte.dtype)
+            dt = jnp.int8 if quant_kv else wte.dtype
+            return jnp.zeros((num_pages, page_size, H, D), dt)
 
-        return {"k": [z() for _ in range(L)], "v": [z() for _ in range(L)]}
+        kv = {"k": [z() for _ in range(L)], "v": [z() for _ in range(L)]}
+        if quant_kv:
+            # static mode: the calibrated scale broadcast per page (the
+            # write path never mutates it); dynamic: the eps floor, grown
+            # by scatter-max as pages fill
+            def sc(static):
+                from ..serving.kv_cache import KV_SCALE_EPS
+
+                if static is None:
+                    return jnp.full((num_pages, H), KV_SCALE_EPS,
+                                    jnp.float32)
+                return jnp.broadcast_to(
+                    static[None, :], (num_pages, H)).astype(jnp.float32) + 0
+            kv["k_scale"] = [sc(k_sc[i] if k_sc else None)
+                             for i in range(L)]
+            kv["v_scale"] = [sc(v_sc[i] if v_sc else None)
+                             for i in range(L)]
+        return kv
 
     def core(tokens, pos, page_tables, kv, valid_len=None, with_head=True):
         N = tokens.shape[0]
@@ -171,26 +334,42 @@ def _make_gpt_paged_core(model, page_size: int, pages_per_seq: int):
             page_idx = jnp.where(pos < valid_len, page_idx, 0)
             seq_lens = jnp.minimum(seq_lens, valid_len)
         ks, vs = [], []
+        ksc_out, vsc_out = [], []
         for i in range(L):
             h = _ln(x, lp(i, "ln1.weight"), lp(i, "ln1.bias"))
-            q = (h @ lp(i, "attn.q_proj.weight")
+            q = (mm(h, f"layers.{i}.attn.q_proj.weight")
                  + lp(i, "attn.q_proj.bias")).reshape(N, H, D)
-            k1 = (h @ lp(i, "attn.k_proj.weight")
+            k1 = (mm(h, f"layers.{i}.attn.k_proj.weight")
                   + lp(i, "attn.k_proj.bias")).reshape(N, H, D)
-            v1 = (h @ lp(i, "attn.v_proj.weight")
+            v1 = (mm(h, f"layers.{i}.attn.v_proj.weight")
                   + lp(i, "attn.v_proj.bias")).reshape(N, H, D)
-            kc = kv["k"][i].at[page_idx, slot].set(k1)
-            vc = kv["v"][i].at[page_idx, slot].set(v1)
+            if quant_kv:
+                kc, ksc = _quant_write_page(
+                    kv["k"][i], kv["k_scale"][i], page_idx, slot, k1,
+                    k_sc[i] if k_sc else None)
+                vc, vsc = _quant_write_page(
+                    kv["v"][i], kv["v_scale"][i], page_idx, slot, v1,
+                    v_sc[i] if v_sc else None)
+                ksc_out.append(ksc)
+                vsc_out.append(vsc)
+                ctx = paged_attn(q, kc, vc, page_tables, seq_lens,
+                                 ksc, vsc).reshape(N, hidden)
+            else:
+                kc = kv["k"][i].at[page_idx, slot].set(k1)
+                vc = kv["v"][i].at[page_idx, slot].set(v1)
+                ctx = paged_attn(q, kc, vc, page_tables,
+                                 seq_lens).reshape(N, hidden)
             ks.append(kc)
             vs.append(vc)
-            ctx = paged_attn(q, kc, vc, page_tables,
-                             seq_lens).reshape(N, hidden)
-            x = x + (ctx @ lp(i, "attn.out_proj.weight")
+            x = x + (mm(ctx, f"layers.{i}.attn.out_proj.weight")
                      + lp(i, "attn.out_proj.bias"))
             h2 = _ln(x, lp(i, "ln2.weight"), lp(i, "ln2.bias"))
-            ff = _gelu(h2 @ lp(i, "fc1.weight") + lp(i, "fc1.bias"))
-            x = x + ff @ lp(i, "fc2.weight") + lp(i, "fc2.bias")
+            ff = _gelu(mm(h2, f"layers.{i}.fc1.weight") + lp(i, "fc1.bias"))
+            x = x + mm(ff, f"layers.{i}.fc2.weight") + lp(i, "fc2.bias")
         kv_out = {"k": ks, "v": vs}
+        if quant_kv:
+            kv_out["k_scale"] = ksc_out
+            kv_out["v_scale"] = vsc_out
         if not with_head:
             return None, kv_out
         x = _ln(x, params["ln_f.weight"], params["ln_f.bias"])
@@ -199,7 +378,9 @@ def _make_gpt_paged_core(model, page_size: int, pages_per_seq: int):
     return core, init_pages
 
 
-def make_gpt_paged_decode_step(model, page_size: int, pages_per_seq: int):
+def make_gpt_paged_decode_step(model, page_size: int, pages_per_seq: int, *,
+                               kv_cache_dtype=None, kv_scales=None,
+                               weight_quant=None):
     """Paged-KV variant of ``make_gpt_decode_step`` — the serving engine's
     decode step (paddle_tpu/serving/engine.py).
 
@@ -222,8 +403,13 @@ def make_gpt_paged_decode_step(model, page_size: int, pages_per_seq: int):
     scatter there harmlessly and are never attended to (seq_len masks
     them), so the step needs no per-lane branching and its shape — hence
     its trace — depends only on the batch bucket.
+
+    ``kv_cache_dtype``/``kv_scales``/``weight_quant`` select the int8
+    serving path (see ``_make_gpt_paged_core``).
     """
-    core, init_pages = _make_gpt_paged_core(model, page_size, pages_per_seq)
+    core, init_pages = _make_gpt_paged_core(
+        model, page_size, pages_per_seq, kv_cache_dtype=kv_cache_dtype,
+        kv_scales=kv_scales, weight_quant=weight_quant)
 
     def step_fn(tokens, pos, page_tables, kv):
         return core(tokens, pos, page_tables, kv)
@@ -231,7 +417,9 @@ def make_gpt_paged_decode_step(model, page_size: int, pages_per_seq: int):
     return step_fn, init_pages
 
 
-def make_gpt_paged_prefill_step(model, page_size: int, pages_per_seq: int):
+def make_gpt_paged_prefill_step(model, page_size: int, pages_per_seq: int, *,
+                                kv_cache_dtype=None, kv_scales=None,
+                                weight_quant=None):
     """Chunked parallel prefill over the paged KV cache — C prompt tokens
     per device program instead of a token-at-a-time scan, so a prompt
     costs O(P / C) dispatches instead of O(P) sequential steps.
@@ -253,7 +441,9 @@ def make_gpt_paged_prefill_step(model, page_size: int, pages_per_seq: int):
     buckets (utils.bucketing.chunk_schedule) without junk escaping into
     live pages.
     """
-    core, init_pages = _make_gpt_paged_core(model, page_size, pages_per_seq)
+    core, init_pages = _make_gpt_paged_core(
+        model, page_size, pages_per_seq, kv_cache_dtype=kv_cache_dtype,
+        kv_scales=kv_scales, weight_quant=weight_quant)
 
     def chunk_fn(tokens, positions, page_table_row, valid_len, kv):
         C = tokens.shape[0]
@@ -267,7 +457,9 @@ def make_gpt_paged_prefill_step(model, page_size: int, pages_per_seq: int):
 
 
 def make_gpt_paged_fused_decode_step(model, page_size: int,
-                                     pages_per_seq: int, num_steps: int):
+                                     pages_per_seq: int, num_steps: int, *,
+                                     kv_cache_dtype=None, kv_scales=None,
+                                     weight_quant=None):
     """Fused K-step greedy decode: one device program advances every lane
     ``num_steps`` positions through a ``lax.fori_loop`` (KV pools carried
     in-place through the loop), returning all K tokens in one [K, B]
@@ -285,7 +477,9 @@ def make_gpt_paged_fused_decode_step(model, page_size: int,
     """
     if num_steps < 1:
         raise ValueError("num_steps must be >= 1")
-    core, init_pages = _make_gpt_paged_core(model, page_size, pages_per_seq)
+    core, init_pages = _make_gpt_paged_core(
+        model, page_size, pages_per_seq, kv_cache_dtype=kv_cache_dtype,
+        kv_scales=kv_scales, weight_quant=weight_quant)
 
     def fused_fn(tokens, pos, page_tables, kv):
         B = tokens.shape[0]
@@ -319,11 +513,17 @@ def prefill(step_fn, state, prompt: jnp.ndarray):
 
 def generate(model, input_ids, max_new_tokens: int = 32, end_id: int = 0,
              decode_strategy: str = "greedy", num_beams: int = 4,
-             length_penalty: float = 0.0):
+             length_penalty: float = 0.0, quant=None):
     """GPTModel text generation (the serving decode path).
 
     input_ids: [B, P] prompt (np/jnp int).  Returns [B, T] (greedy) or
-    [B, K, T] (beam_search) continuations, T = max_new_tokens."""
+    [B, K, T] (beam_search) continuations, T = max_new_tokens.
+
+    ``quant``: an export from ``slim.export_serving_quant`` — runs the
+    decode with the int8 KV cache and/or weight-only int8 matmuls it
+    describes (the reference stream the quantized serving engine is
+    pinned byte-identical to; int8 KV here requires the export's
+    calibrated kv_scales)."""
     from ..nn.decode import beam_search_decode, greedy_search_decode
     from ..tensor import Tensor
     from ..utils.profiler import RecordEvent
@@ -340,7 +540,14 @@ def generate(model, input_ids, max_new_tokens: int = 32, end_id: int = 0,
         raise ValueError(
             f"prompt ({P}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"the model's max_seq_len ({max_pos})")
-    step_fn, init_state = make_gpt_decode_step(model, max_len)
+    qkw = {}
+    if quant is not None:
+        if quant.get("kv_cache_dtype") == "int8":
+            qkw.update(kv_cache_dtype="int8",
+                       kv_scales=quant.get("kv_scales"))
+        if quant.get("weight_dtype") == "int8":
+            qkw.update(weight_quant=quant.get("weights"))
+    step_fn, init_state = make_gpt_decode_step(model, max_len, **qkw)
 
     if decode_strategy == "greedy":
         with RecordEvent("text.generation", strategy="greedy",

@@ -1,0 +1,60 @@
+"""tools/bench_diff.py smoke test — flatten/diff/CLI on synthetic bench
+files, plus recovery of the driver-wrapped {tail: "..."} format."""
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+import bench_diff  # noqa: E402
+
+
+A = {"metric": "x", "value": 100.0, "unit": "tok/s",
+     "detail": {"occupancy": 0.5, "steps": 10, "nested": {"p50": 2.0},
+                "flag": True}}
+B = {"metric": "x", "value": 150.0, "unit": "tok/s",
+     "detail": {"occupancy": 0.75, "steps": 10, "nested": {"p50": 1.0},
+                "new_metric": 7}}
+
+
+def test_flatten_numeric_leaves_only():
+    flat = bench_diff.flatten(A)
+    assert flat["value"] == 100.0
+    assert flat["detail.nested.p50"] == 2.0
+    assert "unit" not in flat and "metric" not in flat
+    assert "detail.flag" not in flat          # bools are labels
+
+
+def test_diff_rows_and_pct():
+    rows = {r["metric"]: r for r in bench_diff.diff(A, B)}
+    assert rows["value"]["delta"] == 50.0
+    assert rows["value"]["pct"] == pytest.approx(50.0)
+    assert rows["detail.occupancy"]["pct"] == pytest.approx(50.0)
+    assert rows["detail.new_metric"]["a"] is None    # one-sided survives
+    assert rows["detail.steps"]["delta"] == 0.0
+    only = bench_diff.diff(A, B, only="occupancy")
+    assert [r["metric"] for r in only] == ["detail.occupancy"]
+    moved = bench_diff.diff(A, B, min_pct=10.0)
+    assert all(r["pct"] is None or abs(r["pct"]) >= 10.0 for r in moved)
+
+
+def test_cli_end_to_end(tmp_path, capsys):
+    pa, pb = tmp_path / "a.json", tmp_path / "b.json"
+    pa.write_text(json.dumps(A))
+    pb.write_text(json.dumps(B))
+    rc = bench_diff.main([str(pa), str(pb), "--only", "value"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "value" in out and "+50.0%" in out
+
+
+def test_driver_tail_recovery(tmp_path):
+    wrapped = {"n": 1, "cmd": "python bench.py", "rc": 0, "parsed": None,
+               "tail": 'truncated junk {"broken": '
+                       + json.dumps({"serving": A}) + " trailing"}
+    p = tmp_path / "w.json"
+    p.write_text(json.dumps(wrapped))
+    loaded = bench_diff.load(str(p))
+    assert loaded == {"serving": A}

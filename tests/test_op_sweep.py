@@ -519,9 +519,19 @@ OVERRIDES.update({
 
 WAIVED = {}
 
+def _woq_inputs(rng):
+    # x [3,4] f32, int8 weights [4,5], positive per-out-channel scales [5]
+    w = fmat(rng, 4, 5)
+    scale = (np.abs(w).max(axis=0) / 127 + 1e-6).astype(np.float32)
+    q = np.clip(np.round(w / scale[None, :]), -127, 127).astype(np.int8)
+    return [t(fmat(rng, 3, 4)), t(q), t(scale)]
+
+
 OVERRIDES.update({
     "linalg.matmul_with_flatten": Spec(lambda rng: [t(fmat(rng, 2, 2, 4)),
                                                     t(fmat(rng, 8, 5))]),
+    # int8 weights are not differentiable inputs (ISSUE 4 weight-only path)
+    "linalg.weight_only_matmul": Spec(_woq_inputs, **NOGRAD),
     "manipulation.pad": Spec(lambda rng: [_img_chw(rng)],
                              kwargs={"pad": [1, 1, 1, 1]}),
     "common.pad": Spec(lambda rng: [_img_chw(rng)],

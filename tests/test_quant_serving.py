@@ -1,0 +1,453 @@
+"""Quantized serving path (ISSUE 4): int8 paged KV cache + weight-only
+int8 matmuls, bridged from slim PTQ.
+
+Acceptance anchors:
+- per-page-per-head scale round-trip: the numpy layout reference in
+  serving/kv_cache.py, the jitted write path and the kernel dequant all
+  agree (round-trip error <= scale/2 per element);
+- quantized matmul kernel vs the jnp dequant reference <= 1e-2;
+- quantized-vs-native decode parity: token-identical greedy on the
+  calibrated toy GPT, logits within tolerance;
+- the int8 engine keeps every ISSUE-3 execution-model guarantee:
+  sync == pipelined == fused byte-identity (static AND dynamic scale
+  modes, under forced preemption), token identity with the quantized
+  ``generate(quant=...)`` reference, and a transfer-guard-clean steady
+  state;
+- int8 KV-cache bytes are >= 1.8x below the native pools'.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.serving import ServingEngine
+from paddle_tpu.serving.kv_cache import (kv_page_bytes, quantize_kv_page,
+                                         dequantize_kv_page)
+from paddle_tpu.slim import (calibrate_kv_scales, export_serving_quant,
+                             quantize_gpt_weights)
+from paddle_tpu.text.generation import (generate, make_gpt_decode_step,
+                                        make_gpt_paged_decode_step)
+from paddle_tpu.text.models import GPTModel
+
+VOCAB, HID, LAYERS, HEADS = 50, 32, 2, 2
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    paddle.seed(11)
+    m = GPTModel(vocab_size=VOCAB, hidden_size=HID, num_layers=LAYERS,
+                 num_heads=HEADS, ffn_size=64, max_seq_len=64, dropout=0.0)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def quant(gpt):
+    rng = np.random.RandomState(5)
+    return export_serving_quant(gpt, calib_prompts=rng.randint(
+        1, VOCAB, (4, 16)))
+
+
+class TestKVPageRoundTrip:
+    def test_roundtrip_error_bounded_by_half_scale(self):
+        rng = np.random.RandomState(0)
+        page = rng.randn(8, 4, 16).astype(np.float32) * 3.0
+        q, scales = quantize_kv_page(page)
+        assert q.dtype == np.int8 and scales.shape == (4,)
+        back = dequantize_kv_page(q, scales)
+        # symmetric round-to-nearest: error <= scale/2 per element
+        assert (np.abs(back - page)
+                <= scales[None, :, None] / 2 + 1e-7).all()
+
+    def test_calibrated_scales_clip_not_wrap(self):
+        page = np.ones((4, 2, 8), np.float32) * 100.0
+        q, _ = quantize_kv_page(page, scales=np.array([0.1, 0.1],
+                                                      np.float32))
+        assert (q == 127).all()          # clipped, no int8 wraparound
+
+    def test_page_bytes_accounting(self):
+        # bf16: 2 bytes/elem; int8: 1 byte/elem + 4 bytes/head scale
+        assert kv_page_bytes(16, 8, 32, "bfloat16") == 16 * 8 * 32 * 2
+        assert kv_page_bytes(16, 8, 32, "int8") == 16 * 8 * 32 + 8 * 4
+        assert (kv_page_bytes(16, 8, 32, "bfloat16")
+                / kv_page_bytes(16, 8, 32, "int8")) > 1.9
+        with pytest.raises(ValueError):
+            kv_page_bytes(16, 8, 32, "int4")
+
+    def test_device_write_path_matches_numpy_reference(self, gpt, quant):
+        """One decode write through the jitted paged core stores the
+        SAME int8 values the numpy reference produces."""
+        step, init_pages = make_gpt_paged_decode_step(
+            gpt, 4, 4, kv_cache_dtype="int8",
+            kv_scales=quant["kv_scales"])
+        kv = init_pages(3)
+        tok = jnp.asarray([7], jnp.int32)
+        _, kv = step(tok, jnp.asarray([0], jnp.int32),
+                     jnp.asarray([[1, 0, 0, 0]], jnp.int32), kv)
+        # recompute the layer-0 k projection on host, quantize via the
+        # numpy reference with the same calibrated scales
+        from paddle_tpu.jit.functional import get_state
+
+        params, _ = get_state(gpt)
+        x = np.asarray(params["wte.weight"])[7] + \
+            np.asarray(params["wpe.weight"])[0]
+        xf = x.astype(np.float32)
+        mean, var = xf.mean(), xf.var()
+        h = (xf - mean) / np.sqrt(var + 1e-5)
+        h = h * np.asarray(params["layers.0.ln1.weight"]) + \
+            np.asarray(params["layers.0.ln1.bias"])
+        k1 = (h @ np.asarray(params["layers.0.attn.k_proj.weight"])
+              + np.asarray(params["layers.0.attn.k_proj.bias"]))
+        k1 = k1.reshape(HEADS, HID // HEADS)
+        want, _ = quantize_kv_page(k1[None],
+                                   scales=quant["kv_scales"]["k"][0])
+        got = np.asarray(kv["k"][0])[1, 0]           # page 1, slot 0
+        np.testing.assert_array_equal(got, want[0])
+
+
+class TestQuantizedMatmul:
+    def _mk(self, M, K, N, seed=0):
+        rng = np.random.RandomState(seed)
+        x = jnp.asarray(rng.randn(M, K).astype(np.float32))
+        w = rng.randn(K, N).astype(np.float32)
+        s = (np.abs(w).max(axis=0) / 127).astype(np.float32)
+        q = np.clip(np.round(w / s[None, :]), -127, 127).astype(np.int8)
+        ref = np.asarray(x) @ (q.astype(np.float32) * s[None, :])
+        return x, jnp.asarray(q), jnp.asarray(s), ref
+
+    def test_kernel_vs_jnp_reference(self):
+        from paddle_tpu.ops.pallas_ops.quantized_matmul import (
+            quantized_matmul_kernel)
+
+        for shape in [(8, 32, 64), (5, 33, 50), (64, 256, 300)]:
+            x, q, s, ref = self._mk(*shape)
+            out = np.asarray(quantized_matmul_kernel(x, q, s,
+                                                     interpret=True))
+            assert np.abs(out - ref).max() <= 1e-2, shape
+
+    def test_xla_route_matches_reference(self):
+        from paddle_tpu.ops.pallas_ops.quantized_matmul import (
+            quantized_matmul_xla)
+
+        x, q, s, ref = self._mk(16, 48, 96)
+        np.testing.assert_allclose(np.asarray(quantized_matmul_xla(x, q, s)),
+                                   ref, rtol=1e-5, atol=1e-5)
+
+    def test_forced_kernel_route_and_3d(self, monkeypatch):
+        from paddle_tpu.ops.pallas_ops import quantized_matmul as qmm
+
+        monkeypatch.setenv("PADDLE_TPU_FORCE_QMM", "1")
+        before = qmm.QMM_ROUTE_STATS["pallas"]
+        x, q, s, ref = self._mk(6, 32, 40)
+        out = qmm.quantized_matmul(x.reshape(2, 3, 32), q, s)
+        assert out.shape == (2, 3, 40)
+        assert np.abs(np.asarray(out).reshape(6, 40) - ref).max() <= 1e-2
+        assert qmm.QMM_ROUTE_STATS["pallas"] == before + 1
+
+    def test_ops_tensor_wrapper(self):
+        from paddle_tpu.ops.linalg import weight_only_matmul
+
+        x, q, s, ref = self._mk(4, 32, 16, seed=3)
+        out = weight_only_matmul(paddle.to_tensor(np.asarray(x)), q, s)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+class TestPagedAttentionInt8:
+    def test_kernel_dequant_matches_dense_reference(self):
+        from paddle_tpu.ops.pallas_ops.paged_attention import (
+            paged_attention_kernel, paged_attention_xla)
+
+        rng = np.random.RandomState(0)
+        N, P, H, D, B, M = 9, 4, 2, 16, 3, 6
+        kf = rng.randn(N, P, H, D).astype(np.float32)
+        vf = rng.randn(N, P, H, D).astype(np.float32)
+        ks = (np.abs(kf).max(axis=(1, 3)) / 127 + 1e-9).astype(np.float32)
+        vs = (np.abs(vf).max(axis=(1, 3)) / 127 + 1e-9).astype(np.float32)
+        kq = np.clip(np.round(kf / ks[:, None, :, None]), -127,
+                     127).astype(np.int8)
+        vq = np.clip(np.round(vf / vs[:, None, :, None]), -127,
+                     127).astype(np.int8)
+        q = jnp.asarray(rng.randn(B, H, D).astype(np.float32))
+        pt = np.zeros((B, M), np.int32)
+        pt[0, :3] = [1, 2, 3]
+        pt[1, :2] = [4, 5]
+        pt[2, :6] = [6, 7, 8, 1, 2, 3]
+        sl = jnp.asarray(np.array([11, 5, 0], np.int32))
+        pt = jnp.asarray(pt)
+        # reference: attention over the DEQUANTIZED dense pages
+        ref = paged_attention_xla(
+            q, jnp.asarray(kq.astype(np.float32) * ks[:, None, :, None]),
+            jnp.asarray(vq.astype(np.float32) * vs[:, None, :, None]),
+            pt, sl)
+        out = paged_attention_kernel(q, jnp.asarray(kq), jnp.asarray(vq),
+                                     pt, sl, jnp.asarray(ks),
+                                     jnp.asarray(vs), interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        # int8 XLA route agrees too, and the empty lane stays zero
+        out_xla = paged_attention_xla(q, jnp.asarray(kq), jnp.asarray(vq),
+                                      pt, sl, jnp.asarray(ks),
+                                      jnp.asarray(vs))
+        np.testing.assert_allclose(np.asarray(out_xla), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-6)
+        assert np.abs(np.asarray(out)[2]).max() == 0.0
+
+    def test_int8_pages_require_scales(self):
+        from paddle_tpu.ops.pallas_ops.paged_attention import (
+            paged_attention_xla)
+
+        z8 = jnp.zeros((2, 4, 2, 8), jnp.int8)
+        with pytest.raises(ValueError, match="require k_scales"):
+            paged_attention_xla(jnp.zeros((1, 2, 8)), z8, z8,
+                                jnp.zeros((1, 2), jnp.int32),
+                                jnp.zeros((1,), jnp.int32))
+
+
+class TestDecodeParity:
+    """Quantized-vs-native decode parity on the calibrated toy GPT."""
+
+    def test_paged_step_token_and_logit_parity(self, gpt, quant):
+        ps, M = 4, 16
+        step_fp, init_fp = make_gpt_paged_decode_step(gpt, ps, M)
+        step_st, init_st = make_gpt_paged_decode_step(
+            gpt, ps, M, kv_cache_dtype="int8",
+            kv_scales=quant["kv_scales"], weight_quant=quant["weights"])
+        step_dy, init_dy = make_gpt_paged_decode_step(
+            gpt, ps, M, kv_cache_dtype="int8")
+        row = np.zeros((M,), np.int32)
+        row[:4] = [1, 2, 3, 4]
+        kvs = [init_fp(6), init_st(6), init_dy(6)]
+        steps = [step_fp, step_st, step_dy]
+        tok = jnp.asarray([7], jnp.int32)
+        for t in range(12):
+            pos = jnp.asarray([t], jnp.int32)
+            logits = []
+            for i, (s, kv) in enumerate(zip(steps, kvs)):
+                lg, kvs[i] = s(tok, pos, jnp.asarray(row)[None, :], kv)
+                logits.append(lg)
+            # greedy tokens identical, logits within quant tolerance
+            nxt = [np.asarray(jnp.argmax(lg, -1)) for lg in logits]
+            assert np.array_equal(nxt[0], nxt[1])
+            assert np.array_equal(nxt[0], nxt[2])
+            assert float(jnp.abs(logits[1] - logits[0]).max()) <= 0.15
+            assert float(jnp.abs(logits[2] - logits[0]).max()) <= 0.15
+            tok = jnp.asarray(nxt[0], jnp.int32)
+
+    def test_dense_generate_quant_token_parity(self, gpt, quant):
+        # fixed seed with a comfortable top-2 logit margin: greedy
+        # parity under int8 noise is a calibrated-model property, not a
+        # universal one (seeds whose argmax sits on a knife edge flip —
+        # see docs/SERVING.md accuracy expectations)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(1, VOCAB, (3, 8))
+        out_fp, _ = generate(gpt, ids, max_new_tokens=8, end_id=0)
+        out_q, _ = generate(gpt, ids, max_new_tokens=8, end_id=0,
+                            quant=quant)
+        np.testing.assert_array_equal(out_fp.numpy(), out_q.numpy())
+
+    def test_dense_int8_requires_calibration(self, gpt):
+        with pytest.raises(ValueError, match="calibrated kv_scales"):
+            make_gpt_decode_step(gpt, 16, kv_cache_dtype="int8")
+
+
+def _drive_staggered(eng, prompts, budgets, arrivals):
+    ids = [None] * len(prompts)
+    submitted = 0
+    step = 0
+    while submitted < len(prompts) or eng.scheduler.has_work() \
+            or eng._pending:
+        while submitted < len(prompts) and arrivals[submitted] <= step:
+            ids[submitted] = eng.add_request(
+                prompts[submitted], max_new_tokens=budgets[submitted])
+            submitted += 1
+        eng.step()
+        step += 1
+        assert step < 10_000
+    return ids
+
+
+class TestQuantEngineIdentity:
+    """The ISSUE-3 execution-model guarantees must survive int8."""
+
+    @pytest.mark.parametrize("mode", ["static", "dynamic"])
+    def test_sync_pipelined_fused_byte_identical_with_preemption(
+            self, gpt, quant, mode):
+        rng = np.random.RandomState(7)
+        n = 16
+        lens = [1, 4, 9, 16]
+        plens = [lens[i % len(lens)] for i in range(n)]
+        budgets = [6] * n
+        prompts = [rng.randint(1, VOCAB, (p,)).astype(np.int32)
+                   for p in plens]
+        arrivals = np.cumsum(rng.exponential(0.7, n))
+        qkw = dict(kv_cache_dtype="int8", weight_dtype="int8")
+        if mode == "static":
+            qkw["quant_scales"] = quant
+
+        def build(**kw):
+            # num_pages tight enough that a full 8-lane batch preempts;
+            # one pinned lane bucket keeps the per-engine trace count
+            # low (the bucket-churn path is covered by
+            # tests/test_serving_async.py on the native dtype)
+            return ServingEngine(gpt, page_size=4, num_pages=21,
+                                 max_batch_size=8, bucket_sizes=[8],
+                                 eos_id=0, **qkw, **kw)
+
+        variants = [("sync", dict(sync_mode=True)), ("pipe", {}),
+                    ("fused", dict(fused_steps=4))]
+        outs = {}
+        for name, kw in variants:
+            eng = build(**kw)
+            ids = _drive_staggered(eng, prompts, budgets, arrivals)
+            outs[name] = [eng.outputs[i] for i in ids]
+            assert eng.cache.pages_in_use == 0
+            if name == "fused":
+                assert eng.scheduler.num_preemptions > 0
+        for name in ("pipe", "fused"):
+            for a, b in zip(outs["sync"], outs[name]):
+                np.testing.assert_array_equal(a, b)
+        if mode == "static":
+            # token identity with the quantized dense reference on the
+            # most preemption-churned prompt-length group
+            members = [i for i in range(n) if plens[i] == 9][:8]
+            want, _ = generate(gpt,
+                               np.stack([prompts[i] for i in members]),
+                               max_new_tokens=6, end_id=0, quant=quant)
+            want = want.numpy()
+            for row, i in enumerate(members):
+                w = want[row]
+                if (w == 0).any():
+                    w = w[: int(np.argmax(w == 0)) + 1]
+                np.testing.assert_array_equal(outs["sync"][i], w)
+
+    def test_steady_state_transfer_guard_clean(self, gpt, quant):
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=4, eos_id=-1,
+                            kv_cache_dtype="int8", weight_dtype="int8",
+                            quant_scales=quant)
+        rng = np.random.RandomState(1)
+        for p in (3, 6, 9, 12):
+            eng.add_request(rng.randint(1, VOCAB, (p,)).astype(np.int32),
+                            max_new_tokens=20)
+        for _ in range(4):
+            eng.step()
+        assert all(s is not None for s in eng._lanes)
+        with jax.transfer_guard("disallow"):
+            for _ in range(6):
+                stats = eng.step()
+                assert stats["bucket"] == 4
+        assert len(eng.drain()) == 4
+
+
+class TestQuantBytesAndStats:
+    def test_kv_cache_bytes_reduction(self, gpt, quant):
+        native = ServingEngine(gpt, page_size=4, max_batch_size=2,
+                               max_seq_len=32)
+        int8 = ServingEngine(gpt, page_size=4, max_batch_size=2,
+                             max_seq_len=32, kv_cache_dtype="int8",
+                             quant_scales=quant)
+        assert int8.kv_cache_bytes() < native.kv_cache_bytes()
+        assert (native.kv_cache_bytes()
+                / int8.kv_cache_bytes()) >= 1.8
+        # per-token form matches the kv_page_bytes accounting
+        D = HID // HEADS
+        expect = 2 * LAYERS * kv_page_bytes(4, HEADS, D, "int8") / 4
+        assert int8.kv_bytes_per_token() == pytest.approx(expect)
+
+    def test_stats_quant_section_and_gauges(self, gpt, quant):
+        from paddle_tpu.framework.monitor import stat_get
+
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=2,
+                            eos_id=-1, kv_cache_dtype="int8",
+                            weight_dtype="int8", quant_scales=quant)
+        eng.add_request(np.array([3, 5], np.int32), max_new_tokens=4)
+        eng.drain()
+        q = eng.stats()["quant"]
+        assert q["kv_cache_dtype"] == "int8"
+        assert q["weight_dtype"] == "int8"
+        assert q["kv_scale_mode"] == "static"
+        assert q["kv_cache_bytes"] == eng.kv_cache_bytes()
+        assert q["quant_weight_bytes"] > 0
+        assert stat_get("serving.kv_cache_bytes") == eng.kv_cache_bytes()
+        # per-step occupancy gauge was exported (last decode step ran
+        # with 1 live lane in a bucket of 1)
+        assert stat_get("serving.batch_occupancy") == 1.0
+
+    def test_dynamic_mode_reported(self, gpt):
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=2,
+                            kv_cache_dtype="int8")
+        assert eng.stats()["quant"]["kv_scale_mode"] == "dynamic"
+        assert eng._scale_reset_jit is not None
+
+    def test_engine_rejects_unknown_dtype(self, gpt):
+        with pytest.raises(ValueError, match="kv_cache_dtype"):
+            ServingEngine(gpt, kv_cache_dtype="int4")
+
+    def test_engine_rejects_orphan_quant_scales(self, gpt, quant):
+        # an export without the dtype knobs would silently run native
+        with pytest.raises(ValueError, match="quant_scales"):
+            ServingEngine(gpt, quant_scales=quant)
+
+    def test_paged_attention_rejects_one_sided_scales(self):
+        import paddle_tpu.nn.functional as F
+
+        z8 = jnp.zeros((2, 4, 2, 8), jnp.int8)
+        with pytest.raises(ValueError, match="together"):
+            F.paged_attention(jnp.zeros((1, 2, 8)), z8, z8,
+                              jnp.zeros((1, 2), jnp.int32),
+                              jnp.zeros((1,), jnp.int32),
+                              key_scales=jnp.ones((2, 2), jnp.float32))
+
+    def test_config_passthrough(self, gpt):
+        from paddle_tpu.inference import Config
+        from paddle_tpu.serving import create_serving_engine
+
+        cfg = Config()
+        cfg.enable_serving(max_batch_size=2, page_size=4,
+                           kv_cache_dtype="int8", weight_dtype="int8")
+        eng = create_serving_engine(gpt, cfg)
+        assert eng.kv_cache_dtype == "int8"
+        assert eng.weight_dtype == "int8"
+
+
+class TestSlimBridge:
+    def test_weight_export_shapes_and_reconstruction(self, gpt, quant):
+        from paddle_tpu.jit.functional import get_state
+
+        params, _ = get_state(gpt)
+        assert len(quant["weights"]) == 6 * LAYERS
+        name = "layers.0.fc1.weight"
+        qw, scale = quant["weights"][name]
+        w = np.asarray(params[name])
+        assert qw.shape == w.shape and qw.dtype == np.int8
+        assert scale.shape == (w.shape[1],)
+        back = qw.astype(np.float32) * scale[None, :]
+        assert np.abs(back - w).max() <= np.abs(w).max() / 127 + 1e-7
+
+    def test_kv_calibration_covers_calib_range(self, gpt):
+        rng = np.random.RandomState(9)
+        prompts = rng.randint(1, VOCAB, (2, 12))
+        scales = calibrate_kv_scales(gpt, prompts, margin=1.0)
+        assert len(scales["k"]) == LAYERS
+        assert all(s.shape == (HEADS,) and (s > 0).all()
+                   for s in scales["k"] + scales["v"])
+        # margin scales linearly
+        scales2 = calibrate_kv_scales(gpt, prompts, margin=2.0)
+        np.testing.assert_allclose(scales2["k"][0], scales["k"][0] * 2,
+                                   rtol=1e-6)
+
+    def test_export_without_calibration_is_dynamic(self, gpt):
+        exp = export_serving_quant(gpt, calib_prompts=None)
+        assert exp["kv_scales"] is None
+        assert exp["weights"] is not None
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=2,
+                            kv_cache_dtype="int8", weight_dtype="int8",
+                            quant_scales=exp)
+        assert eng._kv_dynamic
+
+    def test_quantize_gpt_weights_rejects_non_gpt(self):
+        import paddle_tpu.nn as nn
+
+        with pytest.raises(ValueError, match="GPTModel"):
+            quantize_gpt_weights(nn.Linear(4, 4))

@@ -1,0 +1,150 @@
+"""Diff two BENCH_*.json files and print per-metric deltas.
+
+The bench harness emits nested JSON ({model: {metric, value, unit,
+detail: {...}}}); round-over-round comparisons so far meant eyeballing
+two files side by side.  This tool flattens every NUMERIC leaf into a
+dotted path and prints old → new with absolute and percent deltas, so a
+quantization or scheduling change shows its tokens/sec, occupancy and
+bytes movement in one table.
+
+Usage:
+    python tools/bench_diff.py BENCH_r04.json BENCH_r05.json
+    python tools/bench_diff.py a.json b.json --only serving
+    python tools/bench_diff.py a.json b.json --min-pct 5
+
+Importable (``load``, ``flatten``, ``diff``, ``format_table``) so the
+smoke test runs it in-process; the CLI returns 0 (diffing is reporting,
+not gating).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Dict, List, Optional
+
+
+def load(path: str) -> dict:
+    """Load a bench JSON.  The CI driver wraps ``python bench.py``
+    output as {n, cmd, rc, tail, parsed} with the real result JSON
+    embedded (possibly head-truncated) in the ``tail`` string — when
+    ``parsed`` is empty, recover the largest decodable JSON object from
+    the tail so the diff sees real metrics instead of just {n, rc}."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict) and isinstance(data.get("tail"), str):
+        if isinstance(data.get("parsed"), dict) and data["parsed"]:
+            return data["parsed"]
+        recovered = _recover_json(data["tail"])
+        if recovered is not None:
+            return recovered
+    return data
+
+
+def _recover_json(text: str):
+    """Best-effort: decode the LARGEST complete JSON object found at any
+    '{' in ``text`` (head truncation cuts the outermost object open, but
+    the biggest surviving inner object is the most metric-complete; a
+    successful decode lets the scan skip past the decoded span)."""
+    dec = json.JSONDecoder()
+    best, best_size = None, 0
+    pos = text.find("{")
+    tries = 0
+    while pos != -1 and tries < 2000:
+        try:
+            obj, end = dec.raw_decode(text, pos)
+        except ValueError:
+            pos = text.find("{", pos + 1)
+            tries += 1
+            continue
+        if isinstance(obj, dict) and (end - pos) > best_size:
+            best, best_size = obj, end - pos
+        pos = text.find("{", end)
+        tries += 1
+    return best
+
+
+def flatten(obj, prefix: str = "") -> Dict[str, float]:
+    """Every numeric leaf as {dotted.path: float}; bools and strings are
+    skipped (they are labels, not metrics), list items index by [i]."""
+    out: Dict[str, float] = {}
+    if isinstance(obj, bool):
+        return out
+    if isinstance(obj, (int, float)):
+        out[prefix or "value"] = float(obj)
+        return out
+    if isinstance(obj, dict):
+        for k in sorted(obj):
+            p = f"{prefix}.{k}" if prefix else str(k)
+            out.update(flatten(obj[k], p))
+        return out
+    if isinstance(obj, list):
+        for i, v in enumerate(obj):
+            out.update(flatten(v, f"{prefix}[{i}]"))
+    return out
+
+
+def diff(a: dict, b: dict, only: Optional[str] = None,
+         min_pct: float = 0.0) -> List[dict]:
+    """Rows for every metric path present in either file: value in a,
+    value in b, absolute delta and percent change (None when the metric
+    is missing on one side or the baseline is 0)."""
+    fa, fb = flatten(a), flatten(b)
+    rows: List[dict] = []
+    for key in sorted(set(fa) | set(fb)):
+        if only and only not in key:
+            continue
+        va, vb = fa.get(key), fb.get(key)
+        delta = pct = None
+        if va is not None and vb is not None:
+            delta = vb - va
+            if va != 0:
+                pct = delta / abs(va) * 100.0
+            if min_pct and (pct is None or abs(pct) < min_pct):
+                continue
+        rows.append({"metric": key, "a": va, "b": vb,
+                     "delta": delta, "pct": pct})
+    return rows
+
+
+def _fmt(v, width=14) -> str:
+    if v is None:
+        return "-".rjust(width)
+    if abs(v) >= 1e6 or (v != 0 and abs(v) < 1e-3):
+        return f"{v:.4g}".rjust(width)
+    return f"{v:,.3f}".rstrip("0").rstrip(".").rjust(width)
+
+
+def format_table(rows: List[dict]) -> str:
+    if not rows:
+        return "no overlapping numeric metrics"
+    w = max(len(r["metric"]) for r in rows)
+    lines = [f"{'metric'.ljust(w)} {'a'.rjust(14)} {'b'.rjust(14)} "
+             f"{'delta'.rjust(14)} {'pct'.rjust(9)}"]
+    for r in rows:
+        pct = "-".rjust(9) if r["pct"] is None else f"{r['pct']:+8.1f}%"
+        lines.append(f"{r['metric'].ljust(w)} {_fmt(r['a'])} "
+                     f"{_fmt(r['b'])} {_fmt(r['delta'])} {pct}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Diff two BENCH_*.json files (per-metric deltas)")
+    ap.add_argument("file_a", help="baseline bench JSON")
+    ap.add_argument("file_b", help="comparison bench JSON")
+    ap.add_argument("--only", default=None,
+                    help="substring filter on metric paths")
+    ap.add_argument("--min-pct", type=float, default=0.0,
+                    help="hide rows that moved less than this percent")
+    args = ap.parse_args(argv)
+    rows = diff(load(args.file_a), load(args.file_b),
+                only=args.only, min_pct=args.min_pct)
+    print(format_table(rows))
+    changed = [r for r in rows if r["pct"] is not None]
+    print(f"\n{len(rows)} metrics, {len(changed)} comparable "
+          f"({args.file_a} -> {args.file_b})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
