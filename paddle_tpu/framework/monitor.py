@@ -173,8 +173,18 @@ class LabeledGauge:
         with self._lock:
             self._values[self._key(labels)] = float(value)
 
+    def add(self, value: float, **labels) -> float:
+        """Read-modify-write under the lock (two frontend threads doing
+        get()+set() would lose increments)."""
+        with self._lock:
+            k = self._key(labels)
+            v = self._values.get(k, 0.0) + float(value)
+            self._values[k] = v
+            return v
+
     def get(self, **labels) -> Optional[float]:
-        return self._values.get(self._key(labels))
+        with self._lock:
+            return self._values.get(self._key(labels))
 
     def values(self) -> Dict[Tuple[Tuple[str, str], ...], float]:
         with self._lock:

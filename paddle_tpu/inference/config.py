@@ -63,7 +63,8 @@ class Config:
     def enable_serving(self, max_batch_size=8, page_size=16, num_pages=None,
                        max_seq_len=None, eos_id=0, prefill_chunk=64,
                        sync_mode=False, fused_steps=1,
-                       kv_cache_dtype=None, weight_dtype=None):
+                       kv_cache_dtype=None, weight_dtype=None,
+                       replicas=1, queue_cap=64, default_deadline_ms=None):
         """Opt in to the continuous-batching serving engine
         (docs/SERVING.md).  Stores the paged-KV / scheduler knobs plus the
         pipelining knobs (``prefill_chunk`` tokens per prefill program,
@@ -74,8 +75,16 @@ class Config:
         docs/SERVING.md "Quantized serving"; pass calibrated scales from
         ``slim.export_serving_quant`` to ``create_serving_engine`` via
         ``quant_scales=...``).  Build the engine with
-        ``paddle_tpu.serving.create_serving_engine(model, config)``.  Not
-        reference API — the reference's serving story stops at
+        ``paddle_tpu.serving.create_serving_engine(model, config)``.
+
+        The FRONTEND knobs (docs/SERVING.md "Frontend & deployment")
+        configure ``create_serving_frontend(model, config)`` instead:
+        ``replicas`` engine replicas behind the least-outstanding-tokens
+        router, ``queue_cap`` live requests before reject-on-overload
+        (None = unbounded), ``default_deadline_ms`` applied to requests
+        submitted without an explicit deadline (None = no SLO).
+
+        Not reference API — the reference's serving story stops at
         AnalysisPredictor; this is the TPU-native extension."""
         self._serving = {
             "max_batch_size": int(max_batch_size),
@@ -89,6 +98,13 @@ class Config:
             "kv_cache_dtype": kv_cache_dtype,
             "weight_dtype": weight_dtype,
         }
+        self._serving_frontend = {
+            "replicas": int(replicas),
+            "queue_cap": None if queue_cap is None else int(queue_cap),
+            "default_deadline_ms": (
+                None if default_deadline_ms is None
+                else float(default_deadline_ms)),
+        }
 
     def serving_enabled(self) -> bool:
         return getattr(self, "_serving", None) is not None
@@ -97,6 +113,13 @@ class Config:
         if not self.serving_enabled():
             raise ValueError("serving not enabled — call enable_serving()")
         return dict(self._serving)
+
+    def frontend_config(self) -> dict:
+        """The ServingFrontend-side knobs of ``enable_serving`` —
+        consumed by ``serving.create_serving_frontend``."""
+        if not self.serving_enabled():
+            raise ValueError("serving not enabled — call enable_serving()")
+        return dict(self._serving_frontend)
 
     # --- optimization knobs (XLA-subsumed, kept for parity) -----------------
     def switch_ir_optim(self, flag=True):

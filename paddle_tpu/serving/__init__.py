@@ -10,23 +10,41 @@ Components
 - ``kv_cache.PagedKVCache``     host-side page-table manager over the
                                 global device page pools
 - ``scheduler.Scheduler``       admission / prefill-decode mixing /
-                                preemption / retirement policy
-- ``engine.ServingEngine``      pipelined core: add_request / step /
-                                drain — chunked parallel prefill,
-                                device-resident decode state, and a
-                                dispatch-ahead decode loop over the
-                                paged GPT step (``sync_mode=True``
+                                preemption / retirement / deadline
+                                policy
+- ``engine.ServingEngine``      pipelined core: add_request / abort /
+                                step / drain — chunked parallel
+                                prefill, device-resident decode state,
+                                and a dispatch-ahead decode loop over
+                                the paged GPT step (``sync_mode=True``
                                 restores the synchronous behavior)
-- ``metrics.ServingMetrics``    per-step observability through
-                                framework.monitor's StatRegistry
+- ``metrics.ServingMetrics``    per-step engine observability
+- ``metrics.FrontendMetrics``   per-request frontend observability
+- ``frontend.ServingFrontend``  thread-safe streaming front door:
+                                submit() → ResponseHandle, one pump
+                                thread per replica, deadline/overload
+                                admission control
+- ``router.Router``             least-outstanding-tokens multi-replica
+                                placement, health states, deterministic
+                                fault injection with transparent
+                                failover
+- ``http.ServingHTTPServer``    stdlib POST /generate (chunked token
+                                streaming) + /healthz + /metrics
 
 The attention primitive lives with the other Pallas kernels
 (ops/pallas_ops/paged_attention.py, routed via ops/attention.py).
 """
 from .engine import ServingEngine, create_serving_engine
+from .frontend import (ResponseHandle, ServingFrontend,
+                       create_serving_frontend)
+from .http import ServingHTTPServer, start_http_server
 from .kv_cache import PagedKVCache
-from .metrics import ServingMetrics
+from .metrics import FrontendMetrics, ServingMetrics
+from .router import Replica, Router
 from .scheduler import Request, Scheduler, Sequence
 
 __all__ = ["ServingEngine", "create_serving_engine", "PagedKVCache",
-           "ServingMetrics", "Request", "Scheduler", "Sequence"]
+           "ServingMetrics", "FrontendMetrics", "Request", "Scheduler",
+           "Sequence", "ServingFrontend", "ResponseHandle",
+           "create_serving_frontend", "Router", "Replica",
+           "ServingHTTPServer", "start_http_server"]

@@ -47,7 +47,7 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -92,7 +92,9 @@ class ServingEngine:
                  fused_steps: int = 1,
                  kv_cache_dtype: Optional[str] = None,
                  weight_dtype: Optional[str] = None,
-                 quant_scales: Optional[dict] = None):
+                 quant_scales: Optional[dict] = None,
+                 token_callback: Optional[Callable[[str, int, int],
+                                                   None]] = None):
         from ..text.generation import (make_gpt_paged_decode_step,
                                        make_gpt_paged_fused_decode_step,
                                        make_gpt_paged_prefill_step)
@@ -120,6 +122,17 @@ class ServingEngine:
         self.fused_steps = max(1, int(fused_steps))
         self.outputs: Dict[str, np.ndarray] = {}
         self._ttft_recorded = set()      # per REQUEST, preemption-proof
+        # streaming hook: called as (request_id, index, token) for every
+        # CONSUMED token, in emission order — the single consume path
+        # (_consume_one) serves sync, pipelined and fused modes alike,
+        # so the callback stream is byte-identical across all three.
+        # After a recompute-preemption the deterministic replay re-emits
+        # indices from 0; consumers keep only forward progress
+        # (index == tokens_seen), which reconstructs the exact stream.
+        self.token_callback = token_callback
+        # request ids whose deadline expired (queued or mid-decode) —
+        # drained by the frontend via take_expired()
+        self._expired: List[str] = []
 
         # --- int8 serving path (docs/SERVING.md "Quantized serving") ---
         # kv_cache_dtype="int8": pages store int8 + per-page-per-head
@@ -256,13 +269,18 @@ class ServingEngine:
         self._uploaded_pages: Dict[str, int] = {}
 
     # --- request intake ---------------------------------------------------
-    def add_request(self, prompt, max_new_tokens: int = 32,
-                    request_id: Optional[str] = None) -> str:
-        """Enqueue a generation request; returns its id.  Non-blocking —
-        admission happens inside step() when a slot and pages are free."""
+    def check_request(self, prompt, max_new_tokens: int = 32) -> np.ndarray:
+        """Validate a prospective request against this engine's static
+        limits WITHOUT enqueuing it; returns the canonicalized int32
+        prompt.  Raises ValueError on anything that could never run —
+        the frontend calls this at submit time so an impossible request
+        is rejected synchronously instead of failing inside a pump
+        thread."""
         if hasattr(prompt, "numpy"):
             prompt = prompt.numpy()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if prompt.size + max_new_tokens > self.max_seq_len:
@@ -282,8 +300,20 @@ class ServingEngine:
                 f"{max_new_tokens} new tokens @ page_size "
                 f"{self.page_size}) but the cache caps a sequence at "
                 f"{cap} pages — raise num_pages or lower max_new_tokens")
+        return prompt
+
+    def add_request(self, prompt, max_new_tokens: int = 32,
+                    request_id: Optional[str] = None,
+                    deadline: Optional[float] = None) -> str:
+        """Enqueue a generation request; returns its id.  Non-blocking —
+        admission happens inside step() when a slot and pages are free.
+        ``deadline`` is an ABSOLUTE ``time.monotonic()`` instant: once
+        passed, the request is dropped from the queue (never admitted)
+        or aborted mid-decode with its pages freed; either way its id
+        surfaces through ``take_expired()``."""
+        prompt = self.check_request(prompt, max_new_tokens)
         req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
-                      request_id=request_id or "")
+                      request_id=request_id or "", deadline=deadline)
         # a duplicate id would alias two live sequences onto one KV page
         # table (cross-contaminated attention, double-free) — reject it
         live = (req.request_id in self.outputs
@@ -297,6 +327,66 @@ class ServingEngine:
                 "has an unconsumed output")
         self.scheduler.add(req)
         return req.request_id
+
+    # --- abort ------------------------------------------------------------
+    def abort(self, request_id: str) -> bool:
+        """Retire a queued or in-flight sequence NOW: no output is
+        recorded, its pages and batch lane are freed, and (dynamic int8
+        mode) the freed pages' scales return to the eps floor so their
+        next owner quantizes from scratch.  Returns True when something
+        was aborted; False when the id is unknown or already finished
+        (a finished request's output stays in ``outputs``).
+
+        Survivor safety: the pipeline is collapsed first, so every
+        already-dispatched token is applied before the lane disappears —
+        survivors' streams are byte-identical with and without the abort
+        (tests/test_serving_abort.py pins this).  Not thread-safe: call
+        from the thread that drives ``step()``.
+        """
+        sched = self.scheduler
+        # still waiting (including a preempted sequence's requeued
+        # request): nothing on device, nothing to free
+        for req in sched.waiting:
+            if req.request_id == request_id:
+                sched.waiting.remove(req)
+                self._forget(request_id)
+                self.metrics.on_abort()
+                return True
+        seq = next((s for s in sched.running if s.seq_id == request_id),
+                   None)
+        if seq is None:
+            return False
+        # apply in-flight tokens before tearing the lane down; the
+        # target may complete here, in which case it finished first and
+        # the abort is a no-op
+        self._sync_pending()
+        if seq.done or seq not in sched.running:
+            return False
+        page_ids = self.cache.seq_page_ids(seq.seq_id)
+        sched.finish(seq)                 # frees pages, leaves running
+        seq.done = True
+        seq.epoch += 1                    # any stale device result drops
+        self._reset_page_scales(page_ids)
+        self._forget(request_id)
+        for i, lane_seq in enumerate(self._lanes):
+            if lane_seq is seq:
+                self._lanes[i] = None
+                self._clear_lane(i)
+        self.metrics.on_abort()
+        return True
+
+    def _forget(self, request_id: str):
+        """Drop per-request engine bookkeeping (abort/expiry path)."""
+        self._ttft_recorded.discard(request_id)
+        self._uploaded_pages.pop(request_id, None)
+
+    def take_expired(self) -> List[str]:
+        """Request ids whose deadline expired since the last call
+        (queued → dropped before admission; mid-decode → aborted, pages
+        freed).  Each id appears exactly once, and never in
+        ``outputs``."""
+        out, self._expired = self._expired, []
+        return out
 
     # --- device-resident lane state ---------------------------------------
     def _grow_state(self, new_bucket: int):
@@ -519,6 +609,9 @@ class ServingEngine:
                 seq.generated.append(tok)
                 seq.next_token = tok
                 emitted += 1
+                if self.token_callback is not None:
+                    self.token_callback(seq.seq_id,
+                                        seq.num_generated - 1, tok)
                 if (tok == self.eos_id
                         or seq.num_generated
                         >= seq.request.max_new_tokens):
@@ -556,6 +649,20 @@ class ServingEngine:
         sched = self.scheduler
         admitted: List[Sequence] = []
         emitted = 0
+        # deadline enforcement: expired-in-queue requests are dropped
+        # BEFORE admission (same `now` for the whole step, so a request
+        # expiring exactly on the admission step is rejected, never
+        # prefilled); expired-mid-decode sequences are aborted and their
+        # pages freed.  Pure host python — the steady-state decode loop
+        # stays transfer-guard-clean.
+        now = time.monotonic()
+        for req in sched.expire_queued(now):
+            self._expired.append(req.request_id)
+            self.metrics.on_deadline_miss()
+        for seq in [s for s in sched.running if s.request.expired(now)]:
+            if self.abort(seq.seq_id):
+                self._expired.append(seq.seq_id)
+                self.metrics.on_deadline_miss()
         # admission needs ground truth (free lanes/pages come from
         # retirements hiding in the pipeline), so it collapses the
         # pipeline first; a FULL batch skips the attempt entirely and

@@ -1,0 +1,662 @@
+"""ServingFrontend — the deployable front door over ServingEngine replicas.
+
+The engine (``engine.py``) ends at ``add_request / step / drain``: the
+caller pumps the loop, tokens arrive only at completion, and one engine
+is the whole deployment.  This module adds the host orchestration layer
+the ROADMAP's "heavy traffic" north star needs:
+
+- ``submit()`` is thread-safe and returns a **ResponseHandle** — a
+  per-token streaming iterator with ``cancel()``, ``result()``,
+  TTFT/e2e timing and a ``retried`` flag;
+- one **pump thread per replica** drives its engine's step loop,
+  streams consumed tokens into handles via the engine's
+  ``token_callback``, and enforces deadlines/cancellations between
+  steps (the engine itself stays single-threaded and threadless);
+- a **Router** places each request on the healthy replica with the
+  least outstanding tokens, and its deterministic fault-injection hook
+  kills a replica mid-decode: the frontend requeues the dead replica's
+  live requests onto survivors — streams restart from token 0 with
+  ``retried`` set (greedy decode is deterministic, so the retried
+  stream is byte-identical to the one the dead replica would have
+  produced);
+- **admission control**: a bounded live-request cap rejects on
+  overload, and per-request deadlines are enforced at submit time, in
+  the frontend queue, in the engine queue, and mid-decode (aborted,
+  pages freed).
+
+Threading model (docs/SERVING.md "Frontend & deployment")
+---------------------------------------------------------
+Engines are NOT thread-safe; each is owned by exactly one pump thread.
+Cross-thread traffic goes through per-replica inboxes guarded by the
+frontend lock, and through ResponseHandle's own condition variable.
+``submit()``/``cancel()``/HTTP handlers never touch an engine directly.
+
+Terminal statuses — every request reaches exactly one, no hangs:
+``completed`` | ``rejected`` | ``cancelled`` | ``deadline_miss`` |
+``failed`` (replica died with no healthy survivor, or the request was
+invalid for the engine).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from .engine import ServingEngine
+from .metrics import FrontendMetrics, ServingMetrics
+from .router import DEAD, Replica, Router
+
+__all__ = ["ResponseHandle", "ServingFrontend", "create_serving_frontend",
+           "QUEUED", "RUNNING", "COMPLETED", "REJECTED", "CANCELLED",
+           "DEADLINE_MISS", "FAILED", "TERMINAL_STATUSES"]
+
+QUEUED = "queued"
+RUNNING = "running"
+COMPLETED = "completed"
+REJECTED = "rejected"
+CANCELLED = "cancelled"
+DEADLINE_MISS = "deadline_miss"
+FAILED = "failed"
+TERMINAL_STATUSES = frozenset(
+    {COMPLETED, REJECTED, CANCELLED, DEADLINE_MISS, FAILED})
+
+
+class ResponseHandle:
+    """The caller's view of one submitted request (thread-safe).
+
+    Streaming: iterate the handle (or ``events()``) to receive tokens as
+    the engine emits them.  After a replica failure the stream RESTARTS
+    FROM TOKEN 0 on a surviving replica — ``events()`` yields a
+    ``("restart",)`` marker and re-yields from index 0, ``retried``
+    flips True, and (greedy decode being deterministic) the restarted
+    stream is byte-identical to what the dead replica was producing.
+    Blocking: ``result()`` waits for terminal state and returns the full
+    token array, raising on any non-completed outcome.
+    """
+
+    def __init__(self, request_id: str, max_new_tokens: int,
+                 deadline: Optional[float], frontend: "ServingFrontend"):
+        self._cond = threading.Condition()
+        self.request_id = request_id
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline = deadline          # absolute monotonic or None
+        self.submit_time = time.monotonic()
+        self.retried = False
+        self._frontend = frontend
+        self._tokens: List[int] = []
+        self._status = QUEUED
+        self._detail = ""
+        self._stream_epoch = 0            # bumps on failover restart
+        self._first_token_time: Optional[float] = None
+        self._finish_time: Optional[float] = None
+
+    # --- mutators (pump/frontend threads) -----------------------------------
+    def _on_token(self, index: int, token: int):
+        with self._cond:
+            if self._status in TERMINAL_STATUSES:
+                return
+            if index != len(self._tokens):
+                # recompute-preemption replay re-emits earlier indices —
+                # the values are identical (deterministic greedy), only
+                # forward progress appends
+                return
+            if self._first_token_time is None:
+                self._first_token_time = time.monotonic()
+            self._tokens.append(int(token))
+            self._status = RUNNING
+            self._cond.notify_all()
+
+    def _on_retry(self):
+        """Replica failure: drop the dead replica's partial stream and
+        restart from token 0 on a survivor.  TTFT keeps the FIRST token
+        the client ever saw (the wire truth), even though the stream
+        restarts."""
+        with self._cond:
+            if self._status in TERMINAL_STATUSES:
+                return
+            self._tokens = []
+            self._stream_epoch += 1
+            self.retried = True
+            self._status = QUEUED
+            self._cond.notify_all()
+
+    def _finish(self, status: str, tokens=None, detail: str = "") -> bool:
+        with self._cond:
+            if self._status in TERMINAL_STATUSES:
+                return False
+            if tokens is not None:
+                self._tokens = [int(t) for t in np.asarray(tokens).reshape(-1)]
+            self._status = status
+            self._detail = detail
+            self._finish_time = time.monotonic()
+            self._cond.notify_all()
+            return True
+
+    # --- inspection ---------------------------------------------------------
+    @property
+    def status(self) -> str:
+        with self._cond:
+            return self._status
+
+    @property
+    def detail(self) -> str:
+        with self._cond:
+            return self._detail
+
+    @property
+    def done(self) -> bool:
+        with self._cond:
+            return self._status in TERMINAL_STATUSES
+
+    @property
+    def tokens(self) -> np.ndarray:
+        """Tokens received so far (the full output once completed)."""
+        with self._cond:
+            return np.asarray(self._tokens, np.int32)
+
+    @property
+    def num_tokens(self) -> int:
+        with self._cond:
+            return len(self._tokens)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        with self._cond:
+            if self._first_token_time is None:
+                return None
+            return self._first_token_time - self.submit_time
+
+    @property
+    def ttft_ms(self) -> Optional[float]:
+        t = self.ttft_s
+        return None if t is None else t * 1e3
+
+    @property
+    def e2e_s(self) -> Optional[float]:
+        with self._cond:
+            if self._finish_time is None:
+                return None
+            return self._finish_time - self.submit_time
+
+    @property
+    def e2e_ms(self) -> Optional[float]:
+        t = self.e2e_s
+        return None if t is None else t * 1e3
+
+    # --- control ------------------------------------------------------------
+    def cancel(self):
+        """Request cancellation (idempotent, safe from any thread).  If
+        the request already completed, this is a no-op — completion wins
+        the race and the handle stays ``completed``."""
+        self._frontend._request_cancel(self)
+
+    def wait(self, timeout: Optional[float] = None) -> str:
+        """Block until terminal; returns the terminal status."""
+        with self._cond:
+            if not self._cond.wait_for(
+                    lambda: self._status in TERMINAL_STATUSES, timeout):
+                raise TimeoutError(
+                    f"request {self.request_id} not terminal after "
+                    f"{timeout}s (status {self._status!r})")
+            return self._status
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until terminal; returns the generated tokens on
+        completion, raises RuntimeError on any other outcome."""
+        status = self.wait(timeout)
+        if status != COMPLETED:
+            raise RuntimeError(
+                f"request {self.request_id} {status}"
+                + (f": {self.detail}" if self.detail else ""))
+        return self.tokens
+
+    # --- streaming ----------------------------------------------------------
+    def events(self) -> Iterator[Tuple]:
+        """Yield stream events in order:
+
+        ``("token", index, token)``  one generated token
+        ``("restart",)``             replica failover — the stream
+                                     restarts, following tokens re-index
+                                     from 0 (values identical, greedy)
+        ``("end", status)``          terminal; always the last event
+        """
+        epoch = 0
+        idx = 0
+        while True:
+            with self._cond:
+                self._cond.wait_for(
+                    lambda: self._stream_epoch != epoch
+                    or len(self._tokens) > idx
+                    or self._status in TERMINAL_STATUSES)
+                restart = self._stream_epoch != epoch
+                if restart:
+                    epoch = self._stream_epoch
+                    idx = 0
+                chunk = self._tokens[idx:]
+                base = idx
+                idx += len(chunk)
+                status = self._status
+                ended = (status in TERMINAL_STATUSES
+                         and self._stream_epoch == epoch
+                         and len(self._tokens) == idx)
+            if restart:
+                yield ("restart",)
+            for j, tok in enumerate(chunk):
+                yield ("token", base + j, int(tok))
+            if ended:
+                yield ("end", status)
+                return
+
+    def __iter__(self) -> Iterator[int]:
+        """Token-only view of ``events()``.  NOTE: after a failover the
+        stream re-yields from token 0 (check ``retried``); consumers
+        that must not double-render should track indices via
+        ``events()`` instead."""
+        for ev in self.events():
+            if ev[0] == "token":
+                yield ev[2]
+
+
+class _Entry:
+    """Frontend bookkeeping for one live (non-terminal) request."""
+
+    __slots__ = ("handle", "prompt", "max_new_tokens", "cost", "replica",
+                 "in_engine", "cancel_requested")
+
+    def __init__(self, handle: ResponseHandle, prompt: np.ndarray,
+                 max_new_tokens: int, replica: Replica):
+        self.handle = handle
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        # placement score: total tokens this request will hold alive
+        self.cost = int(prompt.size) + self.max_new_tokens
+        self.replica = replica
+        self.in_engine = False
+        self.cancel_requested = False
+
+
+class ServingFrontend:
+    """Thread-safe streaming front door over N ServingEngine replicas.
+
+    ``queue_cap`` bounds LIVE requests (queued + running, fleet-wide):
+    ``submit`` beyond it returns an already-``rejected`` handle instead
+    of queueing unboundedly — the reject-on-overload half of admission
+    control; the deadline machinery is the other half.  ``close()``
+    drains outstanding work and joins the pump threads.
+    """
+
+    def __init__(self, model=None, *, replicas: int = 1,
+                 queue_cap: Optional[int] = 64,
+                 default_deadline_ms: Optional[float] = None,
+                 engine_kwargs: Optional[dict] = None,
+                 engine_factory=None,
+                 metrics: Optional[FrontendMetrics] = None,
+                 poll_interval_s: float = 0.005):
+        if model is None and engine_factory is None:
+            raise ValueError("pass a model or an engine_factory")
+        if engine_factory is not None and engine_kwargs:
+            raise ValueError(
+                "engine_kwargs and engine_factory are mutually "
+                "exclusive — the factory owns engine construction, so "
+                "the kwargs would be silently ignored")
+        if replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        self.metrics = metrics or FrontendMetrics()
+        # ONE ServingMetrics across replicas: the process-global
+        # serving.* registry names hold fleet aggregates instead of N
+        # engines resetting each other.  The frontend OWNS engine
+        # metrics: engines built by a custom engine_factory get their
+        # .metrics replaced with this shared instance too, so
+        # stats()["engines"] is always the fleet aggregate.
+        self.engine_metrics = ServingMetrics()
+        user_factory = engine_factory
+        if user_factory is None:
+            ekw = dict(engine_kwargs or {})
+            ekw.setdefault("metrics", self.engine_metrics)
+
+            def engine_factory():
+                return ServingEngine(model, **ekw)
+        else:
+            def engine_factory():
+                eng = user_factory()
+                eng.metrics = self.engine_metrics
+                return eng
+
+        self.router = Router()
+        self.queue_cap = None if queue_cap is None else int(queue_cap)
+        self.default_deadline_ms = default_deadline_ms
+        self._poll_interval = float(poll_interval_s)
+        self._lock = threading.RLock()
+        self._live: Dict[str, _Entry] = {}
+        self._closing = False
+        self._rid = itertools.count()
+        self._replicas: List[Replica] = []
+        for i in range(int(replicas)):
+            rep = Replica(f"replica-{i}", engine_factory())
+            # engine emits per-token; bind the replica so tokens from a
+            # replica the request has been failed away from are dropped
+            rep.engine.token_callback = (
+                lambda rid, idx, tok, rep=rep:
+                self._emit(rep, rid, idx, tok))
+            self.router.add(rep)
+            self._replicas.append(rep)
+        for rep in self._replicas:
+            t = threading.Thread(target=self._pump, args=(rep,),
+                                 name=f"serving-pump-{rep.id}", daemon=True)
+            rep.thread = t
+            t.start()
+
+    # --- submission ---------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 32,
+               deadline_ms: Optional[float] = None, stream: bool = True,
+               request_id: Optional[str] = None) -> ResponseHandle:
+        """Submit one generation request; returns immediately with a
+        ResponseHandle (possibly already terminal: ``rejected`` on
+        overload / no healthy replica, ``deadline_miss`` on an
+        already-expired deadline).  Raises ValueError only for requests
+        that could never run (empty prompt, budget beyond the engine's
+        ``max_seq_len``).  ``stream`` is advisory — tokens are always
+        delivered to the handle; it exists so callers (the HTTP layer)
+        can record the client's intent."""
+        del stream  # tokens always stream into the handle
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        deadline = (None if deadline_ms is None
+                    else time.monotonic() + float(deadline_ms) / 1e3)
+        with self._lock:
+            probe = next((r.engine for r in self._replicas
+                          if r.state != DEAD), None)
+        if probe is not None:
+            prompt = probe.check_request(prompt, max_new_tokens)
+        else:
+            prompt = np.asarray(prompt, np.int32).reshape(-1)
+        rid = request_id or f"fr-{next(self._rid)}"
+        handle = ResponseHandle(rid, max_new_tokens, deadline, self)
+        with self._lock:
+            if rid in self._live:
+                raise ValueError(f"request_id {rid!r} is already live")
+            # counted only once the request is accepted as a real
+            # submission (raises above don't inflate the counter), but
+            # BEFORE the terminal-at-submit outcomes — so submitted ==
+            # completed+rejects+cancels+deadline_miss+failures holds
+            self.metrics.on_submit()
+            if self._closing:
+                return self._reject_locked(handle, "frontend is closing")
+            if (self.queue_cap is not None
+                    and len(self._live) >= self.queue_cap):
+                return self._reject_locked(
+                    handle,
+                    f"queue_cap {self.queue_cap} live requests reached")
+            if deadline is not None and time.monotonic() >= deadline:
+                handle._finish(DEADLINE_MISS,
+                               detail="deadline expired at submit")
+                self.metrics.on_deadline_miss()
+                return handle
+            rep = self.router.pick(cost=prompt.size + max_new_tokens)
+            if rep is None:
+                return self._reject_locked(handle, "no healthy replica")
+            entry = _Entry(handle, prompt, max_new_tokens, rep)
+            self._live[rid] = entry
+            self.router.charge(rep, entry.cost)
+            rep.inbox.append(entry)
+            rep.wake.set()
+            self._update_depth_gauges_locked()
+        return handle
+
+    def _reject_locked(self, handle: ResponseHandle,
+                       detail: str) -> ResponseHandle:
+        handle._finish(REJECTED, detail=detail)
+        self.metrics.on_reject()
+        return handle
+
+    # --- cancellation -------------------------------------------------------
+    def _request_cancel(self, handle: ResponseHandle):
+        immediate = None
+        with self._lock:
+            entry = self._live.get(handle.request_id)
+            if (entry is None or entry.handle is not handle
+                    or entry.cancel_requested):
+                return
+            entry.cancel_requested = True
+            rep = entry.replica
+            if not entry.in_engine and entry in rep.inbox:
+                rep.inbox.remove(entry)
+                immediate = entry
+            else:
+                rep.cancels.append(entry)
+            rep.wake.set()
+        if immediate is not None:
+            self._resolve(immediate, CANCELLED)
+
+    # --- fault injection / lifecycle ---------------------------------------
+    def inject_failure(self, replica_id: str, at_step: int):
+        """Arm the router's deterministic kill switch (see
+        Router.inject_failure): the replica crashes once its engine-step
+        counter reaches ``at_step``; its live requests fail over."""
+        self.router.inject_failure(replica_id, at_step)
+
+    def drain_replica(self, replica_id: str):
+        """Graceful drain: no new placements; in-flight work finishes."""
+        self.router.set_draining(replica_id)
+        self.router.get(replica_id).wake.set()
+
+    def health(self) -> dict:
+        hz = self.router.healthz()
+        with self._lock:
+            hz["inflight"] = len(self._live)
+            hz["queued"] = sum(1 for e in self._live.values()
+                               if not e.in_engine)
+            hz["closing"] = self._closing
+        hz["status"] = ("ok" if hz["healthy_replicas"] > 0 and
+                        not hz["closing"] else "unhealthy")
+        return hz
+
+    def stats(self) -> dict:
+        """Frontend + fleet-aggregate engine metrics + router health."""
+        return {
+            "frontend": self.metrics.snapshot(),
+            "engines": self.engine_metrics.snapshot(),
+            "router": self.router.healthz(),
+        }
+
+    def close(self, timeout: float = 30.0):
+        """Drain outstanding work, stop the pump threads, and fail any
+        request that could not finish (e.g. every replica dead)."""
+        with self._lock:
+            self._closing = True
+            reps = list(self._replicas)
+            for rep in reps:
+                rep.wake.set()
+        for rep in reps:
+            if rep.thread is not None:
+                rep.thread.join(timeout)
+        with self._lock:
+            leftovers = list(self._live.values())
+        for entry in leftovers:
+            self._resolve(entry, FAILED, detail="frontend closed")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # --- internals (pump threads) ------------------------------------------
+    def _emit(self, rep: Replica, rid: str, idx: int, tok: int):
+        with self._lock:
+            entry = self._live.get(rid)
+            if entry is None or entry.replica is not rep:
+                return
+            handle = entry.handle
+        handle._on_token(idx, tok)
+
+    def _entry_for(self, rep: Replica, rid: str) -> Optional[_Entry]:
+        with self._lock:
+            entry = self._live.get(rid)
+            if entry is not None and entry.replica is rep:
+                return entry
+            return None
+
+    def _update_depth_gauges_locked(self):
+        self.metrics.set_inflight(len(self._live))
+        self.metrics.set_queue_depth(
+            sum(1 for e in self._live.values() if not e.in_engine))
+
+    def _resolve(self, entry: _Entry, status: str, detail: str = "",
+                 tokens=None) -> bool:
+        """Move one live request to a terminal state exactly once."""
+        rid = entry.handle.request_id
+        with self._lock:
+            if self._live.get(rid) is not entry:
+                return False                 # someone else resolved it
+            del self._live[rid]
+            self.router.discharge(entry.replica, entry.cost)
+            self._update_depth_gauges_locked()
+        finished = entry.handle._finish(status, tokens=tokens,
+                                        detail=detail)
+        if finished:
+            h = entry.handle
+            if status == COMPLETED:
+                self.metrics.on_complete(h.ttft_s, h.e2e_s)
+            elif status == CANCELLED:
+                self.metrics.on_cancel()
+            elif status == DEADLINE_MISS:
+                self.metrics.on_deadline_miss()
+            elif status == REJECTED:
+                self.metrics.on_reject()
+            elif status == FAILED:
+                self.metrics.on_failure()
+        return finished
+
+    def _pump(self, rep: Replica):
+        """One replica's drive loop (the ONLY thread touching its
+        engine): intake → cancellations → one engine step → harvest
+        expiries/completions → failure-injection check."""
+        eng = rep.engine
+        while True:
+            with self._lock:
+                closing = self._closing
+                work, rep.inbox = rep.inbox, []
+                cancels, rep.cancels = rep.cancels, []
+            if rep.state == DEAD:
+                break
+            now = time.monotonic()
+            for entry in work:
+                h = entry.handle
+                if entry.cancel_requested:
+                    self._resolve(entry, CANCELLED)
+                    continue
+                if h.deadline is not None and now >= h.deadline:
+                    self._resolve(entry, DEADLINE_MISS,
+                                  "expired in frontend queue")
+                    continue
+                try:
+                    eng.add_request(entry.prompt, entry.max_new_tokens,
+                                    request_id=h.request_id,
+                                    deadline=h.deadline)
+                    with self._lock:
+                        entry.in_engine = True
+                except ValueError as e:
+                    self._resolve(entry, FAILED, str(e))
+            for entry in cancels:
+                if eng.abort(entry.handle.request_id):
+                    self._resolve(entry, CANCELLED)
+                # else: it finished first — the outputs harvest owns it
+            if eng.scheduler.has_work() or eng._pending:
+                eng.step()
+                rep.steps += 1
+                rep.last_step_time = time.monotonic()
+                self._harvest(rep, eng)
+                if (rep.fail_at_step is not None
+                        and rep.steps >= rep.fail_at_step):
+                    self._kill(rep,
+                               f"injected failure at step {rep.steps}")
+                    break
+            elif closing:
+                break
+            else:
+                rep.wake.wait(self._poll_interval)
+                rep.wake.clear()
+
+    def _harvest(self, rep: Replica, eng: ServingEngine):
+        for rid in eng.take_expired():
+            entry = self._entry_for(rep, rid)
+            if entry is not None:
+                self._resolve(entry, DEADLINE_MISS, "deadline expired")
+        for rid in list(eng.outputs.keys()):
+            toks = eng.take_output(rid)
+            entry = self._entry_for(rep, rid)
+            if entry is not None:
+                self._resolve(entry, COMPLETED, tokens=toks)
+
+    def _kill(self, rep: Replica, reason: str):
+        """Simulated crash: mark the replica dead and fail its live
+        requests over to survivors — streams restart from token 0 with
+        ``retried`` set; with no survivor they terminate ``failed``."""
+        self.router.mark_dead(rep, reason)
+        with self._lock:
+            victims = [e for e in self._live.values()
+                       if e.replica is rep]
+            rep.inbox.clear()
+            rep.cancels.clear()
+        now = time.monotonic()
+        for entry in victims:
+            h = entry.handle
+            if entry.cancel_requested:
+                self._resolve(entry, CANCELLED,
+                              "cancelled during failover")
+                continue
+            if h.deadline is not None and now >= h.deadline:
+                self._resolve(entry, DEADLINE_MISS,
+                              "expired during failover")
+                continue
+            target = self.router.pick(cost=entry.cost)
+            if target is None:
+                self._resolve(
+                    entry, FAILED,
+                    f"replica {rep.id} died ({reason}); no healthy "
+                    "survivor to retry on")
+                continue
+            h._on_retry()
+            self.metrics.on_retry()
+            with self._lock:
+                self.router.discharge(rep, entry.cost)
+                entry.replica = target
+                entry.in_engine = False
+                # cancel_requested is NOT reset: a cancel that raced the
+                # failover is honored by the target's intake loop
+                self.router.charge(target, entry.cost)
+                target.inbox.append(entry)
+                target.wake.set()
+                self._update_depth_gauges_locked()
+
+
+def create_serving_frontend(model, config=None, **overrides
+                            ) -> ServingFrontend:
+    """Build a ServingFrontend from an ``inference.Config`` on which
+    ``enable_serving(...)`` was called: engine knobs come from
+    ``serving_config()``, frontend knobs (replicas / queue_cap /
+    default_deadline_ms) from ``frontend_config()``; kwargs override
+    either side (unknown keys go to the engine).  Passing
+    ``engine_factory`` here conflicts with the config's engine knobs
+    and raises — a custom factory owns engine construction outright,
+    so build ``ServingFrontend(engine_factory=...)`` directly."""
+    fe_kwargs: dict = {}
+    engine_kwargs: dict = {}
+    if config is not None:
+        if not getattr(config, "serving_enabled", lambda: False)():
+            raise ValueError(
+                "config has serving disabled — call "
+                "Config.enable_serving(...) first")
+        engine_kwargs.update(config.serving_config())
+        fe_kwargs.update(config.frontend_config())
+    engine_kwargs.update(overrides.pop("engine_kwargs", {}))
+    for key in ("replicas", "queue_cap", "default_deadline_ms",
+                "engine_factory", "metrics", "poll_interval_s"):
+        if key in overrides:
+            fe_kwargs[key] = overrides.pop(key)
+    engine_kwargs.update(overrides)
+    return ServingFrontend(model, engine_kwargs=engine_kwargs, **fe_kwargs)

@@ -18,12 +18,13 @@ programs carry FLOPs/bytes attribution via
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Optional
 
 from ..framework.monitor import stat_registry
 
-__all__ = ["ServingMetrics"]
+__all__ = ["ServingMetrics", "FrontendMetrics"]
 
 
 class ServingMetrics:
@@ -34,7 +35,12 @@ class ServingMetrics:
     semantics): engines in one process share them, and constructing a
     new ServingMetrics resets them.  Run one engine per process (the
     deployment shape) or pass each engine a metrics object only at
-    points where a shared reset is acceptable."""
+    points where a shared reset is acceptable — the ServingFrontend
+    passes ONE instance to all its replica engines, so the registry
+    holds fleet-wide aggregates.  Every method is THREAD-SAFE: the
+    registry primitives carry their own locks and the derived-rate
+    accumulators here are guarded by ``_lock`` (replica pump threads
+    call ``on_step`` concurrently)."""
 
     GAUGES = ("serving.queue_depth", "serving.running_seqs",
               "serving.kv_pages_in_use", "serving.batch_bucket",
@@ -42,25 +48,28 @@ class ServingMetrics:
     COUNTERS = ("serving.steps", "serving.tokens_generated",
                 "serving.requests_admitted", "serving.requests_completed",
                 "serving.preemptions", "serving.prefill_chunks",
-                "serving.prefill_tokens")
+                "serving.prefill_tokens", "serving.aborts",
+                "serving.deadline_miss")
     HISTOGRAMS = ("serving.step_latency_ms", "serving.prefill_latency_ms",
                   "serving.decode_latency_ms", "serving.ttft_ms",
                   "serving.dispatch_gap_ms")
 
     def __init__(self):
+        self._lock = threading.Lock()
         self.reset()
 
     def reset(self):
-        self._start: Optional[float] = None
-        self._steps = 0
-        self._tokens = 0
-        self._occupancy_sum = 0.0
-        self._occupancy_count = 0
-        self._ttft_sum = 0.0
-        self._ttft_count = 0
-        self._completed = 0
-        self._prefill_tokens = 0
-        self._prefill_seconds = 0.0
+        with self._lock:
+            self._start: Optional[float] = None
+            self._steps = 0
+            self._tokens = 0
+            self._occupancy_sum = 0.0
+            self._occupancy_count = 0
+            self._ttft_sum = 0.0
+            self._ttft_count = 0
+            self._completed = 0
+            self._prefill_tokens = 0
+            self._prefill_seconds = 0.0
         for name in self.GAUGES + self.COUNTERS:
             stat_registry.get(name).reset()
         for name in self.HISTOGRAMS:
@@ -73,16 +82,28 @@ class ServingMetrics:
 
     def on_first_token(self, arrival_time: float, now: float):
         ttft = now - arrival_time
-        self._ttft_sum += ttft
-        self._ttft_count += 1
+        with self._lock:
+            self._ttft_sum += ttft
+            self._ttft_count += 1
         stat_registry.histogram("serving.ttft_ms").observe(ttft * 1e3)
 
     def on_completion(self, n: int = 1):
-        self._completed += n
+        with self._lock:
+            self._completed += n
         stat_registry.get("serving.requests_completed").add(n)
 
     def on_preemption(self, n: int = 1):
         stat_registry.get("serving.preemptions").add(n)
+
+    def on_abort(self, n: int = 1):
+        """A queued or in-flight sequence was retired without output
+        (client cancel, replica failure cleanup, or deadline abort)."""
+        stat_registry.get("serving.aborts").add(n)
+
+    def on_deadline_miss(self, n: int = 1):
+        """A request's deadline passed while queued (dropped before
+        admission) or mid-decode (aborted, pages freed)."""
+        stat_registry.get("serving.deadline_miss").add(n)
 
     def on_prefill(self, seconds: float):
         stat_registry.histogram("serving.prefill_latency_ms").observe(
@@ -94,8 +115,9 @@ class ServingMetrics:
         win of parallel prefill shows up as tokens/chunks >> 1)."""
         stat_registry.get("serving.prefill_chunks").add(int(chunks))
         stat_registry.get("serving.prefill_tokens").add(int(tokens))
-        self._prefill_tokens += int(tokens)
-        self._prefill_seconds += seconds
+        with self._lock:
+            self._prefill_tokens += int(tokens)
+            self._prefill_seconds += seconds
 
     def on_decode(self, seconds: float):
         """Under the pipelined engine this is the CONSUME-side wait for
@@ -117,16 +139,18 @@ class ServingMetrics:
                 step_seconds: Optional[float] = None,
                 kv_cache_bytes: Optional[int] = None):
         now = time.monotonic()
-        if self._start is None:
-            self._start = now
-        self._steps += 1
-        self._tokens += tokens_emitted
+        with self._lock:
+            if self._start is None:
+                self._start = now
+            self._steps += 1
+            self._tokens += tokens_emitted
+            if bucket:
+                # occupancy is a property of DECODE steps: consume-only
+                # steps (the pipelined engine's trailing drains) and
+                # idle steps don't dilute the mean
+                self._occupancy_sum += running / bucket
+                self._occupancy_count += 1
         if bucket:
-            # occupancy is a property of DECODE steps: consume-only
-            # steps (the pipelined engine's trailing drains) and idle
-            # steps don't dilute the mean
-            self._occupancy_sum += running / bucket
-            self._occupancy_count += 1
             # exported per step (the registry/Prometheus view of what
             # snapshot() reports as the mean) — previously derivable
             # only from engine internals
@@ -148,26 +172,134 @@ class ServingMetrics:
 
     # --- derived ----------------------------------------------------------
     def snapshot(self) -> dict:
-        elapsed = (time.monotonic() - self._start) if self._start else 0.0
-        snap = {
-            "steps": self._steps,
-            "tokens_generated": self._tokens,
-            "requests_completed": self._completed,
-            "elapsed_s": elapsed,
-            "tokens_per_sec": self._tokens / elapsed if elapsed > 0 else 0.0,
-            "mean_batch_occupancy": (
-                self._occupancy_sum / self._occupancy_count
-                if self._occupancy_count else 0.0),
-            "mean_ttft_ms": (self._ttft_sum / self._ttft_count * 1e3
-                             if self._ttft_count else 0.0),
-            "prefill_tokens": self._prefill_tokens,
-            "prefill_tokens_per_sec": (
-                self._prefill_tokens / self._prefill_seconds
-                if self._prefill_seconds > 0 else 0.0),
-        }
+        with self._lock:
+            elapsed = ((time.monotonic() - self._start)
+                       if self._start else 0.0)
+            snap = {
+                "steps": self._steps,
+                "tokens_generated": self._tokens,
+                "requests_completed": self._completed,
+                "elapsed_s": elapsed,
+                "tokens_per_sec": (self._tokens / elapsed
+                                   if elapsed > 0 else 0.0),
+                "mean_batch_occupancy": (
+                    self._occupancy_sum / self._occupancy_count
+                    if self._occupancy_count else 0.0),
+                "mean_ttft_ms": (self._ttft_sum / self._ttft_count * 1e3
+                                 if self._ttft_count else 0.0),
+                "prefill_tokens": self._prefill_tokens,
+                "prefill_tokens_per_sec": (
+                    self._prefill_tokens / self._prefill_seconds
+                    if self._prefill_seconds > 0 else 0.0),
+            }
+        snap["aborts"] = stat_registry.get("serving.aborts").get()
+        snap["deadline_miss"] = stat_registry.get(
+            "serving.deadline_miss").get()
         for name in self.HISTOGRAMS:
             h = stat_registry.histogram(name).snapshot()
             key = name[len("serving."):]
             snap[key] = {k: h[k] for k in
                          ("count", "mean", "p50", "p95", "p99")}
+        return snap
+
+
+class FrontendMetrics:
+    """Request-level observability for the ServingFrontend — the
+    ``serving.frontend.*`` registry names (Prometheus-visible through
+    the same exposition as every other stat).  Counters/gauges/
+    histograms live in the thread-safe registry primitives; the derived
+    accumulators are lock-guarded because submit() callers, replica
+    pump threads and HTTP handler threads all report concurrently.
+
+    Lifecycle of a request, in metric terms::
+
+        submitted ──► completed   (ttft_ms + e2e_ms histograms)
+                  ├─► rejects        queue_cap overload / no replica
+                  ├─► cancels        client cancel won the race
+                  ├─► deadline_miss  expired queued or mid-decode
+                  └─► failures       replica died with no survivor, or
+                                     invalid request detected in-pump
+        retries: transparent re-queues after a replica failure — NOT a
+        terminal state (the request lives on, stream restarted at 0).
+    """
+
+    GAUGES = ("serving.frontend.queue_depth", "serving.frontend.inflight")
+    COUNTERS = ("serving.frontend.submitted",
+                "serving.frontend.completed",
+                "serving.frontend.rejects",
+                "serving.frontend.cancels",
+                "serving.frontend.deadline_miss",
+                "serving.frontend.retries",
+                "serving.frontend.failures")
+    HISTOGRAMS = ("serving.frontend.ttft_ms", "serving.frontend.e2e_ms")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self._ttft_sum = 0.0
+            self._ttft_count = 0
+            self._e2e_sum = 0.0
+            self._e2e_count = 0
+        for name in self.GAUGES + self.COUNTERS:
+            stat_registry.get(name).reset()
+        for name in self.HISTOGRAMS:
+            stat_registry.histogram(name).reset()
+
+    # --- event hooks --------------------------------------------------------
+    def on_submit(self):
+        stat_registry.get("serving.frontend.submitted").add(1)
+
+    def on_reject(self):
+        stat_registry.get("serving.frontend.rejects").add(1)
+
+    def on_cancel(self):
+        stat_registry.get("serving.frontend.cancels").add(1)
+
+    def on_deadline_miss(self):
+        stat_registry.get("serving.frontend.deadline_miss").add(1)
+
+    def on_retry(self):
+        stat_registry.get("serving.frontend.retries").add(1)
+
+    def on_failure(self):
+        stat_registry.get("serving.frontend.failures").add(1)
+
+    def on_complete(self, ttft_s: Optional[float], e2e_s: float):
+        stat_registry.get("serving.frontend.completed").add(1)
+        if ttft_s is not None:
+            stat_registry.histogram("serving.frontend.ttft_ms").observe(
+                ttft_s * 1e3)
+        stat_registry.histogram("serving.frontend.e2e_ms").observe(
+            e2e_s * 1e3)
+        with self._lock:
+            if ttft_s is not None:
+                self._ttft_sum += ttft_s
+                self._ttft_count += 1
+            self._e2e_sum += e2e_s
+            self._e2e_count += 1
+
+    def set_queue_depth(self, n: int):
+        stat_registry.get("serving.frontend.queue_depth").set(int(n))
+
+    def set_inflight(self, n: int):
+        stat_registry.get("serving.frontend.inflight").set(int(n))
+
+    # --- derived ------------------------------------------------------------
+    def snapshot(self) -> dict:
+        snap = {}
+        for name in self.GAUGES + self.COUNTERS:
+            snap[name[len("serving.frontend."):]] = \
+                stat_registry.get(name).get()
+        with self._lock:
+            snap["mean_ttft_ms"] = (self._ttft_sum / self._ttft_count * 1e3
+                                    if self._ttft_count else 0.0)
+            snap["mean_e2e_ms"] = (self._e2e_sum / self._e2e_count * 1e3
+                                   if self._e2e_count else 0.0)
+        for name in self.HISTOGRAMS:
+            h = stat_registry.histogram(name).snapshot()
+            snap[name[len("serving.frontend."):]] = {
+                k: h[k] for k in ("count", "mean", "p50", "p95", "p99")}
         return snap

@@ -1,0 +1,168 @@
+"""Multi-replica router: placement, health, and fault injection.
+
+One ``Replica`` wraps one ``ServingEngine`` plus the queueing state its
+pump thread drains (the thread itself lives in ``frontend.py`` — the
+router is pure bookkeeping, so it can be unit-tested without spinning up
+engines or threads).  The ``Router`` owns the placement policy:
+
+placement      least-outstanding-tokens — a new request goes to the
+               HEALTHY replica with the smallest sum of admitted-but-
+               unfinished work (prompt + budget tokens), ties broken by
+               replica id, so routing is deterministic given the
+               submission order.
+health         a replica is routable only in the HEALTHY state.
+               DRAINING replicas finish their in-flight work but take
+               nothing new; DEAD replicas are never routed to again.
+fault
+injection      ``inject_failure(replica_id, at_step)`` arms a
+               deterministic kill switch: the pump thread compares the
+               replica's engine-step counter against ``at_step`` after
+               every step and simulates a crash mid-decode when it
+               trips.  The frontend then requeues the dead replica's
+               live requests onto survivors (streams restart from token
+               0 with ``retried`` set) — the failover path is exercised
+               by tests/bench, not just described.
+
+Thread-safety: every mutator/reader takes the router's RLock.  The
+frontend also serializes its own bookkeeping with its own lock; lock
+order is always frontend → router, never the reverse.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+__all__ = ["Replica", "Router", "HEALTHY", "DRAINING", "DEAD"]
+
+HEALTHY = "healthy"
+DRAINING = "draining"
+DEAD = "dead"
+
+
+class Replica:
+    """One serving engine + the routing/queueing state around it.
+
+    ``inbox`` holds work items the pump thread has not yet handed to the
+    engine and ``cancels`` holds cancellation requests; BOTH are guarded
+    by the frontend's lock (the router never touches them).  ``wake`` is
+    set whenever new work or a cancel arrives so an idle pump thread
+    reacts immediately instead of on its poll timeout.
+    """
+
+    def __init__(self, replica_id: str, engine):
+        self.id = str(replica_id)
+        self.engine = engine
+        self.state = HEALTHY
+        self.dead_reason = ""
+        self.inbox: List = []                # guarded by the frontend lock
+        self.cancels: List = []              # guarded by the frontend lock
+        self.wake = threading.Event()
+        self.thread: Optional[threading.Thread] = None
+        # engine steps taken by the pump thread — the fault-injection
+        # clock (deterministic given a deterministic drive)
+        self.steps = 0
+        self.fail_at_step: Optional[int] = None
+        self.last_step_time: Optional[float] = None
+        # admitted-but-unfinished work in tokens (prompt + budget) —
+        # the placement score
+        self.outstanding_tokens = 0
+
+    @property
+    def healthy(self) -> bool:
+        return self.state == HEALTHY
+
+    def status(self) -> dict:
+        return {
+            "id": self.id,
+            "state": self.state,
+            "dead_reason": self.dead_reason or None,
+            "steps": self.steps,
+            "outstanding_tokens": self.outstanding_tokens,
+            "inbox_depth": len(self.inbox),
+            "last_step_age_s": (
+                None if self.last_step_time is None
+                else round(time.monotonic() - self.last_step_time, 3)),
+        }
+
+
+class Router:
+    """Least-outstanding-tokens placement over a set of replicas."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.replicas: List[Replica] = []
+
+    # --- membership ---------------------------------------------------------
+    def add(self, replica: Replica):
+        with self._lock:
+            if any(r.id == replica.id for r in self.replicas):
+                raise ValueError(f"duplicate replica id {replica.id!r}")
+            self.replicas.append(replica)
+
+    def get(self, replica_id: str) -> Replica:
+        with self._lock:
+            for r in self.replicas:
+                if r.id == replica_id:
+                    return r
+        raise KeyError(f"unknown replica {replica_id!r}")
+
+    # --- placement ----------------------------------------------------------
+    def pick(self, cost: int = 0,
+             exclude: Optional[Replica] = None) -> Optional[Replica]:
+        """The healthy replica with the least outstanding work (tokens),
+        ties broken by id; None when no healthy replica exists.  ``cost``
+        is accepted for symmetry with charge() but does not affect the
+        choice."""
+        with self._lock:
+            cands = [r for r in self.replicas
+                     if r.state == HEALTHY and r is not exclude]
+            if not cands:
+                return None
+            return min(cands, key=lambda r: (r.outstanding_tokens, r.id))
+
+    def charge(self, replica: Replica, tokens: int):
+        with self._lock:
+            replica.outstanding_tokens += int(tokens)
+
+    def discharge(self, replica: Replica, tokens: int):
+        with self._lock:
+            replica.outstanding_tokens = max(
+                0, replica.outstanding_tokens - int(tokens))
+
+    # --- health / lifecycle -------------------------------------------------
+    def healthy_replicas(self) -> List[Replica]:
+        with self._lock:
+            return [r for r in self.replicas if r.state == HEALTHY]
+
+    def inject_failure(self, replica_id: str, at_step: int):
+        """Arm the deterministic kill switch: the replica dies (crash
+        simulation) once its engine-step counter reaches ``at_step``.
+        ``at_step`` is an ABSOLUTE step count of that replica; arming it
+        at or below the current count kills on the next step."""
+        with self._lock:
+            self.get(replica_id).fail_at_step = int(at_step)
+
+    def set_draining(self, replica_id: str):
+        """Graceful drain: stop routing new work to the replica; its
+        in-flight requests run to completion."""
+        with self._lock:
+            rep = self.get(replica_id)
+            if rep.state == HEALTHY:
+                rep.state = DRAINING
+
+    def mark_dead(self, replica: Replica, reason: str = ""):
+        with self._lock:
+            replica.state = DEAD
+            replica.dead_reason = reason
+
+    def healthz(self) -> dict:
+        """Health summary (the /healthz payload's router section)."""
+        with self._lock:
+            reps = [r.status() for r in self.replicas]
+            healthy = sum(1 for r in self.replicas if r.state == HEALTHY)
+        return {
+            "healthy_replicas": healthy,
+            "total_replicas": len(reps),
+            "replicas": reps,
+        }

@@ -18,7 +18,18 @@ preemption   on page exhaustion mid-decode the YOUNGEST other running
              sequence is evicted (recompute-style: its pages are freed
              and the original request returns to the queue FRONT; greedy
              decode is deterministic, so its final output is unchanged).
+             An already-EXPIRED running sequence is preferred as victim:
+             evicting it costs nothing, its requeued request is dropped
+             at the next queue inspection anyway.
 retirement   EOS or max_new_tokens; pages return to the free list.
+deadline     a request may carry an absolute ``deadline`` (monotonic
+             seconds).  ``expire_queued`` drops expired waiting requests
+             — they are never admitted (prefilling them would spend
+             compute on a response nobody is owed); the ENGINE calls it
+             at the top of every step with the same ``now`` it then
+             passes nothing to ``admit`` with, so a request expiring
+             exactly on the admission step is rejected, not admitted.
+             Mid-decode expiry is enforced by the engine via ``abort``.
 """
 from __future__ import annotations
 
@@ -45,6 +56,8 @@ class Request:
     max_new_tokens: int = 32
     request_id: str = ""
     arrival_time: float = field(default_factory=time.monotonic)
+    # absolute time.monotonic() seconds; None = no SLO
+    deadline: Optional[float] = None
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -52,6 +65,15 @@ class Request:
             raise ValueError("empty prompt")
         if not self.request_id:
             self.request_id = f"req-{next(_req_counter)}"
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        """True once the deadline has passed.  The comparison is
+        ``now >= deadline``: a request expiring exactly on the admission
+        step is NOT admitted (the SLO is already blown — any token it
+        would produce arrives late)."""
+        if self.deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) >= self.deadline
 
 
 class Sequence:
@@ -120,6 +142,21 @@ class Scheduler:
     def queue_depth(self) -> int:
         return len(self.waiting)
 
+    def expire_queued(self, now: Optional[float] = None) -> List[Request]:
+        """Remove every waiting request whose deadline has passed and
+        return them (the engine counts each as a ``deadline_miss``).
+        Runs at the top of every engine step, BEFORE ``admit`` — so an
+        expired request is never admitted, never prefilled, and holds no
+        pages to free."""
+        if not self.waiting:
+            return []
+        now = time.monotonic() if now is None else now
+        expired = [r for r in self.waiting if r.expired(now)]
+        if expired:
+            self.waiting = deque(r for r in self.waiting
+                                 if not r.expired(now))
+        return expired
+
     # --- admission --------------------------------------------------------
     def admit(self) -> List[Sequence]:
         """Move waiting requests into the running set while a batch slot
@@ -169,6 +206,13 @@ class Scheduler:
         return preempted
 
     def _pick_victim(self, exclude: Sequence) -> Optional[Sequence]:
+        # an already-expired sequence is a free victim: the engine will
+        # abort it (or expire_queued will drop its requeued request)
+        # before it decodes again, so evicting it costs no recompute
+        now = time.monotonic()
+        for seq in reversed(self.running):
+            if seq is not exclude and seq.request.expired(now):
+                return seq
         for seq in reversed(self.running):      # youngest first
             if seq is not exclude:
                 return seq

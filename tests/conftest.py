@@ -21,6 +21,15 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'` (ROADMAP) — register the marker so
+    # the long Poisson/failover load tests deselect cleanly
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running load test, excluded from the tier-1 run "
+        "(-m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu

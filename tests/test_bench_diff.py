@@ -50,6 +50,80 @@ def test_cli_end_to_end(tmp_path, capsys):
     assert "value" in out and "+50.0%" in out
 
 
+class TestFailOnRegression:
+    """--fail-on-regression PCT: the CI gate mode (ISSUE 5 satellite)."""
+
+    def test_direction_heuristic(self):
+        assert bench_diff.lower_is_better("detail.ttft_ms_p95")
+        assert bench_diff.lower_is_better("serving.deadline_miss_rate")
+        assert bench_diff.lower_is_better("detail.kv_bytes_per_token")
+        assert bench_diff.lower_is_better("detail.dispatch_gap_ms.p50")
+        assert not bench_diff.lower_is_better("value")
+        assert not bench_diff.lower_is_better("detail.tokens_per_sec")
+        assert not bench_diff.lower_is_better("detail.occupancy")
+        # bigger-is-better fragments override lower-better collisions:
+        # a reduction RATIO mentions bytes but higher is the win
+        assert not bench_diff.lower_is_better("detail.kv_bytes_reduction_x")
+        assert not bench_diff.lower_is_better("detail.prefill_tokens_per_sec")
+        assert not bench_diff.lower_is_better("detail.greedy_token_parity")
+
+    def test_reduction_ratio_gates_on_drop_not_rise(self):
+        """The PR-4 acceptance metric: kv_bytes_reduction_x falling
+        3.97 -> 1.5 is the regression; rising to 4.8 is not."""
+        drop = bench_diff.diff({"kv_bytes_reduction_x": 3.97},
+                               {"kv_bytes_reduction_x": 1.5})
+        assert [r["metric"] for r in bench_diff.regressions(drop, 10.0)] \
+            == ["kv_bytes_reduction_x"]
+        rise = bench_diff.diff({"kv_bytes_reduction_x": 3.97},
+                               {"kv_bytes_reduction_x": 4.8})
+        assert bench_diff.regressions(rise, 10.0) == []
+
+    def test_regressions_one_sided(self):
+        rows = bench_diff.diff(
+            {"tokens_per_sec": 100.0, "ttft_ms": 10.0, "occupancy": 0.8},
+            {"tokens_per_sec": 80.0, "ttft_ms": 8.0, "occupancy": 0.9})
+        bad = bench_diff.regressions(rows, 10.0)
+        # throughput dropped 20% -> regression; latency IMPROVED 20%
+        # and occupancy rose -> not regressions
+        assert [r["metric"] for r in bad] == ["tokens_per_sec"]
+        # latency going the other way flips the verdict
+        rows2 = bench_diff.diff({"ttft_ms": 10.0}, {"ttft_ms": 13.0})
+        assert [r["metric"] for r in bench_diff.regressions(rows2, 10.0)] \
+            == ["ttft_ms"]
+        # within threshold: clean
+        assert bench_diff.regressions(rows2, 50.0) == []
+        # one-sided metrics (missing in a file) never gate
+        rows3 = bench_diff.diff({}, {"ttft_ms": 99.0})
+        assert bench_diff.regressions(rows3, 0.1) == []
+
+    def _write(self, tmp_path, name, obj):
+        p = tmp_path / name
+        p.write_text(json.dumps(obj))
+        return str(p)
+
+    def test_cli_exit_codes(self, tmp_path, capsys):
+        a = self._write(tmp_path, "a.json",
+                        {"value": 100.0, "detail": {"ttft_ms": 10.0}})
+        worse = self._write(tmp_path, "worse.json",
+                            {"value": 50.0, "detail": {"ttft_ms": 30.0}})
+        better = self._write(tmp_path, "better.json",
+                             {"value": 120.0, "detail": {"ttft_ms": 7.0}})
+        assert bench_diff.main([a, worse, "--fail-on-regression", "10"]) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSIONS beyond 10%" in out
+        assert "value" in out and "ttft_ms" in out
+        assert bench_diff.main([a, better,
+                                "--fail-on-regression", "10"]) == 0
+        # --only scopes the gate: the latency regression is filtered out
+        assert bench_diff.main([a, worse, "--only", "nonexistent",
+                                "--fail-on-regression", "10"]) == 0
+        # huge threshold tolerates the movement
+        assert bench_diff.main([a, worse,
+                                "--fail-on-regression", "500"]) == 0
+        # without the flag the CLI stays report-only (rc 0)
+        assert bench_diff.main([a, worse]) == 0
+
+
 def test_driver_tail_recovery(tmp_path):
     wrapped = {"n": 1, "cmd": "python bench.py", "rc": 0, "parsed": None,
                "tail": 'truncated junk {"broken": '

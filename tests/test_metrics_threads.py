@@ -1,0 +1,111 @@
+"""Thread-safety hammer for the metrics paths frontend threads hit
+(ISSUE 5 satellite): framework.monitor counter/histogram/labeled-gauge
+mutation, ServingMetrics.on_step + accumulators, FrontendMetrics event
+hooks.  Counts must be EXACT after concurrent hammering — a lost update
+(the pre-PR unlocked read-modify-write on the derived accumulators and
+LabeledGauge.get) shows up as a smaller total.
+"""
+import threading
+
+import pytest
+
+from paddle_tpu.framework.monitor import (Histogram, LabeledGauge,
+                                          stat_add, stat_get,
+                                          stat_registry)
+from paddle_tpu.serving import FrontendMetrics, ServingMetrics
+
+THREADS = 8
+ITERS = 1500
+
+
+def _hammer(fn):
+    """Run ``fn(thread_index, iteration)`` from THREADS threads, barrier
+    aligned so the critical sections actually contend."""
+    barrier = threading.Barrier(THREADS)
+    errs = []
+
+    def work(t):
+        try:
+            barrier.wait()
+            for i in range(ITERS):
+                fn(t, i)
+        except Exception as e:              # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=work, args=(t,))
+               for t in range(THREADS)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errs, errs
+
+
+class TestMonitorPrimitives:
+    def test_counter_no_lost_updates(self):
+        stat_registry.get("t.hammer.counter").reset()
+        _hammer(lambda t, i: stat_add("t.hammer.counter", 1))
+        assert stat_get("t.hammer.counter") == THREADS * ITERS
+
+    def test_histogram_exact_count_and_sum(self):
+        h = Histogram()
+        _hammer(lambda t, i: h.observe(1.0))
+        assert h.count == THREADS * ITERS
+        assert h.sum == pytest.approx(THREADS * ITERS * 1.0)
+        snap = h.snapshot()
+        assert snap["count"] == THREADS * ITERS
+        assert snap["min"] == snap["max"] == 1.0
+
+    def test_labeled_gauge_add_and_get(self):
+        g = LabeledGauge()
+
+        def step(t, i):
+            g.add(1.0, replica=str(t % 2))
+            assert g.get(replica=str(t % 2)) is not None
+
+        _hammer(step)
+        total = sum(g.values().values())
+        assert total == pytest.approx(THREADS * ITERS)
+
+
+class TestServingMetricsConcurrent:
+    def test_on_step_accumulators_exact(self):
+        m = ServingMetrics()
+
+        def step(t, i):
+            m.on_step(queue_depth=1, running=2, bucket=2,
+                      pages_in_use=3, tokens_emitted=2,
+                      step_seconds=1e-4)
+            m.on_completion()
+            if i % 50 == 0:
+                m.snapshot()                 # readers race the writers
+
+        _hammer(step)
+        snap = m.snapshot()
+        n = THREADS * ITERS
+        assert snap["steps"] == n
+        assert snap["tokens_generated"] == 2 * n
+        assert snap["requests_completed"] == n
+        assert snap["mean_batch_occupancy"] == pytest.approx(1.0)
+        assert snap["step_latency_ms"]["count"] == n
+
+    def test_frontend_metrics_exact(self):
+        m = FrontendMetrics()
+
+        def step(t, i):
+            m.on_submit()
+            m.on_complete(0.01, 0.05)
+            if t == 0 and i % 100 == 0:
+                m.on_retry()
+                m.snapshot()
+
+        _hammer(step)
+        snap = m.snapshot()
+        n = THREADS * ITERS
+        assert snap["submitted"] == n
+        assert snap["completed"] == n
+        assert snap["retries"] == ITERS // 100
+        assert snap["ttft_ms"]["count"] == n
+        assert snap["e2e_ms"]["count"] == n
+        assert snap["mean_ttft_ms"] == pytest.approx(10.0)
+        assert snap["mean_e2e_ms"] == pytest.approx(50.0)

@@ -1,0 +1,120 @@
+"""Scheduler deadline edges (ISSUE 5 satellite) — host-only, no jit.
+
+Pinned edges:
+- a request that expires while queued is NEVER admitted (dropped by
+  ``expire_queued`` before ``admit`` sees it);
+- expiry exactly on the admission step counts as expired (``now >=
+  deadline``, not ``>``) — the SLO is already blown;
+- preemption prefers an already-expired victim (free eviction), and the
+  preempted-expired request is then dropped from the queue and counted.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.serving import PagedKVCache, Request, Scheduler
+
+T0 = 1000.0       # synthetic monotonic clock origin
+
+
+def _req(n_prompt=4, deadline=None, rid=""):
+    return Request(prompt=np.arange(1, n_prompt + 1, dtype=np.int32),
+                   max_new_tokens=8, request_id=rid, deadline=deadline)
+
+
+def _sched(num_pages=9, page_size=4, pages_per_seq=4, max_batch=4):
+    cache = PagedKVCache(num_pages, page_size, pages_per_seq)
+    return Scheduler(cache, max_batch)
+
+
+class TestExpiry:
+    def test_expired_in_queue_never_admitted(self):
+        sched = _sched()
+        live = _req(rid="live")
+        dead = _req(deadline=T0 - 1.0, rid="dead")
+        sched.add(dead)
+        sched.add(live)
+        expired = sched.expire_queued(now=T0)
+        assert [r.request_id for r in expired] == ["dead"]
+        admitted = sched.admit()
+        assert [s.seq_id for s in admitted] == ["live"]
+        # the drop left no allocator trace: nothing was ever prefilled
+        assert sched.cache.num_seqs() == 1
+        # idempotent: a second sweep finds nothing
+        assert sched.expire_queued(now=T0) == []
+
+    def test_expires_exactly_on_admission_step(self):
+        """now == deadline is a miss: any token produced would already
+        be late.  The engine passes ONE `now` to the expiry sweep that
+        runs immediately before admit(), so this boundary is the
+        admission-step boundary."""
+        sched = _sched()
+        sched.add(_req(deadline=T0, rid="knife-edge"))
+        expired = sched.expire_queued(now=T0)
+        assert [r.request_id for r in expired] == ["knife-edge"]
+        assert sched.admit() == []
+
+    def test_unexpired_and_deadline_free_survive(self):
+        sched = _sched()
+        sched.add(_req(deadline=T0 + 5.0, rid="later"))
+        sched.add(_req(rid="no-slo"))
+        assert sched.expire_queued(now=T0) == []
+        assert sched.queue_depth() == 2
+
+    def test_request_expired_predicate(self):
+        r = _req(deadline=T0)
+        assert not r.expired(now=T0 - 1e-6)
+        assert r.expired(now=T0)
+        assert r.expired(now=T0 + 1.0)
+        assert not _req(deadline=None).expired(now=1e18)
+
+
+class TestExpiredVictimPreemption:
+    def _two_running(self, sched, deadline_first=None, deadline_second=None):
+        sched.add(_req(deadline=deadline_first, rid="old"))
+        sched.add(_req(deadline=deadline_second, rid="young"))
+        admitted = sched.admit()
+        assert [s.seq_id for s in admitted] == ["old", "young"]
+        return admitted
+
+    def test_pick_victim_prefers_expired(self):
+        """The YOUNGEST rule is overridden by expiry: evicting a
+        sequence that already missed its SLO costs no useful
+        recompute."""
+        sched = _sched()
+        old, young = self._two_running(
+            sched, deadline_first=0.0)     # "old" expired long ago
+        # default policy would pick "young" (reversed order); the
+        # expired "old" must win instead
+        assert sched._pick_victim(exclude=young) is old
+
+    def test_preempting_expired_victim_then_queue_drop(self):
+        """End-to-end policy: page exhaustion preempts the expired
+        victim; its requeued request is then swept by expire_queued —
+        it never burns a prefill again."""
+        # 8 allocatable pages, page_size 4: two 4-token prompts hold 1
+        # page each; growing "young" to 4 pages + "old"'s 1 exceeds 8
+        # only with pages_per_seq headroom — use a tight cache instead
+        cache = PagedKVCache(4, 4, 3)      # 3 allocatable pages
+        sched = Scheduler(cache, 2)
+        sched.add(_req(n_prompt=4, deadline=0.0, rid="expired"))
+        sched.add(_req(n_prompt=4, rid="live"))
+        old, young = sched.admit()
+        assert {old.seq_id, young.seq_id} == {"expired", "live"}
+        # "live" needs pages for positions 4..11 -> 3 pages total; the
+        # free list (1 page) can't cover it: "expired" is evicted
+        young_live = young if young.seq_id == "live" else old
+        young_live.pos = 8
+        preempted = sched.ensure_decode_pages([young_live])
+        assert [s.seq_id for s in preempted] == ["expired"]
+        assert sched.num_preemptions == 1
+        # the victim's request went back to the queue FRONT...
+        assert sched.waiting[0].request_id == "expired"
+        # ...and the next expiry sweep drops it for good
+        dropped = sched.expire_queued()
+        assert [r.request_id for r in dropped] == ["expired"]
+        assert not any(r.request_id == "expired" for r in sched.waiting)
+
+    def test_unexpired_fallback_keeps_youngest_rule(self):
+        sched = _sched()
+        old, young = self._two_running(sched)
+        assert sched._pick_victim(exclude=old) is young

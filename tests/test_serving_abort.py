@@ -1,0 +1,239 @@
+"""ServingEngine.abort + token-emit callback + engine deadline
+enforcement (ISSUE 5).
+
+Pinned guarantees:
+- abort retires a queued OR in-flight sequence with ZERO page leak, and
+  survivors' token streams are byte-identical with and without the
+  abort (the acceptance bar for cancellation);
+- in dynamic int8 KV mode the freed pages' scales are reset, so a new
+  sequence reusing them decodes byte-identically to a solo run;
+- the per-token callback observes exactly the emitted stream through
+  the single consume path (sync, pipelined and fused modes), and
+  forward-progress index filtering reconstructs the stream exactly even
+  under forced recompute-preemption replay;
+- deadline expiry inside the engine: queued -> dropped before
+  admission, mid-decode -> aborted with pages freed, both surfaced via
+  take_expired() and the serving.deadline_miss counter — and the checks
+  keep the steady-state decode loop transfer-guard-clean.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.monitor import stat_get
+from paddle_tpu.serving import ServingEngine
+
+VOCAB, HID, LAYERS, HEADS = 50, 32, 2, 2
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    from paddle_tpu.text.models import GPTModel
+
+    paddle.seed(11)
+    m = GPTModel(vocab_size=VOCAB, hidden_size=HID, num_layers=LAYERS,
+                 num_heads=HEADS, ffn_size=64, max_seq_len=64, dropout=0.0)
+    m.eval()
+    return m
+
+
+def _drain(eng):
+    while eng.scheduler.has_work() or eng._pending:
+        eng.step()
+    return dict(eng.outputs)
+
+
+class TestAbort:
+    def test_abort_queued_request(self, gpt):
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=1, eos_id=-1)
+        a = eng.add_request(np.array([3, 5, 7], np.int32), max_new_tokens=4)
+        b = eng.add_request(np.array([2, 9], np.int32), max_new_tokens=4)
+        assert eng.abort(a) is True
+        outs = _drain(eng)
+        assert set(outs) == {b}
+        assert eng.cache.pages_in_use == 0
+        assert eng.metrics.snapshot()["aborts"] == 1
+
+    def test_abort_unknown_or_finished_is_false(self, gpt):
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=1, eos_id=-1)
+        a = eng.add_request(np.array([3, 5], np.int32), max_new_tokens=2)
+        _drain(eng)
+        assert eng.abort("no-such-id") is False
+        assert eng.abort(a) is False          # finished: output stays
+        assert a in eng.outputs
+
+    def test_abort_mid_decode_survivors_byte_identical(self, gpt):
+        """The satellite acceptance: run A+B, abort A mid-decode; B's
+        stream must match the no-abort run byte for byte, and no page
+        may leak — across all three consume paths (ONE no-abort
+        baseline suffices: sync==pipelined==fused byte-identity is
+        already pinned by tests/test_serving_async.py)."""
+        prompts = {"A": np.array([3, 5, 7], np.int32),
+                   "B": np.array([2, 9], np.int32)}
+
+        def run(kwargs, abort_a):
+            eng = ServingEngine(gpt, page_size=4, max_batch_size=2,
+                                eos_id=-1, **kwargs)
+            for rid, p in prompts.items():
+                eng.add_request(p, max_new_tokens=24, request_id=rid)
+            for _ in range(2):
+                eng.step()
+            if abort_a:
+                assert eng.abort("A") is True
+            outs = _drain(eng)
+            assert eng.cache.pages_in_use == 0
+            return outs
+
+        base = run({}, abort_a=False)
+        assert "A" in base
+        for kwargs in ({},                  # pipelined (default)
+                       {"sync_mode": True},
+                       {"fused_steps": 4}):
+            aborted = run(kwargs, abort_a=True)
+            assert "A" not in aborted
+            np.testing.assert_array_equal(base["B"], aborted["B"])
+
+    def test_abort_frees_lane_for_reuse(self, gpt):
+        """The freed batch lane and pages must be reusable: a request
+        admitted after the abort decodes byte-identically to running
+        solo on a fresh engine."""
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=1,
+                            num_pages=5, eos_id=-1)
+        eng.add_request(np.array([3, 5, 7, 1], np.int32),
+                        max_new_tokens=8, request_id="A")
+        for _ in range(4):
+            eng.step()
+        assert eng.abort("A")
+        c_prompt = np.array([4, 8, 2], np.int32)
+        eng.add_request(c_prompt, max_new_tokens=8, request_id="C")
+        outs = _drain(eng)
+        solo = ServingEngine(gpt, page_size=4, max_batch_size=1,
+                             num_pages=5, eos_id=-1)
+        solo.add_request(c_prompt, max_new_tokens=8, request_id="C")
+        np.testing.assert_array_equal(outs["C"], _drain(solo)["C"])
+        assert eng.cache.pages_in_use == 0
+
+    def test_abort_dynamic_int8_resets_page_scales(self, gpt):
+        """Dynamic int8 KV: an aborted sequence's pages may have grown
+        large per-page scales; a successor reusing those physical pages
+        must still decode byte-identically to a solo run (scale reset
+        on abort + reallocation)."""
+        kw = dict(page_size=4, max_batch_size=1, num_pages=5,
+                  eos_id=-1, kv_cache_dtype="int8")
+        eng = ServingEngine(gpt, **kw)
+        # large-magnitude hidden states not needed: any tokens grow the
+        # scales above the eps floor
+        eng.add_request(np.array([3, 5, 7, 1], np.int32),
+                        max_new_tokens=8, request_id="A")
+        for _ in range(4):
+            eng.step()
+        assert eng.abort("A")
+        c_prompt = np.array([4, 8, 2], np.int32)
+        eng.add_request(c_prompt, max_new_tokens=8, request_id="C")
+        outs = _drain(eng)
+        solo = ServingEngine(gpt, **kw)
+        solo.add_request(c_prompt, max_new_tokens=8, request_id="C")
+        np.testing.assert_array_equal(outs["C"], _drain(solo)["C"])
+
+
+class TestTokenCallback:
+    def test_stream_matches_outputs_under_preemption(self, gpt):
+        """The callback stream, filtered to forward progress
+        (index == tokens_seen), reconstructs every request's final
+        output exactly — including under forced recompute-preemption
+        (tight cache), where earlier indices are re-emitted with
+        identical values."""
+        streams = {}
+        replays = 0
+
+        def cb(rid, idx, tok):
+            nonlocal replays
+            buf = streams.setdefault(rid, [])
+            if idx == len(buf):
+                buf.append(tok)
+            else:
+                replays += 1
+                assert idx < len(buf) and buf[idx] == tok, (
+                    "replayed token diverged from the original emission")
+
+        # num_pages tight enough to force preemption (same shape as
+        # tests/test_serving_async.py)
+        eng = ServingEngine(gpt, page_size=4, num_pages=25,
+                            max_batch_size=8, eos_id=0,
+                            token_callback=cb)
+        rng = np.random.RandomState(7)
+        ids = []
+        for i in range(12):
+            p = rng.randint(1, VOCAB, (int(rng.randint(1, 17)),))
+            ids.append(eng.add_request(p.astype(np.int32),
+                                       max_new_tokens=6))
+        outs = _drain(eng)
+        assert eng.scheduler.num_preemptions > 0 and replays > 0
+        for rid in ids:
+            np.testing.assert_array_equal(
+                np.asarray(streams[rid], np.int32), outs[rid])
+
+    def test_callback_runs_in_fused_and_sync_modes(self, gpt):
+        for kw in ({"sync_mode": True}, {"fused_steps": 4}):
+            seen = []
+            eng = ServingEngine(gpt, page_size=4, max_batch_size=2,
+                                eos_id=-1, token_callback=(
+                                    lambda rid, i, t: seen.append((i, t))),
+                                **kw)
+            rid = eng.add_request(np.array([3, 5], np.int32),
+                                  max_new_tokens=8)
+            outs = _drain(eng)
+            assert [t for _, t in seen] == outs[rid].tolist()
+            assert [i for i, _ in seen] == list(range(8))
+
+
+class TestEngineDeadlines:
+    def test_queued_expiry_dropped_before_admission(self, gpt):
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=1, eos_id=-1)
+        base = stat_get("serving.deadline_miss")
+        x = eng.add_request(np.array([3, 5], np.int32), max_new_tokens=4,
+                            deadline=time.monotonic() - 1.0)
+        eng.step()
+        assert eng.take_expired() == [x]
+        assert eng.take_expired() == []        # drained exactly once
+        assert x not in eng.outputs
+        assert eng.cache.pages_in_use == 0     # never prefilled
+        assert stat_get("serving.deadline_miss") == base + 1
+
+    def test_mid_decode_expiry_aborts_and_frees_pages(self, gpt):
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=1, eos_id=-1)
+        y = eng.add_request(np.array([3, 5], np.int32), max_new_tokens=40,
+                            deadline=time.monotonic() + 0.3)
+        saw_pages = 0
+        while eng.scheduler.has_work() or eng._pending:
+            eng.step()
+            saw_pages = max(saw_pages, eng.cache.pages_in_use)
+        assert saw_pages > 0                   # it really was decoding
+        assert eng.take_expired() == [y]
+        assert y not in eng.outputs
+        assert eng.cache.pages_in_use == 0
+
+    def test_deadline_checks_stay_transfer_guard_clean(self, gpt):
+        """The per-step deadline sweep is host-only python: a steady
+        decode batch carrying (far-future) deadlines must survive
+        jax.transfer_guard('disallow') exactly like the deadline-free
+        loop pinned in tests/test_serving_async.py."""
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=4, eos_id=-1)
+        rng = np.random.RandomState(1)
+        far = time.monotonic() + 3600.0
+        for p in (3, 6, 9, 12):
+            eng.add_request(rng.randint(1, VOCAB, (p,)).astype(np.int32),
+                            max_new_tokens=24, deadline=far)
+        for _ in range(4):
+            eng.step()
+        assert all(s is not None for s in eng._lanes)
+        with jax.transfer_guard("disallow"):
+            for _ in range(8):
+                stats = eng.step()
+                assert stats["bucket"] == 4
+        outs = _drain(eng)
+        assert len(outs) == 4 and eng.take_expired() == []

@@ -11,10 +11,17 @@ Usage:
     python tools/bench_diff.py BENCH_r04.json BENCH_r05.json
     python tools/bench_diff.py a.json b.json --only serving
     python tools/bench_diff.py a.json b.json --min-pct 5
+    python tools/bench_diff.py a.json b.json --only serving \
+        --fail-on-regression 10        # exit 1 on a >10% regression
 
-Importable (``load``, ``flatten``, ``diff``, ``format_table``) so the
-smoke test runs it in-process; the CLI returns 0 (diffing is reporting,
-not gating).
+Importable (``load``, ``flatten``, ``diff``, ``format_table``,
+``lower_is_better``, ``regressions``) so the smoke test runs it
+in-process.  Plain diffing returns 0 (reporting, not gating);
+``--fail-on-regression PCT`` turns the run into a CI gate — nonzero
+exit when any ``--only``-selected comparable metric moved beyond PCT
+percent in the WORSE direction, where direction comes from the metric's
+name (``lower_is_better``): latency/miss/bytes-shaped names regress
+upward, throughput-shaped names regress downward.
 """
 from __future__ import annotations
 
@@ -106,6 +113,50 @@ def diff(a: dict, b: dict, only: Optional[str] = None,
     return rows
 
 
+# name fragments marking metrics where BIGGER is better even though a
+# lower-better fragment also matches the path — checked FIRST (e.g.
+# `kv_bytes_reduction_x` contains "bytes" but a higher reduction is the
+# win; same for rates/ratios of good events)
+_HIGHER_BETTER = ("reduction", "per_sec", "per_second", "goodput",
+                  "throughput", "occupancy", "parity", "speedup",
+                  "utilization", "hit", "_x")
+# name fragments marking metrics where SMALLER is better (latencies,
+# misses, memory, churn); everything else (tokens/sec, accuracy, ...)
+# is treated as bigger-is-better
+_LOWER_BETTER = ("_ms", "latency", "ttft", "e2e", "gap", "miss", "bytes",
+                 "fragmentation", "preemption", "reject", "retries",
+                 "cancel", "abort", "failure", "queue_depth",
+                 "dispatches_per", "_rate")
+
+
+def lower_is_better(metric: str) -> bool:
+    """Direction heuristic by metric path: True when an INCREASE is a
+    regression.  Checked per dotted-path fragment so
+    ``detail.ttft_ms_p95`` and ``serving.deadline_miss_rate`` classify
+    without a manual registry; bigger-is-better fragments win ties
+    (``kv_bytes_reduction_x`` is a reduction RATIO, not a byte count)."""
+    m = metric.lower()
+    if any(frag in m for frag in _HIGHER_BETTER):
+        return False
+    return any(frag in m for frag in _LOWER_BETTER)
+
+
+def regressions(rows: List[dict], pct: float) -> List[dict]:
+    """Rows whose metric moved beyond ``pct`` percent in the worse
+    direction (one-sided: an improvement never gates, however large).
+    Rows missing on either side are skipped — absence is a schema
+    change, not a measured regression."""
+    out = []
+    for r in rows:
+        if r["pct"] is None:
+            continue
+        worse = r["pct"] > 0 if lower_is_better(r["metric"]) \
+            else r["pct"] < 0
+        if worse and abs(r["pct"]) > pct:
+            out.append(r)
+    return out
+
+
 def _fmt(v, width=14) -> str:
     if v is None:
         return "-".rjust(width)
@@ -136,6 +187,11 @@ def main(argv=None) -> int:
                     help="substring filter on metric paths")
     ap.add_argument("--min-pct", type=float, default=0.0,
                     help="hide rows that moved less than this percent")
+    ap.add_argument("--fail-on-regression", type=float, default=None,
+                    metavar="PCT",
+                    help="exit 1 when any selected metric regresses "
+                         "beyond PCT percent (direction inferred from "
+                         "the metric name) — the CI gate mode")
     args = ap.parse_args(argv)
     rows = diff(load(args.file_a), load(args.file_b),
                 only=args.only, min_pct=args.min_pct)
@@ -143,6 +199,16 @@ def main(argv=None) -> int:
     changed = [r for r in rows if r["pct"] is not None]
     print(f"\n{len(rows)} metrics, {len(changed)} comparable "
           f"({args.file_a} -> {args.file_b})")
+    if args.fail_on_regression is not None:
+        bad = regressions(rows, args.fail_on_regression)
+        if bad:
+            print(f"\nREGRESSIONS beyond {args.fail_on_regression:g}%:")
+            for r in bad:
+                direction = "up" if lower_is_better(r["metric"]) else "down"
+                print(f"  {r['metric']}: {r['a']:g} -> {r['b']:g} "
+                      f"({r['pct']:+.1f}%, worse is {direction})")
+            return 1
+        print(f"no regression beyond {args.fail_on_regression:g}%")
     return 0
 
 
