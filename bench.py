@@ -908,6 +908,176 @@ def bench_serving_frontend(num_requests=32, max_new_tokens=12):
     }
 
 
+def bench_serving_resilience(num_requests=16, max_new_tokens=24):
+    """Resilience numbers (docs/SERVING.md "Resilience"), two measured
+    scenarios:
+
+    WARM FAILOVER — the frontend checkpoints every in-flight request
+    every ``snapshot_interval`` tokens; replica-0 is killed mid-decode
+    and its requests resume FROM THE LAST CHECKPOINT on the survivor
+    instead of replaying from token 0.  Reports kill→first-resumed-token
+    recovery latency (``serving.failover_recovery_ms``) and the tokens
+    of recompute the checkpoints saved vs a token-0 restart
+    (``serving.frontend.recompute_saved_tokens`` = Σ resumed_from).
+
+    BROWNOUT — the same arrival schedule at ~2x the fleet's measured
+    service rate, once with brownout OFF (cliff: queue_cap 429s) and
+    once with brownout ON (shed lowest-slack → clamp budgets → reject).
+    Reports goodput (completed req/s) for both and the staged-degradation
+    accounting (shed/clamped/rejected counts, max stage reached).
+    ``goodput_ratio_vs_cliff_x`` > 1 means degrading gracefully beat the
+    cliff on this workload."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import BrownoutPolicy, ServingFrontend
+    from paddle_tpu.text.models import GPTModel
+
+    V, HID, L, HEADS, FF, SEQ = 4096, 128, 2, 4, 512, 256
+    paddle.seed(0)
+    model = GPTModel(vocab_size=V, hidden_size=HID, num_layers=L,
+                     num_heads=HEADS, ffn_size=FF, max_seq_len=SEQ,
+                     dropout=0.0)
+    model.eval()
+    ekw = dict(page_size=16, max_batch_size=8, max_seq_len=SEQ, eos_id=-1)
+    rng = np.random.RandomState(0)
+    snapshot_interval = int(os.environ.get("BENCH_RESILIENCE_SNAP_K", "4"))
+
+    def _warm(fe, n=4):
+        # compile prefill-chunk + decode buckets outside the timed window
+        warm = [fe.submit(rng.randint(1, V, (m,)).astype(np.int32),
+                          max_new_tokens=4) for m in (9, 17, 33, 12)[:n]]
+        for h in warm:
+            h.wait(timeout=300)
+        fe.metrics.reset()
+        fe.engine_metrics.reset()
+
+    # --- scenario 1: warm failover ------------------------------------------
+    prompts = [rng.randint(1, V, (int(p),)).astype(np.int32)
+               for p in rng.randint(8, 40, num_requests)]
+    fe = ServingFrontend(model, replicas=2, queue_cap=num_requests + 4,
+                         engine_kwargs=ekw,
+                         snapshot_interval=snapshot_interval)
+    try:
+        _warm(fe)
+        rep0 = fe.router.get("replica-0")
+        fe.inject_failure("replica-0",
+                          at_step=rep0.steps + max(6, num_requests // 2))
+        t0 = time.perf_counter()
+        handles = [fe.submit(p, max_new_tokens=max_new_tokens)
+                   for p in prompts]
+        statuses = [h.wait(timeout=600) for h in handles]
+        failover_dt = time.perf_counter() - t0
+        esnap = fe.engine_metrics.snapshot()
+        fsnap = fe.metrics.snapshot()
+        resumed = [h for h in handles if h.resumed_from is not None]
+    finally:
+        fe.close()
+    from collections import Counter
+
+    failover = {
+        "num_requests": num_requests,
+        "snapshot_interval": snapshot_interval,
+        "statuses": dict(Counter(statuses)),
+        "resumed_requests": len(resumed),
+        "failover_recovery_ms_p50": round(
+            esnap["failover_recovery_ms"]["p50"], 2),
+        "failover_recovery_ms_p95": round(
+            esnap["failover_recovery_ms"]["p95"], 2),
+        # Σ resumed_from: decode work a token-0 restart would redo
+        "recompute_saved_tokens": fsnap["recompute_saved_tokens"],
+        "snapshots": esnap["snapshots"],
+        "restores": esnap["restores"],
+        "snapshot_bytes_last": esnap["snapshot_bytes"],
+        "wall_s": round(failover_dt, 3),
+    }
+
+    # --- scenario 2: brownout goodput under 2x overload ---------------------
+    # calibrate the fleet's service rate on this machine (closed loop,
+    # no overload), then arrive at 2x that rate for both measured runs
+    cal_n = max(6, num_requests // 2)
+    cal_prompts = [rng.randint(1, V, (16,)).astype(np.int32)
+                   for _ in range(cal_n)]
+    fe = ServingFrontend(model, replicas=1, queue_cap=cal_n + 2,
+                         engine_kwargs=ekw, snapshot_interval=None)
+    try:
+        _warm(fe, n=2)
+        t0 = time.perf_counter()
+        hs = [fe.submit(p, max_new_tokens=max_new_tokens)
+              for p in cal_prompts]
+        for h in hs:
+            h.wait(timeout=600)
+        service_rate = cal_n / (time.perf_counter() - t0)
+    finally:
+        fe.close()
+
+    over_n = int(os.environ.get("BENCH_RESILIENCE_OVERLOAD_N", "24"))
+    over_prompts = [rng.randint(1, V, (int(p),)).astype(np.int32)
+                    for p in rng.randint(8, 32, over_n)]
+    gaps = rng.exponential(1.0 / (2.0 * service_rate), over_n)
+    deadline_ms = 1e3 * over_n / service_rate  # generous: overload, not SLO
+
+    def _overload_run(brownout):
+        fe = ServingFrontend(model, replicas=1, queue_cap=8,
+                             engine_kwargs=ekw, snapshot_interval=None,
+                             brownout=brownout)
+        try:
+            _warm(fe, n=2)
+            t0 = time.perf_counter()
+            handles = []
+            max_stage = 0
+            for i, p in enumerate(over_prompts):
+                time.sleep(gaps[i])
+                handles.append(fe.submit(
+                    p, max_new_tokens=max_new_tokens,
+                    deadline_ms=deadline_ms if i % 3 == 0 else None))
+                if fe.brownout is not None:
+                    max_stage = max(max_stage, fe.brownout.stage)
+            sts = [h.wait(timeout=600) for h in handles]
+            dt = time.perf_counter() - t0
+            snap = fe.metrics.snapshot()
+            tokens = sum(len(h.tokens) for h in handles
+                         if h.status == "completed")
+            return {
+                "statuses": dict(Counter(sts)),
+                "goodput_req_per_sec": round(
+                    sts.count("completed") / dt, 3),
+                "completed_tokens_per_sec": round(tokens / dt, 2),
+                "max_brownout_stage": max_stage,
+                "brownout_shed": snap["brownout_shed"],
+                "brownout_clamped": snap["brownout_clamped"],
+                "brownout_rejected": snap["brownout_rejected"],
+                "rejects": snap["rejects"],
+            }
+        finally:
+            fe.close()
+
+    cliff = _overload_run(brownout=None)
+    graceful = _overload_run(brownout=BrownoutPolicy())
+    brownout = {
+        "overload_requests": over_n,
+        "service_rate_req_per_sec": round(service_rate, 3),
+        "arrival_rate_x_service": 2.0,
+        "cliff": cliff,
+        "graceful": graceful,
+        "goodput_ratio_vs_cliff_x": round(
+            graceful["goodput_req_per_sec"]
+            / max(cliff["goodput_req_per_sec"], 1e-9), 3),
+    }
+
+    return {
+        "metric": "serving_failover_recovery_ms_p50",
+        "value": failover["failover_recovery_ms_p50"],
+        "unit": "ms kill->first resumed token",
+        "detail": {
+            "failover": failover,
+            "brownout": brownout,
+            "model": {"hidden": HID, "layers": L, "heads": HEADS,
+                      "max_seq_len": SEQ},
+        },
+    }
+
+
 def _attach_serving_prefill(result):
     """Attach the prefill-heavy serving workload to a result's detail —
     shared by BENCH_MODEL=serving and the default `all` run."""
@@ -1043,6 +1213,20 @@ def main():
         except Exception as e:  # noqa: BLE001 — rider workload, never fatal
             sys.stderr.write(
                 f"serving frontend bench failed after retries "
+                f"({type(e).__name__}: {e})\n")
+        try:
+            # warm failover recovery + brownout goodput under 2x overload
+            result.setdefault("detail", {})["resilience"] = \
+                _with_retries(
+                    "serving_resilience",
+                    lambda: bench_serving_resilience(
+                        int(os.environ.get("BENCH_RESILIENCE_REQUESTS",
+                                           "16")),
+                        int(os.environ.get("BENCH_RESILIENCE_TOKENS",
+                                           "24"))))
+        except Exception as e:  # noqa: BLE001 — rider workload, never fatal
+            sys.stderr.write(
+                f"serving resilience bench failed after retries "
                 f"({type(e).__name__}: {e})\n")
     else:
         # default: BOTH flagship benches in one driver run (VERDICT r1 #2);

@@ -61,6 +61,46 @@ class ExternalError(EnforceNotMet):
     pass
 
 
+class DeadlineExceededError(ExecutionTimeoutError):
+    """An operation's deadline/SLO passed before it could finish (the
+    reference taxonomy's DEADLINE_EXCEEDED shade of timeout; serving
+    maps the ``deadline_miss`` terminal status onto it)."""
+
+
+class InternalError(EnforceNotMet):
+    """Invariant broken inside the framework itself — the catch-all for
+    crashes that are not the caller's fault (serving maps the ``failed``
+    terminal status onto it)."""
+
+
+# --- HTTP status derivation --------------------------------------------------
+# One place decides how the taxonomy surfaces over HTTP, so the serving
+# frontend/HTTP layer derives its status codes from the error CLASS of a
+# terminal outcome instead of keeping an ad-hoc parallel table
+# (serving/http.py consumes this; docs/SERVING.md "Resilience").
+ERROR_HTTP_STATUS = {
+    InvalidArgumentError: 400,
+    NotFoundError: 404,
+    AlreadyExistsError: 409,
+    ResourceExhaustedError: 429,   # overload / queue_cap — retry later
+    UnavailableError: 503,         # brownout / no healthy replica
+    DeadlineExceededError: 504,
+    ExecutionTimeoutError: 504,
+    InternalError: 500,
+    FatalError: 500,
+}
+
+
+def http_status_for(error, default: int = 500) -> int:
+    """HTTP status for an error instance or class (walks the MRO, so a
+    subclass inherits its nearest ancestor's mapping)."""
+    cls = error if isinstance(error, type) else type(error)
+    for base in cls.__mro__:
+        if base in ERROR_HTTP_STATUS:
+            return ERROR_HTTP_STATUS[base]
+    return default
+
+
 def enforce(condition, message="", error_cls=InvalidArgumentError):
     if not condition:
         raise error_cls(message)

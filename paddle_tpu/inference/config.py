@@ -64,7 +64,8 @@ class Config:
                        max_seq_len=None, eos_id=0, prefill_chunk=64,
                        sync_mode=False, fused_steps=1,
                        kv_cache_dtype=None, weight_dtype=None,
-                       replicas=1, queue_cap=64, default_deadline_ms=None):
+                       replicas=1, queue_cap=64, default_deadline_ms=None,
+                       snapshot_interval=16, watchdog=None, brownout=None):
         """Opt in to the continuous-batching serving engine
         (docs/SERVING.md).  Stores the paged-KV / scheduler knobs plus the
         pipelining knobs (``prefill_chunk`` tokens per prefill program,
@@ -83,6 +84,15 @@ class Config:
         router, ``queue_cap`` live requests before reject-on-overload
         (None = unbounded), ``default_deadline_ms`` applied to requests
         submitted without an explicit deadline (None = no SLO).
+
+        Resilience knobs (docs/SERVING.md "Resilience"):
+        ``snapshot_interval`` checkpoints every in-flight request each K
+        consumed tokens so replica failover RESUMES from the checkpoint
+        instead of replaying from token 0 (None disables);
+        ``watchdog=True`` (or a serving.resilience.WatchdogConfig)
+        enables hung-step detection with suspect/backoff/dead
+        escalation; ``brownout=True`` (or a BrownoutPolicy) enables
+        staged overload degradation (shed → clamp → reject).
 
         Not reference API — the reference's serving story stops at
         AnalysisPredictor; this is the TPU-native extension."""
@@ -104,6 +114,10 @@ class Config:
             "default_deadline_ms": (
                 None if default_deadline_ms is None
                 else float(default_deadline_ms)),
+            "snapshot_interval": (None if snapshot_interval is None
+                                  else int(snapshot_interval)),
+            "watchdog": watchdog,
+            "brownout": brownout,
         }
 
     def serving_enabled(self) -> bool:

@@ -25,11 +25,24 @@ Components
                                 thread per replica, deadline/overload
                                 admission control
 - ``router.Router``             least-outstanding-tokens multi-replica
-                                placement, health states, deterministic
-                                fault injection with transparent
-                                failover
+                                placement, health states (incl. the
+                                watchdog's SUSPECT), bounded
+                                retry-with-backoff placement, and
+                                deterministic fault injection with
+                                transparent failover
+- ``resilience``                warm-failover snapshots
+                                (``EngineSnapshot``), hung-step
+                                ``Watchdog``, staged overload
+                                ``BrownoutController`` — the policy
+                                layer behind engine.snapshot/restore
+                                and the frontend's failure handling
+                                (docs/SERVING.md "Resilience";
+                                deterministic fault injection lives in
+                                ``paddle_tpu.testing.chaos``)
 - ``http.ServingHTTPServer``    stdlib POST /generate (chunked token
-                                streaming) + /healthz + /metrics
+                                streaming) + /healthz + /metrics, HTTP
+                                statuses derived from the
+                                framework.errors taxonomy
 
 The attention primitive lives with the other Pallas kernels
 (ops/pallas_ops/paged_attention.py, routed via ops/attention.py).
@@ -40,6 +53,8 @@ from .frontend import (ResponseHandle, ServingFrontend,
 from .http import ServingHTTPServer, start_http_server
 from .kv_cache import PagedKVCache
 from .metrics import FrontendMetrics, ServingMetrics
+from .resilience import (BrownoutController, BrownoutPolicy,
+                         EngineSnapshot, Watchdog, WatchdogConfig)
 from .router import Replica, Router
 from .scheduler import Request, Scheduler, Sequence
 
@@ -47,4 +62,6 @@ __all__ = ["ServingEngine", "create_serving_engine", "PagedKVCache",
            "ServingMetrics", "FrontendMetrics", "Request", "Scheduler",
            "Sequence", "ServingFrontend", "ResponseHandle",
            "create_serving_frontend", "Router", "Replica",
-           "ServingHTTPServer", "start_http_server"]
+           "ServingHTTPServer", "start_http_server", "EngineSnapshot",
+           "Watchdog", "WatchdogConfig", "BrownoutPolicy",
+           "BrownoutController"]

@@ -45,7 +45,9 @@ Execution model
 """
 from __future__ import annotations
 
+import threading
 import time
+import weakref
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
@@ -54,13 +56,177 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..profiler.jit_cost import cost_registry, profiled_jit
+from ..testing.chaos import chaos_site
 from ..utils.bucketing import chunk_schedule, next_pow2, smallest_bucket
 from ..utils.profiler import RecordEvent
-from .kv_cache import PagedKVCache
+from .kv_cache import (KV_SCALE_EPS, PagedKVCache, dequantize_kv_page,
+                       quantize_kv_page)
 from .metrics import ServingMetrics
+from .resilience import EngineSnapshot
 from .scheduler import Request, Scheduler, Sequence
 
 __all__ = ["ServingEngine", "create_serving_engine"]
+
+
+# --- shared compiled-program bundles -----------------------------------------
+# Replicas of one serving configuration (the frontend's fleet, a test's
+# engine-per-scenario) would otherwise each rebuild and RECOMPILE the
+# identical jitted step programs — on a 2-replica frontend that doubles
+# every XLA compile for zero benefit.  Bundles are keyed per MODEL
+# OBJECT (weak — dropping the model drops its programs) and, inside,
+# by parameter identity plus every knob the traced programs close over:
+# jax arrays are immutable, so training/replacing a param changes its
+# id and misses the cache.  Page POOLS stay per-engine (init_pages
+# builds fresh buffers each call); only the pure compiled programs and
+# the derived int8 weights are shared.
+_PROGRAM_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_PROGRAM_LOCK = threading.Lock()
+
+
+def _shared_programs(model, *, page_size: int, pages_per_seq: int,
+                     kv_cache_dtype, weight_dtype, kv_scales, weights,
+                     fused_steps: int) -> dict:
+    from ..jit.functional import get_state
+    from ..text.generation import (make_gpt_paged_decode_step,
+                                   make_gpt_paged_fused_decode_step,
+                                   make_gpt_paged_prefill_step)
+
+    params, _ = get_state(model)
+    key = (page_size, pages_per_seq, kv_cache_dtype, weight_dtype,
+           fused_steps,
+           None if kv_scales is None else id(kv_scales),
+           None if weights is None else id(weights),
+           tuple(sorted((k, id(v)) for k, v in params.items())))
+    # the ids above are only stable while the keyed objects are ALIVE —
+    # retain them with the bundle so a freed export/param can never be
+    # id-recycled into a stale cache hit (stored under "_key_refs" in
+    # the bundle below)
+    key_refs = (kv_scales, weights, list(params.values()))
+    with _PROGRAM_LOCK:
+        per_model = _PROGRAM_CACHE.get(model)
+        if per_model is None:
+            per_model = _PROGRAM_CACHE[model] = {}
+        progs = per_model.get(key)
+        if progs is not None:
+            return progs
+
+    weight_quant = weights
+    if weight_dtype == "int8" and weight_quant is None:
+        from ..slim.serving_export import quantize_gpt_weights
+
+        weight_quant = quantize_gpt_weights(model)
+    if weight_quant is not None:
+        # ONE device copy shared by the decode/prefill/fused step
+        # builders (jnp.asarray is a no-op on jax arrays, so the
+        # builders' own conversion reuses these buffers)
+        weight_quant = {
+            name: (jnp.asarray(q), jnp.asarray(s, jnp.float32))
+            for name, (q, s) in weight_quant.items()}
+    qkw = dict(kv_cache_dtype=kv_cache_dtype, kv_scales=kv_scales,
+               weight_quant=weight_quant)
+
+    step_fn, init_pages = make_gpt_paged_decode_step(
+        model, page_size, pages_per_seq, **qkw)
+    prefill_fn, _ = make_gpt_paged_prefill_step(
+        model, page_size, pages_per_seq, **qkw)
+
+    def _decode(tokens, pos, page_tables, kv):
+        logits, kv = step_fn(tokens, pos, page_tables, kv)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # the program advances its own state: argmax feeds back as
+        # the next input token, pos steps forward — nothing for the
+        # host to rebuild or upload between steady-state steps
+        return nxt, pos + 1, kv
+
+    def _lane_set(tokens, pos, page_tables, lane, tok, p, row):
+        return (tokens.at[lane].set(tok), pos.at[lane].set(p),
+                page_tables.at[lane].set(row))
+
+    def _row_set(page_tables, lane, row):
+        return page_tables.at[lane].set(row)
+
+    # jit caches per shape: decode retraces per lane bucket, prefill
+    # per chunk bucket — both change rarely by construction.  The kv
+    # pools are donated: the engine reassigns self._kv from the result
+    # right after each call, letting XLA alias the .at[].set update
+    # in place instead of copying every layer's page pool per token
+    # (platforms without donation support just warn and copy).
+    # profiled_jit attributes FLOPs/bytes + compile count/time to
+    # "serving.*" names in profiler.cost_registry.
+    progs = {
+        "_key_refs": key_refs,
+        "init_pages": init_pages,
+        "weight_quant": weight_quant,
+        "decode": profiled_jit("serving.decode", _decode,
+                               donate_argnums=(3,)),
+        "prefill": profiled_jit("serving.prefill", prefill_fn,
+                                donate_argnums=(4,)),
+        # NOT donated: self._tokens aliases the newest _Pending entry's
+        # handle (single-step dispatch returns one buffer for both), so
+        # donating it into a lane clear would delete tokens still
+        # awaiting consumption — the arrays are [bucket] ints, copying
+        # is nothing
+        "lane_set": profiled_jit("serving.lane_update", _lane_set),
+        "row_set": profiled_jit("serving.table_update", _row_set),
+        "fused": None,
+        "scale_reset": None,
+    }
+    if fused_steps > 1:
+        fused_fn, _ = make_gpt_paged_fused_decode_step(
+            model, page_size, pages_per_seq, fused_steps, **qkw)
+        progs["fused"] = profiled_jit("serving.decode_fused", fused_fn,
+                                      donate_argnums=(3,))
+    if kv_cache_dtype == "int8" and kv_scales is None:
+        def _scale_reset(kv, rows):
+            # rows: [R] page ids (pow2-padded with the trash page 0 —
+            # resetting its scale is harmless); back to the eps floor
+            # so a reallocated page quantizes from scratch
+            out = dict(kv)
+            out["k_scale"] = [s.at[rows].set(KV_SCALE_EPS)
+                              for s in kv["k_scale"]]
+            out["v_scale"] = [s.at[rows].set(KV_SCALE_EPS)
+                              for s in kv["v_scale"]]
+            return out
+
+        progs["scale_reset"] = profiled_jit("serving.kv_scale_reset",
+                                            _scale_reset,
+                                            donate_argnums=(0,))
+
+    # --- resilience: snapshot gather / restore scatter ---------------
+    # page payloads move as [R, P, H, D] blocks per layer/side; rows
+    # are pow2-padded with the trash page 0 so the trace set stays
+    # {pow2} (padding writes zeros into the trash page — harmless by
+    # the trash-page convention)
+    def _page_gather(kv, rows):
+        out = {"k": [jnp.take(p, rows, axis=0) for p in kv["k"]],
+               "v": [jnp.take(p, rows, axis=0) for p in kv["v"]]}
+        if "k_scale" in kv:
+            out["k_scale"] = [jnp.take(s, rows, axis=0)
+                              for s in kv["k_scale"]]
+            out["v_scale"] = [jnp.take(s, rows, axis=0)
+                              for s in kv["v_scale"]]
+        return out
+
+    def _page_put(kv, rows, payload):
+        out = dict(kv)
+        out["k"] = [p.at[rows].set(d)
+                    for p, d in zip(kv["k"], payload["k"])]
+        out["v"] = [p.at[rows].set(d)
+                    for p, d in zip(kv["v"], payload["v"])]
+        if "k_scale" in payload:
+            out["k_scale"] = [s.at[rows].set(d) for s, d in
+                              zip(kv["k_scale"], payload["k_scale"])]
+            out["v_scale"] = [s.at[rows].set(d) for s, d in
+                              zip(kv["v_scale"], payload["v_scale"])]
+        return out
+
+    progs["page_gather"] = profiled_jit("serving.page_gather",
+                                        _page_gather)
+    progs["page_put"] = profiled_jit("serving.page_restore",
+                                     _page_put, donate_argnums=(0,))
+    with _PROGRAM_LOCK:
+        # a racing duplicate build is harmless — first one in wins
+        return per_model.setdefault(key, progs)
 
 
 class _Pending:
@@ -95,10 +261,6 @@ class ServingEngine:
                  quant_scales: Optional[dict] = None,
                  token_callback: Optional[Callable[[str, int, int],
                                                    None]] = None):
-        from ..text.generation import (make_gpt_paged_decode_step,
-                                       make_gpt_paged_fused_decode_step,
-                                       make_gpt_paged_prefill_step)
-
         self.model = model
         self.page_size = int(page_size)
         model_max = int(model.wpe.weight.shape[0])
@@ -161,94 +323,34 @@ class ServingEngine:
                 "and/or weight_dtype='int8' (e.g. via "
                 "Config.enable_serving) to activate the quantized path")
         qs = quant_scales or {}
-        weight_quant = None
-        if self.weight_dtype == "int8":
-            weight_quant = qs.get("weights")
-            if weight_quant is None:
-                from ..slim.serving_export import quantize_gpt_weights
-
-                weight_quant = quantize_gpt_weights(model)
-            # ONE device copy shared by the decode/prefill/fused step
-            # builders (jnp.asarray is a no-op on jax arrays, so the
-            # builders' own conversion reuses these buffers)
-            weight_quant = {
-                name: (jnp.asarray(q), jnp.asarray(s, jnp.float32))
-                for name, (q, s) in weight_quant.items()}
         kv_scales = (qs.get("kv_scales")
                      if self.kv_cache_dtype == "int8" else None)
         # dynamic per-page scales need resetting when pages are
         # reallocated (results must not depend on page-reuse history)
         self._kv_dynamic = self.kv_cache_dtype == "int8" and \
             kv_scales is None
-        qkw = dict(kv_cache_dtype=self.kv_cache_dtype,
-                   kv_scales=kv_scales, weight_quant=weight_quant)
-
-        step_fn, init_pages = make_gpt_paged_decode_step(
-            model, self.page_size, self.pages_per_seq, **qkw)
-        prefill_fn, _ = make_gpt_paged_prefill_step(
-            model, self.page_size, self.pages_per_seq, **qkw)
-        self._kv = init_pages(num_pages)
-        self._weight_quant = weight_quant
-
-        def _decode(tokens, pos, page_tables, kv):
-            logits, kv = step_fn(tokens, pos, page_tables, kv)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            # the program advances its own state: argmax feeds back as
-            # the next input token, pos steps forward — nothing for the
-            # host to rebuild or upload between steady-state steps
-            return nxt, pos + 1, kv
-
-        def _lane_set(tokens, pos, page_tables, lane, tok, p, row):
-            return (tokens.at[lane].set(tok), pos.at[lane].set(p),
-                    page_tables.at[lane].set(row))
-
-        def _row_set(page_tables, lane, row):
-            return page_tables.at[lane].set(row)
-
-        # jit caches per shape: decode retraces per lane bucket, prefill
-        # per chunk bucket — both change rarely by construction.  The kv
-        # pools are donated: self._kv is reassigned from the result
-        # right after each call, letting XLA alias the .at[].set update
-        # in place instead of copying every layer's page pool per token
-        # (platforms without donation support just warn and copy).
-        # profiled_jit attributes FLOPs/bytes + compile count/time to
-        # "serving.*" names in profiler.cost_registry.
-        self._decode_jit = profiled_jit("serving.decode", _decode,
-                                        donate_argnums=(3,))
-        self._prefill_jit = profiled_jit("serving.prefill", prefill_fn,
-                                         donate_argnums=(4,))
-        # NOT donated: self._tokens aliases the newest _Pending entry's
-        # handle (single-step dispatch returns one buffer for both), so
-        # donating it into a lane clear would delete tokens still
-        # awaiting consumption — the arrays are [bucket] ints, copying
-        # is nothing
-        self._lane_set_jit = profiled_jit("serving.lane_update", _lane_set)
-        self._row_set_jit = profiled_jit("serving.table_update", _row_set)
-        self._fused_jit = None
-        if self.fused_steps > 1:
-            fused_fn, _ = make_gpt_paged_fused_decode_step(
-                model, self.page_size, self.pages_per_seq, self.fused_steps,
-                **qkw)
-            self._fused_jit = profiled_jit("serving.decode_fused", fused_fn,
-                                           donate_argnums=(3,))
-        self._scale_reset_jit = None
-        if self._kv_dynamic:
-            from .kv_cache import KV_SCALE_EPS
-
-            def _scale_reset(kv, rows):
-                # rows: [R] page ids (pow2-padded with the trash page 0 —
-                # resetting its scale is harmless); back to the eps floor
-                # so a reallocated page quantizes from scratch
-                out = dict(kv)
-                out["k_scale"] = [s.at[rows].set(KV_SCALE_EPS)
-                                  for s in kv["k_scale"]]
-                out["v_scale"] = [s.at[rows].set(KV_SCALE_EPS)
-                                  for s in kv["v_scale"]]
-                return out
-
-            self._scale_reset_jit = profiled_jit("serving.kv_scale_reset",
-                                                 _scale_reset,
-                                                 donate_argnums=(0,))
+        progs = _shared_programs(
+            model, page_size=self.page_size,
+            pages_per_seq=self.pages_per_seq,
+            kv_cache_dtype=self.kv_cache_dtype,
+            weight_dtype=self.weight_dtype, kv_scales=kv_scales,
+            weights=qs.get("weights") if self.weight_dtype == "int8"
+            else None,
+            fused_steps=self.fused_steps)
+        self._kv = progs["init_pages"](num_pages)
+        self._weight_quant = progs["weight_quant"]
+        self._decode_jit = progs["decode"]
+        self._prefill_jit = progs["prefill"]
+        self._lane_set_jit = progs["lane_set"]
+        self._row_set_jit = progs["row_set"]
+        self._fused_jit = progs["fused"]
+        self._scale_reset_jit = progs["scale_reset"]
+        self._page_gather_jit = progs["page_gather"]
+        self._page_put_jit = progs["page_put"]
+        # chaos-injection key for the "engine.step" site (the frontend
+        # sets this to the owning replica's id so fault schedules count
+        # per replica instead of racing across pump threads)
+        self.chaos_key: Optional[str] = None
 
         # device-resident decode state (grown/rebuilt lazily)
         self._tokens = None              # [bucket] int32
@@ -314,19 +416,22 @@ class ServingEngine:
         prompt = self.check_request(prompt, max_new_tokens)
         req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
                       request_id=request_id or "", deadline=deadline)
+        self._check_not_live(req.request_id)
+        self.scheduler.add(req)
+        return req.request_id
+
+    def _check_not_live(self, request_id: str):
         # a duplicate id would alias two live sequences onto one KV page
         # table (cross-contaminated attention, double-free) — reject it
-        live = (req.request_id in self.outputs
-                or any(r.request_id == req.request_id
+        live = (request_id in self.outputs
+                or any(r.request_id == request_id
                        for r in self.scheduler.waiting)
-                or any(s.seq_id == req.request_id
+                or any(s.seq_id == request_id
                        for s in self.scheduler.running))
         if live:
             raise ValueError(
-                f"request_id {req.request_id!r} is already in flight or "
+                f"request_id {request_id!r} is already in flight or "
                 "has an unconsumed output")
-        self.scheduler.add(req)
-        return req.request_id
 
     # --- abort ------------------------------------------------------------
     def abort(self, request_id: str) -> bool:
@@ -387,6 +492,150 @@ class ServingEngine:
         ``outputs``."""
         out, self._expired = self._expired, []
         return out
+
+    # --- checkpoint / warm failover (docs/SERVING.md "Resilience") --------
+    def kv_mode(self) -> str:
+        """The snapshot-contract mode of this engine's KV pools."""
+        if self.kv_cache_dtype != "int8":
+            return "native"
+        return "int8_dynamic" if self._kv_dynamic else "int8_static"
+
+    def snapshot(self, request_id: str) -> Optional[EngineSnapshot]:
+        """Checkpoint one RUNNING request: consumed tokens + the KV pages
+        covering them, portable to ``restore()`` on another engine built
+        from the same model/config.  Returns None when the id is not
+        currently decoding (queued / preempted-back-to-queue / finished
+        — the caller keeps its previous snapshot).
+
+        Consistency: ``generated`` is the CONSUMED stream (what the
+        token_callback has emitted); the pages may additionally contain
+        writes from a still-in-flight dispatch — harmless, the resumed
+        decode deterministically rewrites every position >= ``pos``.
+        Call from the thread that drives ``step()`` (the pump thread).
+        """
+        seq = next((s for s in self.scheduler.running
+                    if s.seq_id == request_id and not s.done), None)
+        if seq is None:
+            return None
+        g = len(seq.generated)
+        pos = seq.request.prompt.size - 1 + g
+        need = self.cache.pages_needed(pos)
+        rows = self.cache.seq_page_ids(request_id)[:need]
+        pages: Dict[str, List[np.ndarray]] = {"k": [], "v": []}
+        mode = self.kv_mode()
+        if rows:
+            padded = np.zeros((next_pow2(len(rows)),), np.int32)
+            padded[: len(rows)] = rows
+            got = jax.device_get(
+                self._page_gather_jit(self._kv, jax.device_put(padded)))
+            R = len(rows)
+            if mode == "int8_dynamic":
+                # dynamic per-page scales are device state owned by the
+                # donor pool: store DEQUANTIZED pages (restore re-derives
+                # abs-max scales — the documented contract).  The pinned
+                # kv_cache reference fns ARE the quantization contract —
+                # snapshot/restore reuse them so the math lives once.
+                for side in ("k", "v"):
+                    for q, s in zip(got[side], got[f"{side}_scale"]):
+                        pages[side].append(np.stack(
+                            [dequantize_kv_page(np.asarray(q[i]),
+                                                np.asarray(s[i]))
+                             for i in range(R)]))
+            else:
+                for side in ("k", "v"):
+                    pages[side] = [np.asarray(p[:R]) for p in got[side]]
+        snap = EngineSnapshot(
+            request_id=request_id, prompt=seq.request.prompt,
+            max_new_tokens=seq.request.max_new_tokens,
+            deadline=seq.request.deadline,
+            generated=np.asarray(seq.generated, np.int32), pos=int(pos),
+            kv_mode=mode, page_size=self.page_size, pages=pages)
+        self.metrics.on_snapshot(snap.nbytes)
+        return snap
+
+    def restore(self, snap: EngineSnapshot) -> str:
+        """Re-admit a snapshotted request MID-STREAM: enqueues a resume
+        request whose admission uploads the snapshot's KV pages instead
+        of prefilling, then decoding continues from ``snap.pos`` — token
+        callbacks fire from index ``snap.num_generated`` onward.  The
+        deadline rides along unchanged (failover never extends an SLO).
+        Raises ValueError on geometry/mode mismatch or a live duplicate
+        id."""
+        if snap.page_size != self.page_size:
+            raise ValueError(
+                f"snapshot page_size {snap.page_size} != engine "
+                f"page_size {self.page_size}")
+        if snap.kv_mode != self.kv_mode():
+            raise ValueError(
+                f"snapshot kv_mode {snap.kv_mode!r} != engine kv_mode "
+                f"{self.kv_mode()!r} — snapshots are portable only "
+                "between replicas of one serving configuration")
+        prompt = self.check_request(snap.prompt, snap.max_new_tokens)
+        self._check_not_live(snap.request_id)
+        req = Request(prompt=prompt,
+                      max_new_tokens=int(snap.max_new_tokens),
+                      request_id=snap.request_id, deadline=snap.deadline,
+                      resume=snap)
+        self.scheduler.add(req)
+        return req.request_id
+
+    def _upload_snapshot(self, seq: Sequence):
+        """Admission path for a resume request: scatter the snapshot's
+        page payloads into the freshly allocated physical pages (the
+        restore-side of the snapshot contract; replaces prefill)."""
+        snap = seq.request.resume
+        rows = self.cache.seq_page_ids(seq.seq_id)
+        if not rows:
+            return                       # 1-token prompt, 0 tokens in
+        R = len(rows)
+        payload = {}
+        if snap.kv_mode == "int8_dynamic":
+            # re-derive fresh abs-max scales from the dequantized pages
+            # and requantize (via the pinned kv_cache reference fns —
+            # the quantization contract lives in one place) — the
+            # restored pool's scales then depend only on this
+            # sequence's content, preserving the dynamic mode's
+            # page-reuse-independence invariant
+            for side in ("k", "v"):
+                qs, ss = [], []
+                for page_fp in snap.pages[side]:        # [R, P, H, D]
+                    pairs = [quantize_kv_page(page_fp[i])
+                             for i in range(len(page_fp))]
+                    qs.append(np.stack([q for q, _ in pairs]))
+                    ss.append(np.stack([s for _, s in pairs]
+                                       ).astype(np.float32))
+                payload[side] = qs
+                payload[f"{side}_scale"] = ss
+        else:
+            dt = np.int8 if snap.kv_mode == "int8_static" else None
+            for side in ("k", "v"):
+                payload[side] = [np.asarray(p, dt) if dt else p
+                                 for p in snap.pages[side]]
+        Rp = next_pow2(R)
+        rows_np = np.zeros((Rp,), np.int32)
+        rows_np[:R] = rows
+        dev = {}
+        for key, arrs in payload.items():
+            padded = []
+            for a in arrs:
+                if Rp != R:
+                    a = np.concatenate(
+                        [a, np.zeros((Rp - R,) + a.shape[1:], a.dtype)])
+                padded.append(jax.device_put(a))
+            dev[key] = padded
+        if snap.kv_mode == "native":
+            # pools carry the model dtype (e.g. bf16) — cast on device
+            model_dt = self._kv["k"][0].dtype
+            dev["k"] = [a.astype(model_dt) for a in dev["k"]]
+            dev["v"] = [a.astype(model_dt) for a in dev["v"]]
+        self._kv = self._page_put_jit(self._kv, jax.device_put(rows_np),
+                                      dev)
+        if snap.num_generated:
+            # TTFT already happened on the donor replica — a resumed
+            # request must not re-enter the TTFT histogram
+            self._ttft_recorded.add(seq.seq_id)
+            seq.first_token_time = snap.created_at
+        self.metrics.on_restore()
 
     # --- device-resident lane state ---------------------------------------
     def _grow_state(self, new_bucket: int):
@@ -640,8 +889,15 @@ class ServingEngine:
     # --- one scheduler iteration -----------------------------------------
     def step(self) -> dict:
         """Admit + prefill waiting requests, then dispatch one decode
-        program and consume the previous one.  Returns the step's stats."""
+        program and consume the previous one.  Returns the step's stats.
+
+        Chaos site ``engine.step``: ``delay`` injects artificial step
+        latency (a straggler — inside the timed window, so the watchdog
+        and ``serving.step_latency_ms`` both see it), ``raise`` throws
+        InternalError mid-step (the frontend treats an engine-step
+        exception as a replica crash and fails its requests over)."""
         t_step = time.perf_counter()
+        chaos_site("engine.step", key=self.chaos_key)
         with RecordEvent("serving/step"):
             return self._step_inner(t_step)
 
@@ -674,7 +930,12 @@ class ServingEngine:
                 # freshly allocated pages must quantize from scratch
                 # (dynamic int8 mode; no-op otherwise)
                 self._reset_page_scales(self.cache.seq_page_ids(seq.seq_id))
-                self._prefill_seq(seq)
+                if seq.request.resume is not None:
+                    # warm-failover resume: upload checkpoint pages
+                    # instead of prefilling — decode continues mid-stream
+                    self._upload_snapshot(seq)
+                else:
+                    self._prefill_seq(seq)
                 self._bind_lane(seq)
             self.metrics.on_admission(len(admitted))
 

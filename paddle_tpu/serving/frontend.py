@@ -15,14 +15,26 @@ the ROADMAP's "heavy traffic" north star needs:
 - a **Router** places each request on the healthy replica with the
   least outstanding tokens, and its deterministic fault-injection hook
   kills a replica mid-decode: the frontend requeues the dead replica's
-  live requests onto survivors — streams restart from token 0 with
-  ``retried`` set (greedy decode is deterministic, so the retried
-  stream is byte-identical to the one the dead replica would have
-  produced);
+  live requests onto survivors — with **warm failover** (periodic
+  per-request engine snapshots every ``snapshot_interval`` tokens) the
+  stream RESUMES from the last checkpoint (``resumed_from`` set, at
+  most K tokens recomputed); without a checkpoint it restarts from
+  token 0.  Either way ``retried`` flips and the final stream is
+  byte-identical to the uninterrupted one (greedy decode is
+  deterministic; int8-dynamic KV resumes are exact-within-quantization
+  — see docs/SERVING.md "Resilience");
 - **admission control**: a bounded live-request cap rejects on
   overload, and per-request deadlines are enforced at submit time, in
   the frontend queue, in the engine queue, and mid-decode (aborted,
-  pages freed).
+  pages freed);
+- **watchdog** (opt-in): a monitor thread detects overdue/hung engine
+  steps against a rolling-p99 threshold, pulls the replica from the
+  routing pool (SUSPECT, exponential backoff before re-admission) and
+  declares it dead past the hang timeout — its requests fail over;
+- **overload brownout** (opt-in): under sustained queue pressure the
+  frontend degrades in stages — shed lowest-deadline-slack queued
+  requests, then clamp ``max_new_tokens``, then reject — instead of a
+  cliff-edge 429 wall (``serving.brownout_stage`` gauge).
 
 Threading model (docs/SERVING.md "Frontend & deployment")
 ---------------------------------------------------------
@@ -45,9 +57,16 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from ..framework.errors import (DeadlineExceededError, InternalError,
+                                InvalidArgumentError,
+                                ResourceExhaustedError, UnavailableError)
+from ..testing.chaos import chaos_site
 from .engine import ServingEngine
 from .metrics import FrontendMetrics, ServingMetrics
-from .router import DEAD, Replica, Router
+from .resilience import (BROWNOUT_CLAMP, BROWNOUT_REJECT, BROWNOUT_SHED,
+                         BrownoutController, BrownoutPolicy, Watchdog,
+                         WatchdogConfig)
+from .router import DEAD, HEALTHY, SUSPECT, Replica, Router
 
 __all__ = ["ResponseHandle", "ServingFrontend", "create_serving_frontend",
            "QUEUED", "RUNNING", "COMPLETED", "REJECTED", "CANCELLED",
@@ -62,6 +81,16 @@ DEADLINE_MISS = "deadline_miss"
 FAILED = "failed"
 TERMINAL_STATUSES = frozenset(
     {COMPLETED, REJECTED, CANCELLED, DEADLINE_MISS, FAILED})
+
+# default error class per non-completed terminal status — the typed
+# taxonomy (framework.errors) every HTTP status code derives from;
+# resolvers may override per-outcome (e.g. brownout rejections carry
+# UnavailableError → 503 instead of the queue_cap ResourceExhausted 429)
+_STATUS_ERROR = {
+    REJECTED: ResourceExhaustedError,
+    DEADLINE_MISS: DeadlineExceededError,
+    FAILED: InternalError,
+}
 
 
 class ResponseHandle:
@@ -85,11 +114,18 @@ class ResponseHandle:
         self.deadline = deadline          # absolute monotonic or None
         self.submit_time = time.monotonic()
         self.retried = False
+        # warm failover: token index the stream resumed from after the
+        # last replica failure (None = never resumed from a checkpoint;
+        # tokens < resumed_from were decoded by the dead replica and
+        # were NOT recomputed)
+        self.resumed_from: Optional[int] = None
         self._frontend = frontend
         self._tokens: List[int] = []
         self._status = QUEUED
         self._detail = ""
+        self._error_cls: Optional[type] = None
         self._stream_epoch = 0            # bumps on failover restart
+        self._resume_pending = False      # events() owes a resume marker
         self._first_token_time: Optional[float] = None
         self._finish_time: Optional[float] = None
 
@@ -110,10 +146,10 @@ class ResponseHandle:
             self._cond.notify_all()
 
     def _on_retry(self):
-        """Replica failure: drop the dead replica's partial stream and
-        restart from token 0 on a survivor.  TTFT keeps the FIRST token
-        the client ever saw (the wire truth), even though the stream
-        restarts."""
+        """Replica failure with NO usable checkpoint: drop the dead
+        replica's partial stream and restart from token 0 on a survivor.
+        TTFT keeps the FIRST token the client ever saw (the wire truth),
+        even though the stream restarts."""
         with self._cond:
             if self._status in TERMINAL_STATUSES:
                 return
@@ -123,7 +159,23 @@ class ResponseHandle:
             self._status = QUEUED
             self._cond.notify_all()
 
-    def _finish(self, status: str, tokens=None, detail: str = "") -> bool:
+    def _on_resume(self, from_index: int):
+        """Replica failure WITH a checkpoint: the stream RESUMES — every
+        token already delivered stays valid, the survivor re-decodes
+        only the (< snapshot_interval) tokens past index ``from_index``
+        and the handle splices them seamlessly (greedy determinism).
+        ``events()``/NDJSON surface a ``resume`` marker."""
+        with self._cond:
+            if self._status in TERMINAL_STATUSES:
+                return
+            self.retried = True
+            self.resumed_from = int(from_index)
+            self._resume_pending = True
+            self._status = QUEUED
+            self._cond.notify_all()
+
+    def _finish(self, status: str, tokens=None, detail: str = "",
+                error_cls: Optional[type] = None) -> bool:
         with self._cond:
             if self._status in TERMINAL_STATUSES:
                 return False
@@ -131,6 +183,7 @@ class ResponseHandle:
                 self._tokens = [int(t) for t in np.asarray(tokens).reshape(-1)]
             self._status = status
             self._detail = detail
+            self._error_cls = error_cls or _STATUS_ERROR.get(status)
             self._finish_time = time.monotonic()
             self._cond.notify_all()
             return True
@@ -145,6 +198,14 @@ class ResponseHandle:
     def detail(self) -> str:
         with self._cond:
             return self._detail
+
+    @property
+    def error_cls(self) -> Optional[type]:
+        """The framework.errors class of a non-completed terminal
+        outcome (None while live or on completion) — what the HTTP
+        layer derives its status code from."""
+        with self._cond:
+            return self._error_cls
 
     @property
     def done(self) -> bool:
@@ -218,9 +279,17 @@ class ResponseHandle:
         """Yield stream events in order:
 
         ``("token", index, token)``  one generated token
-        ``("restart",)``             replica failover — the stream
-                                     restarts, following tokens re-index
-                                     from 0 (values identical, greedy)
+        ``("restart",)``             replica failover without a usable
+                                     checkpoint — the stream restarts,
+                                     following tokens re-index from 0
+                                     (values identical, greedy)
+        ``("resume", from_index)``   warm failover — the stream RESUMES:
+                                     tokens already yielded stay valid,
+                                     decoding continues past
+                                     ``from_index`` on a survivor
+                                     (live-stream marker; replays of a
+                                     finished handle expose it via
+                                     ``resumed_from`` instead)
         ``("end", status)``          terminal; always the last event
         """
         epoch = 0
@@ -229,12 +298,17 @@ class ResponseHandle:
             with self._cond:
                 self._cond.wait_for(
                     lambda: self._stream_epoch != epoch
+                    or self._resume_pending
                     or len(self._tokens) > idx
                     or self._status in TERMINAL_STATUSES)
                 restart = self._stream_epoch != epoch
                 if restart:
                     epoch = self._stream_epoch
                     idx = 0
+                resume_idx = None
+                if self._resume_pending:
+                    self._resume_pending = False
+                    resume_idx = self.resumed_from
                 chunk = self._tokens[idx:]
                 base = idx
                 idx += len(chunk)
@@ -244,6 +318,8 @@ class ResponseHandle:
                          and len(self._tokens) == idx)
             if restart:
                 yield ("restart",)
+            if resume_idx is not None:
+                yield ("resume", int(resume_idx))
             for j, tok in enumerate(chunk):
                 yield ("token", base + j, int(tok))
             if ended:
@@ -264,7 +340,9 @@ class _Entry:
     """Frontend bookkeeping for one live (non-terminal) request."""
 
     __slots__ = ("handle", "prompt", "max_new_tokens", "cost", "replica",
-                 "in_engine", "cancel_requested")
+                 "in_engine", "cancel_requested", "shed_requested",
+                 "snapshot", "snap_tokens", "recover_started",
+                 "tokens_at_failover")
 
     def __init__(self, handle: ResponseHandle, prompt: np.ndarray,
                  max_new_tokens: int, replica: Replica):
@@ -276,6 +354,15 @@ class _Entry:
         self.replica = replica
         self.in_engine = False
         self.cancel_requested = False
+        self.shed_requested = False
+        # warm-failover state: the last EngineSnapshot taken for this
+        # request (refreshed every snapshot_interval consumed tokens)
+        self.snapshot = None
+        self.snap_tokens = 0              # generated count at last snapshot
+        # failover-recovery timing: set at kill time, cleared when the
+        # survivor delivers the first NEW token
+        self.recover_started: Optional[float] = None
+        self.tokens_at_failover = 0
 
 
 class ServingFrontend:
@@ -294,7 +381,25 @@ class ServingFrontend:
                  engine_kwargs: Optional[dict] = None,
                  engine_factory=None,
                  metrics: Optional[FrontendMetrics] = None,
-                 poll_interval_s: float = 0.005):
+                 poll_interval_s: float = 0.005,
+                 snapshot_interval: Optional[int] = 16,
+                 watchdog=None,
+                 brownout=None,
+                 placement_attempts: int = 4,
+                 placement_backoff_s: float = 0.02):
+        """Resilience knobs (docs/SERVING.md "Resilience"):
+
+        - ``snapshot_interval``: checkpoint each in-flight request every
+          K consumed tokens so failover resumes from the checkpoint
+          instead of token 0 (None disables — failover restarts).
+        - ``watchdog``: True / a WatchdogConfig enables the hung-step
+          monitor thread (suspect → backoff → re-admit, dead → failover).
+        - ``brownout``: True / a BrownoutPolicy enables staged overload
+          degradation (shed lowest-slack → clamp budgets → reject).
+        - ``placement_attempts`` / ``placement_backoff_s``: bounded
+          retry-with-backoff for transient no-routable-replica
+          placement failures (router.pick_with_retry).
+        """
         if model is None and engine_factory is None:
             raise ValueError("pass a model or an engine_factory")
         if engine_factory is not None and engine_kwargs:
@@ -325,10 +430,35 @@ class ServingFrontend:
                 eng.metrics = self.engine_metrics
                 return eng
 
-        self.router = Router()
+        self.router = Router(metrics=self.engine_metrics)
         self.queue_cap = None if queue_cap is None else int(queue_cap)
         self.default_deadline_ms = default_deadline_ms
         self._poll_interval = float(poll_interval_s)
+        self.snapshot_interval = (None if snapshot_interval is None
+                                  else max(1, int(snapshot_interval)))
+        self._placement_attempts = max(1, int(placement_attempts))
+        self._placement_backoff = float(placement_backoff_s)
+        # watchdog: False/None = off; True = defaults; or a config.
+        # Anything else truthy raises — silently swapping an operator's
+        # dict of thresholds for the defaults would leave them believing
+        # tighter SLOs are active
+        self.watchdog: Optional[Watchdog] = None
+        if watchdog:
+            if watchdog is not True and not isinstance(watchdog,
+                                                       WatchdogConfig):
+                raise TypeError("watchdog must be True or a "
+                                f"WatchdogConfig, got {watchdog!r}")
+            self.watchdog = Watchdog(
+                watchdog if isinstance(watchdog, WatchdogConfig) else None)
+        # brownout: False/None = off; True = defaults; or a policy
+        self.brownout: Optional[BrownoutController] = None
+        if brownout:
+            if brownout is not True and not isinstance(brownout,
+                                                       BrownoutPolicy):
+                raise TypeError("brownout must be True or a "
+                                f"BrownoutPolicy, got {brownout!r}")
+            self.brownout = BrownoutController(
+                brownout if isinstance(brownout, BrownoutPolicy) else None)
         self._lock = threading.RLock()
         self._live: Dict[str, _Entry] = {}
         self._closing = False
@@ -341,6 +471,9 @@ class ServingFrontend:
             rep.engine.token_callback = (
                 lambda rid, idx, tok, rep=rep:
                 self._emit(rep, rid, idx, tok))
+            # chaos "engine.step" faults count per replica, not per
+            # whoever's pump thread raced first
+            rep.engine.chaos_key = rep.id
             self.router.add(rep)
             self._replicas.append(rep)
         for rep in self._replicas:
@@ -348,6 +481,11 @@ class ServingFrontend:
                                  name=f"serving-pump-{rep.id}", daemon=True)
             rep.thread = t
             t.start()
+        self._monitor_thread = None
+        if self.watchdog is not None:
+            self._monitor_thread = threading.Thread(
+                target=self._monitor, name="serving-watchdog", daemon=True)
+            self._monitor_thread.start()
 
     # --- submission ---------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32,
@@ -366,6 +504,19 @@ class ServingFrontend:
             deadline_ms = self.default_deadline_ms
         deadline = (None if deadline_ms is None
                     else time.monotonic() + float(deadline_ms) / 1e3)
+        # brownout: evaluate queue pressure at every submission; stage 2+
+        # clamps the budget BEFORE validation/handle creation (the
+        # degraded service the caller actually gets), stage 3 rejects in
+        # the admission block below
+        stage = 0
+        if self.brownout is not None:
+            with self._lock:
+                stage = self.brownout.evaluate(self._pressure_locked())
+            if stage >= BROWNOUT_CLAMP:
+                cap = self.brownout.policy.clamp_max_new_tokens
+                if max_new_tokens > cap:
+                    max_new_tokens = cap
+                    self.metrics.on_brownout_clamp()
         with self._lock:
             probe = next((r.engine for r in self._replicas
                           if r.state != DEAD), None)
@@ -375,6 +526,7 @@ class ServingFrontend:
             prompt = np.asarray(prompt, np.int32).reshape(-1)
         rid = request_id or f"fr-{next(self._rid)}"
         handle = ResponseHandle(rid, max_new_tokens, deadline, self)
+        cost = int(prompt.size) + int(max_new_tokens)
         with self._lock:
             if rid in self._live:
                 raise ValueError(f"request_id {rid!r} is already live")
@@ -385,6 +537,11 @@ class ServingFrontend:
             self.metrics.on_submit()
             if self._closing:
                 return self._reject_locked(handle, "frontend is closing")
+            if stage >= BROWNOUT_REJECT:
+                self.metrics.on_brownout_reject()
+                return self._reject_locked(
+                    handle, "brownout stage 3: sustained overload — "
+                    "retry later", error_cls=UnavailableError)
             if (self.queue_cap is not None
                     and len(self._live) >= self.queue_cap):
                 return self._reject_locked(
@@ -395,20 +552,116 @@ class ServingFrontend:
                                detail="deadline expired at submit")
                 self.metrics.on_deadline_miss()
                 return handle
-            rep = self.router.pick(cost=prompt.size + max_new_tokens)
+            rep = self.router.pick(cost=cost)
+            if rep is not None:
+                self._place_locked(handle, prompt, max_new_tokens, rep)
+                if stage >= BROWNOUT_SHED:
+                    self._shed_lowest_slack_locked(
+                        exclude=handle.request_id)
+                return handle
+            retryable = any(r.state in (HEALTHY, SUSPECT)
+                            for r in self._replicas)
+            if not retryable or self._placement_attempts <= 1:
+                # same taxonomy as the post-backoff rejection below: no
+                # healthy replica is Unavailable (503), not overload
+                return self._reject_locked(handle, "no healthy replica",
+                                           error_cls=UnavailableError)
+        # transient no-routable-replica (e.g. every replica SUSPECT
+        # while a watchdog backoff elapses): bounded retry-with-backoff
+        # OUTSIDE the frontend lock — other submissions/pumps proceed
+        rep = self.router.pick_with_retry(
+            cost=cost, attempts=self._placement_attempts,
+            backoff_s=self._placement_backoff, deadline=deadline)
+        with self._lock:
+            if self._closing:
+                return self._reject_locked(handle, "frontend is closing")
+            if rep is not None and rep.state == DEAD:
+                # the pick happened outside our lock: the replica may
+                # have died (and had its inbox cleared + victims
+                # collected) before we re-acquired it — placing there
+                # would strand the entry forever.  One locked re-pick
+                # closes the window.
+                rep = self.router.pick(cost=cost)
             if rep is None:
-                return self._reject_locked(handle, "no healthy replica")
-            entry = _Entry(handle, prompt, max_new_tokens, rep)
-            self._live[rid] = entry
-            self.router.charge(rep, entry.cost)
-            rep.inbox.append(entry)
-            rep.wake.set()
-            self._update_depth_gauges_locked()
+                return self._reject_locked(
+                    handle, "no healthy replica (after bounded "
+                    "retry-with-backoff)", error_cls=UnavailableError)
+            if rid in self._live:
+                # an explicit request_id raced another live submission
+                # while the lock was dropped; rejecting (not raising)
+                # keeps submitted == sum(terminal statuses)
+                return self._reject_locked(
+                    handle, f"request_id {rid!r} is already live")
+            if (self.queue_cap is not None
+                    and len(self._live) >= self.queue_cap):
+                # other submissions may have filled the cap while this
+                # one slept in the backoff — re-check so the live-set
+                # bound (and the pressure signal built on it) holds
+                return self._reject_locked(
+                    handle,
+                    f"queue_cap {self.queue_cap} live requests reached")
+            self._place_locked(handle, prompt, max_new_tokens, rep)
+            if stage >= BROWNOUT_SHED:
+                self._shed_lowest_slack_locked(exclude=handle.request_id)
         return handle
 
-    def _reject_locked(self, handle: ResponseHandle,
-                       detail: str) -> ResponseHandle:
-        handle._finish(REJECTED, detail=detail)
+    def _place_locked(self, handle: ResponseHandle, prompt: np.ndarray,
+                      max_new_tokens: int, rep: Replica):
+        entry = _Entry(handle, prompt, max_new_tokens, rep)
+        self._live[handle.request_id] = entry
+        self.router.charge(rep, entry.cost)
+        rep.inbox.append(entry)
+        rep.wake.set()
+        self._update_depth_gauges_locked()
+
+    def _pressure_locked(self) -> float:
+        """Queue pressure in [0, 1]: live requests over queue_cap (an
+        uncapped frontend reports 0 — brownout needs a capacity notion)."""
+        if self.queue_cap is None or self.queue_cap <= 0:
+            return 0.0
+        return len(self._live) / float(self.queue_cap)
+
+    def _shed_lowest_slack_locked(self, exclude: Optional[str] = None):
+        """Brownout stage 1+: shed the live not-yet-decoding request
+        with the LOWEST deadline slack (deadline - now; no deadline =
+        infinite slack) — the request least likely to meet its SLO, so
+        its tokens would be wasted work.  One shed per triggering
+        submission; deterministic tie-break by request id.  ``exclude``
+        shields the triggering arrival itself: shedding targets the
+        BACKLOG (an arrival the backlog can't absorb is handled by the
+        clamp/reject stages, not by admitting-then-shedding it)."""
+        now = time.monotonic()
+        cands = [e for e in self._live.values()
+                 if e.handle.num_tokens == 0 and not e.cancel_requested
+                 and not e.shed_requested
+                 and e.handle.request_id != exclude]
+        if not cands:
+            return
+
+        def slack(e):
+            d = e.handle.deadline
+            return (float("inf") if d is None else d - now,
+                    e.handle.request_id)
+
+        victim = min(cands, key=slack)
+        self.metrics.on_brownout_shed()
+        rep = victim.replica
+        if not victim.in_engine and victim in rep.inbox:
+            rep.inbox.remove(victim)
+            victim.shed_requested = True
+            # resolve outside the inbox but inside our lock scope is
+            # fine — _resolve re-enters the RLock
+            self._resolve(victim, REJECTED,
+                          "brownout shed (lowest deadline slack)",
+                          error_cls=UnavailableError)
+        else:
+            victim.shed_requested = True
+            rep.sheds.append(victim)
+            rep.wake.set()
+
+    def _reject_locked(self, handle: ResponseHandle, detail: str,
+                       error_cls: Optional[type] = None) -> ResponseHandle:
+        handle._finish(REJECTED, detail=detail, error_cls=error_cls)
         self.metrics.on_reject()
         return handle
 
@@ -452,6 +705,8 @@ class ServingFrontend:
             hz["closing"] = self._closing
         hz["status"] = ("ok" if hz["healthy_replicas"] > 0 and
                         not hz["closing"] else "unhealthy")
+        hz["brownout_stage"] = (0 if self.brownout is None
+                                else self.brownout.stage)
         return hz
 
     def stats(self) -> dict:
@@ -460,6 +715,13 @@ class ServingFrontend:
             "frontend": self.metrics.snapshot(),
             "engines": self.engine_metrics.snapshot(),
             "router": self.router.healthz(),
+            "resilience": {
+                "snapshot_interval": self.snapshot_interval,
+                "watchdog_enabled": self.watchdog is not None,
+                "brownout_enabled": self.brownout is not None,
+                "brownout_stage": (None if self.brownout is None
+                                   else self.brownout.stage),
+            },
         }
 
     def close(self, timeout: float = 30.0):
@@ -473,6 +735,8 @@ class ServingFrontend:
         for rep in reps:
             if rep.thread is not None:
                 rep.thread.join(timeout)
+        if self._monitor_thread is not None:
+            self._monitor_thread.join(timeout)
         with self._lock:
             leftovers = list(self._live.values())
         for entry in leftovers:
@@ -491,6 +755,13 @@ class ServingFrontend:
             if entry is None or entry.replica is not rep:
                 return
             handle = entry.handle
+            if (entry.recover_started is not None
+                    and idx >= entry.tokens_at_failover):
+                # first NEW token since the kill: the survivor has
+                # caught up past everything the client already had
+                self.engine_metrics.on_failover_recovery(
+                    time.monotonic() - entry.recover_started)
+                entry.recover_started = None
         handle._on_token(idx, tok)
 
     def _entry_for(self, rep: Replica, rid: str) -> Optional[_Entry]:
@@ -506,7 +777,7 @@ class ServingFrontend:
             sum(1 for e in self._live.values() if not e.in_engine))
 
     def _resolve(self, entry: _Entry, status: str, detail: str = "",
-                 tokens=None) -> bool:
+                 tokens=None, error_cls: Optional[type] = None) -> bool:
         """Move one live request to a terminal state exactly once."""
         rid = entry.handle.request_id
         with self._lock:
@@ -516,7 +787,7 @@ class ServingFrontend:
             self.router.discharge(entry.replica, entry.cost)
             self._update_depth_gauges_locked()
         finished = entry.handle._finish(status, tokens=tokens,
-                                        detail=detail)
+                                        detail=detail, error_cls=error_cls)
         if finished:
             h = entry.handle
             if status == COMPLETED:
@@ -533,14 +804,21 @@ class ServingFrontend:
 
     def _pump(self, rep: Replica):
         """One replica's drive loop (the ONLY thread touching its
-        engine): intake → cancellations → one engine step → harvest
-        expiries/completions → failure-injection check."""
+        engine): intake (add or snapshot-restore) → cancellations →
+        brownout sheds → one engine step (crash-contained, watchdog-
+        probed) → harvest expiries/completions → periodic snapshots →
+        chaos / failure-injection checks."""
         eng = rep.engine
         while True:
             with self._lock:
                 closing = self._closing
                 work, rep.inbox = rep.inbox, []
                 cancels, rep.cancels = rep.cancels, []
+                sheds, rep.sheds = rep.sheds, []
+                if self.brownout is not None:
+                    # pressure falls as requests finish — keep the stage
+                    # tracking reality between submissions too
+                    self.brownout.evaluate(self._pressure_locked())
             if rep.state == DEAD:
                 break
             now = time.monotonic()
@@ -554,22 +832,65 @@ class ServingFrontend:
                                   "expired in frontend queue")
                     continue
                 try:
-                    eng.add_request(entry.prompt, entry.max_new_tokens,
-                                    request_id=h.request_id,
-                                    deadline=h.deadline)
+                    if entry.snapshot is not None:
+                        # warm failover: resume mid-stream from the
+                        # checkpoint.  The deadline is the handle's
+                        # ABSOLUTE submit-time SLO — a requeue after
+                        # replica death must never extend it
+                        entry.snapshot.deadline = h.deadline
+                        eng.restore(entry.snapshot)
+                    else:
+                        eng.add_request(entry.prompt,
+                                        entry.max_new_tokens,
+                                        request_id=h.request_id,
+                                        deadline=h.deadline)
                     with self._lock:
                         entry.in_engine = True
                 except ValueError as e:
-                    self._resolve(entry, FAILED, str(e))
+                    # a fresh request failing validation is the caller's
+                    # fault (400); a snapshot failing to restore is an
+                    # internal failover/configuration fault (500) — the
+                    # client's original request was valid
+                    self._resolve(entry, FAILED, str(e),
+                                  error_cls=(InternalError
+                                             if entry.snapshot is not None
+                                             else InvalidArgumentError))
             for entry in cancels:
                 if eng.abort(entry.handle.request_id):
                     self._resolve(entry, CANCELLED)
                 # else: it finished first — the outputs harvest owns it
+            for entry in sheds:
+                if eng.abort(entry.handle.request_id):
+                    self._resolve(entry, REJECTED,
+                                  "brownout shed (lowest deadline slack)",
+                                  error_cls=UnavailableError)
+                # else: it finished first — the outputs harvest owns it
             if eng.scheduler.has_work() or eng._pending:
-                eng.step()
+                rep.step_started = time.monotonic()
+                try:
+                    eng.step()
+                except Exception as e:  # noqa: BLE001 — crash containment
+                    # an engine-step exception is a replica crash: the
+                    # engine's device state is suspect, so the replica
+                    # is retired and its requests fail over (resuming
+                    # from their snapshots where one exists)
+                    rep.step_started = None
+                    self._kill(rep, f"engine step raised "
+                                    f"{type(e).__name__}: {e}")
+                    break
+                t_done = time.monotonic()
+                step_s = t_done - rep.step_started
+                rep.step_started = None
                 rep.steps += 1
-                rep.last_step_time = time.monotonic()
+                rep.last_step_time = t_done
+                if self.watchdog is not None:
+                    self.watchdog.observe_step(rep.id, step_s)
                 self._harvest(rep, eng)
+                self._maybe_snapshot(rep, eng)
+                fault = chaos_site("replica.kill", key=rep.id)
+                if fault is not None and fault.action == "kill":
+                    self._kill(rep, f"chaos kill at step {rep.steps}")
+                    break
                 if (rep.fail_at_step is not None
                         and rep.steps >= rep.fail_at_step):
                     self._kill(rep,
@@ -580,6 +901,28 @@ class ServingFrontend:
             else:
                 rep.wake.wait(self._poll_interval)
                 rep.wake.clear()
+
+    def _maybe_snapshot(self, rep: Replica, eng: ServingEngine):
+        """Checkpoint every request on ``rep`` that consumed
+        ``snapshot_interval`` tokens since its last snapshot — the warm
+        failover freshness bound (≤ K tokens ever need recomputing)."""
+        if self.snapshot_interval is None:
+            return
+        k = self.snapshot_interval
+        with self._lock:
+            due = [e for e in self._live.values()
+                   if e.replica is rep and e.in_engine
+                   and not e.cancel_requested and not e.shed_requested
+                   and e.handle.num_tokens - e.snap_tokens >= k]
+        for entry in due:
+            snap = eng.snapshot(entry.handle.request_id)
+            if snap is None:
+                continue          # finished/preempted meanwhile — keep old
+            with self._lock:
+                if (self._live.get(entry.handle.request_id) is entry
+                        and entry.replica is rep):
+                    entry.snapshot = snap
+                    entry.snap_tokens = snap.num_generated
 
     def _harvest(self, rep: Replica, eng: ServingEngine):
         for rid in eng.take_expired():
@@ -593,15 +936,29 @@ class ServingFrontend:
                 self._resolve(entry, COMPLETED, tokens=toks)
 
     def _kill(self, rep: Replica, reason: str):
-        """Simulated crash: mark the replica dead and fail its live
-        requests over to survivors — streams restart from token 0 with
-        ``retried`` set; with no survivor they terminate ``failed``."""
+        """Replica crash (injected, chaos, engine-step exception, or
+        watchdog hang): mark it dead and fail its live requests over to
+        survivors.  A request with a checkpoint RESUMES mid-stream from
+        it (``resumed_from`` set, ≤ snapshot_interval tokens recomputed);
+        without one the stream restarts from token 0.  Placement uses
+        bounded retry-with-backoff (a transient all-SUSPECT fleet is not
+        a terminal failure); with no survivor at all the request
+        terminates ``failed``."""
+        with self._lock:
+            # exactly-once: the watchdog declaring a hung replica dead
+            # can race the pump's own crash path (the hung step finally
+            # returning into a chaos/injection check) — a second kill
+            # would double-requeue the same victims
+            if rep.kill_claimed:
+                return
+            rep.kill_claimed = True
         self.router.mark_dead(rep, reason)
         with self._lock:
             victims = [e for e in self._live.values()
                        if e.replica is rep]
             rep.inbox.clear()
             rep.cancels.clear()
+            rep.sheds.clear()
         now = time.monotonic()
         for entry in victims:
             h = entry.handle
@@ -609,18 +966,39 @@ class ServingFrontend:
                 self._resolve(entry, CANCELLED,
                               "cancelled during failover")
                 continue
+            if entry.shed_requested:
+                # a brownout shed pending in the dead replica's sheds
+                # list was already counted — honor it here instead of
+                # silently failing the request over (which would keep
+                # it running, uncheckpointed, despite the accounting)
+                self._resolve(entry, REJECTED,
+                              "brownout shed (lowest deadline slack)",
+                              error_cls=UnavailableError)
+                continue
             if h.deadline is not None and now >= h.deadline:
                 self._resolve(entry, DEADLINE_MISS,
                               "expired during failover")
                 continue
-            target = self.router.pick(cost=entry.cost)
+            target = self.router.pick_with_retry(
+                cost=entry.cost, attempts=self._placement_attempts,
+                backoff_s=self._placement_backoff, deadline=h.deadline)
             if target is None:
                 self._resolve(
                     entry, FAILED,
                     f"replica {rep.id} died ({reason}); no healthy "
-                    "survivor to retry on")
+                    "survivor to retry on", error_cls=UnavailableError)
                 continue
-            h._on_retry()
+            snap = entry.snapshot
+            with self._lock:
+                entry.tokens_at_failover = h.num_tokens
+                entry.recover_started = time.monotonic()
+            if snap is not None:
+                h._on_resume(snap.num_generated)
+                # tokens before the checkpoint are NOT re-decoded — the
+                # warm-failover win vs a token-0 restart
+                self.metrics.on_recompute_saved(snap.num_generated)
+            else:
+                h._on_retry()
             self.metrics.on_retry()
             with self._lock:
                 self.router.discharge(rep, entry.cost)
@@ -632,6 +1010,44 @@ class ServingFrontend:
                 target.inbox.append(entry)
                 target.wake.set()
                 self._update_depth_gauges_locked()
+
+    def _monitor(self):
+        """Watchdog thread: scan replicas for overdue/hung engine steps
+        (suspect → pulled from routing; hung → dead + failover;
+        recovered → re-admitted after exponential backoff)."""
+        wd = self.watchdog
+        interval = wd.config.check_interval_s
+        while True:
+            with self._lock:
+                if self._closing:
+                    return
+            now = time.monotonic()
+            for rep in list(self._replicas):
+                if rep.state == DEAD:
+                    continue
+                try:
+                    verdict = wd.check(rep.id, rep.busy_for(now), now)
+                    if verdict == "suspect":
+                        if self.router.mark_suspect(rep):
+                            self.engine_metrics.on_watchdog_trip()
+                    elif verdict == "dead":
+                        # requeue OFF the monitor thread: _kill blocks
+                        # in pick_with_retry, and this thread is the
+                        # only one that can READMIT the suspect
+                        # survivors that retry may be waiting for
+                        threading.Thread(
+                            target=self._kill,
+                            args=(rep, "watchdog: engine step hung "
+                                  f"beyond {wd.config.hang_timeout_s}s"),
+                            name=f"serving-failover-{rep.id}",
+                            daemon=True).start()
+                    elif verdict == "readmit":
+                        self.router.mark_healthy(rep)
+                except Exception:  # noqa: BLE001 — the watchdog must
+                    # never die silently: a crashed monitor would turn
+                    # every future hang into an unbounded stall
+                    pass
+            time.sleep(interval)
 
 
 def create_serving_frontend(model, config=None, **overrides
@@ -655,7 +1071,9 @@ def create_serving_frontend(model, config=None, **overrides
         fe_kwargs.update(config.frontend_config())
     engine_kwargs.update(overrides.pop("engine_kwargs", {}))
     for key in ("replicas", "queue_cap", "default_deadline_ms",
-                "engine_factory", "metrics", "poll_interval_s"):
+                "engine_factory", "metrics", "poll_interval_s",
+                "snapshot_interval", "watchdog", "brownout",
+                "placement_attempts", "placement_backoff_s"):
         if key in overrides:
             fe_kwargs[key] = overrides.pop(key)
     engine_kwargs.update(overrides)

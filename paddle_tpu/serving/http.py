@@ -33,23 +33,41 @@ import http.server
 import json
 import threading
 
+from ..framework.errors import http_status_for
 from ..profiler.exposition import prometheus_text
-from .frontend import (CANCELLED, COMPLETED, DEADLINE_MISS, FAILED,
-                       REJECTED, ServingFrontend)
+from ..testing.chaos import chaos_site
+from .frontend import CANCELLED, COMPLETED, ServingFrontend
 
 __all__ = ["ServingHTTPServer", "start_http_server"]
 
-_STATUS_HTTP = {COMPLETED: 200, REJECTED: 429, DEADLINE_MISS: 504,
-                CANCELLED: 499, FAILED: 500}
+
+def _http_status(handle) -> int:
+    """HTTP status of a terminal handle, DERIVED from the typed error
+    taxonomy (framework.errors.ERROR_HTTP_STATUS) instead of an ad-hoc
+    per-status table: queue_cap rejection carries ResourceExhausted →
+    429, brownout/no-replica carries Unavailable → 503, deadline_miss
+    carries DeadlineExceeded → 504, failed carries Internal → 500.
+    ``cancelled`` keeps the conventional (non-RFC) 499, ``completed``
+    is 200."""
+    status = handle.status
+    if status == COMPLETED:
+        return 200
+    if status == CANCELLED:
+        return 499
+    err = handle.error_cls
+    return 500 if err is None else http_status_for(err)
 
 
 def _terminal_payload(handle) -> dict:
+    err = handle.error_cls
     return {
         "done": True,
         "request_id": handle.request_id,
         "status": handle.status,
         "detail": handle.detail or None,
+        "error": None if err is None else err.__name__,
         "retried": handle.retried,
+        "resumed_from": handle.resumed_from,
         "num_tokens": handle.num_tokens,
         "ttft_ms": handle.ttft_ms,
         "e2e_ms": handle.e2e_ms,
@@ -108,6 +126,13 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         if path != "/generate":
             self._send_json(404, {"error": f"no route {self.path!r}"})
             return
+        # chaos site "http.request": inject a 5xx before the frontend is
+        # touched (clients must survive transport-level failures too)
+        fault = chaos_site("http.request", key=path)
+        if fault is not None and fault.action == "http_error":
+            self._send_json(fault.status,
+                            {"error": fault.message, "chaos": True})
+            return
         try:
             length = int(self.headers.get("Content-Length", "0"))
             body = json.loads(self.rfile.read(length) or b"{}")
@@ -135,15 +160,15 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             self._send_json(400, {"error": str(e)})
             return
         if not stream:
-            status = handle.wait()
+            handle.wait()
             payload = _terminal_payload(handle)
             payload["tokens"] = [int(t) for t in handle.tokens]
-            self._send_json(_STATUS_HTTP.get(status, 500), payload)
+            self._send_json(_http_status(handle), payload)
             return
         if handle.done and handle.status != COMPLETED:
             # rejected/missed before any token: a plain JSON error beats
             # an empty chunked stream
-            self._send_json(_STATUS_HTTP.get(handle.status, 500),
+            self._send_json(_http_status(handle),
                             _terminal_payload(handle))
             return
         self.send_response(200)
@@ -156,6 +181,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     self._chunk({"token": ev[2], "index": ev[1]})
                 elif ev[0] == "restart":
                     self._chunk({"restart": True})
+                elif ev[0] == "resume":
+                    # warm failover: tokens already streamed stay valid,
+                    # decoding resumed at from_index on a survivor
+                    self._chunk({"resumed": True, "from_index": ev[1]})
                 else:                      # ("end", status)
                     self._chunk(_terminal_payload(handle))
             self._end_chunks()

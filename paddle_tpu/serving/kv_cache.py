@@ -39,6 +39,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..testing.chaos import chaos_site
+
 __all__ = ["PagedKVCache", "KV_SCALE_EPS", "kv_page_bytes",
            "quantize_kv_page", "dequantize_kv_page"]
 
@@ -135,7 +137,15 @@ class PagedKVCache:
         All-or-nothing: returns False (no state change) when the free
         list cannot supply the growth or the sequence would exceed
         pages_per_seq — the scheduler then preempts or queues.
+
+        Chaos site ``kv.allocate`` (action ``deny``): simulates transient
+        page exhaustion — the call fails exactly as if the free list were
+        empty, so tests drive the preemption / deferred-admission paths
+        deterministically (paddle_tpu.testing.chaos).
         """
+        fault = chaos_site("kv.allocate", key=seq_id)
+        if fault is not None and fault.action == "deny":
+            return False
         table = self._tables.get(seq_id)
         have = len(table) if table is not None else 0
         need = self.pages_needed(num_tokens) - have

@@ -44,15 +44,19 @@ class ServingMetrics:
 
     GAUGES = ("serving.queue_depth", "serving.running_seqs",
               "serving.kv_pages_in_use", "serving.batch_bucket",
-              "serving.kv_cache_bytes", "serving.batch_occupancy")
+              "serving.kv_cache_bytes", "serving.batch_occupancy",
+              "serving.snapshot_bytes", "serving.brownout_stage")
     COUNTERS = ("serving.steps", "serving.tokens_generated",
                 "serving.requests_admitted", "serving.requests_completed",
                 "serving.preemptions", "serving.prefill_chunks",
                 "serving.prefill_tokens", "serving.aborts",
-                "serving.deadline_miss")
+                "serving.deadline_miss", "serving.snapshots",
+                "serving.restores", "serving.watchdog_trips",
+                "serving.retries_backoff")
     HISTOGRAMS = ("serving.step_latency_ms", "serving.prefill_latency_ms",
                   "serving.decode_latency_ms", "serving.ttft_ms",
-                  "serving.dispatch_gap_ms")
+                  "serving.dispatch_gap_ms",
+                  "serving.failover_recovery_ms")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -104,6 +108,33 @@ class ServingMetrics:
         """A request's deadline passed while queued (dropped before
         admission) or mid-decode (aborted, pages freed)."""
         stat_registry.get("serving.deadline_miss").add(n)
+
+    # --- resilience hooks (docs/SERVING.md "Resilience") -------------------
+    def on_snapshot(self, nbytes: int):
+        """One request checkpoint taken; the gauge tracks the latest
+        snapshot's size (tokens + KV pages, host bytes)."""
+        stat_registry.get("serving.snapshots").add(1)
+        stat_registry.get("serving.snapshot_bytes").set(int(nbytes))
+
+    def on_restore(self, n: int = 1):
+        """A snapshot was re-admitted mid-stream (warm failover)."""
+        stat_registry.get("serving.restores").add(n)
+
+    def on_watchdog_trip(self, n: int = 1):
+        """The watchdog pulled a replica from the routing pool
+        (overdue/hung engine step)."""
+        stat_registry.get("serving.watchdog_trips").add(n)
+
+    def on_retry_backoff(self, n: int = 1):
+        """One placement retry slept through its backoff (transient
+        no-routable-replica condition)."""
+        stat_registry.get("serving.retries_backoff").add(n)
+
+    def on_failover_recovery(self, seconds: float):
+        """Replica death → first token decoded by the survivor (the
+        warm-failover headline)."""
+        stat_registry.histogram("serving.failover_recovery_ms").observe(
+            seconds * 1e3)
 
     def on_prefill(self, seconds: float):
         stat_registry.histogram("serving.prefill_latency_ms").observe(
@@ -195,6 +226,10 @@ class ServingMetrics:
         snap["aborts"] = stat_registry.get("serving.aborts").get()
         snap["deadline_miss"] = stat_registry.get(
             "serving.deadline_miss").get()
+        for short in ("snapshots", "restores", "watchdog_trips",
+                      "retries_backoff", "brownout_stage",
+                      "snapshot_bytes"):
+            snap[short] = stat_registry.get(f"serving.{short}").get()
         for name in self.HISTOGRAMS:
             h = stat_registry.histogram(name).snapshot()
             key = name[len("serving."):]
@@ -230,7 +265,15 @@ class FrontendMetrics:
                 "serving.frontend.cancels",
                 "serving.frontend.deadline_miss",
                 "serving.frontend.retries",
-                "serving.frontend.failures")
+                "serving.frontend.failures",
+                # brownout shed accounting, one counter per reason
+                # (docs/SERVING.md "Resilience": shed → clamp → reject)
+                "serving.frontend.brownout_shed",
+                "serving.frontend.brownout_clamped",
+                "serving.frontend.brownout_rejected",
+                # warm failover: tokens NOT recomputed thanks to the
+                # checkpoint (vs a token-0 restart)
+                "serving.frontend.recompute_saved_tokens")
     HISTOGRAMS = ("serving.frontend.ttft_ms", "serving.frontend.e2e_ms")
 
     def __init__(self):
@@ -263,6 +306,28 @@ class FrontendMetrics:
 
     def on_retry(self):
         stat_registry.get("serving.frontend.retries").add(1)
+
+    def on_brownout_shed(self):
+        """A live queued request was shed under brownout (lowest
+        deadline slack first)."""
+        stat_registry.get("serving.frontend.brownout_shed").add(1)
+
+    def on_brownout_clamp(self):
+        """A new submission's max_new_tokens was clamped under
+        brownout."""
+        stat_registry.get("serving.frontend.brownout_clamped").add(1)
+
+    def on_brownout_reject(self):
+        """A new submission was rejected under brownout stage 3."""
+        stat_registry.get("serving.frontend.brownout_rejected").add(1)
+
+    def on_recompute_saved(self, tokens: int):
+        """Warm failover resumed from a checkpoint: ``tokens`` already-
+        emitted tokens did NOT have to be re-decoded (vs token-0
+        restart)."""
+        if tokens > 0:
+            stat_registry.get(
+                "serving.frontend.recompute_saved_tokens").add(int(tokens))
 
     def on_failure(self):
         stat_registry.get("serving.frontend.failures").add(1)

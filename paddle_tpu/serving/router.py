@@ -9,19 +9,28 @@ placement      least-outstanding-tokens — a new request goes to the
                HEALTHY replica with the smallest sum of admitted-but-
                unfinished work (prompt + budget tokens), ties broken by
                replica id, so routing is deterministic given the
-               submission order.
+               submission order.  ``pick_with_retry`` adds BOUNDED
+               retry-with-backoff for transient no-routable-replica
+               conditions (every replica momentarily SUSPECT) instead
+               of failing the request on first error.
 health         a replica is routable only in the HEALTHY state.
-               DRAINING replicas finish their in-flight work but take
-               nothing new; DEAD replicas are never routed to again.
+               SUSPECT replicas (watchdog: overdue/hung step) take
+               nothing new until the watchdog re-admits them after an
+               exponential backoff; DRAINING replicas finish their
+               in-flight work but take nothing new; DEAD replicas are
+               never routed to again.
 fault
 injection      ``inject_failure(replica_id, at_step)`` arms a
                deterministic kill switch: the pump thread compares the
                replica's engine-step counter against ``at_step`` after
                every step and simulates a crash mid-decode when it
-               trips.  The frontend then requeues the dead replica's
-               live requests onto survivors (streams restart from token
-               0 with ``retried`` set) — the failover path is exercised
-               by tests/bench, not just described.
+               trips (the chaos framework's ``replica.kill`` site
+               generalizes this to seeded fault schedules —
+               paddle_tpu.testing.chaos).  The frontend then requeues
+               the dead replica's live requests onto survivors,
+               resuming from their last checkpoint when one exists
+               (token-0 restart otherwise) — the failover path is
+               exercised by tests/bench, not just described.
 
 Thread-safety: every mutator/reader takes the router's RLock.  The
 frontend also serializes its own bookkeeping with its own lock; lock
@@ -33,9 +42,10 @@ import threading
 import time
 from typing import List, Optional
 
-__all__ = ["Replica", "Router", "HEALTHY", "DRAINING", "DEAD"]
+__all__ = ["Replica", "Router", "HEALTHY", "SUSPECT", "DRAINING", "DEAD"]
 
 HEALTHY = "healthy"
+SUSPECT = "suspect"
 DRAINING = "draining"
 DEAD = "dead"
 
@@ -57,16 +67,34 @@ class Replica:
         self.dead_reason = ""
         self.inbox: List = []                # guarded by the frontend lock
         self.cancels: List = []              # guarded by the frontend lock
+        self.sheds: List = []                # guarded by the frontend lock
         self.wake = threading.Event()
         self.thread: Optional[threading.Thread] = None
         # engine steps taken by the pump thread — the fault-injection
         # clock (deterministic given a deterministic drive)
         self.steps = 0
+        # set (under the frontend lock) by the first _kill to claim this
+        # replica — the watchdog's dead verdict can race the pump's own
+        # crash path, and the victims must be requeued exactly once
+        self.kill_claimed = False
         self.fail_at_step: Optional[int] = None
         self.last_step_time: Optional[float] = None
+        # watchdog probe: set by the pump thread immediately before
+        # entering engine.step(), cleared right after — a non-None value
+        # means the replica is mid-step and ``now - step_started`` is
+        # how long it has been stuck there
+        self.step_started: Optional[float] = None
         # admitted-but-unfinished work in tokens (prompt + budget) —
         # the placement score
         self.outstanding_tokens = 0
+
+    def busy_for(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds the replica's CURRENT engine step has been running
+        (None when between steps) — the watchdog's overdue signal."""
+        started = self.step_started
+        if started is None:
+            return None
+        return (time.monotonic() if now is None else now) - started
 
     @property
     def healthy(self) -> bool:
@@ -87,11 +115,16 @@ class Replica:
 
 
 class Router:
-    """Least-outstanding-tokens placement over a set of replicas."""
+    """Least-outstanding-tokens placement over a set of replicas.
 
-    def __init__(self):
+    ``metrics`` (an optional ServingMetrics) receives
+    ``on_retry_backoff`` events from ``pick_with_retry`` — the frontend
+    wires its fleet-shared instance in."""
+
+    def __init__(self, metrics=None):
         self._lock = threading.RLock()
         self.replicas: List[Replica] = []
+        self.metrics = metrics
 
     # --- membership ---------------------------------------------------------
     def add(self, replica: Replica):
@@ -121,6 +154,41 @@ class Router:
                 return None
             return min(cands, key=lambda r: (r.outstanding_tokens, r.id))
 
+    def pick_with_retry(self, cost: int = 0,
+                        exclude: Optional[Replica] = None,
+                        attempts: int = 4, backoff_s: float = 0.02,
+                        deadline: Optional[float] = None
+                        ) -> Optional[Replica]:
+        """``pick`` with bounded retry-with-backoff for TRANSIENT
+        placement failures: when no replica is routable right now (all
+        SUSPECT while a watchdog backoff elapses, a kill racing a
+        re-admission), sleep through an exponential backoff and try
+        again instead of failing the request on first error.  Gives up
+        after ``attempts`` tries, when every replica is terminally DEAD,
+        or when the next backoff would overrun ``deadline`` (absolute
+        monotonic).  Each slept retry counts into
+        ``serving.retries_backoff``."""
+        delay = float(backoff_s)
+        for i in range(max(1, int(attempts))):
+            rep = self.pick(cost=cost, exclude=exclude)
+            if rep is not None:
+                return rep
+            with self._lock:
+                # nothing to wait FOR: no replica can ever come back
+                recoverable = any(r.state in (HEALTHY, SUSPECT)
+                                  and r is not exclude
+                                  for r in self.replicas)
+            if not recoverable or i + 1 >= max(1, int(attempts)):
+                return None
+            if deadline is not None \
+                    and time.monotonic() + delay >= deadline:
+                return None
+            time.sleep(delay)
+            delay *= 2.0
+            if self.metrics is not None:
+                self.metrics.on_retry_backoff()
+        return None
+
     def charge(self, replica: Replica, tokens: int):
         with self._lock:
             replica.outstanding_tokens += int(tokens)
@@ -148,8 +216,26 @@ class Router:
         in-flight requests run to completion."""
         with self._lock:
             rep = self.get(replica_id)
-            if rep.state == HEALTHY:
+            if rep.state in (HEALTHY, SUSPECT):
                 rep.state = DRAINING
+
+    def mark_suspect(self, replica: Replica) -> bool:
+        """Watchdog: pull an overdue replica from the routing pool (its
+        in-flight work continues — a straggler, not a corpse).  Returns
+        True when the state actually changed."""
+        with self._lock:
+            if replica.state == HEALTHY:
+                replica.state = SUSPECT
+                return True
+            return False
+
+    def mark_healthy(self, replica: Replica) -> bool:
+        """Watchdog re-admission after backoff: SUSPECT → HEALTHY."""
+        with self._lock:
+            if replica.state == SUSPECT:
+                replica.state = HEALTHY
+                return True
+            return False
 
     def mark_dead(self, replica: Replica, reason: str = ""):
         with self._lock:
@@ -161,8 +247,10 @@ class Router:
         with self._lock:
             reps = [r.status() for r in self.replicas]
             healthy = sum(1 for r in self.replicas if r.state == HEALTHY)
+            suspect = sum(1 for r in self.replicas if r.state == SUSPECT)
         return {
             "healthy_replicas": healthy,
+            "suspect_replicas": suspect,
             "total_replicas": len(reps),
             "replicas": reps,
         }

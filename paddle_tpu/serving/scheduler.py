@@ -58,6 +58,12 @@ class Request:
     arrival_time: float = field(default_factory=time.monotonic)
     # absolute time.monotonic() seconds; None = no SLO
     deadline: Optional[float] = None
+    # warm-failover resume state (an engine.EngineSnapshot): admission
+    # uploads the snapshot's KV pages instead of prefilling, and the
+    # sequence starts mid-stream at the checkpoint — see
+    # docs/SERVING.md "Resilience".  The scheduler reads only
+    # .pos/.next_token/.generated/.kv_len; the payload stays opaque.
+    resume: Optional[object] = field(default=None, repr=False)
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -89,6 +95,13 @@ class Sequence:
         self.pos = 0
         self.next_token = int(request.prompt[-1])
         self.generated: List[int] = []
+        if request.resume is not None:
+            # warm-failover resume: start mid-stream at the checkpoint
+            # (admission uploads the snapshot's KV pages; the engine
+            # emits token indices from len(generated) onward, and the
+            # consumer's forward-progress filter splices the stream)
+            self.next_token = int(request.resume.next_token)
+            self.generated = [int(t) for t in request.resume.generated]
         self.preemptions = 0
         self.first_token_time: Optional[float] = None
         # epoch stamps in-flight device results: a preemption bumps it,
@@ -106,10 +119,20 @@ class Sequence:
         return len(self.generated)
 
     def reset(self):
-        """Recompute-preemption: back to the unprefilled state."""
-        self.pos = 0
-        self.next_token = int(self.request.prompt[-1])
-        self.generated = []
+        """Recompute-preemption: back to the unprefilled state — or, for
+        a snapshot-resumed sequence, back to its CHECKPOINT (resuming
+        from token 0 would need a prefill, but the resume request's
+        admission path re-uploads the snapshot pages instead; either way
+        the replay is deterministic and the stream splices exactly)."""
+        resume = self.request.resume
+        if resume is not None:
+            self.pos = 0                     # admit() re-derives from resume
+            self.next_token = int(resume.next_token)
+            self.generated = [int(t) for t in resume.generated]
+        else:
+            self.pos = 0
+            self.next_token = int(self.request.prompt[-1])
+            self.generated = []
         self.preemptions += 1
         self.epoch += 1
 
@@ -169,11 +192,16 @@ class Scheduler:
             if limit is not None and len(admitted) >= limit:
                 break
             req = self.waiting[0]
-            if not self.cache.allocate(req.request_id, len(req.prompt)):
+            # a resumed request needs pages covering every KV position
+            # its snapshot carries (pos slots), not just the prompt
+            kv_need = (int(req.resume.kv_len) if req.resume is not None
+                       else len(req.prompt))
+            if not self.cache.allocate(req.request_id, kv_need):
                 break
             self.waiting.popleft()
             seq = Sequence(req)
-            seq.pos = len(req.prompt) - 1
+            seq.pos = (int(req.resume.pos) if req.resume is not None
+                       else len(req.prompt) - 1)
             self.running.append(seq)
             admitted.append(seq)
         return admitted

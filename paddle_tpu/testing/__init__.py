@@ -1,0 +1,14 @@
+"""paddle_tpu.testing — deterministic test harnesses.
+
+``chaos`` is the fault-injection framework the serving resilience layer
+is tested with: a seeded :class:`~paddle_tpu.testing.chaos.ChaosPlan`
+trips faults at named sites instrumented throughout the serving stack
+(page-allocator exhaustion, engine-step exceptions, artificial step
+latency, HTTP 5xx, replica kills), so every failure mode is reproducible
+from a seed instead of depending on thread timing.
+"""
+from . import chaos
+from .chaos import ChaosPlan, Fault, active_plan, chaos_site, install
+
+__all__ = ["chaos", "ChaosPlan", "Fault", "active_plan", "chaos_site",
+           "install"]

@@ -1,0 +1,236 @@
+"""Deterministic chaos injection for the serving stack.
+
+A :class:`ChaosPlan` is a SCHEDULE of faults, not a probability: each
+:class:`Fault` names an instrumented *site* and the evaluation index at
+which it trips, so the same plan against the same workload produces the
+same fault sequence — every failure mode in tests/test_resilience.py is
+reproducible from a seed.  ``ChaosPlan.randomized(seed)`` derives such a
+schedule from a seed for soak runs (same seed → same schedule, pinned by
+tests/test_chaos.py).
+
+Instrumented sites (grep ``chaos_site(`` for the live list)
+-----------------------------------------------------------
+``kv.allocate``       PagedKVCache.allocate — action ``deny`` simulates
+                      transient page exhaustion (the scheduler reacts by
+                      preempting / deferring admission).  Key: seq_id.
+``engine.step``       ServingEngine.step — ``raise`` injects an
+                      engine-step exception (the frontend treats it as a
+                      replica crash), ``delay`` injects artificial step
+                      latency (a straggler — watchdog territory).
+                      Key: none (per-engine counting via the plan).
+``replica.kill``      frontend pump loop, after each step — ``kill``
+                      crashes the replica mid-decode (the generalized
+                      form of Router.inject_failure).  Key: replica id.
+``http.request``      POST /generate intake — ``http_error`` answers
+                      with the fault's status before touching the
+                      frontend.  Key: request path.
+
+Usage::
+
+    plan = ChaosPlan([
+        Fault("replica.kill", at=4, action="kill", match="replica-0"),
+        Fault("engine.step", at=9, action="delay", delay_s=0.2),
+        Fault("kv.allocate", at=5, action="deny"),
+    ])
+    with chaos.running(plan):
+        ... drive the frontend ...
+    assert plan.fired[0]["site"] == "replica.kill"
+
+Sites check ``chaos_site(site, key)`` which is a single global read when
+no plan is installed — production paths pay nothing.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["Fault", "ChaosPlan", "install", "uninstall", "active_plan",
+           "running", "chaos_site", "DENY", "RAISE", "DELAY", "KILL",
+           "HTTP_ERROR"]
+
+DENY = "deny"
+RAISE = "raise"
+DELAY = "delay"
+KILL = "kill"
+HTTP_ERROR = "http_error"
+_ACTIONS = frozenset({DENY, RAISE, DELAY, KILL, HTTP_ERROR})
+
+
+class Fault:
+    """One scheduled fault: trips on the ``at``-th MATCHING evaluation
+    of ``site`` (1-based), ``count`` times in a row.
+
+    Clock semantics (pinned in tests/test_chaos.py): at most ONE fault
+    fires per site visit — the first armed match in plan order wins —
+    and a visit claimed by an earlier fault does NOT advance a later
+    fault's clock.  Two faults at the same site therefore keep
+    independent clocks over the visits each one actually observes:
+    ``at=2`` and ``at=4`` on one site fire on global visits 2 and 5."""
+
+    __slots__ = ("site", "at", "action", "match", "count", "delay_s",
+                 "status", "message", "seen", "remaining")
+
+    def __init__(self, site: str, at: int, action: str,
+                 match: Optional[str] = None, count: int = 1,
+                 delay_s: float = 0.0, status: int = 500,
+                 message: str = ""):
+        if action not in _ACTIONS:
+            raise ValueError(f"unknown chaos action {action!r}; one of "
+                             f"{sorted(_ACTIONS)}")
+        if at < 1:
+            raise ValueError("at is a 1-based evaluation index (>= 1)")
+        self.site = str(site)
+        self.at = int(at)
+        self.action = action
+        self.match = match
+        self.count = int(count)
+        self.delay_s = float(delay_s)
+        self.status = int(status)
+        self.message = message or f"chaos[{site}@{at}:{action}]"
+        self.seen = 0              # matching evaluations so far
+        self.remaining = self.count
+
+    def describe(self) -> dict:
+        """Canonical schedule entry — two plans with equal describe()
+        lists carry the same fault schedule (the determinism pin)."""
+        return {"site": self.site, "at": self.at, "action": self.action,
+                "match": self.match, "count": self.count,
+                "delay_s": round(self.delay_s, 6), "status": self.status}
+
+    def exception(self):
+        from ..framework.errors import InternalError
+
+        return InternalError(self.message)
+
+
+class ChaosPlan:
+    """An ordered set of faults plus the record of what actually fired.
+
+    Thread-safe: serving pump threads, HTTP handler threads and the
+    submitting thread may all evaluate sites concurrently; per-fault
+    counters advance under one lock, so a plan's replay against a
+    deterministic drive is itself deterministic.
+    """
+
+    def __init__(self, faults=(), seed: Optional[int] = None,
+                 name: str = ""):
+        self._lock = threading.Lock()
+        self.faults: List[Fault] = list(faults)
+        self.seed = seed
+        self.name = name or ("chaos-plan" if seed is None
+                             else f"chaos-plan-seed{seed}")
+        # append-only log of fired faults: {site, key, action, seen}
+        self.fired: List[dict] = []
+
+    # --- construction -------------------------------------------------------
+    @classmethod
+    def randomized(cls, seed: int, *, replica_ids=("replica-0",),
+                   kills: int = 1, stragglers: int = 1,
+                   alloc_denials: int = 1, step_window=(3, 30),
+                   delay_range_s=(0.05, 0.25)) -> "ChaosPlan":
+        """Derive a fault schedule from ``seed`` — the soak-test
+        generator.  Same seed → same schedule (no wall-clock, no global
+        RNG): randomness decides only WHICH deterministic triggers are
+        armed."""
+        import numpy as np
+
+        rng = np.random.RandomState(seed)
+        faults: List[Fault] = []
+        for _ in range(kills):
+            rep = replica_ids[int(rng.randint(len(replica_ids)))]
+            faults.append(Fault("replica.kill",
+                                at=int(rng.randint(*step_window)),
+                                action=KILL, match=rep))
+        for _ in range(stragglers):
+            faults.append(Fault(
+                "engine.step", at=int(rng.randint(*step_window)),
+                action=DELAY,
+                delay_s=float(rng.uniform(*delay_range_s))))
+        for _ in range(alloc_denials):
+            faults.append(Fault("kv.allocate",
+                                at=int(rng.randint(*step_window)),
+                                action=DENY))
+        return cls(faults, seed=seed)
+
+    # --- inspection ---------------------------------------------------------
+    def schedule(self) -> List[dict]:
+        """The full fault schedule in canonical form (order preserved)."""
+        return [f.describe() for f in self.faults]
+
+    def fired_log(self) -> List[dict]:
+        with self._lock:
+            return list(self.fired)
+
+    # --- evaluation ---------------------------------------------------------
+    def fire(self, site: str, key: Optional[str] = None) -> Optional[Fault]:
+        """Evaluate one site visit; returns the fault that trips (at most
+        one per visit — the first armed match wins) or None."""
+        with self._lock:
+            for f in self.faults:
+                if f.site != site:
+                    continue
+                if f.match is not None and f.match != key:
+                    continue
+                f.seen += 1
+                if f.remaining > 0 and f.seen >= f.at:
+                    f.remaining -= 1
+                    self.fired.append({"site": site, "key": key,
+                                       "action": f.action, "seen": f.seen})
+                    return f
+        return None
+
+
+# --- global installation ----------------------------------------------------
+_ACTIVE: Optional[ChaosPlan] = None
+_INSTALL_LOCK = threading.Lock()
+
+
+def install(plan: Optional[ChaosPlan]):
+    """Install ``plan`` as the process-wide active plan (None clears).
+    One plan at a time: tests use the ``running()`` context manager so a
+    failing test never leaks faults into the next."""
+    global _ACTIVE
+    with _INSTALL_LOCK:
+        _ACTIVE = plan
+
+
+def uninstall():
+    install(None)
+
+
+def active_plan() -> Optional[ChaosPlan]:
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def running(plan: ChaosPlan):
+    """``with chaos.running(plan): ...`` — install for the block, always
+    uninstall after (even on failure)."""
+    install(plan)
+    try:
+        yield plan
+    finally:
+        uninstall()
+
+
+def chaos_site(site: str, key: Optional[str] = None) -> Optional[Fault]:
+    """The instrumentation hook: one global read when no plan is active.
+
+    Generic actions are applied HERE (``delay`` sleeps, ``raise`` raises
+    the fault's InternalError); site-specific actions (``deny``,
+    ``kill``, ``http_error``) are returned for the caller to act on.
+    """
+    plan = _ACTIVE
+    if plan is None:
+        return None
+    fault = plan.fire(site, key)
+    if fault is None:
+        return None
+    if fault.action == DELAY:
+        time.sleep(fault.delay_s)
+        return fault
+    if fault.action == RAISE:
+        raise fault.exception()
+    return fault

@@ -66,6 +66,16 @@ class TestFailOnRegression:
         assert not bench_diff.lower_is_better("detail.kv_bytes_reduction_x")
         assert not bench_diff.lower_is_better("detail.prefill_tokens_per_sec")
         assert not bench_diff.lower_is_better("detail.greedy_token_parity")
+        # resilience section (ISSUE 6): recovery latency gates upward,
+        # goodput / saved-recompute gate downward
+        assert bench_diff.lower_is_better(
+            "detail.resilience.failover.failover_recovery_ms_p50")
+        assert not bench_diff.lower_is_better(
+            "detail.resilience.brownout.graceful.goodput_req_per_sec")
+        assert not bench_diff.lower_is_better(
+            "detail.resilience.brownout.goodput_ratio_vs_cliff_x")
+        assert not bench_diff.lower_is_better(
+            "detail.resilience.failover.recompute_saved_tokens")
 
     def test_reduction_ratio_gates_on_drop_not_rise(self):
         """The PR-4 acceptance metric: kv_bytes_reduction_x falling

@@ -1,0 +1,162 @@
+"""paddle_tpu.testing.chaos — deterministic fault-injection units.
+
+The resilience acceptance tests (tests/test_resilience.py) lean on one
+property above all: a ChaosPlan is a SCHEDULE, not a probability — the
+same seed derives the same fault schedule, and the same schedule against
+the same drive fires the same faults.  These units pin that contract
+without engines or threads.
+"""
+import threading
+
+import pytest
+
+from paddle_tpu.framework.errors import InternalError
+from paddle_tpu.testing import chaos
+from paddle_tpu.testing.chaos import ChaosPlan, Fault
+
+
+class TestFault:
+    def test_rejects_unknown_action(self):
+        with pytest.raises(ValueError, match="unknown chaos action"):
+            Fault("engine.step", at=1, action="explode")
+
+    def test_rejects_zero_at(self):
+        with pytest.raises(ValueError, match="1-based"):
+            Fault("engine.step", at=0, action="delay")
+
+    def test_describe_is_canonical(self):
+        f = Fault("replica.kill", at=4, action="kill", match="replica-0")
+        assert f.describe() == {
+            "site": "replica.kill", "at": 4, "action": "kill",
+            "match": "replica-0", "count": 1, "delay_s": 0.0,
+            "status": 500}
+
+
+class TestPlanFiring:
+    def test_fires_on_nth_matching_evaluation_only(self):
+        plan = ChaosPlan([Fault("kv.allocate", at=3, action="deny")])
+        assert plan.fire("kv.allocate") is None
+        assert plan.fire("kv.allocate") is None
+        f = plan.fire("kv.allocate")
+        assert f is not None and f.action == "deny"
+        # count=1: armed once, never again
+        assert plan.fire("kv.allocate") is None
+        assert [e["seen"] for e in plan.fired_log()] == [3]
+
+    def test_match_key_filters_evaluations(self):
+        plan = ChaosPlan([Fault("replica.kill", at=2, action="kill",
+                                match="replica-1")])
+        # replica-0 visits don't advance replica-1's fault clock
+        assert plan.fire("replica.kill", "replica-0") is None
+        assert plan.fire("replica.kill", "replica-1") is None
+        assert plan.fire("replica.kill", "replica-0") is None
+        f = plan.fire("replica.kill", "replica-1")
+        assert f is not None
+        assert plan.fired_log() == [{"site": "replica.kill",
+                                     "key": "replica-1", "action": "kill",
+                                     "seen": 2}]
+
+    def test_count_repeats_consecutively(self):
+        plan = ChaosPlan([Fault("kv.allocate", at=2, action="deny",
+                                count=3)])
+        hits = [plan.fire("kv.allocate") is not None for _ in range(6)]
+        assert hits == [False, True, True, True, False, False]
+
+    def test_independent_clocks_per_fault(self):
+        plan = ChaosPlan([Fault("engine.step", at=2, action="delay"),
+                          Fault("engine.step", at=4, action="delay")])
+        # at most one fault per visit — the first armed match wins, and
+        # a visit that trips an earlier fault does not advance a later
+        # fault's clock (so the second at=4 fault fires on its own 4th
+        # counted evaluation: global visit 5)
+        fired_at = [i for i in range(1, 7)
+                    if plan.fire("engine.step") is not None]
+        assert fired_at == [2, 5]
+
+    def test_concurrent_firing_is_exactly_once(self):
+        plan = ChaosPlan([Fault("engine.step", at=5, action="kill")])
+        hits = []
+        barrier = threading.Barrier(4)
+
+        def hammer():
+            barrier.wait()
+            for _ in range(25):
+                if plan.fire("engine.step") is not None:
+                    hits.append(1)
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(hits) == 1
+
+
+class TestActions:
+    def test_raise_action_raises_internal_error(self):
+        plan = ChaosPlan([Fault("engine.step", at=1, action="raise")])
+        with chaos.running(plan):
+            with pytest.raises(InternalError, match="chaos"):
+                chaos.chaos_site("engine.step")
+
+    def test_delay_action_sleeps_and_returns_fault(self):
+        import time
+
+        plan = ChaosPlan([Fault("engine.step", at=1, action="delay",
+                                delay_s=0.05)])
+        with chaos.running(plan):
+            t0 = time.monotonic()
+            f = chaos.chaos_site("engine.step")
+            dt = time.monotonic() - t0
+        assert f is not None and dt >= 0.05
+
+    def test_site_specific_actions_returned_to_caller(self):
+        plan = ChaosPlan([Fault("kv.allocate", at=1, action="deny"),
+                          Fault("http.request", at=1,
+                                action="http_error", status=503)])
+        with chaos.running(plan):
+            assert chaos.chaos_site("kv.allocate").action == "deny"
+            f = chaos.chaos_site("http.request")
+            assert f.action == "http_error" and f.status == 503
+
+
+class TestInstallation:
+    def test_no_plan_is_a_noop(self):
+        chaos.uninstall()
+        assert chaos.active_plan() is None
+        assert chaos.chaos_site("engine.step") is None
+
+    def test_running_uninstalls_even_on_failure(self):
+        plan = ChaosPlan([])
+        with pytest.raises(RuntimeError, match="boom"):
+            with chaos.running(plan):
+                assert chaos.active_plan() is plan
+                raise RuntimeError("boom")
+        assert chaos.active_plan() is None
+
+
+class TestSeededSchedules:
+    def test_same_seed_same_schedule(self):
+        a = ChaosPlan.randomized(31, replica_ids=("r0", "r1"), kills=2,
+                                 stragglers=2, alloc_denials=2)
+        b = ChaosPlan.randomized(31, replica_ids=("r0", "r1"), kills=2,
+                                 stragglers=2, alloc_denials=2)
+        assert a.schedule() == b.schedule()
+        assert a.name == "chaos-plan-seed31"
+
+    def test_different_seed_different_schedule(self):
+        a = ChaosPlan.randomized(1, kills=2, stragglers=2,
+                                 alloc_denials=2)
+        b = ChaosPlan.randomized(2, kills=2, stragglers=2,
+                                 alloc_denials=2)
+        assert a.schedule() != b.schedule()
+
+    def test_schedule_shape(self):
+        plan = ChaosPlan.randomized(
+            7, replica_ids=("replica-0", "replica-1"), kills=1,
+            stragglers=1, alloc_denials=1, step_window=(3, 30))
+        sched = plan.schedule()
+        assert [f["site"] for f in sched] == [
+            "replica.kill", "engine.step", "kv.allocate"]
+        assert all(3 <= f["at"] < 30 for f in sched)
+        assert sched[0]["match"] in ("replica-0", "replica-1")

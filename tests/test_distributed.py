@@ -179,6 +179,11 @@ class TestDataParallelStep:
 
 
 class TestTensorParallel:
+    # slow-marked (ISSUE 6 suite health): a ~19 s full-BERT dp×mp train
+    # step soak; the TP layer semantics stay pinned in tier-1 by the
+    # unit tests below and the soak stays enforced in the full
+    # (slow-inclusive) run
+    @pytest.mark.slow
     def test_bert_tp_step(self):
         """dp×mp sharded BERT train step (the dryrun_multichip path)."""
         import importlib.util

@@ -84,6 +84,7 @@ def _measure_slowdown(batch=32, hw=32, steps=8):
     return dt_loader / dt_syn
 
 
+@pytest.mark.slow
 def test_loader_fed_within_10pct_of_synthetic():
     """Flaky-proofing (VERDICT r4 weak #5): a wall-clock ratio on a
     loaded 1-core CI host jitters far beyond 10%, so (a) take the BEST
@@ -91,7 +92,10 @@ def test_loader_fed_within_10pct_of_synthetic():
     the honest measurement; (b) if even the best attempt fails while the
     host is demonstrably oversubscribed, skip loudly instead of failing
     on scheduler noise (the guarantee is about the feed path, not about
-    CI contention)."""
+    CI contention).  ``slow``-marked (ISSUE 6 suite health): it is a
+    ~29 s best-of-3 wall-clock soak, exactly the class tier-1's
+    ``-m 'not slow'`` excludes — the feed-path guarantee stays enforced
+    in the full (slow-inclusive) run."""
     import os
 
     best = float("inf")

@@ -189,7 +189,12 @@ class TestSaveLoadRoundtrip:
 
 
 class TestGeoAsyncTwoTrainersTwoServers:
-    @pytest.mark.parametrize("mode", ["geo", "async"])
+    # the geo variant is slow-marked (ISSUE 6 suite health): each
+    # variant is an ~10 s 4-process cluster soak and the async variant
+    # already pins the cross-process PS path in tier-1; geo-specific
+    # semantics stay enforced in the full (slow-inclusive) run
+    @pytest.mark.parametrize(
+        "mode", [pytest.param("geo", marks=pytest.mark.slow), "async"])
     def test_cluster_train(self, tmp_path, mode):
         """The r3 done-criterion: CTR training across 2 trainer + 2 server
         processes on localhost; rank 0 proves rank 1's rows reached the
