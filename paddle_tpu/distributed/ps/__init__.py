@@ -11,6 +11,14 @@ backward produces a dense [n_unique, dim] grad, and the communicator PUSHes
 it back applying the rowwise optimizer on the host.  Cross-host scale-out
 rides DCN with the same pull/push contract (the in-process table here is
 the single-host degenerate case of the brpc service)."""
+from ...framework.concurrency import declare_hierarchy as _declare_hierarchy
+
+# PS-side declared lock hierarchy (docs/ANALYSIS.md), outermost first:
+# the device cache may call into its backing table, which (remote) may
+# call into a PS connection — never the reverse.
+_declare_hierarchy("ps.device_cache_io", "ps.device_cache", "ps.table",
+                   "ps.conn")
+
 from . import runtime  # noqa: F401
 from .table import SparseTable
 from .communicator import Communicator

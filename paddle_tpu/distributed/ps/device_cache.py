@@ -8,15 +8,36 @@ dict maps id->slot with second-chance eviction.  A training step's pull
 becomes ONE device gather over the cache (misses are fetched from the
 host/remote table in a single batched pull and scattered into evicted
 slots); pushes apply the rowwise optimizer on the host table and refresh
-the cached copies in one scatter."""
+the cached copies in one scatter.
+
+Locking (ISSUE 7 lock-discipline fix; witness names
+``ps.device_cache_io`` > ``ps.device_cache`` > ``ps.table`` >
+``ps.conn``):
+
+- ``_lock`` guards the cache STRUCTURE (slot index, ref bits, device
+  array) and is held only for host/device bookkeeping — never across a
+  backing-table call.  The backing table may be a RemoteSparseTable (a
+  network round-trip per pull/push), and the pre-fix single-lock design
+  stalled every reader of RESIDENT rows behind any one miss fetch or
+  push RPC.
+- ``_io_lock`` serializes the paths that TALK TO THE BACKING TABLE and
+  then mutate the cache from the response (miss fills, push/delta
+  refresh, state_dict load).  Holding it across the RPC is the point —
+  with writers and miss-fills mutually excluded, a fill can never
+  install rows made stale by a concurrent push (the push's refresh runs
+  strictly before or strictly after the fill's install, and a refresh
+  re-scatters every then-resident id).  All-hit pulls take only
+  ``_lock`` and proceed while an RPC is in flight.
+"""
 from __future__ import annotations
 
-import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ...framework.concurrency import OrderedLock, OrderedRLock
 
 
 class DeviceCachedTable:
@@ -40,7 +61,8 @@ class DeviceCachedTable:
         self._hand = 0
         self._hits = 0
         self._lookups = 0
-        self._lock = threading.RLock()
+        self._lock = OrderedRLock("ps.device_cache")
+        self._io_lock = OrderedLock("ps.device_cache_io")
 
     # -- eviction ------------------------------------------------------------
 
@@ -49,7 +71,9 @@ class DeviceCachedTable:
         slots belong to the in-flight batch and must not be evicted
         (evicting a row pulled moments ago in the SAME batch would hand
         its slot to another id and corrupt the gather).  Returns -1 when
-        every slot is pinned — the caller serves the row uncached."""
+        every slot is pinned — the caller serves the row uncached.
+        Caller holds BOTH _io_lock and _lock (evictions are structure
+        mutations, serialized under the io lock)."""
         scanned = 0
         limit = 2 * self.cache_rows
         while True:
@@ -68,56 +92,72 @@ class DeviceCachedTable:
                 self._slot_of.pop(int(old), None)
             return s
 
-    # -- residency (the shared bookkeeping core) -----------------------------
+    # -- residency -----------------------------------------------------------
 
-    def _ensure_resident(self, ids: np.ndarray, create: bool) \
-            -> Tuple[np.ndarray, Optional[np.ndarray], dict]:
-        """Make `ids` cache-resident where capacity allows.
+    def _lookup_locked(self, ids: np.ndarray, count: bool
+                       ) -> Tuple[np.ndarray, List[int]]:
+        """Resident slots for `ids` ([N], -1 = miss) + miss positions;
+        marks resident rows referenced.  Caller holds _lock."""
+        slots = np.empty(len(ids), np.int64)
+        miss_idx: List[int] = []
+        for i, gid in enumerate(ids):
+            s = self._slot_of.get(int(gid), -1)
+            if s >= 0:
+                self._ref[s] = True
+            else:
+                miss_idx.append(i)
+            slots[i] = s
+        if count:
+            # accounting happens ONCE per pull (the first lookup): the
+            # post-fetch re-validation must not inflate the denominator
+            self._lookups += len(ids)
+            self._hits += len(ids) - len(miss_idx)
+        return slots, miss_idx
+
+    def _fill_misses(self, ids: np.ndarray, create: bool
+                     ) -> Tuple[np.ndarray, Optional[np.ndarray], dict]:
+        """Make `ids` cache-resident where capacity allows, fetching the
+        misses from the backing table WITHOUT holding the cache lock.
 
         Returns (slots [N] with -1 for uncached overflow rows,
         overflow_rows_by_unique_index or None, seen: id -> unique idx).
-        Caller must hold the lock."""
-        slots = np.empty(len(ids), np.int64)
-        pinned = set()
-        miss_idx = []
-        for i, gid in enumerate(ids):
-            s = self._slot_of.get(int(gid), -1)
-            if s < 0:
-                miss_idx.append(i)
-            else:
-                self._ref[s] = True
-                pinned.add(s)
-                slots[i] = s
-        self._lookups += len(ids)
-        self._hits += len(ids) - len(miss_idx)
-        if not miss_idx:
+        Caller holds _io_lock (so no concurrent fill/push/evict can
+        interleave between the fetch and the install) but NOT _lock.
+        """
+        with self._lock:
+            slots, miss_idx = self._lookup_locked(ids, count=False)
+            uniq_ids: List[int] = []
+            seen: Dict[int, int] = {}
+            for i in miss_idx:
+                gid = int(ids[i])
+                if gid not in seen:
+                    seen[gid] = len(uniq_ids)
+                    uniq_ids.append(gid)
+        if not uniq_ids:
             return slots, None, {}
-        # dedupe: one slot per unique missing id
-        uniq_ids = []
-        seen: Dict[int, int] = {}
-        for i in miss_idx:
-            gid = int(ids[i])
-            if gid not in seen:
-                seen[gid] = len(uniq_ids)
-                uniq_ids.append(gid)
-        rows = self.table.pull(np.asarray(uniq_ids, np.int64),
+        # the RPC: cache lock NOT held — concurrent all-hit pulls keep
+        # streaming; _io_lock (held by the caller) is what keeps a
+        # racing push from making these rows stale before they land
+        rows = self.table.pull(np.asarray(uniq_ids, np.int64),  # analyze: allow[lock-discipline] io serialization point: _io_lock intentionally spans fetch+install (see module docstring)
                                create=create)
-        uniq_slots = np.empty(len(uniq_ids), np.int64)
-        for j, gid in enumerate(uniq_ids):
-            s = self._grab_slot(pinned)
-            if s >= 0:
-                self._slot_of[gid] = s
-                self._id_at[s] = gid
-                self._ref[s] = True
-                pinned.add(s)
-            uniq_slots[j] = s
-        cacheable = uniq_slots >= 0
-        if cacheable.any():
-            self._cache = self._cache.at[
-                jnp.asarray(uniq_slots[cacheable])].set(
-                jnp.asarray(rows[cacheable], self._cache.dtype))
-        for i in miss_idx:
-            slots[i] = uniq_slots[seen[int(ids[i])]]
+        with self._lock:
+            pinned = {int(s) for s in slots if s >= 0}
+            uniq_slots = np.empty(len(uniq_ids), np.int64)
+            for j, gid in enumerate(uniq_ids):
+                s = self._grab_slot(pinned)
+                if s >= 0:
+                    self._slot_of[gid] = s
+                    self._id_at[s] = gid
+                    self._ref[s] = True
+                    pinned.add(s)
+                uniq_slots[j] = s
+            cacheable = uniq_slots >= 0
+            if cacheable.any():
+                self._cache = self._cache.at[
+                    jnp.asarray(uniq_slots[cacheable])].set(
+                    jnp.asarray(rows[cacheable], self._cache.dtype))
+            for i in miss_idx:
+                slots[i] = uniq_slots[seen[int(ids[i])]]
         overflow = rows if (~cacheable).any() else None
         return slots, overflow, seen
 
@@ -127,8 +167,14 @@ class DeviceCachedTable:
         """Rows for `ids` as a HOST array (SparseTable-compatible)."""
         ids = np.asarray(ids).reshape(-1).astype(np.int64)
         with self._lock:
-            slots, overflow, seen = self._ensure_resident(ids, create)
-            out = np.array(self._cache[jnp.asarray(np.maximum(slots, 0))])
+            slots, miss_idx = self._lookup_locked(ids, count=True)
+            if not miss_idx:               # all resident: no RPC, no io lock
+                return np.array(self._cache[jnp.asarray(slots)])
+        with self._io_lock:
+            slots, overflow, seen = self._fill_misses(ids, create)
+            with self._lock:
+                out = np.array(
+                    self._cache[jnp.asarray(np.maximum(slots, 0))])
             if overflow is not None:
                 for i in np.nonzero(slots < 0)[0]:
                     out[i] = overflow[seen[int(ids[i])]]
@@ -141,11 +187,16 @@ class DeviceCachedTable:
         unique ids overflow the cache."""
         ids = np.asarray(ids).reshape(-1).astype(np.int64)
         with self._lock:
-            slots, overflow, seen = self._ensure_resident(ids,
-                                                          create=True)
-            if overflow is None:
+            slots, miss_idx = self._lookup_locked(ids, count=True)
+            if not miss_idx:
                 return self._cache[jnp.asarray(slots)]
-            out = np.array(self._cache[jnp.asarray(np.maximum(slots, 0))])
+        with self._io_lock:
+            slots, overflow, seen = self._fill_misses(ids, create=True)
+            with self._lock:
+                if overflow is None:
+                    return self._cache[jnp.asarray(slots)]
+                out = np.array(
+                    self._cache[jnp.asarray(np.maximum(slots, 0))])
             for i in np.nonzero(slots < 0)[0]:
                 out[i] = overflow[seen[int(ids[i])]]
             return jnp.asarray(out)
@@ -154,29 +205,35 @@ class DeviceCachedTable:
         """Re-sync cached copies of `ids` from the backing table — ONE
         batched pull of only the ids actually resident (a cold-cache push
         of 16k ids refreshes nothing and costs no extra RPC).  Caller
-        holds the lock."""
-        live = [(i, self._slot_of[int(g)]) for i, g in enumerate(ids)
-                if int(g) in self._slot_of]
+        holds _io_lock but NOT _lock."""
+        with self._lock:
+            live = [(int(g), self._slot_of[int(g)]) for g in ids
+                    if int(g) in self._slot_of]
         if not live:
             return
-        live_ids = np.asarray([int(ids[i]) for i, _ in live], np.int64)
-        fresh = self.table.pull(live_ids, create=False)
-        ss = jnp.asarray(np.asarray([s for _, s in live], np.int64))
-        self._cache = self._cache.at[ss].set(
-            jnp.asarray(fresh, self._cache.dtype))
+        live_ids = np.asarray([g for g, _ in live], np.int64)
+        fresh = self.table.pull(live_ids, create=False)  # analyze: allow[lock-discipline] io serialization point: _io_lock intentionally spans fetch+scatter (see module docstring)
+        with self._lock:
+            # slots cannot have moved (installs/evictions need _io_lock,
+            # which we hold) — scatter unconditionally
+            ss = jnp.asarray(np.asarray([s for _, s in live], np.int64))
+            self._cache = self._cache.at[ss].set(
+                jnp.asarray(fresh, self._cache.dtype))
 
     def push(self, ids, grads, lr: float = 0.01) -> None:
         """Host-table rowwise update, then refresh the cached copies (the
-        cache must never serve stale rows)."""
+        cache never RETAINS a stale row past a completed push).  The
+        cache lock is never held across the table RPCs — readers of
+        resident rows proceed while the update is in flight."""
         ids = np.asarray(ids).reshape(-1).astype(np.int64)
-        with self._lock:
-            self.table.push(ids, grads, lr=lr)
+        with self._io_lock:
+            self.table.push(ids, grads, lr=lr)  # analyze: allow[lock-discipline] io serialization point: the cache lock is NOT held here (see module docstring)
             self._refresh(ids)
 
     def apply_deltas(self, ids, deltas) -> None:
         ids = np.asarray(ids).reshape(-1).astype(np.int64)
-        with self._lock:
-            self.table.apply_deltas(ids, deltas)
+        with self._io_lock:
+            self.table.apply_deltas(ids, deltas)  # analyze: allow[lock-discipline] io serialization point: the cache lock is NOT held here (see module docstring)
             self._refresh(ids)
 
     # -- introspection -------------------------------------------------------
@@ -197,9 +254,11 @@ class DeviceCachedTable:
         return self.table.state_dict()
 
     def set_state_dict(self, d):
-        with self._lock:
+        with self._io_lock:
             self.table.set_state_dict(d)
-            # drop the cache: cached copies may be stale vs loaded state
-            self._slot_of.clear()
-            self._id_at[:] = -1
-            self._ref[:] = False
+            with self._lock:
+                # drop the cache: cached copies may be stale vs loaded
+                # state
+                self._slot_of.clear()
+                self._id_at[:] = -1
+                self._ref[:] = False

@@ -22,6 +22,7 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 
+from ...framework.concurrency import OrderedCondition, OrderedLock
 from ..gloo import _recv_msg, _send_msg, connect_with_retry
 from .table import SparseTable
 
@@ -59,7 +60,7 @@ class PSServer:
         self.num_servers = num_servers
         self._sparse: Dict[str, SparseTable] = {}
         self._dense: Dict[str, DenseTable] = {}
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("ps.server")
         self._stop_evt = threading.Event()
         self._srv: Optional[socket.socket] = None
         # heartbeat monitor (heart_beat_monitor.cc analog): last-seen per
@@ -209,12 +210,16 @@ class _ServerConn:
         host, port_s = endpoint.rsplit(":", 1)
         self.sock = connect_with_retry(host, int(port_s), timeout,
                                        what="PS server")
-        self.lock = threading.Lock()
+        self.lock = OrderedLock("ps.conn")
 
     def call(self, req: dict) -> dict:
+        # holding the connection lock ACROSS the round-trip is the
+        # design: one in-flight RPC per channel (the length-prefixed
+        # wire format would interleave otherwise); concurrency comes
+        # from one _ServerConn per server + the client's fan-out pool
         with self.lock:
-            _send_msg(self.sock, req)
-            resp = _recv_msg(self.sock)
+            _send_msg(self.sock, req)  # analyze: allow[lock-discipline] per-channel serialization is the contract
+            resp = _recv_msg(self.sock)  # analyze: allow[lock-discipline] per-channel serialization is the contract
         if not resp.get("ok"):
             raise RuntimeError(
                 f"PS RPC {req.get('op')} failed: {resp.get('error')}")
@@ -452,7 +457,7 @@ class AsyncPushQueue:
         self.table = table
         self._items: list = []
         self._pending = 0
-        self._cv = threading.Condition()
+        self._cv = OrderedCondition("ps.push_queue")
         self._err: Optional[BaseException] = None
         self._stopped = False
         self._thread = threading.Thread(target=self._drain, daemon=True)

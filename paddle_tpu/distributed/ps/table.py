@@ -8,10 +8,11 @@ until ids arrive.  Each shard is a dict id->slot plus growing numpy arenas
 gathers/scatters over the arenas."""
 from __future__ import annotations
 
-import threading
 from typing import Dict, Optional, Sequence
 
 import numpy as np
+
+from ...framework.concurrency import OrderedLock
 
 _RULES = ("sgd", "adagrad", "adam", "sum")
 
@@ -144,7 +145,7 @@ class SparseTable:
         # and hogwild workers hit them concurrently.  Row UPDATES stay
         # hogwild (last-writer-wins) in spirit; only the index/arena
         # structure is serialized.
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("ps.table")
 
     def _route(self, ids: np.ndarray):
         ids = np.asarray(ids).reshape(-1).astype(np.int64)

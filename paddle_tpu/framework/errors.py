@@ -25,8 +25,11 @@ class OutOfRangeError(EnforceNotMet, IndexError):
     pass
 
 
-class AlreadyExistsError(EnforceNotMet):
-    pass
+class AlreadyExistsError(EnforceNotMet, ValueError):
+    """A uniquely-keyed entity (request id, replica id, table name) was
+    created twice.  Also a ValueError: pre-taxonomy serving code raised
+    duplicate-id errors as ValueError, and callers reasonably catch it
+    as one."""
 
 
 class ResourceExhaustedError(EnforceNotMet, MemoryError):
@@ -80,14 +83,22 @@ class InternalError(EnforceNotMet):
 # (serving/http.py consumes this; docs/SERVING.md "Resilience").
 ERROR_HTTP_STATUS = {
     InvalidArgumentError: 400,
+    OutOfRangeError: 400,
+    PermissionDeniedError: 403,
     NotFoundError: 404,
     AlreadyExistsError: 409,
+    PreconditionNotMetError: 412,
     ResourceExhaustedError: 429,   # overload / queue_cap — retry later
+    UnimplementedError: 501,
+    ExternalError: 502,            # a dependency outside the framework
     UnavailableError: 503,         # brownout / no healthy replica
     DeadlineExceededError: 504,
     ExecutionTimeoutError: 504,
     InternalError: 500,
     FatalError: 500,
+    # explicit base fallback: EVERY taxonomy class resolves to a status
+    # through the MRO walk (tools/analyze error-taxonomy pins this)
+    EnforceNotMet: 500,
 }
 
 

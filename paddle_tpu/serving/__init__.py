@@ -47,6 +47,15 @@ Components
 The attention primitive lives with the other Pallas kernels
 (ops/pallas_ops/paged_attention.py, routed via ops/attention.py).
 """
+from ..framework.concurrency import declare_hierarchy as _declare_hierarchy
+
+# The serving fleet's declared lock hierarchy (docs/ANALYSIS.md),
+# outermost first: frontend RLock > router RLock > handle condvar >
+# metrics locks.  The framework.concurrency witness enforces it (and
+# hunts undeclared ABBA cycles) whenever tests run with the witness on.
+_declare_hierarchy("serving.frontend", "serving.router",
+                   "serving.handle", "serving.metrics")
+
 from .engine import ServingEngine, create_serving_engine
 from .frontend import (ResponseHandle, ServingFrontend,
                        create_serving_frontend)

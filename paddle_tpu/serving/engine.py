@@ -45,7 +45,6 @@ Execution model
 """
 from __future__ import annotations
 
-import threading
 import time
 import weakref
 from collections import deque
@@ -55,6 +54,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..framework.concurrency import OrderedLock
+from ..framework.errors import (AlreadyExistsError, InternalError,
+                                InvalidArgumentError)
 from ..profiler.jit_cost import cost_registry, profiled_jit
 from ..testing.chaos import chaos_site
 from ..utils.bucketing import chunk_schedule, next_pow2, smallest_bucket
@@ -80,7 +82,7 @@ __all__ = ["ServingEngine", "create_serving_engine"]
 # builds fresh buffers each call); only the pure compiled programs and
 # the derived int8 weights are shared.
 _PROGRAM_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
-_PROGRAM_LOCK = threading.Lock()
+_PROGRAM_LOCK = OrderedLock("serving.programs")
 
 
 def _shared_programs(model, *, page_size: int, pages_per_seq: int,
@@ -266,7 +268,7 @@ class ServingEngine:
         model_max = int(model.wpe.weight.shape[0])
         self.max_seq_len = int(max_seq_len) if max_seq_len else model_max
         if self.max_seq_len > model_max:
-            raise ValueError(
+            raise InvalidArgumentError(
                 f"max_seq_len ({self.max_seq_len}) exceeds the model's "
                 f"position table ({model_max})")
         self.pages_per_seq = -(-self.max_seq_len // self.page_size)
@@ -309,7 +311,8 @@ class ServingEngine:
             if d not in (None, "int8"):
                 # no silent degradation: the pools/weights stay in the
                 # model's native dtype unless int8 is asked for
-                raise ValueError(f"{knob} must be None or 'int8', "
+                raise InvalidArgumentError(
+                    f"{knob} must be None or 'int8', "
                                  f"got {d!r}")
         self.kv_cache_dtype = kv_cache_dtype
         self.weight_dtype = weight_dtype
@@ -317,7 +320,7 @@ class ServingEngine:
                 and weight_dtype is None:
             # an export without the knobs would silently run native —
             # an "int8 vs native" comparison measuring native vs native
-            raise ValueError(
+            raise InvalidArgumentError(
                 "quant_scales was provided but kv_cache_dtype and "
                 "weight_dtype are both unset — pass kv_cache_dtype='int8' "
                 "and/or weight_dtype='int8' (e.g. via "
@@ -382,13 +385,13 @@ class ServingEngine:
             prompt = prompt.numpy()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
-            raise ValueError("empty prompt")
+            raise InvalidArgumentError("empty prompt")
         if max_new_tokens < 1:
-            raise ValueError("max_new_tokens must be >= 1")
+            raise InvalidArgumentError("max_new_tokens must be >= 1")
         if prompt.size + max_new_tokens > self.max_seq_len:
             # mirror generate()'s guard: past the wpe table the position
             # gather would silently clamp — degraded text with no error
-            raise ValueError(
+            raise InvalidArgumentError(
                 f"prompt ({prompt.size}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_seq_len "
                 f"({self.max_seq_len})")
@@ -397,7 +400,7 @@ class ServingEngine:
         need = self.cache.pages_needed(prompt.size + max_new_tokens - 1)
         cap = min(self.cache.num_pages - 1, self.pages_per_seq)
         if need > cap:
-            raise ValueError(
+            raise InvalidArgumentError(
                 f"request needs {need} KV pages (prompt {prompt.size} + "
                 f"{max_new_tokens} new tokens @ page_size "
                 f"{self.page_size}) but the cache caps a sequence at "
@@ -429,7 +432,7 @@ class ServingEngine:
                 or any(s.seq_id == request_id
                        for s in self.scheduler.running))
         if live:
-            raise ValueError(
+            raise AlreadyExistsError(
                 f"request_id {request_id!r} is already in flight or "
                 "has an unconsumed output")
 
@@ -562,11 +565,11 @@ class ServingEngine:
         Raises ValueError on geometry/mode mismatch or a live duplicate
         id."""
         if snap.page_size != self.page_size:
-            raise ValueError(
+            raise InvalidArgumentError(
                 f"snapshot page_size {snap.page_size} != engine "
                 f"page_size {self.page_size}")
         if snap.kv_mode != self.kv_mode():
-            raise ValueError(
+            raise InvalidArgumentError(
                 f"snapshot kv_mode {snap.kv_mode!r} != engine kv_mode "
                 f"{self.kv_mode()!r} — snapshots are portable only "
                 "between replicas of one serving configuration")
@@ -1002,7 +1005,7 @@ class ServingEngine:
             self.step()
             steps += 1
             if steps > max_steps:
-                raise RuntimeError(
+                raise InternalError(
                     f"drain did not converge within {max_steps} steps")
         out, self.outputs = self.outputs, {}
         return out
@@ -1076,7 +1079,7 @@ def create_serving_engine(model, config=None, **overrides) -> ServingEngine:
     kwargs = {}
     if config is not None:
         if not getattr(config, "serving_enabled", lambda: False)():
-            raise ValueError(
+            raise InvalidArgumentError(
                 "config has serving disabled — call "
                 "Config.enable_serving(...) first")
         kwargs.update(config.serving_config())

@@ -57,7 +57,10 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from ..framework.errors import (DeadlineExceededError, InternalError,
+from ..framework.concurrency import OrderedCondition, OrderedRLock
+from ..framework.errors import (AlreadyExistsError,
+                                DeadlineExceededError, EnforceNotMet,
+                                ExecutionTimeoutError, InternalError,
                                 InvalidArgumentError,
                                 ResourceExhaustedError, UnavailableError)
 from ..testing.chaos import chaos_site
@@ -108,7 +111,7 @@ class ResponseHandle:
 
     def __init__(self, request_id: str, max_new_tokens: int,
                  deadline: Optional[float], frontend: "ServingFrontend"):
-        self._cond = threading.Condition()
+        self._cond = OrderedCondition("serving.handle")
         self.request_id = request_id
         self.max_new_tokens = int(max_new_tokens)
         self.deadline = deadline          # absolute monotonic or None
@@ -259,17 +262,24 @@ class ResponseHandle:
         with self._cond:
             if not self._cond.wait_for(
                     lambda: self._status in TERMINAL_STATUSES, timeout):
-                raise TimeoutError(
+                raise ExecutionTimeoutError(
                     f"request {self.request_id} not terminal after "
                     f"{timeout}s (status {self._status!r})")
             return self._status
 
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         """Block until terminal; returns the generated tokens on
-        completion, raises RuntimeError on any other outcome."""
+        completion.  Any other outcome raises the outcome's own
+        framework.errors class (every one is-a RuntimeError via
+        EnforceNotMet, so pre-taxonomy ``except RuntimeError`` callers
+        still work)."""
         status = self.wait(timeout)
         if status != COMPLETED:
-            raise RuntimeError(
+            # typed: the terminal outcome's taxonomy class (the same
+            # one the HTTP layer derives its status from); cancelled
+            # carries no error class and raises the taxonomy base
+            cls = self.error_cls or EnforceNotMet
+            raise cls(
                 f"request {self.request_id} {status}"
                 + (f": {self.detail}" if self.detail else ""))
         return self.tokens
@@ -401,14 +411,15 @@ class ServingFrontend:
           placement failures (router.pick_with_retry).
         """
         if model is None and engine_factory is None:
-            raise ValueError("pass a model or an engine_factory")
+            raise InvalidArgumentError(
+                "pass a model or an engine_factory")
         if engine_factory is not None and engine_kwargs:
-            raise ValueError(
+            raise InvalidArgumentError(
                 "engine_kwargs and engine_factory are mutually "
                 "exclusive — the factory owns engine construction, so "
                 "the kwargs would be silently ignored")
         if replicas < 1:
-            raise ValueError("replicas must be >= 1")
+            raise InvalidArgumentError("replicas must be >= 1")
         self.metrics = metrics or FrontendMetrics()
         # ONE ServingMetrics across replicas: the process-global
         # serving.* registry names hold fleet aggregates instead of N
@@ -446,8 +457,9 @@ class ServingFrontend:
         if watchdog:
             if watchdog is not True and not isinstance(watchdog,
                                                        WatchdogConfig):
-                raise TypeError("watchdog must be True or a "
-                                f"WatchdogConfig, got {watchdog!r}")
+                raise InvalidArgumentError(
+                    "watchdog must be True or a "
+                    f"WatchdogConfig, got {watchdog!r}")
             self.watchdog = Watchdog(
                 watchdog if isinstance(watchdog, WatchdogConfig) else None)
         # brownout: False/None = off; True = defaults; or a policy
@@ -455,11 +467,12 @@ class ServingFrontend:
         if brownout:
             if brownout is not True and not isinstance(brownout,
                                                        BrownoutPolicy):
-                raise TypeError("brownout must be True or a "
-                                f"BrownoutPolicy, got {brownout!r}")
+                raise InvalidArgumentError(
+                    "brownout must be True or a "
+                    f"BrownoutPolicy, got {brownout!r}")
             self.brownout = BrownoutController(
                 brownout if isinstance(brownout, BrownoutPolicy) else None)
-        self._lock = threading.RLock()
+        self._lock = OrderedRLock("serving.frontend")
         self._live: Dict[str, _Entry] = {}
         self._closing = False
         self._rid = itertools.count()
@@ -529,7 +542,8 @@ class ServingFrontend:
         cost = int(prompt.size) + int(max_new_tokens)
         with self._lock:
             if rid in self._live:
-                raise ValueError(f"request_id {rid!r} is already live")
+                raise AlreadyExistsError(
+                    f"request_id {rid!r} is already live")
             # counted only once the request is accepted as a real
             # submission (raises above don't inflate the counter), but
             # BEFORE the terminal-at-submit outcomes — so submitted ==
@@ -1064,7 +1078,7 @@ def create_serving_frontend(model, config=None, **overrides
     engine_kwargs: dict = {}
     if config is not None:
         if not getattr(config, "serving_enabled", lambda: False)():
-            raise ValueError(
+            raise InvalidArgumentError(
                 "config has serving disabled — call "
                 "Config.enable_serving(...) first")
         engine_kwargs.update(config.serving_config())

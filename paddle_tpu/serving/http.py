@@ -33,7 +33,7 @@ import http.server
 import json
 import threading
 
-from ..framework.errors import http_status_for
+from ..framework.errors import InvalidArgumentError, http_status_for
 from ..profiler.exposition import prometheus_text
 from ..testing.chaos import chaos_site
 from .frontend import CANCELLED, COMPLETED, ServingFrontend
@@ -137,7 +137,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             length = int(self.headers.get("Content-Length", "0"))
             body = json.loads(self.rfile.read(length) or b"{}")
             if not isinstance(body, dict):
-                raise ValueError("body must be a JSON object")
+                raise InvalidArgumentError("body must be a JSON object")
         except (ValueError, json.JSONDecodeError) as e:
             self._send_json(400, {"error": f"bad request body: {e}"})
             return

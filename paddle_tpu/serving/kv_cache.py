@@ -39,6 +39,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..framework.errors import InvalidArgumentError
+
 from ..testing.chaos import chaos_site
 
 __all__ = ["PagedKVCache", "KV_SCALE_EPS", "kv_page_bytes",
@@ -59,8 +61,9 @@ def kv_page_bytes(page_size: int, num_heads: int, head_dim: int,
     try:
         itemsize = _KV_ITEMSIZE[str(dtype)]
     except KeyError:
-        raise ValueError(f"unknown KV cache dtype {dtype!r}; one of "
-                         f"{sorted(_KV_ITEMSIZE)}")
+        raise InvalidArgumentError(
+            f"unknown KV cache dtype {dtype!r}; one of "
+            f"{sorted(_KV_ITEMSIZE)}")
     n = page_size * num_heads * head_dim * itemsize
     if itemsize == 1:
         n += num_heads * 4            # fp32 scale per head
@@ -97,10 +100,12 @@ class PagedKVCache:
 
     def __init__(self, num_pages: int, page_size: int, pages_per_seq: int):
         if num_pages < 2:
-            raise ValueError("num_pages must be >= 2 (page 0 is the "
-                             "reserved trash page)")
+            raise InvalidArgumentError(
+                "num_pages must be >= 2 (page 0 is the "
+                "reserved trash page)")
         if page_size < 1 or pages_per_seq < 1:
-            raise ValueError("page_size and pages_per_seq must be >= 1")
+            raise InvalidArgumentError(
+                "page_size and pages_per_seq must be >= 1")
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
         self.pages_per_seq = int(pages_per_seq)

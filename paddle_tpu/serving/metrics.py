@@ -18,10 +18,10 @@ programs carry FLOPs/bytes attribution via
 """
 from __future__ import annotations
 
-import threading
 import time
 from typing import Optional
 
+from ..framework.concurrency import OrderedLock
 from ..framework.monitor import stat_registry
 
 __all__ = ["ServingMetrics", "FrontendMetrics"]
@@ -59,7 +59,7 @@ class ServingMetrics:
                   "serving.failover_recovery_ms")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("serving.metrics")
         self.reset()
 
     def reset(self):
@@ -277,7 +277,7 @@ class FrontendMetrics:
     HISTOGRAMS = ("serving.frontend.ttft_ms", "serving.frontend.e2e_ms")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("serving.metrics")
         self.reset()
 
     def reset(self):

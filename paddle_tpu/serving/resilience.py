@@ -26,13 +26,13 @@ docs/SERVING.md "Resilience".
 """
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..framework.concurrency import OrderedLock
 from ..framework.monitor import stat_registry
 
 __all__ = ["EngineSnapshot", "WatchdogConfig", "Watchdog",
@@ -187,7 +187,7 @@ class Watchdog:
         # pump threads observe_step() while the monitor thread reads the
         # rolling window through check()/threshold_s() — an unguarded
         # list shrink mid-np.asarray would crash the monitor
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("serving.watchdog")
 
     def _w(self, replica_id: str) -> _ReplicaWatch:
         w = self._watch.get(replica_id)

@@ -42,6 +42,9 @@ import threading
 import time
 from typing import List, Optional
 
+from ..framework.concurrency import OrderedRLock
+from ..framework.errors import AlreadyExistsError, NotFoundError
+
 __all__ = ["Replica", "Router", "HEALTHY", "SUSPECT", "DRAINING", "DEAD"]
 
 HEALTHY = "healthy"
@@ -122,7 +125,7 @@ class Router:
     wires its fleet-shared instance in."""
 
     def __init__(self, metrics=None):
-        self._lock = threading.RLock()
+        self._lock = OrderedRLock("serving.router")
         self.replicas: List[Replica] = []
         self.metrics = metrics
 
@@ -130,7 +133,8 @@ class Router:
     def add(self, replica: Replica):
         with self._lock:
             if any(r.id == replica.id for r in self.replicas):
-                raise ValueError(f"duplicate replica id {replica.id!r}")
+                raise AlreadyExistsError(
+                    f"duplicate replica id {replica.id!r}")
             self.replicas.append(replica)
 
     def get(self, replica_id: str) -> Replica:
@@ -138,7 +142,7 @@ class Router:
             for r in self.replicas:
                 if r.id == replica_id:
                     return r
-        raise KeyError(f"unknown replica {replica_id!r}")
+        raise NotFoundError(f"unknown replica {replica_id!r}")
 
     # --- placement ----------------------------------------------------------
     def pick(self, cost: int = 0,

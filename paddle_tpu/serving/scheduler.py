@@ -41,6 +41,8 @@ from typing import Deque, List, Optional
 
 import numpy as np
 
+from ..framework.errors import (InvalidArgumentError,
+                                ResourceExhaustedError)
 from ..utils.bucketing import pow2_buckets, smallest_bucket
 from .kv_cache import PagedKVCache
 
@@ -68,7 +70,7 @@ class Request:
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
         if self.prompt.size == 0:
-            raise ValueError("empty prompt")
+            raise InvalidArgumentError("empty prompt")
         if not self.request_id:
             self.request_id = f"req-{next(_req_counter)}"
 
@@ -149,7 +151,8 @@ class Scheduler:
             bucket_sizes = pow2_buckets(self.max_batch_size)
         self.bucket_sizes = sorted(set(int(b) for b in bucket_sizes))
         if self.bucket_sizes[-1] < self.max_batch_size:
-            raise ValueError("largest bucket must cover max_batch_size")
+            raise InvalidArgumentError(
+                "largest bucket must cover max_batch_size")
         self.max_admissions_per_step = max_admissions_per_step
         self.waiting: Deque[Request] = deque()
         self.running: List[Sequence] = []
@@ -223,7 +226,7 @@ class Scheduler:
             while not self.cache.allocate(seq.seq_id, seq.pos + 1):
                 victim = self._pick_victim(exclude=seq)
                 if victim is None:
-                    raise RuntimeError(
+                    raise ResourceExhaustedError(
                         f"KV cache exhausted: sequence {seq.seq_id} needs "
                         f"{self.cache.pages_needed(seq.pos + 1)} pages but "
                         f"only {self.cache.free_pages} free and no other "

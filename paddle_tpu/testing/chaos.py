@@ -42,9 +42,10 @@ no plan is installed — production paths pay nothing.
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 from typing import Dict, List, Optional
+
+from ..framework.concurrency import OrderedLock
 
 __all__ = ["Fault", "ChaosPlan", "install", "uninstall", "active_plan",
            "running", "chaos_site", "DENY", "RAISE", "DELAY", "KILL",
@@ -116,7 +117,7 @@ class ChaosPlan:
 
     def __init__(self, faults=(), seed: Optional[int] = None,
                  name: str = ""):
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("chaos.plan")
         self.faults: List[Fault] = list(faults)
         self.seed = seed
         self.name = name or ("chaos-plan" if seed is None
@@ -184,7 +185,7 @@ class ChaosPlan:
 
 # --- global installation ----------------------------------------------------
 _ACTIVE: Optional[ChaosPlan] = None
-_INSTALL_LOCK = threading.Lock()
+_INSTALL_LOCK = OrderedLock("chaos.install")
 
 
 def install(plan: Optional[ChaosPlan]):

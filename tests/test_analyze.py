@@ -1,0 +1,317 @@
+"""tools/analyze AST lint suite (ISSUE 7) — planted-violation fixtures
+per checker, live-repo cleanliness, and the CLI exit-code contract
+(bench_diff-style, in-process `main(argv)` plus one stdlib-only
+subprocess proving `python -m tools.analyze`).
+"""
+import os
+import subprocess
+import sys
+import textwrap
+from collections import Counter
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools.analyze import main as analyze_main  # noqa: E402
+from tools.analyze import run_checks  # noqa: E402
+from tools.analyze import core as analyze_core  # noqa: E402
+from tools.analyze.core import (AnalysisContext, Finding,  # noqa: E402
+                                load_baseline, new_findings)
+from tools.analyze.metrics_drift import collect_doc_names  # noqa: E402
+
+
+def make_tree(tmp_path, files):
+    for rel, content in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(content))
+    return str(tmp_path)
+
+
+# =============================================================================
+# lock-discipline
+# =============================================================================
+class TestLockDiscipline:
+    def test_planted_violations_and_exemptions(self, tmp_path):
+        root = make_tree(tmp_path, {"paddle_tpu/serving/bad.py": '''
+            import threading
+            import time
+
+
+            class F:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cond = threading.Condition()
+
+                def bad_sleep(self):
+                    with self._lock:
+                        time.sleep(0.1)
+
+                def bad_foreign_wait(self, other):
+                    with self._lock:
+                        other.wait()
+
+                def bad_engine_step(self, eng):
+                    with self._lock:
+                        eng.step()
+
+                def bad_rpc(self):
+                    with self._lock:
+                        self.table.pull([1])
+
+                def ok_condvar_wait(self):
+                    with self._cond:
+                        self._cond.wait_for(lambda: True)
+
+                def ok_nested_def_runs_later(self):
+                    with self._lock:
+                        def later():
+                            time.sleep(1)
+                        return later
+
+                def ok_suppressed(self):
+                    with self._lock:
+                        time.sleep(0)  # analyze: allow[lock-discipline] test
+
+                def ok_not_under_lock(self):
+                    time.sleep(0.1)
+            '''})
+        found = run_checks(root=root, checks=["lock-discipline"])
+        msgs = sorted(f.message for f in found)
+        assert len(found) == 4, msgs
+        assert all(f.code == "LD001" for f in found)
+        assert any("time.sleep" in m for m in msgs)
+        assert any("wait on 'other'" in m for m in msgs)
+        assert any("engine step" in m for m in msgs)
+        assert any("backing-table" in m for m in msgs)
+
+    def test_live_repo_clean(self):
+        assert run_checks(root=ROOT, checks=["lock-discipline"]) == []
+
+
+# =============================================================================
+# jit-hazard
+# =============================================================================
+class TestJitHazard:
+    def test_planted_violations_by_all_three_detections(self, tmp_path):
+        root = make_tree(tmp_path, {"paddle_tpu/ops/badjit.py": '''
+            import jax
+            import numpy as np
+
+
+            @jax.jit
+            def decorated(x):
+                return np.asarray(x)
+
+
+            def _wrapped(x):
+                return x.item()
+
+
+            w = jax.jit(_wrapped)
+
+
+            def marked(x):  # analyze: jit-path
+                return x.tolist()
+
+
+            def plain_host_helper(x):
+                return np.asarray(x)
+
+
+            class Executor:
+                def run(self, x):
+                    # same NAME as a jitted closure elsewhere must not
+                    # be flagged: class scopes are not in the lexical
+                    # lookup chain
+                    return np.asarray(x)
+
+
+            def outer():
+                def run(x):
+                    return x + 1
+                return jax.jit(run)
+            '''})
+        found = run_checks(root=root, checks=["jit-hazard"])
+        assert all(f.code == "JH001" for f in found)
+        flagged_fns = sorted({f.message.split("'")[1] for f in found})
+        assert flagged_fns == ["_wrapped", "decorated", "marked"]
+
+    def test_live_repo_clean(self):
+        assert run_checks(root=ROOT, checks=["jit-hazard"]) == []
+
+
+# =============================================================================
+# metrics-drift
+# =============================================================================
+class TestMetricsDrift:
+    def test_planted_drift_both_directions(self, tmp_path):
+        root = make_tree(tmp_path, {
+            "paddle_tpu/m.py": '''
+                from paddle_tpu.framework.monitor import stat_registry
+                from paddle_tpu.profiler.jit_cost import profiled_jit
+
+
+                def f():
+                    stat_registry.get("serving.documented").add(1)
+                    stat_registry.get("serving.undocumented").add(1)
+                    prog = profiled_jit("serving.attribution_name", f)
+                    return prog
+                ''',
+            "docs/OBSERVABILITY.md": """
+                The engine emits `serving.documented` and promises
+                `serving.orphan_metric`; `serving.attribution_name` is a
+                jit-cost attribution name, exempt from the emitted set.
+                """})
+        found = run_checks(root=root, checks=["metrics-drift"])
+        by_code = {}
+        for f in found:
+            by_code.setdefault(f.code, []).append(f.message)
+        assert len(by_code.get("MD001", [])) == 1
+        assert "serving.undocumented" in by_code["MD001"][0]
+        assert len(by_code.get("MD002", [])) == 1
+        assert "serving.orphan_metric" in by_code["MD002"][0]
+
+    def test_doc_shorthand_expansion(self, tmp_path):
+        root = make_tree(tmp_path, {"docs/OBSERVABILITY.md": """
+            counters: `serving.frontend.submitted`, `.completed` and
+            `.rejects`; resilience adds `serving.{snapshots,restores}`.
+            Wildcards like `serving.frontend.*` and class references
+            like `serving.FrontendMetrics` are ignored.
+            """})
+        names = collect_doc_names(AnalysisContext(root))
+        assert set(names) == {
+            "serving.frontend.submitted", "serving.frontend.completed",
+            "serving.frontend.rejects", "serving.snapshots",
+            "serving.restores"}
+
+    def test_live_repo_clean(self):
+        assert run_checks(root=ROOT, checks=["metrics-drift"]) == []
+
+
+# =============================================================================
+# error-taxonomy
+# =============================================================================
+class TestErrorTaxonomy:
+    def test_planted_violations(self, tmp_path):
+        root = make_tree(tmp_path, {
+            "paddle_tpu/framework/errors.py": '''
+                class EnforceNotMet(RuntimeError):
+                    pass
+
+
+                class GoodError(EnforceNotMet):
+                    pass
+
+
+                class OrphanError(RuntimeError):
+                    pass
+
+
+                ERROR_HTTP_STATUS = {EnforceNotMet: 500}
+                ''',
+            "paddle_tpu/serving/s.py": '''
+                from ..framework.errors import GoodError
+
+
+                def f(x):
+                    if x:
+                        raise GoodError("fine")
+                    raise ValueError("ad hoc")
+
+
+                def g(e):
+                    raise e
+
+
+                def h():
+                    try:
+                        f(0)
+                    except GoodError:
+                        raise
+                '''})
+        found = run_checks(root=root, checks=["error-taxonomy"])
+        pairs = [(f.code, f.message) for f in found]
+        assert any(c == "ET001" and "ValueError" in m for c, m in pairs)
+        assert any(c == "ET002" and "OrphanError" in m for c, m in pairs)
+        assert len(found) == 2      # GoodError / bare / `raise e` exempt
+
+    def test_live_repo_clean(self):
+        assert run_checks(root=ROOT, checks=["error-taxonomy"]) == []
+
+
+# =============================================================================
+# runner / baseline / CLI contract
+# =============================================================================
+class TestRunnerAndCLI:
+    def test_live_repo_analyzer_clean_and_baseline_empty(self):
+        """The ISSUE 7 acceptance pin: zero non-baselined findings AND a
+        baseline with zero grandfathered entries — the repo is
+        analyzer-clean outright, not clean-modulo-debt."""
+        findings = run_checks(root=ROOT)
+        assert new_findings(findings, load_baseline()) == []
+        assert sum(load_baseline().values()) == 0
+        assert findings == []
+
+    def test_new_findings_multiset_subtraction(self):
+        f = Finding("a.py", 3, "XX001", "x", "msg")
+        g = Finding("a.py", 9, "XX001", "x", "msg")   # same key, new line
+        base = Counter({f.key(): 1})
+        assert new_findings([f], base) == []
+        assert new_findings([f, g], base) == [g]      # one allowed, one new
+        assert new_findings([f], Counter()) == [f]
+
+    def test_cli_exit_codes(self, tmp_path, capsys):
+        assert analyze_main(["--root", ROOT]) == 0
+        assert analyze_main(["--root", ROOT,
+                             "--check", "error-taxonomy"]) == 0
+        assert analyze_main(["--check", "bogus"]) == 2
+        root = make_tree(tmp_path, {"paddle_tpu/serving/bad.py": '''
+            def f():
+                raise ValueError("x")
+            '''})
+        assert analyze_main(["--root", root,
+                             "--check", "error-taxonomy"]) == 1
+        out = capsys.readouterr().out
+        assert "ET001" in out and "bad.py:3" in out
+
+    def test_cli_baseline_roundtrip(self, tmp_path, capsys,
+                                    monkeypatch):
+        """--baseline grandfathers the current findings; the next run
+        exits 0 (and a NEW finding still fails)."""
+        monkeypatch.setattr(analyze_core, "baseline_path",
+                            lambda: str(tmp_path / "baseline.txt"))
+        root = make_tree(tmp_path, {"paddle_tpu/serving/bad.py": '''
+            def f():
+                raise ValueError("x")
+            '''})
+        args = ["--root", root, "--check", "error-taxonomy"]
+        assert analyze_main(args) == 1
+        assert analyze_main(args + ["--baseline"]) == 0
+        assert analyze_main(args) == 0
+        (tmp_path / "paddle_tpu/serving/worse.py").write_text(
+            "def g():\n    raise KeyError('y')\n")
+        assert analyze_main(args) == 1
+
+    def test_module_cli_subprocess(self):
+        """`python -m tools.analyze --list` works from the repo root —
+        the real invocation CI uses (stdlib-only import, fast)."""
+        res = subprocess.run(
+            [sys.executable, "-m", "tools.analyze", "--list"],
+            cwd=ROOT, capture_output=True, text=True, timeout=60)
+        assert res.returncode == 0, res.stderr
+        names = res.stdout.split()
+        assert names == sorted(["error-taxonomy", "jit-hazard",
+                                "lock-discipline", "metrics-drift"])
+
+    def test_suppression_requires_matching_check_name(self, tmp_path):
+        root = make_tree(tmp_path, {"paddle_tpu/serving/bad.py": '''
+            def f():
+                raise ValueError("x")  # analyze: allow[lock-discipline]
+            '''})
+        # wrong check name in the marker: the finding survives
+        found = run_checks(root=root, checks=["error-taxonomy"])
+        assert len(found) == 1

@@ -1,0 +1,238 @@
+"""framework.concurrency lock-order witness units (ISSUE 7 satellite).
+
+Pure host, sub-second: no engines, no jax arrays.  Pins the witness
+contract the serving fleet's chaos/resilience/metrics-hammer tests rely
+on: a seeded ABBA inversion is detected with BOTH acquisition stacks, a
+declared-hierarchy violation raises, re-entrant RLock acquisition and
+condition waits do not false-positive, and an 8-thread consistent-order
+hammer stays clean.
+"""
+import threading
+
+import pytest
+
+from paddle_tpu.framework import concurrency as cc
+from paddle_tpu.framework.concurrency import (LockOrderViolation,
+                                              OrderedCondition,
+                                              OrderedLock, OrderedRLock)
+
+
+@pytest.fixture(autouse=True)
+def _clean_witness():
+    cc.reset()
+    cc.disable_witness()
+    yield
+    cc.disable_witness()
+    cc.reset()
+
+
+def _in_thread(fn):
+    err = []
+
+    def run():
+        try:
+            fn()
+        except BaseException as e:      # noqa: BLE001 — surfaced below
+            err.append(e)
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join(10)
+    assert not t.is_alive()
+    return err
+
+
+class TestABBA:
+    def test_seeded_inversion_detected_with_both_stacks(self):
+        a, b = OrderedLock("t.A"), OrderedLock("t.B")
+        cc.enable_witness(raise_on_violation=True)
+
+        def take_a_then_b():            # seeds the A -> B edge
+            with a:
+                with b:
+                    pass
+
+        assert _in_thread(take_a_then_b) == []
+        assert ("t.A", "t.B") in cc.graph_edges()
+        # now the reverse order closes the cycle
+        with pytest.raises(LockOrderViolation) as ei:
+            with b:
+                with a:
+                    pass
+        msg = str(ei.value)
+        assert "cycle" in msg and "t.A" in msg and "t.B" in msg
+        # BOTH acquisition stacks are in the report: this function's
+        # frame (current acquisition) and the seeding thread's frame
+        assert "test_seeded_inversion_detected_with_both_stacks" in msg
+        assert "take_a_then_b" in msg
+
+    def test_record_mode_collects_instead_of_raising(self):
+        a, b = OrderedLock("t.rA"), OrderedLock("t.rB")
+        cc.enable_witness(raise_on_violation=False)
+        assert _in_thread(lambda: _nest(a, b)) == []
+        with b:
+            with a:                      # inversion — recorded, no raise
+                pass
+        kinds = [v.kind for v in cc.violations()]
+        assert "cycle" in kinds
+        with pytest.raises(LockOrderViolation):
+            cc.assert_clean()
+
+    def test_three_lock_cycle(self):
+        a, b, c = (OrderedLock(n) for n in ("t.c1", "t.c2", "t.c3"))
+        cc.enable_witness(raise_on_violation=False)
+        _in_thread(lambda: _nest(a, b))
+        _in_thread(lambda: _nest(b, c))
+        _in_thread(lambda: _nest(c, a))   # closes c1->c2->c3->c1
+        assert any(v.kind == "cycle" for v in cc.violations())
+
+
+def _nest(outer, inner):
+    with outer:
+        with inner:
+            pass
+
+
+class TestHierarchy:
+    def test_declared_hierarchy_violation_raises(self):
+        cc.declare_hierarchy("t.h.outer", "t.h.inner")
+        outer, inner = OrderedLock("t.h.outer"), OrderedLock("t.h.inner")
+        cc.enable_witness(raise_on_violation=True)
+        with outer:                       # declared order: fine
+            with inner:
+                pass
+        with pytest.raises(LockOrderViolation, match="hierarchy"):
+            with inner:
+                with outer:
+                    pass
+
+    def test_independent_chains_do_not_interact(self):
+        cc.declare_hierarchy("t.ch1.a", "t.ch1.b")
+        cc.declare_hierarchy("t.ch2.a", "t.ch2.b")
+        x, y = OrderedLock("t.ch2.b"), OrderedLock("t.ch1.a")
+        cc.enable_witness(raise_on_violation=True)
+        with x:                           # cross-chain: rank-exempt
+            with y:
+                pass
+        assert cc.violations() == []
+
+    def test_redeclaration_idempotent_conflict_raises(self):
+        cc.declare_hierarchy("t.re.a", "t.re.b")
+        cc.declare_hierarchy("t.re.a", "t.re.b")      # idempotent
+        with pytest.raises(ValueError, match="redeclaration"):
+            cc.declare_hierarchy("t.re.b", "t.re.a")
+
+    def test_same_name_nesting_flagged(self):
+        l1, l2 = OrderedLock("t.same"), OrderedLock("t.same")
+        cc.enable_witness(raise_on_violation=False)
+        with l1:
+            with l2:
+                pass
+        assert [v.kind for v in cc.violations()] == ["self"]
+
+
+class TestNoFalsePositives:
+    def test_reentrant_rlock(self):
+        cc.declare_hierarchy("t.rl.outer", "t.rl.inner")
+        r = OrderedRLock("t.rl.outer")
+        inner = OrderedLock("t.rl.inner")
+        cc.enable_witness(raise_on_violation=True)
+        with r:
+            with r:                       # re-entrant: no self edge
+                with inner:
+                    pass
+            with r:
+                pass
+        assert cc.violations() == []
+        assert cc.held_names() == []
+
+    def test_condition_wait_drops_held_set(self):
+        cond = OrderedCondition("t.cv")
+        other = OrderedLock("t.cv.other")
+        cc.enable_witness(raise_on_violation=True)
+        ready = threading.Event()
+
+        def waiter():
+            with cond:
+                ready.set()
+                # while waiting the thread must hold NOTHING in the
+                # witness view (wait releases the lock)
+                cond.wait(timeout=5)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        ready.wait(5)
+        # notifier: takes `other` then the condvar — if the waiter's
+        # held-set leaked, patterns like this would build false edges
+        with other:
+            with cond:
+                cond.notify_all()
+        t.join(5)
+        assert not t.is_alive()
+        assert cc.violations() == []
+
+    def test_wait_for_rerecords_on_wakeup(self):
+        cond = OrderedCondition("t.cv2")
+        state = {"go": False, "held_after": None}
+
+        cc.enable_witness(raise_on_violation=True)
+
+        def waiter():
+            with cond:
+                cond.wait_for(lambda: state["go"], timeout=5)
+                state["held_after"] = cc.held_names()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        with cond:
+            state["go"] = True
+            cond.notify_all()
+        t.join(5)
+        assert state["held_after"] == ["t.cv2"]
+        assert cc.violations() == []
+
+    def test_disabled_witness_records_nothing(self):
+        a, b = OrderedLock("t.off.a"), OrderedLock("t.off.b")
+        _nest(a, b)
+        _nest(b, a)                       # inversion — witness off
+        assert cc.graph_edges() == []
+        assert cc.violations() == []
+
+
+class TestHammer:
+    def test_8_thread_consistent_order_stays_clean(self):
+        """8 threads hammering a consistent A->B->C order plus
+        independent per-thread locks: zero violations, empty held-sets,
+        and the graph holds exactly the consistent edges."""
+        cc.declare_hierarchy("t.hm.a", "t.hm.b", "t.hm.c")
+        a, b, c = (OrderedLock(n) for n in ("t.hm.a", "t.hm.b", "t.hm.c"))
+        privates = [OrderedLock(f"t.hm.p{i}") for i in range(8)]
+        cc.enable_witness(raise_on_violation=True)
+        barrier = threading.Barrier(8)
+        errs = []
+
+        def work(i):
+            try:
+                barrier.wait()
+                for _ in range(200):
+                    with a:
+                        with b:
+                            with c:
+                                pass
+                    with privates[i]:
+                        with c:           # p_i -> c is order-consistent
+                            pass
+            except BaseException as e:    # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert not errs
+        assert cc.violations() == []
+        edges = set(cc.graph_edges())
+        assert {("t.hm.a", "t.hm.b"), ("t.hm.b", "t.hm.c")} <= edges
+        assert all(not t.is_alive() for t in threads)

@@ -14,6 +14,21 @@ from paddle_tpu.framework.monitor import (Histogram, LabeledGauge,
                                           stat_registry)
 from paddle_tpu.serving import FrontendMetrics, ServingMetrics
 
+
+@pytest.fixture(autouse=True)
+def _lock_witness():
+    """ISSUE 7: every run of this file doubles as a deadlock detector —
+    the framework.concurrency witness records lock-order inversions
+    (ABBA cycles, declared-hierarchy violations) across all the threads
+    the scenarios spin up, and teardown asserts ZERO were seen.
+    Record-only mode: raising inside a pump thread would masquerade as
+    an engine crash and derail the scenario under test."""
+    from paddle_tpu.framework import concurrency
+
+    with concurrency.witness(raise_on_violation=False):
+        yield
+    concurrency.assert_clean()
+
 THREADS = 8
 ITERS = 1500
 

@@ -43,6 +43,21 @@ from paddle_tpu.testing import chaos
 from paddle_tpu.testing.chaos import ChaosPlan, Fault
 from paddle_tpu.text.generation import generate
 
+
+@pytest.fixture(autouse=True)
+def _lock_witness():
+    """ISSUE 7: every run of this file doubles as a deadlock detector —
+    the framework.concurrency witness records lock-order inversions
+    (ABBA cycles, declared-hierarchy violations) across all the threads
+    the scenarios spin up, and teardown asserts ZERO were seen.
+    Record-only mode: raising inside a pump thread would masquerade as
+    an engine crash and derail the scenario under test."""
+    from paddle_tpu.framework import concurrency
+
+    with concurrency.witness(raise_on_violation=False):
+        yield
+    concurrency.assert_clean()
+
 VOCAB, HID, LAYERS, HEADS = 50, 32, 2, 2
 ENGINE_KW = dict(page_size=4, max_batch_size=4, eos_id=0)
 
@@ -563,8 +578,14 @@ class TestChaosAcceptance:
         p2, h2, statuses_b, leaks_b, states_b = _drive_chaos(gpt, plan_b)
         assert statuses_b == statuses
         assert states_b == states and leaks_b == leaks
-        assert ([e["site"] for e in plan_b.fired_log()]
-                == [e["site"] for e in plan_a.fired_log()])
+        # the determinism CONTRACT is the schedule + per-request
+        # outcomes; the wall-clock interleaving of fired-log entries
+        # across two free-running pump threads is not part of it (the
+        # unmatched straggler/alloc faults count GLOBAL site visits, so
+        # which pump logs first is a scheduling race — made visible by
+        # the ISSUE-7 lock-witness overhead, present all along)
+        assert (sorted(e["site"] for e in plan_b.fired_log())
+                == sorted(e["site"] for e in plan_a.fired_log()))
         for a, b in zip(handles, h2):
             np.testing.assert_array_equal(a.tokens, b.tokens)
 

@@ -1,0 +1,28 @@
+"""AST lint suite for the serving fleet (``python -m tools.analyze``).
+
+Static companion to the runtime lock-order witness
+(``paddle_tpu.framework.concurrency``): four checkers over the parsed
+source keep the hazards PR reviews kept catching by hand machine-checked
+instead (docs/ANALYSIS.md has the catalog and the baseline workflow):
+
+- ``lock-discipline``  blocking calls while a framework lock is held
+- ``jit-hazard``       host-sync ops inside jitted functions
+- ``metrics-drift``    emitted metric names <-> docs/OBSERVABILITY.md
+- ``error-taxonomy``   serving raises use framework.errors classes and
+                       every class has an HTTP mapping
+
+Findings print as ``file:line CODE message``; the committed
+``baseline.txt`` grandfathers accepted findings (this repo keeps it
+empty); the CLI exits nonzero on anything new.
+"""
+from .core import (AnalysisContext, Finding, load_baseline,
+                   new_findings, run_checks, save_baseline)
+
+__all__ = ["AnalysisContext", "Finding", "run_checks", "load_baseline",
+           "save_baseline", "new_findings", "main"]
+
+
+def main(argv=None) -> int:
+    from .__main__ import main as _main
+
+    return _main(argv)

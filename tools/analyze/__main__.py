@@ -1,0 +1,60 @@
+"""CLI driver: ``python -m tools.analyze [--check NAME] [--baseline]``.
+
+Exit codes (pinned by tests/test_analyze.py, bench_diff-style):
+
+- 0  no findings beyond the committed baseline
+- 1  new findings (printed as ``file:line CODE message``)
+- 2  usage error (unknown --check name)
+"""
+from __future__ import annotations
+
+import argparse
+
+from .core import (CHECKS, load_baseline, new_findings, run_checks,
+                   save_baseline)
+
+
+def main(argv=None) -> int:
+    from . import checkers  # noqa: F401,PLC0415 — registers CHECKS
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="Concurrency & hazard lint suite "
+                    "(docs/ANALYSIS.md)")
+    ap.add_argument("--check", action="append", metavar="NAME",
+                    help="run only this checker (repeatable); default "
+                         "all")
+    ap.add_argument("--baseline", action="store_true",
+                    help="rewrite tools/analyze/baseline.txt with the "
+                         "current findings and exit 0")
+    ap.add_argument("--root", default=None,
+                    help="repo root to analyze (default: this checkout)")
+    ap.add_argument("--list", action="store_true",
+                    help="list available checkers and exit")
+    args = ap.parse_args(argv)
+    if args.list:
+        for name in sorted(CHECKS):
+            print(name)
+        return 0
+    try:
+        findings = run_checks(root=args.root, checks=args.check)
+    except KeyError as e:
+        print(e.args[0])
+        return 2
+    if args.baseline:
+        path = save_baseline(findings)
+        print(f"wrote {len(findings)} finding(s) to {path}")
+        return 0
+    fresh = new_findings(findings, load_baseline())
+    for f in fresh:
+        print(f.render())
+    base_n = len(findings) - len(fresh)
+    checks = ", ".join(sorted(args.check)) if args.check \
+        else "all checks"
+    print(f"{len(fresh)} new finding(s), {base_n} baselined "
+          f"({checks})")
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,221 @@
+"""Shared machinery for the AST lint suite (``python -m tools.analyze``).
+
+Pure stdlib, no jax/paddle_tpu imports: every checker works on parsed
+source, so the CLI starts in milliseconds and runs identically in CI and
+pre-commit.  The pieces:
+
+- :class:`Finding` — one diagnostic, printed as
+  ``file:line CODE message`` and keyed (file, code, message) for the
+  baseline (line numbers drift with unrelated edits; messages do not).
+- :class:`AnalysisContext` — parse cache over the repo tree; checkers
+  ask it for ASTs and source lines instead of re-reading files.
+- suppression — a finding whose source line carries
+  ``# analyze: allow[<check>]`` is intentional and dropped (use for
+  grandfathered-by-design sites, with a reason in the comment).
+- baseline — ``tools/analyze/baseline.txt`` holds findings accepted at
+  adoption time (one ``file|CODE|message`` per line); the runner exits
+  nonzero only on findings NOT in the baseline, so the suite gates new
+  hazards without demanding a flag-day cleanup.  (This repo's baseline
+  is empty — every original finding was fixed; see docs/ANALYSIS.md.)
+"""
+from __future__ import annotations
+
+import ast
+import os
+from collections import Counter
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+BASELINE_NAME = "baseline.txt"
+
+
+@dataclass
+class Finding:
+    """One diagnostic.  ``file`` is repo-relative with forward slashes."""
+
+    file: str
+    line: int
+    code: str
+    check: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line} {self.code} {self.message}"
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.file, self.code, self.message)
+
+
+class AnalysisContext:
+    """Parse cache + tree walker rooted at the repo checkout."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._asts: Dict[str, Optional[ast.AST]] = {}
+        self._lines: Dict[str, List[str]] = {}
+
+    # --- files --------------------------------------------------------------
+    def rel(self, path: str) -> str:
+        return os.path.relpath(path, self.root).replace(os.sep, "/")
+
+    def abs(self, rel: str) -> str:
+        return os.path.join(self.root, rel.replace("/", os.sep))
+
+    def iter_py(self, subdirs: Sequence[str]) -> List[str]:
+        """Repo-relative paths of every .py under the given repo-relative
+        subdirectories (sorted — deterministic finding order)."""
+        out: List[str] = []
+        for sub in subdirs:
+            base = self.abs(sub)
+            if os.path.isfile(base) and base.endswith(".py"):
+                out.append(self.rel(base))
+                continue
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__",)]
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        out.append(self.rel(os.path.join(dirpath, f)))
+        return sorted(set(out))
+
+    def source(self, rel: str) -> str:
+        return "\n".join(self.lines(rel))
+
+    def lines(self, rel: str) -> List[str]:
+        if rel not in self._lines:
+            try:
+                with open(self.abs(rel), encoding="utf-8") as f:
+                    self._lines[rel] = f.read().splitlines()
+            except OSError:
+                self._lines[rel] = []
+        return self._lines[rel]
+
+    def tree(self, rel: str) -> Optional[ast.AST]:
+        """Parsed AST, or None when the file is missing/unparsable (a
+        syntax error is not this tool's business — the test suite owns
+        that failure)."""
+        if rel not in self._asts:
+            try:
+                self._asts[rel] = ast.parse(self.source(rel),
+                                            filename=rel)
+            except SyntaxError:
+                self._asts[rel] = None
+        return self._asts[rel]
+
+    def line_text(self, rel: str, lineno: int) -> str:
+        lines = self.lines(rel)
+        if 1 <= lineno <= len(lines):
+            return lines[lineno - 1]
+        return ""
+
+
+# --- suppression -------------------------------------------------------------
+def suppressed(ctx: AnalysisContext, f: Finding) -> bool:
+    """True when the flagged line opts out via
+    ``# analyze: allow[<check>]`` (the WITH-statement line works too —
+    multi-line statements report the line of the blocking call)."""
+    marker = f"analyze: allow[{f.check}]"
+    return marker in ctx.line_text(f.file, f.line)
+
+
+# --- baseline ----------------------------------------------------------------
+def baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        BASELINE_NAME)
+
+
+def load_baseline() -> Counter:
+    """Multiset of grandfathered (file, code, message) triples."""
+    out: Counter = Counter()
+    try:
+        with open(baseline_path(), encoding="utf-8") as f:
+            for raw in f:
+                line = raw.rstrip("\n")
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.split("|", 2)
+                if len(parts) == 3:
+                    out[tuple(parts)] += 1
+    except OSError:
+        pass
+    return out
+
+
+def save_baseline(findings: Sequence[Finding]) -> str:
+    path = baseline_path()
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("# tools/analyze grandfathered findings — one\n"
+                "# file|CODE|message per line; regenerate with\n"
+                "#   python -m tools.analyze --baseline\n")
+        for fd in sorted(findings, key=lambda x: x.key()):
+            f.write(f"{fd.file}|{fd.code}|{fd.message}\n")
+    return path
+
+
+def new_findings(findings: Sequence[Finding],
+                 baseline: Counter) -> List[Finding]:
+    """Findings beyond the baseline allowance (multiset subtraction)."""
+    budget = Counter(baseline)
+    out = []
+    for f in findings:
+        if budget[f.key()] > 0:
+            budget[f.key()] -= 1
+        else:
+            out.append(f)
+    return out
+
+
+# --- registry / runner -------------------------------------------------------
+CheckFn = Callable[[AnalysisContext], List[Finding]]
+CHECKS: Dict[str, CheckFn] = {}
+
+
+def register(name: str):
+    def deco(fn: CheckFn) -> CheckFn:
+        CHECKS[name] = fn
+        return fn
+    return deco
+
+
+def default_root() -> str:
+    """The repo checkout containing this tools/analyze package."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_checks(root: Optional[str] = None,
+               checks: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the selected checkers; returns findings with suppressions
+    already dropped (baseline filtering is the caller's policy)."""
+    from . import checkers  # noqa: PLC0415 — registers CHECKS lazily
+
+    del checkers
+    ctx = AnalysisContext(root or default_root())
+    names = list(checks) if checks else sorted(CHECKS)
+    unknown = [n for n in names if n not in CHECKS]
+    if unknown:
+        raise KeyError(f"unknown check(s) {unknown}; "
+                       f"available: {sorted(CHECKS)}")
+    findings: List[Finding] = []
+    for name in names:
+        findings.extend(f for f in CHECKS[name](ctx)
+                        if not suppressed(ctx, f))
+    findings.sort(key=lambda f: (f.file, f.line, f.code, f.message))
+    return findings
+
+
+# --- helpers shared by checkers ---------------------------------------------
+def unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 — diagnostics only
+        return ""
+
+
+def last_component(node: ast.AST) -> str:
+    """Rightmost name of a Name/Attribute chain ('' otherwise)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""

@@ -1,0 +1,130 @@
+"""error-taxonomy: serving raises typed errors; every error maps to HTTP.
+
+The serving HTTP layer DERIVES status codes from the error class of a
+terminal outcome (``framework.errors.http_status_for``) — an ad-hoc
+``raise ValueError`` in serving therefore surfaces as a generic 500/400
+with no taxonomy, and an errors.py class without a mapping silently
+falls back to 500.  Two rules:
+
+- ET001: every ``raise`` under ``paddle_tpu/serving/`` names a class
+  defined in ``paddle_tpu/framework/errors.py`` (bare ``raise``
+  re-raises and re-raised exception variables are exempt; so is
+  ``StopIteration`` — iterator protocol, not an error).
+- ET002: every class defined in errors.py reaches an entry of
+  ``ERROR_HTTP_STATUS`` through its (in-module) base-class chain — the
+  MRO walk ``http_status_for`` performs at runtime must terminate at an
+  explicit mapping for every member of the taxonomy.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from .core import AnalysisContext, Finding, last_component, register
+
+SERVING_ROOT = ("paddle_tpu/serving",)
+ERRORS_PATH = "paddle_tpu/framework/errors.py"
+
+_ALLOWED_NON_TAXONOMY = frozenset({"StopIteration", "SystemExit",
+                                   "KeyboardInterrupt"})
+
+
+def _taxonomy(ctx: AnalysisContext):
+    """(classes: name -> ClassDef, bases: name -> [in-module base names],
+    mapped: names keyed in ERROR_HTTP_STATUS)."""
+    tree = ctx.tree(ERRORS_PATH)
+    classes: Dict[str, ast.ClassDef] = {}
+    bases: Dict[str, List[str]] = {}
+    mapped: Set[str] = set()
+    if tree is None:
+        return classes, bases, mapped
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            classes[node.name] = node
+            bases[node.name] = [last_component(b) for b in node.bases]
+        elif isinstance(node, ast.Assign):
+            targets = {t.id for t in node.targets
+                       if isinstance(t, ast.Name)}
+            if "ERROR_HTTP_STATUS" in targets \
+                    and isinstance(node.value, ast.Dict):
+                for k in node.value.keys:
+                    name = last_component(k) if k is not None else ""
+                    if name:
+                        mapped.add(name)
+    return classes, bases, mapped
+
+
+def _reaches_mapping(name: str, bases: Dict[str, List[str]],
+                     mapped: Set[str]) -> bool:
+    seen: Set[str] = set()
+    stack = [name]
+    while stack:
+        cur = stack.pop()
+        if cur in mapped:
+            return True
+        if cur in seen:
+            continue
+        seen.add(cur)
+        stack.extend(b for b in bases.get(cur, ()) if b)
+    return False
+
+
+class _RaiseScan(ast.NodeVisitor):
+    def __init__(self, rel: str, taxonomy: Set[str]):
+        self.rel = rel
+        self.taxonomy = taxonomy
+        self.findings: List[Finding] = []
+
+    def visit_Raise(self, node: ast.Raise):
+        exc = node.exc
+        name = ""
+        if exc is None:
+            return                      # bare re-raise
+        if isinstance(exc, ast.Call):
+            name = last_component(exc.func)
+        else:
+            name = last_component(exc)
+        if not name:
+            # raise <expr>: can't resolve statically — flag it so the
+            # author either simplifies or allow-comments with a reason
+            self.findings.append(Finding(
+                self.rel, node.lineno, "ET001", "error-taxonomy",
+                "raise of an unresolvable expression — use a "
+                "framework.errors class"))
+            self.generic_visit(node)
+            return
+        if name in self.taxonomy or name in _ALLOWED_NON_TAXONOMY:
+            self.generic_visit(node)
+            return
+        if not name[:1].isupper():
+            # re-raising a caught variable (`raise e`) — exempt
+            self.generic_visit(node)
+            return
+        self.findings.append(Finding(
+            self.rel, node.lineno, "ET001", "error-taxonomy",
+            f"raise {name}(...) is outside the framework.errors "
+            "taxonomy — serving errors must carry an HTTP-mappable "
+            "class (framework/errors.py)"))
+        self.generic_visit(node)
+
+
+@register("error-taxonomy")
+def run(ctx: AnalysisContext) -> List[Finding]:
+    classes, bases, mapped = _taxonomy(ctx)
+    findings: List[Finding] = []
+    for name, node in sorted(classes.items()):
+        if not _reaches_mapping(name, bases, mapped):
+            findings.append(Finding(
+                ERRORS_PATH, node.lineno, "ET002", "error-taxonomy",
+                f"error class {name} has no ERROR_HTTP_STATUS mapping "
+                "(directly or via a base class) — http_status_for "
+                "would fall back to the blanket default"))
+    taxonomy = set(classes)
+    for rel in ctx.iter_py(SERVING_ROOT):
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        scan = _RaiseScan(rel, taxonomy)
+        scan.visit(tree)
+        findings.extend(scan.findings)
+    return findings

@@ -1,0 +1,148 @@
+"""lock-discipline: blocking calls reachable while a framework lock is
+held.
+
+PR 6's deadlock was exactly this shape: a thread blocked (in
+``pick_with_retry``'s backoff sleep) while being the only thread able to
+release what it was waiting for.  The checker walks every ``with``
+statement whose context expression LOOKS like a lock (name ends in
+``lock``/``cv``/``cond``/``mutex``, e.g. ``self._lock``,
+``_INSTALL_LOCK``, ``self._cv``) and flags calls inside the lexical
+block that can block indefinitely or for scheduling-visible time:
+
+- ``time.sleep`` / bare ``sleep``
+- ``.wait`` / ``.wait_for`` on ANY OBJECT OTHER THAN a held lock
+  (waiting on the condvar you hold is the idiom — the wait releases it;
+  waiting on a different event/condvar while holding a lock is the
+  deadlock shape)
+- ``.join`` (thread/process), ``.result`` (futures), ``.acquire`` with
+  a literal timeout is fine to nest (``with inner:``) so plain acquires
+  are NOT flagged — ordering is the runtime witness's job
+- socket ops (``recv``/``send``/``sendall``/``accept``/``connect``) and
+  this repo's RPC helpers (``_recv_msg``/``_send_msg``/
+  ``connect_with_retry``)
+- ``engine.step`` (an engine step is milliseconds-to-seconds of device
+  time — never inside a lock), matched as ``.step()`` on a receiver
+  named ``eng``/``engine``
+- backing-table RPC surface: ``.pull``/``.push``/``.apply_deltas`` on a
+  receiver named ``table`` (a DeviceCachedTable's backing table may be
+  a RemoteSparseTable — a network round-trip)
+
+Lexical scope only: a ``def`` nested inside a ``with`` executes later,
+so the held-set resets at function boundaries.  Intentional sites
+suppress with ``# analyze: allow[lock-discipline] <reason>`` on the
+flagged line.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from .core import (AnalysisContext, Finding, last_component, register,
+                   unparse)
+
+ROOTS = ("paddle_tpu/serving", "paddle_tpu/distributed/ps",
+         "paddle_tpu/profiler", "paddle_tpu/io", "paddle_tpu/testing")
+
+_LOCKISH = re.compile(r"(?:^|_)(lock|cv|cond|mutex)$", re.IGNORECASE)
+
+_BLOCKING_ATTRS = frozenset({
+    "sleep", "wait", "wait_for", "join", "result", "recv", "recv_into",
+    "sendall", "accept", "connect", "select",
+})
+_BLOCKING_NAMES = frozenset({
+    "sleep", "_recv_msg", "_send_msg", "connect_with_retry",
+})
+_ENGINE_RECEIVERS = frozenset({"eng", "engine"})
+_TABLE_RPC_ATTRS = frozenset({"pull", "push", "apply_deltas"})
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    name = last_component(expr)
+    return bool(name) and bool(_LOCKISH.search(name))
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rel: str):
+        self.rel = rel
+        self.held: List[str] = []          # unparsed lock exprs in scope
+        self.findings: List[Finding] = []
+
+    # --- scope boundaries: nested defs run later, outside the lock ----------
+    def _visit_scoped(self, node):
+        saved, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = saved
+
+    def visit_FunctionDef(self, node):
+        self._visit_scoped(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._visit_scoped(node)
+
+    def visit_Lambda(self, node):
+        self._visit_scoped(node)
+
+    # --- with-blocks --------------------------------------------------------
+    def visit_With(self, node: ast.With):
+        for item in node.items:            # context exprs evaluate unheld
+            self.visit(item.context_expr)
+        locks = [unparse(item.context_expr) for item in node.items
+                 if _is_lockish(item.context_expr)]
+        self.held.extend(locks)
+        for stmt in node.body:
+            self.visit(stmt)
+        if locks:
+            del self.held[-len(locks):]
+
+    # --- calls --------------------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        if self.held:
+            reason = self._blocking_reason(node)
+            if reason:
+                self.findings.append(Finding(
+                    self.rel, node.lineno, "LD001", "lock-discipline",
+                    f"{reason} while holding {self.held[-1]!r}"
+                    + (f" (also {', '.join(self.held[:-1])})"
+                       if len(self.held) > 1 else "")))
+        self.generic_visit(node)
+
+    def _blocking_reason(self, node: ast.Call) -> str:
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id in _BLOCKING_NAMES:
+                return f"blocking call {func.id}()"
+            return ""
+        if not isinstance(func, ast.Attribute):
+            return ""
+        recv = func.value
+        recv_txt = unparse(recv)
+        if func.attr in ("wait", "wait_for"):
+            # the condvar idiom: waiting on a lock you hold RELEASES it
+            if recv_txt in self.held:
+                return ""
+            return (f"wait on {recv_txt!r} (not a held lock — the lock "
+                    "stays held for the whole wait)")
+        if func.attr in _BLOCKING_ATTRS or func.attr == "send":
+            return f"blocking call {recv_txt}.{func.attr}()"
+        if (func.attr == "step"
+                and last_component(recv) in _ENGINE_RECEIVERS):
+            return f"engine step {recv_txt}.step()"
+        if (func.attr in _TABLE_RPC_ATTRS
+                and last_component(recv) == "table"):
+            return (f"backing-table call {recv_txt}.{func.attr}() "
+                    "(possible RPC round-trip)")
+        return ""
+
+
+@register("lock-discipline")
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in ctx.iter_py(ROOTS):
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        v = _Visitor(rel)
+        v.visit(tree)
+        findings.extend(v.findings)
+    return findings

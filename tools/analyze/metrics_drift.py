@@ -1,0 +1,147 @@
+"""metrics-drift: code-emitted metric names <-> docs/OBSERVABILITY.md.
+
+An operator dashboards against documented names; a metric the code emits
+but the doc omits is invisible operational surface, and a name the doc
+promises but nothing emits is a dashboard that will silently stay flat.
+The checker keeps the two sets equal for the ``serving.*`` / ``hapi.*``
+families:
+
+- CODE side: string literals passed to the StatRegistry surface
+  (``stat_registry.get/histogram``, ``stat_add``/``stat_get``,
+  ``histogram_observe``/``histogram_snapshot``, ``gauge_set``) plus the
+  ``GAUGES``/``COUNTERS``/``HISTOGRAMS`` class-attribute tuples the
+  metrics classes enumerate (their f-string emissions are derived from
+  these).  Test files are not scanned — a test hammering
+  ``t.hammer.counter`` is not operational surface (and the prefix
+  filter drops such names anyway).
+- DOC side: backtick-quoted names in docs/OBSERVABILITY.md matching
+  ``^(serving|hapi)(\\.[a-z0-9_]+)+$``.  Two doc shorthands are
+  expanded: braces (```serving.{snapshots,restores}``` → two names) and
+  leading-dot continuations (```serving.frontend.submitted``` followed
+  by ```.completed``` → ``serving.frontend.completed``).
+- jit-cost ATTRIBUTION names (``profiled_jit("serving.decode", ...)``)
+  and profiler span names are collected separately and exempt the doc
+  side — they are documented next to the metrics but are not registry
+  metrics.
+
+MD001 = emitted but undocumented; MD002 = documented but never emitted.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from .core import AnalysisContext, Finding, register, unparse
+
+CODE_ROOTS = ("paddle_tpu",)
+DOC_PATH = "docs/OBSERVABILITY.md"
+
+_PREFIXES = ("serving.", "hapi.")
+_NAME_RE = re.compile(r"^(serving|hapi)(\.[a-z0-9_]+)+$")
+_REGISTRY_FUNCS = frozenset({
+    "stat_registry.get", "stat_registry.histogram", "stat_add",
+    "stat_get", "histogram_observe", "histogram_snapshot", "gauge_set",
+})
+_ATTR_FUNCS = frozenset({"profiled_jit", "RecordEvent", "span",
+                         "instant"})
+_LIST_ATTRS = frozenset({"GAUGES", "COUNTERS", "HISTOGRAMS"})
+_SPAN_RE = re.compile(r"`([^`]+)`")
+
+
+def _metric_name(s: str) -> bool:
+    return s.startswith(_PREFIXES) and bool(_NAME_RE.match(s))
+
+
+class _CodeScan(ast.NodeVisitor):
+    def __init__(self, rel: str):
+        self.rel = rel
+        self.emitted: Dict[str, Tuple[str, int]] = {}
+        self.attribution: Set[str] = set()
+
+    def visit_Call(self, node: ast.Call):
+        txt = unparse(node.func)
+        short = txt.rsplit(".", 1)[-1]
+        if (txt in _REGISTRY_FUNCS or txt.endswith(
+                (".stat_registry.get", ".stat_registry.histogram"))):
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                name = node.args[0].value
+                if _metric_name(name):
+                    self.emitted.setdefault(name,
+                                            (self.rel, node.lineno))
+        elif short in _ATTR_FUNCS:
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                self.attribution.add(node.args[0].value)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        names = {t.id for t in node.targets
+                 if isinstance(t, ast.Name)}
+        if names & _LIST_ATTRS and isinstance(node.value,
+                                              (ast.Tuple, ast.List)):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) \
+                        and isinstance(elt.value, str) \
+                        and _metric_name(elt.value):
+                    self.emitted.setdefault(elt.value,
+                                            (self.rel, elt.lineno))
+        self.generic_visit(node)
+
+
+def _expand_braces(span: str) -> List[str]:
+    m = re.match(r"^([^{}]*)\{([^{}]+)\}([^{}]*)$", span)
+    if not m:
+        return [span]
+    head, body, tail = m.groups()
+    return [f"{head}{part.strip()}{tail}" for part in body.split(",")]
+
+
+def collect_doc_names(ctx: AnalysisContext,
+                      doc_rel: str = DOC_PATH) -> Dict[str, int]:
+    """Documented metric names -> first line number, with brace and
+    leading-dot-continuation expansion."""
+    names: Dict[str, int] = {}
+    prev_prefix = ""
+    for lineno, line in enumerate(ctx.lines(doc_rel), start=1):
+        for raw in _SPAN_RE.findall(line):
+            for span in _expand_braces(raw):
+                if "*" in span:
+                    continue
+                if span.startswith(".") and prev_prefix \
+                        and re.match(r"^\.[a-z0-9_]+$", span):
+                    span = prev_prefix + span
+                if _metric_name(span):
+                    names.setdefault(span, lineno)
+                    prev_prefix = span.rsplit(".", 1)[0]
+    return names
+
+
+@register("metrics-drift")
+def run(ctx: AnalysisContext) -> List[Finding]:
+    emitted: Dict[str, Tuple[str, int]] = {}
+    attribution: Set[str] = set()
+    for rel in ctx.iter_py(CODE_ROOTS):
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        scan = _CodeScan(rel)
+        scan.visit(tree)
+        for name, where in scan.emitted.items():
+            emitted.setdefault(name, where)
+        attribution |= scan.attribution
+    documented = collect_doc_names(ctx)
+    findings: List[Finding] = []
+    for name in sorted(set(emitted) - set(documented)):
+        rel, line = emitted[name]
+        findings.append(Finding(
+            rel, line, "MD001", "metrics-drift",
+            f"metric {name!r} is emitted here but not documented in "
+            f"{DOC_PATH}"))
+    for name in sorted(set(documented) - set(emitted) - attribution):
+        findings.append(Finding(
+            DOC_PATH, documented[name], "MD002", "metrics-drift",
+            f"metric {name!r} is documented but nothing emits it "
+            "(and it is not a jit-cost attribution name)"))
+    return findings
