@@ -1078,6 +1078,34 @@ def bench_serving_resilience(num_requests=16, max_new_tokens=24):
     }
 
 
+def _compile_section():
+    """Per-program compile accounting for the serving run
+    (``detail.compile``): compile count + compile ms + calls per
+    ``serving.*`` program.  Counts come from the ``compile_ledger``
+    (which also sees plain-jit FALLBACK compiles the AOT cost registry
+    cannot attribute); compile ms and call counts come from
+    ``cost_registry``.  A compile count that DRIFTS UP round-over-round
+    means a jitted signature destabilized (the retrace-hazard failure
+    mode) — ``bench_diff --fail-on-regression`` gates it like any
+    latency metric."""
+    from paddle_tpu.profiler.jit_cost import compile_ledger, cost_registry
+
+    costs = cost_registry.snapshot()
+    counts = compile_ledger.counts("serving.")
+    out = {}
+    for name in sorted(set(counts) | {n for n in costs
+                                      if n.startswith("serving.")}):
+        ent = costs.get(name, {})
+        out[name] = {
+            "compile_count": counts.get(name,
+                                        ent.get("compile_count", 0)),
+            "compile_time_ms": round(
+                ent.get("compile_time_s", 0.0) * 1e3, 3),
+            "calls": ent.get("calls", 0),
+        }
+    return out
+
+
 def _attach_serving_prefill(result):
     """Attach the prefill-heavy serving workload to a result's detail —
     shared by BENCH_MODEL=serving and the default `all` run."""
@@ -1228,6 +1256,9 @@ def main():
             sys.stderr.write(
                 f"serving resilience bench failed after retries "
                 f"({type(e).__name__}: {e})\n")
+        # whole-run compile accounting LAST: every serving workload
+        # above has already attributed its compiles to the registry
+        result.setdefault("detail", {})["compile"] = _compile_section()
     else:
         # default: BOTH flagship benches in one driver run (VERDICT r1 #2);
         # headline value = geometric mean of the vs-V100 ratios

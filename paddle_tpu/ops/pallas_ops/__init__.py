@@ -1,0 +1,9 @@
+"""Pallas TPU kernels + their declared resource contracts.
+
+Kernel modules import jax at their own top level; ``contracts`` is pure
+stdlib, so ``from paddle_tpu.ops.pallas_ops import contracts`` is safe
+from host-only tooling."""
+from . import contracts  # noqa: F401  — stdlib-only, always importable
+from .contracts import CONTRACTS, BlockDecl, KernelContract  # noqa: F401
+
+__all__ = ["contracts", "CONTRACTS", "BlockDecl", "KernelContract"]

@@ -1,0 +1,368 @@
+"""Declared kernel contracts for the Pallas kernels in this package.
+
+Every hand-picked grid/BlockSpec/scratch literal in ``flash_attention``,
+``paged_attention`` and ``quantized_matmul`` used to live inline in the
+kernel wrappers — invisible to tooling, and exactly the values the
+ROADMAP's Pallas autotuner needs to parameterize.  This module lifts
+them into :class:`KernelContract` objects: a machine-readable statement
+of each kernel's block shapes, dtype tiling rules, memory spaces, grid
+divisibility buckets and static VMEM footprint.  Tensor Processing
+Primitives (PAPERS.md) argues for exactly this contract-carrying
+primitive layer; CUDA-L2 shows the payoff of making kernel configs
+explicit, validated objects before searching over them.
+
+Three consumers, one source of truth:
+
+- the KERNELS read their default block constants from here (e.g.
+  ``flash_attention.DEFAULT_BLOCK_Q`` is ``FLASH_FWD.dim("block_q")``),
+  so a tuned config swap is one ``dims`` replacement away;
+- the STATIC checker (``tools/analyze`` ``pallas-contract``, PC00x)
+  re-derives every contract from this file's AST — declarations must
+  stay PURE LITERALS (ints, strings, tuples, dicts, BlockDecl calls;
+  module-level constants like ``LANE`` are fine) so the stdlib linter
+  can evaluate them without importing jax;
+- the RUNTIME twin :meth:`KernelContract.validate` applies the same
+  rules to any candidate config — the gate the autotuner will run each
+  swapped-in ``dims`` through before measuring it.
+
+Intentional rule exceptions are declared in-contract via ``waivers``
+(a reasoned string per waived rule), not hidden: a waiver shows up in
+``validate()``'s accounting and the lint report alike.
+
+This module is PURE STDLIB (dataclasses only, no jax) — importing it
+costs microseconds, so the analyzer CLI and host-only tests stay fast.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Tuple, Union
+
+__all__ = ["BlockDecl", "KernelContract", "CONTRACTS", "LANE",
+           "SUBLANE_FLOOR", "DTYPE_BYTES", "VMEM_BUDGET_BYTES"]
+
+# TPU lane width: the last dim of every VMEM block tiles in units of 128
+LANE = 128
+
+# minimum sublane (second-to-last dim) tile per dtype — the (8, 128) /
+# (16, 128) / (32, 128) floors from the TPU tiling table
+SUBLANE_FLOOR = {
+    "float32": 8, "int32": 8, "uint32": 8,
+    "bfloat16": 16, "float16": 16,
+    "int8": 32, "uint8": 32, "float8_e4m3fn": 32, "float8_e5m2": 32,
+}
+
+DTYPE_BYTES = {
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2,
+    "int8": 1, "uint8": 1, "float8_e4m3fn": 1, "float8_e5m2": 1,
+}
+
+# per-platform VMEM budget the static footprint estimate is checked
+# against (one TPU core's VMEM; the estimate must leave the compiler
+# headroom, hence the 0.75 duty factor folded in below)
+VMEM_BYTES = {"tpu": 16 * 1024 * 1024}
+VMEM_BUDGET_BYTES = 12 * 1024 * 1024       # 0.75 * VMEM_BYTES["tpu"]
+
+Dim = Union[int, str]
+
+
+@dataclass(frozen=True)
+class BlockDecl:
+    """One operand/output/scratch block of a kernel.
+
+    ``shape`` entries are ints or symbol names resolved through the
+    owning contract's ``dims``.  ``lanes_full`` / ``sublane_full`` mark
+    a trailing dim that spans the WHOLE array extent — the TPU tiling
+    rule is "(8k, 128k) OR equal to the array dims", so such dims are
+    exempt from the alignment floors.  ``waivers`` carries reasoned
+    exemptions, one per waived rule, each starting with the rule key
+    (``lane``/``sublane``/``divisibility``/``vmem``).
+    """
+
+    name: str
+    kind: str                      # "in" | "out" | "scratch"
+    shape: Tuple[Dim, ...]
+    dtype: str
+    memory: str = "vmem"           # "vmem" | "smem"
+    lanes_full: bool = False
+    sublane_full: bool = False
+    waivers: Tuple[str, ...] = ()
+
+    def waived(self, rule: str) -> bool:
+        return any(w.split(":", 1)[0].strip() == rule
+                   for w in self.waivers)
+
+
+@dataclass(frozen=True)
+class KernelContract:
+    """Declared resource contract of one Pallas kernel.
+
+    - ``module``: repo-relative path of the kernel file the contract
+      governs (the drift lint cross-checks its literals).
+    - ``grid``: symbolic grid axes, outermost first.
+    - ``dims``: the DEFAULT config — symbol -> int.  This is the object
+      the autotuner swaps: ``replace(contract, dims={...})`` then
+      ``validate()`` gates the candidate before it is ever compiled.
+    - ``blocks``: every in/out/scratch block (SMEM scalar-prefetch
+      operands included for completeness; they are exempt from the VMEM
+      rules).
+    - ``shape_buckets``: block symbol -> padded array extents the kernel
+      is expected to tile at this config; each bucket must divide by the
+      symbol's bound value (grid divisibility — a non-dividing bucket
+      means a ragged final block the kernel body does not handle).
+    - ``double_buffered``: pallas double-buffers grid-streamed in/out
+      block DMAs, so their VMEM cost counts twice; scratch is resident
+      once.
+    """
+
+    name: str
+    module: str
+    grid: Tuple[str, ...]
+    dims: Mapping[str, int]
+    blocks: Tuple[BlockDecl, ...]
+    shape_buckets: Mapping[str, Tuple[int, ...]] = field(
+        default_factory=dict)
+    double_buffered: bool = True
+    platform: str = "tpu"
+    vmem_budget_bytes: int = VMEM_BUDGET_BYTES
+
+    # --- resolution -------------------------------------------------------
+    def dim(self, sym: str) -> int:
+        return int(self.dims[sym])
+
+    def resolve(self, shape: Tuple[Dim, ...]) -> Tuple[int, ...]:
+        return tuple(d if isinstance(d, int) else self.dim(d)
+                     for d in shape)
+
+    def block_bytes(self, block: BlockDecl) -> int:
+        n = 1
+        for d in self.resolve(block.shape):
+            n *= d
+        return n * DTYPE_BYTES[block.dtype]
+
+    def vmem_estimate_bytes(self) -> int:
+        """Static footprint: sum of VMEM block bytes, grid-streamed
+        in/out blocks counted twice when double-buffered (the DMA for
+        grid cell i+1 overlaps compute on cell i)."""
+        total = 0
+        for b in self.blocks:
+            if b.memory != "vmem":
+                continue
+            mult = 2 if (self.double_buffered
+                         and b.kind in ("in", "out")) else 1
+            total += mult * self.block_bytes(b)
+        return total
+
+    # --- the rule set (runtime twin of the PC00x lint) --------------------
+    def validate(self) -> List[str]:
+        """Apply the tiling/divisibility/footprint rules to THIS config;
+        returns human-readable violations (waived rules excluded — the
+        autotuner gates candidate ``dims`` with this)."""
+        out: List[str] = []
+        for b in self.blocks:
+            if b.memory != "vmem" or len(b.shape) < 2:
+                continue
+            shape = self.resolve(b.shape)
+            lane, sub = shape[-1], shape[-2]
+            if lane % LANE and not b.lanes_full and not b.waived("lane"):
+                out.append(f"block {b.name!r}: last dim {lane} is not a "
+                           f"multiple of the {LANE}-wide lane")
+            floor = SUBLANE_FLOOR[b.dtype]
+            if sub % floor and not b.sublane_full \
+                    and not b.waived("sublane"):
+                out.append(f"block {b.name!r}: sublane dim {sub} is not "
+                           f"a multiple of the {b.dtype} tile floor "
+                           f"{floor}")
+        for sym, buckets in self.shape_buckets.items():
+            size = self.dim(sym)
+            for v in buckets:
+                if v % size:
+                    out.append(f"bucket {v} along {sym!r} is not "
+                               f"divisible by its block size {size}")
+        est = self.vmem_estimate_bytes()
+        if est > self.vmem_budget_bytes:
+            out.append(f"VMEM estimate {est} bytes exceeds the "
+                       f"{self.platform} budget "
+                       f"{self.vmem_budget_bytes}")
+        return out
+
+
+# ===========================================================================
+# flash_attention.py — tiled online-softmax attention, fwd + two bwd
+# kernels.  Block defaults tuned on v5e @ S=4096, D=128 (see the module
+# docstring); the wrapper's _pick_block halves them to a divisor for
+# shorter (always x128-padded) sequences.
+# ===========================================================================
+FLASH_FWD = KernelContract(
+    name="flash_attention_fwd",
+    module="paddle_tpu/ops/pallas_ops/flash_attention.py",
+    grid=("batch_heads", "q_blocks", "k_blocks"),
+    dims={"block_q": 512, "block_k": 1024, "head_dim": 128, "lane": 128},
+    blocks=(
+        BlockDecl("seed", "in", (1,), "int32", memory="smem"),
+        BlockDecl("q", "in", (1, "block_q", "head_dim"), "float32"),
+        BlockDecl("k", "in", (1, "block_k", "head_dim"), "float32"),
+        BlockDecl("v", "in", (1, "block_k", "head_dim"), "float32"),
+        BlockDecl("mask", "in", (1, 1, "block_k"), "float32",
+                  sublane_full=True),
+        BlockDecl("o", "out", (1, "block_q", "head_dim"), "float32"),
+        BlockDecl("lse", "out", (1, "block_q", 1), "float32",
+                  lanes_full=True),
+        BlockDecl("acc", "scratch", ("block_q", "head_dim"), "float32"),
+        BlockDecl("m", "scratch", ("block_q", "lane"), "float32"),
+        BlockDecl("l", "scratch", ("block_q", "lane"), "float32"),
+    ),
+    shape_buckets={"block_q": (1024, 2048, 4096, 8192),
+                   "block_k": (1024, 2048, 4096, 8192)},
+)
+
+FLASH_BWD_DKV = KernelContract(
+    name="flash_attention_bwd_dkv",
+    module="paddle_tpu/ops/pallas_ops/flash_attention.py",
+    grid=("batch_heads", "k_blocks", "q_blocks"),
+    dims={"block_q": 512, "block_k": 1024, "head_dim": 128},
+    blocks=(
+        BlockDecl("seed", "in", (1,), "int32", memory="smem"),
+        BlockDecl("q", "in", (1, "block_q", "head_dim"), "float32"),
+        BlockDecl("k", "in", (1, "block_k", "head_dim"), "float32"),
+        BlockDecl("v", "in", (1, "block_k", "head_dim"), "float32"),
+        BlockDecl("do", "in", (1, "block_q", "head_dim"), "float32"),
+        BlockDecl("lse", "in", (1, "block_q", 1), "float32",
+                  lanes_full=True),
+        BlockDecl("delta", "in", (1, "block_q", 1), "float32",
+                  lanes_full=True),
+        BlockDecl("mask", "in", (1, 1, "block_k"), "float32",
+                  sublane_full=True),
+        BlockDecl("dk", "out", (1, "block_k", "head_dim"), "float32"),
+        BlockDecl("dv", "out", (1, "block_k", "head_dim"), "float32"),
+        BlockDecl("dk_sc", "scratch", ("block_k", "head_dim"), "float32"),
+        BlockDecl("dv_sc", "scratch", ("block_k", "head_dim"), "float32"),
+    ),
+    shape_buckets={"block_q": (1024, 2048, 4096, 8192),
+                   "block_k": (1024, 2048, 4096, 8192)},
+)
+
+FLASH_BWD_DQ = KernelContract(
+    name="flash_attention_bwd_dq",
+    module="paddle_tpu/ops/pallas_ops/flash_attention.py",
+    grid=("batch_heads", "q_blocks", "k_blocks"),
+    dims={"block_q": 512, "block_k": 1024, "head_dim": 128},
+    blocks=(
+        BlockDecl("seed", "in", (1,), "int32", memory="smem"),
+        BlockDecl("q", "in", (1, "block_q", "head_dim"), "float32"),
+        BlockDecl("k", "in", (1, "block_k", "head_dim"), "float32"),
+        BlockDecl("v", "in", (1, "block_k", "head_dim"), "float32"),
+        BlockDecl("do", "in", (1, "block_q", "head_dim"), "float32"),
+        BlockDecl("lse", "in", (1, "block_q", 1), "float32",
+                  lanes_full=True),
+        BlockDecl("delta", "in", (1, "block_q", 1), "float32",
+                  lanes_full=True),
+        BlockDecl("mask", "in", (1, 1, "block_k"), "float32",
+                  sublane_full=True),
+        BlockDecl("dq", "out", (1, "block_q", "head_dim"), "float32"),
+        BlockDecl("dq_sc", "scratch", ("block_q", "head_dim"), "float32"),
+    ),
+    shape_buckets={"block_q": (1024, 2048, 4096, 8192),
+                   "block_k": (1024, 2048, 4096, 8192)},
+)
+
+# ===========================================================================
+# paged_attention.py — ragged paged decode attention.  One block = one
+# physical KV page; the wrapper pads heads to the f32 sublane floor and
+# head_dim to the lane width, so the contract dims ARE the padding
+# constants the wrapper reads.
+# ===========================================================================
+PAGED_DECODE = KernelContract(
+    name="paged_attention_decode",
+    module="paddle_tpu/ops/pallas_ops/paged_attention.py",
+    grid=("batch", "pages_per_seq"),
+    dims={"page_size": 16, "heads": 8, "head_dim": 128, "lane": 128,
+          "head_align": 8},
+    blocks=(
+        BlockDecl("page_tables", "in", ("batch", "pages_per_seq"),
+                  "int32", memory="smem"),
+        BlockDecl("seq_lens", "in", ("batch",), "int32", memory="smem"),
+        BlockDecl("q", "in", (1, "heads", "head_dim"), "float32"),
+        BlockDecl("k_page", "in", (1, "page_size", "heads", "head_dim"),
+                  "float32"),
+        BlockDecl("v_page", "in", (1, "page_size", "heads", "head_dim"),
+                  "float32"),
+        BlockDecl("o", "out", (1, "heads", "head_dim"), "float32"),
+        BlockDecl("acc", "scratch", ("heads", "head_dim"), "float32"),
+        BlockDecl("m", "scratch", ("heads", "lane"), "float32"),
+        BlockDecl("l", "scratch", ("heads", "lane"), "float32"),
+    ),
+    shape_buckets={"head_dim": (128, 256), "heads": (8, 16, 32)},
+)
+
+PAGED_DECODE_INT8 = KernelContract(
+    name="paged_attention_decode_int8",
+    module="paddle_tpu/ops/pallas_ops/paged_attention.py",
+    grid=("batch", "pages_per_seq"),
+    dims={"page_size": 16, "heads": 8, "head_dim": 128, "lane": 128,
+          "head_align": 8},
+    blocks=(
+        BlockDecl("page_tables", "in", ("batch", "pages_per_seq"),
+                  "int32", memory="smem"),
+        BlockDecl("seq_lens", "in", ("batch",), "int32", memory="smem"),
+        BlockDecl("q", "in", (1, "heads", "head_dim"), "float32"),
+        BlockDecl("k_page", "in", (1, "page_size", "heads", "head_dim"),
+                  "int8",
+                  waivers=("sublane: int8 pages keep the f32 page "
+                           "layout (heads padded to 8, not the int8 "
+                           "floor 32) — padding H 4x just for storage "
+                           "tiling would quadruple page bytes and "
+                           "defeat the int8 win; interpret-validated, "
+                           "real-TPU relayout cost accepted until the "
+                           "autotuner revisits",)),
+        BlockDecl("v_page", "in", (1, "page_size", "heads", "head_dim"),
+                  "int8",
+                  waivers=("sublane: same trade as k_page — see its "
+                           "waiver",)),
+        BlockDecl("k_scales", "in", (1, "heads"), "float32",
+                  lanes_full=True,
+                  waivers=("sublane: one [H] fp32 scale row rides each "
+                           "page DMA — a sub-tile row block by design "
+                           "(padding it to 8 rows would 8x the scale "
+                           "traffic for zeros)",)),
+        BlockDecl("v_scales", "in", (1, "heads"), "float32",
+                  lanes_full=True,
+                  waivers=("sublane: same trade as k_scales",)),
+        BlockDecl("o", "out", (1, "heads", "head_dim"), "float32"),
+        BlockDecl("acc", "scratch", ("heads", "head_dim"), "float32"),
+        BlockDecl("m", "scratch", ("heads", "lane"), "float32"),
+        BlockDecl("l", "scratch", ("heads", "lane"), "float32"),
+    ),
+    shape_buckets={"head_dim": (128, 256), "heads": (8, 16, 32)},
+)
+
+# ===========================================================================
+# quantized_matmul.py — weight-only int8 matmul.  Grid (M/bm, N/bn,
+# K/bk), K innermost; int8 weight blocks satisfy the (32, 128) floor at
+# the default 128x128x128 tiling.
+# ===========================================================================
+QUANTIZED_MATMUL = KernelContract(
+    name="quantized_matmul",
+    module="paddle_tpu/ops/pallas_ops/quantized_matmul.py",
+    grid=("m_blocks", "n_blocks", "k_steps"),
+    dims={"block_m": 128, "block_n": 128, "block_k": 128},
+    blocks=(
+        BlockDecl("x", "in", ("block_m", "block_k"), "float32"),
+        BlockDecl("w_q", "in", ("block_k", "block_n"), "int8"),
+        BlockDecl("w_scale", "in", (1, "block_n"), "float32",
+                  sublane_full=True),
+        BlockDecl("o", "out", ("block_m", "block_n"), "float32"),
+        BlockDecl("acc", "scratch", ("block_m", "block_n"), "float32"),
+    ),
+    shape_buckets={"block_k": (128, 256, 512, 1024, 2048),
+                   "block_n": (128, 256, 512, 1024, 2048),
+                   "block_m": (128, 256)},
+)
+
+# name -> contract, the registry the lint, the tests and (next) the
+# autotuner iterate
+CONTRACTS: Dict[str, KernelContract] = {
+    c.name: c for c in (FLASH_FWD, FLASH_BWD_DKV, FLASH_BWD_DQ,
+                        PAGED_DECODE, PAGED_DECODE_INT8,
+                        QUANTIZED_MATMUL)
+}

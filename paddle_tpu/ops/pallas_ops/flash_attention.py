@@ -31,10 +31,16 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .contracts import FLASH_FWD
+
 # tuned on v5e @ S=4096, D=128 (0.41 ms vs 2.17 ms XLA fused attention):
-# big q/k blocks keep the MXU busy and amortize per-block scratch updates
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 1024
+# big q/k blocks keep the MXU busy and amortize per-block scratch
+# updates.  The values live in the declared KernelContract
+# (contracts.FLASH_FWD) — single source of truth for the kernels, the
+# pallas-contract lint and the autotuner.
+DEFAULT_BLOCK_Q = FLASH_FWD.dim("block_q")
+DEFAULT_BLOCK_K = FLASH_FWD.dim("block_k")
+_LANE = FLASH_FWD.dim("lane")
 NEG_INF = -1e30
 
 
@@ -42,7 +48,7 @@ def _pick_block(default, seq_len):
     """Largest power-of-two divisor of seq_len, capped at `default` (≥128
     where possible to satisfy mosaic lane tiling)."""
     b = min(default, seq_len)
-    while b > 128 and seq_len % b:
+    while b > _LANE and seq_len % b:
         b //= 2
     if seq_len % b:
         b = seq_len  # no clean divisor: single block
@@ -298,8 +304,8 @@ def _flash_fwd_bhsd(q, k, v, mask, seed, scale, causal, dropout_p,
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
         ],
         compiler_params=_compiler_params(),
         interpret=_interpret_mode(),
@@ -427,9 +433,9 @@ _flash_attention_core.defvjp(_core_fwd, _core_bwd)
 
 def _pad_head_dim(d):
     """MXU-friendly head width: 64 stays, otherwise next multiple of 128."""
-    if d <= 64:
-        return 64
-    return ((d + 127) // 128) * 128
+    if d <= _LANE // 2:
+        return _LANE // 2
+    return -(-d // _LANE) * _LANE
 
 
 def flash_attention_bshd(q, k, v, causal=False, kv_mask=None, dropout_p=0.0,
@@ -445,7 +451,7 @@ def flash_attention_bshd(q, k, v, causal=False, kv_mask=None, dropout_p=0.0,
     B, S, H, D = q.shape
     scale = 1.0 / math.sqrt(D)
 
-    Sp = ((S + 127) // 128) * 128
+    Sp = -(-S // _LANE) * _LANE
     Dp = _pad_head_dim(D)
     if kv_mask is None:
         mask = jnp.ones((B, Sp), jnp.float32)

@@ -50,7 +50,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .contracts import PAGED_DECODE
+
 NEG_INF = -1e30
+
+# padding constants from the declared KernelContract (contracts.py):
+# heads pad to the f32 sublane floor, head_dim to the lane width — the
+# pallas-contract lint checks the same values the kernel runs with
+_HEAD_ALIGN = PAGED_DECODE.dim("head_align")
+_LANE = PAGED_DECODE.dim("lane")
 
 # trace-time routing telemetry, mirroring ops/attention.py ROUTE_STATS
 PAGED_ROUTE_STATS = {"pallas": 0, "xla": 0}
@@ -201,8 +209,8 @@ def paged_attention_kernel(q, k_pages, v_pages, page_tables, seq_lens,
     # mosaic wants the trailing block dims (H, D) tile-aligned on real
     # TPU; pad unconditionally (cheap — decode arrays are small) so the
     # CPU interpret tests exercise the exact same padded path as TPU
-    Hp = ((H + 7) // 8) * 8
-    Dp = 128 if D <= 128 else ((D + 127) // 128) * 128
+    Hp = -(-H // _HEAD_ALIGN) * _HEAD_ALIGN
+    Dp = _LANE if D <= _LANE else -(-D // _LANE) * _LANE
     if Hp != H or Dp != D:
         q = jnp.pad(q, ((0, 0), (0, Hp - H), (0, Dp - D)))
         k_pages = jnp.pad(k_pages,
@@ -244,8 +252,8 @@ def paged_attention_kernel(q, k_pages, v_pages, page_tables, seq_lens,
         out_specs=pl.BlockSpec((1, Hq, Dq), lambda b, i, pt, sl: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((Hq, Dq), jnp.float32),
-            pltpu.VMEM((Hq, 128), jnp.float32),
-            pltpu.VMEM((Hq, 128), jnp.float32),
+            pltpu.VMEM((Hq, _LANE), jnp.float32),
+            pltpu.VMEM((Hq, _LANE), jnp.float32),
         ],
     )
     out_dtype = q.dtype

@@ -34,8 +34,18 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .contracts import QUANTIZED_MATMUL, SUBLANE_FLOOR
+
 __all__ = ["quantized_matmul", "quantized_matmul_kernel",
            "quantized_matmul_xla", "QMM_ROUTE_STATS"]
+
+# default tiling from the declared KernelContract (contracts.py) — the
+# single source of truth the pallas-contract lint checks and the
+# autotuner will swap
+_BLOCK_M = QUANTIZED_MATMUL.dim("block_m")
+_BLOCK_N = QUANTIZED_MATMUL.dim("block_n")
+_BLOCK_K = QUANTIZED_MATMUL.dim("block_k")
+_F32_SUBLANE = SUBLANE_FLOOR["float32"]
 
 # trace-time routing telemetry, mirroring ops/attention.py ROUTE_STATS —
 # the engine's stats() exposes this as the weight-quant hit counter
@@ -75,7 +85,8 @@ def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc_sc, *, k_steps):
 
 
 def quantized_matmul_kernel(x, w_q, w_scale, *, interpret=None,
-                            block_m=128, block_n=128, block_k=128):
+                            block_m=_BLOCK_M, block_n=_BLOCK_N,
+                            block_k=_BLOCK_K):
     """The Pallas kernel proper (interpret mode off-TPU unless forced).
 
     x        [M, K]  activations (any float dtype; accumulates in f32)
@@ -94,7 +105,8 @@ def quantized_matmul_kernel(x, w_q, w_scale, *, interpret=None,
     # pad everything to the block grid; int8 tile floor is (32, 128) so
     # the weight blocks stay tileable on real TPU.  Decode/prefill M is
     # small (a lane bucket or a prefill chunk) — one M block suffices.
-    bm = min(block_m, max(8, -(-M // 8) * 8))
+    bm = min(block_m, max(_F32_SUBLANE,
+                          -(-M // _F32_SUBLANE) * _F32_SUBLANE))
     Mp = -(-M // bm) * bm
     Kp = -(-K // block_k) * block_k
     Np = -(-N // block_n) * block_n

@@ -26,8 +26,10 @@ from ..framework.monitor import (gauge_set, histogram_observe,  # noqa: F401
 from .chrome_trace import export_chrome_trace, to_trace_events  # noqa: F401
 from .exposition import (MetricsServer, prometheus_text,  # noqa: F401
                          start_metrics_server)
-from .jit_cost import (JitCostRegistry, ProfiledJit,  # noqa: F401
-                       cost_registry, device_memory_stats, profiled_jit)
+from .jit_cost import (CompileBudget, CompileBudgetExceeded,  # noqa: F401
+                       CompileLedger, JitCostRegistry, ProfiledJit,
+                       compile_budget, compile_ledger, cost_registry,
+                       device_memory_stats, profiled_jit)
 from .tracer import (Span, Tracer, aggregates, clear_spans,  # noqa: F401
                      disable_tracing, enable_tracing, get_spans, instant,
                      reset_aggregates, span, tracer, tracing_enabled)
@@ -40,6 +42,8 @@ __all__ = [
     "prometheus_text", "start_metrics_server", "MetricsServer",
     "profiled_jit", "ProfiledJit", "JitCostRegistry", "cost_registry",
     "device_memory_stats",
+    "compile_ledger", "compile_budget", "CompileLedger",
+    "CompileBudget", "CompileBudgetExceeded",
     "stat_add", "stat_get", "stat_registry",
     "histogram_observe", "histogram_snapshot", "gauge_set",
     "metrics_snapshot",

@@ -12,17 +12,40 @@ the cached executable directly — one dict lookup + signature hash of
 overhead on the hot path.  Anything the AOT path cannot handle falls
 back to the plain jitted callable (still counted, just without cost
 attribution).
+
+Compile ledger (the runtime twin of the ``retrace-hazard`` static
+checker, docs/ANALYSIS.md): every new-signature compile of a profiled
+program is also appended to the process-global ``compile_ledger``, and
+``compile_budget(n)`` turns a code region into an assertion about how
+many compiles it may trigger::
+
+    with compile_budget(0, prefix="serving."):   # raise mode
+        for _ in range(32):
+            engine.step()        # steady-state decode must not retrace
+
+    with compile_budget(None) as cb:             # record mode
+        fleet_run()
+    assert cb.compiles() == {"serving.decode": 1, ...}   # exact pins
+
+Raise mode (``limit`` an int) raises :class:`CompileBudgetExceeded` at
+exit when the region compiled more than ``limit`` programs; record mode
+(``limit=None``) never raises — tests assert on the per-name delta,
+which is how the serving suite pins "a 2-replica fleet compiles each
+shared program exactly once" and "a bucket change retraces exactly
+once".
 """
 from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 
 __all__ = ["profiled_jit", "ProfiledJit", "JitCostRegistry",
-           "cost_registry", "device_memory_stats"]
+           "cost_registry", "device_memory_stats",
+           "CompileLedger", "compile_ledger", "compile_budget",
+           "CompileBudget", "CompileBudgetExceeded"]
 
 
 def _leaf_sig(x):
@@ -155,6 +178,120 @@ class JitCostRegistry:
 cost_registry = JitCostRegistry()
 
 
+# --- compile ledger ----------------------------------------------------------
+class CompileLedger:
+    """Process-global per-callable trace/compile counter.
+
+    Append-only and monotonic (``reset()`` exists for test isolation):
+    every new-signature compile of a :class:`ProfiledJit` program lands
+    here as ``(name, sig_key, fallback)``.  ``cost_registry`` keeps the
+    rich attribution; the ledger keeps the ORDERED history cheap enough
+    to diff, which is what :func:`compile_budget` pins against."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._events: List[Tuple[str, str, bool]] = []
+
+    def on_compile(self, name: str, sig_key: str,
+                   fallback: bool = False):
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + 1
+            self._events.append((name, sig_key, fallback))
+
+    def counts(self, prefix: Optional[str] = None) -> Dict[str, int]:
+        """name -> compiles so far (optionally prefix-filtered)."""
+        with self._lock:
+            return {k: v for k, v in self._counts.items()
+                    if prefix is None or k.startswith(prefix)}
+
+    def total(self, prefix: Optional[str] = None) -> int:
+        return sum(self.counts(prefix).values())
+
+    def events(self) -> List[Tuple[str, str, bool]]:
+        with self._lock:
+            return list(self._events)
+
+    def reset(self):
+        with self._lock:
+            self._counts = {}
+            self._events = []
+
+
+compile_ledger = CompileLedger()
+
+
+class CompileBudgetExceeded(AssertionError):
+    """A ``compile_budget`` region compiled more programs than allowed."""
+
+
+class CompileBudget:
+    """Context manager diffing the compile ledger across a region.
+
+    ``limit`` is the maximum number of compiles the region may trigger
+    (0 pins "no retrace at all"); ``None`` selects record mode — never
+    raises, the caller asserts on :meth:`compiles` / :meth:`total`.
+    ``names`` / ``prefix`` scope which programs count."""
+
+    def __init__(self, limit: Optional[int] = None, *,
+                 names: Optional[Tuple[str, ...]] = None,
+                 prefix: Optional[str] = None,
+                 ledger: Optional[CompileLedger] = None):
+        self.limit = limit
+        self.names = tuple(names) if names else None
+        self.prefix = prefix
+        self._ledger = ledger if ledger is not None else compile_ledger
+        self._start: Dict[str, int] = {}
+
+    def _filtered(self, counts: Dict[str, int]) -> Dict[str, int]:
+        out = counts
+        if self.prefix is not None:
+            out = {k: v for k, v in out.items()
+                   if k.startswith(self.prefix)}
+        if self.names is not None:
+            out = {k: v for k, v in out.items() if k in self.names}
+        return out
+
+    def compiles(self) -> Dict[str, int]:
+        """Per-name compiles since entry (zero-delta names omitted)."""
+        now = self._filtered(self._ledger.counts())
+        return {k: v - self._start.get(k, 0) for k, v in now.items()
+                if v - self._start.get(k, 0) > 0}
+
+    def total(self) -> int:
+        return sum(self.compiles().values())
+
+    def __enter__(self) -> "CompileBudget":
+        self._start = self._filtered(self._ledger.counts())
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is None and self.limit is not None:
+            delta = self.compiles()
+            total = sum(delta.values())
+            if total > self.limit:
+                detail = ", ".join(f"{k} x{v}"
+                                   for k, v in sorted(delta.items()))
+                raise CompileBudgetExceeded(
+                    f"region compiled {total} program(s), budget is "
+                    f"{self.limit}: {detail} — a jitted signature "
+                    "drifted (see docs/ANALYSIS.md retrace-hazard)")
+        return False
+
+
+def compile_budget(limit: Optional[int] = None, *,
+                   names: Optional[Tuple[str, ...]] = None,
+                   prefix: Optional[str] = None,
+                   ledger: Optional[CompileLedger] = None
+                   ) -> CompileBudget:
+    """Assert a code region's compile count: ``with compile_budget(0,
+    prefix="serving."): ...`` raises :class:`CompileBudgetExceeded` when
+    any scoped program (re)compiles; ``compile_budget(None)`` records
+    only — assert on ``cb.compiles()`` for exact per-program pins."""
+    return CompileBudget(limit, names=names, prefix=prefix,
+                         ledger=ledger)
+
+
 class ProfiledJit:
     """A jax.jit wrapper with per-signature AOT compile + cost capture."""
 
@@ -187,6 +324,7 @@ class ProfiledJit:
             pass
         self._registry.record_compile(self.name, self._sig_str(sig), dt,
                                       cost, mem)
+        compile_ledger.on_compile(self.name, self._sig_str(sig))
         return compiled
 
     @staticmethod
@@ -210,6 +348,12 @@ class ProfiledJit:
                         compiled = self._compile_for(sig, args, kwargs)
                     except Exception:  # noqa: BLE001 — AOT unsupported
                         compiled = False    # remembered: don't retry
+                        # the plain-jit fallback still traces+compiles
+                        # this signature exactly once — the ledger's
+                        # compile accounting must not lose it
+                        compile_ledger.on_compile(
+                            self.name, self._sig_str(sig),
+                            fallback=True)
                     self._compiled[sig] = compiled
         # timer starts AFTER compilation: compile time is attributed
         # separately (record_compile) and must not pollute call latency

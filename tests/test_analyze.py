@@ -145,6 +145,245 @@ class TestJitHazard:
 
 
 # =============================================================================
+# retrace-hazard
+# =============================================================================
+class TestRetraceHazard:
+    def test_rh001_loop_varying_scalar(self, tmp_path):
+        root = make_tree(tmp_path, {"paddle_tpu/serving/bad.py": '''
+            import jax
+
+
+            @jax.jit
+            def step(x):
+                return x + 1
+
+
+            class Engine:
+                def drive(self, xs):
+                    out = []
+                    for i in range(8):
+                        out.append(step(i))            # RH001
+                        out.append(step(xs[i]))        # ok: array row
+                        out.append(self._decode_jit(i))  # RH001 (_jit attr)
+                    for j, x in enumerate(xs):
+                        out.append(step(j + 1))        # RH001 (arith)
+                        out.append(step(x))            # ok: the element
+                    for s in xs:                       # not range/enumerate
+                        out.append(step(s))            # ok
+                    return out
+
+                def comp(self, fn):
+                    g = jax.jit(fn)
+                    return [g((i, 2)) for i in range(4)]   # RH001
+            '''})
+        found = run_checks(root=root, checks=["retrace-hazard"])
+        assert [f.code for f in found] == ["RH001"] * 4
+        assert {f.line for f in found} == {14, 16, 18, 26}
+
+    def test_rh002_rh003_def_side(self, tmp_path):
+        root = make_tree(tmp_path, {"paddle_tpu/serving/bad.py": '''
+            import jax
+            from functools import partial
+
+
+            @jax.jit
+            def bad_default(x, flag=True, mode="fast"):   # RH002 x2
+                return x
+
+
+            @partial(jax.jit, static_argnames=("mode",))
+            def ok_static(x, mode="fast"):                # covered
+                return x
+
+
+            @jax.jit
+            def bad_mutable(x, cache=[]):                 # RH003
+                return x
+
+
+            def traced_inline_helper(x, with_head=True):  # analyze: jit-path
+                # marker mode: invoked as plain Python by its builder —
+                # call-site/static-argnames rules do not apply
+                return x
+            '''})
+        found = run_checks(root=root, checks=["retrace-hazard"])
+        codes = sorted(f.code for f in found)
+        assert codes == ["RH002", "RH002", "RH003"]
+        msgs = " ".join(f.message for f in found)
+        assert "'flag'" in msgs and "'mode'" in msgs and "'cache'" in msgs
+
+    def test_rh004_bool_str_leaves(self, tmp_path):
+        root = make_tree(tmp_path, {"paddle_tpu/serving/bad.py": '''
+            import jax
+
+
+            def go(fn, x):
+                w = jax.jit(fn)
+                w(x, True)                    # RH004
+                w(x, "greedy")                # RH004
+                ws = jax.jit(fn, static_argnums=(1,))
+                ws(x, True)                   # covered by static_argnums
+                return jax.jit(fn)(x, False)  # RH004 (immediate invoke)
+            '''})
+        found = run_checks(root=root, checks=["retrace-hazard"])
+        assert [f.code for f in found] == ["RH004"] * 3
+
+    def test_rh005_mutable_closure_state(self, tmp_path):
+        root = make_tree(tmp_path, {"paddle_tpu/serving/bad.py": '''
+            import jax
+
+            _EVENTS = []
+
+
+            @jax.jit
+            def side_effect(x):
+                _EVENTS.append(1)             # RH005: trace-time mutation
+                return x
+
+
+            def build():
+                table = [1, 2, 3]
+
+                @jax.jit
+                def stale(x):
+                    return x + table[0]       # RH005: hot mutable capture
+
+                table.append(4)
+                return stale
+
+
+            def ok_build():
+                cfg = [1, 2]                  # never mutated: fine
+
+                @jax.jit
+                def inner(x):
+                    out = dict(a=1)
+                    out["b"] = 2              # local: fine
+                    return x + cfg[0]
+
+                return inner
+            '''})
+        found = run_checks(root=root, checks=["retrace-hazard"])
+        assert [f.code for f in found] == ["RH005"] * 2
+        msgs = " ".join(f.message for f in found)
+        assert "_EVENTS" in msgs and "'table'" in msgs
+
+    def test_live_repo_clean(self):
+        assert run_checks(root=ROOT, checks=["retrace-hazard"]) == []
+
+
+# =============================================================================
+# pallas-contract
+# =============================================================================
+_BAD_CONTRACTS = '''
+    LANE = 128
+
+
+    class BlockDecl:
+        pass
+
+
+    class KernelContract:
+        pass
+
+
+    MISALIGNED = KernelContract(
+        name="misaligned",
+        module="paddle_tpu/ops/pallas_ops/fake_kernel.py",
+        grid=("i",),
+        dims={"bq": 104, "d": 96},
+        blocks=(
+            BlockDecl("q", "in", (1, "bq", "d"), "float32"),       # PC001
+            BlockDecl("w", "in", (8, LANE), "int8"),               # PC002
+            BlockDecl("ok", "out", (1, 4, LANE), "float32",
+                      waivers=("sublane: tested waiver",)),
+        ),
+        shape_buckets={"bq": (100, 250)},                          # PC003
+    )
+
+
+    HOG = KernelContract(
+        name="vmem_hog",
+        module="paddle_tpu/ops/pallas_ops/fake_kernel.py",
+        grid=("i",),
+        dims={"b": 1024},
+        blocks=(
+            BlockDecl("x", "in", ("b", "b"), "float32"),
+            BlockDecl("y", "in", ("b", "b"), "float32"),
+            BlockDecl("o", "out", ("b", "b"), "float32"),
+        ),                                                         # PC004
+    )
+
+
+    OPAQUE = KernelContract(
+        name="opaque",
+        module="paddle_tpu/ops/pallas_ops/fake_kernel.py",
+        grid=("i",),
+        dims=make_dims(),                                          # PC005
+        blocks=(),
+    )
+    '''
+
+_DRIFTY_KERNEL = '''
+    DEFAULT_BLOCK_Q = 512                     # PC005: raw literal
+
+
+    def kern(x, *, block_m=128):              # PC005: raw default
+        return x
+    '''
+
+
+class TestPallasContract:
+    def _tree(self, tmp_path, kernel=_DRIFTY_KERNEL):
+        return make_tree(tmp_path, {
+            "paddle_tpu/ops/pallas_ops/contracts.py": _BAD_CONTRACTS,
+            "paddle_tpu/ops/pallas_ops/fake_kernel.py": kernel,
+        })
+
+    def test_planted_violations_every_code(self, tmp_path):
+        found = run_checks(root=self._tree(tmp_path),
+                           checks=["pallas-contract"])
+        by_code = {}
+        for f in found:
+            by_code.setdefault(f.code, []).append(f.message)
+        assert len(by_code["PC001"]) == 1          # bq=100 lanes
+        assert "96" in by_code["PC001"][0]
+        assert len(by_code["PC002"]) == 1          # int8 sublane 8 < 32
+        assert len(by_code["PC003"]) == 2          # 100, 250 vs bq=100
+        assert len(by_code["PC004"]) == 1          # 3 x 4MB blocks x2
+        # PC005: opaque contract + missing-import + 2 raw literals
+        assert len(by_code["PC005"]) == 4
+        pc5 = " ".join(by_code["PC005"])
+        assert "pure literal" in pc5
+        assert "does not import the contracts module" in pc5
+
+    def test_waiver_suppresses_with_reason_on_record(self, tmp_path):
+        """The 'ok' block's sublane dim (bq=100 % 8 != 0) is waived
+        in-contract; no PC002 fires for it (the misaligned 'w' block
+        still does)."""
+        found = run_checks(root=self._tree(tmp_path),
+                           checks=["pallas-contract"])
+        pc2 = [f for f in found if f.code == "PC002"]
+        assert len(pc2) == 1 and "'w'" in pc2[0].message
+
+    def test_clean_kernel_module_passes_drift(self, tmp_path):
+        clean = '''
+            from .contracts import MISALIGNED as _C
+
+            DEFAULT_BLOCK_Q = _C.dim("bq")
+
+            def kern(x, *, block_m=_C.dim("bq")):
+                return x
+            '''
+        found = run_checks(root=self._tree(tmp_path, kernel=clean),
+                           checks=["pallas-contract"])
+        assert not any("fake_kernel" in f.file for f in found)
+
+    def test_live_repo_clean(self):
+        assert run_checks(root=ROOT, checks=["pallas-contract"]) == []
+
+
+# =============================================================================
 # metrics-drift
 # =============================================================================
 class TestMetricsDrift:
@@ -305,7 +544,8 @@ class TestRunnerAndCLI:
         assert res.returncode == 0, res.stderr
         names = res.stdout.split()
         assert names == sorted(["error-taxonomy", "jit-hazard",
-                                "lock-discipline", "metrics-drift"])
+                                "lock-discipline", "metrics-drift",
+                                "pallas-contract", "retrace-hazard"])
 
     def test_suppression_requires_matching_check_name(self, tmp_path):
         root = make_tree(tmp_path, {"paddle_tpu/serving/bad.py": '''

@@ -76,6 +76,14 @@ class TestFailOnRegression:
             "detail.resilience.brownout.goodput_ratio_vs_cliff_x")
         assert not bench_diff.lower_is_better(
             "detail.resilience.failover.recompute_saved_tokens")
+        # compile ledger section (ISSUE 8): compile counts/time gate
+        # upward — a rising compile_count is a retrace regression
+        assert bench_diff.lower_is_better(
+            "detail.compile.serving.decode.compile_count")
+        assert bench_diff.lower_is_better(
+            "detail.compile.serving.prefill.compile_time_ms")
+        assert bench_diff.lower_is_better(
+            "detail.compile.serving.decode_fused.calls")
 
     def test_reduction_ratio_gates_on_drop_not_rise(self):
         """The PR-4 acceptance metric: kv_bytes_reduction_x falling

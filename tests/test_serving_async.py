@@ -259,7 +259,13 @@ class TestSteadyStateTransfers:
         for _ in range(4):
             eng.step()
         assert all(s is not None for s in eng._lanes)
-        with jax.transfer_guard("disallow"):
+        # the compile-ledger twin of the transfer-guard invariant
+        # (ISSUE 8): the guarded steady state must not RETRACE either —
+        # an implicit transfer and a signature drift are the same class
+        # of silent hot-path regression
+        from paddle_tpu.profiler.jit_cost import compile_budget
+        with jax.transfer_guard("disallow"), \
+                compile_budget(0, prefix="serving."):
             for _ in range(8):
                 stats = eng.step()
                 assert stats["bucket"] == 4

@@ -1,12 +1,18 @@
 """AST lint suite for the serving fleet (``python -m tools.analyze``).
 
-Static companion to the runtime lock-order witness
-(``paddle_tpu.framework.concurrency``): four checkers over the parsed
-source keep the hazards PR reviews kept catching by hand machine-checked
+Static companion to the runtime witnesses (the lock-order witness in
+``paddle_tpu.framework.concurrency``, the compile ledger in
+``paddle_tpu.profiler.jit_cost``): six checkers over the parsed source
+keep the hazards PR reviews kept catching by hand machine-checked
 instead (docs/ANALYSIS.md has the catalog and the baseline workflow):
 
 - ``lock-discipline``  blocking calls while a framework lock is held
 - ``jit-hazard``       host-sync ops inside jitted functions
+- ``retrace-hazard``   jit-signature instability (silent recompiles):
+                       loop-varying scalars, missing static_argnames,
+                       mutable defaults/closures, bool/str leaves
+- ``pallas-contract``  declared KernelContract tiling/VMEM/divisibility
+                       rules + contract/call-site drift
 - ``metrics-drift``    emitted metric names <-> docs/OBSERVABILITY.md
 - ``error-taxonomy``   serving raises use framework.errors classes and
                        every class has an HTTP mapping

@@ -3,4 +3,4 @@ with ``core.CHECKS`` (each checker module calls ``@register`` at import
 time).  New checkers: add the module here and it joins the CLI, the
 baseline workflow and the tier-1 self-run automatically."""
 from . import (error_taxonomy, jit_hazard, lock_discipline,  # noqa: F401
-               metrics_drift)
+               metrics_drift, pallas_contract, retrace_hazard)

@@ -8,14 +8,17 @@ traced function either fails at trace time (late, with a cryptic tracer
 error) or — worse — silently constant-folds a value that should have
 been data-dependent.
 
-A function counts as jitted when any of:
+A function counts as jitted when any of (the shared
+:mod:`.jit_scopes` collector owns the resolution):
 
 - it is decorated with something jit-shaped (``@jax.jit``, ``@jit``,
   ``@pjit``, ``@partial(jax.jit, ...)``, ``@profiled_jit(...)``,
   ``@jax.pmap``);
 - its NAME is passed to a jit-wrapping call in the same module
   (``profiled_jit("serving.decode", _decode, ...)``, ``jax.jit(fn)``)
-  — the engine/generation idiom: define a closure, wrap it later;
+  — the engine/generation idiom: define a closure, wrap it later —
+  resolved LEXICALLY (class scopes excluded, so a method sharing a
+  closure's name is never confused with it);
 - the ``def`` line (or the line above it) carries the explicit marker
   ``# analyze: jit-path`` — the opt-in for steady-state decode-path
   helpers that are traced indirectly (e.g. returned from a ``make_*``
@@ -28,90 +31,18 @@ Hazards flagged inside such functions: ``.item()`` / ``.tolist()`` /
 from __future__ import annotations
 
 import ast
-import re
-from typing import Dict, List, Tuple
+from typing import List
 
-from .core import (AnalysisContext, Finding, last_component, register,
-                   unparse)
+from .core import AnalysisContext, Finding, register, unparse
+from .jit_scopes import JitCollector
 
 ROOTS = ("paddle_tpu",)
 
-_MARKER = "analyze: jit-path"
-_JIT_WRAPPERS = re.compile(
-    r"(?:^|\.)(jit|pjit|pmap|profiled_jit)$")
 _HAZARD_ATTRS = frozenset({"item", "tolist", "numpy",
                            "block_until_ready"})
 _HAZARD_FUNCS = frozenset({"np.asarray", "np.array", "np.copy",
                            "numpy.asarray", "numpy.array",
                            "jax.device_get", "device_get", "print"})
-
-
-def _is_jit_decorator(dec: ast.AST) -> bool:
-    """@jax.jit / @jit / @pjit / @profiled_jit(...) / @partial(jax.jit)."""
-    if isinstance(dec, ast.Call):
-        # @partial(jax.jit, ...) or @profiled_jit("name") — look at the
-        # callee and its first arg
-        if _is_jit_decorator(dec.func):
-            return True
-        return any(not isinstance(a, ast.Constant)
-                   and _is_jit_decorator(a) for a in dec.args)
-    name = last_component(dec)
-    return bool(name) and bool(_JIT_WRAPPERS.search(f".{name}"))
-
-
-class _Collector(ast.NodeVisitor):
-    """Pass 1: find jitted defs — by decorator, by a jit-wrapping call
-    naming the def (resolved LEXICALLY: ``jax.jit(run)`` marks the
-    ``run`` visible from the call's scope, innermost first — never a
-    same-named method elsewhere in the module), or by marker comment."""
-
-    def __init__(self, rel: str, ctx: AnalysisContext):
-        self.rel = rel
-        self.ctx = ctx
-        # one (kind, names) per lexical scope, innermost last.  Class
-        # scopes hold NO resolvable names: a class body is not in the
-        # lexical lookup chain of its methods, so `jax.jit(run)` inside
-        # a method must never resolve to a sibling method `run`.
-        self.scopes: List[Tuple[str, Dict[str, ast.FunctionDef]]] = [
-            ("module", {})]
-        self.jitted: List[ast.FunctionDef] = []
-
-    def visit_FunctionDef(self, node: ast.FunctionDef):
-        kind, names = self.scopes[-1]
-        if kind != "class":
-            names[node.name] = node
-        if any(_is_jit_decorator(d) for d in node.decorator_list):
-            self.jitted.append(node)
-        else:
-            here = self.ctx.line_text(self.rel, node.lineno)
-            above = self.ctx.line_text(self.rel, node.lineno - 1)
-            if _MARKER in here or _MARKER in above:
-                self.jitted.append(node)
-        self.scopes.append(("function", {}))
-        self.generic_visit(node)
-        self.scopes.pop()
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-    def visit_ClassDef(self, node: ast.ClassDef):
-        self.scopes.append(("class", {}))
-        self.generic_visit(node)
-        self.scopes.pop()
-
-    def visit_Call(self, node: ast.Call):
-        callee = last_component(node.func)
-        if callee and _JIT_WRAPPERS.search(f".{callee}"):
-            for arg in node.args:
-                if isinstance(arg, ast.Name):
-                    for kind, names in reversed(self.scopes):
-                        if kind == "class":
-                            continue
-                        target = names.get(arg.id)
-                        if target is not None:
-                            if target not in self.jitted:
-                                self.jitted.append(target)
-                            break
-        self.generic_visit(node)
 
 
 class _HazardScan(ast.NodeVisitor):
@@ -148,11 +79,11 @@ def run(ctx: AnalysisContext) -> List[Finding]:
         tree = ctx.tree(rel)
         if tree is None:
             continue
-        col = _Collector(rel, ctx)
+        col = JitCollector(rel, ctx)
         col.visit(tree)
-        for node in col.jitted:
-            scan = _HazardScan(rel, node.name)
-            for stmt in node.body:
+        for ent in col.jitted:
+            scan = _HazardScan(rel, ent.node.name)
+            for stmt in ent.node.body:
                 scan.visit(stmt)
             findings.extend(scan.findings)
     return findings

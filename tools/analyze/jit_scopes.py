@@ -1,0 +1,218 @@
+"""Shared lexical-scope machinery for the jit-aware checkers.
+
+``jit-hazard`` (JH001) and ``retrace-hazard`` (RH00x) both need the
+same two resolutions over a module:
+
+- which function DEFS are jitted (decorator, name-passed-to-a-wrapper,
+  or ``# analyze: jit-path`` marker), resolved LEXICALLY — a class body
+  is not in the lookup chain of its methods, so ``jax.jit(run)`` inside
+  a method never aliases a sibling method ``run``;
+- which NAMES are bound to jit-wrapped callables
+  (``w = jax.jit(fn)``, ``decode = profiled_jit("serving.decode",
+  _decode, donate_argnums=(3,))``) so a CALL SITE can be recognized as
+  crossing a jit dispatch boundary.
+
+This module owns both (extracted from the PR-7 jit_hazard collector);
+the checkers stay thin rule sets on top.  Pure stdlib.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import AnalysisContext, last_component
+
+MARKER = "analyze: jit-path"
+JIT_WRAPPERS = re.compile(r"(?:^|\.)(jit|pjit|pmap|profiled_jit)$")
+
+# decorator/name-wrap modes cross a REAL jit dispatch boundary when
+# called; marker-mode defs are traced INLINE by a builder (their args
+# are plain Python at trace-build time), so call-site signature rules
+# do not apply to them
+MODE_DECORATOR = "decorator"
+MODE_WRAPPED = "wrapped"
+MODE_MARKER = "marker"
+
+
+def is_jit_wrapper_name(name: str) -> bool:
+    return bool(name) and bool(JIT_WRAPPERS.search(f".{name}"))
+
+
+def is_jit_decorator(dec: ast.AST) -> bool:
+    """@jax.jit / @jit / @pjit / @profiled_jit(...) / @partial(jax.jit)."""
+    if isinstance(dec, ast.Call):
+        # @partial(jax.jit, ...) or @profiled_jit("name") — look at the
+        # callee and its first arg
+        if is_jit_decorator(dec.func):
+            return True
+        return any(not isinstance(a, ast.Constant)
+                   and is_jit_decorator(a) for a in dec.args)
+    return is_jit_wrapper_name(last_component(dec))
+
+
+def static_decls(call: Optional[ast.Call]) -> Tuple[Set[str], Set[int]]:
+    """(static_argnames, static_argnums) declared on a jit wrap call or
+    decorator — empty sets when nothing is declared or the wrap is not
+    a Call (plain ``@jax.jit``)."""
+    names: Set[str] = set()
+    nums: Set[int] = set()
+    if not isinstance(call, ast.Call):
+        return names, nums
+    for kw in call.keywords:
+        vals: List = []
+        if isinstance(kw.value, ast.Constant):
+            vals = [kw.value.value]
+        elif isinstance(kw.value, (ast.Tuple, ast.List)):
+            vals = [e.value for e in kw.value.elts
+                    if isinstance(e, ast.Constant)]
+        if kw.arg == "static_argnames":
+            names |= {v for v in vals if isinstance(v, str)}
+        elif kw.arg == "static_argnums":
+            nums |= {v for v in vals if isinstance(v, int)}
+    # @partial(jax.jit, static_argnames=...) nests the decls one level up
+    if is_jit_decorator(call.func) and isinstance(call.func, ast.Call):
+        n2, i2 = static_decls(call.func)
+        names |= n2
+        nums |= i2
+    return names, nums
+
+
+class JittedDef:
+    """One function def known to be jitted, with how we know."""
+
+    __slots__ = ("node", "mode", "wrap_call")
+
+    def __init__(self, node: ast.FunctionDef, mode: str,
+                 wrap_call: Optional[ast.Call]):
+        self.node = node
+        self.mode = mode           # MODE_DECORATOR / MODE_WRAPPED / MODE_MARKER
+        self.wrap_call = wrap_call  # the Call carrying static_arg* decls
+
+
+class JitCollector(ast.NodeVisitor):
+    """Pass 1 over a module: jitted defs + jit-bound names, resolved
+    through a proper lexical scope stack (class scopes hold NO
+    resolvable names)."""
+
+    def __init__(self, rel: str, ctx: AnalysisContext):
+        self.rel = rel
+        self.ctx = ctx
+        # one (kind, names) frame per lexical scope, innermost last;
+        # names maps identifier -> ast.FunctionDef
+        self.scopes: List[Tuple[str, Dict[str, ast.FunctionDef]]] = [
+            ("module", {})]
+        self.jitted: List[JittedDef] = []
+        self._by_node: Dict[ast.FunctionDef, JittedDef] = {}
+        # scope node (Module/FunctionDef) -> names assigned from a jit
+        # wrap call in that scope, with the wrapping Call
+        self.bound: Dict[ast.AST, Dict[str, ast.Call]] = {}
+        # scope node -> function defs bound in that scope (class bodies
+        # excluded — not in the lexical chain of their methods)
+        self.defs: Dict[ast.AST, Dict[str, ast.FunctionDef]] = {}
+        self._scope_nodes: List[ast.AST] = []
+
+    # --- bookkeeping -----------------------------------------------------
+    def _add_jitted(self, node: ast.FunctionDef, mode: str,
+                    wrap_call: Optional[ast.Call]):
+        ent = self._by_node.get(node)
+        if ent is None:
+            ent = JittedDef(node, mode, wrap_call)
+            self._by_node[node] = ent
+            self.jitted.append(ent)
+        elif ent.wrap_call is None and wrap_call is not None:
+            ent.wrap_call = wrap_call
+            ent.mode = mode
+
+    def jitted_def(self, node: ast.FunctionDef) -> Optional[JittedDef]:
+        return self._by_node.get(node)
+
+    # --- scope walk ------------------------------------------------------
+    def visit_Module(self, node: ast.Module):
+        self._scope_nodes.append(node)
+        self.generic_visit(node)
+        self._scope_nodes.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        kind, names = self.scopes[-1]
+        if kind != "class":
+            names[node.name] = node
+            self.defs.setdefault(self._scope_nodes[-1],
+                                 {})[node.name] = node
+        jit_dec = next((d for d in node.decorator_list
+                        if is_jit_decorator(d)), None)
+        if jit_dec is not None:
+            self._add_jitted(node, MODE_DECORATOR,
+                             jit_dec if isinstance(jit_dec, ast.Call)
+                             else None)
+        else:
+            here = self.ctx.line_text(self.rel, node.lineno)
+            above = self.ctx.line_text(self.rel, node.lineno - 1)
+            if MARKER in here or MARKER in above:
+                self._add_jitted(node, MODE_MARKER, None)
+        self.scopes.append(("function", {}))
+        self._scope_nodes.append(node)
+        self.generic_visit(node)
+        self._scope_nodes.pop()
+        self.scopes.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self.scopes.append(("class", {}))
+        self.generic_visit(node)
+        self.scopes.pop()
+
+    def visit_Call(self, node: ast.Call):
+        callee = last_component(node.func)
+        if is_jit_wrapper_name(callee):
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    target = self._lookup_def(arg.id)
+                    if target is not None:
+                        self._add_jitted(target, MODE_WRAPPED, node)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        # w = jax.jit(fn) / decode = profiled_jit("name", fn, ...):
+        # the assigned NAME is a jit-wrapped callable in this scope
+        if isinstance(node.value, ast.Call) \
+                and is_jit_wrapper_name(last_component(node.value.func)):
+            scope = self._scope_nodes[-1]
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.bound.setdefault(scope, {})[t.id] = node.value
+        self.generic_visit(node)
+
+    # --- resolution ------------------------------------------------------
+    def _lookup_def(self, name: str) -> Optional[ast.FunctionDef]:
+        for kind, names in reversed(self.scopes):
+            if kind == "class":
+                continue
+            target = names.get(name)
+            if target is not None:
+                return target
+        return None
+
+    def resolve_jit_callee(self, name: str,
+                           scope_chain: List[ast.AST]
+                           ) -> Optional[Tuple[str, Optional[ast.Call]]]:
+        """Resolve ``name`` through ``scope_chain`` (innermost last,
+        class scopes must already be excluded): returns (how, wrap_call)
+        when the nearest lexical binding of the name is a jit-wrapped
+        callable — a name assigned from a wrap call, or a def jitted by
+        decorator/name-wrap (marker defs are traced inline, not a
+        dispatch boundary).  Resolution STOPS at the nearest binding:
+        a shadowing non-jitted def hides an outer jitted one."""
+        for scope in reversed(scope_chain):
+            wrap = self.bound.get(scope, {}).get(name)
+            if wrap is not None:
+                return ("bound", wrap)
+            target = self.defs.get(scope, {}).get(name)
+            if target is not None:
+                ent = self._by_node.get(target)
+                if ent is not None and ent.mode in (MODE_DECORATOR,
+                                                    MODE_WRAPPED):
+                    return ("def", ent.wrap_call)
+                return None
+        return None

@@ -1,0 +1,337 @@
+"""pallas-contract: lint the declared KernelContract objects.
+
+``paddle_tpu/ops/pallas_ops/contracts.py`` lifts every hand-picked
+grid/BlockSpec/scratch literal of the Pallas kernels into declared
+:class:`KernelContract` objects.  This checker re-derives the contracts
+FROM THE AST (pure stdlib — no jax import, declarations must stay
+literal) and applies the TPU resource rules; the runtime twin is
+``KernelContract.validate()``, which the autotuner will run against
+candidate configs.
+
+Codes:
+
+- **PC001** — a VMEM block's last dim is not a multiple of the 128-wide
+  lane (and does not span the full array dim / carry a waiver).
+- **PC002** — a VMEM block's sublane (second-to-last) dim misses the
+  dtype tile floor: 8 for f32/i32, 16 for bf16, 32 for int8.
+- **PC003** — a declared shape bucket is not divisible by its block
+  size: the grid would need a ragged final block the kernel body does
+  not handle.
+- **PC004** — the static VMEM footprint estimate (Σ block bytes,
+  grid-streamed in/out blocks ×2 for double-buffering) exceeds the
+  declared per-platform budget.
+- **PC005** — contract/call-site drift: a contract that is not a pure
+  literal (the lint cannot verify what it cannot read), a contract
+  naming a kernel module that does not exist or does not import the
+  contracts module, or a ``block_*`` parameter default / module-level
+  ``*BLOCK*`` constant written as a raw integer literal in a governed
+  kernel module instead of reading the contract.
+
+Waivers declared in-contract (``BlockDecl(..., waivers=("sublane: why",
+...))``) suppress their rule with the reason on record — the
+contract-native form of ``# analyze: allow[...]``.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from .core import AnalysisContext, Finding, register
+
+ROOTS = ("paddle_tpu",)
+CHECK = "pallas-contract"
+
+# local copies of the rule tables in ops/pallas_ops/contracts.py (this
+# suite imports nothing from paddle_tpu by design — the CLI must start
+# in ms); tests/test_kernel_contracts.py pins the two sets EQUAL, so a
+# contracts.py table edit that forgets this mirror fails tier-1
+LANE = 128
+SUBLANE_FLOOR = {
+    "float32": 8, "int32": 8, "uint32": 8,
+    "bfloat16": 16, "float16": 16,
+    "int8": 32, "uint8": 32, "float8_e4m3fn": 32, "float8_e5m2": 32,
+}
+DTYPE_BYTES = {
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2,
+    "int8": 1, "uint8": 1, "float8_e4m3fn": 1, "float8_e5m2": 1,
+}
+DEFAULT_VMEM_BUDGET = 12 * 1024 * 1024
+_BLOCK_CONST_RE = re.compile(r"(^|_)BLOCK(_|$)")
+
+
+class _Unsupported(Exception):
+    pass
+
+
+def _eval(node: ast.AST, env: Dict[str, Any]) -> Any:
+    """Literal evaluator for contract declarations: constants, tuples,
+    lists, dicts, +-*// arithmetic, module-level constant names, and
+    BlockDecl(...) calls (returned as dicts carrying their line)."""
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Tuple):
+        return tuple(_eval(e, env) for e in node.elts)
+    if isinstance(node, ast.List):
+        return [_eval(e, env) for e in node.elts]
+    if isinstance(node, ast.Dict):
+        out = {}
+        for k, v in zip(node.keys, node.values):
+            if k is None:
+                raise _Unsupported("dict unpacking")
+            out[_eval(k, env)] = _eval(v, env)
+        return out
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return -_eval(node.operand, env)
+    if isinstance(node, ast.BinOp):
+        left, right = _eval(node.left, env), _eval(node.right, env)
+        if isinstance(node.op, ast.Add):
+            return left + right
+        if isinstance(node.op, ast.Sub):
+            return left - right
+        if isinstance(node.op, ast.Mult):
+            return left * right
+        if isinstance(node.op, ast.FloorDiv):
+            return left // right
+        raise _Unsupported(f"operator {type(node.op).__name__}")
+    if isinstance(node, ast.Name):
+        if node.id in env:
+            return env[node.id]
+        raise _Unsupported(f"name {node.id!r}")
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id == "BlockDecl":
+        decl: Dict[str, Any] = {"__line__": node.lineno}
+        fields = ("name", "kind", "shape", "dtype", "memory",
+                  "lanes_full", "sublane_full", "waivers")
+        for i, arg in enumerate(node.args):
+            decl[fields[i]] = _eval(arg, env)
+        for kw in node.keywords:
+            decl[kw.arg] = _eval(kw.value, env)
+        decl.setdefault("memory", "vmem")
+        decl.setdefault("lanes_full", False)
+        decl.setdefault("sublane_full", False)
+        decl.setdefault("waivers", ())
+        return decl
+    raise _Unsupported(type(node).__name__)
+
+
+def _waived(decl: Dict[str, Any], rule: str) -> bool:
+    return any(str(w).split(":", 1)[0].strip() == rule
+               for w in decl.get("waivers", ()))
+
+
+def extract_contracts(ctx: AnalysisContext, rel: str
+                      ) -> Tuple[List[Dict[str, Any]], List[Finding]]:
+    """KernelContract declarations in ``rel`` as plain dicts (with
+    ``__line__``), plus PC005 findings for non-literal declarations."""
+    tree = ctx.tree(rel)
+    contracts: List[Dict[str, Any]] = []
+    findings: List[Finding] = []
+    if tree is None:
+        return contracts, findings
+    env: Dict[str, Any] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            value = node.value
+            if isinstance(value, ast.Call) \
+                    and isinstance(value.func, ast.Name) \
+                    and value.func.id == "KernelContract":
+                con: Dict[str, Any] = {"__line__": value.lineno,
+                                       "__var__": name}
+                try:
+                    fields = ("name", "module", "grid", "dims", "blocks",
+                              "shape_buckets", "double_buffered",
+                              "platform", "vmem_budget_bytes")
+                    for i, arg in enumerate(value.args):
+                        con[fields[i]] = _eval(arg, env)
+                    for kw in value.keywords:
+                        con[kw.arg] = _eval(kw.value, env)
+                except _Unsupported as e:
+                    findings.append(Finding(
+                        rel, value.lineno, "PC005", CHECK,
+                        f"contract {name!r} is not a pure literal "
+                        f"({e.args[0]}) — the lint cannot verify what "
+                        "it cannot read; declare dims/blocks as "
+                        "constants"))
+                    continue
+                con.setdefault("shape_buckets", {})
+                con.setdefault("double_buffered", True)
+                con.setdefault("platform", "tpu")
+                con.setdefault("vmem_budget_bytes", DEFAULT_VMEM_BUDGET)
+                contracts.append(con)
+            else:
+                try:
+                    env[name] = _eval(value, env)
+                except _Unsupported:
+                    pass
+    return contracts, findings
+
+
+def _resolve(con: Dict[str, Any], shape) -> Optional[Tuple[int, ...]]:
+    dims = con.get("dims", {})
+    out = []
+    for d in shape:
+        if isinstance(d, int):
+            out.append(d)
+        elif isinstance(d, str) and isinstance(dims.get(d), int):
+            out.append(dims[d])
+        else:
+            return None
+    return tuple(out)
+
+
+def _check_contract(rel: str, con: Dict[str, Any],
+                    findings: List[Finding]):
+    cname = con.get("name", con.get("__var__", "?"))
+    vmem_total = 0
+    for decl in con.get("blocks", ()):
+        if not isinstance(decl, dict):
+            continue
+        line = decl.get("__line__", con["__line__"])
+        bname = decl.get("name", "?")
+        dtype = decl.get("dtype", "float32")
+        if decl.get("memory", "vmem") != "vmem":
+            continue      # SMEM scalar-prefetch extents are data-dependent
+        shape = _resolve(con, decl.get("shape", ()))
+        if shape is None:
+            findings.append(Finding(
+                rel, line, "PC005", CHECK,
+                f"contract {cname!r} block {bname!r}: shape has a "
+                "symbol with no integer binding in dims — the default "
+                "config must resolve fully"))
+            continue
+        if len(shape) >= 2:
+            lane, sub = shape[-1], shape[-2]
+            if lane % LANE and not decl.get("lanes_full") \
+                    and not _waived(decl, "lane"):
+                findings.append(Finding(
+                    rel, line, "PC001", CHECK,
+                    f"contract {cname!r} block {bname!r}: last dim "
+                    f"{lane} is not a multiple of the {LANE}-wide lane"))
+            floor = SUBLANE_FLOOR.get(dtype, 8)
+            if sub % floor and not decl.get("sublane_full") \
+                    and not _waived(decl, "sublane"):
+                findings.append(Finding(
+                    rel, line, "PC002", CHECK,
+                    f"contract {cname!r} block {bname!r}: sublane dim "
+                    f"{sub} misses the {dtype} tile floor {floor}"))
+        n = 1
+        for d in shape:
+            n *= d
+        mult = 2 if (con.get("double_buffered", True)
+                     and decl.get("kind") in ("in", "out")) else 1
+        vmem_total += mult * n * DTYPE_BYTES.get(dtype, 4)
+    for sym, buckets in con.get("shape_buckets", {}).items():
+        size = con.get("dims", {}).get(sym)
+        if not isinstance(size, int):
+            findings.append(Finding(
+                rel, con["__line__"], "PC005", CHECK,
+                f"contract {cname!r}: shape_buckets symbol {sym!r} has "
+                "no integer binding in dims"))
+            continue
+        for v in buckets:
+            if v % size:
+                findings.append(Finding(
+                    rel, con["__line__"], "PC003", CHECK,
+                    f"contract {cname!r}: bucket {v} along {sym!r} is "
+                    f"not divisible by its block size {size} — the "
+                    "grid would need a ragged final block"))
+    budget = con.get("vmem_budget_bytes", DEFAULT_VMEM_BUDGET)
+    if vmem_total > budget:
+        findings.append(Finding(
+            rel, con["__line__"], "PC004", CHECK,
+            f"contract {cname!r}: static VMEM estimate {vmem_total} "
+            f"bytes (Σ block bytes × double-buffering) exceeds the "
+            f"{con.get('platform', 'tpu')} budget {budget}"))
+
+
+def _check_module_drift(ctx: AnalysisContext, rel: str,
+                        module_rel: str, cname: str,
+                        findings: List[Finding]):
+    tree = ctx.tree(module_rel)
+    if tree is None or not ctx.lines(module_rel):
+        findings.append(Finding(
+            rel, 1, "PC005", CHECK,
+            f"contract {cname!r} governs {module_rel!r} but the module "
+            "does not exist or does not parse"))
+        return
+    imports_contracts = any(
+        (isinstance(n, ast.ImportFrom) and n.module
+         and n.module.endswith("contracts"))
+        or (isinstance(n, ast.ImportFrom) and n.module is None
+            and any(a.name == "contracts" for a in n.names))
+        or (isinstance(n, ast.Import)
+            and any(a.name.endswith("contracts") for a in n.names))
+        for n in ast.walk(tree))
+    if not imports_contracts:
+        findings.append(Finding(
+            module_rel, 1, "PC005", CHECK,
+            f"kernel module governed by contract {cname!r} does not "
+            "import the contracts module — its block constants cannot "
+            "be reading the declared values"))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) \
+                        and _BLOCK_CONST_RE.search(t.id) \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, int):
+                    findings.append(Finding(
+                        module_rel, node.lineno, "PC005", CHECK,
+                        f"block constant {t.id} is a raw integer "
+                        "literal — read it from the KernelContract "
+                        "(single source of truth) so the declared and "
+                        "compiled values cannot drift"))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            params = a.posonlyargs + a.args + a.kwonlyargs
+            defaults = ([None] * (len(a.posonlyargs + a.args)
+                                  - len(a.defaults)) + list(a.defaults)
+                        + list(a.kw_defaults))
+            for param, default in zip(params, defaults):
+                if default is None or not param.arg.startswith("block_"):
+                    continue
+                if isinstance(default, ast.Constant) \
+                        and isinstance(default.value, int) \
+                        and not isinstance(default.value, bool):
+                    findings.append(Finding(
+                        module_rel, default.lineno, "PC005", CHECK,
+                        f"parameter {param.arg!r} of {node.name!r} "
+                        "defaults to a raw integer literal — read it "
+                        "from the KernelContract so the declared and "
+                        "compiled values cannot drift"))
+
+
+@register("pallas-contract")
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    governed: Dict[str, str] = {}      # kernel module rel -> contract name
+    for rel in ctx.iter_py(ROOTS):
+        src = ctx.source(rel)
+        if "KernelContract(" not in src:
+            continue
+        # the declaration module, not a kernel importing the class
+        if "class KernelContract" in src or rel.endswith("contracts.py"):
+            contracts, fs = extract_contracts(ctx, rel)
+            findings.extend(fs)
+            for con in contracts:
+                _check_contract(rel, con, findings)
+                mod = con.get("module")
+                cname = con.get("name", con.get("__var__", "?"))
+                # drift-check each governed module once (the first
+                # contract naming it claims the check)
+                if isinstance(mod, str) \
+                        and governed.setdefault(mod, cname) == cname:
+                    _check_module_drift(ctx, rel, mod, cname, findings)
+    # dedupe drift findings (several contracts can govern one module)
+    seen = set()
+    out = []
+    for f in findings:
+        key = (f.file, f.line, f.code, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out

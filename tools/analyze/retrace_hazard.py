@@ -1,0 +1,474 @@
+"""retrace-hazard: jit-signature instability that silently recompiles.
+
+Every serving bench reports ``binding_wall=hbm`` and the PR-6 shared
+program cache made replica fleets cheap — both wins are lost whenever a
+jitted signature drifts and XLA quietly recompiles (20-40s per program
+on real chips).  The runtime compile ledger
+(``paddle_tpu.profiler.jit_cost.compile_budget``) pins compile counts in
+tests; this checker flags the PATTERNS that cause drift statically,
+before a soak has to catch them.  Jitted names resolve lexically through
+the same scope stack as ``jit-hazard`` (:mod:`.jit_scopes`).
+
+A call site counts as crossing a jit dispatch boundary when its callee
+(a) resolves lexically to a name bound from a jit wrap
+(``w = jax.jit(fn)``, ``decode = profiled_jit("serving.decode", ...)``)
+or to a def jitted by decorator/name-wrap, (b) is an attribute named
+``*_jit`` (the engine idiom: ``self._decode_jit(...)``), or (c) is an
+immediately-invoked wrap (``jax.jit(fn)(x)``).  Marker-mode
+(``# analyze: jit-path``) defs are traced INLINE by their builder —
+calling them is plain Python at trace time, not a dispatch — so the
+call-site rules skip them.
+
+Codes:
+
+- **RH001** — a loop-varying Python scalar (the target of a
+  ``range``/``enumerate`` loop, alone or in pure scalar arithmetic /
+  a container display) passed POSITIONALLY to a jit dispatch inside
+  the loop: every iteration changes the compile-cache signature —
+  ``profiled_jit`` keys Python scalars BY VALUE, so this recompiles
+  per iteration.  ``device_put`` it once outside the loop (the
+  engine's ``_lane_ids`` idiom) or declare it static and bucket it.
+- **RH002** — a jitted def has a bool/str-defaulted parameter not named
+  in the wrap's ``static_argnames``: the leaf is traced (a traced bool
+  cannot branch; a str is not a valid jax leaf) or silently retraces
+  per value — declare it static.
+- **RH003** — mutable default argument (``[]`` / ``{}`` / ``set()`` /
+  ``dict()``) on a jitted def: the default is evaluated once, shared
+  across traces, and baked into the compiled program.
+- **RH004** — a bool/str literal passed positionally to a jit dispatch
+  at a position not covered by ``static_argnums``: same physics as
+  RH002, seen from the call site.
+- **RH005** — a jitted function mutates or depends on mutable closure
+  state: ``global``/``nonlocal`` declarations, mutating-method calls /
+  subscript stores on non-local names (the side effect runs ONCE at
+  trace time, not per call), or reads of an enclosing-scope name that
+  is bound to a mutable literal and mutated elsewhere in that scope
+  (the traced value is a stale snapshot).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import (AnalysisContext, Finding, last_component, register,
+                   unparse)
+from .jit_scopes import (MODE_MARKER, JitCollector, is_jit_wrapper_name,
+                         static_decls)
+
+ROOTS = ("paddle_tpu",)
+CHECK = "retrace-hazard"
+
+_MUTATING_ATTRS = frozenset({
+    "append", "extend", "insert", "add", "update", "setdefault",
+    "pop", "popitem", "remove", "discard", "clear",
+})
+
+
+def _is_mutable_literal(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in ("list", "dict", "set")
+            and not node.args and not node.keywords)
+
+
+def _is_mutable_display(node: ast.AST) -> bool:
+    """Only plain displays / empty constructors — a comprehension is
+    usually a build-once mapping (e.g. a quantized-weight dict) and
+    reading one from a closure is the normal capture idiom."""
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in ("list", "dict", "set")
+            and not node.args and not node.keywords)
+
+
+def _scalar_expr_names(node: ast.AST) -> Optional[Set[str]]:
+    """Names in ``node`` when it is PURE Python scalar arithmetic or a
+    container display thereof — i.e. an expression whose runtime value
+    is a Python scalar/container that changes with those names.  None
+    when anything non-scalar participates (a subscript like ``arr[i]``
+    or a call like ``jnp.full((), i)`` materializes BEFORE the dispatch
+    — shape-stable, not a signature change)."""
+    if isinstance(node, ast.Name):
+        return {node.id}
+    if isinstance(node, ast.Constant):
+        return set()
+    if isinstance(node, ast.UnaryOp):
+        return _scalar_expr_names(node.operand)
+    if isinstance(node, (ast.BinOp,)):
+        left = _scalar_expr_names(node.left)
+        right = _scalar_expr_names(node.right)
+        if left is None or right is None:
+            return None
+        return left | right
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out: Set[str] = set()
+        for e in node.elts:
+            sub = _scalar_expr_names(e)
+            if sub is None:
+                return None
+            out |= sub
+        return out
+    if isinstance(node, ast.Dict):
+        out = set()
+        for e in list(node.keys) + list(node.values):
+            if e is None:
+                return None
+            sub = _scalar_expr_names(e)
+            if sub is None:
+                return None
+            out |= sub
+        return out
+    return None
+
+
+def _range_loop_targets(iter_node: ast.AST,
+                        target: ast.AST) -> Set[str]:
+    """Loop-target names that are Python scalars: all targets of a
+    ``range(...)`` loop, the counter of an ``enumerate(...)`` loop."""
+    callee = last_component(iter_node.func) \
+        if isinstance(iter_node, ast.Call) else ""
+    if callee == "range":
+        return {n.id for n in ast.walk(target)
+                if isinstance(n, ast.Name)}
+    if callee == "enumerate" and isinstance(target, ast.Tuple) \
+            and target.elts and isinstance(target.elts[0], ast.Name):
+        return {target.elts[0].id}
+    return set()
+
+
+def _local_names(fn: ast.FunctionDef) -> Set[str]:
+    """Names bound inside ``fn`` (params + any assignment/loop/with
+    target), shallow nested defs included as names."""
+    names: Set[str] = set()
+    a = fn.args
+    for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+        names.add(arg.arg)
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    names.add(n.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.withitem) \
+                and node.optional_vars is not None:
+            for n in ast.walk(node.optional_vars):
+                if isinstance(n, ast.Name):
+                    names.add(n.id)
+        elif isinstance(node, ast.comprehension):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    names.add(n.id)
+    return names
+
+
+def _hot_mutable_names(scope: ast.FunctionDef,
+                       skip: ast.FunctionDef) -> Set[str]:
+    """Names the ``scope`` function binds to a mutable display AND
+    mutates elsewhere (mutations inside ``skip`` — the jitted def being
+    checked — don't count; those are RH005's other arm)."""
+    bound: Set[str] = set()
+    skip_nodes = set(id(n) for n in ast.walk(skip))
+    for node in ast.walk(scope):
+        if id(node) in skip_nodes:
+            continue
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) \
+                        and _is_mutable_display(node.value):
+                    bound.add(t.id)
+    if not bound:
+        return set()
+    mutated: Set[str] = set()
+    for node in ast.walk(scope):
+        if id(node) in skip_nodes:
+            continue
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATING_ATTRS \
+                and isinstance(node.func.value, ast.Name):
+            mutated.add(node.func.value.id)
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name):
+                    mutated.add(t.value.id)
+    return bound & mutated
+
+
+class _CallSiteScan(ast.NodeVisitor):
+    """Module-wide pass for the CALL-SITE rules (RH001/RH004): walks
+    with the same lexical scope discipline as the collector, tracking
+    live range/enumerate loop targets per function."""
+
+    def __init__(self, rel: str, col: JitCollector, module: ast.Module):
+        self.rel = rel
+        self.col = col
+        self.findings: List[Finding] = []
+        self.scope_chain: List[ast.AST] = [module]
+        # one stack of live scalar-loop-target sets per function scope
+        self.loops: List[List[Set[str]]] = [[]]
+
+    # --- scope discipline -------------------------------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self.scope_chain.append(node)
+        self.loops.append([])
+        self.generic_visit(node)
+        self.loops.pop()
+        self.scope_chain.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        # class bodies are not in the lexical chain of their methods;
+        # methods re-enter via visit_FunctionDef above
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For):
+        targets = _range_loop_targets(node.iter, node.target)
+        self.visit(node.iter)
+        self.loops[-1].append(targets)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.loops[-1].pop()
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    visit_AsyncFor = visit_For
+
+    def _visit_comp(self, node):
+        live: Set[str] = set()
+        for gen in node.generators:
+            self.visit(gen.iter)
+            live |= _range_loop_targets(gen.iter, gen.target)
+            for cond in gen.ifs:
+                self.visit(cond)
+        self.loops[-1].append(live)
+        for child in (getattr(node, "elt", None),
+                      getattr(node, "key", None),
+                      getattr(node, "value", None)):
+            if child is not None:
+                self.visit(child)
+        self.loops[-1].pop()
+
+    visit_ListComp = visit_SetComp = visit_GeneratorExp = _visit_comp
+    visit_DictComp = _visit_comp
+
+    # --- the rules ---------------------------------------------------------
+    def _live_loop_targets(self) -> Set[str]:
+        out: Set[str] = set()
+        for s in self.loops[-1]:
+            out |= s
+        return out
+
+    def _jit_dispatch(self, node: ast.Call):
+        """(descr, wrap_call) when this call crosses a jit dispatch
+        boundary; None otherwise (including the wrap calls themselves —
+        ``profiled_jit("name", fn)`` CONSTRUCTS a jitted callable)."""
+        func = node.func
+        if isinstance(func, ast.Name):
+            if is_jit_wrapper_name(func.id):
+                return None                       # a wrap, not a dispatch
+            hit = self.col.resolve_jit_callee(
+                func.id, [s for s in self.scope_chain])
+            if hit is not None:
+                return (func.id, hit[1])
+            return None
+        if isinstance(func, ast.Attribute):
+            if is_jit_wrapper_name(func.attr):
+                return None                       # jax.jit(...) wrap
+            if func.attr.endswith("_jit"):
+                return (unparse(func), None)
+            return None
+        if isinstance(func, ast.Call) \
+                and is_jit_wrapper_name(last_component(func.func)):
+            return (unparse(func), func)          # jax.jit(fn)(...)
+        return None
+
+    def visit_Call(self, node: ast.Call):
+        hit = self._jit_dispatch(node)
+        if hit is not None:
+            callee, wrap = hit
+            _, static_nums = static_decls(wrap)
+            live = self._live_loop_targets()
+            for pos, arg in enumerate(node.args):
+                if isinstance(arg, ast.Starred):
+                    continue
+                if pos in static_nums:
+                    continue
+                if live:
+                    names = _scalar_expr_names(arg)
+                    if names and names & live:
+                        var = ", ".join(sorted(names & live))
+                        self.findings.append(Finding(
+                            self.rel, node.lineno, "RH001", CHECK,
+                            f"loop-varying Python scalar {var!r} passed "
+                            f"positionally to jit-wrapped {callee!r} "
+                            "inside a loop — the compile-cache signature "
+                            "changes every iteration (recompile per "
+                            "value); device_put it once outside the "
+                            "loop or declare it static and bucket it"))
+                        continue
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, (bool, str)) \
+                        and not isinstance(arg, ast.Starred):
+                    kindname = type(arg.value).__name__
+                    self.findings.append(Finding(
+                        self.rel, node.lineno, "RH004", CHECK,
+                        f"{kindname} literal {arg.value!r} passed "
+                        f"positionally to jit-wrapped {callee!r} at a "
+                        "position not covered by static_argnums — a "
+                        "traced bool cannot branch and a str is not a "
+                        "valid jax leaf; declare the argument static"))
+        self.generic_visit(node)
+
+
+def _check_jitted_defs(rel: str, col: JitCollector,
+                       parents: Dict[ast.FunctionDef,
+                                     List[ast.FunctionDef]],
+                       findings: List[Finding]):
+    for ent in col.jitted:
+        fn = ent.node
+        static_names, _ = static_decls(ent.wrap_call)
+        a = fn.args
+        params = a.posonlyargs + a.args + a.kwonlyargs
+        defaults = ([None] * (len(a.posonlyargs + a.args)
+                              - len(a.defaults)) + list(a.defaults)
+                    + list(a.kw_defaults))
+        for param, default in zip(params, defaults):
+            if default is None:
+                continue
+            if ent.mode != MODE_MARKER \
+                    and isinstance(default, ast.Constant) \
+                    and isinstance(default.value, (bool, str)):
+                if param.arg not in static_names:
+                    kindname = type(default.value).__name__
+                    findings.append(Finding(
+                        rel, default.lineno, "RH002", CHECK,
+                        f"parameter {param.arg!r} of jitted function "
+                        f"{fn.name!r} defaults to a {kindname} but is "
+                        "not in static_argnames — it will be traced "
+                        "(bool cannot branch, str is not a valid leaf) "
+                        "instead of specializing the program; declare "
+                        "it static"))
+            if ent.mode != MODE_MARKER and _is_mutable_literal(default):
+                findings.append(Finding(
+                    rel, default.lineno, "RH003", CHECK,
+                    f"mutable default argument on parameter "
+                    f"{param.arg!r} of jitted function {fn.name!r} — "
+                    "evaluated once and shared across traces; the "
+                    "traced program bakes in a stale snapshot"))
+        # --- RH005: mutable closure state -----------------------------
+        local = _local_names(fn)
+        nested = [n for n in ast.walk(fn)
+                  if isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef))
+                  and n is not fn]
+        nested_ids = set()
+        for sub in nested:
+            nested_ids |= {id(x) for x in ast.walk(sub)}
+        for node in ast.walk(fn):
+            if id(node) in nested_ids:
+                continue              # nested defs have their own entry
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                findings.append(Finding(
+                    rel, node.lineno, "RH005", CHECK,
+                    f"jitted function {fn.name!r} declares "
+                    f"{'global' if isinstance(node, ast.Global) else 'nonlocal'} "
+                    f"{', '.join(node.names)} — the mutation runs ONCE "
+                    "at trace time, not per compiled call"))
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATING_ATTRS \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id not in local:
+                findings.append(Finding(
+                    rel, node.lineno, "RH005", CHECK,
+                    f"jitted function {fn.name!r} mutates non-local "
+                    f"{node.func.value.id!r} via .{node.func.attr}() — "
+                    "a trace-time side effect that never re-runs on "
+                    "compiled calls"))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Subscript) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id not in local:
+                        findings.append(Finding(
+                            rel, node.lineno, "RH005", CHECK,
+                            f"jitted function {fn.name!r} stores into "
+                            f"non-local {t.value.id!r} — a trace-time "
+                            "side effect that never re-runs on "
+                            "compiled calls"))
+        # reads of hot mutable enclosing names
+        hot: Set[str] = set()
+        for scope in parents.get(fn, []):
+            hot |= _hot_mutable_names(scope, fn)
+        hot -= local
+        if hot:
+            for node in ast.walk(fn):
+                if id(node) in nested_ids:
+                    continue
+                if isinstance(node, ast.Name) \
+                        and isinstance(node.ctx, ast.Load) \
+                        and node.id in hot:
+                    findings.append(Finding(
+                        rel, node.lineno, "RH005", CHECK,
+                        f"jitted function {fn.name!r} reads enclosing "
+                        f"mutable {node.id!r} (mutated in the enclosing "
+                        "scope) — the traced program bakes in a stale "
+                        "snapshot of its contents"))
+                    break             # one finding per captured name set
+
+
+def _parent_functions(tree: ast.Module
+                      ) -> Dict[ast.FunctionDef, List[ast.FunctionDef]]:
+    """def -> chain of enclosing FUNCTION defs, outermost first."""
+    out: Dict[ast.FunctionDef, List[ast.FunctionDef]] = {}
+
+    def walk(node, chain):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                out[child] = list(chain)
+                walk(child, chain + [child])
+            else:
+                walk(child, chain)
+
+    walk(tree, [])
+    return out
+
+
+@register("retrace-hazard")
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in ctx.iter_py(ROOTS):
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        col = JitCollector(rel, ctx)
+        col.visit(tree)
+        scan = _CallSiteScan(rel, col, tree)
+        scan.visit(tree)
+        findings.extend(scan.findings)
+        _check_jitted_defs(rel, col, _parent_functions(tree), findings)
+    return findings

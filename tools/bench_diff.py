@@ -121,12 +121,13 @@ _HIGHER_BETTER = ("reduction", "per_sec", "per_second", "goodput",
                   "throughput", "occupancy", "parity", "speedup",
                   "utilization", "hit", "_x")
 # name fragments marking metrics where SMALLER is better (latencies,
-# misses, memory, churn); everything else (tokens/sec, accuracy, ...)
-# is treated as bigger-is-better
+# misses, memory, churn, compile counts — a compile_count drifting up
+# round-over-round is a retrace regression); everything else
+# (tokens/sec, accuracy, ...) is treated as bigger-is-better
 _LOWER_BETTER = ("_ms", "latency", "ttft", "e2e", "gap", "miss", "bytes",
                  "fragmentation", "preemption", "reject", "retries",
                  "cancel", "abort", "failure", "queue_depth",
-                 "dispatches_per", "_rate")
+                 "dispatches_per", "_rate", "compile", "retrace")
 
 
 def lower_is_better(metric: str) -> bool:

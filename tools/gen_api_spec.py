@@ -46,6 +46,9 @@ PUBLIC_MODULES = [
     "paddle_tpu.vision.models",
     "paddle_tpu.vision.ops",
     "paddle_tpu.vision.transforms",
+    # the declared Pallas kernel contracts (ISSUE 8): pure-stdlib, the
+    # surface the pallas-contract lint and the autotuner program against
+    "paddle_tpu.ops.pallas_ops.contracts",
     # repo tooling with a stable, test-pinned surface (ISSUE 7): the
     # AST lint suite other tooling may drive in-process
     "tools.analyze",
