@@ -1078,6 +1078,125 @@ def bench_serving_resilience(num_requests=16, max_new_tokens=24):
     }
 
 
+def bench_training_resilience(steps=24, interval=4):
+    """ISSUE 9: the cost and the payoff of crash-consistent training on
+    a tiny calibrated model — checkpoint overhead as a % of step time
+    (async double-buffered writer vs blocking commits), kill-at-step-K
+    recovery wall time, and the recomputed-step count (≤ interval by
+    the exact-resume contract)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.framework.errors import FatalError
+    from paddle_tpu.framework.monitor import stat_get
+    from paddle_tpu.io.dataset import TensorDataset
+    from paddle_tpu.testing import chaos
+
+    batch, feat, hid = 32, 64, 128
+
+    def make_model():
+        net = nn.Sequential(nn.Linear(feat, hid), nn.ReLU(),
+                            nn.Linear(hid, 1))
+        m = paddle.Model(net)
+        m.prepare(optimizer.Adam(learning_rate=1e-3,
+                                 parameters=net.parameters()),
+                  nn.MSELoss())
+        return m
+
+    def make_ds():
+        rng = np.random.RandomState(0)
+        x = rng.randn(batch * steps, feat).astype(np.float32)
+        w = rng.randn(feat, 1).astype(np.float32)
+        return TensorDataset([x, (x @ w).astype(np.float32)])
+
+    def timed_fit(**kw):
+        paddle.seed(1234)
+        m = make_model()
+        ds = make_ds()
+        # warm the jitted train step outside the measured window
+        m.fit(ds, batch_size=batch, epochs=1, shuffle=False, verbose=0,
+              num_iters=2)
+        t0 = time.perf_counter()
+        m.fit(ds, batch_size=batch, epochs=1, shuffle=False, verbose=0,
+              **kw)
+        return (time.perf_counter() - t0) / steps * 1e3, m
+
+    base_ms, _ = timed_fit()
+    dirs = [tempfile.mkdtemp(prefix="bench_ckpt_") for _ in range(3)]
+    try:
+        blocking_ms, _ = timed_fit(checkpoint_dir=dirs[0],
+                                   checkpoint_interval=interval,
+                                   checkpoint_async=False)
+        async_ms, _ = timed_fit(checkpoint_dir=dirs[1],
+                                checkpoint_interval=interval,
+                                checkpoint_async=True)
+        from paddle_tpu.framework.monitor import stat_registry
+        ckpt_bytes = stat_registry.labeled_gauge(
+            "train.checkpoint_bytes").get()
+
+        # kill at step K (train.step chaos), then measure resume: newest
+        # valid checkpoint -> training re-joined and finished
+        kill_at = steps // 2 + 1
+        paddle.seed(1234)
+        m = make_model()
+        ds = make_ds()
+        snaps0 = stat_get("train.snapshots")
+        rec0 = stat_get("train.recomputed_steps")
+        plan = chaos.ChaosPlan([chaos.Fault("train.step", at=kill_at,
+                                            action=chaos.KILL)])
+        try:
+            with chaos.running(plan):
+                m.fit(ds, batch_size=batch, epochs=1, shuffle=False,
+                      verbose=0, checkpoint_dir=dirs[2],
+                      checkpoint_interval=interval)
+            killed = False
+        except FatalError:
+            killed = True
+        m2 = make_model()
+        from paddle_tpu.hapi.callbacks import Callback
+
+        class _FirstStep(Callback):
+            t_first = None
+
+            def on_train_batch_end(self, step, logs=None):
+                if self.t_first is None:
+                    self.t_first = time.perf_counter()
+
+        first = _FirstStep()
+        t0 = time.perf_counter()
+        m2.fit(ds, batch_size=batch, epochs=1, shuffle=False, verbose=0,
+               checkpoint_dir=dirs[2], checkpoint_interval=interval,
+               resume=True, callbacks=[first])
+        # recovery = kill -> training making progress again: newest-valid
+        # load + state restore + loader replay skip + the first resumed
+        # step (includes the fresh process's train-step compile)
+        recovery_ms = ((first.t_first or time.perf_counter()) - t0) * 1e3
+        return {
+            "steps": steps,
+            "interval": interval,
+            "step_ms_baseline": round(base_ms, 3),
+            "step_ms_blocking": round(blocking_ms, 3),
+            "step_ms_async": round(async_ms, 3),
+            "checkpoint_overhead_pct_blocking": round(
+                max(0.0, blocking_ms / base_ms - 1.0) * 100, 2),
+            "checkpoint_overhead_pct_async": round(
+                max(0.0, async_ms / base_ms - 1.0) * 100, 2),
+            "checkpoint_bytes": ckpt_bytes,
+            "killed": bool(killed),
+            "kill_at_step": kill_at,
+            "recovery_ms": round(recovery_ms, 1),
+            "recomputed_steps": stat_get("train.recomputed_steps") - rec0,
+            "snapshots": stat_get("train.snapshots") - snaps0,
+        }
+    finally:
+        for d in dirs:
+            shutil.rmtree(d, ignore_errors=True)
+
+
 def _compile_section():
     """Per-program compile accounting for the serving run
     (``detail.compile``): compile count + compile ms + calls per
@@ -1316,6 +1435,19 @@ def main():
         # prefill-heavy companion workload: the chunked-prefill +
         # dispatch-ahead speedup of ISSUE 3, in the same trajectory
         _attach_serving_prefill(result)
+        try:
+            # crash-consistent training (ISSUE 9): checkpoint overhead
+            # async vs blocking, kill-at-K recovery, recomputed steps
+            result.setdefault("detail", {})["training_resilience"] = \
+                _with_retries(
+                    "training_resilience",
+                    lambda: bench_training_resilience(
+                        int(os.environ.get("BENCH_CKPT_STEPS", "24")),
+                        int(os.environ.get("BENCH_CKPT_INTERVAL", "4"))))
+        except Exception as e:  # noqa: BLE001 — rider workload, never fatal
+            sys.stderr.write(
+                f"training resilience bench failed after retries "
+                f"({type(e).__name__}: {e})\n")
     if trace_dir:
         _dump_observability(trace_dir)
     print(json.dumps(result))

@@ -76,6 +76,20 @@ class InternalError(EnforceNotMet):
     terminal status onto it)."""
 
 
+class CheckpointCorruptError(EnforceNotMet):
+    """A checkpoint failed integrity validation — torn write, truncated
+    file, or a checksum mismatch against its manifest
+    (io.checkpoint.CheckpointStore; ``load_latest`` treats this as
+    "skip and fall back to the newest valid checkpoint")."""
+
+
+class CheckpointIncompatibleError(PreconditionNotMetError):
+    """A checkpoint is well-formed but cannot be restored here — its
+    manifest schema version is newer than this build understands, or
+    its captured state does not match the restoring target (a
+    precondition of the restore, hence 412)."""
+
+
 # --- HTTP status derivation --------------------------------------------------
 # One place decides how the taxonomy surfaces over HTTP, so the serving
 # frontend/HTTP layer derives its status codes from the error CLASS of a
@@ -94,6 +108,8 @@ ERROR_HTTP_STATUS = {
     UnavailableError: 503,         # brownout / no healthy replica
     DeadlineExceededError: 504,
     ExecutionTimeoutError: 504,
+    CheckpointCorruptError: 500,       # durable state lost server-side
+    CheckpointIncompatibleError: 412,  # restore precondition not met
     InternalError: 500,
     FatalError: 500,
     # explicit base fallback: EVERY taxonomy class resolves to a status

@@ -42,6 +42,21 @@ class Generator:
             self._key, sub = jax.random.split(self._key)
             return sub
 
+    def state_dict(self):
+        """Serializable snapshot of the generator (exact-resume leaf:
+        io.checkpoint / hapi train checkpoints persist this so a resumed
+        run splits the SAME subkey sequence the killed run would have)."""
+        with self._lock:
+            return {"seed": int(self._seed),
+                    "key_data": np.asarray(jax.random.key_data(self._key))}
+
+    def set_state_dict(self, state):
+        with self._lock:
+            self._seed = int(state["seed"])
+            self._key = jax.random.wrap_key_data(
+                jax.numpy.asarray(np.asarray(state["key_data"])))
+        return self
+
     @property
     def initial_seed(self):
         return self._seed

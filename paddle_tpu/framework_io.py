@@ -4,9 +4,21 @@ Reference analog: python/paddle/framework/io.py:202 (save) / :292 (load) —
 pickled nested state dicts with tensors converted to numpy.  Large-scale /
 sharded checkpointing lives in paddle_tpu.incubate.checkpoint (orbax-backed);
 this is the simple single-host path.
+
+Crash consistency (ISSUE 9): ``save`` commits through ``atomic_write_bytes``
+— write to a temp file in the same directory, flush + fsync, then
+``os.replace`` onto the destination.  A process killed at ANY point mid-save
+leaves either the previous complete file or the previous file plus a stray
+``*.tmp.*`` dropping; it can never tear the destination.  The deterministic
+``ckpt.write`` chaos sites (``temp`` mid-temp-write, ``rename`` between the
+fsync and the rename — see paddle_tpu.testing.chaos) let tests kill the
+writer at each injection point and assert exactly that.  The structured,
+manifest-carrying store built on the same writer is
+``paddle_tpu.io.checkpoint.CheckpointStore``.
 """
 from __future__ import annotations
 
+import itertools
 import os
 import pickle
 from typing import Any
@@ -16,6 +28,7 @@ import numpy as np
 from .tensor import Parameter, Tensor
 
 _PROTOCOL = 4
+_TMP_SEQ = itertools.count()
 
 
 def _to_serializable(obj):
@@ -46,12 +59,66 @@ def _from_serializable(obj, return_numpy=False):
     return obj
 
 
-def save(obj: Any, path: str, protocol: int = _PROTOCOL, **configs):
+def serialize_bytes(obj: Any, protocol: int = _PROTOCOL) -> bytes:
+    """Pickle ``obj`` with tensors converted to numpy (the on-disk payload
+    format shared by ``save`` and ``io.checkpoint.CheckpointStore``)."""
+    return pickle.dumps(_to_serializable(obj), protocol=protocol)
+
+
+def deserialize_bytes(data: bytes, return_numpy: bool = False):
+    return _from_serializable(pickle.loads(data), return_numpy=return_numpy)
+
+
+def atomic_write_bytes(path: str, data: bytes, fsync: bool = True,
+                       chaos: bool = True) -> None:
+    """Crash-consistent file commit: temp in the same directory + fsync +
+    ``os.replace``.  Readers of ``path`` see the old complete content or
+    the new complete content, never a torn mix.
+
+    ``chaos=True`` evaluates the deterministic ``ckpt.write`` injection
+    points (key ``temp`` after a partial temp write, key ``rename``
+    after the fsync but before the rename) — a chaos ``raise`` there
+    models a kill at that instant: no further bytes are written, the
+    stray temp file stays behind exactly as a real crash would leave it.
+    High-frequency bookkeeping writers (the train progress marker) pass
+    ``chaos=False`` so fault schedules against checkpoint commits keep
+    deterministic clocks.
+    """
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+    if chaos:
+        from .testing.chaos import chaos_site
+    else:
+        def chaos_site(site, key=None):
+            return None
+    tmp = f"{path}.tmp.{os.getpid()}.{next(_TMP_SEQ)}"
+    with open(tmp, "wb") as f:
+        mid = len(data) // 2
+        f.write(data[:mid])
+        # injection point 1: the temp file holds only a PARTIAL payload
+        chaos_site("ckpt.write", key="temp")
+        f.write(data[mid:])
+        f.flush()
+        if fsync:
+            os.fsync(f.fileno())
+    # injection point 2: temp complete + durable, destination untouched
+    chaos_site("ckpt.write", key="rename")
+    os.replace(tmp, path)
+    if fsync and d:
+        # durably record the directory entry (the rename itself)
+        try:
+            dfd = os.open(d, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
+
+def save(obj: Any, path: str, protocol: int = _PROTOCOL, **configs):
+    atomic_write_bytes(path, serialize_bytes(obj, protocol))
 
 
 def load(path: str, return_numpy: bool = False, **configs):

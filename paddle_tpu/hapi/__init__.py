@@ -9,5 +9,10 @@ from .callbacks import (  # noqa: F401
     ReduceLROnPlateau,
     VisualDL,
 )
+from .checkpoint import (  # noqa: F401
+    TrainCheckpointer,
+    capture_train_state,
+    restore_train_state,
+)
 from .model import Model  # noqa: F401
 from .summary import flops, summary  # noqa: F401

@@ -1,0 +1,338 @@
+"""Unified train state + crash-consistent, async training checkpoints.
+
+ISSUE 9 tentpole piece 2: ONE capture covers everything an exact resume
+needs —
+
+- the functional train-state pytree (params, buffers, optimizer slot
+  pytrees, the traced step counter) the jitted hapi train step advances,
+  or the layer/optimizer ``state_dict`` pair on the eager path;
+- host-side optimizer state the pytree does not carry (LR scheduler
+  state, eager ``_step_count``);
+- ``framework.random.default_generator`` state (the per-step jax PRNG
+  key stream) and the global numpy RNG state (shuffles, augmentations);
+- the dataloader position: (epoch, next batch) plus the numpy RNG state
+  AT EPOCH START, so a resumed run re-draws the SAME epoch permutation,
+  skips the already-trained batches, and continues bit-for-bit;
+- the global step counter.
+
+:class:`TrainCheckpointer` drives a :class:`~paddle_tpu.io.checkpoint.
+CheckpointStore` with a **double-buffered background writer**: the train
+loop blocks only for the device→host copy of the state pytree (surfaced
+as ``train.checkpoint_ms``); serialization + checksumming + fsync happen
+on the writer thread while the next steps keep dispatching (the same
+pipeline-overlap discipline as the serving decode loop).  At most one
+snapshot is queued behind the one being written — a third capture waits,
+bounding host memory at two state copies.
+
+Metric names (docs/OBSERVABILITY.md "Training resilience", enforced both
+directions by the ``metrics-drift`` checker): ``train.checkpoint_ms``,
+``train.checkpoint_write_ms``, ``train.checkpoint_bytes``,
+``train.snapshots``, ``train.resumes``, ``train.recomputed_steps``,
+``train.step_retries`` (the last one is observed by the fit retry
+driver).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.concurrency import OrderedCondition
+from ..framework.errors import CheckpointIncompatibleError
+from ..framework.monitor import gauge_set, histogram_observe, stat_add
+from ..framework.random import default_generator
+from ..io.checkpoint import CheckpointStore
+
+__all__ = ["TRAIN_STATE_SCHEMA", "capture_train_state",
+           "restore_train_state", "TrainCheckpointer"]
+
+TRAIN_STATE_SCHEMA = 1
+
+
+def _tree_to_host(tree):
+    """Blocking device→host copy of a nested dict pytree (dtypes
+    preserved — the resume round-trip must be bitwise)."""
+    if isinstance(tree, dict):
+        return {k: _tree_to_host(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_tree_to_host(v) for v in tree)
+    if isinstance(tree, (int, float, bool, str, bytes, type(None))):
+        return tree                     # python scalars stay python
+    return np.asarray(tree)
+
+
+def _tree_to_device(tree):
+    if isinstance(tree, dict):
+        return {k: _tree_to_device(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_tree_to_device(v) for v in tree)
+    if isinstance(tree, (int, float, bool, str, bytes, type(None))):
+        return tree
+    return jnp.asarray(tree)
+
+
+def capture_train_state(model, *, global_step: int, epoch: int = 0,
+                        next_batch: int = 0,
+                        np_state_epoch_start=None) -> Dict[str, Any]:
+    """Snapshot everything a bit-exact resume of ``model`` needs, as a
+    host tree of numpy leaves (CheckpointStore-serializable).
+
+    Call at a step boundary: AFTER ``train_batch`` for batch
+    ``next_batch - 1`` returned, BEFORE the next batch's PRNG key is
+    split.  The capture is consistent by construction — the jitted step
+    already synchronized (its loss was read), and every other leaf is
+    host state read in one pass on the calling thread.
+    """
+    from ..optimizer.lr import LRScheduler
+
+    opt = model._optimizer
+    state: Dict[str, Any] = {
+        "schema": TRAIN_STATE_SCHEMA,
+        "global_step": int(global_step),
+        "rng": default_generator.state_dict(),
+        "np_random": np.random.get_state(),
+        "loader": {
+            "epoch": int(epoch),
+            "next_batch": int(next_batch),
+            "np_state_epoch_start": (np_state_epoch_start
+                                     if np_state_epoch_start is not None
+                                     else np.random.get_state()),
+        },
+        "optimizer_host": {
+            "step_count": int(getattr(opt, "_step_count", 0)),
+            "lr_scheduler": (opt._lr.state_dict()
+                             if opt is not None
+                             and isinstance(opt._lr, LRScheduler)
+                             else None),
+        },
+    }
+    if model._state is not None:        # accelerate=True functional path
+        state["mode"] = "functional"
+        state["model"] = _tree_to_host(model._state)
+    else:                               # eager path: layer + opt dicts
+        state["mode"] = "eager"
+        state["model"] = {
+            "net": _tree_to_host({
+                k: v._value for k, v in model.network.state_dict().items()
+            }),
+            "opt": _tree_to_host(
+                {k: (v._value if hasattr(v, "_value") else v)
+                 for k, v in opt.state_dict().items()}
+                if opt is not None else {}),
+        }
+    return state
+
+
+def restore_train_state(model, state: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of :func:`capture_train_state`: push the captured leaves
+    back into ``model`` (+ optimizer, + RNGs) and return the loader
+    resume position ``{"epoch", "next_batch", "np_state_epoch_start",
+    "np_random", "global_step"}`` for the fit loop to act on."""
+    from ..optimizer.lr import LRScheduler
+
+    schema = int(state.get("schema", -1))
+    if schema > TRAIN_STATE_SCHEMA:
+        raise CheckpointIncompatibleError(
+            f"train-state schema {schema} is newer than this build's "
+            f"{TRAIN_STATE_SCHEMA}")
+    opt = model._optimizer
+    if state["mode"] == "functional":
+        model._state = _tree_to_device(state["model"])
+        model._writeback_state()        # layer tensors observe the restore
+    else:
+        from ..tensor import Tensor
+
+        model.network.set_state_dict(
+            {k: Tensor(v) for k, v in state["model"]["net"].items()})
+        model._state = None
+        if opt is not None and state["model"]["opt"]:
+            opt.set_state_dict({k: Tensor(v) if isinstance(v, np.ndarray)
+                                else v
+                                for k, v in state["model"]["opt"].items()})
+    host = state.get("optimizer_host", {})
+    if opt is not None:
+        opt._step_count = int(host.get("step_count", opt._step_count))
+        if (host.get("lr_scheduler") is not None
+                and isinstance(opt._lr, LRScheduler)):
+            opt._lr.set_state_dict(host["lr_scheduler"])
+    default_generator.set_state_dict(state["rng"])
+    loader = dict(state["loader"])
+    loader["np_random"] = state["np_random"]
+    loader["global_step"] = int(state["global_step"])
+    return loader
+
+
+class TrainCheckpointer:
+    """Periodic, atomic, optionally-async training checkpoints over a
+    :class:`CheckpointStore`.
+
+    Threading: ONE background writer thread; the train loop and the
+    writer hand off through a single ``train.snapshot``
+    OrderedCondition (lock-order-witness clean: the writer serializes
+    and commits OUTSIDE the lock, holding it only to take/clear the
+    one-deep queue slot).  Write failures are remembered and re-raised
+    on the NEXT submit/flush — a background disk error must not be
+    silent, but also must not crash the step that happened to overlap
+    it.
+    """
+
+    def __init__(self, store, interval: int = 1, async_write: bool = True,
+                 keep_last: int = 3, progress_marker: bool = True):
+        self.store = (store if isinstance(store, CheckpointStore)
+                      else CheckpointStore(store, keep_last=keep_last))
+        self.interval = max(1, int(interval))
+        self.async_write = bool(async_write)
+        self.progress_marker = bool(progress_marker)
+        self._cond = OrderedCondition("train.snapshot")
+        self._pending = None            # (state, step) | None — depth-1 queue
+        self._writing = False
+        self._closed = False
+        self._error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        if self.async_write:
+            self._thread = threading.Thread(
+                target=self._run, name="train-snapshot-writer", daemon=True)
+            self._thread.start()
+
+    # --- progress marker ----------------------------------------------------
+    @property
+    def _progress_path(self) -> str:
+        return os.path.join(self.store.directory, "PROGRESS")
+
+    def note_step(self, global_step: int):
+        """Record that ``global_step`` completed (tiny atomic write,
+        chaos-exempt).  On resume, ``progress − checkpoint_step`` is the
+        work the crash destroyed — surfaced as
+        ``train.recomputed_steps``."""
+        if not self.progress_marker:
+            return
+        from ..framework_io import atomic_write_bytes
+
+        atomic_write_bytes(self._progress_path,
+                           str(int(global_step)).encode(),
+                           fsync=False, chaos=False)
+
+    def progress_step(self) -> Optional[int]:
+        try:
+            with open(self._progress_path, "rb") as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    # --- snapshot path ------------------------------------------------------
+    def due(self, global_step: int) -> bool:
+        return global_step % self.interval == 0
+
+    def snapshot(self, model, *, global_step: int, epoch: int,
+                 next_batch: int, np_state_epoch_start) -> None:
+        """Capture + hand off one checkpoint.  Blocks for the host copy
+        (and, if BOTH writer buffers are busy, for the older write) —
+        that blocking cost is the ``train.checkpoint_ms`` histogram."""
+        t0 = time.perf_counter()
+        state = capture_train_state(
+            model, global_step=global_step, epoch=epoch,
+            next_batch=next_batch,
+            np_state_epoch_start=np_state_epoch_start)
+        if self.async_write:
+            with self._cond:
+                if self._error is not None:
+                    err, self._error = self._error, None
+                    raise err
+                # double buffer: one write in flight + one queued
+                self._cond.wait_for(lambda: self._pending is None)
+                self._pending = (state, int(global_step))
+                self._cond.notify_all()
+        else:
+            self._write(state, int(global_step))
+        histogram_observe("train.checkpoint_ms",
+                          (time.perf_counter() - t0) * 1e3)
+
+    def maybe_snapshot(self, model, *, global_step: int, epoch: int,
+                       next_batch: int, np_state_epoch_start) -> bool:
+        if not self.due(global_step):
+            return False
+        self.snapshot(model, global_step=global_step, epoch=epoch,
+                      next_batch=next_batch,
+                      np_state_epoch_start=np_state_epoch_start)
+        return True
+
+    def _write(self, state, step: int):
+        t0 = time.perf_counter()
+        path = self.store.save(state, step,
+                               metadata={"kind": "train_state"})
+        stat_add("train.snapshots", 1)
+        gauge_set("train.checkpoint_bytes", os.path.getsize(path))
+        histogram_observe("train.checkpoint_write_ms",
+                          (time.perf_counter() - t0) * 1e3)
+
+    def _run(self):
+        while True:
+            with self._cond:
+                self._cond.wait_for(
+                    lambda: self._pending is not None or self._closed)
+                if self._pending is None:
+                    return              # closed and drained
+                state, step = self._pending
+                self._pending = None
+                self._writing = True
+                self._cond.notify_all()
+            try:
+                self._write(state, step)
+            except BaseException as e:  # noqa: BLE001 — surfaced later
+                with self._cond:
+                    self._error = e
+            finally:
+                with self._cond:
+                    self._writing = False
+                    self._cond.notify_all()
+
+    # --- resume -------------------------------------------------------------
+    def load_latest_state(self):
+        """(state, manifest) of the newest VALID checkpoint, or None
+        (corrupt entries are skipped by the store — crash recovery)."""
+        return self.store.load_latest()
+
+    def resume(self, model) -> Optional[Dict[str, Any]]:
+        """Restore the newest valid checkpoint into ``model``.  Returns
+        the loader position (see :func:`restore_train_state`) or None
+        when the store holds nothing usable.  Accounts
+        ``train.resumes`` and ``train.recomputed_steps`` (progress
+        marker minus checkpoint step — the steps the crash lost)."""
+        loaded = self.load_latest_state()
+        if loaded is None:
+            return None
+        state, _manifest = loaded
+        pos = restore_train_state(model, state)
+        stat_add("train.resumes", 1)
+        prog = self.progress_step()
+        if prog is not None:
+            stat_add("train.recomputed_steps",
+                     max(0, prog - pos["global_step"]))
+        return pos
+
+    # --- lifecycle ----------------------------------------------------------
+    def flush(self, timeout: Optional[float] = 60.0):
+        """Block until no snapshot is queued or being written; re-raise
+        a background write failure if one happened."""
+        if self.async_write:
+            with self._cond:
+                self._cond.wait_for(
+                    lambda: self._pending is None and not self._writing,
+                    timeout)
+                if self._error is not None:
+                    err, self._error = self._error, None
+                    raise err
+
+    def close(self, timeout: Optional[float] = 60.0):
+        if self._thread is None:
+            return
+        self.flush(timeout)
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        self._thread = None

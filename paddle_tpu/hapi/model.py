@@ -330,9 +330,37 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None,
+            checkpoint_dir=None, checkpoint_interval=None,
+            checkpoint_async=True, keep_checkpoints=3, resume=False,
+            step_retries=0, step_retry_backoff_s=0.05):
+        """Train loop.  Crash-consistency knobs (ISSUE 9 — contracts in
+        docs/CHECKPOINT.md):
+
+        - ``checkpoint_dir`` + ``checkpoint_interval``: commit an atomic
+          train-state checkpoint (params, optimizer slots, LR scheduler,
+          PRNG streams, loader position, step counter) every K steps
+          through an :class:`~paddle_tpu.io.checkpoint.CheckpointStore`;
+          ``checkpoint_async`` overlaps serialization with the next
+          steps (``keep_checkpoints`` = keep-last-K retention).
+        - ``resume``: ``True`` resumes from ``checkpoint_dir``'s newest
+          VALID checkpoint (torn/corrupt ones are skipped); a path or
+          CheckpointStore resumes from there instead.  A resumed run is
+          bit-identical to the uninterrupted one — at most the steps
+          since the last commit are recomputed.  An empty/absent store
+          starts from scratch.
+        - ``step_retries`` + ``step_retry_backoff_s``: transient
+          batch-fetch / train-step failures are retried with bounded
+          exponential backoff (PRNG state restored per attempt, so a
+          retried step consumes the same keys).  ``FatalError`` (e.g. a
+          ``train.step`` chaos ``kill``) is never retried — it models
+          process death.
+        """
+        from ..framework.errors import FatalError, InvalidArgumentError
+        from ..framework.monitor import stat_add
         from ..io import DataLoader
         from ..io.dataset import Dataset
+        from ..testing.chaos import KILL, chaos_site
 
         if isinstance(train_data, Dataset):
             train_loader = DataLoader(train_data, batch_size=batch_size,
@@ -346,6 +374,29 @@ class Model:
         else:
             eval_loader = eval_data
 
+        ckpt = None
+        if checkpoint_dir is not None:
+            from .checkpoint import TrainCheckpointer
+
+            ckpt = TrainCheckpointer(
+                checkpoint_dir,
+                interval=(1 if checkpoint_interval is None
+                          else checkpoint_interval),
+                async_write=checkpoint_async, keep_last=keep_checkpoints)
+        resume_pos = None
+        if resume:
+            from .checkpoint import TrainCheckpointer
+
+            if resume is True:
+                if ckpt is None:
+                    raise InvalidArgumentError(
+                        "resume=True needs checkpoint_dir= (or pass the "
+                        "store/path to resume from as resume=)")
+                resume_pos = ckpt.resume(self)
+            else:
+                resume_pos = TrainCheckpointer(
+                    resume, async_write=False).resume(self)
+
         steps = None
         try:
             steps = len(train_loader)
@@ -356,45 +407,164 @@ class Model:
                             epochs=epochs, steps=steps, log_freq=log_freq)
         cbks.on_begin("train")
         self.stop_training = False
-        for epoch in range(epochs):
-            # one span per epoch; per-batch spans + a latency histogram
-            # nest inside it (trace shows fit > epoch > train_batch)
-            with RecordEvent("hapi/fit.epoch", epoch=epoch):
-                cbks.on_epoch_begin(epoch)
-                for m in self._metrics:
-                    m.reset()
-                logs = {}
-                for step, batch in enumerate(train_loader):
-                    if num_iters is not None and step >= num_iters:
-                        break
-                    cbks.on_batch_begin("train", step, logs)
-                    x, y = batch[0], batch[1] if len(batch) > 1 else None
-                    t0 = _time.perf_counter()
-                    with RecordEvent("hapi/train_batch"):
-                        outs = self.train_batch([x], [y])
-                    histogram_observe("hapi.train_batch_ms",
-                                      (_time.perf_counter() - t0) * 1e3)
-                    logs = {"loss": outs[0],
-                            "batch_size": _batch_size_of(x)}
-                    for name, val in zip(self._metric_names(), outs[1:]):
-                        logs[name] = val
-                    cbks.on_batch_end("train", step, logs)
-                    if self.stop_training:
-                        break
-                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                    eval_logs = self.evaluate(eval_loader, verbose=0,
-                                              _inside_fit=True)
-                    logs.update({f"eval_{k}": v
-                                 for k, v in eval_logs.items()})
-                cbks.on_epoch_end(epoch, logs)
-            if save_dir and (epoch + 1) % save_freq == 0:
-                self.save(f"{save_dir}/{epoch}")
-            if self.stop_training:
-                break
+        global_step = 0 if resume_pos is None else resume_pos["global_step"]
+        start_epoch = 0 if resume_pos is None else resume_pos["epoch"]
+        trained_any = False
+        logs = {}
+        try:
+            for epoch in range(epochs):
+                if epoch < start_epoch:
+                    continue            # fully covered by the checkpoint
+                skip_batches = 0
+                np_resume_mid = None
+                if resume_pos is not None and epoch == start_epoch:
+                    # replay the SAME epoch permutation the killed run
+                    # drew, skip the batches it already trained, then
+                    # rejoin its exact numpy-RNG stream
+                    np.random.set_state(
+                        resume_pos["np_state_epoch_start"])
+                    skip_batches = resume_pos["next_batch"]
+                    np_resume_mid = resume_pos["np_random"]
+                # one span per epoch; per-batch spans + a latency
+                # histogram nest inside it (fit > epoch > train_batch)
+                with RecordEvent("hapi/fit.epoch", epoch=epoch):
+                    cbks.on_epoch_begin(epoch)
+                    for m in self._metrics:
+                        m.reset()
+                    logs = {}
+                    # captured BEFORE the loader draws the permutation:
+                    # the snapshot leaf a mid-epoch resume replays from
+                    np_epoch_start = np.random.get_state()
+                    it = iter(train_loader)
+                    step = 0
+                    while True:
+                        if num_iters is not None and step >= num_iters:
+                            break
+                        # -- fetch (chaos-instrumented, bounded retry) --
+                        batch = self._fetch_with_retry(
+                            it, step_retries, step_retry_backoff_s,
+                            chaos_site, stat_add)
+                        if batch is None:
+                            break       # epoch exhausted
+                        if step < skip_batches:
+                            step += 1   # resume replay: already trained
+                            continue
+                        if np_resume_mid is not None:
+                            np.random.set_state(np_resume_mid)
+                            np_resume_mid = None
+                        cbks.on_batch_begin("train", step, logs)
+                        x = batch[0]
+                        y = batch[1] if len(batch) > 1 else None
+                        t0 = _time.perf_counter()
+                        with RecordEvent("hapi/train_batch"):
+                            outs = self._step_with_retry(
+                                x, y, step_retries, step_retry_backoff_s,
+                                chaos_site, stat_add, KILL, FatalError)
+                        histogram_observe(
+                            "hapi.train_batch_ms",
+                            (_time.perf_counter() - t0) * 1e3)
+                        global_step += 1
+                        trained_any = True
+                        logs = {"loss": outs[0],
+                                "batch_size": _batch_size_of(x)}
+                        for name, val in zip(self._metric_names(),
+                                             outs[1:]):
+                            logs[name] = val
+                        cbks.on_batch_end("train", step, logs)
+                        if ckpt is not None:
+                            ckpt.note_step(global_step)
+                            ckpt.maybe_snapshot(
+                                self, global_step=global_step,
+                                epoch=epoch, next_batch=step + 1,
+                                np_state_epoch_start=np_epoch_start)
+                        step += 1
+                        if self.stop_training:
+                            break
+                    if eval_loader is not None \
+                            and (epoch + 1) % eval_freq == 0:
+                        eval_logs = self.evaluate(eval_loader, verbose=0,
+                                                  _inside_fit=True)
+                        logs.update({f"eval_{k}": v
+                                     for k, v in eval_logs.items()})
+                    cbks.on_epoch_end(epoch, logs)
+                if save_dir and (epoch + 1) % save_freq == 0:
+                    self.save(f"{save_dir}/{epoch}")
+                if self.stop_training:
+                    break
+            if ckpt is not None and (trained_any or resume_pos is None):
+                # terminal checkpoint at position (epochs, 0): resuming
+                # with the same epoch budget is a no-op, a larger one
+                # continues exactly where training ended.  A no-op
+                # resume (every epoch already covered) must NOT rewrite
+                # it: this process's numpy state is unrelated to the
+                # true end-of-training state the existing terminal
+                # checkpoint carries
+                ckpt.snapshot(self, global_step=global_step,
+                              epoch=epochs, next_batch=0,
+                              np_state_epoch_start=np.random.get_state())
+        finally:
+            if ckpt is not None:
+                ckpt.close()
         cbks.on_end("train", logs)
         if save_dir:
             self.save(f"{save_dir}/final")
         return self
+
+    def _fetch_with_retry(self, it, retries, backoff_s, chaos_site,
+                          stat_add):
+        """Next batch through the ``loader.next`` chaos site with
+        bounded-backoff retry; None = epoch exhausted.  ONLY the
+        pre-fetch site faults are retried: the actual ``next()`` may
+        already have consumed a sampler index when it fails, so
+        retrying it would silently skip a batch — a real loader
+        failure propagates instead."""
+        attempt = 0
+        while True:
+            try:
+                chaos_site("loader.next")
+                break
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception:
+                attempt += 1
+                if attempt > retries:
+                    raise
+                stat_add("train.step_retries", 1)
+                _time.sleep(backoff_s * (2 ** (attempt - 1)))
+        try:
+            return next(it)
+        except StopIteration:
+            return None
+
+    def _step_with_retry(self, x, y, retries, backoff_s, chaos_site,
+                         stat_add, KILL, FatalError):
+        """One train step through the ``train.step`` chaos site.
+        Transient failures retry with exponential backoff after
+        restoring BOTH PRNG streams captured before the attempt — a
+        retried step consumes the same keys, so a run with transient
+        faults stays bit-identical to a clean one.  A chaos ``kill``
+        raises FatalError (never retried: it models process death; so
+        does a real crash after the jitted update already donated the
+        previous state)."""
+        attempt = 0
+        while True:
+            key_state = default_generator.get_state()
+            np_state = np.random.get_state()
+            try:
+                fault = chaos_site("train.step")
+                if fault is not None and fault.action == KILL:
+                    raise FatalError(fault.message)
+                return self.train_batch([x], [y])
+            except (KeyboardInterrupt, SystemExit, FatalError):
+                raise
+            except Exception:
+                attempt += 1
+                default_generator.set_state(key_state)
+                np.random.set_state(np_state)
+                if attempt > retries:
+                    raise
+                stat_add("train.step_retries", 1)
+                _time.sleep(backoff_s * (2 ** (attempt - 1)))
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_iters=None, _inside_fit=False):

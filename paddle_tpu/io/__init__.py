@@ -29,3 +29,4 @@ from .sampler import (  # noqa: F401
     WeightedRandomSampler,
 )
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .checkpoint import CheckpointStore  # noqa: F401

@@ -67,8 +67,8 @@ from ..testing.chaos import chaos_site
 from .engine import ServingEngine
 from .metrics import FrontendMetrics, ServingMetrics
 from .resilience import (BROWNOUT_CLAMP, BROWNOUT_REJECT, BROWNOUT_SHED,
-                         BrownoutController, BrownoutPolicy, Watchdog,
-                         WatchdogConfig)
+                         BrownoutController, BrownoutPolicy, EngineSnapshot,
+                         Watchdog, WatchdogConfig)
 from .router import DEAD, HEALTHY, SUSPECT, Replica, Router
 
 __all__ = ["ResponseHandle", "ServingFrontend", "create_serving_frontend",
@@ -396,12 +396,20 @@ class ServingFrontend:
                  watchdog=None,
                  brownout=None,
                  placement_attempts: int = 4,
-                 placement_backoff_s: float = 0.02):
+                 placement_backoff_s: float = 0.02,
+                 snapshot_store=None):
         """Resilience knobs (docs/SERVING.md "Resilience"):
 
         - ``snapshot_interval``: checkpoint each in-flight request every
           K consumed tokens so failover resumes from the checkpoint
           instead of token 0 (None disables — failover restarts).
+        - ``snapshot_store``: a CheckpointStore (or directory path) that
+          additionally PERSISTS each request checkpoint to disk, so a
+          frontend RESTART — not just warm in-process failover —
+          recovers mid-stream requests via ``recover_pending()``.
+          Slots are deleted on client-visible terminal outcomes and
+          kept on ``failed`` (the crash-shaped one a new process can
+          still rescue).
         - ``watchdog``: True / a WatchdogConfig enables the hung-step
           monitor thread (suspect → backoff → re-admit, dead → failover).
         - ``brownout``: True / a BrownoutPolicy enables staged overload
@@ -447,6 +455,14 @@ class ServingFrontend:
         self._poll_interval = float(poll_interval_s)
         self.snapshot_interval = (None if snapshot_interval is None
                                   else max(1, int(snapshot_interval)))
+        self._snapshot_store = None
+        if snapshot_store is not None:
+            from ..io.checkpoint import CheckpointStore
+
+            self._snapshot_store = (
+                snapshot_store if isinstance(snapshot_store, CheckpointStore)
+                else CheckpointStore(snapshot_store))
+        self._persist_errors = 0
         self._placement_attempts = max(1, int(placement_attempts))
         self._placement_backoff = float(placement_backoff_s)
         # watchdog: False/None = off; True = defaults; or a config.
@@ -698,6 +714,96 @@ class ServingFrontend:
         if immediate is not None:
             self._resolve(immediate, CANCELLED)
 
+    # --- restart recovery (ISSUE 9) ----------------------------------------
+    def recover_pending(self) -> List[ResponseHandle]:
+        """Re-admit every request the PREVIOUS process persisted to the
+        snapshot store and never finished: each ``req-*`` slot becomes a
+        live mid-stream request on this frontend — tokens up to the
+        checkpoint are pre-filled on the handle (never re-decoded),
+        decoding continues on a replica via the engine's snapshot
+        restore path, and the handle carries ``retried=True`` /
+        ``resumed_from`` plus a ``("resume", n)`` stream marker exactly
+        like a warm failover.  Deadlines were persisted as REMAINING
+        budget and re-anchor to this process's clock.
+
+        Corrupt slots are skipped (``snapshot_store.last_skipped``); a
+        slot with no routable replica finishes ``failed`` and KEEPS its
+        slot for the next attempt.  Returns the recovered handles.
+        """
+        store = self._snapshot_store
+        if store is None:
+            raise InvalidArgumentError(
+                "recover_pending() needs ServingFrontend("
+                "snapshot_store=...)")
+        handles: List[ResponseHandle] = []
+        for name in store.named():
+            if not name.startswith("req-"):
+                continue
+            loaded = store.load_named(name, return_numpy=True)
+            if loaded is None:
+                continue        # corrupt — recorded in store.last_skipped
+            state, _manifest = loaded
+            try:
+                snap = EngineSnapshot.from_state(state)
+            except EnforceNotMet:
+                continue        # incompatible schema — leave for tooling
+            rid = snap.request_id
+            handle = ResponseHandle(rid, snap.max_new_tokens,
+                                    snap.deadline, self)
+            n = snap.num_generated
+            with handle._cond:
+                # everything up to the checkpoint was already decoded
+                # (and possibly streamed) by the dead process — pre-fill
+                # so result() returns the FULL sequence and the engine's
+                # callbacks (which fire from index n) append seamlessly
+                handle._tokens = [int(t) for t in snap.generated]
+                handle.retried = True
+                handle.resumed_from = n
+                handle._resume_pending = True
+            with self._lock:
+                if self._closing or rid in self._live:
+                    continue
+                self.metrics.on_submit()
+                if (handle.deadline is not None
+                        and time.monotonic() >= handle.deadline):
+                    handle._finish(DEADLINE_MISS,
+                                   detail="deadline expired before "
+                                          "restart recovery")
+                    self.metrics.on_deadline_miss()
+                    handles.append(handle)
+                    continue
+                rep = self.router.pick(
+                    cost=int(snap.prompt.size) + int(snap.max_new_tokens))
+                if rep is None:
+                    # keep the slot: failed is the crash-shaped terminal
+                    handle._finish(FAILED,
+                                   detail="no healthy replica for "
+                                          "restart recovery",
+                                   error_cls=UnavailableError)
+                    self.metrics.on_failure()
+                    handles.append(handle)
+                    continue
+                entry = _Entry(handle, snap.prompt, snap.max_new_tokens,
+                               rep)
+                entry.snapshot = snap
+                entry.snap_tokens = n
+                self._live[rid] = entry
+                self.router.charge(rep, entry.cost)
+                rep.inbox.append(entry)
+                rep.wake.set()
+                self._update_depth_gauges_locked()
+            self.metrics.on_recovered()
+            handles.append(handle)
+        # the deadline-missed slots above are client-visible terminals —
+        # retire them (outside the lock; _resolve never saw them)
+        for h in handles:
+            if h.status == DEADLINE_MISS:
+                try:
+                    store.delete_named(f"req-{h.request_id}")
+                except Exception:  # noqa: BLE001 — stale slot only
+                    pass
+        return handles
+
     # --- fault injection / lifecycle ---------------------------------------
     def inject_failure(self, replica_id: str, at_step: int):
         """Arm the router's deterministic kill switch (see
@@ -735,6 +841,9 @@ class ServingFrontend:
                 "brownout_enabled": self.brownout is not None,
                 "brownout_stage": (None if self.brownout is None
                                    else self.brownout.stage),
+                "snapshot_store": (None if self._snapshot_store is None
+                                   else self._snapshot_store.directory),
+                "snapshot_persist_errors": self._persist_errors,
             },
         }
 
@@ -802,6 +911,16 @@ class ServingFrontend:
             self._update_depth_gauges_locked()
         finished = entry.handle._finish(status, tokens=tokens,
                                         detail=detail, error_cls=error_cls)
+        if finished and self._snapshot_store is not None \
+                and status != FAILED:
+            # the persisted slot is only useful for crash recovery:
+            # client-visible terminals retire it; FAILED (every replica
+            # dead / frontend closed) keeps it so a NEW process's
+            # recover_pending() can still rescue the stream from disk
+            try:
+                self._snapshot_store.delete_named(f"req-{rid}")
+            except Exception:  # noqa: BLE001 — stale slot, not a failure
+                pass
         if finished:
             h = entry.handle
             if status == COMPLETED:
@@ -932,11 +1051,27 @@ class ServingFrontend:
             snap = eng.snapshot(entry.handle.request_id)
             if snap is None:
                 continue          # finished/preempted meanwhile — keep old
+            updated = False
             with self._lock:
                 if (self._live.get(entry.handle.request_id) is entry
                         and entry.replica is rep):
                     entry.snapshot = snap
                     entry.snap_tokens = snap.num_generated
+                    updated = True
+            if updated and self._snapshot_store is not None:
+                # disk durability rides on the warm-failover checkpoint
+                # (pump thread, outside the frontend lock).  Best-effort:
+                # a persist failure never fails the live stream — the
+                # in-memory snapshot still drives warm failover; the
+                # error count is surfaced in stats()["resilience"]
+                rid = entry.handle.request_id
+                try:
+                    self._snapshot_store.save_named(
+                        f"req-{rid}", snap.to_state(),
+                        metadata={"request_id": rid})
+                except Exception:  # noqa: BLE001 — durability degraded,
+                    with self._lock:  # stream unaffected
+                        self._persist_errors += 1
 
     def _harvest(self, rep: Replica, eng: ServingEngine):
         for rid in eng.take_expired():
@@ -1087,7 +1222,8 @@ def create_serving_frontend(model, config=None, **overrides
     for key in ("replicas", "queue_cap", "default_deadline_ms",
                 "engine_factory", "metrics", "poll_interval_s",
                 "snapshot_interval", "watchdog", "brownout",
-                "placement_attempts", "placement_backoff_s"):
+                "placement_attempts", "placement_backoff_s",
+                "snapshot_store"):
         if key in overrides:
             fe_kwargs[key] = overrides.pop(key)
     engine_kwargs.update(overrides)

@@ -273,7 +273,11 @@ class FrontendMetrics:
                 "serving.frontend.brownout_rejected",
                 # warm failover: tokens NOT recomputed thanks to the
                 # checkpoint (vs a token-0 restart)
-                "serving.frontend.recompute_saved_tokens")
+                "serving.frontend.recompute_saved_tokens",
+                # restart recovery (ISSUE 9): requests re-admitted
+                # mid-stream from DISK-persisted snapshots by a new
+                # frontend process (recover_pending)
+                "serving.frontend.recovered")
     HISTOGRAMS = ("serving.frontend.ttft_ms", "serving.frontend.e2e_ms")
 
     def __init__(self):
@@ -328,6 +332,11 @@ class FrontendMetrics:
         if tokens > 0:
             stat_registry.get(
                 "serving.frontend.recompute_saved_tokens").add(int(tokens))
+
+    def on_recovered(self):
+        """A request was re-admitted mid-stream from a DISK-persisted
+        snapshot after a frontend restart (recover_pending)."""
+        stat_registry.get("serving.frontend.recovered").add(1)
 
     def on_failure(self):
         stat_registry.get("serving.frontend.failures").add(1)

@@ -113,6 +113,69 @@ class EngineSnapshot:
     def num_pages(self) -> int:
         return len(self.pages["k"][0]) if self.pages.get("k") else 0
 
+    # --- durable form (ISSUE 9: disk-backed restart recovery) ---------------
+    SNAP_SCHEMA = 1
+
+    def to_state(self) -> dict:
+        """Plain tree of numpy leaves + python scalars for a
+        CheckpointStore named slot.  The absolute-monotonic ``deadline``
+        does NOT survive a process restart (the clock resets), so the
+        durable form carries the REMAINING budget at persist time PLUS
+        a wall-clock persist timestamp: restore charges the elapsed
+        wall time (post-persist decode + downtime) against the budget
+        before re-anchoring to the new process's clock — restart
+        recovery never extends an SLO."""
+        remaining = (None if self.deadline is None
+                     else max(0.0, self.deadline - time.monotonic()))
+        return {
+            "schema": self.SNAP_SCHEMA,
+            "persisted_unix": time.time(),
+            "request_id": self.request_id,
+            "prompt": np.asarray(self.prompt, np.int32),
+            "max_new_tokens": int(self.max_new_tokens),
+            "deadline_remaining_s": remaining,
+            "generated": np.asarray(self.generated, np.int32),
+            "pos": int(self.pos),
+            "kv_mode": self.kv_mode,
+            "page_size": int(self.page_size),
+            "pages": {side: [np.asarray(p) for p in arrs]
+                      for side, arrs in self.pages.items()},
+        }
+
+    @classmethod
+    def from_state(cls, state: dict,
+                   now: Optional[float] = None) -> "EngineSnapshot":
+        from ..framework.errors import CheckpointIncompatibleError
+
+        schema = int(state.get("schema", -1))
+        if schema > cls.SNAP_SCHEMA:
+            raise CheckpointIncompatibleError(
+                f"engine snapshot schema {schema} is newer than this "
+                f"build's {cls.SNAP_SCHEMA}")
+        now = time.monotonic() if now is None else now
+        remaining = state.get("deadline_remaining_s")
+        if remaining is not None:
+            # charge the wall time since persist (decode after the
+            # snapshot + the downtime itself) against the budget; a
+            # skewed wall clock degrades to the persist-time budget at
+            # worst (elapsed clamped at >= 0)
+            persisted = state.get("persisted_unix")
+            if persisted is not None:
+                remaining = max(
+                    0.0, float(remaining)
+                    - max(0.0, time.time() - float(persisted)))
+        return cls(
+            request_id=state["request_id"],
+            prompt=np.asarray(state["prompt"], np.int32),
+            max_new_tokens=int(state["max_new_tokens"]),
+            deadline=None if remaining is None else now + float(remaining),
+            generated=np.asarray(state["generated"], np.int32),
+            pos=int(state["pos"]),
+            kv_mode=state["kv_mode"],
+            page_size=int(state["page_size"]),
+            pages={side: [np.asarray(p) for p in arrs]
+                   for side, arrs in state["pages"].items()})
+
 
 # =============================================================================
 # Watchdog: hung / overdue step detection

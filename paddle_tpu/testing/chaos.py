@@ -1,4 +1,4 @@
-"""Deterministic chaos injection for the serving stack.
+"""Deterministic chaos injection for the serving AND training stacks.
 
 A :class:`ChaosPlan` is a SCHEDULE of faults, not a probability: each
 :class:`Fault` names an instrumented *site* and the evaluation index at
@@ -24,6 +24,28 @@ Instrumented sites (grep ``chaos_site(`` for the live list)
 ``http.request``      POST /generate intake — ``http_error`` answers
                       with the fault's status before touching the
                       frontend.  Key: request path.
+
+Training-side sites (ISSUE 9 — docs/CHECKPOINT.md "Chaos sites"):
+
+``train.step``        hapi fit step driver, before each train step —
+                      ``raise`` injects a TRANSIENT step failure (the
+                      bounded-backoff retry driver's territory),
+                      ``delay`` a straggler step, ``kill`` a simulated
+                      process death (FatalError, never retried — the
+                      exact-resume acceptance trigger).  Key: none.
+``loader.next``       hapi fit batch fetch, before each ``next()`` —
+                      ``raise``/``delay`` model a flaky/slow data
+                      pipeline; the chaos check precedes the fetch, so
+                      a retried injection never skips a batch.
+``ckpt.write``        framework_io.atomic_write_bytes, the commit path
+                      under EVERY checkpoint (hapi saves, the
+                      CheckpointStore, persisted serving snapshots) —
+                      ``raise`` at key ``temp`` kills the writer with a
+                      PARTIAL temp file on disk, at key ``rename``
+                      after the durable temp but before the rename.
+                      Neither may ever corrupt a committed checkpoint
+                      (the atomicity acceptance pin).  Key: the
+                      injection point (``temp`` | ``rename``).
 
 Usage::
 

@@ -84,6 +84,26 @@ class TestFailOnRegression:
             "detail.compile.serving.prefill.compile_time_ms")
         assert bench_diff.lower_is_better(
             "detail.compile.serving.decode_fused.calls")
+        # training resilience section (ISSUE 9): checkpoint overhead %,
+        # recovery latency, recomputed work and checkpoint size all
+        # regress UPWARD; the warm-failover "recompute_saved_tokens"
+        # (higher = better) must NOT be caught by the new "recomputed"
+        # fragment
+        assert bench_diff.lower_is_better(
+            "detail.training_resilience.checkpoint_overhead_pct_async")
+        assert bench_diff.lower_is_better(
+            "detail.training_resilience.checkpoint_overhead_pct_blocking")
+        assert bench_diff.lower_is_better(
+            "detail.training_resilience.recovery_ms")
+        assert bench_diff.lower_is_better(
+            "detail.training_resilience.recomputed_steps")
+        assert bench_diff.lower_is_better(
+            "detail.training_resilience.checkpoint_bytes")
+        # step_ms_* carry the _ms fragment: gate upward like latencies
+        assert bench_diff.lower_is_better(
+            "detail.training_resilience.step_ms_async")
+        assert not bench_diff.lower_is_better(
+            "detail.resilience.failover.recompute_saved_tokens")
 
     def test_reduction_ratio_gates_on_drop_not_rise(self):
         """The PR-4 acceptance metric: kv_bytes_reduction_x falling

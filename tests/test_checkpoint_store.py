@@ -1,0 +1,252 @@
+"""CheckpointStore atomicity + integrity acceptance (ISSUE 9).
+
+The acceptance bars pinned here:
+
+- a deterministic ``ckpt.write`` chaos kill at EVERY injection point
+  (mid-temp-write, pre-rename) never yields a corrupt ``load_latest()``
+  — the store falls back to the previous complete commit;
+- a checksum-corrupted / truncated checkpoint is DETECTED and skipped,
+  with fallback to the newest valid one;
+- ``paddle.save`` (and therefore ``hapi.Model.save``) rides the same
+  atomic commit: a kill mid-save leaves the prior file loading intact
+  (the ISSUE 9 fix satellite);
+- per-leaf manifest checksums point corruption reports at the exact
+  leaf;
+- keep-last-K retention, named slots, schema-version gating.
+
+Pure host logic — no jit, sub-second.
+"""
+import json
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.errors import (CheckpointCorruptError,
+                                         CheckpointIncompatibleError,
+                                         InternalError,
+                                         InvalidArgumentError)
+from paddle_tpu.framework_io import serialize_bytes
+from paddle_tpu.io.checkpoint import (_MAGIC, SCHEMA_VERSION,
+                                      CheckpointStore, leaf_checksums)
+from paddle_tpu.testing import chaos
+from paddle_tpu.testing.chaos import ChaosPlan, Fault
+
+
+def _state(tag: float):
+    return {"w": np.full((4, 3), tag, np.float32),
+            "step": int(tag),
+            "nested": {"b": np.arange(5, dtype=np.int32) + int(tag)}}
+
+
+@pytest.fixture()
+def store(tmp_path):
+    return CheckpointStore(str(tmp_path / "ckpts"), keep_last=2)
+
+
+class TestCommitAndLoad:
+    def test_roundtrip_and_manifest(self, store):
+        path = store.save(_state(1.0), 1, metadata={"note": "x"})
+        assert os.path.exists(path)
+        state, manifest = store.load(1)
+        np.testing.assert_array_equal(state["w"], _state(1.0)["w"])
+        assert state["nested"]["b"].dtype == np.int32
+        assert manifest["schema"] == SCHEMA_VERSION
+        assert manifest["step"] == 1
+        assert manifest["metadata"] == {"note": "x"}
+        # per-leaf records carry crc/dtype/shape for every leaf
+        assert set(manifest["leaves"]) >= {"w", "step", "nested/b"}
+        assert manifest["leaves"]["w"]["dtype"] == "float32"
+        assert manifest["leaves"]["w"]["shape"] == [4, 3]
+
+    def test_load_latest_and_retention(self, store):
+        for i in (1, 2, 3):
+            store.save(_state(float(i)), i)
+        # keep_last=2: step 1 pruned
+        assert store.steps() == [2, 3]
+        state, manifest = store.load_latest()
+        assert manifest["step"] == 3 and state["step"] == 3
+        assert store.latest_step() == 3
+
+    def test_empty_store(self, store):
+        assert store.load_latest() is None
+        assert store.latest_step() is None
+        assert store.steps() == []
+
+    def test_named_slots_replace_and_delete(self, store):
+        store.save_named("req-a", _state(1.0))
+        store.save_named("req-a", _state(2.0))     # atomic replace
+        state, manifest = store.load_named("req-a")
+        assert state["step"] == 2 and manifest["name"] == "req-a"
+        assert store.named() == ["req-a"]
+        # slots are exempt from step retention
+        for i in (1, 2, 3):
+            store.save(_state(float(i)), i)
+        assert store.named() == ["req-a"]
+        store.delete_named("req-a")
+        assert store.named() == [] and store.load_named("req-a") is None
+
+    def test_validation_args(self, tmp_path, store):
+        with pytest.raises(InvalidArgumentError):
+            CheckpointStore(str(tmp_path / "x"), keep_last=0)
+        with pytest.raises(InvalidArgumentError):
+            store.save_named("../escape", _state(1.0))
+        with pytest.raises(InvalidArgumentError):
+            store.load()
+        with pytest.raises(InvalidArgumentError):
+            store.verify()
+
+    def test_named_save_sweeps_stray_tmps(self, store, tmp_path):
+        """Slot-only stores (the serving snapshot_store) must also
+        clean crashed writers' droppings."""
+        stray = os.path.join(store.directory, "slot-x.ckpt.tmp.1.2")
+        open(stray, "wb").write(b"partial")
+        old = os.path.getmtime(stray) - 7200
+        os.utime(stray, (old, old))
+        store.save_named("req-y", _state(1.0))
+        assert not os.path.exists(stray)
+
+
+class TestAtomicityUnderChaos:
+    """The acceptance pin: kill the writer at every injection point —
+    no kill may ever corrupt ``load_latest``."""
+
+    @pytest.mark.parametrize("point", ["temp", "rename"])
+    def test_kill_during_commit_falls_back(self, store, point):
+        store.save(_state(1.0), 1)
+        plan = ChaosPlan([Fault("ckpt.write", at=1, action=chaos.RAISE,
+                                match=point)])
+        with chaos.running(plan):
+            with pytest.raises(InternalError):
+                store.save(_state(2.0), 2)
+        assert plan.fired_log()[0]["key"] == point
+        # the aborted commit is invisible; the previous one loads intact
+        assert store.steps() == [1]
+        state, manifest = store.load_latest()
+        assert manifest["step"] == 1
+        np.testing.assert_array_equal(state["w"], _state(1.0)["w"])
+        assert store.verify(1) == []
+
+    @pytest.mark.parametrize("point", ["temp", "rename"])
+    def test_kill_during_slot_replace_keeps_old(self, store, point):
+        store.save_named("req-x", _state(1.0))
+        plan = ChaosPlan([Fault("ckpt.write", at=1, action=chaos.RAISE,
+                                match=point)])
+        with chaos.running(plan):
+            with pytest.raises(InternalError):
+                store.save_named("req-x", _state(2.0))
+        state, _ = store.load_named("req-x")
+        assert state["step"] == 1          # old slot intact
+
+    def test_framework_io_save_is_atomic(self, tmp_path):
+        """The fix satellite: paddle.save killed mid-write never
+        corrupts the existing file."""
+        p = str(tmp_path / "m.pdparams")
+        paddle.save({"w": paddle.to_tensor([1.0, 2.0])}, p)
+        for point in ("temp", "rename"):
+            plan = ChaosPlan([Fault("ckpt.write", at=1,
+                                    action=chaos.RAISE, match=point)])
+            with chaos.running(plan):
+                with pytest.raises(InternalError):
+                    paddle.save({"w": paddle.to_tensor([9.0, 9.0])}, p)
+            loaded = paddle.load(p)
+            np.testing.assert_array_equal(loaded["w"].numpy(), [1.0, 2.0])
+
+    def test_model_save_crash_keeps_prior_checkpoint(self, tmp_path):
+        """hapi.Model.save rides the same commit path — the regression
+        the ISSUE names: a kill mid-save must not corrupt the only
+        copy."""
+        from paddle_tpu import nn, optimizer
+
+        net = nn.Linear(3, 2)
+        m = paddle.Model(net)
+        m.prepare(optimizer.SGD(0.1, parameters=net.parameters()))
+        path = str(tmp_path / "model")
+        m.save(path)
+        want = net.weight.numpy().copy()
+        # perturb weights, then kill the re-save mid-stream
+        net.weight._value = net.weight._value + 1.0
+        plan = ChaosPlan([Fault("ckpt.write", at=1, action=chaos.RAISE,
+                                match="temp")])
+        with chaos.running(plan):
+            with pytest.raises(InternalError):
+                m.save(path)
+        m2 = paddle.Model(nn.Linear(3, 2))
+        m2.prepare(optimizer.SGD(0.1, parameters=m2.network.parameters()))
+        m2.load(path)                      # prior commit loads intact
+        np.testing.assert_array_equal(m2.network.weight.numpy(), want)
+
+
+class TestCorruptionDetection:
+    def test_payload_corruption_detected_and_skipped(self, store):
+        store.save(_state(1.0), 1)
+        store.save(_state(2.0), 2)
+        p = store.path_for(2)
+        blob = bytearray(open(p, "rb").read())
+        blob[-4] ^= 0xFF                   # flip payload bytes
+        open(p, "wb").write(bytes(blob))
+        with pytest.raises(CheckpointCorruptError):
+            store.load(2)
+        state, manifest = store.load_latest()
+        assert manifest["step"] == 1 and state["step"] == 1
+        assert len(store.last_skipped) == 1
+        assert "CRC" in store.last_skipped[0][1]
+
+    def test_truncation_detected(self, store):
+        store.save(_state(1.0), 1)
+        store.save(_state(2.0), 2)
+        p = store.path_for(2)
+        blob = open(p, "rb").read()
+        open(p, "wb").write(blob[: len(blob) // 2])
+        state, manifest = store.load_latest()
+        assert manifest["step"] == 1
+        # truncating into the header is detected too
+        open(p, "wb").write(blob[:6])
+        assert store.load_latest()[1]["step"] == 1
+
+    def test_all_corrupt_returns_none(self, store):
+        store.save(_state(1.0), 1)
+        open(store.path_for(1), "wb").write(b"garbage")
+        assert store.load_latest() is None
+        assert len(store.last_skipped) == 1
+
+    def test_newer_schema_incompatible_and_skipped(self, store):
+        store.save(_state(1.0), 1)
+        # hand-craft a step-2 file whose manifest claims a future schema
+        payload = serialize_bytes(_state(2.0))
+        manifest = {"schema": SCHEMA_VERSION + 1, "step": 2,
+                    "payload_crc32": zlib.crc32(payload),
+                    "payload_bytes": len(payload), "leaves": {}}
+        m = json.dumps(manifest).encode()
+        open(store.path_for(2), "wb").write(
+            _MAGIC + len(m).to_bytes(4, "big") + m + payload)
+        with pytest.raises(CheckpointIncompatibleError):
+            store.load(2)
+        assert store.load_latest()[1]["step"] == 1
+
+    def test_per_leaf_checksum_names_the_leaf(self, store):
+        """A tampered leaf with a FIXED-UP payload CRC passes the fast
+        whole-payload check but fails verify() at the exact leaf."""
+        store.save(_state(1.0), 1)
+        assert store.verify(1) == []
+        tampered = _state(1.0)
+        tampered["w"][0, 0] = 999.0
+        payload = serialize_bytes(tampered)
+        manifest, _ = store._read(store.path_for(1))
+        manifest["payload_crc32"] = zlib.crc32(payload)
+        manifest["payload_bytes"] = len(payload)
+        m = json.dumps(manifest).encode()
+        open(store.path_for(1), "wb").write(
+            _MAGIC + len(m).to_bytes(4, "big") + m + payload)
+        problems = store.verify(1)
+        assert len(problems) == 1 and "'w'" in problems[0]
+
+    def test_leaf_checksums_cover_scalars_and_tuples(self):
+        recs = leaf_checksums({"a": 1, "t": (np.zeros(2), "s"),
+                               "n": None})
+        assert set(recs) == {"a", "t/0", "t/1", "n"}
+        # deterministic across calls
+        assert recs == leaf_checksums({"a": 1, "t": (np.zeros(2), "s"),
+                                       "n": None})

@@ -460,3 +460,215 @@ class TestOptimizerTail:
             look.clear_grad()
             np.testing.assert_allclose(np.asarray(params["w"]), p.numpy(),
                                        rtol=1e-6)
+
+
+# =============================================================================
+# ISSUE 9 satellite: set_state_dict(state_dict()) round-trips for EVERY
+# optimizer class and LR scheduler — the leaves exact-resume depends on.
+# =============================================================================
+def _opt_factories():
+    """One factory per optimizer class (parameters injected later)."""
+    return {
+        "SGD": lambda ps: optimizer.SGD(0.1, parameters=ps),
+        "Momentum": lambda ps: optimizer.Momentum(
+            0.1, momentum=0.9, parameters=ps),
+        "Adagrad": lambda ps: optimizer.Adagrad(0.1, parameters=ps),
+        "Adam": lambda ps: optimizer.Adam(0.01, parameters=ps),
+        "AdamW": lambda ps: optimizer.AdamW(
+            0.01, weight_decay=0.02, parameters=ps),
+        "Adamax": lambda ps: optimizer.Adamax(0.01, parameters=ps),
+        "Adadelta": lambda ps: optimizer.Adadelta(0.1, parameters=ps),
+        "RMSProp": lambda ps: optimizer.RMSProp(
+            0.01, momentum=0.5, centered=True, parameters=ps),
+        "Lamb": lambda ps: optimizer.Lamb(0.01, parameters=ps),
+        "Lars": lambda ps: optimizer.Lars(0.1, parameters=ps),
+        "Ftrl": lambda ps: optimizer.Ftrl(0.1, l1=0.01, l2=0.01,
+                                          parameters=ps),
+        "Dpsgd": lambda ps: optimizer.Dpsgd(
+            0.01, clip=0.5, batch_size=4.0, seed=3, parameters=ps),
+    }
+
+
+def _params(seed=0):
+    rng = np.random.RandomState(seed)
+    return [paddle.Parameter(rng.randn(3, 2).astype(np.float32)),
+            paddle.Parameter(rng.randn(4).astype(np.float32))]
+
+
+def _drive(opt, ps, steps=3, seed=5):
+    rng = np.random.RandomState(seed)
+    for _ in range(steps):
+        target = paddle.to_tensor(rng.randn(1).astype(np.float32))
+        loss = sum(((p * target[0]) ** 2).sum() for p in ps)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+
+def _flat_state(sd):
+    """state_dict -> {key: numpy} for exact comparison."""
+    out = {}
+    for k, v in sd.items():
+        if isinstance(v, dict):
+            for kk, vv in _flat_state(v).items():
+                out[f"{k}.{kk}"] = vv
+        elif hasattr(v, "numpy"):
+            out[k] = v.numpy()
+        elif hasattr(v, "shape"):
+            out[k] = np.asarray(v)
+        else:
+            out[k] = v
+    return out
+
+
+class TestStateDictRoundTrips:
+    """Every accumulator pytree (momentum velocity, Adam/Lamb moments,
+    RMSProp mean-square/grad/momentum, Ftrl squared/linear, Adamax
+    inf-norm, AdaDelta averages) must survive
+    ``set_state_dict(state_dict())`` EXACTLY, and a restored optimizer
+    must keep stepping identically to the original."""
+
+    @pytest.mark.parametrize("name", sorted(_opt_factories()))
+    def test_roundtrip_exact_and_next_step_identical(self, name):
+        make = _opt_factories()[name]
+        ps = _params()
+        opt = make(ps)
+        _drive(opt, ps)
+        sd = opt.state_dict()
+        # fresh optimizer over IDENTICAL parameter values
+        ps2 = _params()
+        for p2, p in zip(ps2, ps):
+            p2._value = p._value
+        opt2 = make(ps2)
+        opt2.set_state_dict(sd)
+        got = _flat_state(opt2.state_dict())
+        for k, v in _flat_state(sd).items():
+            if isinstance(v, np.ndarray):
+                np.testing.assert_array_equal(v, got[k], err_msg=k)
+            else:
+                assert got[k] == v, k
+        assert opt2._step_count == opt._step_count
+        # the restored accumulators drive the SAME next update
+        rng_a = np.random.RandomState(99)
+        rng_b = np.random.RandomState(99)
+        t1 = paddle.to_tensor(rng_a.randn(1).astype(np.float32))
+        t2 = paddle.to_tensor(rng_b.randn(1).astype(np.float32))
+        loss1 = sum(((p * t1[0]) ** 2).sum() for p in ps)
+        loss1.backward()
+        opt.step()
+        loss2 = sum(((p * t2[0]) ** 2).sum() for p in ps2)
+        loss2.backward()
+        opt2.step()
+        for p, p2 in zip(ps, ps2):
+            np.testing.assert_array_equal(p.numpy(), p2.numpy())
+
+    def test_model_average_roundtrip(self):
+        ps = _params()
+        sgd = optimizer.SGD(0.1, parameters=ps)
+        ma = optimizer.ModelAverage(0.5, parameters=ps,
+                                    min_average_window=2,
+                                    max_average_window=4)
+        for _ in range(3):
+            _drive(sgd, ps, steps=1)
+            ma.step()
+        sd = ma.state_dict()
+        ma2 = optimizer.ModelAverage(0.5, parameters=ps,
+                                     min_average_window=2,
+                                     max_average_window=4)
+        ma2.set_state_dict(sd)
+        assert ma2._num_updates == ma._num_updates
+        assert ma2._num_accumulates == ma._num_accumulates
+        assert ma2._old_num_accumulates == ma._old_num_accumulates
+        for kind in ("sum_1", "sum_2", "sum_3"):
+            for p in ps:
+                np.testing.assert_array_equal(
+                    np.asarray(ma._accumulators[kind][id(p)]),
+                    np.asarray(ma2._accumulators[kind][id(p)]))
+        # the averaged weights derived from the restored sums agree
+        with ma.apply():
+            want = [p.numpy().copy() for p in ps]
+        with ma2.apply():
+            got = [p.numpy().copy() for p in ps]
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
+
+    def test_lookahead_roundtrip_exact(self):
+        ps = _params()
+        look = optimizer.Lookahead(
+            optimizer.Adam(0.01, parameters=ps), alpha=0.5, k=2)
+        _drive(look, ps, steps=3)
+        sd = look.state_dict()
+        ps2 = _params()
+        for p2, p in zip(ps2, ps):
+            p2._value = p._value
+        look2 = optimizer.Lookahead(
+            optimizer.Adam(0.01, parameters=ps2), alpha=0.5, k=2)
+        look2.set_state_dict(sd)
+        assert look2._k_count == look._k_count
+        for i, (p, p2) in enumerate(zip(ps, ps2)):
+            np.testing.assert_array_equal(
+                np.asarray(look._slow[id(p)]),
+                np.asarray(look2._slow[id(p2)]))
+        inner = _flat_state(look.inner_optimizer.state_dict())
+        inner2 = _flat_state(look2.inner_optimizer.state_dict())
+        for k, v in inner.items():
+            if isinstance(v, np.ndarray):
+                np.testing.assert_array_equal(v, inner2[k], err_msg=k)
+
+
+def _sched_factories():
+    from paddle_tpu.optimizer import lr as lr_mod
+
+    return {
+        "NoamDecay": lambda: lr_mod.NoamDecay(64, 10, 1.0),
+        "PiecewiseDecay": lambda: lr_mod.PiecewiseDecay(
+            [3, 6], [0.1, 0.05, 0.01]),
+        "NaturalExpDecay": lambda: lr_mod.NaturalExpDecay(0.1, 0.5),
+        "InverseTimeDecay": lambda: lr_mod.InverseTimeDecay(0.1, 0.5),
+        "PolynomialDecay": lambda: lr_mod.PolynomialDecay(
+            0.1, 10, cycle=True),
+        "LinearWarmup": lambda: lr_mod.LinearWarmup(0.1, 4, 0.0, 0.1),
+        "ExponentialDecay": lambda: lr_mod.ExponentialDecay(0.1, 0.9),
+        "MultiStepDecay": lambda: lr_mod.MultiStepDecay(0.1, [2, 5]),
+        "StepDecay": lambda: lr_mod.StepDecay(0.1, 3),
+        "LambdaDecay": lambda: lr_mod.LambdaDecay(
+            0.1, lambda e: 0.95 ** e),
+        "CosineAnnealingDecay": lambda: lr_mod.CosineAnnealingDecay(
+            0.1, 8),
+        "CyclicLR": lambda: lr_mod.CyclicLR(0.01, 0.1, 4,
+                                            mode="triangular2"),
+        "OneCycleLR": lambda: lr_mod.OneCycleLR(0.1, 12),
+    }
+
+
+class TestLRSchedulerRoundTrips:
+    @pytest.mark.parametrize("name", sorted(_sched_factories()))
+    def test_roundtrip_and_future_lrs_identical(self, name):
+        make = _sched_factories()[name]
+        a = make()
+        for _ in range(5):
+            a.step()
+        b = make()
+        b.set_state_dict(a.state_dict())
+        assert b.last_epoch == a.last_epoch
+        assert b() == a()
+        # the restored scheduler produces the SAME future lr sequence
+        for _ in range(6):
+            a.step()
+            b.step()
+            assert b() == a(), name
+
+    def test_reduce_on_plateau_roundtrip(self):
+        from paddle_tpu.optimizer import lr as lr_mod
+
+        a = lr_mod.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+        for v in (1.0, 1.1, 1.2, 1.3):
+            a.step(v)
+        b = lr_mod.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+        b.set_state_dict(a.state_dict())
+        assert (b.best, b.num_bad, b.last_lr) == \
+            (a.best, a.num_bad, a.last_lr)
+        for v in (1.4, 1.5, 1.6):
+            a.step(v)
+            b.step(v)
+            assert b.last_lr == a.last_lr

@@ -1,0 +1,264 @@
+"""Disk-backed serving restart recovery (ISSUE 9 serving tie-in).
+
+Warm failover (ISSUE 6) survives a replica death inside one process;
+this file pins the next ring out: EngineSnapshot persistence through
+the atomic CheckpointStore lets a serving frontend *restart* — a NEW
+process with fresh engines — recover mid-stream requests from disk,
+byte-identical to the uninterrupted ``generate(greedy)`` stream.
+
+Also pinned: the durable-form round-trip (deadline persisted as
+REMAINING budget, re-anchored on restore), slot lifecycle (retired on
+client-visible terminals, kept on ``failed``), and corrupt-slot
+skipping.  Runs under the lock-order witness like the other serving
+suites.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io.checkpoint import CheckpointStore
+from paddle_tpu.serving import ServingFrontend
+from paddle_tpu.serving.resilience import EngineSnapshot
+from paddle_tpu.testing import chaos
+from paddle_tpu.text.generation import generate
+
+VOCAB, HID, LAYERS, HEADS = 50, 32, 2, 2
+ENGINE_KW = dict(page_size=4, max_batch_size=4, eos_id=0)
+PROMPTS = [[5, 9, 3], [7, 2, 8, 4]]
+BUDGET = 16
+
+
+@pytest.fixture(autouse=True)
+def _lock_witness():
+    """Every run doubles as a deadlock detector over the pump threads,
+    the snapshot persistence path and the recovery path (ISSUE 7)."""
+    from paddle_tpu.framework import concurrency
+
+    with concurrency.witness(raise_on_violation=False):
+        yield
+    concurrency.assert_clean()
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    from paddle_tpu.text.models import GPTModel
+
+    paddle.seed(11)
+    m = GPTModel(vocab_size=VOCAB, hidden_size=HID, num_layers=LAYERS,
+                 num_heads=HEADS, ffn_size=64, max_seq_len=64,
+                 dropout=0.0)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def refs(gpt):
+    out = []
+    for p in PROMPTS:
+        want, _ = generate(gpt, np.asarray(p, np.int32)[None, :],
+                           max_new_tokens=BUDGET, end_id=0)
+        w = want.numpy()[0]
+        if (w == 0).any():
+            w = w[: int(np.argmax(w == 0)) + 1]
+        out.append(w)
+    return out
+
+
+def _wait(pred, timeout=20.0, what=""):
+    t0 = time.monotonic()
+    while not pred():
+        assert time.monotonic() - t0 < timeout, f"timeout: {what}"
+        time.sleep(0.01)
+
+
+class TestSnapshotDurableForm:
+    def test_state_roundtrip_reanchors_deadline(self):
+        snap = EngineSnapshot(
+            request_id="r1", prompt=np.array([1, 2, 3], np.int32),
+            max_new_tokens=8, deadline=time.monotonic() + 5.0,
+            generated=np.array([4, 5], np.int32), pos=4,
+            kv_mode="native", page_size=4,
+            pages={"k": [np.ones((2, 4, 2, 8), np.float32)],
+                   "v": [np.ones((2, 4, 2, 8), np.float32)]})
+        state = snap.to_state()
+        assert 0.0 < state["deadline_remaining_s"] <= 5.0
+        back = EngineSnapshot.from_state(state, now=1000.0)
+        assert back.request_id == "r1"
+        assert back.deadline == pytest.approx(
+            1000.0 + state["deadline_remaining_s"], abs=0.2)
+        assert back.num_generated == 2 and back.pos == 4
+        np.testing.assert_array_equal(back.pages["k"][0],
+                                      snap.pages["k"][0])
+        # no deadline stays no deadline
+        snap.deadline = None
+        assert EngineSnapshot.from_state(snap.to_state()).deadline is None
+
+    def test_downtime_charged_against_budget(self):
+        """The SLO clock keeps ticking while the process is down:
+        restore charges wall time since persist against the remaining
+        budget."""
+        snap = EngineSnapshot(
+            request_id="r1", prompt=np.array([1, 2], np.int32),
+            max_new_tokens=4, deadline=time.monotonic() + 10.0,
+            generated=np.array([], np.int32), pos=1, kv_mode="native",
+            page_size=4, pages={"k": [], "v": []})
+        state = snap.to_state()
+        state["persisted_unix"] -= 7.0       # 7s of "downtime"
+        back = EngineSnapshot.from_state(state, now=0.0)
+        assert back.deadline == pytest.approx(
+            state["deadline_remaining_s"] - 7.0, abs=0.2)
+        # downtime beyond the budget clamps to an already-due deadline
+        state["persisted_unix"] -= 100.0
+        assert EngineSnapshot.from_state(state, now=0.0).deadline == 0.0
+
+    def test_newer_schema_refused(self):
+        snap = EngineSnapshot(
+            request_id="r1", prompt=np.array([1], np.int32),
+            max_new_tokens=4, deadline=None,
+            generated=np.array([], np.int32), pos=0, kv_mode="native",
+            page_size=4, pages={"k": [], "v": []})
+        state = snap.to_state()
+        state["schema"] = EngineSnapshot.SNAP_SCHEMA + 1
+        from paddle_tpu.framework.errors import \
+            CheckpointIncompatibleError
+
+        with pytest.raises(CheckpointIncompatibleError):
+            EngineSnapshot.from_state(state)
+
+
+class TestRestartRecovery:
+    def test_crash_then_recover_byte_identical(self, gpt, refs,
+                                               tmp_path):
+        """The acceptance scenario: frontend with a snapshot store,
+        the ONLY replica dies (no survivor -> ``failed``), slots stay
+        on disk; a NEW frontend recovers both requests mid-stream and
+        their full streams equal the uninterrupted references."""
+        store = CheckpointStore(str(tmp_path / "snaps"))
+        fe = ServingFrontend(gpt, replicas=1, queue_cap=8,
+                             engine_kwargs=ENGINE_KW,
+                             snapshot_interval=4, snapshot_store=store)
+        # arm the kill BEFORE submitting: by engine step 10 both
+        # requests hold >= interval tokens (snapshots persisted, same
+        # pump thread) but are far from their budget — deterministic
+        # regardless of host scheduling
+        fe.inject_failure("replica-0", at_step=10)
+        hs = [fe.submit(p, max_new_tokens=BUDGET) for p in PROMPTS]
+        for h in hs:
+            assert h.wait(timeout=20) == "failed"
+        assert len([n for n in store.named()
+                    if n.startswith("req-")]) == 2
+        fe.close()
+        # FAILED keeps the slots — that is the rescue material
+        assert sorted(store.named()) == [f"req-{h.request_id}"
+                                         for h in hs]
+
+        fe2 = ServingFrontend(gpt, replicas=1, queue_cap=8,
+                              engine_kwargs=ENGINE_KW,
+                              snapshot_interval=4, snapshot_store=store)
+        recovered = sorted(fe2.recover_pending(),
+                           key=lambda h: h.request_id)
+        assert [h.request_id for h in recovered] == \
+            sorted(h.request_id for h in hs)
+        for h, ref in zip(recovered, refs):
+            assert h.retried and h.resumed_from >= 4
+            toks = h.result(timeout=30)
+            # byte-identical to the uninterrupted stream: the persisted
+            # prefix + the re-decoded tail (greedy determinism)
+            np.testing.assert_array_equal(toks, ref)
+        assert fe2.stats()["frontend"]["recovered"] == 2
+        # completion retires the slots (poll: deletion follows _finish)
+        _wait(lambda: store.named() == [], what="slots retired")
+        fe2.close()
+
+    def test_recovered_stream_emits_resume_marker(self, gpt, refs,
+                                                  tmp_path):
+        store = CheckpointStore(str(tmp_path / "snaps2"))
+        fe = ServingFrontend(gpt, replicas=1, queue_cap=8,
+                             engine_kwargs=ENGINE_KW,
+                             snapshot_interval=4, snapshot_store=store)
+        fe.inject_failure("replica-0", at_step=10)
+        h = fe.submit(PROMPTS[0], max_new_tokens=BUDGET)
+        assert h.wait(timeout=20) == "failed"
+        fe.close()
+        assert store.named()
+        fe2 = ServingFrontend(gpt, replicas=1, queue_cap=8,
+                              engine_kwargs=ENGINE_KW,
+                              snapshot_interval=4, snapshot_store=store)
+        (h2,) = fe2.recover_pending()
+        evs = list(h2.events())
+        kinds = [e[0] for e in evs]
+        assert "resume" in kinds
+        resume_at = next(e[1] for e in evs if e[0] == "resume")
+        assert resume_at == h2.resumed_from
+        toks = [e[2] for e in evs if e[0] == "token"]
+        np.testing.assert_array_equal(np.asarray(toks, np.int32),
+                                      refs[0])
+        assert evs[-1] == ("end", "completed")
+        fe2.close()
+
+    def test_corrupt_slot_skipped(self, gpt, tmp_path):
+        store = CheckpointStore(str(tmp_path / "snaps3"))
+        fe = ServingFrontend(gpt, replicas=1, queue_cap=8,
+                             engine_kwargs=ENGINE_KW,
+                             snapshot_interval=4, snapshot_store=store)
+        fe.inject_failure("replica-0", at_step=10)
+        h = fe.submit(PROMPTS[0], max_new_tokens=BUDGET)
+        assert h.wait(timeout=20) == "failed"
+        fe.close()
+        assert store.named()
+        # tear the slot on disk: recovery must skip it, not crash
+        name = store.named()[0]
+        open(store._slot_path(name), "wb").write(b"torn")
+        fe2 = ServingFrontend(gpt, replicas=1, queue_cap=8,
+                              engine_kwargs=ENGINE_KW,
+                              snapshot_interval=4, snapshot_store=store)
+        assert fe2.recover_pending() == []
+        assert store.last_skipped
+        fe2.close()
+
+    def test_completed_requests_leave_no_slots(self, gpt, refs,
+                                               tmp_path):
+        """The happy path stays clean: normal completions retire their
+        slots, so a restart has nothing (spurious) to recover."""
+        store = CheckpointStore(str(tmp_path / "snaps4"))
+        fe = ServingFrontend(gpt, replicas=1, queue_cap=8,
+                             engine_kwargs=ENGINE_KW,
+                             snapshot_interval=4, snapshot_store=store)
+        hs = [fe.submit(p, max_new_tokens=BUDGET) for p in PROMPTS]
+        for h, ref in zip(hs, refs):
+            np.testing.assert_array_equal(h.result(timeout=30), ref)
+        _wait(lambda: not store.named(), what="slots retired")
+        fe.close()
+        assert fe.stats()["resilience"]["snapshot_persist_errors"] == 0
+
+    def test_recover_pending_requires_store(self, gpt):
+        fe = ServingFrontend(gpt, replicas=1, queue_cap=8,
+                             engine_kwargs=ENGINE_KW)
+        with pytest.raises(ValueError):
+            fe.recover_pending()
+        fe.close()
+
+    def test_expired_budget_terminates_deadline_miss(self, gpt,
+                                                     tmp_path):
+        """A persisted request whose remaining budget ran out while the
+        process was down terminates deadline_miss at recovery (restart
+        never extends an SLO) and retires its slot."""
+        store = CheckpointStore(str(tmp_path / "snaps5"))
+        snap = EngineSnapshot(
+            request_id="stale", prompt=np.array(PROMPTS[0], np.int32),
+            max_new_tokens=BUDGET, deadline=time.monotonic(),  # now
+            generated=np.array([31, 31, 37, 9], np.int32), pos=6,
+            kv_mode="native", page_size=4,
+            pages={"k": [], "v": []})
+        state = snap.to_state()
+        assert state["deadline_remaining_s"] == 0.0
+        store.save_named("req-stale", state)
+        fe = ServingFrontend(gpt, replicas=1, queue_cap=8,
+                             engine_kwargs=ENGINE_KW,
+                             snapshot_interval=4, snapshot_store=store)
+        (h,) = fe.recover_pending()
+        assert h.status == "deadline_miss"
+        _wait(lambda: not store.named(), what="stale slot retired")
+        fe.close()

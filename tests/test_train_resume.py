@@ -1,0 +1,355 @@
+"""Exact-resume + fault-tolerant train loop acceptance (ISSUE 9).
+
+The acceptance bars pinned here:
+
+- EXACT RESUME: train N steps uninterrupted vs train, kill at step K
+  (deterministic ``train.step`` chaos), resume from ``load_latest()``
+  on a FRESH model — the loss trajectory and the final parameter
+  pytree are byte-identical, and the recomputed-step accounting is
+  ≤ the checkpoint interval;
+- the async double-buffered writer commits the SAME states the
+  blocking writer does;
+- transient ``train.step`` / ``loader.next`` faults are absorbed by
+  the bounded-backoff retry driver with the PRNG streams restored per
+  attempt, so a run with transient faults stays bit-identical to a
+  clean one;
+- capture/restore round-trips the unified TrainState (functional and
+  eager paths, optimizer host state, generator, numpy RNG).
+
+The tiny model keeps each fit() in the low seconds; the randomized
+kill-step soak is ``slow``-marked.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.framework.errors import FatalError
+from paddle_tpu.framework.monitor import stat_get
+from paddle_tpu.hapi.callbacks import Callback
+from paddle_tpu.hapi.checkpoint import (TrainCheckpointer,
+                                        capture_train_state,
+                                        restore_train_state)
+from paddle_tpu.io.checkpoint import CheckpointStore
+from paddle_tpu.io.dataset import TensorDataset
+from paddle_tpu.testing import chaos
+from paddle_tpu.testing.chaos import ChaosPlan, Fault
+
+BATCH, FEAT, HID = 8, 6, 8
+EPOCHS, PER_EPOCH = 3, 6                 # 18 total steps
+
+
+def make_model():
+    net = nn.Sequential(nn.Linear(FEAT, HID), nn.ReLU(),
+                        nn.Linear(HID, 1))
+    m = paddle.Model(net)
+    m.prepare(optimizer.Adam(learning_rate=0.01,
+                             parameters=net.parameters()),
+              nn.MSELoss())
+    return m
+
+
+def make_ds():
+    rng = np.random.RandomState(0)
+    x = rng.randn(BATCH * PER_EPOCH, FEAT).astype(np.float32)
+    w = rng.randn(FEAT, 1).astype(np.float32)
+    return TensorDataset([x, (x @ w).astype(np.float32)])
+
+
+class LossLog(Callback):
+    def __init__(self):
+        self.losses = []
+
+    def on_train_batch_end(self, step, logs=None):
+        self.losses.append(logs["loss"])
+
+
+def run_fit(seed=7, **fit_kw):
+    """One seeded fit over the standard tiny problem; returns (losses,
+    final param dict)."""
+    paddle.seed(seed)
+    log = LossLog()
+    m = make_model()
+    m.fit(make_ds(), batch_size=BATCH, epochs=EPOCHS, shuffle=True,
+          verbose=0, callbacks=[log], **fit_kw)
+    params = {k: v.numpy().copy() for k, v in m.state_dict().items()}
+    return log.losses, params
+
+
+@pytest.fixture(scope="module")
+def reference():
+    """The uninterrupted 18-step run every scenario compares against."""
+    return run_fit()
+
+
+class TestExactResume:
+    def _kill_and_resume(self, tmp_path, reference, kill_at, interval,
+                         checkpoint_async=True):
+        ref_losses, ref_params = reference
+        d = str(tmp_path / "ckpts")
+        paddle.seed(7)
+        kill_log = LossLog()
+        m_k = make_model()
+        plan = ChaosPlan([Fault("train.step", at=kill_at,
+                                action=chaos.KILL)])
+        with chaos.running(plan):
+            with pytest.raises(FatalError):
+                m_k.fit(make_ds(), batch_size=BATCH, epochs=EPOCHS,
+                        shuffle=True, verbose=0, callbacks=[kill_log],
+                        checkpoint_dir=d, checkpoint_interval=interval,
+                        checkpoint_async=checkpoint_async)
+        # the killed run's prefix IS the reference's prefix
+        assert kill_log.losses == ref_losses[: kill_at - 1]
+        ckpt_step = CheckpointStore(d).latest_step()
+        assert ckpt_step == ((kill_at - 1) // interval) * interval
+        rec0 = stat_get("train.recomputed_steps")
+        res0 = stat_get("train.resumes")
+        # resume on a FRESH model (new process simulation: fresh jit,
+        # fresh optimizer, no seeding — the checkpoint carries the RNG)
+        res_log = LossLog()
+        m_r = make_model()
+        m_r.fit(make_ds(), batch_size=BATCH, epochs=EPOCHS, shuffle=True,
+                verbose=0, callbacks=[res_log], checkpoint_dir=d,
+                checkpoint_interval=interval, resume=True)
+        assert stat_get("train.resumes") - res0 == 1
+        # recomputed = progress (kill_at-1 completed) − checkpoint step
+        recomputed = stat_get("train.recomputed_steps") - rec0
+        assert recomputed == (kill_at - 1) - ckpt_step
+        assert recomputed <= interval
+        # BYTE-IDENTITY: resumed trajectory == reference tail, final
+        # params equal bit for bit
+        assert res_log.losses == ref_losses[ckpt_step:]
+        res_params = {k: v.numpy()
+                      for k, v in m_r.state_dict().items()}
+        for k in ref_params:
+            np.testing.assert_array_equal(ref_params[k], res_params[k])
+
+    def test_kill_mid_epoch_resume_byte_identical(self, tmp_path,
+                                                  reference):
+        # kill at step 11 (epoch 1), interval 4 -> resume from step 8,
+        # 2 recomputed
+        self._kill_and_resume(tmp_path, reference, kill_at=11,
+                              interval=4)
+
+    def test_kill_at_epoch_boundary(self, tmp_path, reference):
+        # kill at step 13 (first step of epoch 2); checkpoint at 12 is
+        # exactly the epoch boundary -> zero recomputed steps
+        self._kill_and_resume(tmp_path, reference, kill_at=13,
+                              interval=6)
+
+    def test_blocking_writer_same_guarantee(self, tmp_path, reference):
+        self._kill_and_resume(tmp_path, reference, kill_at=10,
+                              interval=4, checkpoint_async=False)
+
+    def test_resume_empty_store_starts_fresh(self, tmp_path, reference):
+        losses, params = run_fit(
+            checkpoint_dir=str(tmp_path / "none"),
+            checkpoint_interval=4, resume=True)
+        assert losses == reference[0]
+
+    def test_resume_after_completion_is_noop(self, tmp_path, reference):
+        d = str(tmp_path / "done")
+        losses, params = run_fit(checkpoint_dir=d, checkpoint_interval=4)
+        assert losses == reference[0]
+        # the terminal checkpoint sits at (EPOCHS, 0): same epoch budget
+        # resumes to an immediate no-op with params preserved
+        res_log = LossLog()
+        m = make_model()
+        m.fit(make_ds(), batch_size=BATCH, epochs=EPOCHS, shuffle=True,
+              verbose=0, callbacks=[res_log], checkpoint_dir=d,
+              checkpoint_interval=4, resume=True)
+        assert res_log.losses == []
+        got = {k: v.numpy() for k, v in m.state_dict().items()}
+        for k, v in params.items():
+            np.testing.assert_array_equal(v, got[k])
+        # the no-op re-fit must NOT rewrite the terminal checkpoint:
+        # this process's numpy state is unrelated to the true
+        # end-of-training state, and a rewrite would corrupt the
+        # continuation point for a later larger-epoch-budget resume
+        a, _ = CheckpointStore(d).load_latest()
+        paddle.seed(7)
+        run_fit(checkpoint_dir=d + "_fresh", checkpoint_interval=4)
+        b, _ = CheckpointStore(d + "_fresh").load_latest()
+        np.testing.assert_array_equal(
+            np.asarray(a["loader"]["np_state_epoch_start"][1]),
+            np.asarray(b["loader"]["np_state_epoch_start"][1]))
+
+    def test_resume_true_requires_dir(self):
+        m = make_model()
+        with pytest.raises(ValueError):
+            m.fit(make_ds(), batch_size=BATCH, epochs=1, verbose=0,
+                  resume=True)
+
+    def test_async_commits_identical_states(self, tmp_path):
+        """Double-buffered writes commit the same bytes-on-disk state
+        trees as blocking ones."""
+        da, db = str(tmp_path / "a"), str(tmp_path / "b")
+        run_fit(checkpoint_dir=da, checkpoint_interval=4,
+                checkpoint_async=True)
+        run_fit(checkpoint_dir=db, checkpoint_interval=4,
+                checkpoint_async=False)
+        sa, sb = CheckpointStore(da), CheckpointStore(db)
+        assert sa.steps() == sb.steps()
+        a, _ = sa.load_latest()
+        b, _ = sb.load_latest()
+        for k in a["model"]["params"]:
+            np.testing.assert_array_equal(a["model"]["params"][k],
+                                          b["model"]["params"][k])
+        np.testing.assert_array_equal(a["rng"]["key_data"],
+                                      b["rng"]["key_data"])
+
+
+class TestRetryDriver:
+    def test_transient_step_fault_absorbed_bit_identical(self,
+                                                         reference):
+        """A chaos raise at the train.step site retries with restored
+        PRNG state — the faulted run equals the clean one exactly."""
+        r0 = stat_get("train.step_retries")
+        plan = ChaosPlan([Fault("train.step", at=3,
+                                action=chaos.RAISE)])
+        with chaos.running(plan):
+            losses, params = run_fit(step_retries=2,
+                                     step_retry_backoff_s=0.001)
+        assert stat_get("train.step_retries") - r0 == 1
+        assert losses == reference[0]
+        for k, v in reference[1].items():
+            np.testing.assert_array_equal(v, params[k])
+
+    def test_transient_loader_fault_absorbed(self, reference):
+        plan = ChaosPlan([Fault("loader.next", at=5,
+                                action=chaos.RAISE)])
+        with chaos.running(plan):
+            losses, _ = run_fit(step_retries=2,
+                                step_retry_backoff_s=0.001)
+        assert losses == reference[0]
+
+    def test_retries_exhausted_raises(self):
+        plan = ChaosPlan([Fault("train.step", at=2, action=chaos.RAISE,
+                                count=5)])
+        with chaos.running(plan):
+            with pytest.raises(Exception):
+                run_fit(step_retries=2, step_retry_backoff_s=0.001)
+
+    def test_zero_retries_propagates_first_fault(self):
+        plan = ChaosPlan([Fault("train.step", at=2,
+                                action=chaos.RAISE)])
+        with chaos.running(plan):
+            with pytest.raises(Exception):
+                run_fit()
+
+    def test_kill_never_retried(self):
+        plan = ChaosPlan([Fault("train.step", at=2, action=chaos.KILL)])
+        with chaos.running(plan):
+            with pytest.raises(FatalError):
+                run_fit(step_retries=5, step_retry_backoff_s=0.001)
+
+
+class TestTrainStateCapture:
+    def test_functional_roundtrip(self):
+        paddle.seed(3)
+        m = make_model()
+        ds = make_ds()
+        m.fit(ds, batch_size=BATCH, epochs=1, shuffle=False, verbose=0)
+        state = capture_train_state(m, global_step=PER_EPOCH, epoch=1,
+                                    next_batch=0)
+        assert state["mode"] == "functional"
+        # Adam slot pytrees ride in the capture
+        assert set(state["model"]["opt"]) == {"moment1", "moment2"}
+        m2 = make_model()
+        pos = restore_train_state(m2, state)
+        assert pos["global_step"] == PER_EPOCH
+        assert pos["epoch"] == 1 and pos["next_batch"] == 0
+        for k, v in m.state_dict().items():
+            np.testing.assert_array_equal(v.numpy(),
+                                          m2.state_dict()[k].numpy())
+        # step counter restored into the traced state
+        assert int(np.asarray(m2._state["step"])) == PER_EPOCH
+
+    def test_eager_roundtrip_with_scheduler(self):
+        from paddle_tpu.optimizer import lr as lr_mod
+
+        paddle.seed(4)
+        net = nn.Linear(FEAT, 1)
+        m = paddle.Model(net)
+        sched = lr_mod.StepDecay(0.1, step_size=2, gamma=0.5)
+        m.prepare(optimizer.Momentum(learning_rate=sched, momentum=0.9,
+                                     parameters=net.parameters()),
+                  nn.MSELoss(), accelerate=False)
+        x = np.random.RandomState(0).randn(BATCH, FEAT).astype(np.float32)
+        y = np.random.RandomState(1).randn(BATCH, 1).astype(np.float32)
+        for _ in range(3):
+            m.train_batch([paddle.to_tensor(x)], [paddle.to_tensor(y)])
+            sched.step()
+        state = capture_train_state(m, global_step=3)
+        assert state["mode"] == "eager"
+        assert state["optimizer_host"]["step_count"] == 3
+        net2 = nn.Linear(FEAT, 1)
+        m2 = paddle.Model(net2)
+        sched2 = lr_mod.StepDecay(0.1, step_size=2, gamma=0.5)
+        m2.prepare(optimizer.Momentum(learning_rate=sched2, momentum=0.9,
+                                      parameters=net2.parameters()),
+                   nn.MSELoss(), accelerate=False)
+        restore_train_state(m2, state)
+        assert m2._optimizer._step_count == 3
+        assert sched2.last_epoch == sched.last_epoch
+        assert sched2.last_lr == sched.last_lr
+        np.testing.assert_array_equal(net.weight.numpy(),
+                                      net2.weight.numpy())
+        # one more step on both stays identical (momentum velocity
+        # survived the round-trip)
+        m.train_batch([paddle.to_tensor(x)], [paddle.to_tensor(y)])
+        m2.train_batch([paddle.to_tensor(x)], [paddle.to_tensor(y)])
+        np.testing.assert_array_equal(net.weight.numpy(),
+                                      net2.weight.numpy())
+
+    def test_writer_error_surfaces_on_flush(self, tmp_path):
+        """A background write failure is re-raised at the next
+        flush/submit, never swallowed."""
+        paddle.seed(5)
+        m = make_model()
+        m.fit(make_ds(), batch_size=BATCH, epochs=1, shuffle=False,
+              verbose=0, num_iters=1)
+        ck = TrainCheckpointer(str(tmp_path / "w"), interval=1)
+        ck.store.save = lambda *a, **k: (_ for _ in ()).throw(
+            OSError("disk full"))
+        ck.snapshot(m, global_step=1, epoch=0, next_batch=1,
+                    np_state_epoch_start=np.random.get_state())
+        with pytest.raises(OSError):
+            ck.close()
+
+
+@pytest.mark.slow
+class TestKillSweepSoak:
+    def test_every_kill_step_resumes_exactly(self, tmp_path):
+        """Chaos kill at EVERY step of the run, resume each time —
+        byte-identity must hold regardless of where the crash lands."""
+        ref_losses, ref_params = run_fit()
+        interval = 4
+        for kill_at in range(2, EPOCHS * PER_EPOCH + 1, 3):
+            d = str(tmp_path / f"k{kill_at}")
+            paddle.seed(7)
+            m_k = make_model()
+            plan = ChaosPlan([Fault("train.step", at=kill_at,
+                                    action=chaos.KILL)])
+            with chaos.running(plan):
+                with pytest.raises(FatalError):
+                    m_k.fit(make_ds(), batch_size=BATCH, epochs=EPOCHS,
+                            shuffle=True, verbose=0, checkpoint_dir=d,
+                            checkpoint_interval=interval)
+            ckpt_step = CheckpointStore(d).latest_step()
+            if ckpt_step is None:
+                # killed before the first commit: resume=True starts
+                # from scratch — re-seed like any fresh launch would
+                ckpt_step = 0
+                paddle.seed(7)
+            res_log = LossLog()
+            m_r = make_model()
+            m_r.fit(make_ds(), batch_size=BATCH, epochs=EPOCHS,
+                    shuffle=True, verbose=0, callbacks=[res_log],
+                    checkpoint_dir=d, checkpoint_interval=interval,
+                    resume=True)
+            assert res_log.losses == ref_losses[ckpt_step:], \
+                f"kill@{kill_at}"
+            got = {k: v.numpy() for k, v in m_r.state_dict().items()}
+            for k, v in ref_params.items():
+                np.testing.assert_array_equal(v, got[k])

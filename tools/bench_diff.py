@@ -127,7 +127,12 @@ _HIGHER_BETTER = ("reduction", "per_sec", "per_second", "goodput",
 _LOWER_BETTER = ("_ms", "latency", "ttft", "e2e", "gap", "miss", "bytes",
                  "fragmentation", "preemption", "reject", "retries",
                  "cancel", "abort", "failure", "queue_depth",
-                 "dispatches_per", "_rate", "compile", "retrace")
+                 "dispatches_per", "_rate", "compile", "retrace",
+                 # training resilience (ISSUE 9): checkpoint overhead %
+                 # and crash-recomputed work both regress upward
+                 # ("recomputed" stays distinct from the higher-better
+                 # "recompute_saved_tokens")
+                 "overhead", "recomputed")
 
 
 def lower_is_better(metric: str) -> bool:
