@@ -1197,6 +1197,128 @@ def bench_training_resilience(steps=24, interval=4):
             shutil.rmtree(d, ignore_errors=True)
 
 
+def bench_serving_prefix_cache(num_requests=16, max_new_tokens=8):
+    """Prefix cache (docs/SERVING.md "Prefix caching"): shared-system-
+    prompt Poisson workload at target hit rates {0, 0.5, 0.9} — the
+    fraction of requests whose prompt is the shared system prefix plus
+    a short unique suffix (the rest are fully unique prompts).  The
+    index is warmed with ONE untimed seed request carrying the system
+    prompt, so every shared arrival hits.  Per rate: TTFT p50/p95,
+    prefill tokens skipped (``serving.prefix.hit_tokens``), prefill
+    FLOPs actually spent (``cost_registry`` ``serving.prefill``), and
+    the measured hit rate.  The headline is TTFT p95 at the 0.9-rate
+    workload with the cache ON vs the SAME workload with it OFF —
+    ``ttft_p95_speedup_x`` (the ISSUE 10 acceptance asks >= 1.5x) —
+    plus the matching ``prefill_flops_reduction_x``."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler.jit_cost import cost_registry
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.text.models import GPTModel
+
+    V, HID, L, HEADS, FF, SEQ = 50304, 256, 4, 8, 1024, 512
+    PAGE = 16
+    sys_len = int(os.environ.get("BENCH_PREFIX_SYSLEN", "192"))
+    paddle.seed(0)
+    model = GPTModel(vocab_size=V, hidden_size=HID, num_layers=L,
+                     num_heads=HEADS, ffn_size=FF, max_seq_len=SEQ,
+                     dropout=0.0)
+    model.eval()
+
+    rng = np.random.RandomState(0)
+    system_prompt = rng.randint(1, V, (sys_len,)).astype(np.int32)
+    lam = 0.5
+    arrivals = np.cumsum(rng.exponential(lam, num_requests))
+    suffixes = [rng.randint(1, V, (int(s),)).astype(np.int32)
+                for s in rng.randint(8, 33, num_requests)]
+    uniques = [rng.randint(1, V, (sys_len + len(sfx),)).astype(np.int32)
+               for sfx in suffixes]
+    # per-request shared/unique draw, one schedule reused across rates
+    # and across the on/off baseline (same Poisson trace, same lengths)
+    draws = rng.uniform(size=num_requests)
+
+    def run(rate, prefix_cache):
+        eng = ServingEngine(model, page_size=PAGE, max_batch_size=8,
+                            max_seq_len=SEQ, eos_id=-1,
+                            prefix_cache=prefix_cache)
+        # warm: compile every bucket AND seed the index with the system
+        # prompt (the resident donor every shared arrival hits)
+        eng.add_request(np.concatenate([system_prompt, suffixes[0]]),
+                        max_new_tokens=4)
+        eng.drain()
+        for wp in (9, 17, 33, 63):
+            eng.add_request(uniques[0][:wp], max_new_tokens=4)
+        eng.drain()
+        eng.metrics.reset()
+        if eng.prefix_cache is not None:
+            # warmup admissions must not dilute the measured hit rate
+            eng.prefix_cache.reset_stats()
+        flops0 = cost_registry.snapshot().get(
+            "serving.prefill", {}).get("total_flops", 0)
+        submitted = 0
+        step = 0
+        t0 = time.perf_counter()
+        while submitted < num_requests or eng.scheduler.has_work() \
+                or eng._pending:
+            while submitted < num_requests \
+                    and arrivals[submitted] <= step:
+                i = submitted
+                p = (np.concatenate([system_prompt, suffixes[i]])
+                     if draws[i] < rate else uniques[i])
+                eng.add_request(p, max_new_tokens=max_new_tokens)
+                submitted += 1
+            eng.step()
+            step += 1
+        dt = time.perf_counter() - t0
+        snap = eng.metrics.snapshot()
+        flops = cost_registry.snapshot().get(
+            "serving.prefill", {}).get("total_flops", 0) - flops0
+        pc = eng.stats()["prefix_cache"]
+        return {
+            "wall_seconds": round(dt, 3),
+            "ttft_ms_p50": round(snap["ttft_ms"]["p50"], 2),
+            "ttft_ms_p95": round(snap["ttft_ms"]["p95"], 2),
+            "prefill_tokens": snap["prefill_tokens"],
+            "prefill_flops": int(flops),
+            "prefill_tokens_skipped": (pc.get("hit_tokens", 0)
+                                       if pc.get("enabled") else 0),
+            "hit_rate": round(pc.get("hit_rate", 0.0), 3)
+            if pc.get("enabled") else 0.0,
+            "cow_copies": pc.get("cow_copies", 0)
+            if pc.get("enabled") else 0,
+            "evictions": pc.get("evictions", 0)
+            if pc.get("enabled") else 0,
+        }
+
+    rates = {}
+    for rate, key in ((0.0, "rate00"), (0.5, "rate05"), (0.9, "rate09")):
+        rates[key] = run(rate, True)
+    off09 = run(0.9, False)
+    on09 = rates["rate09"]
+    speedup = (off09["ttft_ms_p95"] / on09["ttft_ms_p95"]
+               if on09["ttft_ms_p95"] > 0 else 0.0)
+    flops_red = (off09["prefill_flops"] / on09["prefill_flops"]
+                 if on09["prefill_flops"] > 0 else 0.0)
+    return {
+        "metric": "serving_prefix_ttft_p95_speedup_at_09",
+        "value": round(speedup, 2),
+        "unit": "x (cache off/on, 0.9 hit-rate workload)",
+        "detail": {
+            "num_requests": num_requests,
+            "max_new_tokens": max_new_tokens,
+            "system_prompt_tokens": sys_len,
+            "page_size": PAGE,
+            "rates": rates,
+            "baseline_off_rate09": off09,
+            "ttft_p95_speedup_x": round(speedup, 2),
+            "prefill_flops_reduction_x": round(flops_red, 2),
+            "model": {"hidden": HID, "layers": L, "heads": HEADS,
+                      "max_seq_len": SEQ},
+        },
+    }
+
+
 def _compile_section():
     """Per-program compile accounting for the serving run
     (``detail.compile``): compile count + compile ms + calls per
@@ -1374,6 +1496,19 @@ def main():
         except Exception as e:  # noqa: BLE001 — rider workload, never fatal
             sys.stderr.write(
                 f"serving resilience bench failed after retries "
+                f"({type(e).__name__}: {e})\n")
+        try:
+            # shared-system-prompt prefix cache: TTFT/FLOPs vs hit rate
+            result.setdefault("detail", {})["prefix_cache"] = \
+                _with_retries(
+                    "serving_prefix_cache",
+                    lambda: bench_serving_prefix_cache(
+                        int(os.environ.get("BENCH_PREFIX_REQUESTS",
+                                           "16")),
+                        int(os.environ.get("BENCH_PREFIX_TOKENS", "8"))))
+        except Exception as e:  # noqa: BLE001 — rider workload, never fatal
+            sys.stderr.write(
+                f"serving prefix-cache bench failed after retries "
                 f"({type(e).__name__}: {e})\n")
         # whole-run compile accounting LAST: every serving workload
         # above has already attributed its compiles to the registry

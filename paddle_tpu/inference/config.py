@@ -65,7 +65,8 @@ class Config:
                        sync_mode=False, fused_steps=1,
                        kv_cache_dtype=None, weight_dtype=None,
                        replicas=1, queue_cap=64, default_deadline_ms=None,
-                       snapshot_interval=16, watchdog=None, brownout=None):
+                       snapshot_interval=16, watchdog=None, brownout=None,
+                       prefix_cache=False):
         """Opt in to the continuous-batching serving engine
         (docs/SERVING.md).  Stores the paged-KV / scheduler knobs plus the
         pipelining knobs (``prefill_chunk`` tokens per prefill program,
@@ -94,6 +95,15 @@ class Config:
         escalation; ``brownout=True`` (or a BrownoutPolicy) enables
         staged overload degradation (shed → clamp → reject).
 
+        ``prefix_cache=True`` (docs/SERVING.md "Prefix caching") turns
+        on the radix prefix index with refcounted copy-on-write page
+        sharing: prompts sharing a resident full-page prefix (system
+        prompts, few-shot templates, multi-turn history) skip straight
+        to the first uncached token at prefill.  Requires native or
+        int8_static KV (int8_dynamic engines bypass the index — the
+        documented scale contract); per-request opt-out via
+        ``submit(prefix_cache=False)``.
+
         Not reference API — the reference's serving story stops at
         AnalysisPredictor; this is the TPU-native extension."""
         self._serving = {
@@ -107,6 +117,7 @@ class Config:
             "fused_steps": int(fused_steps),
             "kv_cache_dtype": kv_cache_dtype,
             "weight_dtype": weight_dtype,
+            "prefix_cache": bool(prefix_cache),
         }
         self._serving_frontend = {
             "replicas": int(replicas),

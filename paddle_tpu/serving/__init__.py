@@ -18,6 +18,11 @@ Components
                                 and a dispatch-ahead decode loop over
                                 the paged GPT step (``sync_mode=True``
                                 restores the synchronous behavior)
+- ``prefix_cache.PrefixCache``  radix index over resident KV pages:
+                                refcounted copy-on-write page sharing —
+                                shared-prefix prompts skip straight to
+                                the first uncached token at prefill
+                                (docs/SERVING.md "Prefix caching")
 - ``metrics.ServingMetrics``    per-step engine observability
 - ``metrics.FrontendMetrics``   per-request frontend observability
 - ``frontend.ServingFrontend``  thread-safe streaming front door:
@@ -62,14 +67,15 @@ from .frontend import (ResponseHandle, ServingFrontend,
 from .http import ServingHTTPServer, start_http_server
 from .kv_cache import PagedKVCache
 from .metrics import FrontendMetrics, ServingMetrics
+from .prefix_cache import PrefixCache
 from .resilience import (BrownoutController, BrownoutPolicy,
                          EngineSnapshot, Watchdog, WatchdogConfig)
 from .router import Replica, Router
 from .scheduler import Request, Scheduler, Sequence
 
 __all__ = ["ServingEngine", "create_serving_engine", "PagedKVCache",
-           "ServingMetrics", "FrontendMetrics", "Request", "Scheduler",
-           "Sequence", "ServingFrontend", "ResponseHandle",
+           "PrefixCache", "ServingMetrics", "FrontendMetrics", "Request",
+           "Scheduler", "Sequence", "ServingFrontend", "ResponseHandle",
            "create_serving_frontend", "Router", "Replica",
            "ServingHTTPServer", "start_http_server", "EngineSnapshot",
            "Watchdog", "WatchdogConfig", "BrownoutPolicy",

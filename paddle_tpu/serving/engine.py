@@ -226,6 +226,25 @@ def _shared_programs(model, *, page_size: int, pages_per_seq: int,
                                         _page_gather)
     progs["page_put"] = profiled_jit("serving.page_restore",
                                      _page_put, donate_argnums=(0,))
+
+    # --- prefix cache: copy-on-write page copy (ISSUE 10) ------------
+    # device-to-device: one page's payload (every layer/side, scale
+    # rows included) duplicated from src to dst without a host round
+    # trip — the write half of COW divergence.  src/dst are () int32
+    # device scalars, so the trace is shape-stable (compiles once).
+    def _page_cow(kv, src, dst):
+        out = dict(kv)
+        for side in ("k", "v"):
+            out[side] = [p.at[dst].set(p[src]) for p in kv[side]]
+        if "k_scale" in kv:
+            out["k_scale"] = [s.at[dst].set(s[src])
+                              for s in kv["k_scale"]]
+            out["v_scale"] = [s.at[dst].set(s[src])
+                              for s in kv["v_scale"]]
+        return out
+
+    progs["page_cow"] = profiled_jit("serving.page_cow", _page_cow,
+                                     donate_argnums=(0,))
     with _PROGRAM_LOCK:
         # a racing duplicate build is harmless — first one in wins
         return per_model.setdefault(key, progs)
@@ -261,6 +280,7 @@ class ServingEngine:
                  kv_cache_dtype: Optional[str] = None,
                  weight_dtype: Optional[str] = None,
                  quant_scales: Optional[dict] = None,
+                 prefix_cache: bool = False,
                  token_callback: Optional[Callable[[str, int, int],
                                                    None]] = None):
         self.model = model
@@ -350,6 +370,35 @@ class ServingEngine:
         self._scale_reset_jit = progs["scale_reset"]
         self._page_gather_jit = progs["page_gather"]
         self._page_put_jit = progs["page_put"]
+        self._page_cow_jit = progs["page_cow"]
+
+        # --- prefix cache (docs/SERVING.md "Prefix caching") -----------
+        # opt-in radix index over resident full prompt/output pages:
+        # admission maps hits into the page table and the chunked
+        # prefill starts at the first uncached token.  int8_dynamic
+        # BYPASSES the index (documented scale contract: dynamic
+        # per-page scale growth under a reader would requantize the
+        # shared content under every other reader) — requests run
+        # uncached, exactly as with the knob off.
+        if not isinstance(prefix_cache, bool):
+            # truthy configs must not silently become defaults (the
+            # watchdog=/brownout= validation discipline)
+            raise InvalidArgumentError(
+                f"prefix_cache must be a bool, got {prefix_cache!r}")
+        self.prefix_cache = None
+        self._prefix_bypass_reason = None
+        if prefix_cache:
+            if self._kv_dynamic:
+                self._prefix_bypass_reason = (
+                    "int8_dynamic KV: per-page scales are device state "
+                    "grown by the writer — shared pages require "
+                    "int8_static or native KV (docs/SERVING.md)")
+            else:
+                from .prefix_cache import PrefixCache
+
+                self.prefix_cache = PrefixCache(self.cache,
+                                                metrics=self.metrics)
+                self.scheduler.prefix_cache = self.prefix_cache
         # chaos-injection key for the "engine.step" site (the frontend
         # sets this to the owning replica's id so fault schedules count
         # per replica instead of racing across pump threads)
@@ -409,16 +458,24 @@ class ServingEngine:
 
     def add_request(self, prompt, max_new_tokens: int = 32,
                     request_id: Optional[str] = None,
-                    deadline: Optional[float] = None) -> str:
+                    deadline: Optional[float] = None,
+                    prefix_cache: bool = True) -> str:
         """Enqueue a generation request; returns its id.  Non-blocking —
         admission happens inside step() when a slot and pages are free.
         ``deadline`` is an ABSOLUTE ``time.monotonic()`` instant: once
         passed, the request is dropped from the queue (never admitted)
         or aborted mid-decode with its pages freed; either way its id
-        surfaces through ``take_expired()``."""
+        surfaces through ``take_expired()``.  ``prefix_cache=False``
+        opts this request out of the engine's prefix cache (no index
+        lookup, its pages are never sealed for other requests); a no-op
+        when the engine has none."""
         prompt = self.check_request(prompt, max_new_tokens)
+        if not isinstance(prefix_cache, bool):
+            raise InvalidArgumentError(
+                f"prefix_cache must be a bool, got {prefix_cache!r}")
         req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
-                      request_id=request_id or "", deadline=deadline)
+                      request_id=request_id or "", deadline=deadline,
+                      use_prefix_cache=prefix_cache)
         self._check_not_live(req.request_id)
         self.scheduler.add(req)
         return req.request_id
@@ -758,22 +815,31 @@ class ServingEngine:
         """Teacher-force prompt[:-1] through the paged cache in parallel
         chunks of up to ``prefill_chunk`` positions — O(P/C) dispatches.
         Padded tail positions scatter into the trash page (valid_len
-        mask), so chunk shapes are pow2 buckets shared across prompts."""
+        mask), so chunk shapes are pow2 buckets shared across prompts.
+
+        Prefix-cache skip: positions below ``seq.cached_tokens`` already
+        sit in shared index pages mapped at admission — prefill starts
+        at the first uncached token (the ``valid_len`` machinery handles
+        the ragged start; positions are absolute, so the chunk queries
+        attend over the shared pages like any previously-written ones).
+        A fully-covered prompt dispatches NOTHING."""
         prompt = seq.request.prompt
         n = prompt.size - 1
-        if n == 0:
+        start = min(seq.cached_tokens, n)
+        if n - start == 0:
             return
-        spans = chunk_schedule(n, self.prefill_chunk)
+        spans = chunk_schedule(n - start, self.prefill_chunk)
         row = jax.device_put(self.cache.page_table_row(seq.seq_id))
         n_dev = jax.device_put(np.int32(n))
         t0 = time.perf_counter()
         with RecordEvent("serving/prefill", chunks=len(spans),
                          prompt_len=int(prompt.size)):
-            for start, size in spans:
+            for off, size in spans:
+                s0 = start + off
                 ctok = np.zeros((size,), np.int32)
-                valid = min(start + size, n) - start
-                ctok[:valid] = prompt[start:start + valid]
-                cpos = (start + np.arange(size)).astype(np.int32)
+                valid = min(s0 + size, n) - s0
+                ctok[:valid] = prompt[s0:s0 + valid]
+                cpos = (s0 + np.arange(size)).astype(np.int32)
                 with RecordEvent("serving/prefill_chunk", size=size):
                     self._kv = self._prefill_jit(
                         jax.device_put(ctok), jax.device_put(cpos),
@@ -784,7 +850,41 @@ class ServingEngine:
             jax.block_until_ready(self._kv)
         dt = time.perf_counter() - t0
         self.metrics.on_prefill(dt)
-        self.metrics.on_prefill_chunks(len(spans), n, dt)
+        self.metrics.on_prefill_chunks(len(spans), n - start, dt)
+
+    # --- prefix cache (docs/SERVING.md "Prefix caching") ------------------
+    def _apply_cow(self, seq: Sequence):
+        """Perform the device half of a copy-on-write admission: the
+        scheduler already swapped the shared page for a fresh one in the
+        host table; duplicate the payload src -> dst on device
+        (``serving.page_cow`` — no host round trip) so the sequence's
+        decode writes diverge privately."""
+        src, dst = seq.cow_pair
+        self._kv = self._page_cow_jit(self._kv,
+                                      jax.device_put(np.int32(src)),
+                                      jax.device_put(np.int32(dst)))
+        self.prefix_cache.on_cow()
+
+    def _seal_prefix(self, seq: Sequence, upto_pos: int):
+        """Publish ``seq``'s full pages covering positions
+        ``[0, upto_pos)`` into the prefix index, keyed by the token ids
+        that produced them (prompt + generated).  Only pages the
+        sequence will NEVER write again are sealable: callers pass the
+        first position any future write of this sequence can touch.
+        Pure host work — steady decode stays transfer-guard-clean."""
+        pc = self.prefix_cache
+        req = seq.request
+        if pc is None or req.resume is not None \
+                or not req.use_prefix_cache:
+            return
+        full = upto_pos // self.page_size
+        if full <= 0:
+            return
+        tokens = req.prompt
+        if full * self.page_size > tokens.size:
+            tokens = np.concatenate(
+                [tokens, np.asarray(seq.generated, np.int32)])
+        pc.insert(tokens, self.cache.seq_page_ids(seq.seq_id), full)
 
     # --- pipelined decode -------------------------------------------------
     def _remaining(self, seq: Sequence) -> int:
@@ -873,6 +973,12 @@ class ServingEngine:
     def _retire(self, seq: Sequence, lane: int):
         """EOS / budget retirement: final — the id never reappears."""
         self.outputs[seq.seq_id] = np.asarray(seq.generated, np.int32)
+        # seal BEFORE finish: the full pages this request wrote (prompt
+        # AND generated tokens) stay resident in the prefix index after
+        # its references drop — a completed request is the donor the
+        # next shared-prefix arrival hits
+        self._seal_prefix(seq, seq.request.prompt.size - 1
+                          + seq.num_generated)
         self.scheduler.finish(seq)
         seq.done = True
         self._ttft_recorded.discard(seq.seq_id)
@@ -931,13 +1037,22 @@ class ServingEngine:
             admitted = sched.admit()
             for seq in admitted:
                 # freshly allocated pages must quantize from scratch
-                # (dynamic int8 mode; no-op otherwise)
+                # (dynamic int8 mode; no-op otherwise — and dynamic
+                # mode bypasses the prefix cache, so no shared page can
+                # ever be scale-reset here)
                 self._reset_page_scales(self.cache.seq_page_ids(seq.seq_id))
                 if seq.request.resume is not None:
                     # warm-failover resume: upload checkpoint pages
                     # instead of prefilling — decode continues mid-stream
                     self._upload_snapshot(seq)
                 else:
+                    # hit/miss accounting and the sealing of prompt
+                    # pages happened inside Scheduler.admit (host-side,
+                    # so intra-batch sharing works); the device halves
+                    # — the COW page copy and the suffix prefill — run
+                    # here in admission order
+                    if seq.cow_pair is not None:
+                        self._apply_cow(seq)
                     self._prefill_seq(seq)
                 self._bind_lane(seq)
             self.metrics.on_admission(len(admitted))
@@ -1056,6 +1171,11 @@ class ServingEngine:
                 "in_flight": len(self._pending),
                 "state_bucket": self._state_bucket,
             },
+            "prefix_cache": (
+                self.prefix_cache.stats()
+                if self.prefix_cache is not None else
+                {"enabled": False,
+                 "bypass_reason": self._prefix_bypass_reason}),
             "quant": {
                 "kv_cache_dtype": self.kv_cache_dtype or "native",
                 "weight_dtype": self.weight_dtype or "native",

@@ -352,7 +352,7 @@ class _Entry:
     __slots__ = ("handle", "prompt", "max_new_tokens", "cost", "replica",
                  "in_engine", "cancel_requested", "shed_requested",
                  "snapshot", "snap_tokens", "recover_started",
-                 "tokens_at_failover")
+                 "tokens_at_failover", "use_prefix_cache")
 
     def __init__(self, handle: ResponseHandle, prompt: np.ndarray,
                  max_new_tokens: int, replica: Replica):
@@ -373,6 +373,9 @@ class _Entry:
         # survivor delivers the first NEW token
         self.recover_started: Optional[float] = None
         self.tokens_at_failover = 0
+        # per-request prefix-cache opt-out (submit(prefix_cache=False));
+        # rides through failover — the opt-out holds on the survivor too
+        self.use_prefix_cache = True
 
 
 class ServingFrontend:
@@ -397,7 +400,8 @@ class ServingFrontend:
                  brownout=None,
                  placement_attempts: int = 4,
                  placement_backoff_s: float = 0.02,
-                 snapshot_store=None):
+                 snapshot_store=None,
+                 prefix_cache: Optional[bool] = None):
         """Resilience knobs (docs/SERVING.md "Resilience"):
 
         - ``snapshot_interval``: checkpoint each in-flight request every
@@ -417,6 +421,11 @@ class ServingFrontend:
         - ``placement_attempts`` / ``placement_backoff_s``: bounded
           retry-with-backoff for transient no-routable-replica
           placement failures (router.pick_with_retry).
+        - ``prefix_cache``: opt-in radix prefix cache on every replica
+          engine (docs/SERVING.md "Prefix caching") — shared-prefix
+          prompts skip straight to the first uncached token.  None
+          leaves the engines' own default (off); per-request opt-out
+          via ``submit(prefix_cache=False)``.
         """
         if model is None and engine_factory is None:
             raise InvalidArgumentError(
@@ -426,6 +435,17 @@ class ServingFrontend:
                 "engine_kwargs and engine_factory are mutually "
                 "exclusive — the factory owns engine construction, so "
                 "the kwargs would be silently ignored")
+        if prefix_cache is not None and not isinstance(prefix_cache, bool):
+            # same discipline as watchdog=/brownout=: a truthy config
+            # object must not silently become the default
+            raise InvalidArgumentError(
+                f"prefix_cache must be None or a bool, "
+                f"got {prefix_cache!r}")
+        if engine_factory is not None and prefix_cache is not None:
+            raise InvalidArgumentError(
+                "prefix_cache is an engine knob — a custom "
+                "engine_factory owns engine construction, so pass "
+                "ServingEngine(prefix_cache=...) inside the factory")
         if replicas < 1:
             raise InvalidArgumentError("replicas must be >= 1")
         self.metrics = metrics or FrontendMetrics()
@@ -440,6 +460,8 @@ class ServingFrontend:
         if user_factory is None:
             ekw = dict(engine_kwargs or {})
             ekw.setdefault("metrics", self.engine_metrics)
+            if prefix_cache is not None:
+                ekw["prefix_cache"] = prefix_cache
 
             def engine_factory():
                 return ServingEngine(model, **ekw)
@@ -457,6 +479,18 @@ class ServingFrontend:
                                   else max(1, int(snapshot_interval)))
         self._snapshot_store = None
         if snapshot_store is not None:
+            if self.snapshot_interval is None:
+                # disk persistence rides on the periodic warm-failover
+                # checkpoints: with the interval disabled nothing would
+                # ever be written and recover_pending() after a crash
+                # would silently find an empty store — refuse loudly
+                # (the knob-validation discipline: a truthy config must
+                # not silently do nothing)
+                raise InvalidArgumentError(
+                    "snapshot_store requires snapshot_interval (disk "
+                    "persistence piggybacks on the periodic request "
+                    "checkpoints; with snapshot_interval=None no slot "
+                    "would ever be written)")
             from ..io.checkpoint import CheckpointStore
 
             self._snapshot_store = (
@@ -519,7 +553,8 @@ class ServingFrontend:
     # --- submission ---------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32,
                deadline_ms: Optional[float] = None, stream: bool = True,
-               request_id: Optional[str] = None) -> ResponseHandle:
+               request_id: Optional[str] = None,
+               prefix_cache: bool = True) -> ResponseHandle:
         """Submit one generation request; returns immediately with a
         ResponseHandle (possibly already terminal: ``rejected`` on
         overload / no healthy replica, ``deadline_miss`` on an
@@ -527,8 +562,14 @@ class ServingFrontend:
         that could never run (empty prompt, budget beyond the engine's
         ``max_seq_len``).  ``stream`` is advisory — tokens are always
         delivered to the handle; it exists so callers (the HTTP layer)
-        can record the client's intent."""
+        can record the client's intent.  ``prefix_cache=False`` opts
+        THIS request out of the fleet's prefix cache (no lookup, and its
+        pages are never sealed for other requests) — a no-op when the
+        engines run without one."""
         del stream  # tokens always stream into the handle
+        if not isinstance(prefix_cache, bool):
+            raise InvalidArgumentError(
+                f"prefix_cache must be a bool, got {prefix_cache!r}")
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
         deadline = (None if deadline_ms is None
@@ -584,7 +625,8 @@ class ServingFrontend:
                 return handle
             rep = self.router.pick(cost=cost)
             if rep is not None:
-                self._place_locked(handle, prompt, max_new_tokens, rep)
+                self._place_locked(handle, prompt, max_new_tokens, rep,
+                                   use_prefix_cache=prefix_cache)
                 if stage >= BROWNOUT_SHED:
                     self._shed_lowest_slack_locked(
                         exclude=handle.request_id)
@@ -630,14 +672,17 @@ class ServingFrontend:
                 return self._reject_locked(
                     handle,
                     f"queue_cap {self.queue_cap} live requests reached")
-            self._place_locked(handle, prompt, max_new_tokens, rep)
+            self._place_locked(handle, prompt, max_new_tokens, rep,
+                               use_prefix_cache=prefix_cache)
             if stage >= BROWNOUT_SHED:
                 self._shed_lowest_slack_locked(exclude=handle.request_id)
         return handle
 
     def _place_locked(self, handle: ResponseHandle, prompt: np.ndarray,
-                      max_new_tokens: int, rep: Replica):
+                      max_new_tokens: int, rep: Replica,
+                      use_prefix_cache: bool = True):
         entry = _Entry(handle, prompt, max_new_tokens, rep)
+        entry.use_prefix_cache = use_prefix_cache
         self._live[handle.request_id] = entry
         self.router.charge(rep, entry.cost)
         rep.inbox.append(entry)
@@ -973,10 +1018,11 @@ class ServingFrontend:
                         entry.snapshot.deadline = h.deadline
                         eng.restore(entry.snapshot)
                     else:
-                        eng.add_request(entry.prompt,
-                                        entry.max_new_tokens,
-                                        request_id=h.request_id,
-                                        deadline=h.deadline)
+                        eng.add_request(
+                            entry.prompt, entry.max_new_tokens,
+                            request_id=h.request_id,
+                            deadline=h.deadline,
+                            prefix_cache=entry.use_prefix_cache)
                     with self._lock:
                         entry.in_engine = True
                 except ValueError as e:
@@ -1223,7 +1269,7 @@ def create_serving_frontend(model, config=None, **overrides
                 "engine_factory", "metrics", "poll_interval_s",
                 "snapshot_interval", "watchdog", "brownout",
                 "placement_attempts", "placement_backoff_s",
-                "snapshot_store"):
+                "snapshot_store", "prefix_cache"):
         if key in overrides:
             fe_kwargs[key] = overrides.pop(key)
     engine_kwargs.update(overrides)

@@ -20,6 +20,28 @@ fragmentation (allocated-but-unused tail slots), the only fragmentation
 kind paging admits — there is no external fragmentation to defrag, which
 is the point of fixed-size pages.
 
+Refcounted sharing + copy-on-write (the prefix cache, ISSUE 10)
+---------------------------------------------------------------
+Pages carry a REFERENCE COUNT — the number of sequence page tables that
+contain them.  ``share()`` maps already-resident pages (located by the
+``serving.prefix_cache`` radix index) into a new sequence's table head
+and increfs them; ``free()`` DECREFS instead of unconditionally
+releasing, so a page shared by several sequences returns to the free
+list only when the last reference drops.  Pages the prefix index holds
+(``pin_cached``) additionally stay RESIDENT at refcount 0 — evictable,
+not free: ``allocate`` reclaims them through the registered
+``reclaimer`` (the index's LRU eviction) only when the free list runs
+short, so cached prefixes survive exactly as long as memory allows.
+``cow_page`` is the copy-on-write step: when a sequence must write into
+a shared page (its first decode position falls inside the matched
+prefix), the HOST side swaps in a freshly allocated page here and the
+ENGINE device-copies the payload (``serving.page_cow``) — the shared
+original is never mutated.  Accounting counts a shared page EXACTLY
+ONCE: ``pages_in_use`` is the number of distinct referenced pages (not
+the sum of table lengths), ``pages_cached`` the refcount-0 resident
+set, and ``pages_in_use + pages_cached + free_pages == num_pages - 1``
+always holds (the leak invariant tests pin).
+
 Quantized page layout (the int8 serving path)
 ---------------------------------------------
 With ``kv_cache_dtype="int8"`` the device pools store each [P, H, D]
@@ -35,7 +57,7 @@ pin all three to each other.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -112,8 +134,21 @@ class PagedKVCache:
         # LIFO free list; page 0 excluded (trash page)
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
         self._tables: Dict[str, List[int]] = {}
+        # page id -> number of sequence tables containing it (absent =
+        # not referenced); a page appears in pages_in_use ONCE however
+        # many sequences share it
+        self._ref: Dict[int, int] = {}
+        # page ids the prefix index holds resident: at refcount 0 they
+        # are EVICTABLE (reclaimed via the reclaimer hook), never free
+        self._cached: set = set()
+        # opt-in hook (the prefix cache's LRU eviction): called with the
+        # page deficit when the free list cannot cover an allocation;
+        # returns how many pages it released back to the free list
+        self._reclaimer: Optional[Callable[[int], int]] = None
         self.total_allocs = 0
         self.total_frees = 0
+        self.total_shared_maps = 0
+        self.total_cow = 0
         self.peak_pages_in_use = 0
 
     # --- capacity ---------------------------------------------------------
@@ -127,7 +162,18 @@ class PagedKVCache:
 
     @property
     def pages_in_use(self) -> int:
-        return (self.num_pages - 1) - len(self._free)
+        """Distinct pages referenced by >= 1 sequence — a page shared by
+        N sequences counts ONCE (the leak-accounting contract)."""
+        return len(self._ref)
+
+    @property
+    def pages_cached(self) -> int:
+        """Resident refcount-0 pages held only by the prefix index
+        (evictable on demand — neither leaked nor free)."""
+        return sum(1 for p in self._cached if p not in self._ref)
+
+    def ref_count(self, page_id: int) -> int:
+        return self._ref.get(int(page_id), 0)
 
     def num_seqs(self) -> int:
         return len(self._tables)
@@ -159,26 +205,132 @@ class PagedKVCache:
         if have + need > self.pages_per_seq:
             return False
         if need > len(self._free):
+            self._reclaim(need - len(self._free))
+        if need > len(self._free):
             # no phantom registration on failure: a rejected first
             # allocation must leave no trace in num_seqs()/stats()
             return False
         if table is None:
             table = self._tables[seq_id] = []
         for _ in range(need):
-            table.append(self._free.pop())
+            page = self._free.pop()
+            table.append(page)
+            self._ref[page] = 1
         self.total_allocs += need
         self.peak_pages_in_use = max(self.peak_pages_in_use,
                                      self.pages_in_use)
         return True
 
+    def _reclaim(self, deficit: int):
+        """Ask the prefix index (if attached) to evict refcount-0 cached
+        pages back to the free list — cached prefixes yield to live
+        sequences before allocation fails or preemption strikes."""
+        if self._reclaimer is not None and deficit > 0:
+            self._reclaimer(deficit)
+
+    def set_reclaimer(self, fn: Optional[Callable[[int], int]]):
+        """Register the cached-page eviction hook (one owner at a time —
+        the prefix cache attaches itself here)."""
+        self._reclaimer = fn
+
+    def _release_ref(self, page: int):
+        """Drop one reference; a page reaching refcount 0 returns to the
+        free list UNLESS the prefix index holds it resident."""
+        n = self._ref.get(page, 0) - 1
+        if n > 0:
+            self._ref[page] = n
+            return
+        self._ref.pop(page, None)
+        if page not in self._cached:
+            self._free.append(page)
+
     def free(self, seq_id: str) -> int:
-        """Release all of ``seq_id``'s pages; returns the count."""
+        """Drop all of ``seq_id``'s page references; returns the table
+        length.  Shared pages only DECREF (another reader, or the prefix
+        index, may keep them resident) — premature free of a shared page
+        is structurally impossible here."""
         table = self._tables.pop(seq_id, None)
         if not table:
             return 0
-        self._free.extend(reversed(table))
+        for page in reversed(table):
+            self._release_ref(page)
         self.total_frees += len(table)
         return len(table)
+
+    # --- prefix sharing / copy-on-write ------------------------------------
+    def share(self, seq_id: str, page_ids: List[int]) -> bool:
+        """Map already-resident ``page_ids`` (a radix-index prefix match)
+        as the HEAD of a new sequence's page table, increffing each.
+        Must run before the sequence's first ``allocate`` (prefix pages
+        cover positions [0, len*page_size)).  Returns False untouched
+        when the sequence already has a table or the prefix alone would
+        exceed ``pages_per_seq``."""
+        if not page_ids:
+            return True
+        if seq_id in self._tables or len(page_ids) > self.pages_per_seq:
+            return False
+        for page in page_ids:
+            if not (0 < page < self.num_pages):
+                raise InvalidArgumentError(
+                    f"shared page id {page} out of range (1.."
+                    f"{self.num_pages - 1})")
+        self._tables[seq_id] = list(int(p) for p in page_ids)
+        for page in self._tables[seq_id]:
+            self._ref[page] = self._ref.get(page, 0) + 1
+        self.total_shared_maps += len(page_ids)
+        self.peak_pages_in_use = max(self.peak_pages_in_use,
+                                     self.pages_in_use)
+        return True
+
+    def cow_page(self, seq_id: str,
+                 table_index: int) -> Optional[Tuple[int, int]]:
+        """Copy-on-write (host half): replace the SHARED page at
+        ``table_index`` of ``seq_id``'s table with a freshly allocated
+        private page, decreffing the original.  Returns ``(src, dst)``
+        page ids for the engine's ``serving.page_cow`` device copy, or
+        None (state untouched — the caller DEFERS the admission) when
+        the pool cannot supply a page.
+
+        Chaos: routes through the ``kv.allocate`` site like every other
+        page allocation — a ``deny`` fault defers the COW exactly like
+        transient exhaustion and can never corrupt the shared page."""
+        fault = chaos_site("kv.allocate", key=seq_id)
+        if fault is not None and fault.action == "deny":
+            return None
+        table = self._tables.get(seq_id)
+        if table is None or not (0 <= table_index < len(table)):
+            raise InvalidArgumentError(
+                f"cow_page: sequence {seq_id!r} has no page at table "
+                f"index {table_index}")
+        if not self._free:
+            self._reclaim(1)
+        if not self._free:
+            return None
+        src = table[table_index]
+        dst = self._free.pop()
+        table[table_index] = dst
+        self._ref[dst] = 1
+        self._release_ref(src)
+        self.total_allocs += 1
+        self.total_cow += 1
+        self.peak_pages_in_use = max(self.peak_pages_in_use,
+                                     self.pages_in_use)
+        return src, dst
+
+    # --- prefix-index residency (called by serving.prefix_cache) ----------
+    def pin_cached(self, page_id: int):
+        """The prefix index took custody of ``page_id``: keep it
+        resident (evictable, not free) when its refcount drops to 0."""
+        self._cached.add(int(page_id))
+
+    def release_cached(self, page_id: int):
+        """The prefix index evicted ``page_id``: a refcount-0 page
+        returns to the free list; a still-referenced one just loses its
+        index residency (it frees normally when the readers finish)."""
+        page_id = int(page_id)
+        self._cached.discard(page_id)
+        if page_id not in self._ref:
+            self._free.append(page_id)
 
     # --- page-table export ------------------------------------------------
     def seq_page_ids(self, seq_id: str) -> List[int]:
@@ -200,10 +352,13 @@ class PagedKVCache:
             "num_pages": self.num_pages - 1,      # allocatable (sans trash)
             "page_size": self.page_size,
             "pages_in_use": self.pages_in_use,
+            "pages_cached": self.pages_cached,
             "pages_free": self.free_pages,
             "num_seqs": self.num_seqs(),
             "total_allocs": self.total_allocs,
             "total_frees": self.total_frees,
+            "total_shared_maps": self.total_shared_maps,
+            "total_cow": self.total_cow,
             "peak_pages_in_use": self.peak_pages_in_use,
             "utilization": self.pages_in_use / max(self.num_pages - 1, 1),
         }

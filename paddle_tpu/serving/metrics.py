@@ -45,14 +45,23 @@ class ServingMetrics:
     GAUGES = ("serving.queue_depth", "serving.running_seqs",
               "serving.kv_pages_in_use", "serving.batch_bucket",
               "serving.kv_cache_bytes", "serving.batch_occupancy",
-              "serving.snapshot_bytes", "serving.brownout_stage")
+              "serving.snapshot_bytes", "serving.brownout_stage",
+              # prefix cache (ISSUE 10): tokens' worth of KV the radix
+              # index can currently serve (resident sealed pages)
+              "serving.prefix.cached_tokens")
     COUNTERS = ("serving.steps", "serving.tokens_generated",
                 "serving.requests_admitted", "serving.requests_completed",
                 "serving.preemptions", "serving.prefill_chunks",
                 "serving.prefill_tokens", "serving.aborts",
                 "serving.deadline_miss", "serving.snapshots",
                 "serving.restores", "serving.watchdog_trips",
-                "serving.retries_backoff")
+                "serving.retries_backoff",
+                # prefix cache (ISSUE 10): per-admission hit/miss, the
+                # prefill tokens the hits skipped, LRU page evictions,
+                # and copy-on-write page copies on divergence
+                "serving.prefix.hits", "serving.prefix.misses",
+                "serving.prefix.hit_tokens", "serving.prefix.evictions",
+                "serving.prefix.cow")
     HISTOGRAMS = ("serving.step_latency_ms", "serving.prefill_latency_ms",
                   "serving.decode_latency_ms", "serving.ttft_ms",
                   "serving.dispatch_gap_ms",
@@ -135,6 +144,31 @@ class ServingMetrics:
         warm-failover headline)."""
         stat_registry.histogram("serving.failover_recovery_ms").observe(
             seconds * 1e3)
+
+    # --- prefix cache hooks (docs/SERVING.md "Prefix caching") -------------
+    def on_prefix_hit(self, tokens: int):
+        """One eligible admission matched a resident prefix: ``tokens``
+        prompt positions were mapped from the index instead of
+        prefilled."""
+        stat_registry.get("serving.prefix.hits").add(1)
+        if tokens > 0:
+            stat_registry.get("serving.prefix.hit_tokens").add(int(tokens))
+
+    def on_prefix_miss(self, n: int = 1):
+        stat_registry.get("serving.prefix.misses").add(n)
+
+    def on_prefix_evict(self, n: int = 1):
+        """Refcount-0 cached pages reclaimed (LRU, leaf-first) to cover
+        a live allocation."""
+        stat_registry.get("serving.prefix.evictions").add(n)
+
+    def on_prefix_cow(self, n: int = 1):
+        """Copy-on-write page copies: a sequence diverged inside a
+        shared page and received a private device-side copy."""
+        stat_registry.get("serving.prefix.cow").add(n)
+
+    def set_prefix_cached_tokens(self, tokens: int):
+        stat_registry.get("serving.prefix.cached_tokens").set(int(tokens))
 
     def on_prefill(self, seconds: float):
         stat_registry.histogram("serving.prefill_latency_ms").observe(
@@ -230,6 +264,10 @@ class ServingMetrics:
                       "retries_backoff", "brownout_stage",
                       "snapshot_bytes"):
             snap[short] = stat_registry.get(f"serving.{short}").get()
+        snap["prefix"] = {
+            short: stat_registry.get(f"serving.prefix.{short}").get()
+            for short in ("hits", "misses", "hit_tokens", "evictions",
+                          "cow", "cached_tokens")}
         for name in self.HISTOGRAMS:
             h = stat_registry.histogram(name).snapshot()
             key = name[len("serving."):]

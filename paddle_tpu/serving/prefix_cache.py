@@ -1,0 +1,265 @@
+"""Prefix cache: radix index + refcounted copy-on-write page sharing.
+
+Serving millions of users means massive shared prefixes — system
+prompts, few-shot templates, multi-turn history — and every bench since
+r03 reports ``binding_wall=hbm``: re-prefilling tokens whose KV already
+sits in HBM is pure wasted bandwidth and FLOPs.  The block-paged KV
+cache is exactly the substrate for sharing them (the Ragged Paged
+Attention flexible-page regime): this module adds the HOST-side index
+that finds resident pages by token content, while the Pallas kernels
+stay untouched — a sequence's page table simply starts with somebody
+else's pages.
+
+How the pieces fit (docs/SERVING.md "Prefix caching"):
+
+- **Radix index** (this module): a trie keyed on PAGE-GRANULARITY
+  token-id chunks — one node per full page of ``page_size`` token ids,
+  mapping the chunk chain to the resident physical page that holds that
+  prefix's KV.  Only FULL pages are indexed (a partial page is still
+  being written by its owner), so a hit is always immutable content.
+- **Refcounts** (``kv_cache.PagedKVCache``): ``match`` + ``share`` map
+  the hit pages into the new sequence's table head and incref them;
+  retirement/abort/preemption DECREF — a shared page is never freed
+  while any sequence references it, and ``stats()`` counts it exactly
+  once.
+- **Copy-on-write** (``cow_page`` + the engine's ``serving.page_cow``
+  jit): when the whole prompt is covered, the first decode write
+  (position P-1) lands inside the last matched page — the host swaps in
+  a fresh page, the engine device-copies the payload (no host round
+  trip), and the shared original is never mutated.
+- **Prefill skip** (``ServingEngine._prefill_seq``): admission starts
+  the chunked prefill at the first uncached token; the ``valid_len``
+  machinery already handles ragged starts, so the skipped tokens cost
+  zero dispatches and zero FLOPs.
+- **Eviction**: pages whose refcount is 0 stay RESIDENT in the index
+  (evictable, not free) and are reclaimed leaf-first in LRU order only
+  when an allocation would otherwise fail — cached prefixes always
+  yield to live sequences before any preemption fires.
+
+Sealing (who publishes pages): at ADMISSION a sequence seals every full
+prompt page strictly before the page its first decode write touches; at
+RETIREMENT it seals the remaining full pages, generated tokens included
+— a multi-turn follow-up whose prompt extends a finished conversation
+hits those pages too.  Greedy decode is deterministic, so a page's
+content is a pure function of the token ids keying it, and a cached
+stream is byte-identical to the uncached one (pinned across
+sync/pipelined/fused consume modes in tests/test_prefix_cache.py).
+
+Quantized-KV contract: shared pages require a scale that is not device
+state — ``native`` and ``int8_static`` (calibrated scales are engine
+config, identical for every reader) index normally; ``int8_dynamic``
+BYPASSES the index entirely (the engine never constructs one), because
+a reader-triggered per-page scale growth would requantize content under
+every other reader.  Failover: ``EngineSnapshot`` gathers shared pages
+like owned ones and ``restore`` re-admits them as private — a survivor
+never depends on the dead replica's index state.
+
+Threading: instances are owned by the engine's driving thread (the
+frontend pump) exactly like the scheduler — no locks, no device calls.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..framework.errors import InvalidArgumentError
+from .kv_cache import PagedKVCache
+
+__all__ = ["PrefixCache"]
+
+
+class _Node:
+    """One full-page chunk in the radix trie.
+
+    ``chunk`` is the page's token ids (the edge label from the parent),
+    ``page`` the resident physical page holding that prefix's KV.
+    Children extend the prefix by one more full page.  ``lru`` is a
+    monotonic touch stamp — eviction takes the smallest, leaf-first (an
+    interior node's page is still reachable through its children, so
+    evicting it would strand them unreachable-but-resident)."""
+
+    __slots__ = ("chunk", "page", "parent", "children", "lru")
+
+    def __init__(self, chunk: Tuple[int, ...], page: int,
+                 parent: Optional["_Node"]):
+        self.chunk = chunk
+        self.page = page
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.lru = 0
+
+
+class PrefixCache:
+    """Radix index over resident KV pages, keyed by token content.
+
+    Owned by one ``ServingEngine`` (page ids are pool-local); attaches
+    itself as the ``PagedKVCache`` reclaimer so allocation pressure
+    evicts cached pages before failing or preempting.  ``metrics`` is
+    the engine's ``ServingMetrics`` (the ``serving.prefix.*`` counters
+    and the ``serving.prefix.cached_tokens`` gauge)."""
+
+    def __init__(self, cache: PagedKVCache, metrics=None):
+        if cache.page_size < 1:
+            raise InvalidArgumentError("page_size must be >= 1")
+        self.cache = cache
+        self.page_size = cache.page_size
+        self.metrics = metrics
+        self._root = _Node((), 0, None)
+        self._by_page: Dict[int, _Node] = {}
+        self._clock = itertools.count(1)
+        # plain counters mirrored into the metrics registry (stats()
+        # works without a metrics object — host-only unit tests)
+        self.hits = 0
+        self.misses = 0
+        self.hit_tokens = 0
+        self.evictions = 0
+        self.cow_copies = 0
+        cache.set_reclaimer(self.evict)
+
+    # --- lookup -------------------------------------------------------------
+    def _chunks(self, tokens: np.ndarray, limit_pages: int):
+        toks = np.asarray(tokens).reshape(-1)
+        for j in range(min(int(len(toks)) // self.page_size, limit_pages)):
+            yield tuple(int(t) for t in
+                        toks[j * self.page_size:(j + 1) * self.page_size])
+
+    def match(self, prompt: np.ndarray) -> List[int]:
+        """Longest resident full-page prefix of ``prompt``: the physical
+        page ids covering its first ``len(result) * page_size`` tokens,
+        in position order.  Touches the matched chain's LRU stamps; does
+        NOT incref — the caller maps the pages via ``cache.share`` (the
+        same host step, so no eviction can interleave)."""
+        node = self._root
+        pages: List[int] = []
+        stamp = next(self._clock)
+        for chunk in self._chunks(prompt, self.cache.pages_per_seq):
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            child.lru = stamp
+            pages.append(child.page)
+            node = child
+        return pages
+
+    # --- publication --------------------------------------------------------
+    def insert(self, tokens: np.ndarray, page_ids: List[int],
+               full_pages: int) -> int:
+        """Seal ``page_ids[:full_pages]`` into the index under the chunk
+        chain of ``tokens`` — each page must hold the finished KV of its
+        full page of token ids and never be written again by its owner.
+        An existing node keeps its page (first publisher wins; the
+        duplicate page stays private and frees normally).  Returns how
+        many pages were newly indexed."""
+        node = self._root
+        added = 0
+        stamp = next(self._clock)
+        for j, chunk in enumerate(self._chunks(tokens, full_pages)):
+            child = node.children.get(chunk)
+            if child is None:
+                page = int(page_ids[j])
+                child = _Node(chunk, page, node)
+                node.children[chunk] = child
+                self._by_page[page] = child
+                self.cache.pin_cached(page)
+                added += 1
+            child.lru = stamp
+            node = child
+        if added:
+            self._publish_gauge()
+        return added
+
+    # --- eviction -----------------------------------------------------------
+    def evict(self, n_pages: int) -> int:
+        """Release up to ``n_pages`` refcount-0 cached pages back to the
+        allocator, leaf-first in LRU order (the PagedKVCache reclaimer
+        hook — runs only when the free list cannot cover an
+        allocation).  Pages still referenced by sequences are never
+        touched.  Returns the number released."""
+        released = 0
+        while released < n_pages:
+            # one scan per GENERATION: collect every currently-evictable
+            # leaf, evict them LRU-first up to the deficit, and rescan
+            # only if unwinding those leaves exposed new ones (a chain's
+            # parent becomes a leaf only after its child goes) — O(index)
+            # per generation instead of per released page, so a deep
+            # deficit under load cannot quadratically stall admission
+            leaves = [node for page, node in self._by_page.items()
+                      if not node.children
+                      and self.cache.ref_count(page) == 0]
+            if not leaves:
+                break
+            leaves.sort(key=lambda n: n.lru)
+            for victim in leaves[: n_pages - released]:
+                self._drop_node(victim)
+                released += 1
+                self.evictions += 1
+                if self.metrics is not None:
+                    self.metrics.on_prefix_evict()
+        if released:
+            self._publish_gauge()
+        return released
+
+    def _drop_node(self, node: _Node):
+        del self._by_page[node.page]
+        if node.parent is not None:
+            node.parent.children.pop(node.chunk, None)
+        self.cache.release_cached(node.page)
+
+    # --- accounting ---------------------------------------------------------
+    def on_admission(self, matched_tokens: int):
+        """Record one eligible admission's hit/miss outcome (called by
+        the engine after ``Scheduler.admit`` committed the mapping)."""
+        if matched_tokens > 0:
+            self.hits += 1
+            self.hit_tokens += matched_tokens
+            if self.metrics is not None:
+                self.metrics.on_prefix_hit(matched_tokens)
+        else:
+            self.misses += 1
+            if self.metrics is not None:
+                self.metrics.on_prefix_miss()
+
+    def on_cow(self):
+        self.cow_copies += 1
+        if self.metrics is not None:
+            self.metrics.on_prefix_cow()
+
+    def _publish_gauge(self):
+        if self.metrics is not None:
+            self.metrics.set_prefix_cached_tokens(self.cached_tokens)
+
+    @property
+    def num_pages(self) -> int:
+        return len(self._by_page)
+
+    @property
+    def cached_tokens(self) -> int:
+        """Tokens' worth of KV the index can currently serve."""
+        return len(self._by_page) * self.page_size
+
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def reset_stats(self):
+        """Zero the hit/miss/evict/cow counters — the INDEX keeps its
+        pages (benches reset after warmup so measured rates reflect the
+        timed window only).  The registry counters are owned by
+        ``ServingMetrics.reset`` like every other serving stat."""
+        self.hits = self.misses = self.hit_tokens = 0
+        self.evictions = self.cow_copies = 0
+
+    def stats(self) -> dict:
+        return {
+            "enabled": True,
+            "pages": self.num_pages,
+            "cached_tokens": self.cached_tokens,
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": self.hit_rate(),
+            "hit_tokens": self.hit_tokens,
+            "evictions": self.evictions,
+            "cow_copies": self.cow_copies,
+        }

@@ -71,6 +71,16 @@ class EngineSnapshot:
                        requantizes.  Equal within quantization noise;
                        byte-identity is NOT guaranteed in this mode
                        (use static scales when failover must be exact).
+
+    Prefix-cache interaction (ISSUE 10, pinned in
+    tests/test_prefix_cache.py): a sequence holding SHARED pages from
+    the donor's radix index snapshots them exactly like owned pages —
+    the gather walks the host page table, which does not distinguish —
+    and ``restore`` re-admits every page as PRIVATE (the resume
+    admission path never consults the survivor's index).  Failover
+    therefore never depends on the survivor having (or lacking) any
+    index state; the survivor's own prefix cache warms up from its own
+    traffic.
     """
 
     request_id: str

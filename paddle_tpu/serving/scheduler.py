@@ -66,6 +66,12 @@ class Request:
     # docs/SERVING.md "Resilience".  The scheduler reads only
     # .pos/.next_token/.generated/.kv_len; the payload stays opaque.
     resume: Optional[object] = field(default=None, repr=False)
+    # prefix-cache eligibility (docs/SERVING.md "Prefix caching"): the
+    # per-request OPT-OUT — False skips both the index lookup and the
+    # sealing of this request's pages (private data that must not be
+    # served to other requests).  Ignored when the engine has no prefix
+    # cache; resume requests always restore as private regardless.
+    use_prefix_cache: bool = True
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -106,6 +112,12 @@ class Sequence:
             self.generated = [int(t) for t in request.resume.generated]
         self.preemptions = 0
         self.first_token_time: Optional[float] = None
+        # prefix-cache admission outcome (set by Scheduler.admit):
+        # tokens covered by shared index pages (prefill starts there)
+        # and the (src, dst) of a pending copy-on-write page the engine
+        # must device-copy before the first dispatch
+        self.cached_tokens = 0
+        self.cow_pair: Optional[tuple] = None
         # epoch stamps in-flight device results: a preemption bumps it,
         # so tokens dispatched before the reset are dropped on consume
         # (the recompute replays them deterministically)
@@ -157,6 +169,10 @@ class Scheduler:
         self.waiting: Deque[Request] = deque()
         self.running: List[Sequence] = []
         self.num_preemptions = 0
+        # optional serving.prefix_cache.PrefixCache (set by the engine):
+        # admission consults it for resident full-page prompt prefixes
+        # and maps hits into the page table instead of allocating them
+        self.prefix_cache = None
 
     # --- queue ------------------------------------------------------------
     def add(self, request: Request):
@@ -188,7 +204,19 @@ class Scheduler:
         """Move waiting requests into the running set while a batch slot
         is free and the cache can cover the prompt; FIFO order, so a big
         stuck request head-of-line blocks (documented policy — no
-        out-of-order admission that could starve it)."""
+        out-of-order admission that could starve it).
+
+        Prefix cache (when the engine attached one): eligible requests
+        (not a resume, not opted out) first map the index's longest
+        resident full-page prompt prefix into their table via
+        ``cache.share`` and allocate only the uncached suffix; when the
+        match covers the WHOLE prompt the first decode write (position
+        P-1) would land in a shared page, so the last matched page is
+        swapped copy-on-write (``cache.cow_page`` — the engine device-
+        copies the payload before dispatching).  Any failure along the
+        way (page exhaustion, chaos ``kv.allocate`` denial on the COW
+        allocation) rolls the mapping back and DEFERS the admission —
+        the shared pages are never mutated or leaked."""
         admitted: List[Sequence] = []
         limit = self.max_admissions_per_step
         while self.waiting and len(self.running) < self.max_batch_size:
@@ -199,14 +227,52 @@ class Scheduler:
             # its snapshot carries (pos slots), not just the prompt
             kv_need = (int(req.resume.kv_len) if req.resume is not None
                        else len(req.prompt))
+            matched: List[int] = []
+            if (self.prefix_cache is not None and req.resume is None
+                    and req.use_prefix_cache):
+                matched = self.prefix_cache.match(req.prompt)
+                if matched and not self.cache.share(req.request_id,
+                                                    matched):
+                    matched = []
             if not self.cache.allocate(req.request_id, kv_need):
+                if matched:
+                    # roll the shared mapping back (pure decref — the
+                    # pages stay resident for the retry next step)
+                    self.cache.free(req.request_id)
                 break
+            matched_tokens = len(matched) * self.cache.page_size
+            cow_pair = None
+            if matched_tokens >= len(req.prompt):
+                # full-prompt match: position P-1 (the first decode
+                # write) sits inside the last matched page — copy it
+                # out before any dispatch can touch it
+                cow_pair = self.cache.cow_page(req.request_id,
+                                               len(matched) - 1)
+                if cow_pair is None:
+                    self.cache.free(req.request_id)
+                    break
             self.waiting.popleft()
             seq = Sequence(req)
             seq.pos = (int(req.resume.pos) if req.resume is not None
                        else len(req.prompt) - 1)
+            seq.cached_tokens = matched_tokens
+            seq.cow_pair = cow_pair
             self.running.append(seq)
             admitted.append(seq)
+            if (self.prefix_cache is not None and req.resume is None
+                    and req.use_prefix_cache):
+                self.prefix_cache.on_admission(matched_tokens)
+                # seal the full prompt pages strictly below the first
+                # decode write (position P-1) RIGHT AWAY — pure host
+                # bookkeeping, so a later request in this very admit()
+                # batch already shares them (the engine dispatches the
+                # prefills in admission order, and device program order
+                # commits the writes before any reader's attention)
+                full = (len(req.prompt) - 1) // self.cache.page_size
+                if full > 0:
+                    self.prefix_cache.insert(
+                        req.prompt,
+                        self.cache.seq_page_ids(req.request_id), full)
         return admitted
 
     # --- decode-time page growth -----------------------------------------

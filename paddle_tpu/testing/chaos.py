@@ -10,9 +10,14 @@ tests/test_chaos.py).
 
 Instrumented sites (grep ``chaos_site(`` for the live list)
 -----------------------------------------------------------
-``kv.allocate``       PagedKVCache.allocate — action ``deny`` simulates
-                      transient page exhaustion (the scheduler reacts by
-                      preempting / deferring admission).  Key: seq_id.
+``kv.allocate``       PagedKVCache.allocate AND PagedKVCache.cow_page —
+                      action ``deny`` simulates transient page
+                      exhaustion: the scheduler reacts by preempting /
+                      deferring admission, and a denied COPY-ON-WRITE
+                      allocation (ISSUE 10 prefix cache) DEFERS the
+                      admission with the shared mapping rolled back —
+                      the shared page is never mutated or leaked.
+                      Key: seq_id.
 ``engine.step``       ServingEngine.step — ``raise`` injects an
                       engine-step exception (the frontend treats it as a
                       replica crash), ``delay`` injects artificial step

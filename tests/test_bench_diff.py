@@ -104,6 +104,29 @@ class TestFailOnRegression:
             "detail.training_resilience.step_ms_async")
         assert not bench_diff.lower_is_better(
             "detail.resilience.failover.recompute_saved_tokens")
+        # prefix cache section (ISSUE 10): hit rate, cached/skipped
+        # tokens and the TTFT/FLOPs win ratios gate DOWNWARD (a falling
+        # hit rate or speedup is the regression); TTFT itself, eviction
+        # churn and COW copies gate UPWARD
+        assert not bench_diff.lower_is_better(
+            "detail.prefix_cache.rates.rate09.hit_rate")
+        assert not bench_diff.lower_is_better(
+            "detail.prefix_cache.rates.rate09.prefill_tokens_skipped")
+        assert not bench_diff.lower_is_better(
+            "serving.prefix.cached_tokens")
+        assert not bench_diff.lower_is_better(
+            "serving.prefix.hit_tokens")
+        assert not bench_diff.lower_is_better(
+            "detail.prefix_cache.ttft_p95_speedup_x")
+        assert not bench_diff.lower_is_better(
+            "detail.prefix_cache.prefill_flops_reduction_x")
+        assert bench_diff.lower_is_better(
+            "detail.prefix_cache.rates.rate09.ttft_ms_p95")
+        assert bench_diff.lower_is_better(
+            "detail.prefix_cache.rates.rate09.evictions")
+        assert bench_diff.lower_is_better(
+            "detail.prefix_cache.rates.rate09.cow_copies")
+        assert bench_diff.lower_is_better("serving.prefix.misses")
 
     def test_reduction_ratio_gates_on_drop_not_rise(self):
         """The PR-4 acceptance metric: kv_bytes_reduction_x falling

@@ -1,0 +1,668 @@
+"""Prefix cache (ISSUE 10): radix index + refcounted copy-on-write page
+sharing over the paged KV pool.
+
+Acceptance anchors:
+- a request whose prompt shares an N-page prefix with a completed (or
+  still-resident) request prefills only the uncached suffix — pinned via
+  ``cost_registry`` prefill call/FLOPs deltas and the
+  ``serving.prefix.*`` counters — and its greedy stream is
+  BYTE-IDENTICAL to the same request served with the cache disabled,
+  across sync/pipelined/fused consume modes;
+- refcount invariants under the PR-6 seeded-chaos acceptance shape:
+  kill/preempt/abort/deadline-expire a sequence holding shared pages →
+  ZERO page leak and ZERO premature free (a surviving reader's stream
+  stays byte-identical);
+- steady-state decode with shared pages in the batch stays
+  transfer-guard-clean and ``compile_budget(0)``-clean;
+- int8 scale contract: ``int8_static`` shares, ``int8_dynamic``
+  bypasses the index;
+- failover: a snapshot of a sequence holding SHARED pages gathers them
+  like owned pages and restores as PRIVATE on the survivor.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.errors import InvalidArgumentError
+from paddle_tpu.profiler.jit_cost import compile_budget, cost_registry
+from paddle_tpu.serving import (PagedKVCache, PrefixCache, ServingEngine,
+                                ServingFrontend)
+from paddle_tpu.serving.router import DEAD
+from paddle_tpu.testing import chaos
+from paddle_tpu.testing.chaos import ChaosPlan, Fault
+from paddle_tpu.text.generation import generate
+
+VOCAB, HID, LAYERS, HEADS = 50, 32, 2, 2
+
+
+@pytest.fixture(autouse=True)
+def _lock_witness():
+    """Every run doubles as a deadlock detector (ISSUE 7 discipline)."""
+    from paddle_tpu.framework import concurrency
+
+    with concurrency.witness(raise_on_violation=False):
+        yield
+    concurrency.assert_clean()
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    from paddle_tpu.text.models import GPTModel
+
+    paddle.seed(11)
+    m = GPTModel(vocab_size=VOCAB, hidden_size=HID, num_layers=LAYERS,
+                 num_heads=HEADS, ffn_size=64, max_seq_len=64, dropout=0.0)
+    m.eval()
+    return m
+
+
+def _reference(gpt, prompt, budget):
+    want, _ = generate(gpt, np.asarray(prompt, np.int32)[None, :],
+                       max_new_tokens=budget, end_id=0)
+    w = want.numpy()[0]
+    if (w == 0).any():
+        w = w[: int(np.argmax(w == 0)) + 1]
+    return w
+
+
+def _invariant(cache: PagedKVCache):
+    """pages_in_use + pages_cached + free == allocatable pages, always —
+    shared pages counted exactly once, cached pages neither leaked nor
+    free."""
+    assert (cache.pages_in_use + cache.pages_cached + cache.free_pages
+            == cache.num_pages - 1)
+
+
+# =============================================================================
+# Host-only units: refcounts, radix index, COW, eviction
+# =============================================================================
+class TestRefcountedCache:
+    def test_share_counts_pages_exactly_once(self):
+        c = PagedKVCache(num_pages=17, page_size=4, pages_per_seq=8)
+        assert c.allocate("a", 12)                 # 3 private pages
+        pages = c.seq_page_ids("a")
+        for p in pages:
+            c.pin_cached(p)
+        assert c.share("b", pages[:2])             # 2 shared + suffix
+        assert c.allocate("b", 12)
+        assert c.seq_page_ids("b")[:2] == pages[:2]
+        # 3 (a) + 1 (b suffix) distinct pages; shared ones count ONCE
+        assert c.pages_in_use == 4
+        assert c.ref_count(pages[0]) == 2
+        _invariant(c)
+        # a leaves: shared pages survive for b (no premature free)
+        c.free("a")
+        assert c.ref_count(pages[0]) == 1
+        assert c.pages_in_use == 3
+        # pages[2] was cached -> resident-evictable, not free
+        assert c.pages_cached == 1
+        _invariant(c)
+        c.free("b")
+        assert c.pages_in_use == 0 and c.pages_cached == 3
+        _invariant(c)
+        # index lets go -> pages return to the free list
+        for p in pages:
+            c.release_cached(p)
+        assert c.free_pages == 16
+        _invariant(c)
+
+    def test_share_rejects_oversize_and_existing_table(self):
+        c = PagedKVCache(num_pages=17, page_size=4, pages_per_seq=2)
+        assert c.allocate("a", 8)
+        assert not c.share("a", [1])               # table exists
+        assert not c.share("b", [1, 2, 3])         # > pages_per_seq
+        assert c.pages_in_use == 2                 # untouched
+        with pytest.raises(InvalidArgumentError):
+            c.share("c", [99])                     # out of range
+
+    def test_cow_swaps_page_and_decrefs_original(self):
+        c = PagedKVCache(num_pages=9, page_size=4, pages_per_seq=4)
+        assert c.allocate("a", 8)
+        src_pages = c.seq_page_ids("a")
+        for p in src_pages:
+            c.pin_cached(p)
+        assert c.share("b", src_pages)
+        pair = c.cow_page("b", 1)
+        assert pair is not None
+        src, dst = pair
+        assert src == src_pages[1] and dst not in src_pages
+        assert c.seq_page_ids("b") == [src_pages[0], dst]
+        assert c.ref_count(src) == 1               # only a again
+        assert c.ref_count(dst) == 1
+        assert c.total_cow == 1
+        _invariant(c)
+
+    def test_cow_chaos_denial_defers_without_corruption(self):
+        c = PagedKVCache(num_pages=9, page_size=4, pages_per_seq=4)
+        assert c.allocate("a", 8)
+        pages = c.seq_page_ids("a")
+        assert c.share("b", pages)
+        plan = ChaosPlan([Fault("kv.allocate", at=1, action="deny",
+                                match="b")])
+        with chaos.running(plan):
+            assert c.cow_page("b", 1) is None      # denied -> defer
+        assert c.seq_page_ids("b") == pages        # mapping untouched
+        assert c.ref_count(pages[1]) == 2
+        assert plan.fired_log()
+        _invariant(c)
+
+    def test_cow_exhaustion_returns_none(self):
+        c = PagedKVCache(num_pages=3, page_size=4, pages_per_seq=2)
+        assert c.allocate("a", 8)                  # pool exhausted
+        pages = c.seq_page_ids("a")
+        assert c.share("b", pages)                 # sharing needs no page
+        assert c.cow_page("b", 0) is None          # nothing free: defer
+        assert c.seq_page_ids("b") == pages        # mapping untouched
+        _invariant(c)
+
+    def test_allocate_reclaims_cached_pages_before_failing(self):
+        c = PagedKVCache(num_pages=5, page_size=4, pages_per_seq=4)
+        pc = PrefixCache(c)
+        toks = np.arange(1, 9, dtype=np.int32)     # 2 full pages
+        assert c.allocate("a", 8)
+        pages = c.seq_page_ids("a")
+        assert pc.insert(toks, pages, 2) == 2
+        c.free("a")
+        assert c.pages_cached == 2 and c.free_pages == 2
+        # a 4-page allocation needs the cached pages back: the reclaimer
+        # evicts LRU refcount-0 index pages instead of failing
+        assert c.allocate("big", 16)
+        assert c.free_pages == 0 and c.pages_cached == 0
+        assert pc.evictions == 2
+        assert pc.match(toks) == []                # index emptied
+        _invariant(c)
+
+
+class TestRadixIndex:
+    def test_match_longest_full_page_prefix(self):
+        c = PagedKVCache(num_pages=17, page_size=4, pages_per_seq=8)
+        pc = PrefixCache(c)
+        toks = np.arange(1, 13, dtype=np.int32)    # 3 full pages
+        assert c.allocate("a", 12)
+        pages = c.seq_page_ids("a")
+        assert pc.insert(toks, pages, 3) == 3
+        assert pc.match(toks) == pages
+        assert pc.match(toks[:8]) == pages[:2]
+        assert pc.match(toks[:7]) == pages[:1]     # partial page ignored
+        assert pc.match(toks[:3]) == []            # below one page
+        div = toks.copy()
+        div[5] = 49                                # diverge in page 2
+        assert pc.match(div) == pages[:1]
+        assert pc.cached_tokens == 12
+
+    def test_insert_is_idempotent_first_publisher_wins(self):
+        c = PagedKVCache(num_pages=17, page_size=4, pages_per_seq=8)
+        pc = PrefixCache(c)
+        toks = np.arange(1, 9, dtype=np.int32)
+        assert c.allocate("a", 8)
+        pa = c.seq_page_ids("a")
+        assert pc.insert(toks, pa, 2) == 2
+        assert c.allocate("b", 8)
+        pb = c.seq_page_ids("b")
+        assert pc.insert(toks, pb, 2) == 0         # duplicates skipped
+        assert pc.match(toks) == pa                # first publisher wins
+        c.free("b")                                # duplicate frees fully
+        # b's unindexed pages return to the free list; only a's 2 stay
+        assert c.free_pages == 16 - 2
+
+    def test_eviction_is_lru_leaf_first(self):
+        c = PagedKVCache(num_pages=17, page_size=4, pages_per_seq=8)
+        pc = PrefixCache(c)
+        chain = np.arange(1, 13, dtype=np.int32)   # parent+child chain
+        assert c.allocate("a", 12)
+        pa = c.seq_page_ids("a")
+        pc.insert(chain, pa, 3)
+        other = np.arange(20, 28, dtype=np.int32)
+        assert c.allocate("b", 8)
+        pb = c.seq_page_ids("b")
+        pc.insert(other, pb, 2)
+        c.free("a")
+        c.free("b")
+        pc.match(chain)                            # chain is most recent
+        assert pc.evict(1) == 1
+        # LRU leaf = other's tail page, NOT the chain's interior pages
+        assert pc.match(chain) == pa
+        assert pc.match(other) == pb[:1]
+        # deeper eviction unwinds the chain from the leaf
+        assert pc.evict(10) == 4
+        assert pc.match(chain) == [] and pc.match(other) == []
+        assert c.free_pages == 16
+
+    def test_referenced_pages_never_evicted(self):
+        c = PagedKVCache(num_pages=9, page_size=4, pages_per_seq=4)
+        pc = PrefixCache(c)
+        toks = np.arange(1, 9, dtype=np.int32)
+        assert c.allocate("a", 8)
+        pc.insert(toks, c.seq_page_ids("a"), 2)
+        assert pc.evict(8) == 0                    # all refcount >= 1
+        assert pc.match(toks) == c.seq_page_ids("a")
+
+
+# =============================================================================
+# Engine: prefill skip, byte identity, COW, int8 contract
+# =============================================================================
+ENGINE_KW = dict(page_size=4, max_batch_size=4, eos_id=0)
+
+
+def _drain(eng):
+    out = {}
+    while eng.scheduler.has_work() or eng._pending:
+        eng.step()
+        out.update({k: eng.take_output(k) for k in list(eng.outputs)})
+    return out
+
+
+class TestPrefillSkip:
+    @pytest.mark.parametrize("mode", ["sync", "pipelined", "fused"])
+    def test_shared_prefix_skips_prefill_byte_identical(self, gpt, mode):
+        """The headline acceptance: request B shares A's 2-page prefix —
+        B prefills ONLY the uncached suffix (pinned via prefill call and
+        FLOPs deltas) and its stream is byte-identical to the cache-off
+        engine, in every consume mode."""
+        kw = dict(ENGINE_KW)
+        if mode == "sync":
+            kw["sync_mode"] = True
+        elif mode == "fused":
+            kw["fused_steps"] = 4
+        rng = np.random.RandomState(5)
+        prefix = rng.randint(1, VOCAB, (9,)).astype(np.int32)
+        pa = np.concatenate([prefix,
+                             rng.randint(1, VOCAB, (3,)).astype(np.int32)])
+        pb = np.concatenate([prefix,
+                             rng.randint(1, VOCAB, (5,)).astype(np.int32)])
+        eng = ServingEngine(gpt, prefix_cache=True, **kw)
+        eng.add_request(pa, max_new_tokens=10, request_id="a")
+        outs = _drain(eng)
+        calls0 = cost_registry.snapshot()["serving.prefill"]["calls"]
+        flops0 = cost_registry.snapshot()["serving.prefill"]["total_flops"]
+        eng.add_request(pb, max_new_tokens=10, request_id="b")
+        outs.update(_drain(eng))
+        calls1 = cost_registry.snapshot()["serving.prefill"]["calls"]
+        flops1 = cost_registry.snapshot()["serving.prefill"]["total_flops"]
+        st = eng.stats()["prefix_cache"]
+        assert st["hits"] == 1 and st["hit_tokens"] == 8
+        # uncached B would prefill 13 positions (>= 3 pow2 chunks);
+        # cached B prefills 5 -> exactly one pow2-8 chunk dispatch
+        assert calls1 - calls0 == 1
+        off = ServingEngine(gpt, prefix_cache=False, **kw)
+        off.add_request(pa, max_new_tokens=10, request_id="a")
+        off.add_request(pb, max_new_tokens=10, request_id="b")
+        ref = _drain(off)
+        calls_off = cost_registry.snapshot()["serving.prefill"]["calls"]
+        flops_off = \
+            cost_registry.snapshot()["serving.prefill"]["total_flops"]
+        np.testing.assert_array_equal(outs["a"], ref["a"])
+        np.testing.assert_array_equal(outs["b"], ref["b"])
+        np.testing.assert_array_equal(outs["b"], _reference(gpt, pb, 10))
+        # FLOPs: the cache-off run spent MORE prefill FLOPs on the same
+        # pair of prompts than the cached run spent on B alone... and
+        # B-cached spent strictly less than B-uncached (the off run's
+        # second prompt)
+        assert flops1 - flops0 < (flops_off - flops1) / 2 + 1
+        assert eng.cache.pages_in_use == 0
+        _invariant(eng.cache)
+
+    def test_cow_on_full_prompt_match(self, gpt):
+        """Page-aligned identical prompt: the match covers the whole
+        prompt, the first decode write (P-1) lands in a shared page ->
+        exactly one COW copy, streams byte-identical, donor pages never
+        mutated (the donor can be replayed from the index again)."""
+        rng = np.random.RandomState(6)
+        p8 = rng.randint(1, VOCAB, (8,)).astype(np.int32)
+        eng = ServingEngine(gpt, prefix_cache=True, **ENGINE_KW)
+        eng.add_request(p8, max_new_tokens=8, request_id="a")
+        outs = _drain(eng)
+        for rid in ("b", "c"):                    # two readers in a row
+            eng.add_request(p8.copy(), max_new_tokens=8, request_id=rid)
+            outs.update(_drain(eng))
+        st = eng.stats()["prefix_cache"]
+        assert st["cow_copies"] == 2 and st["hits"] == 2
+        ref = _reference(gpt, p8, 8)
+        for rid in ("a", "b", "c"):
+            np.testing.assert_array_equal(outs[rid], ref)
+        assert eng.cache.pages_in_use == 0
+        _invariant(eng.cache)
+
+    def test_intra_batch_sharing_same_step(self, gpt):
+        """Requests admitted in the SAME engine step share: the first
+        seals its prompt pages at admission (host-side), the second maps
+        them before its own prefill dispatch."""
+        rng = np.random.RandomState(7)
+        prefix = rng.randint(1, VOCAB, (9,)).astype(np.int32)
+        ps = [np.concatenate([prefix, rng.randint(
+            1, VOCAB, (k,)).astype(np.int32)]) for k in (2, 3, 4)]
+        eng = ServingEngine(gpt, prefix_cache=True, **ENGINE_KW)
+        rids = [eng.add_request(p, max_new_tokens=8) for p in ps]
+        outs = _drain(eng)
+        assert eng.stats()["prefix_cache"]["hits"] == 2
+        # reference: the identical workload with the cache off (shares
+        # the compiled-program cache — no fresh XLA compiles)
+        off = ServingEngine(gpt, prefix_cache=False, **ENGINE_KW)
+        rids_off = [off.add_request(p, max_new_tokens=8) for p in ps]
+        ref = _drain(off)
+        for r, ro in zip(rids, rids_off):
+            np.testing.assert_array_equal(outs[r], ref[ro])
+        assert eng.cache.pages_in_use == 0
+
+    def test_retirement_seals_generated_tokens(self, gpt):
+        """A finished request's GENERATED pages are sealed too: a
+        follow-up whose prompt extends the finished conversation
+        (prompt + output prefix) hits them."""
+        rng = np.random.RandomState(8)
+        p5 = rng.randint(1, VOCAB, (5,)).astype(np.int32)
+        eng = ServingEngine(gpt, prefix_cache=True, page_size=4,
+                            max_batch_size=4, eos_id=-1)
+        eng.add_request(p5, max_new_tokens=12, request_id="a")
+        outs = _drain(eng)
+        # prompt (5) + the first 7 generated tokens = 12 = 3 full pages,
+        # all sealed at retirement; the follow-up turn extends them
+        turn2 = np.concatenate([p5, outs["a"][:7],
+                                rng.randint(1, VOCAB,
+                                            (2,)).astype(np.int32)])
+        assert turn2.size == 14
+        eng.add_request(turn2, max_new_tokens=8, request_id="b")
+        outs.update(_drain(eng))
+        st = eng.stats()["prefix_cache"]
+        assert st["hits"] == 1 and st["hit_tokens"] == 12
+        want, _ = generate(gpt, turn2[None, :], max_new_tokens=8,
+                           end_id=-1)
+        np.testing.assert_array_equal(outs["b"], want.numpy()[0])
+
+    def test_per_request_opt_out_and_type_validation(self, gpt):
+        rng = np.random.RandomState(9)
+        p8 = rng.randint(1, VOCAB, (8,)).astype(np.int32)
+        eng = ServingEngine(gpt, prefix_cache=True, **ENGINE_KW)
+        eng.add_request(p8, max_new_tokens=6, request_id="a",
+                        prefix_cache=False)
+        outs = _drain(eng)
+        st = eng.stats()["prefix_cache"]
+        # opted out: no lookup, no sealing, nothing resident
+        assert st["hits"] == 0 and st["misses"] == 0 and st["pages"] == 0
+        eng.add_request(p8.copy(), max_new_tokens=6, request_id="b")
+        outs.update(_drain(eng))
+        np.testing.assert_array_equal(outs["a"], outs["b"])
+        assert eng.stats()["prefix_cache"]["misses"] == 1
+        with pytest.raises(InvalidArgumentError):
+            eng.add_request(p8, max_new_tokens=2, prefix_cache="yes")
+        with pytest.raises(InvalidArgumentError):
+            ServingEngine(gpt, prefix_cache="on", **ENGINE_KW)
+
+    def test_int8_static_shares_int8_dynamic_bypasses(self, gpt):
+        """The documented scale contract: static scales are engine
+        config (shared pages dequantize identically under every
+        reader); dynamic per-page scales are device state grown by the
+        writer, so the engine never builds an index."""
+        from paddle_tpu.slim import export_serving_quant
+
+        rng = np.random.RandomState(10)
+        quant = export_serving_quant(
+            gpt, calib_prompts=rng.randint(1, VOCAB,
+                                           (4, 12)).astype(np.int32))
+        prefix = rng.randint(1, VOCAB, (8,)).astype(np.int32)
+        pb = np.concatenate([prefix,
+                             rng.randint(1, VOCAB, (4,)).astype(np.int32)])
+        got = {}
+        for name, pc in (("on", True), ("off", False)):
+            eng = ServingEngine(gpt, kv_cache_dtype="int8",
+                                quant_scales=quant, prefix_cache=pc,
+                                **ENGINE_KW)
+            eng.add_request(prefix, max_new_tokens=6, request_id="a")
+            _drain(eng)
+            eng.add_request(pb, max_new_tokens=6, request_id="b")
+            got[name] = (_drain(eng)["b"], eng.stats()["prefix_cache"])
+        np.testing.assert_array_equal(got["on"][0], got["off"][0])
+        assert got["on"][1]["hits"] == 1
+        dyn = ServingEngine(gpt, kv_cache_dtype="int8",
+                            prefix_cache=True, **ENGINE_KW)
+        assert dyn.prefix_cache is None
+        st = dyn.stats()["prefix_cache"]
+        assert st["enabled"] is False
+        assert "int8_dynamic" in st["bypass_reason"]
+        # requests still serve, uncached
+        dyn.add_request(prefix, max_new_tokens=4, request_id="a")
+        assert "a" in _drain(dyn)
+
+    def test_steady_decode_transfer_and_retrace_clean(self, gpt):
+        """Shared pages in the decode batch change NOTHING on the hot
+        path: steady state stays transfer-guard-clean and
+        compile_budget(0)-clean (COW/sealing happen at admission/
+        retirement, which are outside the guarded window)."""
+        rng = np.random.RandomState(12)
+        prefix = rng.randint(1, VOCAB, (9,)).astype(np.int32)
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=4, eos_id=-1,
+                            prefix_cache=True)
+        eng.add_request(np.concatenate([prefix, [7]]).astype(np.int32),
+                        max_new_tokens=4, request_id="warm")
+        _drain(eng)
+        for i in range(4):
+            sfx = rng.randint(1, VOCAB, (2 + i,)).astype(np.int32)
+            eng.add_request(np.concatenate([prefix, sfx]),
+                            max_new_tokens=24, request_id=f"s{i}")
+        for _ in range(4):
+            eng.step()
+        assert all(s is not None for s in eng._lanes)
+        assert eng.stats()["prefix_cache"]["hits"] >= 4
+        with jax.transfer_guard("disallow"), \
+                compile_budget(0, prefix="serving."):
+            for _ in range(8):
+                assert eng.step()["bucket"] == 4
+        _drain(eng)
+        assert eng.cache.pages_in_use == 0
+
+
+# =============================================================================
+# Refcount invariants under failure: abort / preempt / expire / failover
+# =============================================================================
+class TestSharedPageFailureInvariants:
+    def test_abort_reader_keeps_survivor_byte_identical(self, gpt):
+        rng = np.random.RandomState(13)
+        prefix = rng.randint(1, VOCAB, (9,)).astype(np.int32)
+        pa = np.concatenate([prefix, [11, 12]]).astype(np.int32)
+        pb = np.concatenate([prefix, [13, 14, 15]]).astype(np.int32)
+        eng = ServingEngine(gpt, prefix_cache=True, page_size=4,
+                            max_batch_size=4, eos_id=-1)
+        eng.add_request(pa, max_new_tokens=20, request_id="a")
+        eng.add_request(pb, max_new_tokens=20, request_id="b")
+        for _ in range(5):
+            eng.step()
+        shared = [p for p in eng.cache.seq_page_ids("a")
+                  if eng.cache.ref_count(p) == 2]
+        assert shared, "no shared pages in flight"
+        assert eng.abort("b")
+        # zero premature free: a still holds every shared page
+        for p in shared:
+            assert eng.cache.ref_count(p) == 1
+        outs = _drain(eng)
+        want, _ = generate(gpt, pa[None, :], max_new_tokens=20, end_id=-1)
+        np.testing.assert_array_equal(outs["a"], want.numpy()[0])
+        assert eng.cache.pages_in_use == 0
+        _invariant(eng.cache)
+
+    def test_deadline_expiry_of_shared_reader(self, gpt):
+        import time as _time
+
+        rng = np.random.RandomState(14)
+        prefix = rng.randint(1, VOCAB, (9,)).astype(np.int32)
+        pa = np.concatenate([prefix, [11]]).astype(np.int32)
+        pb = np.concatenate([prefix, [13, 14]]).astype(np.int32)
+        eng = ServingEngine(gpt, prefix_cache=True, page_size=4,
+                            max_batch_size=4, eos_id=-1)
+        eng.add_request(pa, max_new_tokens=16, request_id="a")
+        eng.add_request(pb, max_new_tokens=16, request_id="b",
+                        deadline=_time.monotonic() + 1e9)
+        for _ in range(4):
+            eng.step()
+        # age b's deadline -> the next step aborts it mid-decode
+        req_b = next(s for s in eng.scheduler.running
+                     if s.seq_id == "b").request
+        req_b.deadline = _time.monotonic() - 1.0
+        eng.step()
+        assert "b" in eng.take_expired()
+        outs = _drain(eng)
+        want, _ = generate(gpt, pa[None, :], max_new_tokens=16, end_id=-1)
+        np.testing.assert_array_equal(outs["a"], want.numpy()[0])
+        assert eng.cache.pages_in_use == 0
+        _invariant(eng.cache)
+
+    def test_preemption_under_pressure_replays_byte_identical(self, gpt):
+        """A tight pool forces cached-page eviction AND preemption of
+        readers holding shared pages; every stream still matches the
+        unconstrained reference (deterministic replay + rematch)."""
+        rng = np.random.RandomState(15)
+        prefix = rng.randint(1, VOCAB, (9,)).astype(np.int32)
+        prompts = [np.concatenate([prefix, rng.randint(
+            1, VOCAB, (k,)).astype(np.int32)]) for k in (2, 3, 4, 5)]
+        eng = ServingEngine(gpt, prefix_cache=True, page_size=4,
+                            max_batch_size=3, eos_id=0, num_pages=19)
+        rids = [eng.add_request(p, max_new_tokens=10) for p in prompts]
+        outs = _drain(eng)
+        # reference: same workload, cache off, ROOMY pool — no
+        # preemption, no eviction, shared compiled programs
+        off = ServingEngine(gpt, prefix_cache=False, page_size=4,
+                            max_batch_size=3, eos_id=0)
+        rids_off = [off.add_request(p, max_new_tokens=10)
+                    for p in prompts]
+        ref = _drain(off)
+        for r, ro in zip(rids, rids_off):
+            np.testing.assert_array_equal(outs[r], ref[ro])
+        assert eng.cache.pages_in_use == 0
+        _invariant(eng.cache)
+
+    def test_snapshot_of_shared_pages_restores_private(self, gpt):
+        """Failover contract: the snapshot gathers shared pages like
+        owned ones; restore on a fresh engine re-admits them as private
+        (the survivor's index state is irrelevant) — byte-identical."""
+        rng = np.random.RandomState(16)
+        prefix = rng.randint(1, VOCAB, (8,)).astype(np.int32)
+        pb = np.concatenate([prefix, [9, 21, 33]]).astype(np.int32)
+        eng = ServingEngine(gpt, prefix_cache=True, page_size=4,
+                            max_batch_size=2, eos_id=-1)
+        eng.add_request(prefix, max_new_tokens=6, request_id="a")
+        _drain(eng)
+        eng.add_request(pb, max_new_tokens=14, request_id="b")
+        for _ in range(6):
+            eng.step()
+        assert eng.stats()["prefix_cache"]["hits"] == 1
+        snap = eng.snapshot("b")
+        assert snap is not None and snap.num_generated > 0
+        eng2 = ServingEngine(gpt, prefix_cache=True, page_size=4,
+                             max_batch_size=2, eos_id=-1)
+        eng2.restore(snap)
+        outs2 = _drain(eng2)
+        want, _ = generate(gpt, pb[None, :], max_new_tokens=14, end_id=-1)
+        np.testing.assert_array_equal(outs2["b"], want.numpy()[0])
+        # restored as PRIVATE: no index consulted, every page refcount 1
+        assert eng2.stats()["prefix_cache"]["hits"] == 0
+        assert eng.abort("b")
+        assert eng.cache.pages_in_use == 0
+        _invariant(eng.cache)
+
+    def test_seeded_chaos_shared_prefix_fleet(self, gpt):
+        """The PR-6 acceptance shape with the prefix cache ON and every
+        request sharing one system prompt: replica kill + straggler +
+        allocator denial (which also exercises COW deferral on the
+        identical prompts).  Every request completes byte-identical to
+        the uninterrupted reference, survivors leak zero pages and free
+        none prematurely, and a replay of the same schedule reproduces
+        the same outcomes."""
+        rng = np.random.RandomState(17)
+        prefix = rng.randint(1, VOCAB, (8,)).astype(np.int32)
+        prompts = [np.concatenate([prefix, rng.randint(
+            1, VOCAB, (k,)).astype(np.int32)]) if k else prefix.copy()
+            for k in (2, 0, 5, 3, 0, 4, 6, 1)]
+
+        def drive(plan):
+            fe = ServingFrontend(gpt, replicas=2, queue_cap=32,
+                                 engine_kwargs=dict(ENGINE_KW),
+                                 prefix_cache=True, snapshot_interval=4)
+            try:
+                with chaos.running(plan):
+                    handles = [fe.submit(p, max_new_tokens=10)
+                               for p in prompts]
+                    statuses = [h.wait(timeout=300) for h in handles]
+                leaks = {rep.id: rep.engine.cache.pages_in_use
+                         for rep in fe._replicas if rep.state != DEAD}
+                for rep in fe._replicas:
+                    if rep.state != DEAD:
+                        _invariant(rep.engine.cache)
+                return handles, statuses, leaks
+            finally:
+                fe.close()
+
+        def plan():
+            return ChaosPlan([
+                Fault("replica.kill", at=6, action="kill",
+                      match="replica-0"),
+                Fault("engine.step", at=9, action="delay", delay_s=0.05),
+                Fault("kv.allocate", at=5, action="deny"),
+            ], name="prefix-chaos")
+
+        plan_a = plan()
+        handles, statuses, leaks = drive(plan_a)
+        assert sorted(e["site"] for e in plan_a.fired_log()) == [
+            "engine.step", "kv.allocate", "replica.kill"]
+        assert statuses == ["completed"] * 8
+        assert all(v == 0 for v in leaks.values())
+        # uninterrupted reference: one cache-off engine, same prompts
+        # (shares the compiled-program cache — no fresh XLA compiles)
+        off = ServingEngine(gpt, prefix_cache=False, **ENGINE_KW)
+        rids = [off.add_request(p, max_new_tokens=10) for p in prompts]
+        refs = _drain(off)
+        for r, h in zip(rids, handles):
+            np.testing.assert_array_equal(h.tokens, refs[r])
+        plan_b = plan()
+        h2, statuses_b, leaks_b = drive(plan_b)
+        assert statuses_b == statuses and leaks_b == leaks
+        for a, b in zip(handles, h2):
+            np.testing.assert_array_equal(a.tokens, b.tokens)
+
+
+# =============================================================================
+# Frontend knob surface
+# =============================================================================
+class TestFrontendKnob:
+    def test_frontend_prefix_cache_and_opt_out(self, gpt):
+        rng = np.random.RandomState(18)
+        p = rng.randint(1, VOCAB, (9,)).astype(np.int32)
+        fe = ServingFrontend(gpt, replicas=1, queue_cap=8,
+                             engine_kwargs=dict(ENGINE_KW),
+                             prefix_cache=True)
+        try:
+            ref = fe.submit(p, max_new_tokens=8).result(timeout=120)
+            eng = fe._replicas[0].engine
+            base_hits = eng.stats()["prefix_cache"]["hits"]
+            h = fe.submit(p.copy(), max_new_tokens=8)
+            np.testing.assert_array_equal(h.result(timeout=120), ref)
+            assert eng.stats()["prefix_cache"]["hits"] == base_hits + 1
+            # per-request opt-out: no new hit
+            h2 = fe.submit(p.copy(), max_new_tokens=8,
+                           prefix_cache=False)
+            np.testing.assert_array_equal(h2.result(timeout=120), ref)
+            assert eng.stats()["prefix_cache"]["hits"] == base_hits + 1
+            with pytest.raises(InvalidArgumentError):
+                fe.submit(p, prefix_cache="yes")
+        finally:
+            fe.close()
+
+    def test_frontend_knob_type_validation(self, gpt):
+        with pytest.raises(InvalidArgumentError):
+            ServingFrontend(gpt, prefix_cache={"on": True},
+                            engine_kwargs=dict(ENGINE_KW))
+        with pytest.raises(InvalidArgumentError):
+            ServingFrontend(engine_factory=lambda: ServingEngine(
+                gpt, **ENGINE_KW), prefix_cache=True)
+
+    def test_config_enable_serving_knob(self, gpt):
+        from paddle_tpu.inference import Config
+        from paddle_tpu.serving import create_serving_engine
+
+        cfg = Config()
+        cfg.enable_serving(max_batch_size=2, page_size=4,
+                           prefix_cache=True)
+        eng = create_serving_engine(gpt, cfg)
+        assert eng.prefix_cache is not None
+        cfg2 = Config()
+        cfg2.enable_serving(max_batch_size=2, page_size=4)
+        assert create_serving_engine(gpt, cfg2).prefix_cache is None

@@ -116,10 +116,16 @@ def diff(a: dict, b: dict, only: Optional[str] = None,
 # name fragments marking metrics where BIGGER is better even though a
 # lower-better fragment also matches the path — checked FIRST (e.g.
 # `kv_bytes_reduction_x` contains "bytes" but a higher reduction is the
-# win; same for rates/ratios of good events)
+# win; same for rates/ratios of good events).  Prefix-cache (ISSUE 10):
+# "hit" covers hit_rate/hit_tokens, "cached" the resident-index gauge
+# (serving.prefix.cached_tokens), "skipped"/"saved" work the cache
+# avoided (prefill_tokens_skipped, recompute_saved_tokens) — all of
+# which would otherwise collide with lower-better fragments in their
+# paths and must gate DOWNWARD.
 _HIGHER_BETTER = ("reduction", "per_sec", "per_second", "goodput",
                   "throughput", "occupancy", "parity", "speedup",
-                  "utilization", "hit", "_x")
+                  "utilization", "hit", "cached", "skipped", "saved",
+                  "_x")
 # name fragments marking metrics where SMALLER is better (latencies,
 # misses, memory, churn, compile counts — a compile_count drifting up
 # round-over-round is a retrace regression); everything else
@@ -132,7 +138,11 @@ _LOWER_BETTER = ("_ms", "latency", "ttft", "e2e", "gap", "miss", "bytes",
                  # and crash-recomputed work both regress upward
                  # ("recomputed" stays distinct from the higher-better
                  # "recompute_saved_tokens")
-                 "overhead", "recomputed")
+                 "overhead", "recomputed",
+                 # prefix cache (ISSUE 10): eviction churn and COW
+                 # copies rising round-over-round mean the index is
+                 # thrashing or diverging more, both worse
+                 "evict", "cow")
 
 
 def lower_is_better(metric: str) -> bool:
