@@ -1319,6 +1319,105 @@ def bench_serving_prefix_cache(num_requests=16, max_new_tokens=8):
     }
 
 
+def bench_serving_observability(num_requests=24, max_new_tokens=16):
+    """ISSUE 11: the cost of the always-on request tracing + flight
+    recorder, A/B-measured on the serving engine's hot path.
+
+    The same closed-loop workload (mixed prompt lengths, greedy to a
+    fixed budget) runs alternately with recorder+span-tracing OFF and
+    ON (interleaved arms, median per arm — machine noise does not land
+    on one side); the headline ``trace_overhead_pct`` is the tokens/s
+    lost with everything on (acceptance: < 2%).  Also reports the
+    postmortem-bundle numbers an operator cares about: bundle size and
+    ``dump()`` latency with the rings warm from the measured run."""
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+    from paddle_tpu.profiler.flight_recorder import recorder
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.text.models import GPTModel
+
+    V, HID, L, HEADS, FF, SEQ = 4096, 128, 2, 4, 512, 256
+    paddle.seed(0)
+    model = GPTModel(vocab_size=V, hidden_size=HID, num_layers=L,
+                     num_heads=HEADS, ffn_size=FF, max_seq_len=SEQ,
+                     dropout=0.0)
+    model.eval()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, V, (int(p),)).astype(np.int32)
+               for p in rng.randint(8, 48, num_requests)]
+    reps = int(os.environ.get("BENCH_OBS_REPS", "3"))
+
+    def run_once():
+        eng = ServingEngine(model, page_size=16, max_batch_size=8,
+                            max_seq_len=SEQ, eos_id=-1)
+        for p in prompts:
+            eng.add_request(p, max_new_tokens=max_new_tokens)
+        t0 = time.perf_counter()
+        outs = eng.drain()
+        dt = time.perf_counter() - t0
+        tokens = sum(len(v) for v in outs.values())
+        snap = eng.metrics.snapshot()
+        return tokens / dt, snap["ttft_ms"]["p95"]
+
+    def arm(enabled):
+        recorder.configure(enabled=enabled)
+        if enabled:
+            profiler.enable_tracing()
+        else:
+            profiler.disable_tracing()
+        try:
+            return run_once()
+        finally:
+            profiler.disable_tracing()
+            recorder.configure(enabled=True)
+
+    arm(True)                       # warmup: compile every bucket
+    offs, ons = [], []
+    for _ in range(reps):           # interleaved A/B: noise lands on both
+        offs.append(arm(False))
+        ons.append(arm(True))
+    thr_off = float(np.median([r[0] for r in offs]))
+    thr_on = float(np.median([r[0] for r in ons]))
+    ttft_off = float(np.median([r[1] for r in offs]))
+    ttft_on = float(np.median([r[1] for r in ons]))
+    overhead = (thr_off - thr_on) / thr_off * 100.0 if thr_off else 0.0
+
+    # postmortem bundle, rings warm from the run above
+    rsnap = recorder.snapshot()
+    with tempfile.TemporaryDirectory() as tmp:
+        t0 = time.perf_counter()
+        bundle = recorder.dump("bench", path=os.path.join(tmp, "pm.json"))
+        dump_ms = (time.perf_counter() - t0) * 1e3
+        bundle_bytes = os.path.getsize(bundle["path"])
+
+    return {
+        "metric": "serving_trace_overhead_pct",
+        "value": round(overhead, 3),
+        "unit": "% tokens/s lost, recorder+tracing on (accept < 2)",
+        "detail": {
+            "num_requests": num_requests,
+            "max_new_tokens": max_new_tokens,
+            "runs_per_arm": reps,
+            "trace_overhead_pct": round(overhead, 3),
+            "tokens_per_sec_off": round(thr_off, 2),
+            "tokens_per_sec_on": round(thr_on, 2),
+            "ttft_ms_p95_off": round(ttft_off, 2),
+            "ttft_ms_p95_on": round(ttft_on, 2),
+            "ring_events": rsnap["events"],
+            "ring_steps": rsnap["steps"],
+            "terminal_traces": rsnap["terminal_traces"],
+            "bundle_bytes": bundle_bytes,
+            "bundle_dump_ms": round(dump_ms, 2),
+            "model": {"hidden": HID, "layers": L, "heads": HEADS,
+                      "max_seq_len": SEQ},
+        },
+    }
+
+
 def _compile_section():
     """Per-program compile accounting for the serving run
     (``detail.compile``): compile count + compile ms + calls per
@@ -1509,6 +1608,18 @@ def main():
         except Exception as e:  # noqa: BLE001 — rider workload, never fatal
             sys.stderr.write(
                 f"serving prefix-cache bench failed after retries "
+                f"({type(e).__name__}: {e})\n")
+        try:
+            # tracing + flight-recorder overhead A/B + bundle numbers
+            result.setdefault("detail", {})["observability"] = \
+                _with_retries(
+                    "serving_observability",
+                    lambda: bench_serving_observability(
+                        int(os.environ.get("BENCH_OBS_REQUESTS", "24")),
+                        int(os.environ.get("BENCH_OBS_TOKENS", "16"))))
+        except Exception as e:  # noqa: BLE001 — rider workload, never fatal
+            sys.stderr.write(
+                f"serving observability bench failed after retries "
                 f"({type(e).__name__}: {e})\n")
         # whole-run compile accounting LAST: every serving workload
         # above has already attributed its compiles to the registry

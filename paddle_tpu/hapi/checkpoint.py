@@ -42,7 +42,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.concurrency import OrderedCondition
-from ..framework.errors import CheckpointIncompatibleError
+from ..framework.errors import (CheckpointIncompatibleError,
+                                ExecutionTimeoutError)
 from ..framework.monitor import gauge_set, histogram_observe, stat_add
 from ..framework.random import default_generator
 from ..io.checkpoint import CheckpointStore
@@ -261,6 +262,8 @@ class TrainCheckpointer:
         return True
 
     def _write(self, state, step: int):
+        from ..profiler.flight_recorder import recorder as _flight
+
         t0 = time.perf_counter()
         path = self.store.save(state, step,
                                metadata={"kind": "train_state"})
@@ -268,6 +271,7 @@ class TrainCheckpointer:
         gauge_set("train.checkpoint_bytes", os.path.getsize(path))
         histogram_observe("train.checkpoint_write_ms",
                           (time.perf_counter() - t0) * 1e3)
+        _flight.on_transition("train.checkpoint", f"step-{step}", path)
 
     def _run(self):
         while True:
@@ -302,6 +306,8 @@ class TrainCheckpointer:
         when the store holds nothing usable.  Accounts
         ``train.resumes`` and ``train.recomputed_steps`` (progress
         marker minus checkpoint step — the steps the crash lost)."""
+        from ..profiler.flight_recorder import recorder as _flight
+
         loaded = self.load_latest_state()
         if loaded is None:
             return None
@@ -312,20 +318,32 @@ class TrainCheckpointer:
         if prog is not None:
             stat_add("train.recomputed_steps",
                      max(0, prog - pos["global_step"]))
+        _flight.on_transition(
+            "train.resume", f"step-{pos['global_step']}",
+            f"recomputed={max(0, (prog or 0) - pos['global_step'])}")
         return pos
 
     # --- lifecycle ----------------------------------------------------------
     def flush(self, timeout: Optional[float] = 60.0):
         """Block until no snapshot is queued or being written; re-raise
-        a background write failure if one happened."""
+        a background write failure if one happened.  A TIMEOUT raises
+        ExecutionTimeoutError (the PR-9 finding: returning normally
+        with a write still in flight reported durability the disk never
+        delivered — callers treating flush() as a durability barrier
+        must hear about it)."""
         if self.async_write:
             with self._cond:
-                self._cond.wait_for(
+                drained = self._cond.wait_for(
                     lambda: self._pending is None and not self._writing,
                     timeout)
                 if self._error is not None:
                     err, self._error = self._error, None
                     raise err
+                if not drained:
+                    raise ExecutionTimeoutError(
+                        f"checkpoint writer still busy after {timeout}s "
+                        "— flush() did not reach a durable state (the "
+                        "queued/in-flight snapshot is NOT committed)")
 
     def close(self, timeout: Optional[float] = 60.0):
         if self._thread is None:

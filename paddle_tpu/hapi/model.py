@@ -440,6 +440,22 @@ class Model:
                     while True:
                         if num_iters is not None and step >= num_iters:
                             break
+                        if step >= skip_batches \
+                                and np_resume_mid is not None:
+                            # rejoin the checkpoint's exact numpy
+                            # stream BEFORE fetching the first
+                            # non-replayed batch: the capture happened
+                            # after training batch k-1 and before
+                            # fetching batch k, so a dataset whose
+                            # __getitem__ consumes np.random must see
+                            # the restored state at fetch time —
+                            # restoring after the fetch (the PR-9
+                            # ordering) fed batch k the replay stream,
+                            # which lacks the training-time RNG
+                            # consumption and diverges from the
+                            # uninterrupted run
+                            np.random.set_state(np_resume_mid)
+                            np_resume_mid = None
                         # -- fetch (chaos-instrumented, bounded retry) --
                         batch = self._fetch_with_retry(
                             it, step_retries, step_retry_backoff_s,
@@ -449,9 +465,6 @@ class Model:
                         if step < skip_batches:
                             step += 1   # resume replay: already trained
                             continue
-                        if np_resume_mid is not None:
-                            np.random.set_state(np_resume_mid)
-                            np_resume_mid = None
                         cbks.on_batch_begin("train", step, logs)
                         x = batch[0]
                         y = batch[1] if len(batch) > 1 else None
@@ -504,7 +517,20 @@ class Model:
                               np_state_epoch_start=np.random.get_state())
         finally:
             if ckpt is not None:
-                ckpt.close()
+                import sys as _sys
+
+                in_flight = _sys.exc_info()[0] is not None
+                try:
+                    ckpt.close()
+                except Exception:  # noqa: BLE001 — see re-raise below
+                    # a close failure (flush timeout on a hung disk,
+                    # deferred write error) must never MASK a training
+                    # exception already propagating — FatalError is the
+                    # crash cause resume tooling keys on.  With no
+                    # exception in flight the close failure IS the
+                    # error and propagates as before.
+                    if not in_flight:
+                        raise
         cbks.on_end("train", logs)
         if save_dir:
             self.save(f"{save_dir}/final")
@@ -518,6 +544,8 @@ class Model:
         already have consumed a sampler index when it fails, so
         retrying it would silently skip a batch — a real loader
         failure propagates instead."""
+        from ..profiler.flight_recorder import recorder as _flight
+
         attempt = 0
         while True:
             try:
@@ -525,11 +553,13 @@ class Model:
                 break
             except (KeyboardInterrupt, SystemExit):
                 raise
-            except Exception:
+            except Exception as e:
                 attempt += 1
                 if attempt > retries:
                     raise
                 stat_add("train.step_retries", 1)
+                _flight.on_transition("train.retry", "loader.next",
+                                      f"{type(e).__name__}: {e}")
                 _time.sleep(backoff_s * (2 ** (attempt - 1)))
         try:
             return next(it)
@@ -545,7 +575,12 @@ class Model:
         faults stays bit-identical to a clean one.  A chaos ``kill``
         raises FatalError (never retried: it models process death; so
         does a real crash after the jitted update already donated the
-        previous state)."""
+        previous state).  Retries and fatals land in the flight
+        recorder — a FatalError additionally triggers a postmortem
+        bundle (when a bundle_dir is armed), so a training crash
+        leaves the same black box a replica death does."""
+        from ..profiler.flight_recorder import recorder as _flight
+
         attempt = 0
         while True:
             key_state = default_generator.get_state()
@@ -555,15 +590,22 @@ class Model:
                 if fault is not None and fault.action == KILL:
                     raise FatalError(fault.message)
                 return self.train_batch([x], [y])
-            except (KeyboardInterrupt, SystemExit, FatalError):
+            except (KeyboardInterrupt, SystemExit):
                 raise
-            except Exception:
+            except FatalError as e:
+                _flight.on_transition("train.fatal", "train.step",
+                                      str(e))
+                _flight.auto_dump(f"train step fatal: {e}")
+                raise
+            except Exception as e:
                 attempt += 1
                 default_generator.set_state(key_state)
                 np.random.set_state(np_state)
                 if attempt > retries:
                     raise
                 stat_add("train.step_retries", 1)
+                _flight.on_transition("train.retry", "train.step",
+                                      f"{type(e).__name__}: {e}")
                 _time.sleep(backoff_s * (2 ** (attempt - 1)))
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,

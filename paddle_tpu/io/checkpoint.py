@@ -122,6 +122,11 @@ class CheckpointStore:
         self.fsync = bool(fsync)
         # (path, reason) entries the last load_latest() skipped over
         self.last_skipped: List[Tuple[str, str]] = []
+        # tmp-dropping sweep throttle (PR-9 finding: the sweep ran its
+        # full listdir+stat scan on EVERY save — per-request serving
+        # snapshot stores commit many times a second)
+        self._last_sweep = 0.0
+        self._sweeps = 0
         os.makedirs(self.directory, exist_ok=True)
 
     # --- paths --------------------------------------------------------------
@@ -203,10 +208,21 @@ class CheckpointStore:
                 pass                     # already gone — retention races
         self._sweep_tmp()
 
-    def _sweep_tmp(self, max_age_s: float = 3600.0):
+    def _sweep_tmp(self, max_age_s: float = 3600.0,
+                   min_interval_s: float = 60.0, force: bool = False):
         """Remove stray ``*.ckpt.tmp.*`` droppings from crashed
         writers, once they are older than any live commit attempt
-        could be."""
+        could be.  Throttled to at most one directory scan per
+        ``min_interval_s`` (droppings only need max_age_s to pass
+        before they are ELIGIBLE, so scanning on every commit bought
+        nothing — the first sweep after the interval collects exactly
+        the same set); ``force=True`` bypasses the throttle (tests,
+        explicit maintenance)."""
+        now = time.time()
+        if not force and now - self._last_sweep < min_interval_s:
+            return
+        self._last_sweep = now
+        self._sweeps += 1
         for fn in os.listdir(self.directory):
             if ".ckpt.tmp." in fn:
                 full = os.path.join(self.directory, fn)

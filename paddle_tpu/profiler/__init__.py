@@ -23,9 +23,13 @@ from __future__ import annotations
 from ..framework.monitor import (gauge_set, histogram_observe,  # noqa: F401
                                  histogram_snapshot, stat_add, stat_get,
                                  stat_registry)
-from .chrome_trace import export_chrome_trace, to_trace_events  # noqa: F401
+from .chrome_trace import (export_chrome_trace,  # noqa: F401
+                           export_request_trace, request_trace_events,
+                           to_trace_events)
 from .exposition import (MetricsServer, prometheus_text,  # noqa: F401
                          start_metrics_server)
+from .flight_recorder import (FlightRecorder, RequestTrace,  # noqa: F401
+                              TraceContext, recorder)
 from .jit_cost import (CompileBudget, CompileBudgetExceeded,  # noqa: F401
                        CompileLedger, JitCostRegistry, ProfiledJit,
                        compile_budget, compile_ledger, cost_registry,
@@ -39,6 +43,8 @@ __all__ = [
     "enable_tracing", "disable_tracing", "tracing_enabled",
     "get_spans", "clear_spans", "aggregates", "reset_aggregates",
     "export_chrome_trace", "to_trace_events",
+    "request_trace_events", "export_request_trace",
+    "FlightRecorder", "RequestTrace", "TraceContext", "recorder",
     "prometheus_text", "start_metrics_server", "MetricsServer",
     "profiled_jit", "ProfiledJit", "JitCostRegistry", "cost_registry",
     "device_memory_stats",

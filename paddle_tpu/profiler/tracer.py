@@ -160,6 +160,11 @@ class Tracer:
         with self._lock:
             if len(self._instants) < self._max_spans:
                 self._instants.append(sp)
+            else:
+                # overflow is a fact the trace consumer must see —
+                # instants share the ``dropped`` counter with spans
+                # (previously they vanished uncounted past the cap)
+                self._dropped += 1
 
     def span(self, name: str, **args):
         """Context-manager span: ``with tracer.span("serving.step"): ...``"""

@@ -57,6 +57,9 @@ import numpy as np
 from ..framework.concurrency import OrderedLock
 from ..framework.errors import (AlreadyExistsError, InternalError,
                                 InvalidArgumentError)
+from ..profiler.flight_recorder import (EV_ADMITTED, EV_FIRST_TOKEN,
+                                        EV_PREFILL_CHUNK, EV_PREFIX_HIT)
+from ..profiler.flight_recorder import recorder as flight
 from ..profiler.jit_cost import cost_registry, profiled_jit
 from ..testing.chaos import chaos_site
 from ..utils.bucketing import chunk_schedule, next_pow2, smallest_bucket
@@ -840,6 +843,8 @@ class ServingEngine:
                 valid = min(s0 + size, n) - s0
                 ctok[:valid] = prompt[s0:s0 + valid]
                 cpos = (s0 + np.arange(size)).astype(np.int32)
+                flight.request_event(seq.seq_id, EV_PREFILL_CHUNK,
+                                     replica=self.chaos_key, size=size)
                 with RecordEvent("serving/prefill_chunk", size=size):
                     self._kv = self._prefill_jit(
                         jax.device_put(ctok), jax.device_put(cpos),
@@ -958,6 +963,8 @@ class ServingEngine:
                         self._ttft_recorded.add(seq.seq_id)
                         self.metrics.on_first_token(
                             seq.request.arrival_time, now)
+                        flight.request_event(seq.seq_id, EV_FIRST_TOKEN,
+                                             replica=self.chaos_key)
                 seq.generated.append(tok)
                 seq.next_token = tok
                 emitted += 1
@@ -984,6 +991,11 @@ class ServingEngine:
         self._ttft_recorded.discard(seq.seq_id)
         self._uploaded_pages.pop(seq.seq_id, None)
         self.metrics.on_completion()
+        # first-wins with the frontend's own resolve (same status) —
+        # standalone engines get terminal-complete traces too
+        flight.request_terminal(seq.seq_id, "completed",
+                                replica=self.chaos_key,
+                                tokens=seq.num_generated)
         if (lane < len(self._lanes)) and self._lanes[lane] is seq:
             self._lanes[lane] = None
             self._clear_lane(lane)
@@ -1024,10 +1036,14 @@ class ServingEngine:
         for req in sched.expire_queued(now):
             self._expired.append(req.request_id)
             self.metrics.on_deadline_miss()
+            flight.request_terminal(req.request_id, "deadline_miss",
+                                    replica=self.chaos_key)
         for seq in [s for s in sched.running if s.request.expired(now)]:
             if self.abort(seq.seq_id):
                 self._expired.append(seq.seq_id)
                 self.metrics.on_deadline_miss()
+                flight.request_terminal(seq.seq_id, "deadline_miss",
+                                        replica=self.chaos_key)
         # admission needs ground truth (free lanes/pages come from
         # retirements hiding in the pipeline), so it collapses the
         # pipeline first; a FULL batch skips the attempt entirely and
@@ -1036,6 +1052,13 @@ class ServingEngine:
             emitted += self._sync_pending()
             admitted = sched.admit()
             for seq in admitted:
+                flight.request_event(seq.seq_id, EV_ADMITTED,
+                                     replica=self.chaos_key,
+                                     resume=seq.request.resume is not None)
+                if seq.request.resume is None and seq.cached_tokens:
+                    flight.request_event(seq.seq_id, EV_PREFIX_HIT,
+                                         replica=self.chaos_key,
+                                         tokens=int(seq.cached_tokens))
                 # freshly allocated pages must quantize from scratch
                 # (dynamic int8 mode; no-op otherwise — and dynamic
                 # mode bypasses the prefix cache, so no shared page can
@@ -1089,6 +1112,7 @@ class ServingEngine:
             emitted += self._consume_one()
         self._maybe_shrink()
 
+        step_seconds = time.perf_counter() - t_step
         self.metrics.on_step(
             queue_depth=sched.queue_depth(),
             # lanes actually dispatched this step (pre-retirement), so a
@@ -1097,8 +1121,12 @@ class ServingEngine:
             running=dispatched_lanes if bucket else len(sched.running),
             bucket=bucket, pages_in_use=self.cache.pages_in_use,
             tokens_emitted=emitted,
-            step_seconds=time.perf_counter() - t_step,
+            step_seconds=step_seconds,
             kv_cache_bytes=self.kv_cache_bytes())
+        flight.on_step(self.chaos_key, bucket=bucket,
+                       lanes=dispatched_lanes,
+                       pages_in_use=self.cache.pages_in_use,
+                       step_ms=step_seconds * 1e3)
         return {
             "admitted": len(admitted),
             "running": len(sched.running),

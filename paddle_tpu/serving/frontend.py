@@ -63,6 +63,10 @@ from ..framework.errors import (AlreadyExistsError,
                                 ExecutionTimeoutError, InternalError,
                                 InvalidArgumentError,
                                 ResourceExhaustedError, UnavailableError)
+from ..profiler.flight_recorder import (EV_PLACED, EV_QUEUED,
+                                        EV_RESTARTED, EV_RESUMED_ON,
+                                        EV_SNAPSHOT)
+from ..profiler.flight_recorder import recorder as flight
 from ..testing.chaos import chaos_site
 from .engine import ServingEngine
 from .metrics import FrontendMetrics, ServingMetrics
@@ -401,7 +405,8 @@ class ServingFrontend:
                  placement_attempts: int = 4,
                  placement_backoff_s: float = 0.02,
                  snapshot_store=None,
-                 prefix_cache: Optional[bool] = None):
+                 prefix_cache: Optional[bool] = None,
+                 bundle_dir: Optional[str] = None):
         """Resilience knobs (docs/SERVING.md "Resilience"):
 
         - ``snapshot_interval``: checkpoint each in-flight request every
@@ -426,6 +431,11 @@ class ServingFrontend:
           prompts skip straight to the first uncached token.  None
           leaves the engines' own default (off); per-request opt-out
           via ``submit(prefix_cache=False)``.
+        - ``bundle_dir``: configure the process flight recorder to
+          write a postmortem bundle here on every replica death
+          (docs/OBSERVABILITY.md "Request tracing & flight recorder");
+          None leaves the recorder's current setting (tracing stays on
+          either way — only crash-time bundle WRITES need a directory).
         """
         if model is None and engine_factory is None:
             raise InvalidArgumentError(
@@ -544,6 +554,21 @@ class ServingFrontend:
                                  name=f"serving-pump-{rep.id}", daemon=True)
             rep.thread = t
             t.start()
+        # flight recorder (ISSUE 11): request traces are always on; a
+        # bundle_dir arms crash-time postmortem writes, and the context
+        # provider hands the dump per-replica engine stats + health.
+        # The arming is UNDONE at close() (restoring the prior value)
+        # so a later fleet in the same process doesn't keep dumping
+        # into this one's — possibly deleted — directory.
+        self._armed_bundle_dir = None
+        self._prev_bundle_dir = None
+        if bundle_dir is not None:
+            self._prev_bundle_dir = flight.bundle_dir
+            flight.configure(bundle_dir=bundle_dir)
+            self._armed_bundle_dir = bundle_dir
+        self._recorder_ctx = f"serving.frontend-{id(self):x}"
+        flight.register_context(self._recorder_ctx,
+                                self._postmortem_context)
         self._monitor_thread = None
         if self.watchdog is not None:
             self._monitor_thread = threading.Thread(
@@ -606,6 +631,12 @@ class ServingFrontend:
             # BEFORE the terminal-at-submit outcomes — so submitted ==
             # completed+rejects+cancels+deadline_miss+failures holds
             self.metrics.on_submit()
+            # trace id assigned at submit: every accepted submission
+            # gets a timeline, terminal-at-submit outcomes included
+            flight.start_trace(rid).event(
+                EV_QUEUED, prompt_tokens=int(prompt.size),
+                max_new_tokens=int(max_new_tokens),
+                deadline_ms=deadline_ms)
             if self._closing:
                 return self._reject_locked(handle, "frontend is closing")
             if stage >= BROWNOUT_REJECT:
@@ -622,6 +653,9 @@ class ServingFrontend:
                 handle._finish(DEADLINE_MISS,
                                detail="deadline expired at submit")
                 self.metrics.on_deadline_miss()
+                flight.request_terminal(rid, DEADLINE_MISS,
+                                        detail="deadline expired at "
+                                               "submit")
                 return handle
             rep = self.router.pick(cost=cost)
             if rep is not None:
@@ -688,6 +722,8 @@ class ServingFrontend:
         rep.inbox.append(entry)
         rep.wake.set()
         self._update_depth_gauges_locked()
+        flight.request_event(handle.request_id, EV_PLACED,
+                             replica=rep.id)
 
     def _pressure_locked(self) -> float:
         """Queue pressure in [0, 1]: live requests over queue_cap (an
@@ -738,6 +774,8 @@ class ServingFrontend:
                        error_cls: Optional[type] = None) -> ResponseHandle:
         handle._finish(REJECTED, detail=detail, error_cls=error_cls)
         self.metrics.on_reject()
+        flight.request_terminal(handle.request_id, REJECTED,
+                                detail=detail)
         return handle
 
     # --- cancellation -------------------------------------------------------
@@ -809,12 +847,20 @@ class ServingFrontend:
                 if self._closing or rid in self._live:
                     continue
                 self.metrics.on_submit()
+                flight.start_trace(rid).event(
+                    EV_QUEUED, prompt_tokens=int(snap.prompt.size),
+                    max_new_tokens=int(snap.max_new_tokens),
+                    recovered_from_disk=True)
                 if (handle.deadline is not None
                         and time.monotonic() >= handle.deadline):
                     handle._finish(DEADLINE_MISS,
                                    detail="deadline expired before "
                                           "restart recovery")
                     self.metrics.on_deadline_miss()
+                    flight.request_terminal(
+                        rid, DEADLINE_MISS,
+                        detail="deadline expired before restart "
+                               "recovery")
                     handles.append(handle)
                     continue
                 rep = self.router.pick(
@@ -826,6 +872,9 @@ class ServingFrontend:
                                           "restart recovery",
                                    error_cls=UnavailableError)
                     self.metrics.on_failure()
+                    flight.request_terminal(
+                        rid, FAILED, detail="no healthy replica for "
+                                            "restart recovery")
                     handles.append(handle)
                     continue
                 entry = _Entry(handle, snap.prompt, snap.max_new_tokens,
@@ -837,6 +886,9 @@ class ServingFrontend:
                 rep.inbox.append(entry)
                 rep.wake.set()
                 self._update_depth_gauges_locked()
+                flight.request_event(rid, EV_RESUMED_ON, replica=rep.id,
+                                     from_token=n,
+                                     recovered_from_disk=True)
             self.metrics.on_recovered()
             handles.append(handle)
         # the deadline-missed slots above are client-visible terminals —
@@ -874,12 +926,43 @@ class ServingFrontend:
                                 else self.brownout.stage)
         return hz
 
+    def trace(self, request_id: str) -> Optional[dict]:
+        """Structured lifecycle timeline of a live or recently-terminal
+        request (queued → placed → admitted → ... → terminal, replicas
+        annotated), or None when unknown.  Export it with
+        ``profiler.export_request_trace`` or fetch it over HTTP at
+        ``GET /debug/requests/<rid>``."""
+        return flight.trace(request_id)
+
+    def recent_traces(self) -> List[dict]:
+        """Summaries of recently-terminal request traces (newest last)
+        — the ``GET /debug/requests`` listing."""
+        return flight.recent_traces()
+
+    def _postmortem_context(self) -> dict:
+        """Dump-time context for postmortem bundles: per-replica health
+        + engine stats.  Runs on whichever thread triggered the dump
+        while pump threads may still be stepping — engine stats are
+        host-side reads, a racing mutation at worst skews a count in a
+        diagnostic artifact (and a raising provider degrades to an
+        error string in the bundle, never blocks the dump)."""
+        out = {"replicas": {}, "health": self.health()}
+        for rep in self._replicas:
+            out["replicas"][rep.id] = {
+                "state": rep.state,
+                "steps": rep.steps,
+                "dead_reason": rep.dead_reason or None,
+                "engine": rep.engine.stats(),
+            }
+        return out
+
     def stats(self) -> dict:
         """Frontend + fleet-aggregate engine metrics + router health."""
         return {
             "frontend": self.metrics.snapshot(),
             "engines": self.engine_metrics.snapshot(),
             "router": self.router.healthz(),
+            "recorder": flight.snapshot(),
             "resilience": {
                 "snapshot_interval": self.snapshot_interval,
                 "watchdog_enabled": self.watchdog is not None,
@@ -909,6 +992,11 @@ class ServingFrontend:
             leftovers = list(self._live.values())
         for entry in leftovers:
             self._resolve(entry, FAILED, detail="frontend closed")
+        flight.unregister_context(self._recorder_ctx)
+        if (self._armed_bundle_dir is not None
+                and flight.bundle_dir == self._armed_bundle_dir):
+            # restore only if nobody re-armed it since (last-set wins)
+            flight.bundle_dir = self._prev_bundle_dir
 
     def __enter__(self):
         return self
@@ -978,6 +1066,11 @@ class ServingFrontend:
                 self.metrics.on_reject()
             elif status == FAILED:
                 self.metrics.on_failure()
+            # first-wins with the engine's completed-at-retire record
+            # (same status); every other outcome is frontend-owned
+            flight.request_terminal(rid, status, detail=detail,
+                                    tokens=h.num_tokens,
+                                    retried=h.retried)
         return finished
 
     def _pump(self, rep: Replica):
@@ -1104,6 +1197,10 @@ class ServingFrontend:
                     entry.snapshot = snap
                     entry.snap_tokens = snap.num_generated
                     updated = True
+            if updated:
+                flight.request_event(entry.handle.request_id,
+                                     EV_SNAPSHOT, replica=rep.id,
+                                     tokens=snap.num_generated)
             if updated and self._snapshot_store is not None:
                 # disk durability rides on the warm-failover checkpoint
                 # (pump thread, outside the frontend lock).  Best-effort:
@@ -1192,8 +1289,15 @@ class ServingFrontend:
                 # tokens before the checkpoint are NOT re-decoded — the
                 # warm-failover win vs a token-0 restart
                 self.metrics.on_recompute_saved(snap.num_generated)
+                flight.request_event(h.request_id, EV_RESUMED_ON,
+                                     replica=target.id,
+                                     from_token=snap.num_generated,
+                                     dead_replica=rep.id)
             else:
                 h._on_retry()
+                flight.request_event(h.request_id, EV_RESTARTED,
+                                     replica=target.id,
+                                     dead_replica=rep.id)
             self.metrics.on_retry()
             with self._lock:
                 self.router.discharge(rep, entry.cost)
@@ -1205,6 +1309,11 @@ class ServingFrontend:
                 target.inbox.append(entry)
                 target.wake.set()
                 self._update_depth_gauges_locked()
+        # black box: replica death is THE postmortem trigger — after the
+        # victims are requeued (their resumed_on/restarted events are in
+        # the rings) write the bundle, if a bundle_dir is armed.  Never
+        # raises; the failover above already succeeded either way.
+        flight.auto_dump(f"replica {rep.id} died: {reason}")
 
     def _monitor(self):
         """Watchdog thread: scan replicas for overdue/hung engine steps
@@ -1269,7 +1378,7 @@ def create_serving_frontend(model, config=None, **overrides
                 "engine_factory", "metrics", "poll_interval_s",
                 "snapshot_interval", "watchdog", "brownout",
                 "placement_attempts", "placement_backoff_s",
-                "snapshot_store", "prefix_cache"):
+                "snapshot_store", "prefix_cache", "bundle_dir"):
         if key in overrides:
             fe_kwargs[key] = overrides.pop(key)
     engine_kwargs.update(overrides)

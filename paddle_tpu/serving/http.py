@@ -23,6 +23,17 @@ Endpoints
                      StatRegistry (``serving.*`` engine metrics,
                      ``serving.frontend.*`` request metrics, and
                      everything else the process records).
+``GET /debug/requests``
+                     recent TERMINAL request traces (newest last) plus
+                     the ids of live ones — the flight recorder's
+                     request index (ISSUE 11).
+``GET /debug/requests/<rid>``
+                     one request's structured lifecycle timeline
+                     (queued → placed → admitted → ... → terminal,
+                     replica-annotated — a failover trace spans both
+                     replicas).  ``?format=chrome`` returns the same
+                     timeline as Chrome-trace JSON (chrome://tracing /
+                     Perfetto); unknown/evicted ids are ``404``.
 
 A client disconnect mid-stream cancels the request (frees its pages and
 batch lane) instead of decoding tokens nobody will read.
@@ -106,7 +117,8 @@ class _Handler(http.server.BaseHTTPRequestHandler):
 
     # --- routes -------------------------------------------------------------
     def do_GET(self):                     # noqa: N802 — http.server contract
-        path = self.path.split("?", 1)[0].rstrip("/")
+        path, _, query = self.path.partition("?")
+        path = path.rstrip("/")
         if path == "/healthz":
             hz = self.frontend.health()
             self._send_json(200 if hz["status"] == "ok" else 503, hz)
@@ -118,6 +130,26 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif path == "/debug/requests":
+            from ..profiler.flight_recorder import recorder
+
+            self._send_json(200, {
+                "recent": self.frontend.recent_traces(),
+                "live": recorder.live_request_ids()})
+        elif path.startswith("/debug/requests/"):
+            rid = path[len("/debug/requests/"):]
+            trace = self.frontend.trace(rid)
+            if trace is None:
+                self._send_json(404, {"error": f"no trace for request "
+                                               f"{rid!r} (unknown or "
+                                               "evicted)"})
+                return
+            if "format=chrome" in query:
+                from ..profiler.chrome_trace import request_trace_events
+
+                self._send_json(200, request_trace_events(trace))
+            else:
+                self._send_json(200, trace)
         else:
             self._send_json(404, {"error": f"no route {self.path!r}"})
 

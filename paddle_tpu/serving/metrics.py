@@ -15,6 +15,15 @@ the profiler summary table and in the Chrome-trace timeline
 programs carry FLOPs/bytes attribution via
 ``profiler.cost_registry`` (names ``serving.prefill`` /
 ``serving.decode``).
+
+Aggregates answer "how is the fleet doing"; the REQUEST-SCOPED view
+("what happened to request X") lives in the flight recorder
+(``profiler.flight_recorder``, ISSUE 11): every submission carries a
+trace id, lifecycle events land in bounded rings next to these
+counters, and the ``serving.trace.*`` / ``recorder.*`` registry names
+it emits are documented alongside this module's in
+docs/OBSERVABILITY.md (enforced both ways by the ``metrics-drift``
+checker).
 """
 from __future__ import annotations
 

@@ -65,6 +65,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..framework.errors import InvalidArgumentError
+from ..profiler.flight_recorder import recorder as flight
 from .kv_cache import PagedKVCache
 
 __all__ = ["PrefixCache"]
@@ -199,6 +200,12 @@ class PrefixCache:
                     self.metrics.on_prefix_evict()
         if released:
             self._publish_gauge()
+            # black-box context: a burst of index evictions right before
+            # an incident usually IS the incident (thrash under memory
+            # pressure) — record it fleet-wide, not just as a counter
+            flight.on_transition(
+                "prefix.evicted", "index",
+                f"released={released} resident_pages={len(self._by_page)}")
         return released
 
     def _drop_node(self, node: _Node):

@@ -34,6 +34,7 @@ import numpy as np
 
 from ..framework.concurrency import OrderedLock
 from ..framework.monitor import stat_registry
+from ..profiler.flight_recorder import recorder as flight
 
 __all__ = ["EngineSnapshot", "WatchdogConfig", "Watchdog",
            "BrownoutPolicy", "BrownoutController",
@@ -480,4 +481,10 @@ class BrownoutController:
             self._stage = self._streak_target
             self._streak_target, self._streak_dir, self._streak = None, 0, 0
             stat_registry.get("serving.brownout_stage").set(self._stage)
+            # fleet-wide black box: a brownout stage change is exactly
+            # the "what was happening before X" context a postmortem
+            # bundle needs next to the per-request shed/clamp events
+            flight.on_transition("brownout.stage",
+                                 BROWNOUT_STAGES[self._stage],
+                                 f"pressure={pressure:.3f}")
         return self._stage

@@ -44,6 +44,7 @@ from typing import List, Optional
 
 from ..framework.concurrency import OrderedRLock
 from ..framework.errors import AlreadyExistsError, NotFoundError
+from ..profiler.flight_recorder import recorder as flight
 
 __all__ = ["Replica", "Router", "HEALTHY", "SUSPECT", "DRAINING", "DEAD"]
 
@@ -218,33 +219,44 @@ class Router:
     def set_draining(self, replica_id: str):
         """Graceful drain: stop routing new work to the replica; its
         in-flight requests run to completion."""
+        changed = False
         with self._lock:
             rep = self.get(replica_id)
             if rep.state in (HEALTHY, SUSPECT):
                 rep.state = DRAINING
+                changed = True
+        if changed:
+            flight.on_transition("replica.draining", replica_id)
 
     def mark_suspect(self, replica: Replica) -> bool:
         """Watchdog: pull an overdue replica from the routing pool (its
         in-flight work continues — a straggler, not a corpse).  Returns
         True when the state actually changed."""
         with self._lock:
-            if replica.state == HEALTHY:
+            changed = replica.state == HEALTHY
+            if changed:
                 replica.state = SUSPECT
-                return True
-            return False
+        if changed:
+            flight.on_transition("replica.suspect", replica.id,
+                                 "watchdog: overdue engine step")
+        return changed
 
     def mark_healthy(self, replica: Replica) -> bool:
         """Watchdog re-admission after backoff: SUSPECT → HEALTHY."""
         with self._lock:
-            if replica.state == SUSPECT:
+            changed = replica.state == SUSPECT
+            if changed:
                 replica.state = HEALTHY
-                return True
-            return False
+        if changed:
+            flight.on_transition("replica.healthy", replica.id,
+                                 "watchdog: re-admitted after backoff")
+        return changed
 
     def mark_dead(self, replica: Replica, reason: str = ""):
         with self._lock:
             replica.state = DEAD
             replica.dead_reason = reason
+        flight.on_transition("replica.dead", replica.id, reason)
 
     def healthz(self) -> dict:
         """Health summary (the /healthz payload's router section)."""

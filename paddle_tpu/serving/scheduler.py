@@ -43,6 +43,8 @@ import numpy as np
 
 from ..framework.errors import (InvalidArgumentError,
                                 ResourceExhaustedError)
+from ..profiler.flight_recorder import EV_PREEMPTED
+from ..profiler.flight_recorder import recorder as flight
 from ..utils.bucketing import pow2_buckets, smallest_bucket
 from .kv_cache import PagedKVCache
 
@@ -323,6 +325,10 @@ class Scheduler:
         seq.reset()
         self.waiting.appendleft(seq.request)
         self.num_preemptions += 1
+        # the single choke point every eviction passes through — the
+        # request's timeline shows preempted → (re)admitted → replay
+        flight.request_event(seq.seq_id, EV_PREEMPTED,
+                             preemptions=seq.preemptions)
 
     # --- retirement -------------------------------------------------------
     def finish(self, seq: Sequence):

@@ -249,6 +249,10 @@ def chaos_site(site: str, key: Optional[str] = None) -> Optional[Fault]:
     Generic actions are applied HERE (``delay`` sleeps, ``raise`` raises
     the fault's InternalError); site-specific actions (``deny``,
     ``kill``, ``http_error``) are returned for the caller to act on.
+    Every firing ALSO lands in the flight recorder's fault ring
+    (ISSUE 11) — a postmortem bundle shows the injected faults next to
+    the lifecycle events they caused, and the seeded-plan determinism
+    pin extends to the bundle's fault multiset.
     """
     plan = _ACTIVE
     if plan is None:
@@ -256,6 +260,9 @@ def chaos_site(site: str, key: Optional[str] = None) -> Optional[Fault]:
     fault = plan.fire(site, key)
     if fault is None:
         return None
+    from ..profiler.flight_recorder import recorder
+
+    recorder.on_fault(site, key, fault.action, fault.seen)
     if fault.action == DELAY:
         time.sleep(fault.delay_s)
         return fault

@@ -36,3 +36,27 @@ def _seed():
 
     paddle_tpu.seed(102)
     yield
+
+
+@pytest.fixture(scope="session")
+def shared_gpt_small():
+    """ONE tiny GPT for the serving-stack test modules (ISSUE 11 suite
+    health).  Seven modules (serving / async / abort / frontend /
+    resilience / prefix_cache / quant_serving) each built the IDENTICAL
+    model — seed 11, vocab 50, hid 32, 2 layers / 2 heads, ffn 64,
+    seq 64 — so each module recompiled the same serving XLA programs.
+    The engine's shared-program cache is keyed per MODEL OBJECT: one
+    session-scoped instance compiles each program once for the whole
+    suite.  Weights are identical to what every module built before
+    (same seed at construction), so every byte-identity reference is
+    unchanged.  Eval-only by contract — serving tests never train it.
+    test_jit_ledger deliberately keeps its own private models: its
+    compile-count pins need a cold program cache."""
+    import paddle_tpu
+    from paddle_tpu.text.models import GPTModel
+
+    paddle_tpu.seed(11)
+    m = GPTModel(vocab_size=50, hidden_size=32, num_layers=2,
+                 num_heads=2, ffn_size=64, max_seq_len=64, dropout=0.0)
+    m.eval()
+    return m

@@ -127,6 +127,22 @@ class TestFailOnRegression:
         assert bench_diff.lower_is_better(
             "detail.prefix_cache.rates.rate09.cow_copies")
         assert bench_diff.lower_is_better("serving.prefix.misses")
+        # observability section (ISSUE 11): the tracing/recorder
+        # overhead %, bundle size and dump latency all regress UPWARD;
+        # the A/B throughput arms and TTFT classify like any other
+        # per_sec / _ms metric
+        assert bench_diff.lower_is_better(
+            "detail.observability.trace_overhead_pct")
+        assert bench_diff.lower_is_better(
+            "detail.observability.bundle_bytes")
+        assert bench_diff.lower_is_better(
+            "detail.observability.bundle_dump_ms")
+        assert bench_diff.lower_is_better(
+            "detail.observability.ttft_ms_p95_on")
+        assert not bench_diff.lower_is_better(
+            "detail.observability.tokens_per_sec_on")
+        assert not bench_diff.lower_is_better(
+            "detail.observability.tokens_per_sec_off")
 
     def test_reduction_ratio_gates_on_drop_not_rise(self):
         """The PR-4 acceptance metric: kv_bytes_reduction_x falling

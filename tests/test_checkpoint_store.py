@@ -108,6 +108,26 @@ class TestCommitAndLoad:
         store.save_named("req-y", _state(1.0))
         assert not os.path.exists(stray)
 
+    def test_sweep_throttled_not_per_save(self, store):
+        """PR-9 follow-up: the tmp sweep's full directory scan must not
+        run on EVERY commit (a serving snapshot_store commits many
+        times a second) — at most one scan per interval, and droppings
+        only become eligible after max_age_s anyway, so the first sweep
+        after the interval collects the same set."""
+        for i in range(6):
+            store.save_named("req-a", _state(float(i)))
+            store.save(_state(float(i)), step=i)
+        assert store._sweeps == 1          # first commit swept, rest throttled
+        # the throttle never strands droppings: once the interval
+        # passes (or a forced sweep runs) old tmps still go
+        stray = os.path.join(store.directory, "slot-z.ckpt.tmp.9.9")
+        open(stray, "wb").write(b"partial")
+        old = os.path.getmtime(stray) - 7200
+        os.utime(stray, (old, old))
+        store._sweep_tmp(force=True)
+        assert not os.path.exists(stray)
+        assert store._sweeps == 2
+
 
 class TestAtomicityUnderChaos:
     """The acceptance pin: kill the writer at every injection point —

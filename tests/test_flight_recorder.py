@@ -1,0 +1,514 @@
+"""Flight recorder + request-lifecycle tracing acceptance (ISSUE 11).
+
+The acceptance bars pinned here:
+
+- a seeded-chaos run (1 kill + 1 straggler over 8 requests /
+  2 replicas) produces a POSTMORTEM BUNDLE whose fault-site multiset
+  and victim request timelines are deterministic across a double
+  drive, and the victims' traces show queued → placed → … →
+  resumed_on → terminal spanning BOTH replicas — exportable as ONE
+  Chrome-trace JSON;
+- with tracing and the flight recorder enabled (they always are),
+  steady-state decode stays ``jax.transfer_guard("disallow")``-clean
+  and ``compile_budget(0, prefix="serving.")``-clean;
+- ring buffers are bounded (overwrites counted, live traces capped),
+  terminal events are exactly-once, bundles commit atomically;
+- ``GET /debug/requests`` / ``/debug/requests/<rid>`` serve the
+  listing and the timeline (``?format=chrome`` included);
+- a chaos-killed TRAINING run leaves the same black box.
+
+Determinism contract (the PR-6 idiom carried over): the schedule, the
+per-request outcomes, the fault (site, action) multiset and each
+victim's STRUCTURAL event subsequence are pinned; wall-clock
+interleaving across free-running pump threads (which pump logs an
+unmatched fault first, how admissions split across steps and therefore
+snapshot/prefill-chunk repeat counts) is explicitly not part of it.
+"""
+import json
+import os
+import urllib.request
+from collections import Counter
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.errors import InvalidArgumentError
+from paddle_tpu.profiler import chrome_trace
+from paddle_tpu.profiler.flight_recorder import (EV_TERMINAL,
+                                                 FlightRecorder, recorder)
+from paddle_tpu.serving import ServingEngine, ServingFrontend
+from paddle_tpu.serving.router import DEAD
+from paddle_tpu.testing import chaos
+from paddle_tpu.testing.chaos import ChaosPlan, Fault
+
+VOCAB = 50
+ENGINE_KW = dict(page_size=4, max_batch_size=4, eos_id=-1)
+
+# the structural lifecycle phases every drive must reproduce exactly;
+# repeatable events (prefill_chunk, snapshot, preempted) depend on how
+# admissions split across steps — wall clock, outside the contract
+STRUCTURAL = ("queued", "placed", "admitted", "first_token",
+              "resumed_on", "restarted", "terminal")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    """Every test starts with empty rings and no bundle dir, and the
+    lock witness hunts inversions across the pump threads."""
+    from paddle_tpu.framework import concurrency
+
+    recorder.reset()
+    recorder.configure(enabled=True)
+    old_dir = recorder.bundle_dir
+    recorder.bundle_dir = None
+    with concurrency.witness(raise_on_violation=False):
+        yield
+    concurrency.assert_clean()
+    recorder.bundle_dir = old_dir
+    recorder.reset()
+
+
+@pytest.fixture(scope="module")
+def gpt(shared_gpt_small):
+    return shared_gpt_small
+
+
+# =============================================================================
+# Recorder units (no engines)
+# =============================================================================
+class TestRecorderUnits:
+    def test_rings_bounded_and_drop_counted(self):
+        from paddle_tpu.framework.monitor import stat_get
+
+        r = FlightRecorder(ring_size=4, traces_keep=2)
+        d0 = stat_get("recorder.dropped")
+        for i in range(10):
+            r.on_transition("k", f"t{i}")
+        snap = r.snapshot()
+        assert snap["transitions"] == 4
+        assert stat_get("recorder.dropped") - d0 == 6
+
+    def test_trace_lifecycle_and_terminal_first_wins(self):
+        r = FlightRecorder(ring_size=16, traces_keep=4)
+        ctx = r.start_trace("a")
+        ctx.event("queued", prompt_tokens=3)
+        ctx.event("placed", replica="replica-0")
+        ctx.terminal("completed", tokens=5)
+        ctx.terminal("failed")            # late duplicate: ignored
+        t = r.trace("a")
+        assert t["status"] == "completed"
+        assert [e["kind"] for e in t["events"]] == \
+            ["queued", "placed", "terminal"]
+        assert t["events"][-1]["status"] == "completed"
+        # relative times monotone, absolute ns kept
+        assert t["events"][0]["t_ms"] == 0.0
+        assert all(e["t_ms"] >= 0 for e in t["events"])
+
+    def test_terminal_ring_bounded_and_listing_order(self):
+        r = FlightRecorder(ring_size=64, traces_keep=3)
+        for i in range(5):
+            r.start_trace(f"r{i}").terminal("completed")
+        recent = r.recent_traces()
+        assert [s["request_id"] for s in recent] == ["r2", "r3", "r4"]
+        assert r.trace("r0") is None      # evicted from the done ring
+
+    def test_live_cap_evicts_oldest(self):
+        r = FlightRecorder(ring_size=64, traces_keep=8, live_cap=3)
+        for i in range(5):
+            r.start_trace(f"r{i}").event("queued")
+        assert len(r.live_request_ids()) == 3
+        assert "r0" not in r.live_request_ids()
+        assert "r4" in r.live_request_ids()
+
+    def test_disabled_recorder_records_nothing(self):
+        r = FlightRecorder(ring_size=8)
+        r.configure(enabled=False)
+        r.start_trace("x").event("queued")
+        r.on_step("rep", bucket=2, lanes=2, pages_in_use=1, step_ms=1.0)
+        r.on_fault("s", None, "kill", 1)
+        snap = r.snapshot()
+        assert snap["events"] == snap["steps"] == snap["faults"] == 0
+        assert r.trace("x") is None
+
+    def test_dump_needs_dir_or_path(self, tmp_path):
+        r = FlightRecorder(ring_size=8)
+        with pytest.raises(InvalidArgumentError):
+            r.dump("no dir")
+        assert r.auto_dump("crash") is None   # dir unarmed: silent no-op
+        r.start_trace("x").event("queued")
+        p = str(tmp_path / "pm.json")
+        bundle = r.dump("manual", path=p)
+        on_disk = json.load(open(p))
+        assert on_disk["reason"] == "manual"
+        assert on_disk["schema"] == bundle["schema"]
+        assert on_disk["live_traces"][0]["request_id"] == "x"
+        assert "metrics" in on_disk and "compile_ledger" in on_disk
+
+    def test_concurrent_dumps_never_collide(self, tmp_path):
+        """Two replicas dying at once dump from two pump threads — the
+        bundle index is reserved under the lock, so neither postmortem
+        overwrites the other."""
+        import threading
+
+        r = FlightRecorder(ring_size=8, bundle_dir=str(tmp_path))
+        r.start_trace("x").event("queued")
+        barrier = threading.Barrier(2)
+
+        def dump():
+            barrier.wait()
+            r.dump("simultaneous")
+
+        ts = [threading.Thread(target=dump) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+        files = sorted(os.listdir(str(tmp_path)))
+        assert files == ["postmortem-0000.json", "postmortem-0001.json"]
+
+    def test_dump_context_provider_errors_degrade(self, tmp_path):
+        r = FlightRecorder(ring_size=8, bundle_dir=str(tmp_path))
+        r.register_context("ok", lambda: {"n": 1})
+        r.register_context("boom", lambda: 1 / 0)
+        bundle = r.dump("ctx")
+        assert bundle["context"]["ok"] == {"n": 1}
+        assert "ZeroDivisionError" in bundle["context"]["boom"]["error"]
+        r.unregister_context("ok")
+        assert "ok" not in r.build_bundle("again")["context"]
+
+
+# =============================================================================
+# Chrome export of request timelines
+# =============================================================================
+class TestChromeExport:
+    def _failover_trace(self):
+        r = FlightRecorder(ring_size=64)
+        ctx = r.start_trace("req-9")
+        ctx.event("queued", prompt_tokens=4)
+        ctx.event("placed", replica="replica-0")
+        ctx.event("admitted", replica="replica-0")
+        ctx.event("first_token", replica="replica-0")
+        ctx.event("snapshot", replica="replica-0", tokens=4)
+        ctx.event("resumed_on", replica="replica-1", from_token=4,
+                  dead_replica="replica-0")
+        ctx.event("admitted", replica="replica-1")
+        ctx.terminal("completed", tokens=10)
+        return r.trace("req-9")
+
+    def test_failover_trace_spans_two_replicas_one_file(self, tmp_path):
+        doc = chrome_trace.request_trace_events(self._failover_trace())
+        evs = doc["traceEvents"]
+        rows = {e["args"]["name"] for e in evs
+                if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert {"frontend", "replica-0", "replica-1"} <= rows
+        bars = [e for e in evs if e["ph"] == "X"]
+        # one bar per replica segment + the frontend row
+        assert len(bars) == 3
+        instants = [e for e in evs if e["ph"] == "i"]
+        assert {"queued", "resumed_on", "terminal"} <= \
+            {e["name"] for e in instants}
+        path = chrome_trace.export_request_trace(
+            str(tmp_path / "req.json"), self._failover_trace())
+        loaded = json.load(open(path))
+        assert loaded["traceEvents"]
+
+
+# =============================================================================
+# Standalone engine: traces without a frontend
+# =============================================================================
+class TestEngineTraces:
+    def test_engine_drain_builds_timelines_and_step_records(self, gpt):
+        eng = ServingEngine(gpt, **ENGINE_KW)
+        rng = np.random.RandomState(3)
+        rid = eng.add_request(rng.randint(1, VOCAB, (9,)).astype(np.int32),
+                              max_new_tokens=6)
+        eng.drain()
+        t = recorder.trace(rid)
+        kinds = [e["kind"] for e in t["events"]]
+        assert kinds[0] == "admitted"
+        assert "prefill_chunk" in kinds and "first_token" in kinds
+        assert t["status"] == "completed"
+        assert t["events"][-1]["kind"] == EV_TERMINAL
+        assert recorder.snapshot()["steps"] > 0
+
+    def test_preemption_event_recorded(self, gpt):
+        # tiny pool: two long requests cannot coexist — the scheduler
+        # preempts, and the victim's timeline shows it
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=2,
+                            num_pages=9, eos_id=-1)
+        rng = np.random.RandomState(5)
+        rids = [eng.add_request(
+            rng.randint(1, VOCAB, (8,)).astype(np.int32),
+            max_new_tokens=12) for _ in range(2)]
+        eng.drain()
+        assert eng.scheduler.num_preemptions > 0
+        preempted = [r for r in rids
+                     if any(e["kind"] == "preempted"
+                            for e in recorder.trace(r)["events"])]
+        assert preempted
+
+
+# =============================================================================
+# THE acceptance: seeded chaos → deterministic postmortem bundle
+# =============================================================================
+def _chaos_plan():
+    """1 replica kill + 1 straggler step over 8 requests / 2 replicas
+    (the ISSUE 11 acceptance schedule).  eos_id=-1 keeps every request
+    decoding to its full budget, so the victim set is exactly the
+    deterministic replica-0 placement."""
+    return ChaosPlan([
+        Fault("replica.kill", at=8, action="kill", match="replica-0"),
+        Fault("engine.step", at=9, action="delay", delay_s=0.05),
+    ], name="issue11-acceptance")
+
+
+def _drive(gpt, plan, bundle_dir):
+    recorder.reset()
+    recorder.configure(enabled=True)
+    fe = ServingFrontend(gpt, replicas=2, queue_cap=32,
+                         engine_kwargs=ENGINE_KW, snapshot_interval=2,
+                         bundle_dir=bundle_dir)
+    try:
+        rng = np.random.RandomState(7)
+        prompts = [rng.randint(1, VOCAB, (p,)).astype(np.int32)
+                   for p in (3, 5, 9, 4, 7, 6, 8, 2)]
+        with chaos.running(plan):
+            handles = [fe.submit(p, max_new_tokens=10) for p in prompts]
+            statuses = [h.wait(timeout=300) for h in handles]
+        states = {rep.id: rep.state for rep in fe._replicas}
+        traces = {h.request_id: fe.trace(h.request_id) for h in handles}
+        tokens = {h.request_id: h.tokens.tolist() for h in handles}
+        victims = [h.request_id for h in handles if h.retried]
+        return statuses, states, traces, tokens, victims
+    finally:
+        fe.close()
+        recorder.bundle_dir = None
+
+
+def _structural(trace):
+    return [e["kind"] for e in trace["events"]
+            if e["kind"] in STRUCTURAL]
+
+
+class TestChaosPostmortemAcceptance:
+    def test_double_drive_deterministic_bundle(self, gpt, tmp_path):
+        dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+        plan_a = _chaos_plan()
+        st_a, states_a, traces_a, tok_a, victims_a = _drive(
+            gpt, plan_a, dir_a)
+        # 1) outcomes: every request completed despite the kill
+        assert st_a == ["completed"] * 8
+        assert states_a["replica-0"] == DEAD
+        assert victims_a, "the kill produced no victims"
+        # 2) the bundle exists and is machine-readable
+        bundles_a = sorted(os.listdir(dir_a))
+        assert bundles_a, "replica death wrote no postmortem bundle"
+        pm_a = json.load(open(os.path.join(dir_a, bundles_a[0])))
+        assert pm_a["schema"] == 1
+        assert "replica-0 died" in pm_a["reason"]
+        # faults that had fired by dump time are in the bundle; the
+        # full drive fired exactly the schedule
+        assert sorted((f["site"], f["action"])
+                      for f in pm_a["chaos_faults"]) <= \
+            [("engine.step", "delay"), ("replica.kill", "kill")]
+        assert any(f["site"] == "replica.kill"
+                   for f in pm_a["chaos_faults"])
+        assert any(t["kind"] == "replica.dead"
+                   for t in pm_a["transitions"])
+        assert pm_a["engine_steps"], "no step records in the bundle"
+        ctx = [v for k, v in pm_a["context"].items()
+               if k.startswith("serving.frontend")]
+        assert ctx and "replica-0" in ctx[0]["replicas"]
+        # 3) victim timelines: queued → placed → … → resumed_on →
+        #    terminal, spanning BOTH replicas
+        for rid in victims_a:
+            tr = traces_a[rid]
+            ks = _structural(tr)
+            assert ks[0:3] == ["queued", "placed", "admitted"]
+            assert "resumed_on" in ks or "restarted" in ks
+            assert ks[-1] == "terminal"
+            assert tr["status"] == "completed"
+            if "resumed_on" in ks:
+                assert set(tr["replicas"]) == {"replica-0", "replica-1"}
+        # at least one victim RESUMED from a checkpoint (snapshot_interval
+        # 2 over ≥5 decoded tokens) — the warm-failover trace shape
+        assert any("resumed_on" in _structural(traces_a[r])
+                   for r in victims_a)
+        # 4) one victim's whole story exports as ONE chrome trace with
+        #    both replica rows
+        rid = next(r for r in victims_a
+                   if "resumed_on" in _structural(traces_a[r]))
+        doc = chrome_trace.request_trace_events(traces_a[rid])
+        rows = {e["args"]["name"] for e in doc["traceEvents"]
+                if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert {"replica-0", "replica-1"} <= rows
+        # 5) DETERMINISM: the same seeded schedule reproduces the same
+        #    fault multiset, outcomes, streams, victim set and
+        #    structural timelines
+        plan_b = _chaos_plan()
+        assert plan_b.schedule() == plan_a.schedule()
+        st_b, states_b, traces_b, tok_b, victims_b = _drive(
+            gpt, plan_b, dir_b)
+        assert st_b == st_a and states_b == states_a
+        assert tok_b == tok_a
+        assert sorted(victims_b) == sorted(victims_a)
+        assert (sorted((e["site"], e["action"])
+                       for e in plan_b.fired_log())
+                == sorted((e["site"], e["action"])
+                          for e in plan_a.fired_log()))
+        pm_b = json.load(open(os.path.join(
+            dir_b, sorted(os.listdir(dir_b))[0])))
+        assert (Counter((f["site"], f["action"])
+                        for f in pm_b["chaos_faults"])
+                == Counter((f["site"], f["action"])
+                           for f in pm_a["chaos_faults"]))
+        for rid in victims_a:
+            assert _structural(traces_b[rid]) == \
+                _structural(traces_a[rid]), rid
+            assert traces_b[rid]["replicas"] == traces_a[rid]["replicas"]
+
+
+# =============================================================================
+# Hot-path cleanliness: recorder on, guards clean
+# =============================================================================
+class TestGuardsClean:
+    def test_steady_decode_transfer_and_retrace_clean_with_recorder(
+            self, gpt):
+        """The ISSUE 11 acceptance guard: request tracing + flight
+        recording are pure host bookkeeping — with both enabled (the
+        default), the pipelined steady state must not trigger one
+        implicit transfer or one retrace."""
+        from paddle_tpu.profiler.jit_cost import compile_budget
+
+        assert recorder.enabled
+        paddle.seed(102)
+        eng = ServingEngine(gpt, **ENGINE_KW)
+        rng = np.random.RandomState(1)
+        for p in (3, 6, 9, 12):
+            eng.add_request(rng.randint(1, VOCAB, (p,)).astype(np.int32),
+                            max_new_tokens=24)
+        for _ in range(4):
+            eng.step()                   # warm: admissions + compiles
+        ev0 = recorder.snapshot()["steps"]
+        with jax.transfer_guard("disallow"), \
+                compile_budget(0, prefix="serving."):
+            for _ in range(8):
+                eng.step()
+        assert recorder.snapshot()["steps"] - ev0 == 8
+        eng.drain()
+
+
+# =============================================================================
+# HTTP debug surface
+# =============================================================================
+class TestBundleDirScope:
+    def test_frontend_close_restores_prior_arming(self, gpt, tmp_path):
+        """ServingFrontend(bundle_dir=) arms the PROCESS recorder; its
+        close() must hand back the previous arming so a later fleet
+        doesn't auto-dump into this one's (possibly deleted) dir."""
+        assert recorder.bundle_dir is None
+        fe = ServingFrontend(gpt, replicas=1, queue_cap=4,
+                             engine_kwargs=ENGINE_KW,
+                             bundle_dir=str(tmp_path / "a"))
+        assert recorder.bundle_dir == str(tmp_path / "a")
+        fe.close()
+        assert recorder.bundle_dir is None
+        # last-set wins: a close must not clobber a NEWER arming
+        fe1 = ServingFrontend(gpt, replicas=1, queue_cap=4,
+                              engine_kwargs=ENGINE_KW,
+                              bundle_dir=str(tmp_path / "b"))
+        recorder.configure(bundle_dir=str(tmp_path / "c"))
+        fe1.close()
+        assert recorder.bundle_dir == str(tmp_path / "c")
+
+
+class TestHttpDebug:
+    def test_debug_requests_endpoints(self, gpt):
+        from paddle_tpu.serving import start_http_server
+
+        fe = ServingFrontend(gpt, replicas=1, queue_cap=8,
+                             engine_kwargs=ENGINE_KW)
+        srv = start_http_server(fe)
+        try:
+            h = fe.submit(np.array([3, 5, 9], np.int32), max_new_tokens=4)
+            assert h.wait(timeout=300) == "completed"
+            rid = h.request_id
+            listing = json.load(urllib.request.urlopen(
+                f"{srv.url}/debug/requests"))
+            assert rid in [s["request_id"] for s in listing["recent"]]
+            tl = json.load(urllib.request.urlopen(
+                f"{srv.url}/debug/requests/{rid}"))
+            assert tl["status"] == "completed"
+            assert [e["kind"] for e in tl["events"]][0] == "queued"
+            doc = json.load(urllib.request.urlopen(
+                f"{srv.url}/debug/requests/{rid}?format=chrome"))
+            assert any(e.get("ph") == "X" for e in doc["traceEvents"])
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"{srv.url}/debug/requests/no-such-rid")
+            assert exc.value.code == 404
+        finally:
+            srv.stop(close_frontend=True)
+
+
+# =============================================================================
+# Training crashes leave the same black box
+# =============================================================================
+class TestTrainCrashBundle:
+    def test_chaos_killed_fit_dumps_bundle(self, tmp_path):
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.framework.errors import FatalError
+        from paddle_tpu.io.dataset import TensorDataset
+
+        recorder.configure(bundle_dir=str(tmp_path / "pm"))
+        paddle.seed(7)
+        net = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 1))
+        m = paddle.Model(net)
+        m.prepare(optimizer.Adam(learning_rate=0.01,
+                                 parameters=net.parameters()),
+                  nn.MSELoss())
+        rng = np.random.RandomState(0)
+        x = rng.randn(32, 6).astype(np.float32)
+        ds = TensorDataset([x, (x @ rng.randn(6, 1)).astype(np.float32)])
+        plan = ChaosPlan([Fault("train.step", at=3, action=chaos.KILL)])
+        with chaos.running(plan):
+            with pytest.raises(FatalError):
+                m.fit(ds, batch_size=8, epochs=2, verbose=0,
+                      checkpoint_dir=str(tmp_path / "ck"),
+                      checkpoint_interval=2)
+        bundles = os.listdir(str(tmp_path / "pm"))
+        assert bundles, "FatalError in the train loop wrote no bundle"
+        pm = json.load(open(os.path.join(str(tmp_path / "pm"),
+                                         bundles[0])))
+        kinds = [t["kind"] for t in pm["transitions"]]
+        assert "train.fatal" in kinds
+        assert any(f["site"] == "train.step"
+                   for f in pm["chaos_faults"])
+        # the step-2 commit is ASYNC: the crash-time bundle may or may
+        # not have seen it (the writer thread races the kill), but
+        # fit's finally-close drains the writer before FatalError
+        # propagates — so by NOW the ring must hold the commit marker
+        post = recorder.build_bundle("post-close")
+        assert "train.checkpoint" in [t["kind"]
+                                      for t in post["transitions"]]
+
+
+# =============================================================================
+# Metrics surface
+# =============================================================================
+class TestRecorderMetrics:
+    def test_trace_and_recorder_counters_move(self, gpt):
+        from paddle_tpu.framework.monitor import stat_get
+
+        e0 = stat_get("serving.trace.events")
+        t0 = stat_get("serving.trace.terminals")
+        r0 = stat_get("recorder.events")
+        eng = ServingEngine(gpt, **ENGINE_KW)
+        eng.add_request(np.array([3, 5, 9], np.int32), max_new_tokens=4)
+        eng.drain()
+        assert stat_get("serving.trace.events") > e0
+        assert stat_get("serving.trace.terminals") > t0
+        assert stat_get("recorder.events") > r0
+        assert recorder.snapshot()["live_traces"] == 0

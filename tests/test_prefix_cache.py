@@ -48,14 +48,11 @@ def _lock_witness():
 
 
 @pytest.fixture(scope="module")
-def gpt():
-    from paddle_tpu.text.models import GPTModel
-
-    paddle.seed(11)
-    m = GPTModel(vocab_size=VOCAB, hidden_size=HID, num_layers=LAYERS,
-                 num_heads=HEADS, ffn_size=64, max_seq_len=64, dropout=0.0)
-    m.eval()
-    return m
+def gpt(shared_gpt_small):
+    # session-shared model (conftest): identical seed/dims to
+    # what this module built privately — the serving programs
+    # compile once for the whole suite instead of per module
+    return shared_gpt_small
 
 
 def _reference(gpt, prompt, budget):

@@ -25,12 +25,11 @@ VOCAB, HID, LAYERS, HEADS = 50, 32, 2, 2
 
 
 @pytest.fixture(scope="module")
-def gpt():
-    paddle.seed(11)
-    m = GPTModel(vocab_size=VOCAB, hidden_size=HID, num_layers=LAYERS,
-                 num_heads=HEADS, ffn_size=64, max_seq_len=64, dropout=0.0)
-    m.eval()
-    return m
+def gpt(shared_gpt_small):
+    # session-shared model (conftest): identical seed/dims to
+    # what this module built privately — the serving programs
+    # compile once for the whole suite instead of per module
+    return shared_gpt_small
 
 
 def _dense_ref(q, k_pages, v_pages, page_tables, seq_lens):
@@ -213,10 +212,19 @@ def _generate_ref(gpt, prompt, T, end_id=0):
 
 
 class TestServingEngine:
+    @pytest.mark.slow
     def test_64_staggered_requests_match_generate_no_page_leak(self, gpt):
         """The acceptance scenario: 64 requests with mixed prompt lengths
         arriving over time; greedy output token-identical to the
-        sequential generate path, pages-in-use 0 after drain."""
+        sequential generate path, pages-in-use 0 after drain.
+
+        Demoted to ``slow`` in PR 11 (suite health): the tier-1 run
+        carries the strictly-wider twin —
+        tests/test_serving_async.py 64-staggered-Poisson pins the SAME
+        64-request byte-identity vs generate() across sync, pipelined
+        AND fused modes plus forced preemption; this PR-1-era
+        sync-drive variant adds only the staggered-submission shape on
+        top and stays in the slow tier."""
         rng = np.random.RandomState(7)
         n = 64
         # mixed lengths drawn from a small set so the reference
@@ -258,9 +266,17 @@ class TestServingEngine:
                     w = w[: int(np.argmax(w == 0)) + 1]
                 np.testing.assert_array_equal(outs[ids[i]], w)
 
+    @pytest.mark.slow
     def test_preemption_preserves_greedy_output(self, gpt):
         """A cache too small for the whole batch forces recompute
-        preemption; deterministic greedy output must be unchanged."""
+        preemption; deterministic greedy output must be unchanged.
+
+        Demoted to ``slow`` in PR 11 (suite health): tier-1 keeps the
+        preemption byte-identity pinned through
+        tests/test_serving_async.py (forced preemption, pipelined ==
+        sync == generate) and tests/test_prefix_cache.py (preemption
+        under page pressure replays byte-identical over shared pages —
+        a strictly harder variant of this scenario)."""
         rng = np.random.RandomState(8)
         plens = (6, 6, 5, 5, 4, 4)      # 3 (P, T) groups for batched refs
         prompts = [rng.randint(1, VOCAB, (p,)).astype(np.int32)

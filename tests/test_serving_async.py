@@ -33,12 +33,11 @@ VOCAB, HID, LAYERS, HEADS = 50, 32, 2, 2
 
 
 @pytest.fixture(scope="module")
-def gpt():
-    paddle.seed(11)
-    m = GPTModel(vocab_size=VOCAB, hidden_size=HID, num_layers=LAYERS,
-                 num_heads=HEADS, ffn_size=64, max_seq_len=64, dropout=0.0)
-    m.eval()
-    return m
+def gpt(shared_gpt_small):
+    # session-shared model (conftest): identical seed/dims to
+    # what this module built privately — the serving programs
+    # compile once for the whole suite instead of per module
+    return shared_gpt_small
 
 
 class TestPrefillStepUnits:

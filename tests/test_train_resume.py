@@ -147,6 +147,69 @@ class TestExactResume:
             checkpoint_interval=4, resume=True)
         assert losses == reference[0]
 
+    def test_mid_epoch_resume_with_augmenting_loader(self, tmp_path):
+        """PR-9 follow-up (RNG restore ordering): a loader that consumes
+        np.random at FETCH time on the train thread (synchronous
+        augmentation — the DataLoader's worker threads draw at iter()
+        time, so they never exposed this) must resume byte-identically
+        too.  The checkpoint's mid-epoch numpy state was captured
+        BEFORE fetching batch k, so the resume loop must restore it
+        BEFORE that fetch — the old after-the-fetch restore rewound the
+        stream, making batch k+1 re-draw batch k's augmentation noise
+        and silently diverging from the uninterrupted run."""
+
+        class NoisyLoader:
+            """Synchronous loader: next() draws augmentation noise from
+            the global numpy stream on the calling thread."""
+
+            def __init__(self):
+                rng = np.random.RandomState(0)
+                self.x = rng.randn(PER_EPOCH, BATCH,
+                                   FEAT).astype(np.float32)
+                w = rng.randn(FEAT, 1).astype(np.float32)
+                self.y = (self.x @ w).astype(np.float32)
+
+            def __len__(self):
+                return PER_EPOCH
+
+            def __iter__(self):
+                for i in range(PER_EPOCH):
+                    noise = (np.random.randn(BATCH, FEAT)
+                             * 0.05).astype(np.float32)
+                    yield [paddle.to_tensor(self.x[i] + noise),
+                           paddle.to_tensor(self.y[i])]
+
+        def fit(m, log, **kw):
+            m.fit(NoisyLoader(), batch_size=BATCH, epochs=2,
+                  verbose=0, callbacks=[log], **kw)
+
+        paddle.seed(7)
+        ref_log = LossLog()
+        m_ref = make_model()
+        fit(m_ref, ref_log)
+        ref_params = {k: v.numpy().copy()
+                      for k, v in m_ref.state_dict().items()}
+        d = str(tmp_path / "aug")
+        paddle.seed(7)
+        m_k = make_model()
+        kill_log = LossLog()
+        # kill at step 9 (mid epoch 1), interval 4 -> checkpoint at 8,
+        # next_batch=2: the first non-replayed fetch is the bug site
+        plan = ChaosPlan([Fault("train.step", at=9, action=chaos.KILL)])
+        with chaos.running(plan):
+            with pytest.raises(FatalError):
+                fit(m_k, kill_log, checkpoint_dir=d,
+                    checkpoint_interval=4)
+        assert kill_log.losses == ref_log.losses[:8]
+        res_log = LossLog()
+        m_r = make_model()
+        fit(m_r, res_log, checkpoint_dir=d, checkpoint_interval=4,
+            resume=True)
+        assert res_log.losses == ref_log.losses[8:]
+        res_params = {k: v.numpy() for k, v in m_r.state_dict().items()}
+        for k in ref_params:
+            np.testing.assert_array_equal(ref_params[k], res_params[k])
+
     def test_resume_after_completion_is_noop(self, tmp_path, reference):
         d = str(tmp_path / "done")
         losses, params = run_fit(checkpoint_dir=d, checkpoint_interval=4)
@@ -315,6 +378,63 @@ class TestTrainStateCapture:
         ck.snapshot(m, global_step=1, epoch=0, next_batch=1,
                     np_state_epoch_start=np.random.get_state())
         with pytest.raises(OSError):
+            ck.close()
+
+    def test_fit_close_failure_never_masks_the_crash(self, tmp_path,
+                                                     monkeypatch):
+        """The flush-timeout fix must not let checkpointer-close errors
+        in fit's finally MASK the propagating FatalError (the crash
+        cause resume tooling keys on); with no crash in flight the
+        close failure still propagates."""
+        import paddle_tpu.hapi.checkpoint as hc
+
+        monkeypatch.setattr(
+            hc.TrainCheckpointer, "close",
+            lambda self, timeout=60.0: (_ for _ in ()).throw(
+                OSError("close failed")))
+        paddle.seed(7)
+        plan = ChaosPlan([Fault("train.step", at=2, action=chaos.KILL)])
+        with chaos.running(plan):
+            with pytest.raises(FatalError):      # NOT the OSError
+                make_model().fit(make_ds(), batch_size=BATCH, epochs=1,
+                                 verbose=0,
+                                 checkpoint_dir=str(tmp_path / "m"),
+                                 checkpoint_interval=1)
+        with pytest.raises(OSError, match="close failed"):
+            make_model().fit(make_ds(), batch_size=BATCH, epochs=1,
+                             verbose=0, num_iters=2,
+                             checkpoint_dir=str(tmp_path / "m2"),
+                             checkpoint_interval=1)
+
+    def test_flush_timeout_raises_not_silent(self, tmp_path):
+        """PR-9 follow-up: flush(timeout) hitting the timeout must
+        RAISE, not return as if the write committed — callers treat
+        flush() as a durability barrier."""
+        import threading
+
+        from paddle_tpu.framework.errors import ExecutionTimeoutError
+
+        paddle.seed(5)
+        m = make_model()
+        m.fit(make_ds(), batch_size=BATCH, epochs=1, shuffle=False,
+              verbose=0, num_iters=1)
+        ck = TrainCheckpointer(str(tmp_path / "slow"), interval=1)
+        release = threading.Event()
+        real_save = ck.store.save
+
+        def stalled_save(*a, **k):
+            release.wait(30.0)           # a hung disk, not a dead one
+            return real_save(*a, **k)
+
+        ck.store.save = stalled_save
+        try:
+            ck.snapshot(m, global_step=1, epoch=0, next_batch=1,
+                        np_state_epoch_start=np.random.get_state())
+            with pytest.raises(ExecutionTimeoutError,
+                               match="still busy"):
+                ck.flush(timeout=0.1)
+        finally:
+            release.set()                # un-stall so close() drains
             ck.close()
 
 
