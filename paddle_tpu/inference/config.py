@@ -66,7 +66,7 @@ class Config:
                        kv_cache_dtype=None, weight_dtype=None,
                        replicas=1, queue_cap=64, default_deadline_ms=None,
                        snapshot_interval=16, watchdog=None, brownout=None,
-                       prefix_cache=False):
+                       prefix_cache=False, spec_decode=False):
         """Opt in to the continuous-batching serving engine
         (docs/SERVING.md).  Stores the paged-KV / scheduler knobs plus the
         pipelining knobs (``prefill_chunk`` tokens per prefill program,
@@ -104,6 +104,15 @@ class Config:
         documented scale contract); per-request opt-out via
         ``submit(prefix_cache=False)``.
 
+        ``spec_decode=True`` (docs/SERVING.md "Speculative decoding")
+        turns on speculative decoding: a model-free n-gram /
+        prompt-lookup drafter proposes continuation tokens and ONE
+        fused ``serving.spec_verify`` dispatch scores all of them —
+        accepted tokens cost ~1/K of the HBM bandwidth of plain
+        decode while the emitted stream stays exactly the greedy
+        stream, byte for byte.  Pass an int to set the K-token verify
+        horizon (True = 4).
+
         Not reference API — the reference's serving story stops at
         AnalysisPredictor; this is the TPU-native extension."""
         self._serving = {
@@ -118,6 +127,8 @@ class Config:
             "kv_cache_dtype": kv_cache_dtype,
             "weight_dtype": weight_dtype,
             "prefix_cache": bool(prefix_cache),
+            # bool or int K-horizon — validated by the engine
+            "spec_decode": spec_decode,
         }
         self._serving_frontend = {
             "replicas": int(replicas),

@@ -48,8 +48,9 @@ from ..framework.monitor import stat_registry
 __all__ = [
     "FlightRecorder", "RequestTrace", "TraceContext", "recorder",
     "EV_QUEUED", "EV_PLACED", "EV_ADMITTED", "EV_PREFIX_HIT",
-    "EV_PREFILL_CHUNK", "EV_FIRST_TOKEN", "EV_PREEMPTED", "EV_SNAPSHOT",
-    "EV_RESUMED_ON", "EV_RESTARTED", "EV_TERMINAL", "LIFECYCLE_EVENTS",
+    "EV_PREFILL_CHUNK", "EV_FIRST_TOKEN", "EV_SPECULATED",
+    "EV_PREEMPTED", "EV_SNAPSHOT", "EV_RESUMED_ON", "EV_RESTARTED",
+    "EV_TERMINAL", "LIFECYCLE_EVENTS",
 ]
 
 # --- the request lifecycle event taxonomy (docs/OBSERVABILITY.md) -----------
@@ -59,6 +60,7 @@ EV_ADMITTED = "admitted"          # engine admitted it into the batch
 EV_PREFIX_HIT = "prefix_hit"      # radix index covered {tokens} positions
 EV_PREFILL_CHUNK = "prefill_chunk"  # one chunked-prefill dispatch {size}
 EV_FIRST_TOKEN = "first_token"    # first decode token consumed
+EV_SPECULATED = "speculated"      # one verify dispatch {drafted, accepted}
 EV_PREEMPTED = "preempted"        # evicted mid-decode (replays later)
 EV_SNAPSHOT = "snapshot"          # warm-failover checkpoint {tokens}
 EV_RESUMED_ON = "resumed_on"      # failover resume {replica, from}
@@ -66,8 +68,8 @@ EV_RESTARTED = "restarted"        # failover with no checkpoint (token 0)
 EV_TERMINAL = "terminal"          # exactly-once final outcome {status}
 LIFECYCLE_EVENTS = frozenset({
     EV_QUEUED, EV_PLACED, EV_ADMITTED, EV_PREFIX_HIT, EV_PREFILL_CHUNK,
-    EV_FIRST_TOKEN, EV_PREEMPTED, EV_SNAPSHOT, EV_RESUMED_ON,
-    EV_RESTARTED, EV_TERMINAL})
+    EV_FIRST_TOKEN, EV_SPECULATED, EV_PREEMPTED, EV_SNAPSHOT,
+    EV_RESUMED_ON, EV_RESTARTED, EV_TERMINAL})
 
 BUNDLE_SCHEMA = 1
 

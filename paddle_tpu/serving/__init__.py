@@ -23,6 +23,13 @@ Components
                                 shared-prefix prompts skip straight to
                                 the first uncached token at prefill
                                 (docs/SERVING.md "Prefix caching")
+- ``spec_decode``               speculative decoding: model-free n-gram
+                                drafter (pluggable ``Drafter``) + one
+                                fused K-token ``serving.spec_verify``
+                                dispatch — K tokens per weight-set
+                                stream at exact greedy byte-identity
+                                (docs/SERVING.md "Speculative
+                                decoding")
 - ``metrics.ServingMetrics``    per-step engine observability
 - ``metrics.FrontendMetrics``   per-request frontend observability
 - ``frontend.ServingFrontend``  thread-safe streaming front door:
@@ -72,6 +79,7 @@ from .resilience import (BrownoutController, BrownoutPolicy,
                          EngineSnapshot, Watchdog, WatchdogConfig)
 from .router import Replica, Router
 from .scheduler import Request, Scheduler, Sequence
+from .spec_decode import Drafter, NgramDrafter, SpecDecoder
 
 __all__ = ["ServingEngine", "create_serving_engine", "PagedKVCache",
            "PrefixCache", "ServingMetrics", "FrontendMetrics", "Request",
@@ -79,4 +87,5 @@ __all__ = ["ServingEngine", "create_serving_engine", "PagedKVCache",
            "create_serving_frontend", "Router", "Replica",
            "ServingHTTPServer", "start_http_server", "EngineSnapshot",
            "Watchdog", "WatchdogConfig", "BrownoutPolicy",
-           "BrownoutController"]
+           "BrownoutController", "Drafter", "NgramDrafter",
+           "SpecDecoder"]

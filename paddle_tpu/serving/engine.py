@@ -58,7 +58,8 @@ from ..framework.concurrency import OrderedLock
 from ..framework.errors import (AlreadyExistsError, InternalError,
                                 InvalidArgumentError)
 from ..profiler.flight_recorder import (EV_ADMITTED, EV_FIRST_TOKEN,
-                                        EV_PREFILL_CHUNK, EV_PREFIX_HIT)
+                                        EV_PREFILL_CHUNK, EV_PREFIX_HIT,
+                                        EV_SPECULATED)
 from ..profiler.flight_recorder import recorder as flight
 from ..profiler.jit_cost import cost_registry, profiled_jit
 from ..testing.chaos import chaos_site
@@ -90,15 +91,17 @@ _PROGRAM_LOCK = OrderedLock("serving.programs")
 
 def _shared_programs(model, *, page_size: int, pages_per_seq: int,
                      kv_cache_dtype, weight_dtype, kv_scales, weights,
-                     fused_steps: int) -> dict:
+                     fused_steps: int, spec_steps: int = 0,
+                     spec_sequential: bool = False) -> dict:
     from ..jit.functional import get_state
     from ..text.generation import (make_gpt_paged_decode_step,
                                    make_gpt_paged_fused_decode_step,
-                                   make_gpt_paged_prefill_step)
+                                   make_gpt_paged_prefill_step,
+                                   make_gpt_paged_spec_verify_step)
 
     params, _ = get_state(model)
     key = (page_size, pages_per_seq, kv_cache_dtype, weight_dtype,
-           fused_steps,
+           fused_steps, spec_steps, spec_sequential,
            None if kv_scales is None else id(kv_scales),
            None if weights is None else id(weights),
            tuple(sorted((k, id(v)) for k, v in params.items())))
@@ -174,6 +177,7 @@ def _shared_programs(model, *, page_size: int, pages_per_seq: int,
         "lane_set": profiled_jit("serving.lane_update", _lane_set),
         "row_set": profiled_jit("serving.table_update", _row_set),
         "fused": None,
+        "spec_verify": None,
         "scale_reset": None,
     }
     if fused_steps > 1:
@@ -181,6 +185,17 @@ def _shared_programs(model, *, page_size: int, pages_per_seq: int,
             model, page_size, pages_per_seq, fused_steps, **qkw)
         progs["fused"] = profiled_jit("serving.decode_fused", fused_fn,
                                       donate_argnums=(3,))
+    if spec_steps > 1:
+        # speculative decoding (ISSUE 12): one dispatch teacher-forces
+        # K tokens per lane — the weight set streams from HBM once per
+        # K positions.  int8_dynamic engines get the sequential
+        # schedule (per-page scale growth must replay the plain decode
+        # loop's progressive quantization exactly).
+        verify_fn, _ = make_gpt_paged_spec_verify_step(
+            model, page_size, pages_per_seq, spec_steps,
+            sequential=spec_sequential, **qkw)
+        progs["spec_verify"] = profiled_jit(
+            "serving.spec_verify", verify_fn, donate_argnums=(3,))
     if kv_cache_dtype == "int8" and kv_scales is None:
         def _scale_reset(kv, rows):
             # rows: [R] page ids (pow2-padded with the trash page 0 —
@@ -284,6 +299,8 @@ class ServingEngine:
                  weight_dtype: Optional[str] = None,
                  quant_scales: Optional[dict] = None,
                  prefix_cache: bool = False,
+                 spec_decode=False,
+                 spec_drafter=None,
                  token_callback: Optional[Callable[[str, int, int],
                                                    None]] = None):
         self.model = model
@@ -355,6 +372,40 @@ class ServingEngine:
         # reallocated (results must not depend on page-reuse history)
         self._kv_dynamic = self.kv_cache_dtype == "int8" and \
             kv_scales is None
+
+        # --- speculative decoding (docs/SERVING.md "Speculative
+        # decoding"): bool (True = default K of 4) or an explicit int
+        # K-token verify horizon — the established validated-knob
+        # style.  K is a traced-over constant of the ONE spec_verify
+        # program, never a per-call scalar (RH001).
+        if not isinstance(spec_decode, (bool, int)):
+            raise InvalidArgumentError(
+                f"spec_decode must be a bool or an int K-token verify "
+                f"horizon, got {spec_decode!r}")
+        if isinstance(spec_decode, bool):
+            spec_k = 4 if spec_decode else 0
+        else:
+            spec_k = int(spec_decode)
+            if spec_k < 2:
+                raise InvalidArgumentError(
+                    f"spec_decode={spec_k} — the int form is the "
+                    "K-token verify horizon and must be >= 2 (K=1 is "
+                    "plain decode; pass False to disable)")
+        if spec_drafter is not None and not spec_k:
+            # truthy configs must not silently do nothing (the
+            # watchdog=/brownout= validation discipline)
+            raise InvalidArgumentError(
+                "spec_drafter was provided but spec_decode is off — "
+                "pass spec_decode=True (or an int horizon) to enable "
+                "speculative decoding")
+        self.spec = None
+        if spec_k:
+            from .spec_decode import SpecDecoder
+
+            self.spec = SpecDecoder(spec_k, drafter=spec_drafter,
+                                    metrics=self.metrics,
+                                    sequential=self._kv_dynamic)
+
         progs = _shared_programs(
             model, page_size=self.page_size,
             pages_per_seq=self.pages_per_seq,
@@ -362,7 +413,8 @@ class ServingEngine:
             weight_dtype=self.weight_dtype, kv_scales=kv_scales,
             weights=qs.get("weights") if self.weight_dtype == "int8"
             else None,
-            fused_steps=self.fused_steps)
+            fused_steps=self.fused_steps, spec_steps=spec_k,
+            spec_sequential=self._kv_dynamic)
         self._kv = progs["init_pages"](num_pages)
         self._weight_quant = progs["weight_quant"]
         self._decode_jit = progs["decode"]
@@ -370,6 +422,7 @@ class ServingEngine:
         self._lane_set_jit = progs["lane_set"]
         self._row_set_jit = progs["row_set"]
         self._fused_jit = progs["fused"]
+        self._spec_jit = progs["spec_verify"]
         self._scale_reset_jit = progs["scale_reset"]
         self._page_gather_jit = progs["page_gather"]
         self._page_put_jit = progs["page_put"]
@@ -547,6 +600,8 @@ class ServingEngine:
         """Drop per-request engine bookkeeping (abort/expiry path)."""
         self._ttft_recorded.discard(request_id)
         self._uploaded_pages.pop(request_id, None)
+        if self.spec is not None:
+            self.spec.on_drop(request_id)
 
     def take_expired(self) -> List[str]:
         """Request ids whose deadline expired since the last call
@@ -607,12 +662,19 @@ class ServingEngine:
             else:
                 for side in ("k", "v"):
                     pages[side] = [np.asarray(p[:R]) for p in got[side]]
+        spec_state = None
+        if self.spec is not None:
+            # the drafter's adaptive lane state rides along so a
+            # resumed request keeps speculating where the donor left
+            # off (its n-gram index rebuilds from prompt + generated)
+            spec_state = self.spec.drafter.export_lane(request_id) or None
         snap = EngineSnapshot(
             request_id=request_id, prompt=seq.request.prompt,
             max_new_tokens=seq.request.max_new_tokens,
             deadline=seq.request.deadline,
             generated=np.asarray(seq.generated, np.int32), pos=int(pos),
-            kv_mode=mode, page_size=self.page_size, pages=pages)
+            kv_mode=mode, page_size=self.page_size, pages=pages,
+            spec=spec_state)
         self.metrics.on_snapshot(snap.nbytes)
         return snap
 
@@ -909,7 +971,7 @@ class ServingEngine:
             # reserve pages covering pos+K for every lane WITHOUT
             # preemption — speculative capacity must not evict anyone;
             # partial reservations are kept (they're used within K steps)
-            if all(self.cache.allocate(s.seq_id, s.pos + self.fused_steps)
+            if all(self.scheduler.reserve(s, s.pos + self.fused_steps)
                    for _, s in active):
                 k = self.fused_steps
         # the reservation above (and any partial one) may have grown
@@ -956,30 +1018,47 @@ class ServingEngine:
                 # bump): the device token is junk — drop it
                 if seq.done or seq.epoch != epoch:
                     continue
-                tok = int(krow[lane])
-                if seq.first_token_time is None:
-                    seq.first_token_time = now
-                    if seq.seq_id not in self._ttft_recorded:
-                        self._ttft_recorded.add(seq.seq_id)
-                        self.metrics.on_first_token(
-                            seq.request.arrival_time, now)
-                        flight.request_event(seq.seq_id, EV_FIRST_TOKEN,
-                                             replica=self.chaos_key)
-                seq.generated.append(tok)
-                seq.next_token = tok
                 emitted += 1
-                if self.token_callback is not None:
-                    self.token_callback(seq.seq_id,
-                                        seq.num_generated - 1, tok)
-                if (tok == self.eos_id
-                        or seq.num_generated
-                        >= seq.request.max_new_tokens):
-                    self._retire(seq, lane)
+                self._emit_token(seq, lane, int(krow[lane]), now)
         return emitted
+
+    def _emit_token(self, seq: Sequence, lane: int, tok: int,
+                    now: float) -> bool:
+        """Apply ONE consumed token to a live sequence — the single
+        emission path (the pipelined consume loop and the spec-decode
+        accept loop both feed it, so the callback stream is identical
+        across every mode): TTFT bookkeeping, stream callback, drafter
+        observation, EOS/budget retirement.  Returns True when the
+        token retired the sequence."""
+        if seq.first_token_time is None:
+            seq.first_token_time = now
+            if seq.seq_id not in self._ttft_recorded:
+                self._ttft_recorded.add(seq.seq_id)
+                self.metrics.on_first_token(
+                    seq.request.arrival_time, now)
+                flight.request_event(seq.seq_id, EV_FIRST_TOKEN,
+                                     replica=self.chaos_key)
+        seq.generated.append(tok)
+        seq.next_token = tok
+        if self.spec is not None:
+            self.spec.on_token(seq.seq_id, tok)
+        if self.token_callback is not None:
+            self.token_callback(seq.seq_id,
+                                seq.num_generated - 1, tok)
+        if (tok == self.eos_id
+                or seq.num_generated >= seq.request.max_new_tokens):
+            self._retire(seq, lane)
+            return True
+        return False
 
     def _retire(self, seq: Sequence, lane: int):
         """EOS / budget retirement: final — the id never reappears."""
         self.outputs[seq.seq_id] = np.asarray(seq.generated, np.int32)
+        if self.spec is not None:
+            # publish the finished stream into the drafter's shared
+            # n-gram corpus (the same chain _seal_prefix publishes as
+            # radix-index pages) and drop the lane state
+            self.spec.on_retire(seq)
         # seal BEFORE finish: the full pages this request wrote (prompt
         # AND generated tokens) stay resident in the prefix index after
         # its references drop — a completed request is the donor the
@@ -1006,6 +1085,183 @@ class ServingEngine:
         while self._pending:
             emitted += self._consume_one()
         return emitted
+
+    # --- speculative decoding (docs/SERVING.md "Speculative decoding") ----
+    def _spec_touched_pages(self, seq: Sequence) -> List[int]:
+        """The allocated pages a spec dispatch can write for ``seq``:
+        pages covering positions [pos, pos + K) that exist in its table
+        (junk past the allocation lands in the trash page)."""
+        P = self.page_size
+        table = self.cache.seq_page_ids(seq.seq_id)
+        p0 = seq.pos // P
+        p1 = min((seq.pos + self.spec.k - 1) // P, len(table) - 1)
+        return table[p0: p1 + 1] if p1 >= p0 else []
+
+    def _spec_rollback(self, seq: Sequence, saved, inputs, pos0: int,
+                       took: int):
+        """int8_dynamic rollback: junk writes past the accepted prefix
+        grew per-page scales and requantized page content — restore the
+        dispatch's touched pages from the pre-dispatch device gather,
+        then replay the ``took`` emitted positions ONE AT A TIME through
+        the prefill program, so per-page scale growth is progressive
+        exactly like the plain decode loop's (the documented dynamic
+        byte-identity contract).  Native / int8_static modes never get
+        here: their junk is inert until overwritten."""
+        rows_dev, payload = saved
+        self._kv = self._page_put_jit(self._kv, rows_dev, payload)
+        row = jax.device_put(self.cache.page_table_row(seq.seq_id))
+        for j in range(took):
+            self._kv = self._prefill_jit(
+                jax.device_put(np.asarray([inputs[j]], np.int32)),
+                jax.device_put(np.asarray([pos0 + j], np.int32)),
+                row, jax.device_put(np.int32(pos0 + j + 1)), self._kv)
+
+    def _spec_step(self, active) -> Optional[dict]:
+        """Attempt one drafter/verifier speculation step.  Returns None
+        when nothing was touched (the caller runs the plain/fused
+        dispatch: no drafts plausible, chaos ``spec.draft`` denial,
+        admissions waiting, or a lane too close to its position
+        ceiling); otherwise a ``{"emitted", "bucket", "lanes"}`` dict —
+        including the degraded case where drafts evaporated after the
+        pipeline collapse and a plain dispatch ran instead.
+
+        Synchronous by design: the accept decision gates the NEXT
+        dispatch's positions, so the pipeline is collapsed first and
+        the verify dispatch is consumed immediately — the win is K
+        tokens per weight-set stream, not dispatch overlap."""
+        spec = self.spec
+        K = spec.k
+        # NOTE: unlike fused mode there is no ``scheduler.waiting``
+        # gate — a verify is ONE dispatch (admission latency matches a
+        # plain step, and admission runs before dispatch every step),
+        # whereas fused mode holds the device for K sequential steps.
+        # Queue-pressure page safety comes from the non-preempting
+        # per-lane reserve below: a lane whose horizon cannot be
+        # covered degrades to a plain ride-along, never evicts anyone.
+        # position ceiling: the verify program writes K positions per
+        # lane; past max_seq_len the core's clamps would fold junk into
+        # a live page — degrade instead
+        if any(s.pos + K > self.max_seq_len for _, s in active):
+            return None
+        # chaos site ``spec.draft``: deny => this step degrades to
+        # plain decode (never fails or corrupts a request)
+        fault = chaos_site("spec.draft", key=self.chaos_key)
+        if fault is not None and fault.action == "deny":
+            spec.on_degraded()
+            return None
+        # cheap probe on the (possibly one-dispatch-stale) host mirror
+        # BEFORE collapsing the pipeline: a draftless steady state keeps
+        # dispatch-ahead intact.  The probe is the throttle clock
+        # (tick=True): per-lane cooldowns count spec-considered engine
+        # steps, whether or not a dispatch follows
+        if not any(len(d) for d in
+                   spec.propose(active, tick=True).values()):
+            return None
+        emitted = self._sync_pending()
+        active = [(i, s) for i, s in enumerate(self._lanes)
+                  if s is not None]
+        if not active:
+            return {"emitted": emitted, "bucket": 0, "lanes": 0}
+        # real proposals against the now-current history (the probe
+        # already ticked the throttle — tick=False here), then reserve
+        # each drafted lane's K-token horizon WITHOUT preemption —
+        # denial degrades that lane to a plain ride-along within the
+        # same dispatch
+        drafts = spec.propose(active, tick=False)
+        for lane, seq in active:
+            d = drafts.get(lane)
+            if d is not None and len(d) \
+                    and not self.scheduler.reserve(seq, seq.pos + K):
+                spec.on_degraded()
+                drafts[lane] = d[:0]
+        if not any(len(d) for d in drafts.values()):
+            # the probe's candidates evaporated (consumed tokens or
+            # reservation denial): plain dispatch so the step still
+            # makes progress — a permanent denial must not livelock
+            self._dispatch(active)
+            return {"emitted": emitted, "bucket": self._state_bucket,
+                    "lanes": len(active)}
+        bucket = self._state_bucket
+        # device table rows must cover every reserved position
+        self._sync_rows(active)
+        saved = {}
+        if self._kv_dynamic:
+            # pre-dispatch device-to-device gather of the write-span
+            # pages: junk writes grow per-page scales irreversibly, so
+            # rejection restores from this copy (no host round trip)
+            for lane, seq in active:
+                rows = self._spec_touched_pages(seq)
+                if rows:
+                    padded = np.zeros((next_pow2(len(rows)),), np.int32)
+                    padded[: len(rows)] = rows
+                    rows_dev = jax.device_put(padded)
+                    saved[lane] = (rows_dev, self._page_gather_jit(
+                        self._kv, rows_dev))
+        # [K, bucket] teacher-forcing inputs: row 0 every lane's real
+        # next token, rows 1.. the draft (junk-padded to the traced K —
+        # outputs past the real draft are ignored host-side, their
+        # writes land in reserved pages or the trash page)
+        draft_mat = np.zeros((K, bucket), np.int32)
+        for lane, seq in active:
+            draft_mat[0, lane] = seq.next_token
+            d = drafts.get(lane)
+            if d is not None and len(d):
+                draft_mat[1: 1 + len(d), lane] = d
+        t = time.perf_counter()
+        if self._last_dispatch is not None:
+            self.metrics.on_dispatch_gap(t - self._last_dispatch)
+        self._last_dispatch = t
+        with RecordEvent("serving/spec_verify", bucket=bucket, steps=K):
+            out, self._kv = self._spec_jit(
+                jax.device_put(draft_mat), self._pos, self._tables,
+                self._kv)
+            t0 = time.perf_counter()
+            toks = np.asarray(jax.device_get(out))        # [K, bucket]
+            self.metrics.on_decode(time.perf_counter() - t0)
+        now = time.monotonic()
+        results = []
+        for lane, seq in active:
+            d = drafts.get(lane)
+            dn = len(d) if d is not None else 0
+            col = toks[:, lane]
+            # prefix-match-then-take-the-verifier's-next-token: exact
+            # greedy byte-identity whatever the drafter proposed
+            a = spec.accept_len(d if dn else col[:0], col)
+            e = min(a, self._remaining(seq))
+            pos0 = seq.pos
+            took = 0
+            done = False
+            for i in range(e):
+                seq.pos += 1
+                took += 1
+                emitted += 1
+                done = self._emit_token(seq, lane, int(col[i]), now)
+                if done:
+                    break
+            if dn:
+                results.append((seq.seq_id, dn, a - 1))
+                flight.request_event(seq.seq_id, EV_SPECULATED,
+                                     replica=self.chaos_key,
+                                     drafted=dn, accepted=a - 1)
+            if self._kv_dynamic and not done and lane in saved \
+                    and min(pos0 + K, self.cache.allocated_tokens(
+                        seq.seq_id)) > pos0 + took:
+                self._spec_rollback(seq, saved[lane], draft_mat[:, lane],
+                                    pos0, took)
+        spec.on_verify(results)
+        # one wholesale upload of the surviving lanes' (token, pos) —
+        # the verify program advances nothing on device, the accept
+        # decision lives here on host
+        tokens = np.zeros((self._state_bucket,), np.int32)
+        pos = np.zeros((self._state_bucket,), np.int32)
+        for i, s in enumerate(self._lanes):
+            if s is not None:
+                tokens[i] = s.next_token
+                pos[i] = s.pos
+        self._tokens = jax.device_put(tokens)
+        self._pos = jax.device_put(pos)
+        return {"emitted": emitted, "bucket": bucket,
+                "lanes": len(active)}
 
     # --- one scheduler iteration -----------------------------------------
     def step(self) -> dict:
@@ -1078,6 +1334,11 @@ class ServingEngine:
                         self._apply_cow(seq)
                     self._prefill_seq(seq)
                 self._bind_lane(seq)
+                if self.spec is not None:
+                    # seed the drafter with the lane's full history
+                    # (prompt, plus generated for a snapshot resume —
+                    # which also restores the drafter's adaptive state)
+                    self.spec.on_admit(seq)
             self.metrics.on_admission(len(admitted))
 
         bucket = 0
@@ -1093,6 +1354,8 @@ class ServingEngine:
                 self.metrics.on_preemption(len(preempted))
                 for victim in preempted:
                     self._uploaded_pages.pop(victim.seq_id, None)
+                    if self.spec is not None:
+                        self.spec.on_drop(victim.seq_id)
                     for i, lane_seq in enumerate(self._lanes):
                         if lane_seq is victim:
                             self._lanes[i] = None
@@ -1100,9 +1363,16 @@ class ServingEngine:
             active = [(i, s) for i, s in enumerate(self._lanes)
                       if s is not None]
             if any(self._remaining(s) > 0 for _, s in active):
-                bucket = self._state_bucket
-                dispatched_lanes = len(active)
-                self._dispatch(active)
+                spec_res = (self._spec_step(active)
+                            if self.spec is not None else None)
+                if spec_res is not None:
+                    emitted += spec_res["emitted"]
+                    bucket = spec_res["bucket"]
+                    dispatched_lanes = spec_res["lanes"]
+                else:
+                    bucket = self._state_bucket
+                    dispatched_lanes = len(active)
+                    self._dispatch(active)
 
         # dispatch-ahead: keep ONE step in flight (none in sync_mode or
         # when nothing was dispatched — then drain fully so retirements
@@ -1204,6 +1474,8 @@ class ServingEngine:
                 if self.prefix_cache is not None else
                 {"enabled": False,
                  "bypass_reason": self._prefix_bypass_reason}),
+            "spec": (self.spec.stats() if self.spec is not None
+                     else {"enabled": False}),
             "quant": {
                 "kv_cache_dtype": self.kv_cache_dtype or "native",
                 "weight_dtype": self.weight_dtype or "native",

@@ -406,6 +406,7 @@ class ServingFrontend:
                  placement_backoff_s: float = 0.02,
                  snapshot_store=None,
                  prefix_cache: Optional[bool] = None,
+                 spec_decode=None,
                  bundle_dir: Optional[str] = None):
         """Resilience knobs (docs/SERVING.md "Resilience"):
 
@@ -431,6 +432,13 @@ class ServingFrontend:
           prompts skip straight to the first uncached token.  None
           leaves the engines' own default (off); per-request opt-out
           via ``submit(prefix_cache=False)``.
+        - ``spec_decode``: opt-in speculative decoding on every replica
+          engine (docs/SERVING.md "Speculative decoding") — an n-gram
+          drafter plus one fused K-token verify dispatch per step,
+          exact greedy byte-identity preserved; True or an int K-token
+          horizon.  None leaves the engines' own default (off).  The
+          drafter's per-lane state rides the warm-failover snapshots,
+          so a victim resumes speculating on the survivor.
         - ``bundle_dir``: configure the process flight recorder to
           write a postmortem bundle here on every replica death
           (docs/OBSERVABILITY.md "Request tracing & flight recorder");
@@ -456,6 +464,19 @@ class ServingFrontend:
                 "prefix_cache is an engine knob — a custom "
                 "engine_factory owns engine construction, so pass "
                 "ServingEngine(prefix_cache=...) inside the factory")
+        if spec_decode is not None and not isinstance(spec_decode,
+                                                     (bool, int)):
+            # same discipline as prefix_cache=: a truthy config object
+            # must not silently become the default (the engine
+            # re-validates the int-horizon form)
+            raise InvalidArgumentError(
+                f"spec_decode must be None, a bool, or an int K-token "
+                f"horizon, got {spec_decode!r}")
+        if engine_factory is not None and spec_decode is not None:
+            raise InvalidArgumentError(
+                "spec_decode is an engine knob — a custom "
+                "engine_factory owns engine construction, so pass "
+                "ServingEngine(spec_decode=...) inside the factory")
         if replicas < 1:
             raise InvalidArgumentError("replicas must be >= 1")
         self.metrics = metrics or FrontendMetrics()
@@ -472,6 +493,8 @@ class ServingFrontend:
             ekw.setdefault("metrics", self.engine_metrics)
             if prefix_cache is not None:
                 ekw["prefix_cache"] = prefix_cache
+            if spec_decode is not None:
+                ekw["spec_decode"] = spec_decode
 
             def engine_factory():
                 return ServingEngine(model, **ekw)
@@ -1378,7 +1401,8 @@ def create_serving_frontend(model, config=None, **overrides
                 "engine_factory", "metrics", "poll_interval_s",
                 "snapshot_interval", "watchdog", "brownout",
                 "placement_attempts", "placement_backoff_s",
-                "snapshot_store", "prefix_cache", "bundle_dir"):
+                "snapshot_store", "prefix_cache", "spec_decode",
+                "bundle_dir"):
         if key in overrides:
             fe_kwargs[key] = overrides.pop(key)
     engine_kwargs.update(overrides)

@@ -57,7 +57,10 @@ class ServingMetrics:
               "serving.snapshot_bytes", "serving.brownout_stage",
               # prefix cache (ISSUE 10): tokens' worth of KV the radix
               # index can currently serve (resident sealed pages)
-              "serving.prefix.cached_tokens")
+              "serving.prefix.cached_tokens",
+              # speculative decoding (ISSUE 12): lifetime fraction of
+              # drafted tokens the verifier accepted
+              "serving.spec.accept_rate")
     COUNTERS = ("serving.steps", "serving.tokens_generated",
                 "serving.requests_admitted", "serving.requests_completed",
                 "serving.preemptions", "serving.prefill_chunks",
@@ -70,7 +73,13 @@ class ServingMetrics:
                 # and copy-on-write page copies on divergence
                 "serving.prefix.hits", "serving.prefix.misses",
                 "serving.prefix.hit_tokens", "serving.prefix.evictions",
-                "serving.prefix.cow")
+                "serving.prefix.cow",
+                # speculative decoding (ISSUE 12): drafted tokens
+                # submitted to the verifier, the split into accepted
+                # (emitted for ~1/K of the bandwidth) vs rejected, and
+                # the lanes rolled back mid-draft
+                "serving.spec.drafted", "serving.spec.accepted",
+                "serving.spec.rejected", "serving.spec.rollbacks")
     HISTOGRAMS = ("serving.step_latency_ms", "serving.prefill_latency_ms",
                   "serving.decode_latency_ms", "serving.ttft_ms",
                   "serving.dispatch_gap_ms",
@@ -179,6 +188,28 @@ class ServingMetrics:
     def set_prefix_cached_tokens(self, tokens: int):
         stat_registry.get("serving.prefix.cached_tokens").set(int(tokens))
 
+    # --- speculative decoding (docs/SERVING.md "Speculative decoding") -----
+    def on_spec(self, drafted: int, accepted: int, rejected: int,
+                rollbacks: int):
+        """One verify dispatch's outcome: ``drafted`` tokens were
+        teacher-forced, ``accepted`` of them emitted (each one a token
+        that skipped a full weight-set stream), ``rejected`` discarded,
+        and ``rollbacks`` lanes had their draft cut short.  The
+        ``serving.spec.accept_rate`` gauge is the lifetime derived
+        ratio (accepted / drafted)."""
+        stat_registry.get("serving.spec.drafted").add(int(drafted))
+        if accepted:
+            stat_registry.get("serving.spec.accepted").add(int(accepted))
+        if rejected:
+            stat_registry.get("serving.spec.rejected").add(int(rejected))
+        if rollbacks:
+            stat_registry.get("serving.spec.rollbacks").add(int(rollbacks))
+        total_d = stat_registry.get("serving.spec.drafted").get()
+        total_a = stat_registry.get("serving.spec.accepted").get()
+        if total_d:
+            stat_registry.get("serving.spec.accept_rate").set(
+                total_a / total_d)
+
     def on_prefill(self, seconds: float):
         stat_registry.histogram("serving.prefill_latency_ms").observe(
             seconds * 1e3)
@@ -277,6 +308,10 @@ class ServingMetrics:
             short: stat_registry.get(f"serving.prefix.{short}").get()
             for short in ("hits", "misses", "hit_tokens", "evictions",
                           "cow", "cached_tokens")}
+        snap["spec"] = {
+            short: stat_registry.get(f"serving.spec.{short}").get()
+            for short in ("drafted", "accepted", "rejected", "rollbacks",
+                          "accept_rate")}
         for name in self.HISTOGRAMS:
             h = stat_registry.histogram(name).snapshot()
             key = name[len("serving."):]

@@ -96,6 +96,13 @@ class EngineSnapshot:
     pages: Dict[str, List[np.ndarray]]  # {"k": [L x [R,P,H,D]], "v": ...}
     nbytes: int = 0
     created_at: float = field(default_factory=time.monotonic)
+    # speculative-decoding drafter state (ISSUE 12): the lane's
+    # adaptive throttle (plain python scalars, Drafter.export_lane) —
+    # a resumed request keeps drafting exactly where the donor left
+    # off, so a seeded chaos replay reproduces the same
+    # drafted/accepted counts across a failover.  None/{} when the
+    # donor engine ran without speculation; ignored by engines that do.
+    spec: Optional[dict] = None
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -151,6 +158,7 @@ class EngineSnapshot:
             "page_size": int(self.page_size),
             "pages": {side: [np.asarray(p) for p in arrs]
                       for side, arrs in self.pages.items()},
+            "spec": dict(self.spec) if self.spec else None,
         }
 
     @classmethod
@@ -185,7 +193,8 @@ class EngineSnapshot:
             kv_mode=state["kv_mode"],
             page_size=int(state["page_size"]),
             pages={side: [np.asarray(p) for p in arrs]
-                   for side, arrs in state["pages"].items()})
+                   for side, arrs in state["pages"].items()},
+            spec=state.get("spec"))
 
 
 # =============================================================================

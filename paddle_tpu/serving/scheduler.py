@@ -304,6 +304,15 @@ class Scheduler:
                 preempted.append(victim)
         return preempted
 
+    def reserve(self, seq: Sequence, num_tokens: int) -> bool:
+        """Reserve pages covering ``num_tokens`` KV positions WITHOUT
+        preemption — speculative capacity (the fused K-step horizon,
+        spec-decode draft windows) must never evict a live sequence to
+        make room for tokens that may be rolled back.  Partial growth
+        is kept on failure (the pages are real and get used within the
+        horizon); the caller degrades to plain decode for the step."""
+        return self.cache.allocate(seq.seq_id, num_tokens)
+
     def _pick_victim(self, exclude: Sequence) -> Optional[Sequence]:
         # an already-expired sequence is a free victim: the engine will
         # abort it (or expire_queued will drop its requeued request)

@@ -29,6 +29,14 @@ Instrumented sites (grep ``chaos_site(`` for the live list)
 ``http.request``      POST /generate intake — ``http_error`` answers
                       with the fault's status before touching the
                       frontend.  Key: request path.
+``spec.draft``        ServingEngine._spec_step, before the drafter is
+                      consulted — ``deny`` makes that step degrade to
+                      plain decode (no drafts verified, nothing
+                      reserved; the request stream is unchanged and
+                      can never fail or corrupt — speculative decoding
+                      only ever spends or saves bandwidth).  Evaluated
+                      once per spec-capable engine step.
+                      Key: the engine's chaos/replica key.
 
 Training-side sites (ISSUE 9 — docs/CHECKPOINT.md "Chaos sites"):
 

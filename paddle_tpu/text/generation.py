@@ -23,7 +23,7 @@ from ..jit.functional import get_state
 
 __all__ = ["make_gpt_decode_step", "make_gpt_paged_decode_step",
            "make_gpt_paged_prefill_step", "make_gpt_paged_fused_decode_step",
-           "prefill", "generate"]
+           "make_gpt_paged_spec_verify_step", "prefill", "generate"]
 
 
 def _ln(x, w, b, eps=1e-5):
@@ -496,6 +496,80 @@ def make_gpt_paged_fused_decode_step(model, page_size: int,
         return out, tok, p, kv
 
     return fused_fn, init_pages
+
+
+def make_gpt_paged_spec_verify_step(model, page_size: int,
+                                    pages_per_seq: int, num_steps: int, *,
+                                    sequential: bool = False,
+                                    kv_cache_dtype=None, kv_scales=None,
+                                    weight_quant=None):
+    """Speculative-decoding verifier: teacher-force ``num_steps`` tokens
+    per lane through the paged core in ONE device program and return the
+    greedy argmax at every position — the drafted continuation is
+    accepted exactly as far as it matches (serving/spec_decode.py owns
+    the accept rule; this is just the batched primitive).
+
+    Builds ``(verify_fn, init_pages)``:
+
+    ``verify_fn(tokens [K, B], pos [B], page_tables [B, M], kv) ->
+    (out [K, B], kv')`` — row ``tokens[j]`` is the input every lane
+    consumes at position ``pos + j`` (``tokens[0]`` is the lane's
+    current next_token, rows 1.. the drafted continuation, junk-padded
+    past each lane's real draft), ``out[j]`` the verifier's argmax at
+    that position.  K/V for all K positions is written into the lanes'
+    pages exactly like the fused K-step path — positions past the
+    accepted prefix hold junk that the next real decode write overwrites
+    BEFORE any attention can reach it (``seq_lens`` masks it until
+    then), so native and int8_static KV need no device-side rollback.
+
+    ``sequential=False`` (the throughput shape) runs all B*K positions
+    as one ragged chunked-prefill-style forward — the weight set streams
+    from HBM ONCE per K tokens instead of once per token, which is the
+    whole speculative-decoding bandwidth win.  ``sequential=True`` runs
+    a ``lax.fori_loop`` of K single-position steps (teacher-forced
+    ``make_gpt_paged_fused_decode_step``): required by int8_dynamic KV,
+    where per-page scale growth couples positions within a page — the
+    sequential schedule reproduces the plain decode loop's progressive
+    quantization bit for bit (docs/SERVING.md "Speculative decoding").
+    """
+    if num_steps < 2:
+        raise ValueError("num_steps must be >= 2 (1 is plain decode)")
+    core, init_pages = _make_gpt_paged_core(
+        model, page_size, pages_per_seq, kv_cache_dtype=kv_cache_dtype,
+        kv_scales=kv_scales, weight_quant=weight_quant)
+    K = int(num_steps)
+
+    if sequential:
+        def verify_fn(tokens, pos, page_tables, kv):
+            B = pos.shape[0]
+            out0 = jnp.zeros((K, B), jnp.int32)
+
+            def body(j, carry):
+                kv, out = carry
+                logits, kv = core(tokens[j], pos + j, page_tables, kv)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return kv, out.at[j].set(nxt)
+
+            kv, out = jax.lax.fori_loop(0, K, body, (kv, out0))
+            return out, kv
+    else:
+        def verify_fn(tokens, pos, page_tables, kv):
+            B = pos.shape[0]
+            # one ragged forward over B*K rows: row (b, j) consumes
+            # tokens[j, b] at position pos[b] + j against lane b's page
+            # table — the chunked-prefill broadcast trick, per lane.
+            # Causality within the draft comes for free: all K k/v
+            # slabs scatter first, then row (b, j) attends with
+            # seq_lens = pos[b] + j + 1.
+            toks = tokens.T.reshape(-1)                       # [B*K]
+            posf = (pos[:, None]
+                    + jnp.arange(K, dtype=pos.dtype)).reshape(-1)
+            tables = jnp.repeat(page_tables, K, axis=0)       # [B*K, M]
+            logits, kv = core(toks, posf, tables, kv)
+            out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return out.reshape(B, K).T, kv
+
+    return verify_fn, init_pages
 
 
 def prefill(step_fn, state, prompt: jnp.ndarray):

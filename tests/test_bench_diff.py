@@ -143,6 +143,24 @@ class TestFailOnRegression:
             "detail.observability.tokens_per_sec_on")
         assert not bench_diff.lower_is_better(
             "detail.observability.tokens_per_sec_off")
+        # speculative decoding section (ISSUE 12): accept_rate and
+        # accepted/drafted tokens gate DOWNWARD (the "accept" fragment
+        # must beat the lower-better "_rate" collision, like hit_rate);
+        # rejected drafts, rollbacks and ITL latencies gate UPWARD, and
+        # the off/on speedup ratio is a higher-better "_x"
+        assert not bench_diff.lower_is_better(
+            "detail.spec_decode.on.accept_rate")
+        assert not bench_diff.lower_is_better("serving.spec.accept_rate")
+        assert not bench_diff.lower_is_better("serving.spec.accepted")
+        assert not bench_diff.lower_is_better("serving.spec.drafted")
+        assert bench_diff.lower_is_better("serving.spec.rejected")
+        assert bench_diff.lower_is_better("serving.spec.rollbacks")
+        assert bench_diff.lower_is_better(
+            "detail.spec_decode.on.itl_ms_p95")
+        assert not bench_diff.lower_is_better(
+            "detail.spec_decode.tokens_per_sec_speedup_x")
+        assert not bench_diff.lower_is_better(
+            "detail.spec_decode.on.tokens_per_sec")
 
     def test_reduction_ratio_gates_on_drop_not_rise(self):
         """The PR-4 acceptance metric: kv_bytes_reduction_x falling

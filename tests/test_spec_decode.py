@@ -1,0 +1,516 @@
+"""Speculative decoding (ISSUE 12): n-gram drafter + fused K-token
+verifier over the paged serving engine.
+
+Acceptance anchors:
+- speculation-on token streams are BYTE-IDENTICAL to
+  ``generate(greedy)`` across sync / pipelined / fused consume modes
+  and native / int8_static / int8_dynamic KV (the dynamic mode's
+  rollback restores per-page scales via gather/restore/replay);
+- the steady-state speculative loop stays ``jax.transfer_guard``- and
+  ``compile_budget(0, prefix="serving.")``-clean with mixed
+  accept/reject lanes (K is a traced-over constant, never a per-call
+  scalar);
+- the ``spec.draft`` chaos site's ``deny`` degrades a step to plain
+  decode without changing any stream;
+- a seeded-chaos replica kill mid-speculation fails over byte-identical
+  from the last checkpoint, with the drafter's lane state riding the
+  snapshot.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.errors import InvalidArgumentError
+from paddle_tpu.serving import (NgramDrafter, ServingEngine,
+                                ServingFrontend, SpecDecoder)
+from paddle_tpu.testing import chaos
+from paddle_tpu.testing.chaos import ChaosPlan, Fault
+from paddle_tpu.text.generation import (generate,
+                                        make_gpt_paged_decode_step,
+                                        make_gpt_paged_spec_verify_step)
+
+VOCAB, HID, LAYERS, HEADS = 50, 32, 2, 2
+
+
+@pytest.fixture(scope="module")
+def gpt(shared_gpt_small):
+    # session-shared model (conftest): the serving programs compile
+    # once for the whole suite; weights identical to every reference
+    return shared_gpt_small
+
+
+@pytest.fixture(scope="module")
+def quant(gpt):
+    from paddle_tpu.slim import export_serving_quant
+
+    rng = np.random.RandomState(3)
+    return export_serving_quant(
+        gpt, calib_prompts=rng.randint(1, VOCAB, (4, 12)).astype(np.int32))
+
+
+def _reference(gpt, prompt, budget, quant=None):
+    want, _ = generate(gpt, np.asarray(prompt)[None, :],
+                       max_new_tokens=budget, end_id=0, quant=quant)
+    w = want.numpy()[0]
+    if (w == 0).any():
+        w = w[: int(np.argmax(w == 0)) + 1]
+    return w
+
+
+def _mixed_prompts(rng):
+    """One cyclic prompt (accept-friendly), two structureless ones —
+    drives accepted AND rejected drafts in one run."""
+    pat = rng.randint(1, VOCAB, (5,)).astype(np.int32)
+    return [np.tile(pat, 4),
+            rng.randint(1, VOCAB, (9,)).astype(np.int32),
+            rng.randint(1, VOCAB, (3,)).astype(np.int32)]
+
+
+# =============================================================================
+# Drafter units (pure host)
+# =============================================================================
+class TestNgramDrafter:
+    def test_self_history_cycle_with_extension(self):
+        d = NgramDrafter(max_ngram=4, min_ngram=2)
+        d.begin_lane("a", [7, 8, 9, 7, 8, 9, 7, 8, 9])
+        # suffix (8, 9) last occurred earlier with continuation 7 —
+        # self-extension then wraps the cycle out to max_tokens
+        got = d.propose("a", 6)
+        np.testing.assert_array_equal(got, [7, 8, 9, 7, 8, 9])
+
+    def test_corpus_continuation_beats_prompt_region_self_match(self):
+        d = NgramDrafter(max_ngram=4, min_ngram=2)
+        # a previous completion: tiled prompt then a DIFFERENT stream
+        d.ingest([5, 6, 5, 6, 5, 6, 40, 41, 42, 43])
+        # a new lane with the same tiled prompt: the prompt-region
+        # self-match would predict "5, 6, ..." forever; the corpus
+        # knows the prompt->generation boundary broke the pattern
+        d.begin_lane("b", [5, 6, 5, 6, 5, 6])
+        got = d.propose("b", 4)
+        np.testing.assert_array_equal(got, [40, 41, 42, 43])
+
+    def test_generated_region_self_match_wins_over_corpus(self):
+        d = NgramDrafter(max_ngram=4, min_ngram=2)
+        d.ingest([1, 2, 3, 30, 31, 32])
+        d.begin_lane("c", [9])
+        for t in (1, 2, 3, 1, 2, 3):       # the lane's OWN cycle
+            d.observe("c", t)
+        got = d.propose("c", 3)
+        np.testing.assert_array_equal(got, [1, 2, 3])
+
+    def test_cooldown_backoff_and_reset(self):
+        d = NgramDrafter(max_ngram=3, min_ngram=2)
+        d.begin_lane("a", [4, 5, 4, 5, 4, 5])
+        assert len(d.propose("a", 2, tick=False)) == 2
+        d.on_result("a", drafted=2, accepted=0)     # full rejection
+        assert d._lanes["a"].cooldown == 2
+        assert len(d.propose("a", 2)) == 0          # tick 2 -> 1
+        assert len(d.propose("a", 2)) == 0          # tick 1 -> 0
+        got = d.propose("a", 2)                     # recovered
+        assert len(got) == 2
+        d.on_result("a", drafted=2, accepted=1)
+        assert d._lanes["a"].miss_streak == 0
+        # repeated full misses back off exponentially, capped
+        for _ in range(9):
+            d.on_result("a", 2, 0)
+        assert d._lanes["a"].cooldown == NgramDrafter.COOLDOWN_CAP
+
+    def test_export_import_lane_state(self):
+        d = NgramDrafter()
+        d.begin_lane("a", [1, 2, 3, 1, 2, 3])
+        d.on_result("a", 2, 0)
+        state = d.export_lane("a")
+        assert state == {"miss_streak": 1, "cooldown": 2}
+        d2 = NgramDrafter()
+        d2.begin_lane("a", [1, 2, 3, 1, 2, 3])
+        d2.import_lane("a", state)
+        assert d2.export_lane("a") == state
+        d.forget("a")
+        assert d.export_lane("a") == {}
+
+    def test_corpus_eviction_is_bounded(self):
+        d = NgramDrafter(max_ngram=3, min_ngram=3, max_corpora=2)
+        d.ingest([1, 2, 3, 4, 5])
+        n_after_one = len(d._corpus_idx)
+        d.ingest([6, 7, 8, 9, 10])
+        d.ingest([11, 12, 13, 14, 15])      # evicts the oldest
+        assert len(d._corpora) == 2
+        # the victim's index entries were swept — the n-gram view stays
+        # bounded by the LIVE corpora, not by total tokens ever served
+        assert len(d._corpus_idx) == 2 * n_after_one
+        d.begin_lane("x", [1, 2, 3])
+        assert len(d.propose("x", 2)) == 0  # evicted
+        d.begin_lane("y", [11, 12, 13])
+        np.testing.assert_array_equal(d.propose("y", 2), [14, 15])
+        # identical re-ingest is deduplicated
+        d.ingest([11, 12, 13, 14, 15])
+        assert len(d._corpora) == 2
+
+    def test_validation(self):
+        with pytest.raises(InvalidArgumentError):
+            NgramDrafter(max_ngram=2, min_ngram=3)
+        with pytest.raises(InvalidArgumentError):
+            NgramDrafter(max_corpora=-1)
+        with pytest.raises(InvalidArgumentError):
+            SpecDecoder(1)
+        with pytest.raises(InvalidArgumentError):
+            SpecDecoder(4, drafter=object())
+
+    def test_accept_rule_is_prefix_match_then_verifier_token(self):
+        s = SpecDecoder(4)
+        col = np.array([10, 11, 12, 13], np.int32)
+        assert s.accept_len(np.array([], np.int32), col) == 1
+        assert s.accept_len(np.array([10, 11, 12], np.int32), col) == 4
+        assert s.accept_len(np.array([10, 99, 12], np.int32), col) == 2
+        assert s.accept_len(np.array([99], np.int32), col) == 1
+
+
+# =============================================================================
+# The verify primitive
+# =============================================================================
+class TestSpecVerifyProgram:
+    @pytest.mark.parametrize("sequential", [False, True])
+    def test_verify_matches_k_teacher_forced_steps(self, gpt, sequential):
+        """One verify dispatch's outputs == K single decode steps fed
+        the same inputs, junk-padded drafts and all (the sequential
+        schedule is the int8_dynamic variant; on native KV both must
+        agree with the step-at-a-time ground truth)."""
+        ps, M, K, B = 4, 16, 4, 2
+        step, init_pages = make_gpt_paged_decode_step(gpt, ps, M)
+        verify, _ = make_gpt_paged_spec_verify_step(
+            gpt, ps, M, K, sequential=sequential)
+        rng = np.random.RandomState(5)
+        toks = rng.randint(1, VOCAB, (K, B)).astype(np.int32)
+        pos0 = np.array([0, 3], np.int32)
+        tables = np.arange(1, 1 + B * M, dtype=np.int32).reshape(B, M)
+
+        kv = init_pages(1 + B * M)
+        want = []
+        import jax.numpy as jnp
+        for j in range(K):
+            logits, kv = step(jnp.asarray(toks[j]),
+                              jnp.asarray(pos0 + j),
+                              jnp.asarray(tables), kv)
+            want.append(np.asarray(jnp.argmax(logits, axis=-1)))
+        out, _ = verify(jnp.asarray(toks), jnp.asarray(pos0),
+                        jnp.asarray(tables), init_pages(1 + B * M))
+        np.testing.assert_array_equal(np.asarray(out), np.stack(want))
+
+    def test_num_steps_validation(self, gpt):
+        with pytest.raises(ValueError):
+            make_gpt_paged_spec_verify_step(gpt, 4, 16, 1)
+
+
+# =============================================================================
+# Engine byte-identity
+# =============================================================================
+class TestByteIdentity:
+    BUDGET = 20
+
+    def _drive(self, eng, prompts):
+        ids = [eng.add_request(p, max_new_tokens=self.BUDGET)
+               for p in prompts]
+        return ids, eng.drain()
+
+    @pytest.mark.parametrize("mode", ["pipelined", "sync", "fused"])
+    def test_native_modes_match_generate(self, gpt, mode):
+        kw = {"sync": dict(sync_mode=True),
+              "fused": dict(fused_steps=4)}.get(mode, {})
+        prompts = _mixed_prompts(np.random.RandomState(0))
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=4, eos_id=0,
+                            spec_decode=4, **kw)
+        ids, outs = self._drive(eng, prompts)
+        for p, rid in zip(prompts, ids):
+            np.testing.assert_array_equal(outs[rid],
+                                          _reference(gpt, p, self.BUDGET))
+        s = eng.stats()["spec"]
+        assert s["enabled"] and s["k"] == 4
+        assert s["drafted"] > 0
+        assert s["rejected"] > 0          # mixed accept/reject exercised
+        assert eng.cache.pages_in_use == 0
+
+    def test_speculated_lifecycle_events_recorded(self, gpt):
+        from paddle_tpu.profiler.flight_recorder import recorder as flight
+
+        prompts = _mixed_prompts(np.random.RandomState(0))
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=4, eos_id=0,
+                            spec_decode=4)
+        ids, _ = self._drive(eng, prompts)
+        evs = [e for rid in ids
+               for e in (flight.trace(rid) or {"events": []})["events"]
+               if e["kind"] == "speculated"]
+        assert evs, "no speculated lifecycle events recorded"
+        assert all("drafted" in e and "accepted" in e for e in evs)
+
+    def test_int8_static_matches_quantized_generate(self, gpt, quant):
+        q = {"kv_cache_dtype": "int8", "kv_scales": quant["kv_scales"]}
+        prompts = _mixed_prompts(np.random.RandomState(0))
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=4, eos_id=0,
+                            spec_decode=4, kv_cache_dtype="int8",
+                            quant_scales=quant)
+        assert not eng.spec.sequential
+        ids, outs = self._drive(eng, prompts)
+        for p, rid in zip(prompts, ids):
+            np.testing.assert_array_equal(
+                outs[rid], _reference(gpt, p, self.BUDGET, quant=q))
+        assert eng.stats()["spec"]["drafted"] > 0
+
+    def test_int8_dynamic_rollback_restores_scales(self, gpt):
+        """Dynamic per-page scales are grown by every write, junk
+        included — the gather/restore/replay rollback must make a
+        rejected draft invisible, so the spec-on stream equals the
+        spec-off engine's (the established dynamic-mode reference)."""
+        prompts = _mixed_prompts(np.random.RandomState(0))
+
+        def run(spec):
+            eng = ServingEngine(gpt, page_size=4, max_batch_size=4,
+                                eos_id=0, spec_decode=spec,
+                                kv_cache_dtype="int8",
+                                sync_mode=not spec)
+            ids, outs = self._drive(eng, prompts)
+            return eng, ids, outs
+
+        e_off, ids_off, outs_off = run(False)
+        e_on, ids_on, outs_on = run(4)
+        assert e_on.spec.sequential   # the documented dynamic schedule
+        for a, b in zip(ids_on, ids_off):
+            np.testing.assert_array_equal(outs_on[a], outs_off[b])
+        s = e_on.stats()["spec"]
+        assert s["drafted"] > 0 and s["rollbacks"] > 0
+        assert e_on.cache.pages_in_use == 0
+
+
+# =============================================================================
+# Steady-state invariants: no transfers, no retraces, mixed lanes
+# =============================================================================
+class _SplitDrafter(NgramDrafter):
+    """Test drafter: one designated lane always gets a WRONG draft
+    (bypassing the cooldown), every other lane drafts normally — a
+    deterministic mixed accept/reject steady state, and a live check
+    that the pluggable Drafter seam works."""
+
+    def __init__(self, wrong_lane_id):
+        super().__init__(max_ngram=4, min_ngram=2)
+        self.wrong = wrong_lane_id
+
+    def propose(self, seq_id, max_tokens, tick=True):
+        if seq_id == self.wrong:
+            return np.asarray([1, 2, 3][:max_tokens], np.int32)
+        return super().propose(seq_id, max_tokens, tick=tick)
+
+    def on_result(self, seq_id, drafted, accepted):
+        if seq_id != self.wrong:
+            super().on_result(seq_id, drafted, accepted)
+
+
+class TestSteadyState:
+    def test_transfer_guard_and_compile_budget_with_mixed_lanes(self, gpt):
+        """With speculation enabled and both accepting and rejecting
+        lanes in the batch, the warmed loop must neither retrace any
+        serving.* program (K is traced-over — RH001) nor perform an
+        implicit host transfer (drafts move via explicit device_put,
+        results via explicit device_get)."""
+        rng = np.random.RandomState(1)
+        pat = rng.randint(1, VOCAB, (4,)).astype(np.int32)
+        cyc = np.tile(pat, 5)
+        rnd = rng.randint(1, VOCAB, (9,)).astype(np.int32)
+        eng = ServingEngine(
+            gpt, page_size=4, max_batch_size=2, eos_id=-1,
+            spec_decode=4, spec_drafter=_SplitDrafter("wrong"))
+        eng.add_request(cyc, max_new_tokens=40, request_id="cycle")
+        eng.add_request(rnd, max_new_tokens=40, request_id="wrong")
+        for _ in range(6):               # admit + compile + warm cycle
+            eng.step()
+        s0 = eng.stats()["spec"]
+        from paddle_tpu.profiler.jit_cost import compile_budget
+
+        with jax.transfer_guard("disallow"), \
+                compile_budget(0, prefix="serving."):
+            for _ in range(5):
+                eng.step()
+        s1 = eng.stats()["spec"]
+        assert s1["steps"] > s0["steps"], "no spec step in the window"
+        assert s1["accepted"] > s0["accepted"]
+        assert s1["rejected"] > s0["rejected"]
+        outs = eng.drain()
+        # identity after the guarded segment (vs the plain engine)
+        plain = ServingEngine(gpt, page_size=4, max_batch_size=2,
+                              eos_id=-1)
+        a = plain.add_request(cyc, max_new_tokens=40)
+        b = plain.add_request(rnd, max_new_tokens=40)
+        want = plain.drain()
+        np.testing.assert_array_equal(outs["cycle"], want[a])
+        np.testing.assert_array_equal(outs["wrong"], want[b])
+
+
+# =============================================================================
+# Degradation: chaos denial and horizon pressure
+# =============================================================================
+class TestDegradation:
+    def test_chaos_deny_degrades_to_plain_decode(self, gpt):
+        prompts = _mixed_prompts(np.random.RandomState(0))
+        plan = ChaosPlan([Fault("spec.draft", at=1, action="deny",
+                                count=10_000)])
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=4, eos_id=0,
+                            spec_decode=4)
+        with chaos.running(plan):
+            ids = [eng.add_request(p, max_new_tokens=16) for p in prompts]
+            outs = eng.drain()
+        for p, rid in zip(prompts, ids):
+            np.testing.assert_array_equal(outs[rid],
+                                          _reference(gpt, p, 16))
+        s = eng.stats()["spec"]
+        assert s["drafted"] == 0 and s["steps"] == 0
+        assert s["degraded"] > 0
+        assert any(f["site"] == "spec.draft" for f in plan.fired_log())
+
+    def test_reservation_denial_degrades_lane(self, gpt):
+        """kv.allocate denial during the horizon reserve: the drafted
+        lane degrades to a plain ride-along, nothing fails, streams
+        unchanged."""
+        prompts = _mixed_prompts(np.random.RandomState(0))
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=4, eos_id=0,
+                            spec_decode=4)
+        orig = eng.scheduler.reserve
+        denied = {"n": 0}
+
+        def deny_twice(seq, num_tokens):
+            denied["n"] += 1
+            if denied["n"] <= 2:
+                return False
+            return orig(seq, num_tokens)
+
+        eng.scheduler.reserve = deny_twice
+        ids = [eng.add_request(p, max_new_tokens=16) for p in prompts]
+        outs = eng.drain()
+        for p, rid in zip(prompts, ids):
+            np.testing.assert_array_equal(outs[rid],
+                                          _reference(gpt, p, 16))
+        assert denied["n"] > 2
+        assert eng.stats()["spec"]["degraded"] > 0
+        assert eng.cache.pages_in_use == 0
+
+
+# =============================================================================
+# Failover: snapshots carry drafter state; seeded kill stays identical
+# =============================================================================
+class TestFailover:
+    def test_snapshot_resume_mid_speculation(self, gpt):
+        rng = np.random.RandomState(2)
+        prompt = np.tile(rng.randint(1, VOCAB, (4,)).astype(np.int32), 4)
+        budget = 18
+        want, _ = generate(gpt, prompt[None, :], max_new_tokens=budget,
+                           end_id=-1)
+        want = want.numpy()[0]
+
+        class OracleDrafter(NgramDrafter):
+            """Deterministic always-right drafts from the precomputed
+            reference — speculation is guaranteed live on both sides
+            of the failover."""
+
+            def propose(self, seq_id, max_tokens, tick=True):
+                st = self._lanes.get(seq_id)
+                if st is None:
+                    return np.zeros((0,), np.int32)
+                gen = len(st.hist) - st.prompt_len
+                return np.asarray(want[gen: gen + max_tokens], np.int32)
+
+        # eos disabled: the checkpoint must happen MID-stream
+        a = ServingEngine(gpt, page_size=4, max_batch_size=2, eos_id=-1,
+                          spec_decode=4, spec_drafter=OracleDrafter())
+        rid = a.add_request(prompt, max_new_tokens=budget)
+        for _ in range(100):
+            a.step()
+            seq = next((s for s in a.scheduler.running
+                        if s.seq_id == rid), None)
+            if seq is not None and 0 < len(seq.generated) < budget:
+                break
+        else:
+            pytest.fail("never observed the request mid-stream")
+        assert a.stats()["spec"]["drafted"] > 0
+        snap = a.snapshot(rid)
+        assert snap is not None
+        # the drafter's adaptive lane state rides along (plain dict)
+        assert snap.spec == {"miss_streak": 0, "cooldown": 0}
+        state = snap.to_state()
+        from paddle_tpu.serving import EngineSnapshot
+
+        snap2 = EngineSnapshot.from_state(state)
+        assert snap2.spec == snap.spec
+        b = ServingEngine(gpt, page_size=4, max_batch_size=2, eos_id=-1,
+                          spec_decode=4, spec_drafter=OracleDrafter())
+        b.restore(snap2)
+        outs = b.drain()
+        np.testing.assert_array_equal(outs[rid], want)
+        assert b.stats()["spec"]["drafted"] > 0  # resumed AND speculated
+
+    def test_seeded_kill_mid_speculation_fails_over_byte_identical(
+            self, gpt):
+        """The chaos-coverage satellite: a seeded replica kill while
+        speculation is active — every stream completes byte-identical
+        from the last checkpoint on the survivor."""
+        rng = np.random.RandomState(7)
+        pats = [rng.randint(1, VOCAB, (4,)).astype(np.int32)
+                for _ in range(3)]
+        prompts = [np.tile(pats[i % 3], 3 + i % 2) for i in range(6)]
+        budget = 12
+        plan = ChaosPlan([Fault("replica.kill", at=6, action="kill",
+                                match="replica-0")])
+        fe = ServingFrontend(gpt, replicas=2, queue_cap=16,
+                             engine_kwargs=dict(page_size=4,
+                                                max_batch_size=4,
+                                                eos_id=0),
+                             spec_decode=4, snapshot_interval=4)
+        try:
+            with chaos.running(plan):
+                handles = [fe.submit(p, max_new_tokens=budget)
+                           for p in prompts]
+                statuses = [h.wait(timeout=300) for h in handles]
+            assert statuses == ["completed"] * len(prompts)
+            assert any(f["site"] == "replica.kill"
+                       for f in plan.fired_log())
+            for p, h in zip(prompts, handles):
+                np.testing.assert_array_equal(
+                    h.tokens, _reference(gpt, p, budget))
+            # speculation was live in the fleet around the kill
+            es = fe.engine_metrics.snapshot()
+            assert es["spec"]["drafted"] > 0
+        finally:
+            fe.close()
+
+
+# =============================================================================
+# Knobs, config plumbing, stats surface
+# =============================================================================
+class TestKnobs:
+    def test_engine_validation(self, gpt):
+        with pytest.raises(InvalidArgumentError):
+            ServingEngine(gpt, spec_decode="yes")
+        with pytest.raises(InvalidArgumentError):
+            ServingEngine(gpt, spec_decode=1)
+        with pytest.raises(InvalidArgumentError):
+            ServingEngine(gpt, spec_drafter=NgramDrafter())
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=2)
+        assert eng.spec is None
+        assert eng.stats()["spec"] == {"enabled": False}
+
+    def test_frontend_validation(self, gpt):
+        with pytest.raises(InvalidArgumentError):
+            ServingFrontend(gpt, spec_decode="fast")
+        with pytest.raises(InvalidArgumentError):
+            ServingFrontend(engine_factory=lambda: None, spec_decode=True)
+
+    def test_config_plumbing(self, gpt):
+        from paddle_tpu.inference import Config
+        from paddle_tpu.serving import create_serving_engine
+
+        cfg = Config()
+        cfg.enable_serving(page_size=4, max_batch_size=2, spec_decode=3)
+        eng = create_serving_engine(gpt, cfg)
+        assert eng.spec is not None and eng.spec.k == 3
+        snap = eng.metrics.snapshot()
+        assert snap["spec"] == {"drafted": 0, "accepted": 0,
+                                "rejected": 0, "rollbacks": 0,
+                                "accept_rate": 0}

@@ -125,6 +125,13 @@ def diff(a: dict, b: dict, only: Optional[str] = None,
 _HIGHER_BETTER = ("reduction", "per_sec", "per_second", "goodput",
                   "throughput", "occupancy", "parity", "speedup",
                   "utilization", "hit", "cached", "skipped", "saved",
+                  # speculative decoding (ISSUE 12): accept_rate and
+                  # accepted/drafted token counts falling
+                  # round-over-round mean the drafter is losing its
+                  # grip on the workload ("accept" must outrank the
+                  # lower-better "_rate" fragment; "drafted" measures
+                  # how much speculation even engages)
+                  "accept", "drafted",
                   "_x")
 # name fragments marking metrics where SMALLER is better (latencies,
 # misses, memory, churn, compile counts — a compile_count drifting up
@@ -142,7 +149,12 @@ _LOWER_BETTER = ("_ms", "latency", "ttft", "e2e", "gap", "miss", "bytes",
                  # prefix cache (ISSUE 10): eviction churn and COW
                  # copies rising round-over-round mean the index is
                  # thrashing or diverging more, both worse
-                 "evict", "cow")
+                 "evict", "cow",
+                 # speculative decoding (ISSUE 12): rollbacks rising
+                 # mean more bandwidth burned on wrong guesses
+                 # (rejected-draft counters are covered by the
+                 # pre-existing "reject" fragment above)
+                 "rollback")
 
 
 def lower_is_better(metric: str) -> bool:
