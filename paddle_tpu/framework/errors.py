@@ -90,6 +90,24 @@ class CheckpointIncompatibleError(PreconditionNotMetError):
     precondition of the restore, hence 412)."""
 
 
+class NumericalFaultError(InternalError):
+    """Numerical damage detected by a device-side guard — a non-finite
+    loss/gradient in the train step, or non-finite logits on a serving
+    lane (ISSUE 13).  Server-side damage, never the caller's fault:
+    serving quarantines exactly the damaged request with this class
+    (HTTP 500 within one engine step) while every other stream
+    continues untouched; training skips or rolls back the step
+    (docs/CHECKPOINT.md "Numerical self-healing")."""
+
+
+class ParameterCorruptionError(InternalError):
+    """The SDC audit found a corrupted parameter leaf — a non-finite
+    value in live device params, or a per-leaf CRC mismatch against a
+    checkpoint manifest (ISSUE 13).  The message names the EXACT leaf;
+    the anomaly runtime responds by rolling back to the newest verified
+    checkpoint (docs/CHECKPOINT.md "Numerical self-healing")."""
+
+
 # --- HTTP status derivation --------------------------------------------------
 # One place decides how the taxonomy surfaces over HTTP, so the serving
 # frontend/HTTP layer derives its status codes from the error CLASS of a
@@ -110,6 +128,8 @@ ERROR_HTTP_STATUS = {
     ExecutionTimeoutError: 504,
     CheckpointCorruptError: 500,       # durable state lost server-side
     CheckpointIncompatibleError: 412,  # restore precondition not met
+    NumericalFaultError: 500,          # numeric guard tripped server-side
+    ParameterCorruptionError: 500,     # SDC audit named a corrupt leaf
     InternalError: 500,
     FatalError: 500,
     # explicit base fallback: EVERY taxonomy class resolves to a status

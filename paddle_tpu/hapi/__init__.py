@@ -9,6 +9,12 @@ from .callbacks import (  # noqa: F401
     ReduceLROnPlateau,
     VisualDL,
 )
+from .anomaly import (  # noqa: F401
+    AnomalyPolicy,
+    AnomalyRuntime,
+    LossSpikeDetector,
+    ParameterAudit,
+)
 from .checkpoint import (  # noqa: F401
     TrainCheckpointer,
     capture_train_state,

@@ -1,0 +1,454 @@
+"""Numerical self-healing for the hapi train loop (ISSUE 13).
+
+The reference framework wraps every kernel boundary in ``PADDLE_ENFORCE*``
+checks so numerical faults surface as classified errors; this module is
+the train-loop analog for the faults no enforce can catch at a kernel
+boundary — a NaN gradient, a diverging loss, a silently-corrupted
+parameter.  Three graduated responses, cheapest first
+(docs/CHECKPOINT.md "Numerical self-healing"):
+
+1. **SKIP-STEP** — the guarded jitted train step folds
+   ``isfinite(loss) & isfinite(global_grad_norm)`` into its existing
+   outputs (read on host together with the loss — zero extra syncs).
+   A non-finite step is discarded: the pre-step state handle is
+   restored (guard mode trades the in-place state donation for keeping
+   the previous buffers alive — the discard is a host pointer swap,
+   no device round trip) and BOTH PRNG streams rewind to their
+   pre-attempt capture, so the trajectory continues exactly as if the
+   poisoned batch had never been drawn (``train.anomaly.skipped_steps``).
+2. **SPIKE DETECTION** — a rolling median/MAD detector over the loss
+   (window + k·MAD threshold, warmup grace) flags divergence the
+   finiteness guard can't see; ``spike_action`` picks skip (discard the
+   update like a non-finite step) or tolerate (count it, keep going)
+   (``train.anomaly.loss_spikes``).
+3. **ROLLBACK** — ``rollback_after`` damage events within
+   ``rollback_window`` observed steps, or a corrupted parameter named
+   by the SDC audit, restore the newest VERIFIED checkpoint through the
+   fit loop's :class:`~paddle_tpu.hapi.checkpoint.TrainCheckpointer`:
+   candidates are per-leaf-CRC-verified (``CheckpointStore.verify`` —
+   the store's records finally have a live caller) AND finiteness-swept
+   before being trusted, poisoned/corrupt ones are skipped
+   (``train.anomaly.corrupt_checkpoints``), and the batches that caused
+   step-damage are fast-forwarded past on replay.  A ``rollback_budget``
+   bounds the loop: exhausting it escalates to ``FatalError`` with a
+   postmortem bundle (the flight recorder's crash path).
+
+The **SDC audit** (:class:`ParameterAudit`) is a jitted on-device
+per-leaf finiteness sweep over the live parameters, run every
+``audit_interval`` steps and after each committed checkpoint; its one
+host read per audit is the measured ``train.anomaly.audit_ms``.  A
+corrupted leaf raises a typed
+:class:`~paddle_tpu.framework.errors.ParameterCorruptionError` naming
+the EXACT leaf.  Detection boundary (documented honestly): the live
+sweep catches flips that drive a value non-finite (exponent-field
+damage — what the chaos ``corrupt_param`` action injects); a flip that
+leaves the value finite and plausible is invisible to any single-copy
+checker and is caught at the durability boundary instead, by the
+store's per-leaf CRC records (``load_latest(verify=True)``).
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+
+from ..framework.errors import (CheckpointCorruptError,
+                                CheckpointIncompatibleError, FatalError,
+                                InvalidArgumentError,
+                                ParameterCorruptionError)
+from ..framework.monitor import histogram_observe, stat_add
+from ..profiler.flight_recorder import recorder as flight
+
+__all__ = ["AnomalyPolicy", "LossSpikeDetector", "ParameterAudit",
+           "AnomalyRuntime"]
+
+_SPIKE_ACTIONS = ("skip", "tolerate")
+
+
+@dataclass
+class AnomalyPolicy:
+    """Knobs for the graduated numerical-fault responses (the
+    ``Model.fit(anomaly=)`` config object; ``anomaly=True`` uses the
+    defaults).  Contracts in docs/CHECKPOINT.md "Numerical
+    self-healing".
+
+    - ``spike_window`` / ``spike_k`` / ``spike_warmup``: the rolling
+      median/MAD loss-spike detector — a finite loss above
+      ``median + k * MAD`` of the last ``window`` accepted losses is a
+      spike once ``warmup`` losses have been observed
+      (``spike_window=0`` disables spike detection).
+    - ``spike_action``: ``"skip"`` discards the spiked update exactly
+      like a non-finite step; ``"tolerate"`` keeps it but still counts
+      the damage event.
+    - ``rollback_after`` / ``rollback_window``: that many damage events
+      (non-finite skips + spikes) within a window of observed steps
+      trigger a checkpoint rollback; ``rollback_after=None`` disarms
+      rollback (skip-only operation — no ``checkpoint_dir`` needed).
+    - ``rollback_budget``: rollbacks allowed before the run escalates
+      to ``FatalError`` with a postmortem bundle — healing that never
+      converges is a crash, not a loop.
+    - ``audit_interval``: run the SDC parameter audit every N trained
+      steps (None = only ``audit_on_checkpoint``); ``audit_on_checkpoint``
+      additionally audits right after every committed checkpoint.
+    """
+
+    spike_window: int = 32
+    spike_k: float = 10.0
+    spike_warmup: int = 8
+    spike_action: str = "skip"
+    rollback_after: Optional[int] = 3
+    rollback_window: int = 16
+    rollback_budget: int = 2
+    audit_interval: Optional[int] = None
+    audit_on_checkpoint: bool = True
+
+    def __post_init__(self):
+        if self.spike_action not in _SPIKE_ACTIONS:
+            raise InvalidArgumentError(
+                f"spike_action must be one of {_SPIKE_ACTIONS}, got "
+                f"{self.spike_action!r}")
+        if self.spike_window < 0:
+            raise InvalidArgumentError("spike_window must be >= 0")
+        if self.spike_k <= 0:
+            raise InvalidArgumentError("spike_k must be > 0")
+        if 0 < self.spike_window < self.spike_warmup:
+            # the detector's history is capped at spike_window samples,
+            # so a warmup gate it can never reach would silently
+            # disable spike detection while the config says it is on
+            raise InvalidArgumentError(
+                f"spike_warmup ({self.spike_warmup}) exceeds "
+                f"spike_window ({self.spike_window}) — the rolling "
+                "window can never satisfy the warmup gate, so spike "
+                "detection would silently never fire")
+        if self.rollback_after is not None and self.rollback_after < 1:
+            raise InvalidArgumentError(
+                "rollback_after must be >= 1 (or None to disarm)")
+        if self.rollback_window < 1:
+            raise InvalidArgumentError("rollback_window must be >= 1")
+        if self.rollback_budget < 0:
+            raise InvalidArgumentError("rollback_budget must be >= 0")
+        if self.audit_interval is not None and self.audit_interval < 1:
+            raise InvalidArgumentError(
+                "audit_interval must be >= 1 (or None)")
+
+
+class LossSpikeDetector:
+    """Rolling median/MAD spike detector over ACCEPTED losses.
+
+    A spiked sample is flagged but NOT admitted into the window — a
+    divergence burst must not inflate its own baseline.  The MAD is
+    floored (relative to the median's magnitude) so a flat loss
+    plateau, whose MAD is ~0, does not turn ordinary noise into
+    spikes."""
+
+    def __init__(self, window: int, k: float, warmup: int):
+        self.window = int(window)
+        self.k = float(k)
+        self.warmup = max(1, int(warmup))
+        self._hist: deque = deque(maxlen=self.window or 1)
+
+    def threshold(self) -> Optional[float]:
+        """Current spike threshold, or None during warmup/disabled."""
+        if self.window <= 0 or len(self._hist) < self.warmup:
+            return None
+        arr = np.asarray(self._hist, np.float64)
+        med = float(np.median(arr))
+        mad = float(np.median(np.abs(arr - med)))
+        scale = max(mad, 1e-3 * abs(med), 1e-8)
+        return med + self.k * scale
+
+    def observe(self, loss: float) -> bool:
+        """Feed one finite loss; True = spike (sample NOT admitted)."""
+        if self.window <= 0 or not np.isfinite(loss):
+            return False            # non-finite is the guard's business
+        thr = self.threshold()
+        if thr is not None and loss > thr:
+            return True
+        self._hist.append(float(loss))
+        return False
+
+    def reset(self):
+        self._hist.clear()
+
+
+class ParameterAudit:
+    """On-device per-leaf finiteness sweep over the live parameters.
+
+    One jitted program returns a ``[n_leaves]`` bool vector (leaf order
+    = sorted names, deterministic); the audit's only host cost is that
+    one small read.  Non-float leaves audit as clean by construction.
+    The eager (``accelerate=False``) path sweeps the layer tensors on
+    host — same contract, debug-path cost."""
+
+    def __init__(self):
+        self._names: Optional[List[str]] = None
+        self._fn = None
+
+    def _build(self, params: dict):
+        import jax
+        import jax.numpy as jnp
+
+        names = sorted(params)
+
+        def sweep(ps):
+            flags = []
+            for n in names:
+                a = ps[n]
+                if np.issubdtype(np.dtype(a.dtype), np.inexact):
+                    flags.append(jnp.all(jnp.isfinite(a)))
+                else:
+                    flags.append(jnp.asarray(True))
+            return jnp.stack(flags)
+
+        self._names = names
+        self._fn = jax.jit(sweep)
+
+    def corrupted_leaf(self, model) -> Optional[str]:
+        """Name of the first (sorted order) parameter leaf holding a
+        non-finite value, or None when every leaf is clean."""
+        if getattr(model, "_state", None) is not None:
+            params = model._state["params"]
+            if self._fn is None or self._names != sorted(params):
+                self._build(params)
+            flags = np.asarray(self._fn(params))
+            for name, ok in zip(self._names, flags):
+                if not ok:
+                    return name
+            return None
+        # eager path: layer tensors on host
+        for name, p in model.network.named_parameters():
+            arr = np.asarray(p._value)
+            if np.issubdtype(arr.dtype, np.inexact) \
+                    and not np.all(np.isfinite(arr)):
+                return name
+        return None
+
+
+class _RollbackRequested(Exception):
+    """Internal control-flow signal: the fit loop catches it at the
+    epoch boundary and restores the newest verified checkpoint."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class AnomalyRuntime:
+    """Per-fit state machine driving the graduated responses.
+
+    Created by ``Model.fit(anomaly=)``; consulted after every train
+    step (``on_step_outcome``) and on the audit cadence
+    (``maybe_audit``).  Raises :class:`_RollbackRequested` when damage
+    crosses the rollback threshold — the fit loop translates that into
+    a checkpoint restore via :meth:`perform_rollback`."""
+
+    def __init__(self, policy: AnomalyPolicy, checkpointer=None):
+        self.policy = policy
+        self.ckpt = checkpointer
+        self.spikes = LossSpikeDetector(
+            policy.spike_window, policy.spike_k, policy.spike_warmup)
+        self.audit = ParameterAudit()
+        # (event_clock, epoch, batch, poison) of recent damage events
+        self._damage: deque = deque()
+        self._clock = 0                 # observed steps (trained+skipped)
+        self._steps_since_audit = 0
+        # (epoch, batch) pairs to fast-forward past on post-rollback
+        # replay — the poisoned batches are discarded for good
+        self.poisoned = set()
+        self.rollbacks_used = 0
+        self.skipped_steps = 0
+        self.loss_spikes = 0
+
+    # --- damage accounting --------------------------------------------------
+    def _note_damage(self, epoch: int, batch: int, kind: str,
+                     poison: bool):
+        pol = self.policy
+        self._damage.append((self._clock, epoch, batch, poison))
+        while self._damage and \
+                self._clock - self._damage[0][0] >= pol.rollback_window:
+            self._damage.popleft()
+        if pol.rollback_after is not None \
+                and len(self._damage) >= pol.rollback_after:
+            n = len(self._damage)
+            for _, e, b, p in self._damage:
+                if p:
+                    self.poisoned.add((e, b))
+            self._damage.clear()
+            raise _RollbackRequested(
+                f"{n} damage events within {pol.rollback_window} steps "
+                f"(last: {kind} at epoch {epoch} batch {batch})")
+
+    def on_step_outcome(self, model, outs, *, epoch: int, batch: int,
+                        global_step: int) -> str:
+        """Classify one completed train step.  Returns ``"ok"`` (keep
+        the update) or ``"skip"`` (the caller rewinds the PRNG streams;
+        the state handle is already restored here).  Raises
+        :class:`_RollbackRequested` when the damage window fills."""
+        self._clock += 1
+        guard = model._last_guard
+        pol = self.policy
+        if guard is not None and not guard["ok"]:
+            # non-finite loss/grad-norm ⇒ SKIP-STEP: discard the update
+            # (pointer swap back to the pre-step buffers) and count the
+            # damage.  The batch is marked poisoned — a rollback replay
+            # fast-forwards past it instead of re-poisoning itself.
+            self.skipped_steps += 1
+            stat_add("train.anomaly.skipped_steps", 1)
+            flight.on_transition(
+                "train.anomaly", "skip",
+                f"non-finite step (loss={guard['loss']}, "
+                f"grad_norm={guard['grad_norm']}) at epoch {epoch} "
+                f"batch {batch}")
+            if model._state is not None and model._prev_state is not None:
+                model._state = model._prev_state
+            self._note_damage(epoch, batch, "nonfinite", poison=True)
+            return "skip"
+        loss = float(outs[0])
+        if self.spikes.observe(loss):
+            self.loss_spikes += 1
+            stat_add("train.anomaly.loss_spikes", 1)
+            skip = (pol.spike_action == "skip"
+                    and model._state is not None
+                    and model._prev_state is not None)
+            flight.on_transition(
+                "train.anomaly", "spike",
+                f"loss {loss:.6g} above median+{pol.spike_k}*MAD at "
+                f"epoch {epoch} batch {batch} "
+                f"({'skipped' if skip else 'tolerated'})")
+            if skip:
+                model._state = model._prev_state
+                stat_add("train.anomaly.skipped_steps", 1)
+                self.skipped_steps += 1
+            self._note_damage(epoch, batch, "loss_spike", poison=skip)
+            return "skip" if skip else "ok"
+        return "ok"
+
+    # --- SDC audit ----------------------------------------------------------
+    def maybe_audit(self, model, *, global_step: int, epoch: int,
+                    batch: int, force: bool = False):
+        """Run the parameter audit when due (every ``audit_interval``
+        trained steps, or ``force=True`` right after a committed
+        checkpoint).  A corrupted leaf raises ``_RollbackRequested``
+        (rollback armed) or ``ParameterCorruptionError`` (skip-only
+        policy — nothing to heal from, the typed error names the leaf
+        and a postmortem bundle is written)."""
+        pol = self.policy
+        self._steps_since_audit += 1
+        due = force and pol.audit_on_checkpoint
+        if pol.audit_interval is not None \
+                and self._steps_since_audit >= pol.audit_interval:
+            due = True
+        if not due:
+            return
+        self._steps_since_audit = 0
+        t0 = time.perf_counter()
+        leaf = self.audit.corrupted_leaf(model)
+        histogram_observe("train.anomaly.audit_ms",
+                          (time.perf_counter() - t0) * 1e3)
+        if leaf is None:
+            return
+        flight.on_transition(
+            "train.corruption", leaf,
+            f"SDC audit: non-finite values at step {global_step}")
+        if pol.rollback_after is not None and self.ckpt is not None:
+            raise _RollbackRequested(
+                f"SDC audit named corrupted parameter leaf {leaf!r} at "
+                f"step {global_step}")
+        flight.auto_dump(f"parameter corruption with rollback disarmed: "
+                         f"{leaf}")
+        raise ParameterCorruptionError(
+            f"SDC audit: parameter leaf {leaf!r} contains non-finite "
+            f"values at step {global_step} and rollback is disarmed "
+            "(pass AnomalyPolicy(rollback_after=...) + checkpoint_dir= "
+            "to heal automatically)")
+
+    # --- rollback -----------------------------------------------------------
+    @staticmethod
+    def _first_nonfinite_leaf(tree, path="model") -> Optional[str]:
+        """Host finiteness walk of a LOADED checkpoint's model tree —
+        a checkpoint captured after the damage is internally consistent
+        (its CRCs match its own poisoned payload), so CRC verification
+        alone cannot reject it as a rollback target."""
+        if isinstance(tree, dict):
+            for k in sorted(tree):
+                bad = AnomalyRuntime._first_nonfinite_leaf(
+                    tree[k], f"{path}/{k}")
+                if bad is not None:
+                    return bad
+            return None
+        if isinstance(tree, (list, tuple)):
+            for i, v in enumerate(tree):
+                bad = AnomalyRuntime._first_nonfinite_leaf(
+                    v, f"{path}/{i}")
+                if bad is not None:
+                    return bad
+            return None
+        try:
+            arr = np.asarray(tree)
+        except Exception:
+            return None
+        if arr.dtype != object and np.issubdtype(arr.dtype, np.inexact) \
+                and not np.all(np.isfinite(arr)):
+            return path
+        return None
+
+    def perform_rollback(self, model, reason: str) -> dict:
+        """Restore the newest TRUSTWORTHY checkpoint: per-leaf CRC
+        verified (``CheckpointStore`` manifest records) AND
+        finiteness-swept (a poisoned capture passes its own CRCs).
+        Skipped candidates count as ``train.anomaly.corrupt_checkpoints``.
+        Returns the loader resume position; exhausting the rollback
+        budget — or an empty/unrestorable store — escalates to
+        ``FatalError`` with a postmortem bundle."""
+        from .checkpoint import restore_train_state
+
+        self.rollbacks_used += 1
+        pol = self.policy
+        if self.rollbacks_used > pol.rollback_budget:
+            flight.on_transition("train.rollback", "budget_exhausted",
+                                 reason)
+            flight.auto_dump(
+                f"anomaly rollback budget exhausted: {reason}")
+            raise FatalError(
+                f"anomaly rollback budget ({pol.rollback_budget}) "
+                f"exhausted — numerical damage persists: {reason}")
+        store = self.ckpt.store
+        try:
+            self.ckpt.flush()
+        except Exception:  # noqa: BLE001 — a failed queued write only
+            pass           # shrinks the candidate set; older ones remain
+        for step in reversed(store.steps()):
+            try:
+                state, _manifest = store.load(step=step, verify=True)
+            except (CheckpointCorruptError,
+                    CheckpointIncompatibleError) as e:
+                stat_add("train.anomaly.corrupt_checkpoints", 1)
+                flight.on_transition("train.ckpt_corrupt",
+                                     f"step-{step}", str(e))
+                continue
+            bad = self._first_nonfinite_leaf(state.get("model"))
+            if bad is not None:
+                # internally consistent but poisoned: captured after
+                # the damage — roll back PAST it
+                stat_add("train.anomaly.corrupt_checkpoints", 1)
+                flight.on_transition("train.ckpt_poisoned",
+                                     f"step-{step}", bad)
+                continue
+            pos = restore_train_state(model, state)
+            model._prev_state = None
+            model._last_guard = None
+            self._damage.clear()
+            self.spikes.reset()
+            self._steps_since_audit = 0
+            stat_add("train.anomaly.rollbacks", 1)
+            flight.on_transition(
+                "train.rollback", f"step-{pos['global_step']}", reason)
+            return pos
+        flight.auto_dump(
+            f"numerical damage with no restorable checkpoint: {reason}")
+        raise FatalError(
+            f"numerical damage ({reason}) but no verified restorable "
+            f"checkpoint in {store.directory}")

@@ -295,20 +295,34 @@ class TrainCheckpointer:
                     self._cond.notify_all()
 
     # --- resume -------------------------------------------------------------
-    def load_latest_state(self):
+    def load_latest_state(self, verify: bool = True):
         """(state, manifest) of the newest VALID checkpoint, or None
-        (corrupt entries are skipped by the store — crash recovery)."""
-        return self.store.load_latest()
+        (corrupt entries are skipped by the store — crash recovery).
+        ``verify=True`` (the default, ISSUE 13) applies the deep
+        per-leaf CRC check: a checkpoint whose leaves drifted from
+        their manifest records — disk-level silent data corruption —
+        is skipped like a torn write instead of restored."""
+        return self.store.load_latest(verify=verify)
 
     def resume(self, model) -> Optional[Dict[str, Any]]:
         """Restore the newest valid checkpoint into ``model``.  Returns
         the loader position (see :func:`restore_train_state`) or None
         when the store holds nothing usable.  Accounts
         ``train.resumes`` and ``train.recomputed_steps`` (progress
-        marker minus checkpoint step — the steps the crash lost)."""
+        marker minus checkpoint step — the steps the crash lost).
+        Checkpoints skipped as corrupt along the way are no longer
+        silent: each one counts into
+        ``train.anomaly.corrupt_checkpoints`` and lands in the flight
+        recorder (ISSUE 13)."""
         from ..profiler.flight_recorder import recorder as _flight
 
-        loaded = self.load_latest_state()
+        loaded = self.load_latest_state(verify=True)
+        if self.store.last_skipped:
+            stat_add("train.anomaly.corrupt_checkpoints",
+                     len(self.store.last_skipped))
+            for path, reason in self.store.last_skipped:
+                _flight.on_transition("train.ckpt_corrupt", path,
+                                      reason)
         if loaded is None:
             return None
         state, _manifest = loaded

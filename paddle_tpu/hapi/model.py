@@ -171,6 +171,16 @@ class Model:
         self._state = None
         self._adapter = None       # StaticGraphAdapter when static mode
         self.stop_training = False
+        # numerical self-healing (ISSUE 13, docs/CHECKPOINT.md): with
+        # fit(anomaly=) active the train step is built GUARDED — it
+        # additionally returns isfinite(loss) & isfinite(global grad
+        # norm) (read on host with the loss, zero extra syncs) and
+        # keeps the pre-step state handle alive so a poisoned update
+        # can be discarded by a pointer swap
+        self._anomaly_guard = False
+        self._train_step_guarded = False
+        self._last_guard = None    # {"ok", "loss", "grad_norm"} | None
+        self._prev_state = None    # pre-step state (guard mode only)
 
     # --- prepare -----------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None,
@@ -208,7 +218,7 @@ class Model:
             if n in self._state["buffers"]:
                 b._value = self._state["buffers"][n]
 
-    def _build_train_step(self):
+    def _build_train_step(self, guarded: bool = False):
         network, loss_fn, optimizer = self.network, self._loss, self._optimizer
 
         def step_fn(state, key, x, y):
@@ -230,9 +240,25 @@ class Model:
                 state["params"], grads, state["opt"], count)
             new_state = {"params": new_params, "buffers": new_bufs,
                          "opt": new_opt, "step": count}
+            if guarded:
+                # device-side numeric guard folded into the step's own
+                # outputs (ISSUE 13): one f32 reduction over the grads
+                # XLA fuses into the update it is already computing —
+                # the host learns ok/grad_norm at the same sync point
+                # it reads the loss, zero extra transfers
+                gn = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree_util.tree_leaves(grads)))
+                ok = jnp.isfinite(loss) & jnp.isfinite(gn)
+                return new_state, loss, out, gn, ok
             return new_state, loss, out
 
-        return jax.jit(step_fn, donate_argnums=(0,))
+        # guard mode keeps the pre-step buffers alive (no donation) so
+        # SKIP-STEP can discard a poisoned update with a host pointer
+        # swap — the measured cost of that trade is the bench's
+        # detail.numerical_resilience guard-overhead number
+        return jax.jit(step_fn,
+                       donate_argnums=() if guarded else (0,))
 
     def _build_eval_fn(self):
         network = self.network
@@ -257,9 +283,28 @@ class Model:
 
         if self._accelerate:
             self._ensure_state()
-            if self._train_step is None:
-                self._train_step = self._build_train_step()
+            if self._train_step is None \
+                    or self._train_step_guarded != self._anomaly_guard:
+                self._train_step = self._build_train_step(
+                    self._anomaly_guard)
+                self._train_step_guarded = self._anomaly_guard
             key = default_generator.split_key()
+            if self._anomaly_guard:
+                prev = self._state
+                (self._state, loss, out,
+                 gn, ok) = self._train_step(self._state, key, xv, yv)
+                lossf = float(np.asarray(loss))
+                okb = bool(np.asarray(ok))
+                self._last_guard = {"ok": okb, "loss": lossf,
+                                    "grad_norm": float(np.asarray(gn))}
+                self._prev_state = prev
+                if not okb:
+                    # poisoned step: never feed NaN outputs into the
+                    # metrics; the anomaly runtime decides skip/rollback
+                    return [lossf]
+                metrics_out = self._update_metrics(out, yv)
+                return [lossf] + metrics_out
+            self._last_guard = None
             self._state, loss, out = self._train_step(self._state, key, xv, yv)
             metrics_out = self._update_metrics(out, yv)
             return [float(np.asarray(loss))] + metrics_out
@@ -270,6 +315,27 @@ class Model:
         outs = _to_list(outputs)
         loss = self._loss(*outs, Tensor(yv))
         loss.backward()
+        if self._anomaly_guard:
+            lossf = float(np.asarray(loss._value))
+            gn_sq = 0.0
+            for p in self.network.parameters():
+                g = getattr(p, "grad", None)
+                if g is None:
+                    continue
+                garr = np.asarray(g._value if hasattr(g, "_value") else g,
+                                  np.float64)
+                gn_sq += float(np.sum(np.square(garr)))
+            gnf = float(np.sqrt(gn_sq))
+            okb = bool(np.isfinite(lossf) and np.isfinite(gnf))
+            self._last_guard = {"ok": okb, "loss": lossf,
+                                "grad_norm": gnf}
+            if not okb:
+                # eager SKIP-STEP: the optimizer never runs, so the
+                # params are untouched by construction
+                self._optimizer.clear_grad()
+                return [lossf]
+        else:
+            self._last_guard = None
         if update:
             self._optimizer.step()
             self._optimizer.clear_grad()
@@ -333,7 +399,7 @@ class Model:
             accumulate_grad_batches=1, num_iters=None,
             checkpoint_dir=None, checkpoint_interval=None,
             checkpoint_async=True, keep_checkpoints=3, resume=False,
-            step_retries=0, step_retry_backoff_s=0.05):
+            step_retries=0, step_retry_backoff_s=0.05, anomaly=None):
         """Train loop.  Crash-consistency knobs (ISSUE 9 — contracts in
         docs/CHECKPOINT.md):
 
@@ -355,6 +421,20 @@ class Model:
           retried step consumes the same keys).  ``FatalError`` (e.g. a
           ``train.step`` chaos ``kill``) is never retried — it models
           process death.
+        - ``anomaly``: ``True`` or an
+          :class:`~paddle_tpu.hapi.anomaly.AnomalyPolicy` turns on
+          numerical self-healing (ISSUE 13 — docs/CHECKPOINT.md
+          "Numerical self-healing"): the jitted train step grows a
+          device-side ``isfinite(loss) & isfinite(global_grad_norm)``
+          guard, a non-finite step is SKIPPED (state, optimizer, LR and
+          PRNG streams untouched, batch discarded), a rolling
+          median/MAD loss-spike detector skips or tolerates divergence
+          bursts, repeated damage ROLLS BACK to the newest verified
+          checkpoint (requires ``checkpoint_dir`` when rollback is
+          armed), and a periodic SDC audit sweeps the live parameters
+          for corruption, naming the exact leaf.  A rollback budget
+          bounds the healing loop; exhausting it raises ``FatalError``
+          with a postmortem bundle.
         """
         from ..framework.errors import FatalError, InvalidArgumentError
         from ..framework.monitor import stat_add
@@ -397,6 +477,48 @@ class Model:
                 resume_pos = TrainCheckpointer(
                     resume, async_write=False).resume(self)
 
+        # --- numerical self-healing (ISSUE 13) ---------------------------
+        anomaly_rt = None
+        if anomaly:
+            from .anomaly import AnomalyPolicy, AnomalyRuntime
+
+            if anomaly is not True and not isinstance(anomaly,
+                                                      AnomalyPolicy):
+                # the watchdog=/brownout= discipline: a truthy config
+                # object must not silently become the defaults
+                raise InvalidArgumentError(
+                    f"anomaly must be True or an AnomalyPolicy, "
+                    f"got {anomaly!r}")
+            policy = (anomaly if isinstance(anomaly, AnomalyPolicy)
+                      else AnomalyPolicy())
+            if self._adapter is not None:
+                raise InvalidArgumentError(
+                    "anomaly= is not supported in static-graph mode — "
+                    "the guard rides the jitted dynamic train step")
+            if policy.rollback_after is not None and ckpt is None:
+                raise InvalidArgumentError(
+                    "AnomalyPolicy with rollback armed "
+                    "(rollback_after is not None) needs checkpoint_dir= "
+                    "— rollback restores from the TrainCheckpointer's "
+                    "store; pass AnomalyPolicy(rollback_after=None) for "
+                    "skip-only operation")
+            if not self._accelerate and policy.spike_window > 0 \
+                    and policy.spike_action == "skip":
+                # the eager optimizer update is already applied when
+                # the spike is detected — "skip" cannot be honored, and
+                # silently tolerating would violate the configured
+                # policy (non-finite eager steps still skip exactly:
+                # their update never runs)
+                raise InvalidArgumentError(
+                    "spike_action='skip' needs the accelerated (jitted)"
+                    " train path; with accelerate=False use "
+                    "spike_action='tolerate' or spike_window=0")
+            anomaly_rt = AnomalyRuntime(policy, checkpointer=ckpt)
+            self._anomaly_guard = True
+        else:
+            self._anomaly_guard = False
+        from .anomaly import _RollbackRequested
+
         steps = None
         try:
             steps = len(train_loader)
@@ -412,8 +534,10 @@ class Model:
         trained_any = False
         logs = {}
         try:
-            for epoch in range(epochs):
+            epoch = 0
+            while epoch < epochs:
                 if epoch < start_epoch:
+                    epoch += 1
                     continue            # fully covered by the checkpoint
                 skip_batches = 0
                 np_resume_mid = None
@@ -425,85 +549,148 @@ class Model:
                         resume_pos["np_state_epoch_start"])
                     skip_batches = resume_pos["next_batch"]
                     np_resume_mid = resume_pos["np_random"]
-                # one span per epoch; per-batch spans + a latency
-                # histogram nest inside it (fit > epoch > train_batch)
-                with RecordEvent("hapi/fit.epoch", epoch=epoch):
-                    cbks.on_epoch_begin(epoch)
-                    for m in self._metrics:
-                        m.reset()
-                    logs = {}
-                    # captured BEFORE the loader draws the permutation:
-                    # the snapshot leaf a mid-epoch resume replays from
-                    np_epoch_start = np.random.get_state()
-                    it = iter(train_loader)
-                    step = 0
-                    while True:
-                        if num_iters is not None and step >= num_iters:
-                            break
-                        if step >= skip_batches \
-                                and np_resume_mid is not None:
-                            # rejoin the checkpoint's exact numpy
-                            # stream BEFORE fetching the first
-                            # non-replayed batch: the capture happened
-                            # after training batch k-1 and before
-                            # fetching batch k, so a dataset whose
-                            # __getitem__ consumes np.random must see
-                            # the restored state at fetch time —
-                            # restoring after the fetch (the PR-9
-                            # ordering) fed batch k the replay stream,
-                            # which lacks the training-time RNG
-                            # consumption and diverges from the
-                            # uninterrupted run
-                            np.random.set_state(np_resume_mid)
-                            np_resume_mid = None
-                        # -- fetch (chaos-instrumented, bounded retry) --
-                        batch = self._fetch_with_retry(
-                            it, step_retries, step_retry_backoff_s,
-                            chaos_site, stat_add)
-                        if batch is None:
-                            break       # epoch exhausted
-                        if step < skip_batches:
-                            step += 1   # resume replay: already trained
-                            continue
-                        cbks.on_batch_begin("train", step, logs)
-                        x = batch[0]
-                        y = batch[1] if len(batch) > 1 else None
-                        t0 = _time.perf_counter()
-                        with RecordEvent("hapi/train_batch"):
-                            outs = self._step_with_retry(
-                                x, y, step_retries, step_retry_backoff_s,
-                                chaos_site, stat_add, KILL, FatalError)
-                        histogram_observe(
-                            "hapi.train_batch_ms",
-                            (_time.perf_counter() - t0) * 1e3)
-                        global_step += 1
-                        trained_any = True
-                        logs = {"loss": outs[0],
-                                "batch_size": _batch_size_of(x)}
-                        for name, val in zip(self._metric_names(),
-                                             outs[1:]):
-                            logs[name] = val
-                        cbks.on_batch_end("train", step, logs)
-                        if ckpt is not None:
-                            ckpt.note_step(global_step)
-                            ckpt.maybe_snapshot(
-                                self, global_step=global_step,
-                                epoch=epoch, next_batch=step + 1,
-                                np_state_epoch_start=np_epoch_start)
-                        step += 1
-                        if self.stop_training:
-                            break
-                    if eval_loader is not None \
-                            and (epoch + 1) % eval_freq == 0:
-                        eval_logs = self.evaluate(eval_loader, verbose=0,
-                                                  _inside_fit=True)
-                        logs.update({f"eval_{k}": v
-                                     for k, v in eval_logs.items()})
-                    cbks.on_epoch_end(epoch, logs)
+                try:
+                    # one span per epoch; per-batch spans + a latency
+                    # histogram nest inside it (fit > epoch > train_batch)
+                    with RecordEvent("hapi/fit.epoch", epoch=epoch):
+                        cbks.on_epoch_begin(epoch)
+                        for m in self._metrics:
+                            m.reset()
+                        logs = {}
+                        # captured BEFORE the loader draws the
+                        # permutation: the snapshot leaf a mid-epoch
+                        # resume replays from
+                        np_epoch_start = np.random.get_state()
+                        it = iter(train_loader)
+                        step = 0
+                        while True:
+                            if num_iters is not None \
+                                    and step >= num_iters:
+                                break
+                            if step >= skip_batches \
+                                    and np_resume_mid is not None:
+                                # rejoin the checkpoint's exact numpy
+                                # stream BEFORE fetching the first
+                                # non-replayed batch: the capture
+                                # happened after training batch k-1 and
+                                # before fetching batch k, so a dataset
+                                # whose __getitem__ consumes np.random
+                                # must see the restored state at fetch
+                                # time — restoring after the fetch (the
+                                # PR-9 ordering) fed batch k the replay
+                                # stream, which lacks the training-time
+                                # RNG consumption and diverges from the
+                                # uninterrupted run
+                                np.random.set_state(np_resume_mid)
+                                np_resume_mid = None
+                            # -- fetch (chaos-instrumented, retried) --
+                            batch = self._fetch_with_retry(
+                                it, step_retries, step_retry_backoff_s,
+                                chaos_site, stat_add)
+                            if batch is None:
+                                break       # epoch exhausted
+                            if step < skip_batches:
+                                step += 1   # resume replay: trained
+                                continue
+                            if anomaly_rt is not None \
+                                    and (epoch, step) in anomaly_rt.poisoned:
+                                # post-rollback replay: the batch whose
+                                # damage triggered the rollback is
+                                # discarded for good — training it
+                                # again would deterministically poison
+                                # the restored trajectory.  No RNG is
+                                # consumed (the skip that recorded it
+                                # rewound the streams), so the replay
+                                # continues bit-exact past it.
+                                step += 1
+                                continue
+                            cbks.on_batch_begin("train", step, logs)
+                            x = batch[0]
+                            y = batch[1] if len(batch) > 1 else None
+                            t0 = _time.perf_counter()
+                            with RecordEvent("hapi/train_batch"):
+                                outs = self._step_with_retry(
+                                    x, y, step_retries,
+                                    step_retry_backoff_s, chaos_site,
+                                    stat_add, KILL, FatalError,
+                                    runtime=anomaly_rt, epoch=epoch,
+                                    batch=step, global_step=global_step)
+                            histogram_observe(
+                                "hapi.train_batch_ms",
+                                (_time.perf_counter() - t0) * 1e3)
+                            if outs is None:
+                                # anomaly SKIP-STEP: batch discarded,
+                                # state/optimizer/PRNG untouched — the
+                                # step never happened.  The SDC audit
+                                # still ticks: persistent parameter
+                                # corruption makes EVERY step skip, and
+                                # exactly then the audit (not the skip
+                                # machinery) must name the leaf and
+                                # trigger the rollback.  Callbacks keep
+                                # their begin/end pairing (a consumer
+                                # pairing timers/counters must not see
+                                # an unmatched begin); logs are the
+                                # previous batch's — the skipped step
+                                # contributed nothing.
+                                cbks.on_batch_end("train", step, logs)
+                                anomaly_rt.maybe_audit(
+                                    self, global_step=global_step,
+                                    epoch=epoch, batch=step)
+                                step += 1
+                                continue
+                            global_step += 1
+                            trained_any = True
+                            logs = {"loss": outs[0],
+                                    "batch_size": _batch_size_of(x)}
+                            for name, val in zip(self._metric_names(),
+                                                 outs[1:]):
+                                logs[name] = val
+                            cbks.on_batch_end("train", step, logs)
+                            snapped = False
+                            if ckpt is not None:
+                                ckpt.note_step(global_step)
+                                snapped = ckpt.maybe_snapshot(
+                                    self, global_step=global_step,
+                                    epoch=epoch, next_batch=step + 1,
+                                    np_state_epoch_start=np_epoch_start)
+                            if anomaly_rt is not None:
+                                # SDC audit cadence: every N trained
+                                # steps, plus right after a committed
+                                # checkpoint
+                                anomaly_rt.maybe_audit(
+                                    self, global_step=global_step,
+                                    epoch=epoch, batch=step,
+                                    force=snapped)
+                            step += 1
+                            if self.stop_training:
+                                break
+                        if eval_loader is not None \
+                                and (epoch + 1) % eval_freq == 0:
+                            eval_logs = self.evaluate(
+                                eval_loader, verbose=0, _inside_fit=True)
+                            logs.update({f"eval_{k}": v
+                                         for k, v in eval_logs.items()})
+                        cbks.on_epoch_end(epoch, logs)
+                except _RollbackRequested as rb:
+                    # numerical damage crossed the policy threshold (or
+                    # the audit named a corrupt leaf): restore the
+                    # newest verified checkpoint and re-enter the loop
+                    # at its position — the resume machinery replays
+                    # the epoch permutation, skips the already-covered
+                    # batches and rejoins the checkpoint's RNG streams,
+                    # while the poisoned set fast-forwards past the
+                    # damaged batches
+                    resume_pos = anomaly_rt.perform_rollback(
+                        self, rb.reason)
+                    global_step = resume_pos["global_step"]
+                    start_epoch = resume_pos["epoch"]
+                    epoch = start_epoch
+                    continue
                 if save_dir and (epoch + 1) % save_freq == 0:
                     self.save(f"{save_dir}/{epoch}")
                 if self.stop_training:
                     break
+                epoch += 1
             if ckpt is not None and (trained_any or resume_pos is None):
                 # terminal checkpoint at position (epochs, 0): resuming
                 # with the same epoch budget is a no-op, a larger one
@@ -516,6 +703,15 @@ class Model:
                               epoch=epochs, next_batch=0,
                               np_state_epoch_start=np.random.get_state())
         finally:
+            # guard mode is a per-fit property: leaving it armed would
+            # make later standalone train_batch calls run guarded with
+            # no runtime to act on the verdict (a poisoned update kept,
+            # a 1-element return breaking the [loss, *metrics]
+            # contract), and _prev_state would pin a full extra
+            # params+optimizer copy for the model's lifetime
+            self._anomaly_guard = False
+            self._prev_state = None
+            self._last_guard = None
             if ckpt is not None:
                 import sys as _sys
 
@@ -567,7 +763,8 @@ class Model:
             return None
 
     def _step_with_retry(self, x, y, retries, backoff_s, chaos_site,
-                         stat_add, KILL, FatalError):
+                         stat_add, KILL, FatalError, runtime=None,
+                         epoch=0, batch=0, global_step=0):
         """One train step through the ``train.step`` chaos site.
         Transient failures retry with exponential backoff after
         restoring BOTH PRNG streams captured before the attempt — a
@@ -578,18 +775,33 @@ class Model:
         previous state).  Retries and fatals land in the flight
         recorder — a FatalError additionally triggers a postmortem
         bundle (when a bundle_dir is armed), so a training crash
-        leaves the same black box a replica death does."""
+        leaves the same black box a replica death does.
+
+        Numeric chaos (ISSUE 13): ``nan_loss``/``nan_grad`` poison the
+        batch before the step, ``corrupt_param`` flips a named param
+        leaf's element non-finite on device.  With ``runtime`` (an
+        AnomalyRuntime) the step's guard verdict is applied here:
+        SKIP-STEP returns None after rewinding BOTH PRNG streams to the
+        pre-attempt capture — the poisoned batch never happened."""
         from ..profiler.flight_recorder import recorder as _flight
+        from ..testing.chaos import CORRUPT_PARAM, NAN_GRAD, NAN_LOSS
 
         attempt = 0
         while True:
             key_state = default_generator.get_state()
             np_state = np.random.get_state()
+            xin = x
             try:
                 fault = chaos_site("train.step")
-                if fault is not None and fault.action == KILL:
-                    raise FatalError(fault.message)
-                return self.train_batch([x], [y])
+                if fault is not None:
+                    if fault.action == KILL:
+                        raise FatalError(fault.message)
+                    if fault.action in (NAN_LOSS, NAN_GRAD):
+                        xin = self._poison_batch(fault.action, x,
+                                                 NAN_LOSS)
+                    elif fault.action == CORRUPT_PARAM:
+                        self._corrupt_param(fault)
+                outs = self.train_batch([xin], [y])
             except (KeyboardInterrupt, SystemExit):
                 raise
             except FatalError as e:
@@ -607,6 +819,76 @@ class Model:
                 _flight.on_transition("train.retry", "train.step",
                                       f"{type(e).__name__}: {e}")
                 _time.sleep(backoff_s * (2 ** (attempt - 1)))
+                continue
+            # success path: apply the anomaly policy OUTSIDE the retry
+            # try-block — a rollback signal must propagate, never be
+            # swallowed into the transient-retry loop
+            if runtime is None or self._last_guard is None:
+                return outs
+            verdict = runtime.on_step_outcome(
+                self, outs, epoch=epoch, batch=batch,
+                global_step=global_step)
+            if verdict == "skip":
+                # the batch is discarded: rewind both PRNG streams so
+                # the next batch consumes exactly the keys it would
+                # have consumed had this batch never been drawn
+                default_generator.set_state(key_state)
+                np.random.set_state(np_state)
+                return None
+            return outs
+
+    def _poison_batch(self, action, x, NAN_LOSS):
+        """Chaos ``nan_loss``/``nan_grad``: return a poisoned copy of
+        the batch inputs — NaN drives the loss non-finite, an
+        overflow-scale magnitude blows up the gradient norm (both trip
+        the combined device guard; they differ in which side of
+        ``isfinite(loss) & isfinite(grad_norm)`` carries the damage)."""
+        from ..framework.errors import InvalidArgumentError
+
+        arr = np.array(x.numpy() if hasattr(x, "numpy") else x)
+        if not np.issubdtype(arr.dtype, np.floating):
+            raise InvalidArgumentError(
+                f"chaos {action} needs a floating-point input batch to "
+                f"poison, got dtype {arr.dtype}")
+        arr[...] = np.nan if action == NAN_LOSS \
+            else np.finfo(arr.dtype).max
+        return arr
+
+    def _corrupt_param(self, fault):
+        """Chaos ``corrupt_param``: flip one deterministically chosen
+        element of the named parameter leaf to a non-finite bit
+        pattern on device — the simulated SDC event the ISSUE 13 audit
+        exists to catch.  The flip persists until a rollback restores a
+        clean checkpoint (SKIP-STEP deliberately does not heal it: the
+        pre-step state it restores is already corrupted)."""
+        from ..framework.errors import InvalidArgumentError
+        from ..profiler.flight_recorder import recorder as _flight
+
+        leaf = fault.leaf
+        if self._state is not None:
+            params = self._state["params"]
+            if leaf not in params:
+                raise InvalidArgumentError(
+                    f"corrupt_param leaf {leaf!r} not in the model's "
+                    f"params (have e.g. {sorted(params)[:4]})")
+            arr = params[leaf]
+            idx = fault.element_index(int(np.prod(arr.shape)) or 1)
+            flat = arr.reshape(-1).at[idx].set(jnp.nan)
+            self._state = {**self._state,
+                           "params": {**params,
+                                      leaf: flat.reshape(arr.shape)}}
+        else:
+            target = dict(self.network.named_parameters()).get(leaf)
+            if target is None:
+                raise InvalidArgumentError(
+                    f"corrupt_param leaf {leaf!r} not found among the "
+                    "network's named parameters")
+            arr = target._value
+            idx = fault.element_index(int(np.prod(arr.shape)) or 1)
+            target._value = arr.reshape(-1).at[idx].set(
+                jnp.nan).reshape(arr.shape)
+        _flight.on_transition("chaos.corrupt_param", leaf,
+                              f"element {idx} set non-finite")
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_iters=None, _inside_fit=False):

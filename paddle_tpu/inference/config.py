@@ -66,7 +66,8 @@ class Config:
                        kv_cache_dtype=None, weight_dtype=None,
                        replicas=1, queue_cap=64, default_deadline_ms=None,
                        snapshot_interval=16, watchdog=None, brownout=None,
-                       prefix_cache=False, spec_decode=False):
+                       prefix_cache=False, spec_decode=False,
+                       numeric_guards=True):
         """Opt in to the continuous-batching serving engine
         (docs/SERVING.md).  Stores the paged-KV / scheduler knobs plus the
         pipelining knobs (``prefill_chunk`` tokens per prefill program,
@@ -113,6 +114,15 @@ class Config:
         stream, byte for byte.  Pass an int to set the K-token verify
         horizon (True = 4).
 
+        ``numeric_guards=True`` (the default — docs/SERVING.md "Logit
+        quarantine", ISSUE 13) folds a per-lane logit-finiteness flag
+        into the decode/verify programs' already-consumed outputs: a
+        lane whose logits come back non-finite fails exactly that
+        request with a typed ``NumericalFaultError`` (HTTP 500) within
+        one engine step, its lane is reset and its pages scrubbed +
+        freed, while every other stream continues byte-identically.
+        ``False`` removes the guard (the A/B arm the bench measures).
+
         Not reference API — the reference's serving story stops at
         AnalysisPredictor; this is the TPU-native extension."""
         self._serving = {
@@ -129,6 +139,7 @@ class Config:
             "prefix_cache": bool(prefix_cache),
             # bool or int K-horizon — validated by the engine
             "spec_decode": spec_decode,
+            "numeric_guards": bool(numeric_guards),
         }
         self._serving_frontend = {
             "replicas": int(replicas),

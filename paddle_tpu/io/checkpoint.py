@@ -272,10 +272,15 @@ class CheckpointStore:
         return manifest
 
     def load(self, step: Optional[int] = None, path: Optional[str] = None,
-             return_numpy: bool = False) -> Tuple[Any, dict]:
+             return_numpy: bool = False,
+             verify: bool = False) -> Tuple[Any, dict]:
         """Load + validate one specific checkpoint; raises on any
         integrity problem (use ``load_latest`` for fall-back
-        semantics)."""
+        semantics).  ``verify=True`` additionally re-checksums EVERY
+        leaf of the deserialized state against the manifest's per-leaf
+        CRC records (the deep SDC check — a whole-payload CRC pass with
+        a per-leaf mismatch means the payload was corrupted between
+        capture and commit); the raised error names the exact leaf."""
         if path is None:
             if step is None:
                 raise InvalidArgumentError("pass step= or path=")
@@ -286,19 +291,30 @@ class CheckpointStore:
         except Exception as e:
             raise CheckpointCorruptError(
                 f"{path}: payload CRC ok but unpickle failed ({e})")
+        if verify:
+            problems = self._verify_leaves(state, manifest)
+            if problems:
+                raise CheckpointCorruptError(
+                    f"{path}: per-leaf CRC verification failed — "
+                    + "; ".join(problems))
         return state, manifest
 
-    def load_latest(self, return_numpy: bool = False
-                    ) -> Optional[Tuple[Any, dict]]:
+    def load_latest(self, return_numpy: bool = False,
+                    verify: bool = False) -> Optional[Tuple[Any, dict]]:
         """Newest VALID checkpoint, or None when the store is empty or
         every entry is corrupt.  Torn/corrupt/incompatible entries are
         skipped (recorded in ``last_skipped``) — the crash-recovery
-        read path."""
+        read path.  ``verify=True`` applies the deep per-leaf CRC check
+        to every candidate (ISSUE 13: the resume/rollback paths refuse
+        to restore a checkpoint whose leaves drifted from their
+        manifest records — a leaf-level mismatch falls back to the next
+        older checkpoint exactly like a torn write)."""
         self.last_skipped = []
         for step in reversed(self.steps()):
             path = self.path_for(step)
             try:
-                return self.load(path=path, return_numpy=return_numpy)
+                return self.load(path=path, return_numpy=return_numpy,
+                                 verify=verify)
             except (CheckpointCorruptError,
                     CheckpointIncompatibleError) as e:
                 self.last_skipped.append((path, str(e)))
@@ -318,19 +334,11 @@ class CheckpointStore:
             self.last_skipped.append((path, str(e)))
             return None
 
-    def verify(self, step: Optional[int] = None,
-               path: Optional[str] = None) -> List[str]:
-        """Deep integrity check: payload CRC + every per-leaf CRC
-        against the manifest.  Returns a list of problems (empty =
-        clean); never raises for content problems."""
-        if path is None:
-            if step is None:
-                raise InvalidArgumentError("pass step= or path=")
-            path = self.path_for(step)
-        try:
-            state, manifest = self.load(path=path)
-        except (CheckpointCorruptError, CheckpointIncompatibleError) as e:
-            return [str(e)]
+    @staticmethod
+    def _verify_leaves(state, manifest: dict) -> List[str]:
+        """Per-leaf CRC comparison of a loaded state against its
+        manifest records; returns problem strings naming the exact
+        leaf (empty = clean)."""
         problems = []
         want = manifest.get("leaves", {})
         got = leaf_checksums(state)
@@ -345,6 +353,25 @@ class CheckpointStore:
         for leaf in set(got) - set(want):
             problems.append(f"leaf {leaf!r} not in manifest")
         return problems
+
+    def verify(self, step: Optional[int] = None,
+               path: Optional[str] = None) -> List[str]:
+        """Deep integrity check: payload CRC + every per-leaf CRC
+        against the manifest.  Returns a list of problems (empty =
+        clean); never raises for content problems.  Live callers
+        (ISSUE 13): the anomaly runtime verifies a checkpoint HERE
+        before trusting it as a rollback target, and
+        ``load_latest(verify=True)`` runs the same per-leaf records on
+        the resume path."""
+        if path is None:
+            if step is None:
+                raise InvalidArgumentError("pass step= or path=")
+            path = self.path_for(step)
+        try:
+            state, manifest = self.load(path=path)
+        except (CheckpointCorruptError, CheckpointIncompatibleError) as e:
+            return [str(e)]
+        return self._verify_leaves(state, manifest)
 
     def delete(self, step: int):
         try:

@@ -17,7 +17,12 @@ Components
                                 prefill, device-resident decode state,
                                 and a dispatch-ahead decode loop over
                                 the paged GPT step (``sync_mode=True``
-                                restores the synchronous behavior)
+                                restores the synchronous behavior).
+                                Numeric guards (``numeric_guards=``,
+                                default on): non-finite logits
+                                quarantine exactly the damaged request
+                                with a typed 500 within one step
+                                (docs/SERVING.md "Logit quarantine")
 - ``prefix_cache.PrefixCache``  radix index over resident KV pages:
                                 refcounted copy-on-write page sharing —
                                 shared-prefix prompts skip straight to

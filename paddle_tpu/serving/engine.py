@@ -92,7 +92,8 @@ _PROGRAM_LOCK = OrderedLock("serving.programs")
 def _shared_programs(model, *, page_size: int, pages_per_seq: int,
                      kv_cache_dtype, weight_dtype, kv_scales, weights,
                      fused_steps: int, spec_steps: int = 0,
-                     spec_sequential: bool = False) -> dict:
+                     spec_sequential: bool = False,
+                     numeric_guards: bool = True) -> dict:
     from ..jit.functional import get_state
     from ..text.generation import (make_gpt_paged_decode_step,
                                    make_gpt_paged_fused_decode_step,
@@ -101,7 +102,7 @@ def _shared_programs(model, *, page_size: int, pages_per_seq: int,
 
     params, _ = get_state(model)
     key = (page_size, pages_per_seq, kv_cache_dtype, weight_dtype,
-           fused_steps, spec_steps, spec_sequential,
+           fused_steps, spec_steps, spec_sequential, numeric_guards,
            None if kv_scales is None else id(kv_scales),
            None if weights is None else id(weights),
            tuple(sorted((k, id(v)) for k, v in params.items())))
@@ -144,6 +145,18 @@ def _shared_programs(model, *, page_size: int, pages_per_seq: int,
         # the program advances its own state: argmax feeds back as
         # the next input token, pos steps forward — nothing for the
         # host to rebuild or upload between steady-state steps
+        if numeric_guards:
+            # ISSUE 13 device-side guard: the per-lane logit-finiteness
+            # verdict is folded INTO the token array the host already
+            # consumes — a non-finite lane's token comes back
+            # NEGATIVE-PACKED (-1 - tok, never emitted anyway: it is
+            # an argmax over NaN).  Zero extra host transfers, zero
+            # extra outputs: guarded steady decode stays
+            # transfer-guard- and compile_budget(0)-clean.  The clean
+            # argmax still feeds back on device so the device state
+            # never sees a packed id.
+            fin = jnp.all(jnp.isfinite(logits), axis=-1)
+            return (nxt, jnp.where(fin, nxt, -1 - nxt)), pos + 1, kv
         return nxt, pos + 1, kv
 
     def _lane_set(tokens, pos, page_tables, lane, tok, p, row):
@@ -182,7 +195,8 @@ def _shared_programs(model, *, page_size: int, pages_per_seq: int,
     }
     if fused_steps > 1:
         fused_fn, _ = make_gpt_paged_fused_decode_step(
-            model, page_size, pages_per_seq, fused_steps, **qkw)
+            model, page_size, pages_per_seq, fused_steps,
+            with_guard=numeric_guards, **qkw)
         progs["fused"] = profiled_jit("serving.decode_fused", fused_fn,
                                       donate_argnums=(3,))
     if spec_steps > 1:
@@ -193,7 +207,8 @@ def _shared_programs(model, *, page_size: int, pages_per_seq: int,
         # loop's progressive quantization exactly).
         verify_fn, _ = make_gpt_paged_spec_verify_step(
             model, page_size, pages_per_seq, spec_steps,
-            sequential=spec_sequential, **qkw)
+            sequential=spec_sequential, with_guard=numeric_guards,
+            **qkw)
         progs["spec_verify"] = profiled_jit(
             "serving.spec_verify", verify_fn, donate_argnums=(3,))
     if kv_cache_dtype == "int8" and kv_scales is None:
@@ -271,7 +286,9 @@ def _shared_programs(model, *, page_size: int, pages_per_seq: int,
 class _Pending:
     """One in-flight decode dispatch: the device token handle plus the
     lane binding it was dispatched against (seq, epoch) — the epoch drops
-    results that a preemption has since invalidated."""
+    results that a preemption has since invalidated.  With numeric
+    guards on, ``tokens`` carries the guard verdict in-band: a
+    non-finite lane's token is negative-packed (``-1 - tok``)."""
 
     __slots__ = ("tokens", "steps", "lanes")
 
@@ -301,6 +318,7 @@ class ServingEngine:
                  prefix_cache: bool = False,
                  spec_decode=False,
                  spec_drafter=None,
+                 numeric_guards: bool = True,
                  token_callback: Optional[Callable[[str, int, int],
                                                    None]] = None):
         self.model = model
@@ -337,6 +355,25 @@ class ServingEngine:
         # request ids whose deadline expired (queued or mid-decode) —
         # drained by the frontend via take_expired()
         self._expired: List[str] = []
+        # --- numeric guards (ISSUE 13, docs/SERVING.md "Logit
+        # quarantine"): the decode/fused/spec programs additionally
+        # return per-lane logit-finiteness flags (computed on device,
+        # consumed with the tokens — zero extra syncs); a non-finite
+        # lane QUARANTINES its request: failed with a typed
+        # NumericalFaultError within one engine step, lane reset,
+        # pages scrubbed + freed (drained via take_faulted()).
+        if not isinstance(numeric_guards, bool):
+            # the watchdog=/brownout= validation discipline
+            raise InvalidArgumentError(
+                f"numeric_guards must be a bool, got {numeric_guards!r}")
+        self.numeric_guards = numeric_guards
+        # request ids failed by the numeric guard since the last
+        # take_faulted() — the frontend resolves them as failed/500
+        self._faulted: List[str] = []
+        # sequences flagged mid-consume, quarantined at the end of the
+        # step (after the pipeline is collapsed — pages are never freed
+        # with a dispatch still in flight)
+        self._quarantine_pending: List[Sequence] = []
 
         # --- int8 serving path (docs/SERVING.md "Quantized serving") ---
         # kv_cache_dtype="int8": pages store int8 + per-page-per-head
@@ -368,6 +405,11 @@ class ServingEngine:
         qs = quant_scales or {}
         kv_scales = (qs.get("kv_scales")
                      if self.kv_cache_dtype == "int8" else None)
+        # kept for the quarantine scrub (ISSUE 13): int8_static pool
+        # scale rows are calibrated constants, so healing a poisoned
+        # row means restoring THESE values (dynamic rows reset to the
+        # eps floor via the scale_reset program instead)
+        self._static_kv_scales = kv_scales
         # dynamic per-page scales need resetting when pages are
         # reallocated (results must not depend on page-reuse history)
         self._kv_dynamic = self.kv_cache_dtype == "int8" and \
@@ -414,7 +456,8 @@ class ServingEngine:
             weights=qs.get("weights") if self.weight_dtype == "int8"
             else None,
             fused_steps=self.fused_steps, spec_steps=spec_k,
-            spec_sequential=self._kv_dynamic)
+            spec_sequential=self._kv_dynamic,
+            numeric_guards=self.numeric_guards)
         self._kv = progs["init_pages"](num_pages)
         self._weight_quant = progs["weight_quant"]
         self._decode_jit = progs["decode"]
@@ -610,6 +653,128 @@ class ServingEngine:
         ``outputs``."""
         out, self._expired = self._expired, []
         return out
+
+    # --- numeric quarantine (docs/SERVING.md "Logit quarantine") ----------
+    def take_faulted(self) -> List[str]:
+        """Request ids quarantined by the numeric guard since the last
+        call (non-finite decode/verify logits → failed with
+        NumericalFaultError, lane reset, pages scrubbed + freed).  Each
+        id appears exactly once, and never in ``outputs``."""
+        out, self._faulted = self._faulted, []
+        return out
+
+    def _scrub_pages(self, page_ids):
+        """Zero the payload of pages being freed by a quarantine so the
+        NaN they carry can never reach a future owner: attention masks
+        unwritten positions, but a NaN at a masked position is one
+        where-vs-additive-mask kernel subtlety away from escaping —
+        the fault path pays one scatter instead of relying on it.
+        Scale rows: int8_static rows are restored to their CALIBRATED
+        values (a nan_logits poison writes NaN into the scale row, and
+        static mode has no other reset path — without this, one
+        injected fault would cascade NaN through every future owner of
+        the physical page); dynamic rows are reset to the eps floor by
+        ``_reset_page_scales``; native pools have none."""
+        if not page_ids:
+            return
+        R = next_pow2(len(page_ids))
+        rows_np = np.zeros((R,), np.int32)
+        rows_np[: len(page_ids)] = page_ids
+        payload = {
+            side: [jnp.zeros((R,) + tuple(p.shape[1:]),
+                             p.dtype) for p in self._kv[side]]
+            for side in ("k", "v")}
+        if self._static_kv_scales is not None:
+            for side in ("k", "v"):
+                payload[f"{side}_scale"] = [
+                    jnp.broadcast_to(
+                        jnp.asarray(np.asarray(s, np.float32))[None, :],
+                        (R, np.asarray(s).shape[0])) + 0
+                    for s in self._static_kv_scales[side]]
+        self._kv = self._page_put_jit(self._kv,
+                                      jax.device_put(rows_np), payload)
+
+    def _quarantine(self, seq: Sequence):
+        """Fail one guard-flagged request NOW (pipeline already
+        collapsed): no output, typed NumericalFaultError surfaced via
+        ``take_faulted()``, lane zeroed, pages scrubbed + freed — the
+        damage is contained to this one request within the step that
+        consumed it."""
+        if seq.done or seq not in self.scheduler.running:
+            return
+        rid = seq.seq_id
+        page_ids = self.cache.seq_page_ids(rid)
+        self.scheduler.finish(seq)        # frees pages, leaves running
+        seq.done = True
+        seq.epoch += 1                    # stale device results drop
+        # scrub ONLY pages that actually returned to the free list: a
+        # prefix-cache-shared page still has readers (or sits resident
+        # in the radix index) after our decref, and its content is the
+        # CLEAN prefill the sharers rely on — zeroing it would corrupt
+        # their streams.  The poisoned page is always in the freed set:
+        # decode-write pages are private by the COW contract.
+        freed = [p for p in page_ids if self.cache.is_free(p)]
+        self._scrub_pages(freed)
+        self._reset_page_scales(freed)
+        self._forget(rid)
+        for i, lane_seq in enumerate(self._lanes):
+            if lane_seq is seq:
+                self._lanes[i] = None
+                self._clear_lane(i)
+        self._faulted.append(rid)
+        self.metrics.on_quarantine()
+        flight.request_terminal(rid, "failed", replica=self.chaos_key,
+                                reason="numerical_fault",
+                                tokens=seq.num_generated)
+
+    def _process_quarantines(self):
+        """Collapse the pipeline, then quarantine every flagged lane
+        (collapsing may flag more — loop until drained).  Runs at the
+        end of the step that consumed the damage: 'failed within one
+        engine step' is the quarantine contract."""
+        while self._quarantine_pending:
+            self._sync_pending()
+            pending, self._quarantine_pending = \
+                self._quarantine_pending, []
+            for seq in pending:
+                self._quarantine(seq)
+
+    def _poison_lane(self, seq: Sequence):
+        """Chaos ``serving.logits`` ``nan_logits`` action: drive the
+        NEXT decode's logits for exactly this lane non-finite ON
+        DEVICE — native KV poisons the page content at the lane's last
+        written position, int8 KV poisons that page's scale row (int8
+        payloads cannot hold NaN; a NaN scale makes every dequant of
+        the page NaN).  Real device-side propagation, not a faked
+        flag: the guard reduction must catch it inside the jitted
+        program.
+
+        Injection-targeting note: once the lane has dispatched at
+        least once (fault ``at >= 2``), pos-1 is a decode-write
+        position — always PRIVATE by the prefix-cache COW contract, so
+        the damage is surgically one request's.  An ``at=1`` injection
+        on a fresh prefix-hit lane would target the last PROMPT
+        position, which can sit in a shared page and (faithfully to
+        real SDC in shared memory) damage every reader — schedule
+        chaos plans accordingly."""
+        table = self.cache.seq_page_ids(seq.seq_id)
+        if not table:
+            return
+        pos = max(seq.pos - 1, 0)
+        page = table[min(pos // self.page_size, len(table) - 1)]
+        rows = jax.device_put(np.asarray([page], np.int32))
+        payload = {key: [np.array(a) for a in arrs]    # writable copies
+                   for key, arrs in jax.device_get(
+                       self._page_gather_jit(self._kv, rows)).items()}
+        if "k_scale" in payload:
+            for arr in payload["k_scale"]:
+                arr[...] = np.nan
+        else:
+            for arr in payload["k"]:
+                arr[...] = np.nan
+        dev = {key: [jax.device_put(a) for a in arrs]
+               for key, arrs in payload.items()}
+        self._kv = self._page_put_jit(self._kv, rows, dev)
 
     # --- checkpoint / warm failover (docs/SERVING.md "Resilience") --------
     def kv_mode(self) -> str:
@@ -987,7 +1152,13 @@ class ServingEngine:
             if k == 1:
                 out, self._pos, self._kv = self._decode_jit(
                     self._tokens, self._pos, self._tables, self._kv)
-                self._tokens = out
+                if self.numeric_guards:
+                    # (clean argmax for device feedback, guard-packed
+                    # copy for host consumption) — one transfer either way
+                    clean, out = out
+                    self._tokens = clean
+                else:
+                    self._tokens = out
             else:
                 out, self._tokens, self._pos, self._kv = self._fused_jit(
                     self._tokens, self._pos, self._tables, self._kv)
@@ -1014,12 +1185,25 @@ class ServingEngine:
                 if binding is None:
                     continue
                 seq, epoch = binding
-                # retired (one-step EOS lag) or preempted-since (epoch
-                # bump): the device token is junk — drop it
-                if seq.done or seq.epoch != epoch:
+                # retired (one-step EOS lag), preempted-since (epoch
+                # bump) or already guard-flagged: the device token is
+                # junk — drop it
+                if seq.done or seq.epoch != epoch or seq.numeric_fault:
+                    continue
+                tok = int(krow[lane])
+                if tok < 0:
+                    # guard verdict, in-band: argmax is always >= 0, so
+                    # a negative token is the device-side guard's
+                    # non-finite-logits flag (-1 - tok).  NEVER
+                    # emitted; the request is quarantined (failed,
+                    # pages scrubbed + freed) once the step's pipeline
+                    # collapses.
+                    self.metrics.on_nan_lane()
+                    seq.numeric_fault = True
+                    self._quarantine_pending.append(seq)
                     continue
                 emitted += 1
-                self._emit_token(seq, lane, int(krow[lane]), now)
+                self._emit_token(seq, lane, tok, now)
         return emitted
 
     def _emit_token(self, seq: Sequence, lane: int, tok: int,
@@ -1232,6 +1416,17 @@ class ServingEngine:
             took = 0
             done = False
             for i in range(e):
+                if col[i] < 0:
+                    # the verifier inherits the decode guard: a
+                    # negative-packed verify token means non-finite
+                    # logits at that position — the lane is
+                    # quarantined, nothing at or past it is emitted.
+                    # (A packed token also never equals a draft token,
+                    # so accept_len cannot extend past the damage.)
+                    self.metrics.on_nan_lane()
+                    seq.numeric_fault = True
+                    self._quarantine_pending.append(seq)
+                    break
                 seq.pos += 1
                 took += 1
                 emitted += 1
@@ -1243,7 +1438,8 @@ class ServingEngine:
                 flight.request_event(seq.seq_id, EV_SPECULATED,
                                      replica=self.chaos_key,
                                      drafted=dn, accepted=a - 1)
-            if self._kv_dynamic and not done and lane in saved \
+            if self._kv_dynamic and not done and not seq.numeric_fault \
+                    and lane in saved \
                     and min(pos0 + K, self.cache.allocated_tokens(
                         seq.seq_id)) > pos0 + took:
                 self._spec_rollback(seq, saved[lane], draft_mat[:, lane],
@@ -1363,6 +1559,17 @@ class ServingEngine:
             active = [(i, s) for i, s in enumerate(self._lanes)
                       if s is not None]
             if any(self._remaining(s) > 0 for _, s in active):
+                # chaos site ``serving.logits`` (ISSUE 13): one visit
+                # per active lane, keyed by its request id — a
+                # ``nan_logits`` fault poisons that lane's KV on device
+                # so the NEXT dispatch's logits are non-finite for
+                # exactly that lane (a single global read per lane when
+                # no plan is installed)
+                for _lane, s in active:
+                    fault = chaos_site("serving.logits", key=s.seq_id)
+                    if fault is not None \
+                            and fault.action == "nan_logits":
+                        self._poison_lane(s)
                 spec_res = (self._spec_step(active)
                             if self.spec is not None else None)
                 if spec_res is not None:
@@ -1380,6 +1587,11 @@ class ServingEngine:
         target_depth = 0 if (self.sync_mode or not bucket) else 1
         while len(self._pending) > target_depth:
             emitted += self._consume_one()
+        # guard verdicts land here: a lane flagged by this step's
+        # consume is failed within this same step (pipeline collapsed
+        # first so pages are never freed under an in-flight dispatch)
+        if self._quarantine_pending:
+            self._process_quarantines()
         self._maybe_shrink()
 
         step_seconds = time.perf_counter() - t_step
@@ -1468,6 +1680,7 @@ class ServingEngine:
                 "prefill_chunk": self.prefill_chunk,
                 "in_flight": len(self._pending),
                 "state_bucket": self._state_bucket,
+                "numeric_guards": self.numeric_guards,
             },
             "prefix_cache": (
                 self.prefix_cache.stats()

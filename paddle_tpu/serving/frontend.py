@@ -61,7 +61,7 @@ from ..framework.concurrency import OrderedCondition, OrderedRLock
 from ..framework.errors import (AlreadyExistsError,
                                 DeadlineExceededError, EnforceNotMet,
                                 ExecutionTimeoutError, InternalError,
-                                InvalidArgumentError,
+                                InvalidArgumentError, NumericalFaultError,
                                 ResourceExhaustedError, UnavailableError)
 from ..profiler.flight_recorder import (EV_PLACED, EV_QUEUED,
                                         EV_RESTARTED, EV_RESUMED_ON,
@@ -1244,6 +1244,21 @@ class ServingFrontend:
             entry = self._entry_for(rep, rid)
             if entry is not None:
                 self._resolve(entry, DEADLINE_MISS, "deadline expired")
+        for rid in eng.take_faulted():
+            # numeric quarantine (ISSUE 13): exactly the damaged
+            # request fails, typed 500 — and the watchdog hears about
+            # it: repeated guard faults on one replica are damaged
+            # hardware/state, not damaged requests, and escalate
+            # suspect → dead so victims move to healthy survivors
+            entry = self._entry_for(rep, rid)
+            if entry is not None:
+                self._resolve(
+                    entry, FAILED,
+                    "numeric guard quarantined the request "
+                    "(non-finite logits)",
+                    error_cls=NumericalFaultError)
+            if self.watchdog is not None:
+                self.watchdog.note_numeric_fault(rep.id)
         for rid in list(eng.outputs.keys()):
             toks = eng.take_output(rid)
             entry = self._entry_for(rep, rid)

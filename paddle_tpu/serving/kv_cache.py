@@ -175,6 +175,15 @@ class PagedKVCache:
     def ref_count(self, page_id: int) -> int:
         return self._ref.get(int(page_id), 0)
 
+    def is_free(self, page_id: int) -> bool:
+        """True when the page is genuinely on the free list —
+        unreferenced by any sequence AND not held resident by a prefix
+        index.  The quarantine scrub (ISSUE 13) keys on this: a page a
+        quarantined sequence SHARED must never be zeroed out from under
+        its other readers."""
+        p = int(page_id)
+        return self._ref.get(p, 0) == 0 and p not in self._cached
+
     def num_seqs(self) -> int:
         return len(self._tables)
 

@@ -79,7 +79,12 @@ class ServingMetrics:
                 # (emitted for ~1/K of the bandwidth) vs rejected, and
                 # the lanes rolled back mid-draft
                 "serving.spec.drafted", "serving.spec.accepted",
-                "serving.spec.rejected", "serving.spec.rollbacks")
+                "serving.spec.rejected", "serving.spec.rollbacks",
+                # numeric guards (ISSUE 13): lanes whose decode/verify
+                # logits came back non-finite, and the requests
+                # quarantined (failed with NumericalFaultError, lane
+                # reset, pages scrubbed + freed) as a result
+                "serving.guard.nan_lanes", "serving.guard.quarantines")
     HISTOGRAMS = ("serving.step_latency_ms", "serving.prefill_latency_ms",
                   "serving.decode_latency_ms", "serving.ttft_ms",
                   "serving.dispatch_gap_ms",
@@ -210,6 +215,18 @@ class ServingMetrics:
             stat_registry.get("serving.spec.accept_rate").set(
                 total_a / total_d)
 
+    # --- numeric guards (ISSUE 13, docs/SERVING.md "Logit quarantine") -----
+    def on_nan_lane(self, n: int = 1):
+        """A decode/verify dispatch returned non-finite logits for a
+        lane (the device-side guard flag) — each flagged (lane, step)
+        counts once."""
+        stat_registry.get("serving.guard.nan_lanes").add(n)
+
+    def on_quarantine(self, n: int = 1):
+        """A request was quarantined: failed with NumericalFaultError,
+        its lane reset and its pages scrubbed + freed."""
+        stat_registry.get("serving.guard.quarantines").add(n)
+
     def on_prefill(self, seconds: float):
         stat_registry.histogram("serving.prefill_latency_ms").observe(
             seconds * 1e3)
@@ -312,6 +329,9 @@ class ServingMetrics:
             short: stat_registry.get(f"serving.spec.{short}").get()
             for short in ("drafted", "accepted", "rejected", "rollbacks",
                           "accept_rate")}
+        snap["guard"] = {
+            short: stat_registry.get(f"serving.guard.{short}").get()
+            for short in ("nan_lanes", "quarantines")}
         for name in self.HISTOGRAMS:
             h = stat_registry.histogram(name).snapshot()
             key = name[len("serving."):]

@@ -222,6 +222,15 @@ class WatchdogConfig:
     includes XLA compilation (tens of seconds on a real chip), which
     would otherwise false-SUSPECT — or past ``hang_timeout_s`` falsely
     kill — every replica in a freshly started fleet.
+
+    Numeric-fault channel (ISSUE 13): the frontend reports every
+    guard-quarantined request via ``note_numeric_fault``.  One NaN lane
+    is a damaged REQUEST; a replica producing them repeatedly is
+    damaged HARDWARE/state (bad HBM, a corrupted weight buffer) —
+    ``numeric_fault_suspect`` faults within ``numeric_fault_window_s``
+    pull the replica from the routing pool, ``numeric_fault_dead``
+    declare it dead so warm failover moves its victims to healthy
+    survivors.
     """
 
     min_threshold_s: float = 0.25
@@ -232,16 +241,22 @@ class WatchdogConfig:
     backoff_initial_s: float = 0.25
     backoff_max_s: float = 30.0
     check_interval_s: float = 0.02
+    numeric_fault_suspect: int = 2
+    numeric_fault_dead: int = 4
+    numeric_fault_window_s: float = 60.0
 
 
 class _ReplicaWatch:
-    __slots__ = ("latencies", "trips", "suspect_since", "backoff_until")
+    __slots__ = ("latencies", "trips", "suspect_since", "backoff_until",
+                 "numeric_faults")
 
     def __init__(self):
         self.latencies: List[float] = []
         self.trips = 0
         self.suspect_since: Optional[float] = None
         self.backoff_until: Optional[float] = None
+        # monotonic timestamps of guard-quarantined requests (ISSUE 13)
+        self.numeric_faults: List[float] = []
 
 
 class Watchdog:
@@ -294,6 +309,26 @@ class Watchdog:
                 now = time.monotonic() if now is None else now
                 w.backoff_until = now + self._backoff_s_locked(w)
 
+    def note_numeric_fault(self, replica_id: str,
+                           now: Optional[float] = None):
+        """Record one guard-quarantined request on ``replica_id``
+        (ISSUE 13).  The next ``check`` escalates when the rolling
+        window crosses the configured suspect/dead thresholds."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._w(replica_id).numeric_faults.append(now)
+
+    def numeric_faults(self, replica_id: str,
+                       now: Optional[float] = None) -> int:
+        """Guard faults within the rolling window (trims old ones)."""
+        now = time.monotonic() if now is None else now
+        wnd = self.config.numeric_fault_window_s
+        with self._lock:
+            w = self._w(replica_id)
+            w.numeric_faults = [t for t in w.numeric_faults
+                                if now - t < wnd]
+            return len(w.numeric_faults)
+
     def threshold_s(self, replica_id: str) -> float:
         """Current overdue threshold for the replica."""
         with self._lock:
@@ -321,6 +356,22 @@ class Watchdog:
         idle)."""
         now = time.monotonic() if now is None else now
         w = self._w(replica_id)
+        # numeric-fault escalation (ISSUE 13): evaluated first — a
+        # replica streaming NaN is damaged whether or not its steps are
+        # fast.  DEAD hands its victims to warm failover on healthy
+        # survivors; SUSPECT pulls it from routing like an overdue step
+        # (same trip/backoff machinery, so re-admission waits out the
+        # exponential backoff AND the fault window draining).
+        nfaults = self.numeric_faults(replica_id, now)
+        if nfaults >= self.config.numeric_fault_dead:
+            w.suspect_since = w.suspect_since or now
+            return WD_DEAD
+        if nfaults >= self.config.numeric_fault_suspect \
+                and w.suspect_since is None:
+            w.suspect_since = now
+            w.trips += 1
+            w.backoff_until = None
+            return WD_SUSPECT
         if busy_for is not None:
             if not w.latencies:
                 # cold replica: the first step includes jit compilation,
@@ -345,20 +396,27 @@ class Watchdog:
             # (armed by a completed step — recovery evidence) elapsed is
             # re-admitted even if it is never sampled idle (a busy
             # replica serving back-to-back steps has only sub-ms idle
-            # windows between steps)
+            # windows between steps).  Re-admission ALSO requires the
+            # numeric-fault window to have drained below the suspect
+            # threshold — a replica re-entering routing with its fault
+            # count still over the line would be re-suspected one check
+            # later, flapping victims in and out of a damaged replica.
             if (w.suspect_since is not None
                     and w.backoff_until is not None
-                    and now >= w.backoff_until):
+                    and now >= w.backoff_until
+                    and nfaults < self.config.numeric_fault_suspect):
                 w.suspect_since = None
                 w.backoff_until = None
                 return WD_READMIT
             return WD_OK
         # not mid-step: a suspect replica has recovered — re-admit only
-        # after its backoff (armed at recovery time) elapses
+        # after its backoff (armed at recovery time) elapses AND the
+        # numeric-fault window has drained (see above)
         if w.suspect_since is not None:
             if w.backoff_until is None:
                 w.backoff_until = now + self.backoff_s(replica_id)
-            if now >= w.backoff_until:
+            if now >= w.backoff_until \
+                    and nfaults < self.config.numeric_fault_suspect:
                 w.suspect_since = None
                 w.backoff_until = None
                 return WD_READMIT

@@ -125,6 +125,11 @@ class Sequence:
         # (the recompute replays them deterministically)
         self.epoch = 0
         self.done = False
+        # numeric guard verdict (ISSUE 13): set when a decode/verify
+        # dispatch returned non-finite logits for this lane — every
+        # later token of the damaged stream is dropped and the engine
+        # quarantines the request at the end of the step
+        self.numeric_fault = False
 
     @property
     def seq_id(self) -> str:

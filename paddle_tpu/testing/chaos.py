@@ -38,6 +38,19 @@ Instrumented sites (grep ``chaos_site(`` for the live list)
                       once per spec-capable engine step.
                       Key: the engine's chaos/replica key.
 
+``serving.logits``    ServingEngine step, evaluated once per ACTIVE
+                      LANE before the decode dispatch (key: that
+                      lane's request id) — ``nan_logits`` poisons the
+                      lane's most recently written KV page (native
+                      KV: page payload; int8 KV: the page's scale
+                      row) with NaN ON DEVICE, so the next decode's
+                      logits for exactly that lane are non-finite.
+                      With numeric guards on, the engine quarantines
+                      the request (typed NumericalFaultError) within
+                      one step; with guards off it reproduces the
+                      motivating failure — an argmax over NaN logits
+                      streaming token 0 forever (ISSUE 13).
+
 Training-side sites (ISSUE 9 — docs/CHECKPOINT.md "Chaos sites"):
 
 ``train.step``        hapi fit step driver, before each train step —
@@ -45,7 +58,17 @@ Training-side sites (ISSUE 9 — docs/CHECKPOINT.md "Chaos sites"):
                       bounded-backoff retry driver's territory),
                       ``delay`` a straggler step, ``kill`` a simulated
                       process death (FatalError, never retried — the
-                      exact-resume acceptance trigger).  Key: none.
+                      exact-resume acceptance trigger).  ISSUE 13
+                      numeric actions: ``nan_loss`` poisons the
+                      batch's inputs with NaN (forward → NaN loss),
+                      ``nan_grad`` poisons with overflow-scale values
+                      (the global-grad-norm guard trips), and
+                      ``corrupt_param`` flips the exponent field of
+                      ONE deterministically chosen element of the
+                      param leaf named by ``Fault(leaf=...)`` to a
+                      non-finite bit pattern on device — the
+                      simulated silent-data-corruption event the SDC
+                      audit exists to catch.  Key: none.
 ``loader.next``       hapi fit batch fetch, before each ``next()`` —
                       ``raise``/``delay`` model a flaky/slow data
                       pipeline; the chaos check precedes the fetch, so
@@ -84,14 +107,24 @@ from ..framework.concurrency import OrderedLock
 
 __all__ = ["Fault", "ChaosPlan", "install", "uninstall", "active_plan",
            "running", "chaos_site", "DENY", "RAISE", "DELAY", "KILL",
-           "HTTP_ERROR"]
+           "HTTP_ERROR", "NAN_LOSS", "NAN_GRAD", "CORRUPT_PARAM",
+           "NAN_LOGITS"]
 
 DENY = "deny"
 RAISE = "raise"
 DELAY = "delay"
 KILL = "kill"
 HTTP_ERROR = "http_error"
-_ACTIONS = frozenset({DENY, RAISE, DELAY, KILL, HTTP_ERROR})
+# numeric-fault actions (ISSUE 13) — site-specific, returned to the
+# caller like deny/kill: the train step driver poisons the batch
+# (nan_loss/nan_grad) or a named param leaf (corrupt_param), the
+# serving engine poisons a lane's KV page (nan_logits)
+NAN_LOSS = "nan_loss"
+NAN_GRAD = "nan_grad"
+CORRUPT_PARAM = "corrupt_param"
+NAN_LOGITS = "nan_logits"
+_ACTIONS = frozenset({DENY, RAISE, DELAY, KILL, HTTP_ERROR,
+                      NAN_LOSS, NAN_GRAD, CORRUPT_PARAM, NAN_LOGITS})
 
 
 class Fault:
@@ -106,17 +139,21 @@ class Fault:
     ``at=2`` and ``at=4`` on one site fire on global visits 2 and 5."""
 
     __slots__ = ("site", "at", "action", "match", "count", "delay_s",
-                 "status", "message", "seen", "remaining")
+                 "status", "message", "leaf", "seen", "remaining")
 
     def __init__(self, site: str, at: int, action: str,
                  match: Optional[str] = None, count: int = 1,
                  delay_s: float = 0.0, status: int = 500,
-                 message: str = ""):
+                 message: str = "", leaf: str = ""):
         if action not in _ACTIONS:
             raise ValueError(f"unknown chaos action {action!r}; one of "
                              f"{sorted(_ACTIONS)}")
         if at < 1:
             raise ValueError("at is a 1-based evaluation index (>= 1)")
+        if action == CORRUPT_PARAM and not leaf:
+            raise ValueError(
+                "corrupt_param needs leaf= (the param leaf name whose "
+                "element gets the seeded bit-flip)")
         self.site = str(site)
         self.at = int(at)
         self.action = action
@@ -125,15 +162,29 @@ class Fault:
         self.delay_s = float(delay_s)
         self.status = int(status)
         self.message = message or f"chaos[{site}@{at}:{action}]"
+        self.leaf = str(leaf)
         self.seen = 0              # matching evaluations so far
         self.remaining = self.count
 
     def describe(self) -> dict:
         """Canonical schedule entry — two plans with equal describe()
         lists carry the same fault schedule (the determinism pin)."""
-        return {"site": self.site, "at": self.at, "action": self.action,
-                "match": self.match, "count": self.count,
-                "delay_s": round(self.delay_s, 6), "status": self.status}
+        d = {"site": self.site, "at": self.at, "action": self.action,
+             "match": self.match, "count": self.count,
+             "delay_s": round(self.delay_s, 6), "status": self.status}
+        if self.leaf:
+            # only corrupt_param carries a leaf — keep the canonical
+            # form of every other fault unchanged (pinned)
+            d["leaf"] = self.leaf
+        return d
+
+    def element_index(self, size: int) -> int:
+        """Deterministic flat element index for corrupt_param: derived
+        from (leaf, at) via CRC32 — no RNG, no wall clock, so a seeded
+        schedule flips the SAME element on every drive."""
+        import zlib
+
+        return zlib.crc32(f"{self.leaf}:{self.at}".encode()) % max(size, 1)
 
     def exception(self):
         from ..framework.errors import InternalError

@@ -459,7 +459,8 @@ def make_gpt_paged_prefill_step(model, page_size: int, pages_per_seq: int, *,
 def make_gpt_paged_fused_decode_step(model, page_size: int,
                                      pages_per_seq: int, num_steps: int, *,
                                      kv_cache_dtype=None, kv_scales=None,
-                                     weight_quant=None):
+                                     weight_quant=None,
+                                     with_guard: bool = False):
     """Fused K-step greedy decode: one device program advances every lane
     ``num_steps`` positions through a ``lax.fori_loop`` (KV pools carried
     in-place through the loop), returning all K tokens in one [K, B]
@@ -474,6 +475,14 @@ def make_gpt_paged_fused_decode_step(model, page_size: int,
     single steps.  EOS cannot retire a lane mid-loop; the engine drops
     post-EOS tokens on host (the one-step-lag rule, just K steps wide)
     and must pre-reserve pages covering ``pos + K`` for every live lane.
+
+    ``with_guard=True`` (ISSUE 13 numeric guards) folds a per-lane
+    logit-finiteness verdict INTO the returned token matrix: a
+    position whose logits were non-finite comes back NEGATIVE-PACKED
+    (``-1 - tok``) — in-band, so the guard costs no extra outputs or
+    host transfers and guarded steady decode stays
+    transfer-guard-clean.  The clean argmax still feeds back inside
+    the loop (device state never sees a packed id).
     """
     if num_steps < 1:
         raise ValueError("num_steps must be >= 1")
@@ -489,7 +498,11 @@ def make_gpt_paged_fused_decode_step(model, page_size: int,
             tok, p, kv, out = carry
             logits, kv = core(tok, p, page_tables, kv)
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return nxt, p + 1, kv, out.at[j].set(nxt)
+            row = nxt
+            if with_guard:
+                fin = jnp.all(jnp.isfinite(logits), axis=-1)
+                row = jnp.where(fin, nxt, -1 - nxt)
+            return nxt, p + 1, kv, out.at[j].set(row)
 
         tok, p, kv, out = jax.lax.fori_loop(
             0, num_steps, body, (tokens, pos, kv, out0))
@@ -502,7 +515,8 @@ def make_gpt_paged_spec_verify_step(model, page_size: int,
                                     pages_per_seq: int, num_steps: int, *,
                                     sequential: bool = False,
                                     kv_cache_dtype=None, kv_scales=None,
-                                    weight_quant=None):
+                                    weight_quant=None,
+                                    with_guard: bool = False):
     """Speculative-decoding verifier: teacher-force ``num_steps`` tokens
     per lane through the paged core in ONE device program and return the
     greedy argmax at every position — the drafted continuation is
@@ -531,6 +545,12 @@ def make_gpt_paged_spec_verify_step(model, page_size: int,
     where per-page scale growth couples positions within a page — the
     sequential schedule reproduces the plain decode loop's progressive
     quantization bit for bit (docs/SERVING.md "Speculative decoding").
+
+    ``with_guard=True`` (ISSUE 13) folds the per-lane logit-finiteness
+    verdict INTO the returned ``out`` matrix — a non-finite position's
+    token comes back negative-packed (``-1 - tok``), in-band like the
+    decode step's, so the verifier inherits the guard at zero extra
+    outputs.
     """
     if num_steps < 2:
         raise ValueError("num_steps must be >= 2 (1 is plain decode)")
@@ -538,6 +558,12 @@ def make_gpt_paged_spec_verify_step(model, page_size: int,
         model, page_size, pages_per_seq, kv_cache_dtype=kv_cache_dtype,
         kv_scales=kv_scales, weight_quant=weight_quant)
     K = int(num_steps)
+
+    def _pack(nxt, logits):
+        if not with_guard:
+            return nxt
+        fin = jnp.all(jnp.isfinite(logits), axis=-1)
+        return jnp.where(fin, nxt, -1 - nxt)
 
     if sequential:
         def verify_fn(tokens, pos, page_tables, kv):
@@ -548,7 +574,7 @@ def make_gpt_paged_spec_verify_step(model, page_size: int,
                 kv, out = carry
                 logits, kv = core(tokens[j], pos + j, page_tables, kv)
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                return kv, out.at[j].set(nxt)
+                return kv, out.at[j].set(_pack(nxt, logits))
 
             kv, out = jax.lax.fori_loop(0, K, body, (kv, out0))
             return out, kv
@@ -567,7 +593,7 @@ def make_gpt_paged_spec_verify_step(model, page_size: int,
             tables = jnp.repeat(page_tables, K, axis=0)       # [B*K, M]
             logits, kv = core(toks, posf, tables, kv)
             out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return out.reshape(B, K).T, kv
+            return _pack(out, logits).reshape(B, K).T, kv
 
     return verify_fn, init_pages
 

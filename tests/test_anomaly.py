@@ -1,0 +1,595 @@
+"""Numerical self-healing for training (ISSUE 13).
+
+Acceptance anchors (docs/CHECKPOINT.md "Numerical self-healing"):
+
+- a seeded ``nan_loss``/``nan_grad`` injection at batch K SKIPS that
+  step — final params BYTE-IDENTICAL to a reference run trained on the
+  same stream minus batch K, and deterministic across a double drive;
+- a seeded ``corrupt_param`` flip is named (exact leaf) by the SDC
+  audit, rolled back to the newest verified checkpoint, and the
+  post-rollback trajectory matches the clean reference bit for bit;
+- rollback is bounded (budget exhaustion / no restorable checkpoint
+  escalate to FatalError) and checkpoint verification gets live
+  callers (``load_latest(verify=True)``, corrupt-checkpoint counters).
+"""
+import json
+import zlib
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.framework.errors import (FatalError, InvalidArgumentError,
+                                         ParameterCorruptionError)
+from paddle_tpu.framework.monitor import stat_get
+from paddle_tpu.hapi.anomaly import (AnomalyPolicy, LossSpikeDetector,
+                                     ParameterAudit)
+from paddle_tpu.io.checkpoint import CheckpointStore
+from paddle_tpu.io.dataset import TensorDataset
+from paddle_tpu.profiler.flight_recorder import recorder
+from paddle_tpu.testing import chaos
+
+BATCH, FEAT, HID = 4, 8, 16
+N_BATCHES = 10
+
+
+def make_model(seed=1234):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(FEAT, HID), nn.ReLU(),
+                        nn.Linear(HID, 1))
+    m = paddle.Model(net)
+    m.prepare(optimizer.Adam(learning_rate=1e-3,
+                             parameters=net.parameters()),
+              nn.MSELoss())
+    return m
+
+
+def make_data(n_batches=N_BATCHES, y_scale=None):
+    rng = np.random.RandomState(0)
+    x = rng.randn(BATCH * n_batches, FEAT).astype(np.float32)
+    w = rng.randn(FEAT, 1).astype(np.float32)
+    y = (x @ w).astype(np.float32)
+    if y_scale is not None:
+        for idx, s in y_scale.items():
+            y[idx * BATCH:(idx + 1) * BATCH] *= s
+    return x, y
+
+
+def fit_kwargs(**over):
+    kw = dict(batch_size=BATCH, epochs=1, shuffle=False, verbose=0)
+    kw.update(over)
+    return kw
+
+
+def params_bytes(m):
+    return {k: np.asarray(v).tobytes()
+            for k, v in m._state["params"].items()}
+
+
+def skip_only(**over):
+    kw = dict(rollback_after=None, spike_window=0)
+    kw.update(over)
+    return AnomalyPolicy(**kw)
+
+
+class TestPolicyValidation:
+    def test_bad_spike_action(self):
+        with pytest.raises(InvalidArgumentError, match="spike_action"):
+            AnomalyPolicy(spike_action="explode")
+
+    @pytest.mark.parametrize("kw", [
+        dict(spike_window=-1), dict(spike_k=0.0),
+        dict(rollback_after=0), dict(rollback_window=0),
+        dict(rollback_budget=-1), dict(audit_interval=0),
+        # warmup the capped window can never satisfy = spike detection
+        # silently off while configured on (review fix)
+        dict(spike_window=4, spike_warmup=8)])
+    def test_bad_numbers(self, kw):
+        with pytest.raises(InvalidArgumentError):
+            AnomalyPolicy(**kw)
+
+    def test_fit_rejects_garbage_anomaly(self):
+        m = make_model()
+        x, y = make_data(2)
+        with pytest.raises(InvalidArgumentError, match="AnomalyPolicy"):
+            m.fit(TensorDataset([x, y]),
+                  **fit_kwargs(anomaly={"skip": True}))
+
+    def test_rollback_armed_needs_checkpoint_dir(self):
+        m = make_model()
+        x, y = make_data(2)
+        with pytest.raises(InvalidArgumentError,
+                           match="checkpoint_dir"):
+            m.fit(TensorDataset([x, y]), **fit_kwargs(anomaly=True))
+
+    def test_guard_mode_disarmed_after_fit(self):
+        """Review fix: guard mode is per-fit — after fit(anomaly=)
+        returns, a standalone train_batch runs UNGUARDED (normal
+        [loss, *metrics] contract, no silently-kept poisoned update)
+        and the pre-step state copy is released."""
+        m = make_model()
+        x, y = make_data(2)
+        m.fit(TensorDataset([x, y]), **fit_kwargs(anomaly=skip_only()))
+        assert m._anomaly_guard is False
+        assert m._prev_state is None and m._last_guard is None
+        outs = m.train_batch([x[:BATCH]], [y[:BATCH]])
+        assert m._last_guard is None       # unguarded path ran
+        assert len(outs) == 1              # [loss] (no metrics attached)
+
+    def test_eager_spike_skip_rejected(self):
+        """Review fix: the eager update is already applied when a
+        spike is detected, so spike_action='skip' cannot be honored on
+        the accelerate=False path — refuse loudly instead of silently
+        tolerating (non-finite eager steps still skip exactly)."""
+        m = make_model()
+        m._accelerate = False
+        x, y = make_data(2)
+        with pytest.raises(InvalidArgumentError, match="accelerated"):
+            m.fit(TensorDataset([x, y]), **fit_kwargs(
+                anomaly=AnomalyPolicy(rollback_after=None,
+                                      spike_action="skip")))
+
+    def test_corrupt_param_fault_needs_leaf(self):
+        with pytest.raises(ValueError, match="leaf"):
+            chaos.Fault("train.step", at=1, action=chaos.CORRUPT_PARAM)
+
+    def test_element_index_deterministic(self):
+        f = chaos.Fault("train.step", at=3,
+                        action=chaos.CORRUPT_PARAM, leaf="0.weight")
+        assert f.element_index(100) == f.element_index(100)
+        assert 0 <= f.element_index(100) < 100
+
+
+class TestSpikeDetector:
+    def test_warmup_grace_then_spike(self):
+        d = LossSpikeDetector(window=16, k=5.0, warmup=4)
+        for v in (1.0, 1.1, 0.9, 1.05):
+            assert not d.observe(v)        # warmup: never a spike
+        assert d.threshold() is not None
+        assert not d.observe(1.2)
+        assert d.observe(100.0)            # way past median + k*MAD
+
+    def test_spike_not_admitted_into_window(self):
+        d = LossSpikeDetector(window=16, k=5.0, warmup=4)
+        for v in (1.0, 1.1, 0.9, 1.05):
+            d.observe(v)
+        thr0 = d.threshold()
+        assert d.observe(1e6)
+        # the spiked sample must not inflate its own baseline
+        assert d.threshold() == thr0
+        assert d.observe(1e6)              # still a spike
+
+    def test_flat_plateau_mad_floor(self):
+        d = LossSpikeDetector(window=16, k=10.0, warmup=4)
+        for _ in range(8):
+            assert not d.observe(2.0)      # MAD == 0: floored, no spike
+        assert not d.observe(2.0000001)
+
+    def test_nonfinite_is_not_a_spike(self):
+        d = LossSpikeDetector(window=16, k=5.0, warmup=1)
+        d.observe(1.0)
+        assert not d.observe(float("nan"))  # the guard's business
+
+
+class TestGuardedStep:
+    def test_guard_outputs_on_clean_step(self):
+        m = make_model()
+        x, y = make_data(1)
+        m._anomaly_guard = True
+        outs = m.train_batch([x], [y])
+        g = m._last_guard
+        assert g is not None and g["ok"]
+        assert np.isfinite(g["grad_norm"]) and g["grad_norm"] > 0
+        assert outs[0] == pytest.approx(g["loss"])
+
+    def test_guard_trips_on_nan_batch(self):
+        m = make_model()
+        x, y = make_data(1)
+        m._anomaly_guard = True
+        before = params_bytes(m) if m._state else None
+        m.train_batch([x], [y])            # builds state + guarded step
+        before = params_bytes(m)
+        outs = m.train_batch([np.full_like(x, np.nan)], [y])
+        assert not m._last_guard["ok"]
+        assert len(outs) == 1              # no poisoned metric update
+        # SKIP-STEP discard is a pointer swap back to the pre-step state
+        m._state = m._prev_state
+        assert params_bytes(m) == before
+
+    def test_eager_guard_skips_update(self):
+        m = make_model()
+        m._accelerate = False
+        m._anomaly_guard = True
+        x, y = make_data(1)
+        m.train_batch([x], [y])
+        w0 = {k: np.asarray(v._value).copy()
+              for k, v in m.network.named_parameters()}
+        m.train_batch([np.full_like(x, np.nan)], [y])
+        assert not m._last_guard["ok"]
+        for k, v in m.network.named_parameters():
+            assert np.array_equal(np.asarray(v._value), w0[k])
+
+
+class TestSkipStep:
+    @pytest.mark.parametrize("action", [chaos.NAN_LOSS, chaos.NAN_GRAD])
+    def test_skip_byte_identical_to_reference_minus_batch(self, action):
+        """Acceptance (a): injection at batch K ⇒ final params
+        byte-identical to the SAME stream trained without batch K —
+        state, optimizer slots and both PRNG streams rewound exactly."""
+        K = 3
+        x, y = make_data()
+        sk0 = stat_get("train.anomaly.skipped_steps")
+        m1 = make_model()
+        plan = chaos.ChaosPlan([chaos.Fault("train.step", at=K + 1,
+                                            action=action)])
+        with chaos.running(plan):
+            m1.fit(TensorDataset([x, y]),
+                   **fit_kwargs(anomaly=skip_only()))
+        assert stat_get("train.anomaly.skipped_steps") - sk0 == 1
+        assert [f["site"] for f in plan.fired_log()] == ["train.step"]
+
+        mask = np.ones(len(x), bool)
+        mask[K * BATCH:(K + 1) * BATCH] = False
+        m2 = make_model()
+        m2.fit(TensorDataset([x[mask], y[mask]]),
+               **fit_kwargs(anomaly=skip_only()))
+        assert params_bytes(m1) == params_bytes(m2)
+
+    def test_skip_keeps_callback_pairing(self):
+        """Review fix: a skipped step still delivers a matching
+        on_batch_end for its on_batch_begin — consumers pairing
+        per-batch timers/counters must never see an unmatched begin."""
+        from paddle_tpu.hapi.callbacks import Callback
+
+        class Pairing(Callback):
+            begins = 0
+            ends = 0
+
+            def on_train_batch_begin(self, step, logs=None):
+                Pairing.begins += 1
+
+            def on_train_batch_end(self, step, logs=None):
+                Pairing.ends += 1
+
+        x, y = make_data()
+        m = make_model()
+        plan = chaos.ChaosPlan([chaos.Fault("train.step", at=3,
+                                            action=chaos.NAN_LOSS)])
+        with chaos.running(plan):
+            m.fit(TensorDataset([x, y]), callbacks=[Pairing()],
+                  **fit_kwargs(anomaly=skip_only()))
+        assert Pairing.begins == N_BATCHES
+        assert Pairing.ends == Pairing.begins
+
+    def test_double_drive_deterministic(self):
+        K = 4
+
+        def drive():
+            m = make_model()
+            x, y = make_data()
+            plan = chaos.ChaosPlan([chaos.Fault(
+                "train.step", at=K + 1, action=chaos.NAN_LOSS)])
+            with chaos.running(plan):
+                m.fit(TensorDataset([x, y]),
+                      **fit_kwargs(anomaly=skip_only()))
+            return params_bytes(m), plan.fired_log()
+
+        p1, log1 = drive()
+        p2, log2 = drive()
+        assert p1 == p2
+        assert log1 == log2
+
+    def test_spike_skip_and_tolerate(self):
+        """A finite divergence burst (one batch's labels scaled 1e3)
+        trips the median/MAD detector; skip discards the update
+        (params match the reference-minus-that-batch), tolerate keeps
+        it (params differ) — both count the spike."""
+        K = 6
+        x, y = make_data(y_scale={K: 1e3})
+        pol = dict(rollback_after=None, spike_window=8, spike_k=6.0,
+                   spike_warmup=3)
+        s0 = stat_get("train.anomaly.loss_spikes")
+        m_skip = make_model()
+        m_skip.fit(TensorDataset([x, y]), **fit_kwargs(
+            anomaly=AnomalyPolicy(spike_action="skip", **pol)))
+        assert stat_get("train.anomaly.loss_spikes") - s0 == 1
+
+        mask = np.ones(len(x), bool)
+        mask[K * BATCH:(K + 1) * BATCH] = False
+        m_ref = make_model()
+        m_ref.fit(TensorDataset([x[mask], y[mask]]),
+                  **fit_kwargs(anomaly=skip_only()))
+        assert params_bytes(m_skip) == params_bytes(m_ref)
+
+        m_tol = make_model()
+        m_tol.fit(TensorDataset([x, y]), **fit_kwargs(
+            anomaly=AnomalyPolicy(spike_action="tolerate", **pol)))
+        assert stat_get("train.anomaly.loss_spikes") - s0 == 2
+        assert params_bytes(m_tol) != params_bytes(m_ref)
+
+
+class TestAudit:
+    def test_audit_names_exact_leaf(self):
+        m = make_model()
+        x, y = make_data(1)
+        m.train_batch([x], [y])            # materialize functional state
+        audit = ParameterAudit()
+        assert audit.corrupted_leaf(m) is None
+        leaf = sorted(m._state["params"])[1]
+        arr = m._state["params"][leaf]
+        m._state["params"][leaf] = arr.reshape(-1).at[0].set(
+            np.nan).reshape(arr.shape)
+        assert audit.corrupted_leaf(m) == leaf
+
+    def test_skip_only_corruption_is_typed_fatal(self):
+        """With rollback disarmed there is nothing to heal from — the
+        audit raises the typed error naming the leaf."""
+        m = make_model()
+        x, y = make_data(6)
+        plan = chaos.ChaosPlan([chaos.Fault(
+            "train.step", at=2, action=chaos.CORRUPT_PARAM,
+            leaf="0.weight")])
+        with chaos.running(plan):
+            with pytest.raises(ParameterCorruptionError,
+                               match="0.weight"):
+                m.fit(TensorDataset([x, y]), **fit_kwargs(
+                    anomaly=skip_only(audit_interval=1)))
+
+
+class TestRollback:
+    def test_corrupt_param_audit_rollback_matches_clean(self, tmp_path):
+        """Acceptance (b): seeded corrupt_param ⇒ the audit names the
+        exact leaf, rollback restores the newest verified checkpoint,
+        and the replayed trajectory matches the clean run bit for bit
+        — deterministic across a double drive."""
+        x, y = make_data(12)
+        pol = AnomalyPolicy(rollback_after=10, rollback_window=32,
+                            rollback_budget=2, audit_interval=2,
+                            spike_window=0)
+        leaf = "2.weight"
+
+        def drive(d):
+            m = make_model()
+            plan = chaos.ChaosPlan([chaos.Fault(
+                "train.step", at=6, action=chaos.CORRUPT_PARAM,
+                leaf=leaf)])
+            recorder.reset()
+            with chaos.running(plan):
+                m.fit(TensorDataset([x, y]), **fit_kwargs(
+                    checkpoint_dir=str(d), checkpoint_interval=2,
+                    checkpoint_async=False, anomaly=pol))
+            trans = recorder.build_bundle("test")["transitions"]
+            return params_bytes(m), trans
+
+        rb0 = stat_get("train.anomaly.rollbacks")
+        p1, trans1 = drive(tmp_path / "a")
+        assert stat_get("train.anomaly.rollbacks") - rb0 == 1
+        # the audit named the exact corrupted leaf in the black box
+        corr = [t for t in trans1 if t["kind"] == "train.corruption"]
+        assert corr and corr[0]["target"] == leaf
+        assert any(t["kind"] == "train.rollback" for t in trans1)
+
+        m_ref = make_model()
+        m_ref.fit(TensorDataset([x, y]), **fit_kwargs(
+            checkpoint_dir=str(tmp_path / "ref"),
+            checkpoint_interval=2, checkpoint_async=False, anomaly=pol))
+        assert p1 == params_bytes(m_ref)
+
+        p2, _ = drive(tmp_path / "b")
+        assert p1 == p2                    # double drive
+
+    def test_damage_threshold_rollback_fast_forwards_poisoned(
+            self, tmp_path):
+        """Repeated NaN damage fills the window ⇒ rollback; the replay
+        fast-forwards past the poisoned batches instead of re-tripping
+        on them — final params match a reference without those
+        batches."""
+        K = 5
+        x, y = make_data(12)
+        pol = AnomalyPolicy(rollback_after=2, rollback_window=8,
+                            rollback_budget=2, spike_window=0)
+        rb0 = stat_get("train.anomaly.rollbacks")
+        m1 = make_model()
+        plan = chaos.ChaosPlan([chaos.Fault(
+            "train.step", at=K + 1, action=chaos.NAN_LOSS, count=3)])
+        with chaos.running(plan):
+            m1.fit(TensorDataset([x, y]), **fit_kwargs(
+                checkpoint_dir=str(tmp_path / "a"),
+                checkpoint_interval=2, checkpoint_async=False,
+                anomaly=pol))
+        assert stat_get("train.anomaly.rollbacks") - rb0 == 1
+
+        # batches K and K+1 were poisoned (the damage window) and
+        # fast-forwarded past on replay; the checkpoint (interval 2)
+        # restored to next_batch K-1, whose replay ate the fault's
+        # THIRD firing and was guard-skipped — so exactly batches
+        # {K-1, K, K+1} contribute nothing to the final params
+        mask = np.ones(len(x), bool)
+        mask[(K - 1) * BATCH:(K + 2) * BATCH] = False
+        m2 = make_model()
+        m2.fit(TensorDataset([x[mask], y[mask]]),
+               **fit_kwargs(anomaly=skip_only()))
+        assert params_bytes(m1) == params_bytes(m2)
+
+    def test_rollback_budget_exhaustion_is_fatal(self, tmp_path):
+        x, y = make_data(12)
+        pol = AnomalyPolicy(rollback_after=10, rollback_window=32,
+                            rollback_budget=0, audit_interval=1,
+                            spike_window=0)
+        m = make_model()
+        plan = chaos.ChaosPlan([chaos.Fault(
+            "train.step", at=4, action=chaos.CORRUPT_PARAM,
+            leaf="0.weight")])
+        with chaos.running(plan):
+            with pytest.raises(FatalError, match="budget"):
+                m.fit(TensorDataset([x, y]), **fit_kwargs(
+                    checkpoint_dir=str(tmp_path), checkpoint_interval=2,
+                    checkpoint_async=False, anomaly=pol))
+
+    def test_no_restorable_checkpoint_is_fatal(self, tmp_path):
+        """Damage before the first commit: the store is empty, healing
+        is impossible — FatalError, not a silent loop."""
+        x, y = make_data(8)
+        pol = AnomalyPolicy(rollback_after=10, rollback_window=32,
+                            rollback_budget=2, audit_interval=1,
+                            spike_window=0)
+        m = make_model()
+        plan = chaos.ChaosPlan([chaos.Fault(
+            "train.step", at=1, action=chaos.CORRUPT_PARAM,
+            leaf="0.weight")])
+        with chaos.running(plan):
+            with pytest.raises(FatalError, match="no verified"):
+                m.fit(TensorDataset([x, y]), **fit_kwargs(
+                    checkpoint_dir=str(tmp_path),
+                    checkpoint_interval=100,   # never due before damage
+                    checkpoint_async=False, anomaly=pol))
+
+    def test_rollback_skips_poisoned_checkpoint(self, tmp_path):
+        """A checkpoint captured AFTER the corruption is internally
+        consistent (its CRCs cover its own poisoned payload) — CRC
+        verification alone cannot reject it; the rollback's finiteness
+        sweep must, falling back to the older clean commit.  (The fit
+        loop never produces one naturally — skip-step suppresses
+        checkpointing of skipped batches — so this drives the runtime
+        directly with a hand-committed poisoned capture, the shape a
+        guard-less earlier build or foreign tool would leave.)"""
+        from paddle_tpu.hapi.anomaly import AnomalyRuntime
+        from paddle_tpu.hapi.checkpoint import (TrainCheckpointer,
+                                                capture_train_state)
+
+        m = make_model()
+        x, y = make_data(1)
+        m.train_batch([x], [y])            # materialize state
+        ckpt = TrainCheckpointer(str(tmp_path), async_write=False)
+        ckpt.store.save(capture_train_state(
+            m, global_step=1, epoch=0, next_batch=1), 1)
+        clean = params_bytes(m)
+        leaf = sorted(m._state["params"])[0]
+        arr = m._state["params"][leaf]
+        m._state["params"][leaf] = arr.reshape(-1).at[0].set(
+            np.nan).reshape(arr.shape)
+        ckpt.store.save(capture_train_state(
+            m, global_step=2, epoch=0, next_batch=2), 2)
+
+        rt = AnomalyRuntime(AnomalyPolicy(rollback_after=2,
+                                          rollback_budget=2,
+                                          spike_window=0),
+                            checkpointer=ckpt)
+        cc0 = stat_get("train.anomaly.corrupt_checkpoints")
+        pos = rt.perform_rollback(m, "poisoned-newest")
+        assert pos["global_step"] == 1     # fell back past step 2
+        assert stat_get("train.anomaly.corrupt_checkpoints") - cc0 == 1
+        assert ParameterAudit().corrupted_leaf(m) is None
+        assert params_bytes(m) == clean
+
+
+class TestStoreVerifySatellite:
+    def _tamper_leaf_crc(self, store, step, leaf):
+        """Rewrite a checkpoint so the payload CRC still matches but
+        one leaf's manifest CRC record does not — the disk-SDC shape
+        only the DEEP verify can catch."""
+        path = store.path_for(step)
+        blob = open(path, "rb").read()
+        magic = b"PTCKPT1\n"
+        mlen = int.from_bytes(blob[len(magic):len(magic) + 4], "big")
+        mstart = len(magic) + 4
+        manifest = json.loads(blob[mstart:mstart + mlen].decode())
+        payload = blob[mstart + mlen:]
+        manifest["leaves"][leaf]["crc32"] ^= 0xDEADBEEF
+        mb = json.dumps(manifest, sort_keys=True).encode()
+        open(path, "wb").write(
+            magic + len(mb).to_bytes(4, "big") + mb + payload)
+
+    def test_load_verify_names_exact_leaf(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        state = {"a": np.arange(4, dtype=np.float32),
+                 "b": np.ones((2, 2), np.float32)}
+        store.save(state, 1)
+        store.load(step=1, verify=True)    # clean round-trip
+        self._tamper_leaf_crc(store, 1, "b")
+        # shallow load still passes (payload CRC matches the payload)
+        store.load(step=1)
+        from paddle_tpu.framework.errors import CheckpointCorruptError
+        with pytest.raises(CheckpointCorruptError, match="'b'"):
+            store.load(step=1, verify=True)
+
+    def test_load_latest_verify_falls_back(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        store.save({"a": np.zeros(3, np.float32)}, 1)
+        store.save({"a": np.ones(3, np.float32)}, 2)
+        self._tamper_leaf_crc(store, 2, "a")
+        assert store.load_latest(verify=True)[1]["step"] == 1
+        assert len(store.last_skipped) == 1
+        # without the deep check the tampered newest wins — the gap
+        # load_latest(verify=True) exists to close
+        assert store.load_latest()[1]["step"] == 2
+
+    def test_resume_counts_corrupt_checkpoints(self, tmp_path):
+        """Model.fit(resume=) no longer walks past corrupt checkpoints
+        silently: each skip lands in
+        ``train.anomaly.corrupt_checkpoints``."""
+        x, y = make_data(8)
+        m = make_model()
+        m.fit(TensorDataset([x, y]), **fit_kwargs(
+            checkpoint_dir=str(tmp_path), checkpoint_interval=2,
+            checkpoint_async=False, keep_checkpoints=8))
+        store = CheckpointStore(str(tmp_path))
+        steps = store.steps()
+        assert len(steps) >= 2
+        # torn-write-shape the newest
+        path = store.path_for(steps[-1])
+        open(path, "wb").write(open(path, "rb").read()[:40])
+        cc0 = stat_get("train.anomaly.corrupt_checkpoints")
+        m2 = make_model()
+        m2.fit(TensorDataset([x, y]), **fit_kwargs(
+            checkpoint_dir=str(tmp_path), checkpoint_interval=2,
+            checkpoint_async=False, resume=True))
+        assert stat_get("train.anomaly.corrupt_checkpoints") - cc0 >= 1
+
+
+@pytest.mark.slow
+class TestSweeps:
+    def test_nan_at_every_step_skip_only(self):
+        """Guard soak: NaN at EVERY step with a skip-only policy — the
+        run completes with every batch discarded and params exactly at
+        their initial values."""
+        x, y = make_data()
+        m = make_model()
+        w0 = None
+        plan = chaos.ChaosPlan([chaos.Fault(
+            "train.step", at=1, action=chaos.NAN_LOSS,
+            count=N_BATCHES)])
+        with chaos.running(plan):
+            m.fit(TensorDataset([x, y]),
+                  **fit_kwargs(anomaly=skip_only()))
+        m_ref = make_model()
+        m_ref.train_batch([x[:BATCH]], [y[:BATCH]])  # materialize state
+        m_ref._state = None
+        m_ref2 = make_model()
+        # untouched reference: materialize the functional state without
+        # training (prepare + a guard-mode probe would update; instead
+        # compare against a fresh model's initial layer tensors)
+        init = {k: np.asarray(v._value).tobytes()
+                for k, v in m_ref2.network.named_parameters()}
+        got = {k: np.asarray(v).tobytes()
+               for k, v in m._state["params"].items()}
+        assert got == init
+
+    def test_nan_at_every_step_rollback_budget_fatal(self, tmp_path):
+        """Rollback soak: persistent NaN damage exhausts the rollback
+        budget and escalates to FatalError instead of looping."""
+        x, y = make_data(20)
+        pol = AnomalyPolicy(rollback_after=2, rollback_window=8,
+                            rollback_budget=2, spike_window=0)
+        m = make_model()
+        # a few clean steps first so checkpoints exist — damage before
+        # the first commit escalates as "no restorable checkpoint"
+        # (covered in TestRollback) instead of exhausting the budget
+        plan = chaos.ChaosPlan([chaos.Fault(
+            "train.step", at=5, action=chaos.NAN_LOSS, count=200)])
+        with chaos.running(plan):
+            with pytest.raises(FatalError, match="budget"):
+                m.fit(TensorDataset([x, y]), **fit_kwargs(
+                    checkpoint_dir=str(tmp_path), checkpoint_interval=2,
+                    checkpoint_async=False, anomaly=pol))

@@ -161,6 +161,35 @@ class TestFailOnRegression:
             "detail.spec_decode.tokens_per_sec_speedup_x")
         assert not bench_diff.lower_is_better(
             "detail.spec_decode.on.tokens_per_sec")
+        # numerical self-healing section (ISSUE 13): skipped steps,
+        # spikes, rollbacks, quarantines and NaN lanes are damage
+        # counters — they regress UPWARD; guard overhead % and
+        # recovery latencies likewise; the prefix-cache
+        # prefill_tokens_skipped keeps gating DOWNWARD (the
+        # "tokens_skipped" fragment outranks the generic "skipped")
+        assert bench_diff.lower_is_better(
+            "detail.numerical_resilience.train.skipped_steps")
+        assert bench_diff.lower_is_better(
+            "train.anomaly.skipped_steps")
+        assert bench_diff.lower_is_better("train.anomaly.loss_spikes")
+        assert bench_diff.lower_is_better("train.anomaly.rollbacks")
+        assert bench_diff.lower_is_better(
+            "train.anomaly.corrupt_checkpoints")
+        assert bench_diff.lower_is_better("train.anomaly.audit_ms.p95")
+        assert bench_diff.lower_is_better("serving.guard.quarantines")
+        assert bench_diff.lower_is_better("serving.guard.nan_lanes")
+        assert bench_diff.lower_is_better(
+            "detail.numerical_resilience.train.guard_overhead_pct")
+        assert bench_diff.lower_is_better(
+            "detail.numerical_resilience.serving.guard_overhead_pct")
+        assert bench_diff.lower_is_better(
+            "detail.numerical_resilience.train.skip_recovery_ms")
+        assert bench_diff.lower_is_better(
+            "detail.numerical_resilience.train.rollback_recovery_ms")
+        # the prefix-cache win still gates downward after the fragment
+        # split (regression guard for the "skipped" reclassification)
+        assert not bench_diff.lower_is_better(
+            "detail.prefix_cache.rates.rate05.prefill_tokens_skipped")
 
     def test_reduction_ratio_gates_on_drop_not_rise(self):
         """The PR-4 acceptance metric: kv_bytes_reduction_x falling

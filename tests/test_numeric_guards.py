@@ -1,0 +1,366 @@
+"""Serving numeric guards + logit quarantine (ISSUE 13).
+
+Acceptance anchors (docs/SERVING.md "Logit quarantine"):
+
+- an injected ``nan_logits`` fault on 1 of 8 streams fails EXACTLY that
+  request with a typed ``NumericalFaultError`` (HTTP 500) within one
+  engine step, while the other 7 stay byte-identical to
+  ``generate(greedy)`` with zero page leak — deterministic across a
+  double drive;
+- guards-ON steady decode stays ``jax.transfer_guard("disallow")``- and
+  ``compile_budget(0, prefix="serving.")``-clean (the guard verdict is
+  negative-packed INTO the already-consumed token transfer);
+- the fused K-step and spec-verify dispatches inherit the same guard;
+- repeated numeric faults on one replica trip the watchdog
+  suspect → dead.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.framework.errors import (InvalidArgumentError,
+                                         NumericalFaultError,
+                                         http_status_for)
+from paddle_tpu.framework.monitor import stat_get
+from paddle_tpu.profiler.jit_cost import compile_budget
+from paddle_tpu.serving import ServingEngine, ServingFrontend
+from paddle_tpu.serving.resilience import Watchdog, WatchdogConfig
+from paddle_tpu.testing import chaos
+from paddle_tpu.text.generation import generate
+
+VOCAB = 50
+
+
+@pytest.fixture(scope="module")
+def gpt(shared_gpt_small):
+    return shared_gpt_small
+
+
+def _prompts(n=8, seed=1):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, VOCAB, (p,)).astype(np.int32)
+            for p in (3, 5, 7, 4, 6, 8, 5, 3)[:n]]
+
+
+_REF_CACHE = {}
+
+
+def _ref(gpt, prompt, n):
+    # module-level memo: every quarantine scenario compares the same 7
+    # survivors against the same greedy references, and each generate()
+    # call builds (and XLA-compiles) a fresh dense decode closure —
+    # cache by (prompt bytes, n) so the suite pays each reference once
+    key = (prompt.tobytes(), n)
+    if key not in _REF_CACHE:
+        out, _ = generate(gpt, prompt[None, :], max_new_tokens=n,
+                          end_id=-1)
+        _REF_CACHE[key] = np.asarray(out._value)[0]
+    return _REF_CACHE[key]
+
+
+class TestQuarantine:
+    def _drive(self, gpt, **engine_kw):
+        prompts = _prompts()
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=8,
+                            eos_id=-1, **engine_kw)
+        # explicit ids: the chaos fired-log keys on them, and the
+        # double-drive pin compares the logs verbatim
+        rids = [eng.add_request(p, max_new_tokens=10,
+                                request_id=f"ng-{i}")
+                for i, p in enumerate(prompts)]
+        victim = rids[2]
+        plan = chaos.ChaosPlan([chaos.Fault(
+            "serving.logits", at=3, action=chaos.NAN_LOGITS,
+            match=victim)])
+        with chaos.running(plan):
+            outs = eng.drain()
+        return eng, prompts, rids, victim, outs, plan
+
+    def test_one_of_eight_quarantined_survivors_byte_identical(
+            self, gpt):
+        """Acceptance (c): exactly the damaged request fails; the
+        other 7 match generate(greedy) byte for byte; zero page leak.
+        (Counters read as absolutes: constructing the engine's
+        ServingMetrics resets the process-global serving.* registry.)"""
+        eng, prompts, rids, victim, outs, _ = self._drive(gpt)
+        assert eng.take_faulted() == [victim]
+        assert victim not in outs
+        assert stat_get("serving.guard.quarantines") == 1
+        assert stat_get("serving.guard.nan_lanes") > 0
+        assert eng.cache.pages_in_use == 0          # zero leak
+        for rid, p in zip(rids, prompts):
+            if rid == victim:
+                continue
+            assert np.array_equal(outs[rid], _ref(gpt, p, 10)), rid
+
+    def test_double_drive_deterministic(self, gpt):
+        r1 = self._drive(gpt)
+        r2 = self._drive(gpt)
+        assert r1[5].fired_log() == r2[5].fired_log()
+        assert set(r1[4]) == set(r2[4])
+        for rid in r1[4]:
+            assert np.array_equal(r1[4][rid], r2[4][rid])
+
+    def test_fused_decode_inherits_guard(self, gpt):
+        eng, prompts, rids, victim, outs, _ = self._drive(
+            gpt, fused_steps=4)
+        assert eng.take_faulted() == [victim]
+        assert eng.cache.pages_in_use == 0
+        for rid, p in zip(rids, prompts):
+            if rid != victim:
+                assert np.array_equal(outs[rid], _ref(gpt, p, 10)), rid
+
+    def test_spec_verify_inherits_guard(self, gpt):
+        eng, prompts, rids, victim, outs, _ = self._drive(
+            gpt, spec_decode=True)
+        assert eng.take_faulted() == [victim]
+        assert eng.cache.pages_in_use == 0
+        for rid, p in zip(rids, prompts):
+            if rid != victim:
+                assert np.array_equal(outs[rid], _ref(gpt, p, 10)), rid
+
+    def test_int8_dynamic_scale_row_poison_path(self, gpt):
+        """int8 pages cannot hold NaN — the injection poisons the
+        page's SCALE row instead, and the guard still catches the
+        resulting NaN dequant inside the jitted step."""
+        eng, prompts, rids, victim, outs, _ = self._drive(
+            gpt, kv_cache_dtype="int8")
+        assert eng.take_faulted() == [victim]
+        assert eng.cache.pages_in_use == 0
+        assert victim not in outs
+
+    def test_guards_off_reproduces_motivating_failure(self, gpt):
+        """The OFF arm documents why the guard exists: NaN logits
+        stream argmax-over-NaN junk to completion at full cost — no
+        quarantine, the request 'completes'."""
+        eng, prompts, rids, victim, outs, _ = self._drive(
+            gpt, numeric_guards=False)
+        assert eng.take_faulted() == []
+        assert victim in outs
+        assert len(outs[victim]) == 10     # full budget of junk tokens
+        assert stat_get("serving.guard.quarantines") == 0
+
+    def test_scrubbed_pages_reusable_after_quarantine(self, gpt):
+        """The freed pages were NaN-poisoned; a follow-up request
+        reusing them must decode byte-identically to its reference —
+        the scrub-on-quarantine containment pin."""
+        eng, _, _, victim, _, _ = self._drive(gpt)
+        eng.take_faulted()
+        p = _prompts(seed=9)[0]
+        rid = eng.add_request(p, max_new_tokens=10)
+        outs = eng.drain()
+        assert np.array_equal(outs[rid], _ref(gpt, p, 10))
+        assert eng.cache.pages_in_use == 0
+
+    def test_numeric_guards_knob_validation(self, gpt):
+        with pytest.raises(InvalidArgumentError, match="numeric_guards"):
+            ServingEngine(gpt, page_size=4, numeric_guards="yes")
+
+    def test_quarantine_never_scrubs_shared_prefix_pages(self, gpt):
+        """Review fix: the scrub targets only pages that actually
+        returned to the free list — a quarantined request's
+        prefix-cache-SHARED pages still feed other readers and the
+        radix index, and zeroing them would corrupt every sharer's
+        stream with finite-but-wrong KV the guard cannot catch."""
+        rng = np.random.RandomState(3)
+        sysp = rng.randint(1, VOCAB, (12,)).astype(np.int32)  # 3 pages
+        mk = lambda: np.concatenate(
+            [sysp, rng.randint(1, VOCAB, (3,)).astype(np.int32)])
+        pa, pb, pc = mk(), mk(), mk()
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=4,
+                            eos_id=-1, prefix_cache=True)
+        eng.add_request(pa, max_new_tokens=8, request_id="donor")
+        eng.drain()                        # seals the shared prefix
+        eng.add_request(pb, max_new_tokens=8, request_id="victim")
+        eng.add_request(pc, max_new_tokens=8, request_id="reader")
+        plan = chaos.ChaosPlan([chaos.Fault(
+            "serving.logits", at=2, action=chaos.NAN_LOGITS,
+            match="victim")])
+        with chaos.running(plan):
+            outs = eng.drain()
+        assert eng.take_faulted() == ["victim"]
+        # the co-reader sharing the prefix pages stays byte-identical
+        assert np.array_equal(outs["reader"], _ref(gpt, pc, 8))
+        # and the index still serves the UNCORRUPTED prefix: a fresh
+        # hit must decode exactly like the uncached reference
+        pd = mk()
+        eng.add_request(pd, max_new_tokens=8, request_id="late")
+        outs2 = eng.drain()
+        assert eng.prefix_cache.stats()["hits"] >= 1
+        assert np.array_equal(outs2["late"], _ref(gpt, pd, 8))
+
+    def test_int8_static_scale_row_healed_on_scrub(self, gpt):
+        """Review fix: a nan_logits poison lands in the page's SCALE
+        row in int8 modes; static mode has no scale-reset program, so
+        the scrub must restore the CALIBRATED values — otherwise one
+        injected fault cascades NaN through every future owner of the
+        physical page."""
+        L = len(gpt.layers)
+        H = gpt.layers[0].attn.num_heads
+        scales = {"k": [np.full((H,), 0.05, np.float32)] * L,
+                  "v": [np.full((H,), 0.05, np.float32)] * L}
+
+        def build():
+            return ServingEngine(gpt, page_size=4, max_batch_size=2,
+                                 eos_id=-1, kv_cache_dtype="int8",
+                                 quant_scales={"kv_scales": scales})
+
+        rng = np.random.RandomState(4)
+        pv = rng.randint(1, VOCAB, (5,)).astype(np.int32)
+        pf = rng.randint(1, VOCAB, (6,)).astype(np.int32)
+        eng = build()
+        eng.add_request(pv, max_new_tokens=8, request_id="victim")
+        plan = chaos.ChaosPlan([chaos.Fault(
+            "serving.logits", at=2, action=chaos.NAN_LOGITS,
+            match="victim")])
+        with chaos.running(plan):
+            eng.drain()
+        assert eng.take_faulted() == ["victim"]
+        # follow-up request reuses the freed (previously NaN-scaled)
+        # pages — must match an uninjected engine of the same config
+        eng.add_request(pf, max_new_tokens=8, request_id="follow")
+        outs = eng.drain()
+        ref_eng = build()
+        ref_eng.add_request(pf, max_new_tokens=8, request_id="follow")
+        ref = ref_eng.drain()
+        assert np.array_equal(outs["follow"], ref["follow"])
+        assert eng.take_faulted() == []    # no cascading quarantine
+
+
+class TestSteadyStateClean:
+    def test_guards_on_transfer_guard_and_compile_budget_clean(
+            self, gpt):
+        """Acceptance (d): the guard verdict rides the token transfer
+        in-band, so guarded steady decode performs no implicit host
+        transfer and no retrace."""
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=4,
+                            eos_id=-1, numeric_guards=True)
+        rng = np.random.RandomState(1)
+        for p in (3, 6, 9, 12):
+            eng.add_request(rng.randint(1, VOCAB, (p,)).astype(np.int32),
+                            max_new_tokens=24)
+        for _ in range(4):
+            eng.step()
+        assert all(s is not None for s in eng._lanes)
+        with jax.transfer_guard("disallow"), \
+                compile_budget(0, prefix="serving."):
+            for _ in range(8):
+                stats = eng.step()
+                assert stats["bucket"] == 4
+        outs = eng.drain()
+        assert len(outs) == 4
+        assert eng.stats()["pipeline"]["numeric_guards"] is True
+
+
+class TestWatchdogNumericChannel:
+    def test_escalation_suspect_then_dead(self):
+        wd = Watchdog(WatchdogConfig(numeric_fault_suspect=2,
+                                     numeric_fault_dead=4,
+                                     numeric_fault_window_s=10.0))
+        t = 100.0
+        assert wd.check("r0", None, t) == "ok"
+        wd.note_numeric_fault("r0", t)
+        assert wd.check("r0", None, t) == "ok"       # 1 < suspect
+        wd.note_numeric_fault("r0", t + 1)
+        assert wd.check("r0", None, t + 1) == "suspect"
+        assert wd.trips("r0") == 1
+        wd.note_numeric_fault("r0", t + 2)
+        wd.note_numeric_fault("r0", t + 3)
+        assert wd.check("r0", None, t + 3) == "dead"
+
+    def test_no_readmit_while_fault_window_full(self):
+        """Review fix: backoff elapsing alone must not re-admit a
+        replica whose numeric-fault window is still over the suspect
+        threshold — it would flap back to SUSPECT one check later with
+        victims routed to damaged hardware in between."""
+        wd = Watchdog(WatchdogConfig(numeric_fault_suspect=2,
+                                     numeric_fault_dead=10,
+                                     numeric_fault_window_s=10.0,
+                                     backoff_initial_s=0.5))
+        t = 100.0
+        wd.note_numeric_fault("r0", t)
+        wd.note_numeric_fault("r0", t + 0.1)
+        assert wd.check("r0", None, t + 0.1) == "suspect"
+        # backoff long elapsed, faults still inside the 10 s window
+        assert wd.check("r0", None, t + 5.0) == "ok"
+        # window drained -> readmit
+        assert wd.check("r0", None, t + 11.0) == "readmit"
+
+    def test_faults_age_out_of_window(self):
+        wd = Watchdog(WatchdogConfig(numeric_fault_suspect=2,
+                                     numeric_fault_dead=4,
+                                     numeric_fault_window_s=10.0))
+        t = 100.0
+        wd.note_numeric_fault("r0", t)
+        wd.note_numeric_fault("r0", t + 1)
+        assert wd.numeric_faults("r0", t + 1) == 2
+        assert wd.numeric_faults("r0", t + 20) == 0
+        # a fresh incident after the window starts a fresh count
+        assert wd.check("r0", None, t + 20) in ("ok", "readmit")
+
+    def test_busy_replica_numeric_dead_beats_latency_ok(self):
+        """Numeric escalation is evaluated before the latency logic —
+        a fast-stepping replica streaming NaN is still dead."""
+        wd = Watchdog(WatchdogConfig(numeric_fault_suspect=2,
+                                     numeric_fault_dead=3,
+                                     numeric_fault_window_s=10.0))
+        t = 100.0
+        for i in range(64):
+            wd.observe_step("r0", 0.005, t)
+        for i in range(3):
+            wd.note_numeric_fault("r0", t + i * 0.1)
+        assert wd.check("r0", 0.001, t + 1) == "dead"
+
+
+class TestFrontend:
+    def test_victim_fails_typed_500_survivors_complete(self, gpt):
+        fe = ServingFrontend(
+            gpt, replicas=1, queue_cap=16,
+            engine_kwargs=dict(page_size=4, max_batch_size=8,
+                               eos_id=-1))
+        try:
+            rng = np.random.RandomState(1)
+            plan = chaos.ChaosPlan([chaos.Fault(
+                "serving.logits", at=2, action=chaos.NAN_LOGITS,
+                match="victim")])
+            with chaos.running(plan):
+                prompts = [rng.randint(1, VOCAB, (4,)).astype(np.int32)
+                           for _ in range(3)]
+                hs = [fe.submit(p, max_new_tokens=8) for p in prompts]
+                vic_p = rng.randint(1, VOCAB, (5,)).astype(np.int32)
+                hv = fe.submit(vic_p, max_new_tokens=8,
+                               request_id="victim")
+                for h in hs:
+                    assert h.wait(30) == "completed"
+                assert hv.wait(30) == "failed"
+            assert hv.error_cls is NumericalFaultError
+            assert http_status_for(hv.error_cls) == 500
+            with pytest.raises(NumericalFaultError):
+                hv.result(1)
+            for h, p in zip(hs, prompts):
+                assert np.array_equal(h.tokens, _ref(gpt, p, 8))
+        finally:
+            fe.close()
+
+    def test_faults_feed_the_watchdog(self, gpt):
+        """Each quarantined request on a replica lands in the
+        watchdog's numeric-fault window (the suspect→dead feed)."""
+        fe = ServingFrontend(
+            gpt, replicas=1, queue_cap=16,
+            watchdog=WatchdogConfig(numeric_fault_suspect=50,
+                                    numeric_fault_dead=100),
+            engine_kwargs=dict(page_size=4, max_batch_size=8,
+                               eos_id=-1))
+        try:
+            rng = np.random.RandomState(2)
+            plan = chaos.ChaosPlan([chaos.Fault(
+                "serving.logits", at=2, action=chaos.NAN_LOGITS,
+                match="v0")])
+            with chaos.running(plan):
+                h = fe.submit(rng.randint(1, VOCAB, (5,)).astype(np.int32),
+                              max_new_tokens=8, request_id="v0")
+                assert h.wait(30) == "failed"
+            assert fe.watchdog.numeric_faults("replica-0") == 1
+        finally:
+            fe.close()

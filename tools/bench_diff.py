@@ -124,7 +124,14 @@ def diff(a: dict, b: dict, only: Optional[str] = None,
 # paths and must gate DOWNWARD.
 _HIGHER_BETTER = ("reduction", "per_sec", "per_second", "goodput",
                   "throughput", "occupancy", "parity", "speedup",
-                  "utilization", "hit", "cached", "skipped", "saved",
+                  # prefix-cache prefill work the cache avoided
+                  # (prefill_tokens_skipped / tokens_skipped) — was the
+                  # broader "skipped" fragment until ISSUE 13's
+                  # train.anomaly.skipped_steps needed the generic word
+                  # to gate the OTHER way (skipped training steps
+                  # rising round-over-round = more numerical damage)
+                  "utilization", "hit", "cached", "tokens_skipped",
+                  "saved",
                   # speculative decoding (ISSUE 12): accept_rate and
                   # accepted/drafted token counts falling
                   # round-over-round mean the drafter is losing its
@@ -153,8 +160,16 @@ _LOWER_BETTER = ("_ms", "latency", "ttft", "e2e", "gap", "miss", "bytes",
                  # speculative decoding (ISSUE 12): rollbacks rising
                  # mean more bandwidth burned on wrong guesses
                  # (rejected-draft counters are covered by the
-                 # pre-existing "reject" fragment above)
-                 "rollback")
+                 # pre-existing "reject" fragment above); ISSUE 13's
+                 # anomaly rollbacks gate the same way
+                 "rollback",
+                 # numerical self-healing (ISSUE 13): skipped train
+                 # steps, loss spikes, quarantined serving requests and
+                 # guard-flagged NaN lanes are all DAMAGE counters —
+                 # rising round-over-round means the stack is healing
+                 # more, i.e. numerically worse ("tokens_skipped", the
+                 # prefix-cache win, outranks "skipped" above)
+                 "skipped", "spike", "quarantine", "nan", "corrupt")
 
 
 def lower_is_better(metric: str) -> bool:
