@@ -1770,6 +1770,150 @@ def bench_serving_observability(num_requests=24, max_new_tokens=16):
     }
 
 
+def bench_autotune(num_requests=4, max_new_tokens=6):
+    """Contract-gated Pallas kernel autotuner (ISSUE 14): sweep the
+    runnable kernels at their bench shape buckets (candidates pruned by
+    KernelContract.validate() before any compile, winners gated
+    output-identical to the contract defaults), commit the winners to a
+    TuningTable, then A/B a small int8 serving workload with the table
+    OFF vs ON (kernel routes forced so the seam engages off-TPU too).
+    Reports per-kernel default-vs-best kernel time per bucket, the
+    table hit/fallback counters, and the end-to-end decode tokens/sec
+    + TTFT delta — all under `detail.autotune`, direction-gated by
+    bench_diff (`speedup`/`tuned`/`hit` up-is-better, `_ms`/`fallback`
+    down-is-better)."""
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import tune
+    from paddle_tpu.framework.monitor import stat_get
+    from paddle_tpu.ops.pallas_ops.contracts import CONTRACTS
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.slim import export_serving_quant
+    from paddle_tpu.text.models import GPTModel
+    from paddle_tpu.tune.__main__ import DEFAULT_EXTENTS, _dtype_for
+
+    repeats = int(os.environ.get("BENCH_TUNE_REPEATS", "3"))
+    kernels = os.environ.get(
+        "BENCH_TUNE_KERNELS",
+        "quantized_matmul,paged_attention_decode,"
+        "paged_attention_decode_int8").split(",")
+    tune.reset()
+    table = tune.TuningTable(os.path.join(
+        tempfile.mkdtemp(prefix="bench_tune_"), "table.ptt"))
+    sweeps = {}
+    for name in kernels:
+        for extents in DEFAULT_EXTENTS[name]:
+            rep = tune.sweep_kernel(name, extents,
+                                    dtype=_dtype_for(name),
+                                    repeats=repeats, table=table)
+            pruned = sum(1 for r in rep.results if r.rejected
+                         and r.rejected.startswith("validate"))
+            rejects = sum(1 for r in rep.results if r.rejected
+                          and r.rejected.startswith("parity"))
+            sweeps.setdefault(name, {})[rep.bucket] = {
+                "default_ms": round(rep.default_ms, 3),
+                "best_ms": round(rep.winner.wall_ms, 3),
+                "speedup_x": round(rep.speedup_x, 3),
+                "candidates": len(rep.results),
+                "pruned": pruned,
+                "sweep_rejects": rejects,
+                # strings, not numbers: the winning dims are a LABEL —
+                # a different winner next round is not a "regression"
+                "winner": ",".join(f"{k}={v}" for k, v in
+                                   sorted(rep.winner.choice.items())),
+                "winner_is_default": str(rep.winner.choice == {
+                    s: CONTRACTS[name].dim(s)
+                    for s in rep.winner.choice}),
+            }
+    path = table.save()
+
+    # --- end-to-end A/B: int8 serving decode, table off vs on ------------
+    V, HID, L, HEADS, SEQ = 50, 32, 2, 2, 64
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, V, (int(p),)).astype(np.int32)
+               for p in rng.randint(4, 12, num_requests)]
+    # ONE calibration set for both arms: a per-arm draw would quantize
+    # the two engines differently and void the byte-parity assert
+    calib = rng.randint(1, V, (2, 12))
+
+    def run_arm(active):
+        tune.set_active_table(table if active else None)
+        hits0 = stat_get("tune.table.hits") or 0
+        paddle.seed(11)
+        model = GPTModel(vocab_size=V, hidden_size=HID, num_layers=L,
+                         num_heads=HEADS, ffn_size=64, max_seq_len=SEQ,
+                         dropout=0.0)
+        model.eval()
+        quant = export_serving_quant(model, calib_prompts=calib)
+        eng = ServingEngine(model, page_size=4, max_batch_size=4,
+                            eos_id=-1, kv_cache_dtype="int8",
+                            weight_dtype="int8", quant_scales=quant)
+        rids = [eng.add_request(p, max_new_tokens=max_new_tokens)
+                for p in prompts]
+        t0 = time.perf_counter()
+        outs = eng.drain()
+        dt = time.perf_counter() - t0
+        snap = eng.metrics.snapshot()
+        tune.set_active_table(None)
+        return {
+            # keyed by SUBMISSION ORDER: request ids are process-unique
+            # and differ between the two arms
+            "outs": [np.asarray(outs[r]) for r in rids],
+            "tokens_per_sec": round(
+                snap["tokens_generated"] / max(dt, 1e-9), 2),
+            "mean_ttft_ms": round(snap["mean_ttft_ms"], 2),
+            "table_hits": (stat_get("tune.table.hits") or 0) - hits0,
+        }
+
+    # force the Pallas routes so the lookup seam engages off-TPU too;
+    # clear the env table for the A/B — set_active_table(None) re-arms
+    # the lazy env probe, so an operator's PADDLE_TPU_TUNING_TABLE
+    # would silently load into the "off" arm and flatten the delta
+    forced = {"PADDLE_TPU_FORCE_PAGED": "1", "PADDLE_TPU_FORCE_QMM": "1"}
+    saved = {k: os.environ.get(k)
+             for k in (*forced, "PADDLE_TPU_TUNING_TABLE")}
+    os.environ.pop("PADDLE_TPU_TUNING_TABLE", None)
+    os.environ.update(forced)
+    try:
+        off = run_arm(False)
+        on = run_arm(True)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    # tuned configs are parity-gated: the two arms must stream the SAME
+    # bytes (the acceptance contract, asserted here so a bad table can
+    # never publish a "speedup")
+    for a, b in zip(off["outs"], on["outs"]):
+        np.testing.assert_array_equal(a, b)
+    return {
+        "metric": "autotune_e2e_decode_speedup",
+        "value": round(on["tokens_per_sec"]
+                       / max(off["tokens_per_sec"], 1e-9), 3),
+        "unit": "x (table on / off)",
+        "detail": {
+            "table_path": path,
+            "table_entries": len(table),
+            "sweeps": sweeps,
+            "fallbacks": stat_get("tune.table.fallbacks") or 0,
+            # arm labels deliberately avoid the higher-better "tuned"
+            # fragment: their _ms leaves must keep gating upward
+            "decode_off": {
+                "tokens_per_sec": off["tokens_per_sec"],
+                "mean_ttft_ms": off["mean_ttft_ms"]},
+            "decode_on": {
+                "tokens_per_sec": on["tokens_per_sec"],
+                "mean_ttft_ms": on["mean_ttft_ms"],
+                "table_hits": on["table_hits"]},
+        },
+    }
+
+
 def _compile_section():
     """Per-program compile accounting for the serving run
     (``detail.compile``): compile count + compile ms + calls per
@@ -1985,6 +2129,19 @@ def main():
         except Exception as e:  # noqa: BLE001 — rider workload, never fatal
             sys.stderr.write(
                 f"serving observability bench failed after retries "
+                f"({type(e).__name__}: {e})\n")
+        try:
+            # kernel autotuner: contract-gated sweep + tuned-vs-default
+            # kernel times + end-to-end int8 decode A/B (ISSUE 14)
+            result.setdefault("detail", {})["autotune"] = \
+                _with_retries(
+                    "autotune",
+                    lambda: bench_autotune(
+                        int(os.environ.get("BENCH_TUNE_REQUESTS", "4")),
+                        int(os.environ.get("BENCH_TUNE_TOKENS", "6"))))
+        except Exception as e:  # noqa: BLE001 — rider workload, never fatal
+            sys.stderr.write(
+                f"autotune bench failed after retries "
                 f"({type(e).__name__}: {e})\n")
         # whole-run compile accounting LAST: every serving workload
         # above has already attributed its compiles to the registry

@@ -90,6 +90,22 @@ class CheckpointIncompatibleError(PreconditionNotMetError):
     precondition of the restore, hence 412)."""
 
 
+class TuningTableCorruptError(CheckpointCorruptError):
+    """An on-disk kernel tuning table failed integrity validation —
+    torn write, truncated file, bad magic, or a CRC mismatch against
+    its manifest (tune.TuningTable; ISSUE 14).  The soft-loading
+    runtime path (``tune.runtime``) treats this as "fall back to the
+    contract-default kernel configs, never a wrong kernel"; the strict
+    loaders (``TuningTable.load``, the ``verify`` CLI) raise it."""
+
+
+class TuningTableIncompatibleError(CheckpointIncompatibleError):
+    """A kernel tuning table is well-formed but its schema version is
+    newer than this build understands (tune.TuningTable; ISSUE 14).
+    Soft loading falls back to contract defaults; strict loading
+    raises (a precondition of applying the table, hence 412)."""
+
+
 class NumericalFaultError(InternalError):
     """Numerical damage detected by a device-side guard — a non-finite
     loss/gradient in the train step, or non-finite logits on a serving

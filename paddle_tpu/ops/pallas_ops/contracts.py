@@ -113,6 +113,13 @@ class KernelContract:
     - ``double_buffered``: pallas double-buffers grid-streamed in/out
       block DMAs, so their VMEM cost counts twice; scratch is resident
       once.
+    - ``sweep``: the AUTOTUNER's declared search axes — dim symbol ->
+      candidate values (``paddle_tpu/tune``).  The cartesian product of
+      these axes, overlaid on ``dims`` and gated through ``validate()``
+      at the target shape bucket, is the candidate set; a kernel with an
+      empty sweep has no tunable axis (its config is structural).  Axes
+      must name symbols bound in ``dims`` so the default config is
+      always a member of its own search space.
     """
 
     name: str
@@ -125,6 +132,7 @@ class KernelContract:
     double_buffered: bool = True
     platform: str = "tpu"
     vmem_budget_bytes: int = VMEM_BUDGET_BYTES
+    sweep: Mapping[str, Tuple[int, ...]] = field(default_factory=dict)
 
     # --- resolution -------------------------------------------------------
     def dim(self, sym: str) -> int:
@@ -214,6 +222,11 @@ FLASH_FWD = KernelContract(
     ),
     shape_buckets={"block_q": (1024, 2048, 4096, 8192),
                    "block_k": (1024, 2048, 4096, 8192)},
+    # block_q partitions independent query rows (exactly
+    # parity-preserving); block_k reorders the online-softmax
+    # accumulation (winners must still pass the sweep's parity gate)
+    sweep={"block_q": (256, 512, 1024),
+           "block_k": (512, 1024, 2048)},
 )
 
 FLASH_BWD_DKV = KernelContract(
@@ -293,14 +306,24 @@ PAGED_DECODE = KernelContract(
         BlockDecl("l", "scratch", ("heads", "lane"), "float32"),
     ),
     shape_buckets={"head_dim": (128, 256), "heads": (8, 16, 32)},
+    # the head padding floor is a legal relayout knob: any multiple of
+    # the f32 sublane floor tiles, padded rows are sliced off — exactly
+    # parity-preserving
+    sweep={"head_align": (8, 16)},
 )
 
 PAGED_DECODE_INT8 = KernelContract(
     name="paged_attention_decode_int8",
     module="paddle_tpu/ops/pallas_ops/paged_attention.py",
     grid=("batch", "pages_per_seq"),
+    # fused_dequant=1 is the historical epilogue: the [H] scale rows
+    # multiply the LOGITS (K) and the accumulated context (V) after the
+    # dots; 0 dequantizes the page in-register BEFORE the dots.  Both
+    # stream 1 byte/element from HBM — the choice moves the multiply
+    # between the VPU epilogue and the MXU operand path, which is
+    # exactly the kind of platform-dependent tie the sweep measures.
     dims={"page_size": 16, "heads": 8, "head_dim": 128, "lane": 128,
-          "head_align": 8},
+          "head_align": 8, "fused_dequant": 1},
     blocks=(
         BlockDecl("page_tables", "in", ("batch", "pages_per_seq"),
                   "int32", memory="smem"),
@@ -334,6 +357,10 @@ PAGED_DECODE_INT8 = KernelContract(
         BlockDecl("l", "scratch", ("heads", "lane"), "float32"),
     ),
     shape_buckets={"head_dim": (128, 256), "heads": (8, 16, 32)},
+    # fused_dequant moves the scale multiply across the dot — NOT
+    # bit-exact (rounding points differ), so the non-default choice only
+    # survives a sweep run with an explicit tolerance (docs/TUNING.md)
+    sweep={"head_align": (8, 16), "fused_dequant": (0, 1)},
 )
 
 # ===========================================================================
@@ -357,6 +384,12 @@ QUANTIZED_MATMUL = KernelContract(
     shape_buckets={"block_k": (128, 256, 512, 1024, 2048),
                    "block_n": (128, 256, 512, 1024, 2048),
                    "block_m": (128, 256)},
+    # the wrapper pads every extent up to the block grid, so any
+    # candidate tiles any array; block_k reorders the K-sum (parity
+    # gate applies), block_m/block_n are exactly parity-preserving
+    sweep={"block_m": (128, 256),
+           "block_n": (128, 256, 512),
+           "block_k": (128, 256, 512)},
 )
 
 # name -> contract, the registry the lint, the tests and (next) the

@@ -55,6 +55,22 @@ def _pick_block(default, seq_len):
     return b
 
 
+def _resolved_blocks(seq_len_padded):
+    """Preferred (block_q, block_k) for this padded sequence length:
+    tuning-table hit (validate()-gated at the shape bucket) -> contract
+    default; both then pass the `_pick_block` divisor guard, because a
+    bucket covers every x128-padded length below it and the kernel
+    needs blocks that tile THIS array exactly (docs/TUNING.md)."""
+    from ...tune.runtime import lookup_dims
+
+    tuned = lookup_dims(FLASH_FWD, {"block_q": seq_len_padded,
+                                    "block_k": seq_len_padded})
+    if tuned is None:
+        return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+    return (tuned.get("block_q", DEFAULT_BLOCK_Q),
+            tuned.get("block_k", DEFAULT_BLOCK_K))
+
+
 def _keep_mask(seed, bh, rows, cols, dropout_p):
     """Deterministic dropout keep-mask: xorshift-mix hash of the GLOBAL
     (row, col) position + seed + batch·head.  Independent of block shape, so
@@ -471,8 +487,10 @@ def flash_attention_bshd(q, k, v, causal=False, kv_mask=None, dropout_p=0.0,
         k = jnp.pad(k, pad)
         v = jnp.pad(v, pad)
 
-    bq = block_q or _pick_block(DEFAULT_BLOCK_Q, Sp)
-    bk = block_k or _pick_block(DEFAULT_BLOCK_K, Sp)
+    pref_q, pref_k = (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K) \
+        if (block_q and block_k) else _resolved_blocks(Sp)
+    bq = block_q or _pick_block(pref_q, Sp)
+    bk = block_k or _pick_block(pref_k, Sp)
     if seed is None:
         seed = jnp.zeros((1,), jnp.int32)
     else:

@@ -50,7 +50,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .contracts import PAGED_DECODE
+from .contracts import PAGED_DECODE, PAGED_DECODE_INT8
 
 NEG_INF = -1e30
 
@@ -59,6 +59,23 @@ NEG_INF = -1e30
 # pallas-contract lint checks the same values the kernel runs with
 _HEAD_ALIGN = PAGED_DECODE.dim("head_align")
 _LANE = PAGED_DECODE.dim("lane")
+_FUSED_DEQUANT = PAGED_DECODE_INT8.dim("fused_dequant")
+
+
+def _resolved_dims(H, D, quantized):
+    """(head_align, fused_dequant) for this call: tuning-table hit
+    (validate()-gated at the (heads, head_dim) shape bucket) ->
+    contract default.  With no table installed this is a single None
+    check — the historical padding/epilogue run unchanged."""
+    from ...tune.runtime import lookup_dims
+
+    contract = PAGED_DECODE_INT8 if quantized else PAGED_DECODE
+    tuned = lookup_dims(contract, {"heads": H, "head_dim": D},
+                        dtype="int8" if quantized else "float32")
+    if tuned is None:
+        return _HEAD_ALIGN, bool(_FUSED_DEQUANT)
+    return (tuned.get("head_align", _HEAD_ALIGN),
+            bool(tuned.get("fused_dequant", _FUSED_DEQUANT)))
 
 # trace-time routing telemetry, mirroring ops/attention.py ROUTE_STATS
 PAGED_ROUTE_STATS = {"pallas": 0, "xla": 0}
@@ -129,12 +146,16 @@ def _decode_kernel(pt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
 
 def _decode_kernel_quant(pt_ref, sl_ref, q_ref, k_ref, v_ref, ks_ref,
                          vs_ref, o_ref, acc_sc, m_sc, l_sc, *, scale,
-                         page_size, num_pages_grid):
+                         page_size, num_pages_grid, fused_dequant=True):
     """Int8-KV variant of ``_decode_kernel``: the DMA'd page blocks are
-    int8 and ride with their [H] fp32 scale rows; dequantization is a
-    per-head multiply folded into the logits (K) and the accumulated
-    context contribution (V) — everything after that is the same f32
-    online softmax."""
+    int8 and ride with their [H] fp32 scale rows.  ``fused_dequant``
+    (a sweepable contract axis, ISSUE 14) picks WHERE the per-head
+    dequant multiply lands: True (the historical epilogue) folds it
+    into the logits (K) and the accumulated context contribution (V)
+    after the dots; False dequantizes the page in-register BEFORE the
+    dots.  Either way HBM streams 1 byte/element and everything after
+    is the same f32 online softmax — the two differ only in rounding
+    points and in which unit does the multiply."""
     b = pl.program_id(0)
     i = pl.program_id(1)
 
@@ -153,9 +174,13 @@ def _decode_kernel_quant(pt_ref, sl_ref, q_ref, k_ref, v_ref, ks_ref,
         v = v_ref[0].astype(jnp.float32)
         ks = ks_ref[0].astype(jnp.float32)                # [H] page K scale
         vs = vs_ref[0].astype(jnp.float32)                # [H] page V scale
+        if not fused_dequant:
+            k = k * ks[None, :, None]                     # dequant K pre-dot
+            v = v * vs[None, :, None]                     # dequant V pre-dot
         s = jax.lax.dot_general(q, k, (((1,), (2,)), ((0,), (1,))),
                                 preferred_element_type=jnp.float32)
-        s = s * ks[:, None]                               # dequant K
+        if fused_dequant:
+            s = s * ks[:, None]                           # dequant K
         H = q.shape[0]
         pos = i * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (H, page_size), 1)
@@ -168,7 +193,9 @@ def _decode_kernel_quant(pt_ref, sl_ref, q_ref, k_ref, v_ref, ks_ref,
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         ctx = jax.lax.dot_general(p, v, (((1,), (0,)), ((0,), (1,))),
                                   preferred_element_type=jnp.float32)
-        acc_sc[:] = acc_sc[:] * alpha + ctx * vs[:, None]  # dequant V
+        if fused_dequant:
+            ctx = ctx * vs[:, None]                       # dequant V
+        acc_sc[:] = acc_sc[:] * alpha + ctx
         m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
         l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
 
@@ -179,7 +206,8 @@ def _decode_kernel_quant(pt_ref, sl_ref, q_ref, k_ref, v_ref, ks_ref,
 
 
 def paged_attention_kernel(q, k_pages, v_pages, page_tables, seq_lens,
-                           k_scales=None, v_scales=None, *, interpret=None):
+                           k_scales=None, v_scales=None, *, interpret=None,
+                           head_align=None, fused_dequant=None):
     """The Pallas kernel proper (interpret mode off-TPU unless forced).
 
     q           [B, H, D]   one decode query per sequence
@@ -192,6 +220,10 @@ def paged_attention_kernel(q, k_pages, v_pages, page_tables, seq_lens,
     v_scales    [N, H] fp32  per-page-per-head V dequant scales
 
     Returns [B, H, D]; softmax scale 1/sqrt(D) is applied internally.
+
+    ``head_align`` (padding floor for H) and ``fused_dequant`` (where
+    the int8 scale multiply lands) resolve explicit argument >
+    tuning-table hit > contract default (``None`` selects the lookup).
     """
     B, H, D = q.shape
     page_size = k_pages.shape[1]
@@ -199,6 +231,10 @@ def paged_attention_kernel(q, k_pages, v_pages, page_tables, seq_lens,
     quantized = k_pages.dtype == jnp.int8
     if quantized and (k_scales is None or v_scales is None):
         raise ValueError("int8 KV pages require k_scales/v_scales")
+    if head_align is None or (quantized and fused_dequant is None):
+        t_align, t_fused = _resolved_dims(H, D, quantized)
+        head_align = t_align if head_align is None else head_align
+        fused_dequant = t_fused if fused_dequant is None else fused_dequant
     # the softmax temperature comes from the REAL head_dim — computed
     # before any tile padding so the padded kernel is numerically
     # identical to the unpadded one (zero-padded D lanes add 0 to q·k)
@@ -209,7 +245,7 @@ def paged_attention_kernel(q, k_pages, v_pages, page_tables, seq_lens,
     # mosaic wants the trailing block dims (H, D) tile-aligned on real
     # TPU; pad unconditionally (cheap — decode arrays are small) so the
     # CPU interpret tests exercise the exact same padded path as TPU
-    Hp = -(-H // _HEAD_ALIGN) * _HEAD_ALIGN
+    Hp = -(-H // head_align) * head_align
     Dp = _LANE if D <= _LANE else -(-D // _LANE) * _LANE
     if Hp != H or Dp != D:
         q = jnp.pad(q, ((0, 0), (0, Hp - H), (0, Dp - D)))
@@ -243,7 +279,8 @@ def paged_attention_kernel(q, k_pages, v_pages, page_tables, seq_lens,
         ]
         operands += [k_scales.astype(jnp.float32),
                      v_scales.astype(jnp.float32)]
-        kern = _decode_kernel_quant
+        kern = functools.partial(_decode_kernel_quant,
+                                 fused_dequant=bool(fused_dequant))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,        # page_tables, seq_lens

@@ -41,11 +41,28 @@ __all__ = ["quantized_matmul", "quantized_matmul_kernel",
 
 # default tiling from the declared KernelContract (contracts.py) — the
 # single source of truth the pallas-contract lint checks and the
-# autotuner will swap
+# autotuner swaps (paddle_tpu/tune)
 _BLOCK_M = QUANTIZED_MATMUL.dim("block_m")
 _BLOCK_N = QUANTIZED_MATMUL.dim("block_n")
 _BLOCK_K = QUANTIZED_MATMUL.dim("block_k")
 _F32_SUBLANE = SUBLANE_FLOOR["float32"]
+
+
+def _resolved_blocks(M, K, N):
+    """Tiling for this call: tuning-table hit (validate()-gated, keyed
+    by the (M, K, N) shape bucket) -> contract default.  With no table
+    installed this is a single None check — the historical configs run
+    unchanged (docs/TUNING.md)."""
+    from ...tune.runtime import lookup_dims
+
+    tuned = lookup_dims(QUANTIZED_MATMUL,
+                        {"block_m": M, "block_k": K, "block_n": N},
+                        dtype="int8_weights")
+    if tuned is None:
+        return _BLOCK_M, _BLOCK_N, _BLOCK_K
+    return (tuned.get("block_m", _BLOCK_M),
+            tuned.get("block_n", _BLOCK_N),
+            tuned.get("block_k", _BLOCK_K))
 
 # trace-time routing telemetry, mirroring ops/attention.py ROUTE_STATS —
 # the engine's stats() exposes this as the weight-quant hit counter
@@ -85,8 +102,8 @@ def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc_sc, *, k_steps):
 
 
 def quantized_matmul_kernel(x, w_q, w_scale, *, interpret=None,
-                            block_m=_BLOCK_M, block_n=_BLOCK_N,
-                            block_k=_BLOCK_K):
+                            block_m=None, block_n=None,
+                            block_k=None):
     """The Pallas kernel proper (interpret mode off-TPU unless forced).
 
     x        [M, K]  activations (any float dtype; accumulates in f32)
@@ -94,6 +111,9 @@ def quantized_matmul_kernel(x, w_q, w_scale, *, interpret=None,
     w_scale  [N]     fp32 per-output-channel dequant scales
 
     Returns [M, K] @ (w_q * w_scale[None, :]) as x.dtype.
+
+    Block sizes resolve explicit argument > tuning-table hit > contract
+    default (``None`` selects the lookup).
     """
     M, K = x.shape
     Kw, N = w_q.shape
@@ -101,6 +121,11 @@ def quantized_matmul_kernel(x, w_q, w_scale, *, interpret=None,
         raise ValueError(f"x [{M},{K}] vs w_q [{Kw},{N}]: K mismatch")
     if w_scale.shape != (N,):
         raise ValueError(f"w_scale must be [N={N}], got {w_scale.shape}")
+    if block_m is None or block_n is None or block_k is None:
+        t_m, t_n, t_k = _resolved_blocks(M, K, N)
+        block_m = t_m if block_m is None else block_m
+        block_n = t_n if block_n is None else block_n
+        block_k = t_k if block_k is None else block_k
 
     # pad everything to the block grid; int8 tile floor is (32, 128) so
     # the weight blocks stay tileable on real TPU.  Decode/prefill M is

@@ -1,0 +1,39 @@
+"""paddle_tpu.tune — the contract-gated Pallas kernel autotuner
+(ISSUE 14; ROADMAP "Pallas kernel autotuner").
+
+Component map:
+
+- ``table``    — :class:`TuningTable`: the persistent, versioned,
+  CRC'd on-disk store of winning configs (atomic commits through
+  ``framework_io.atomic_write_bytes``; corrupt/newer-schema files fall
+  back to contract defaults, never to a wrong kernel).
+- ``search``   — candidate enumeration from the contracts' declared
+  ``sweep`` axes, pruned through ``KernelContract.validate()`` before
+  anything compiles, measured min-of-N against the default config's
+  output (:func:`sweep_kernel`).
+- ``runners``  — per-kernel input builders + ``profiled_jit``-wrapped
+  execution (``tune.<kernel>`` cost attribution).
+- ``runtime``  — the kernel-side lookup seam: explicit arg > table hit
+  > contract default; with no table installed the kernels run exactly
+  their historical configs.
+- ``__main__`` — ``python -m paddle_tpu.tune {sweep,show,verify}``.
+
+Docs: docs/TUNING.md.  Metrics: ``tune.*`` (docs/OBSERVABILITY.md).
+"""
+from .search import (CandidateResult, SweepReport, bucket_key,  # noqa: F401
+                     candidate_contract, enumerate_candidates,
+                     shape_bucket, sweep_kernel)
+from .table import TUNE_SCHEMA_VERSION, TuningTable, entry_key  # noqa: F401
+from .runtime import (active_source, get_active_table,  # noqa: F401
+                      lookup_dims, reset, set_active_table)
+from .runners import RUNNERS, register_runner, runner_for  # noqa: F401
+
+__all__ = [
+    "TuningTable", "TUNE_SCHEMA_VERSION", "entry_key",
+    "shape_bucket", "bucket_key", "candidate_contract",
+    "enumerate_candidates", "sweep_kernel", "CandidateResult",
+    "SweepReport",
+    "set_active_table", "get_active_table", "active_source",
+    "lookup_dims", "reset",
+    "RUNNERS", "register_runner", "runner_for",
+]

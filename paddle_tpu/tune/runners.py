@@ -1,0 +1,191 @@
+"""Per-kernel measurement runners for the sweep harness (ISSUE 14).
+
+A *runner* answers "run THIS contract's kernel at THIS shape bucket
+with THAT candidate config and hand me the output": it builds
+deterministic representative inputs once, then returns a callable
+``run(choice) -> jax array``.  Every candidate executes under a
+``profiled_jit`` named ``tune.<kernel>`` so its compile time and XLA
+cost analysis land in the process-wide ``cost_registry`` next to the
+serving programs' (docs/OBSERVABILITY.md).
+
+Runners exist for the kernels with a runtime-swappable config:
+``flash_attention_fwd`` (block_q/block_k through the wrapper),
+``paged_attention_decode`` / ``..._int8`` (head padding floor, and the
+int8 fused-dequant epilogue choice) and ``quantized_matmul``
+(block_m/n/k).  The flash BACKWARD contracts declare no sweep axes and
+have no runner — their blocks ride the forward's choices today; a
+dedicated grad-path runner is future work (docs/TUNING.md).
+
+Kernel modules are imported lazily inside each runner so this package
+never participates in an import cycle with ``ops.pallas_ops``.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Mapping
+
+import numpy as np
+
+from ..ops.pallas_ops.contracts import KernelContract
+
+__all__ = ["runner_for", "register_runner", "RUNNERS"]
+
+# contract name -> runner factory (contract, bucket, dtype) -> run(choice)
+RUNNERS: Dict[str, Callable] = {}
+
+
+def register_runner(name: str):
+    def deco(fn):
+        RUNNERS[name] = fn
+        return fn
+    return deco
+
+
+def runner_for(name: str):
+    try:
+        return RUNNERS[name]
+    except KeyError:
+        raise ValueError(
+            f"no sweep runner registered for kernel {name!r} — "
+            f"runnable kernels: {sorted(RUNNERS)}") from None
+
+
+def _profiled(name: str, fn):
+    from ..profiler.jit_cost import profiled_jit
+
+    return profiled_jit(f"tune.{name}", fn)
+
+
+def _per_choice(name: str, build):
+    """Memoize ONE ProfiledJit per candidate choice: the first call
+    compiles (attributed to ``tune.<kernel>``), the timed min-of-N
+    repeats hit the compiled executable — the sweep measures kernel
+    time, not retrace time."""
+    jits: Dict[tuple, object] = {}
+
+    def get(choice):
+        key = tuple(sorted(choice.items()))
+        fn = jits.get(key)
+        if fn is None:
+            fn = jits[key] = _profiled(name, build(dict(choice)))
+        return fn
+
+    return get
+
+
+@register_runner("quantized_matmul")
+def _qmm_runner(contract: KernelContract, bucket: Mapping[str, int],
+                dtype: str):
+    import jax.numpy as jnp
+
+    from ..ops.pallas_ops.quantized_matmul import quantized_matmul_kernel
+
+    M, K, N = (bucket["block_m"], bucket["block_k"], bucket["block_n"])
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(M, K).astype(np.float32))
+    w_q = jnp.asarray(rng.randint(-127, 128, (K, N)).astype(np.int8))
+    w_s = jnp.asarray((rng.rand(N).astype(np.float32) * 0.1 + 1e-3))
+
+    jit_for = _per_choice(
+        contract.name,
+        lambda c: lambda a, b, s: quantized_matmul_kernel(
+            a, b, s, block_m=c["block_m"], block_n=c["block_n"],
+            block_k=c["block_k"]))
+
+    def run(choice):
+        return jit_for(choice)(x, w_q, w_s)
+
+    return run
+
+
+@register_runner("flash_attention_fwd")
+def _flash_runner(contract: KernelContract, bucket: Mapping[str, int],
+                  dtype: str):
+    import jax.numpy as jnp
+
+    from ..ops.pallas_ops.flash_attention import flash_attention_bshd
+
+    # both sweep axes tile the same sequence extent — run at the larger
+    S = max(bucket["block_q"], bucket["block_k"])
+    B, H, D = 1, 2, 64
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32) * 0.2)
+    k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32) * 0.2)
+    v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32) * 0.2)
+
+    jit_for = _per_choice(
+        contract.name,
+        lambda c: lambda a, b, d: flash_attention_bshd(
+            a, b, d, causal=True, block_q=c["block_q"],
+            block_k=c["block_k"]))
+
+    def run(choice):
+        return jit_for(choice)(q, k, v)
+
+    return run
+
+
+def _paged_inputs(bucket: Mapping[str, int], page_size: int,
+                  int8: bool):
+    import jax.numpy as jnp
+
+    H, D = bucket["heads"], bucket["head_dim"]
+    N, B, M = 9, 2, 4
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(B, H, D).astype(np.float32) * 0.3)
+    kf = rng.randn(N, page_size, H, D).astype(np.float32)
+    vf = rng.randn(N, page_size, H, D).astype(np.float32)
+    pt = np.zeros((B, M), np.int32)
+    pt[0, :3] = [1, 2, 3]
+    pt[1, :4] = [4, 5, 6, 7]
+    sl = jnp.asarray(np.array([page_size * 2 + 3, page_size * 4],
+                              np.int32))
+    pt = jnp.asarray(pt)
+    if not int8:
+        return q, jnp.asarray(kf), jnp.asarray(vf), pt, sl, None, None
+    ks = (np.abs(kf).max(axis=(1, 3)) / 127 + 1e-9).astype(np.float32)
+    vs = (np.abs(vf).max(axis=(1, 3)) / 127 + 1e-9).astype(np.float32)
+    kq = np.clip(np.round(kf / ks[:, None, :, None]), -127,
+                 127).astype(np.int8)
+    vq = np.clip(np.round(vf / vs[:, None, :, None]), -127,
+                 127).astype(np.int8)
+    return (q, jnp.asarray(kq), jnp.asarray(vq), pt, sl,
+            jnp.asarray(ks), jnp.asarray(vs))
+
+
+@register_runner("paged_attention_decode")
+def _paged_runner(contract: KernelContract, bucket: Mapping[str, int],
+                  dtype: str):
+    from ..ops.pallas_ops.paged_attention import paged_attention_kernel
+
+    q, kp, vp, pt, sl, _, _ = _paged_inputs(
+        bucket, contract.dim("page_size"), int8=False)
+
+    jit_for = _per_choice(
+        contract.name,
+        lambda c: lambda a, b, d, e, f: paged_attention_kernel(
+            a, b, d, e, f, head_align=c["head_align"]))
+
+    def run(choice):
+        return jit_for(choice)(q, kp, vp, pt, sl)
+
+    return run
+
+
+@register_runner("paged_attention_decode_int8")
+def _paged_int8_runner(contract: KernelContract,
+                       bucket: Mapping[str, int], dtype: str):
+    from ..ops.pallas_ops.paged_attention import paged_attention_kernel
+
+    q, kp, vp, pt, sl, ks, vs = _paged_inputs(
+        bucket, contract.dim("page_size"), int8=True)
+
+    jit_for = _per_choice(
+        contract.name,
+        lambda c: lambda a, b, d, e, f, g, h: paged_attention_kernel(
+            a, b, d, e, f, g, h, head_align=c["head_align"],
+            fused_dequant=bool(c["fused_dequant"])))
+
+    def run(choice):
+        return jit_for(choice)(q, kp, vp, pt, sl, ks, vs)
+
+    return run

@@ -1,0 +1,171 @@
+"""The kernel-side tuning-table lookup seam (ISSUE 14).
+
+The three Pallas kernel wrappers resolve their swappable dims through
+ONE function — :func:`lookup_dims` — with a strict resolution order:
+
+    explicit caller argument  >  active-table hit  >  contract default
+
+With no table installed (the default state of every process) the
+lookup is a single ``None`` check and the kernels run EXACTLY their
+historical contract-default configs — zero behavior change, which is
+what keeps the ``test_kernel_contracts`` literal pins green.
+
+An active table comes from either :func:`set_active_table` (tests, the
+bench A/B arms, embedding applications) or the
+``PADDLE_TPU_TUNING_TABLE`` environment variable, loaded lazily on the
+first lookup through :meth:`TuningTable.load_or_default` — a corrupt or
+newer-schema file degrades to contract defaults (``tune.table.
+fallbacks`` counts it, the reason is kept on the table object), never
+to an unvalidated kernel config.
+
+Every table hit is re-gated through ``validate()`` ONCE per (kernel,
+bucket) — a hand-edited table row that breaks the tiling rules is
+dropped (counted as ``tune.table.invalid``) instead of compiled.
+Counters: ``tune.table.{hits,misses,fallbacks,invalid}``
+(docs/OBSERVABILITY.md "Kernel autotuning").
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Mapping, Optional, Tuple
+
+from ..framework.monitor import stat_add
+from ..ops.pallas_ops.contracts import KernelContract
+from .table import TuningTable
+
+__all__ = ["set_active_table", "get_active_table", "active_source",
+           "lookup_dims", "reset"]
+
+ENV_TABLE = "PADDLE_TPU_TUNING_TABLE"
+
+_lock = threading.Lock()
+_active: Optional[TuningTable] = None
+_source: Optional[str] = None          # "explicit" | "env:<path>" | None
+_env_checked = False
+# (kernel, bucket, dtype, platform) -> validated dims | None; cleared on
+# table swap — lookups happen at kernel TRACE time, so this cache keeps
+# the steady-state cost at one dict probe
+_resolved: Dict[Tuple[str, str, str, str], Optional[Dict[str, int]]] = {}
+_UNRESOLVED = object()         # cache-miss sentinel (None is a cached miss)
+
+
+def set_active_table(table_or_path=None) -> Optional[TuningTable]:
+    """Install (or clear, with ``None``) the process-wide tuning table.
+    Accepts a :class:`TuningTable` or a path (soft-loaded: a bad file
+    falls back to an empty table and counts ``tune.table.fallbacks``).
+    Returns the installed table."""
+    global _active, _source, _env_checked
+    with _lock:
+        if table_or_path is None:
+            _active, _source = None, None
+            # an explicit clear also re-arms the env probe so test
+            # monkeypatching of ENV_TABLE behaves predictably
+            _env_checked = False
+        elif isinstance(table_or_path, TuningTable):
+            _active, _source = table_or_path, "explicit"
+        else:
+            t, reason = TuningTable.load_or_default(str(table_or_path))
+            if reason is not None and reason != "missing":
+                stat_add("tune.table.fallbacks")
+            _active, _source = t, "explicit"
+        _resolved.clear()
+        return _active
+
+
+def get_active_table() -> Optional[TuningTable]:
+    _maybe_load_env()
+    return _active
+
+
+def active_source() -> Optional[str]:
+    return _source
+
+
+def reset() -> None:
+    """Test isolation: drop the active table, the resolution cache and
+    the env-probe memo."""
+    set_active_table(None)
+
+
+def _maybe_load_env() -> None:
+    global _active, _source, _env_checked
+    if _env_checked or _active is not None:
+        return
+    with _lock:
+        if _env_checked or _active is not None:
+            return
+        _env_checked = True
+        path = os.environ.get(ENV_TABLE)
+        if not path:
+            return
+        t, reason = TuningTable.load_or_default(path)
+        if reason == "missing":
+            return                      # env names a not-yet-swept path
+        if reason is not None:
+            stat_add("tune.table.fallbacks")
+        _active, _source = t, f"env:{path}"
+        _resolved.clear()
+
+
+def lookup_dims(contract: KernelContract,
+                extents: Mapping[str, int], *,
+                dtype: str = "float32",
+                platform: Optional[str] = None
+                ) -> Optional[Dict[str, int]]:
+    """Tuned dims for ``contract`` at the shape bucket covering
+    ``extents``, or ``None`` (= use the contract defaults).  Hit dims
+    are validate()-gated once per bucket and cached."""
+    _maybe_load_env()
+    table = _active
+    if table is None or len(table) == 0:
+        return None
+    from .search import bucket_key, candidate_contract, shape_bucket
+
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    bkey = bucket_key(contract, extents)
+    ckey = (contract.name, bkey, dtype, platform)
+    # single atomic read: a concurrent set_active_table may clear the
+    # cache between a membership test and an index, so never split them
+    hit = _resolved.get(ckey, _UNRESOLVED)
+    if hit is not _UNRESOLVED:
+        if hit is None:            # cached miss (or dropped-invalid row)
+            stat_add("tune.table.misses")
+            return None
+        stat_add("tune.table.hits")
+        return dict(hit)
+
+    def publish(resolved):
+        # publish only if the table we resolved against is still the
+        # active one — a concurrent set_active_table cleared the cache
+        # and must not have stale dims re-inserted behind it
+        with _lock:
+            if _active is table:
+                _resolved[ckey] = resolved
+
+    entry = table.get(contract.name, bkey, dtype, platform)
+    if entry is None:
+        publish(None)
+        stat_add("tune.table.misses")
+        return None
+    try:
+        dims = {str(k): int(v)
+                for k, v in dict(entry.get("dims") or {}).items()}
+    except (TypeError, ValueError):
+        # non-numeric dims in a hand-edited row: drop it like any
+        # other invalid row — the lookup seam never raises
+        publish(None)
+        stat_add("tune.table.invalid")
+        return None
+    bucket = shape_bucket(contract, extents)
+    if candidate_contract(contract, dims, bucket).validate():
+        # never compile an unvalidated config, whatever the file says
+        publish(None)
+        stat_add("tune.table.invalid")
+        return None
+    publish(dims)
+    stat_add("tune.table.hits")
+    return dict(dims)

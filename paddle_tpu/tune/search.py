@@ -1,0 +1,265 @@
+"""Contract-gated candidate search for the Pallas kernels (ISSUE 14).
+
+The search space is DECLARED, not guessed: each :class:`KernelContract`
+carries ``sweep`` axes (symbol -> candidate values) next to the default
+``dims`` it would override.  Enumeration is the cartesian product of
+those axes; every candidate is gated through
+``replace(contract, dims=..., shape_buckets=<target bucket>).validate()``
+BEFORE it is ever compiled — the same lane/sublane floors, bucket
+divisibility and static VMEM estimate the ``pallas-contract`` lint
+(PC001–PC004) applies to the defaults prune the search space for free
+(an invalid candidate never costs a compile, let alone a mis-tiled run).
+
+Measurement (:func:`sweep_kernel`): the survivor configs run through a
+per-kernel *runner* (``tune.runners``) under a ``profiled_jit`` named
+``tune.<kernel>`` — compile time and cost_analysis land in the
+process-wide ``cost_registry`` — and are timed as a min-of-N wall
+clock.  Correctness is checked against the DEFAULT config's output;
+with the default tolerance of 0.0 a winner must be output-IDENTICAL to
+the config it replaces (candidates that reorder float accumulation and
+drift are rejected and counted, not silently accepted).
+
+Shape buckets: a tuned config is only trusted for the bucket it was
+measured at.  :func:`shape_bucket` canonicalizes runtime extents by
+rounding each swept/bucketed symbol UP to its contract-DEFAULT multiple
+— stable regardless of which tuned config later serves the bucket, so
+lookup and sweep agree on the key by construction.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field, replace
+from typing import Callable, Dict, List, Mapping, Optional, Tuple
+
+import numpy as np
+
+from ..framework.monitor import stat_add
+from ..ops.pallas_ops.contracts import CONTRACTS, KernelContract
+from .table import TuningTable
+
+__all__ = ["shape_bucket", "bucket_key", "candidate_contract",
+           "enumerate_candidates", "sweep_kernel", "CandidateResult",
+           "SweepReport"]
+
+
+def shape_bucket(contract: KernelContract,
+                 extents: Mapping[str, int]) -> Dict[str, int]:
+    """Round each extent UP to the contract-default multiple of its
+    symbol — the canonical bucket the table keys on."""
+    out: Dict[str, int] = {}
+    for sym in sorted(extents):
+        v = int(extents[sym])
+        default = contract.dim(sym)
+        out[sym] = max(default, -(-v // default) * default)
+    return out
+
+
+def bucket_key(contract: KernelContract,
+               extents: Mapping[str, int]) -> str:
+    return ",".join(f"{s}={v}"
+                    for s, v in shape_bucket(contract, extents).items())
+
+
+def candidate_contract(contract: KernelContract,
+                       choice: Mapping[str, int],
+                       bucket: Mapping[str, int]) -> KernelContract:
+    """The contract as it would run with ``choice`` swapped in at
+    ``bucket``: bucket extents overlay the non-swept dims they bind
+    (e.g. the paged kernel's full-extent ``heads``/``head_dim`` blocks),
+    the sweep choice overlays its axes, and ``shape_buckets`` narrows to
+    exactly the target bucket — ``validate()`` then answers "is this
+    config legal for THESE shapes"."""
+    dims = dict(contract.dims)
+    for sym, v in bucket.items():
+        if sym in dims and sym not in contract.sweep:
+            dims[sym] = int(v)
+    dims.update({k: int(v) for k, v in choice.items()})
+    buckets = {sym: (int(v),) for sym, v in bucket.items()
+               if sym in contract.shape_buckets}
+    return replace(contract, dims=dims, shape_buckets=buckets)
+
+
+def enumerate_candidates(contract: KernelContract,
+                         bucket: Mapping[str, int]
+                         ) -> Tuple[List[Dict[str, int]],
+                                    List[Tuple[Dict[str, int],
+                                               List[str]]]]:
+    """(valid, rejected) candidate ``sweep`` choices for ``bucket``.
+
+    The DEFAULT choice (the contract's own dims restricted to the sweep
+    axes) enumerates first — the search space always contains the
+    config it is trying to beat.  ``rejected`` pairs each pruned choice
+    with its ``validate()`` violations (the tests exercise every rule
+    as a rejection)."""
+    for sym in contract.sweep:
+        if sym not in contract.dims:
+            raise ValueError(
+                f"contract {contract.name!r}: sweep axis {sym!r} is not "
+                "bound in dims — the default config must be a member of "
+                "its own search space")
+    axes = sorted(contract.sweep)
+    default = {sym: contract.dim(sym) for sym in axes}
+    choices = [default]
+    for combo in itertools.product(*(contract.sweep[s] for s in axes)):
+        choice = dict(zip(axes, (int(v) for v in combo)))
+        if choice != default:
+            choices.append(choice)
+    valid: List[Dict[str, int]] = []
+    rejected: List[Tuple[Dict[str, int], List[str]]] = []
+    for choice in choices:
+        violations = candidate_contract(contract, choice,
+                                        bucket).validate()
+        if violations:
+            rejected.append((choice, violations))
+        else:
+            valid.append(choice)
+    return valid, rejected
+
+
+@dataclass
+class CandidateResult:
+    choice: Dict[str, int]
+    wall_ms: Optional[float] = None
+    parity_ok: Optional[bool] = None
+    max_abs_diff: Optional[float] = None
+    rejected: Optional[str] = None      # prune/parity/error reason
+
+    @property
+    def measured(self) -> bool:
+        return self.wall_ms is not None and self.rejected is None
+
+
+@dataclass
+class SweepReport:
+    kernel: str
+    bucket: str
+    dtype: str
+    platform: str
+    results: List[CandidateResult] = field(default_factory=list)
+    winner: Optional[CandidateResult] = None
+    default_ms: Optional[float] = None
+    repeats: int = 0
+
+    @property
+    def speedup_x(self) -> float:
+        if not self.winner or not self.default_ms or not self.winner.wall_ms:
+            return 1.0
+        return self.default_ms / self.winner.wall_ms
+
+
+def _time_min_of_n(fn: Callable[[], object], repeats: int,
+                   timer: Callable[[], float]) -> float:
+    best = None
+    for _ in range(max(1, repeats)):
+        t0 = timer()
+        out = fn()
+        # jax arrays: wait for the device before reading the clock
+        getattr(out, "block_until_ready", lambda: None)()
+        dt = (timer() - t0) * 1e3
+        best = dt if best is None else min(best, dt)
+    return float(best)
+
+
+def sweep_kernel(contract_or_name, extents: Mapping[str, int], *,
+                 dtype: str = "float32", repeats: int = 3,
+                 atol: float = 0.0, timer: Callable[[], float] =
+                 time.perf_counter, runner=None,
+                 table: Optional[TuningTable] = None,
+                 platform: Optional[str] = None) -> SweepReport:
+    """One full contract-gated sweep at one shape bucket.
+
+    1. enumerate sweep choices, prune through ``validate()``;
+    2. run the DEFAULT config once for the reference output and its
+       min-of-N wall clock;
+    3. run every surviving candidate; reject any whose output differs
+       from the default's by more than ``atol`` (0.0 = bit-identical);
+    4. pick the fastest survivor (ties: first in enumeration order —
+       deterministic) and, when ``table`` is given, record it.
+
+    ``timer`` is injectable so the winner-selection tests run against a
+    scripted clock; ``runner`` overrides the registered per-kernel
+    runner (tests use toy callables)."""
+    contract = (contract_or_name
+                if isinstance(contract_or_name, KernelContract)
+                else CONTRACTS[contract_or_name])
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    bucket = shape_bucket(contract, extents)
+    bkey = bucket_key(contract, extents)
+    report = SweepReport(kernel=contract.name, bucket=bkey, dtype=dtype,
+                         platform=platform, repeats=int(repeats))
+    valid, rejected = enumerate_candidates(contract, bucket)
+    stat_add("tune.sweep.candidates", len(valid) + len(rejected))
+    stat_add("tune.sweep.pruned", len(rejected))
+    for choice, violations in rejected:
+        report.results.append(CandidateResult(
+            choice, rejected="validate: " + "; ".join(violations)))
+    default = {sym: contract.dim(sym) for sym in sorted(contract.sweep)}
+    if not valid or valid[0] != default:
+        raise ValueError(
+            f"contract {contract.name!r}: the DEFAULT config fails "
+            f"validate() at bucket {bkey!r} — nothing to tune against "
+            f"({rejected[0][1] if rejected else 'no candidates'})")
+    if runner is None:
+        from .runners import runner_for
+
+        runner = runner_for(contract.name)
+    run = runner(contract, bucket, dtype)
+
+    default_choice = valid[0]
+    ref = np.asarray(run(default_choice))
+    default_ms = _time_min_of_n(lambda: run(default_choice), repeats,
+                                timer)
+    report.default_ms = default_ms
+    default_res = CandidateResult(default_choice, wall_ms=default_ms,
+                                  parity_ok=True, max_abs_diff=0.0)
+    report.results.append(default_res)
+    stat_add("tune.sweep.measured", 1)
+
+    best = default_res
+    for choice in valid[1:]:
+        res = CandidateResult(choice)
+        report.results.append(res)
+        try:
+            out = np.asarray(run(choice))
+        except Exception as e:  # noqa: BLE001 — a candidate that fails
+            # to compile/run is rejected, never fatal to the sweep
+            res.rejected = f"error: {type(e).__name__}: {e}"
+            stat_add("tune.sweep.errors", 1)
+            continue
+        if out.shape != ref.shape or out.dtype != ref.dtype:
+            res.parity_ok = False
+            res.rejected = (f"parity: shape/dtype drift {out.shape} "
+                            f"{out.dtype} vs {ref.shape} {ref.dtype}")
+            stat_add("tune.sweep.parity_rejects", 1)
+            continue
+        diff = float(np.max(np.abs(out.astype(np.float64)
+                                   - ref.astype(np.float64)))) \
+            if out.size else 0.0
+        res.max_abs_diff = diff
+        res.parity_ok = diff <= atol
+        if not res.parity_ok:
+            res.rejected = (f"parity: max |Δ| {diff:g} exceeds atol "
+                            f"{atol:g} vs the default-config output")
+            stat_add("tune.sweep.parity_rejects", 1)
+            continue
+        res.wall_ms = _time_min_of_n(lambda c=choice: run(c), repeats,
+                                     timer)
+        stat_add("tune.sweep.measured", 1)
+        if res.wall_ms < best.wall_ms:
+            best = res
+    report.winner = best
+    if table is not None:
+        table.put(contract.name, bkey, dtype, platform,
+                  dims=best.choice,
+                  is_default=(best is default_res),
+                  best_ms=round(best.wall_ms, 6),
+                  default_ms=round(default_ms, 6),
+                  speedup_x=round(report.speedup_x, 4),
+                  repeats=int(repeats),
+                  candidates=len(valid) + len(rejected),
+                  pruned=len(rejected))
+    return report

@@ -1,0 +1,208 @@
+"""Persistent, versioned kernel tuning table (ISSUE 14 tentpole).
+
+One file holds every tuned kernel config the sweep harness accepted:
+``{kernel, shape-bucket, dtype, platform} -> dims`` plus the
+measurements that justified the choice.  The on-disk format follows the
+``CheckpointStore`` discipline (docs/CHECKPOINT.md):
+
+    file := MAGIC (8 bytes, b"PTTUNE1\\n")
+          | manifest length (4 bytes, big-endian)
+          | manifest JSON   (schema version, payload CRC32, entry count)
+          | payload JSON    (the entries, human-debuggable)
+
+and every commit goes through ``framework_io.atomic_write_bytes`` —
+temp in the same directory + fsync + ``os.replace`` — carrying the
+deterministic ``ckpt.write`` chaos sites, so a kill mid-save can never
+corrupt a previously committed table.
+
+Failure semantics are asymmetric by design:
+
+- the STRICT readers (:meth:`TuningTable.load`, the ``verify`` CLI)
+  raise typed :class:`TuningTableCorruptError` /
+  :class:`TuningTableIncompatibleError`;
+- the RUNTIME reader (:func:`TuningTable.load_or_default`, used by the
+  kernel lookup seam in ``tune.runtime``) NEVER raises on a bad table —
+  a corrupt or newer-schema file degrades to the contract-default
+  configs (counted as ``tune.table.fallbacks``), because a serving
+  process must not refuse to start, and must never run a config nobody
+  validated, over a damaged cache of measurements.
+"""
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Dict, Iterator, Optional, Tuple
+
+from ..framework.errors import (TuningTableCorruptError,
+                                TuningTableIncompatibleError)
+from ..framework_io import atomic_write_bytes
+
+__all__ = ["TuningTable", "TUNE_SCHEMA_VERSION", "entry_key"]
+
+TUNE_SCHEMA_VERSION = 1
+_MAGIC = b"PTTUNE1\n"
+
+
+def entry_key(kernel: str, bucket: str, dtype: str, platform: str) -> str:
+    """Canonical table key.  ``bucket`` is the canonical shape-bucket
+    string from :func:`tune.search.bucket_key` (extents rounded up to
+    the contract-default block multiples — stable regardless of which
+    tuned config later serves the bucket)."""
+    for part, label in ((kernel, "kernel"), (bucket, "bucket"),
+                        (dtype, "dtype"), (platform, "platform")):
+        if "|" in part:
+            raise ValueError(f"{label} {part!r} may not contain '|'")
+    return f"{kernel}|{bucket}|{dtype}|{platform}"
+
+
+class TuningTable:
+    """In-memory view of the tuning table + the atomic commit path.
+
+    Entries map :func:`entry_key` strings to plain dicts::
+
+        {"dims": {sym: int, ...},      # the winning config
+         "is_default": bool,           # winner == contract default?
+         "best_ms": float, "default_ms": float, "speedup_x": float,
+         "repeats": int, "candidates": int, "pruned": int,
+         "schema": TUNE_SCHEMA_VERSION}
+
+    Only ``dims`` is load-bearing for kernel resolution; the rest is
+    the audit trail ``show``/``verify`` and the bench report read.
+    """
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._entries: Dict[str, dict] = {}
+        self.fallback_reason: Optional[str] = None
+
+    # --- mutation ----------------------------------------------------------
+    def put(self, kernel: str, bucket: str, dtype: str, platform: str,
+            dims: Dict[str, int], **stats) -> str:
+        key = entry_key(kernel, bucket, dtype, platform)
+        entry = {"dims": {str(k): int(v) for k, v in dims.items()},
+                 "schema": TUNE_SCHEMA_VERSION}
+        entry.update(stats)
+        self._entries[key] = entry
+        return key
+
+    # --- reads -------------------------------------------------------------
+    def get(self, kernel: str, bucket: str, dtype: str,
+            platform: str) -> Optional[dict]:
+        return self._entries.get(entry_key(kernel, bucket, dtype,
+                                           platform))
+
+    def entries(self) -> Iterator[Tuple[str, dict]]:
+        return iter(sorted(self._entries.items()))
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __bool__(self) -> bool:          # an EMPTY table is still a table
+        return True
+
+    # --- persistence -------------------------------------------------------
+    def _encode(self) -> bytes:
+        payload = json.dumps(self._entries, sort_keys=True,
+                             separators=(",", ":")).encode()
+        manifest = json.dumps({
+            "schema": TUNE_SCHEMA_VERSION,
+            "crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+            "entries": len(self._entries),
+        }, sort_keys=True).encode()
+        return (_MAGIC + len(manifest).to_bytes(4, "big") + manifest
+                + payload)
+
+    def save(self, path: Optional[str] = None) -> str:
+        """Atomically commit the table.  A crash anywhere inside leaves
+        the previous file untouched (``ckpt.write`` chaos sites apply —
+        the tests kill at ``temp`` and ``rename``)."""
+        path = path or self.path
+        if not path:
+            raise ValueError("TuningTable.save needs a path (none bound)")
+        atomic_write_bytes(path, self._encode())
+        self.path = path
+        return path
+
+    @classmethod
+    def _decode(cls, blob: bytes, origin: str) -> Dict[str, dict]:
+        if len(blob) < len(_MAGIC) + 4 or not blob.startswith(_MAGIC):
+            raise TuningTableCorruptError(
+                f"{origin}: bad magic / truncated header — not a tuning "
+                "table (or a torn write)")
+        mlen = int.from_bytes(blob[len(_MAGIC): len(_MAGIC) + 4], "big")
+        mstart = len(_MAGIC) + 4
+        if len(blob) < mstart + mlen:
+            raise TuningTableCorruptError(
+                f"{origin}: truncated manifest ({mlen} bytes declared)")
+        try:
+            manifest = json.loads(blob[mstart: mstart + mlen])
+        except ValueError as e:
+            raise TuningTableCorruptError(
+                f"{origin}: manifest is not valid JSON ({e})") from e
+        # the manifest is NOT covered by the payload CRC — validate its
+        # shape explicitly so a hand-mangled manifest stays a TYPED
+        # corruption (the soft loader's never-raise contract rests on
+        # every failure here being one of the two table error classes)
+        if not isinstance(manifest, dict) \
+                or not isinstance(manifest.get("schema"), int):
+            raise TuningTableCorruptError(
+                f"{origin}: manifest missing an integer schema field")
+        schema = manifest["schema"]
+        if schema > TUNE_SCHEMA_VERSION:
+            raise TuningTableIncompatibleError(
+                f"{origin}: table schema {schema} is newer than this "
+                f"build's {TUNE_SCHEMA_VERSION} — refusing a lossy "
+                "reinterpretation")
+        payload = blob[mstart + mlen:]
+        crc = zlib.crc32(payload) & 0xFFFFFFFF
+        if crc != manifest.get("crc32"):
+            raise TuningTableCorruptError(
+                f"{origin}: payload CRC mismatch (stored "
+                f"{manifest.get('crc32')}, computed {crc})")
+        try:
+            entries = json.loads(payload)
+        except ValueError as e:
+            raise TuningTableCorruptError(
+                f"{origin}: payload is not valid JSON ({e})") from e
+        if not isinstance(entries, dict) or not all(
+                isinstance(v, dict) for v in entries.values()):
+            raise TuningTableCorruptError(
+                f"{origin}: payload is not an entry mapping")
+        return entries
+
+    @classmethod
+    def load(cls, path: str) -> "TuningTable":
+        """STRICT load: raises typed errors on any integrity or schema
+        problem (the ``verify`` CLI path)."""
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError as e:
+            raise TuningTableCorruptError(
+                f"{path}: unreadable ({e})") from e
+        t = cls(path)
+        t._entries = cls._decode(blob, path)
+        return t
+
+    @classmethod
+    def load_or_default(cls, path: Optional[str]
+                        ) -> Tuple["TuningTable", Optional[str]]:
+        """SOFT load for the kernel-resolution seam: any problem —
+        missing file, torn write, CRC mismatch, newer schema — yields
+        an EMPTY table plus the reason, so every lookup falls through
+        to the contract defaults.  Never raises."""
+        if not path:
+            return cls(None), None
+        if not os.path.exists(path):
+            t = cls(path)
+            t.fallback_reason = "missing"
+            return t, "missing"
+        try:
+            return cls.load(path), None
+        except (TuningTableCorruptError,
+                TuningTableIncompatibleError) as e:
+            t = cls(path)
+            reason = f"{type(e).__name__}: {e}"
+            t.fallback_reason = reason
+            return t, reason

@@ -39,6 +39,54 @@ def _seed():
 
 
 @pytest.fixture(scope="session")
+def greedy_ref_memo():
+    """SESSION-scoped ``generate()`` reference memo (ISSUE 14 suite
+    health, extending test_numeric_guards' module-level memo of ISSUE
+    13 to every serving byte-identity module).  Each ``generate()``
+    call builds — and XLA-compiles — a fresh dense decode closure, so
+    every repeated (model, prompt, budget, end_id) reference costs a
+    full compile; the serving modules re-derive the same greedy refs
+    across tests (and, via ``shared_gpt_small``, across modules).  The
+    memo pays each distinct reference ONCE per suite.
+
+    Returns ``ref(model, input_ids, max_new_tokens, end_id=0,
+    quant=None, quant_key=None)`` -> the UNTRUNCATED [T] (1-D input)
+    or [B, T] token array, a defensive copy.  EOS truncation stays at
+    the call sites (it is per-consumer policy, not part of the
+    reference).  ``quant=`` references must pass a stable
+    ``quant_key`` naming the export; keys are scoped per MODEL via a
+    WeakKeyDictionary, so id-reuse of a collected private model can
+    never alias another model's streams."""
+    import weakref
+
+    from paddle_tpu.text.generation import generate
+
+    caches = weakref.WeakKeyDictionary()
+
+    def ref(model, input_ids, max_new_tokens, end_id=0, quant=None,
+            quant_key=None):
+        ids = np.asarray(input_ids, np.int32)
+        squeeze = ids.ndim == 1
+        if squeeze:
+            ids = ids[None, :]
+        if quant is not None and quant_key is None:
+            raise ValueError(
+                "quant= references need a stable quant_key to memoize")
+        cache = caches.setdefault(model, {})
+        key = (ids.shape, ids.tobytes(), int(max_new_tokens),
+               int(end_id), quant_key)
+        if key not in cache:
+            out, _ = generate(model, ids,
+                              max_new_tokens=max_new_tokens,
+                              end_id=end_id, quant=quant)
+            cache[key] = np.asarray(out._value)
+        out = cache[key]
+        return out[0].copy() if squeeze else out.copy()
+
+    return ref
+
+
+@pytest.fixture(scope="session")
 def shared_gpt_small():
     """ONE tiny GPT for the serving-stack test modules (ISSUE 11 suite
     health).  Seven modules (serving / async / abort / frontend /

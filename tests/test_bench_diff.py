@@ -190,6 +190,32 @@ class TestFailOnRegression:
         # split (regression guard for the "skipped" reclassification)
         assert not bench_diff.lower_is_better(
             "detail.prefix_cache.rates.rate05.prefill_tokens_skipped")
+        # kernel autotuner section (ISSUE 14): speedups / tuned-arm
+        # throughput / table hits gate DOWNWARD, kernel times / table
+        # fallbacks / invalid rows / parity rejects gate UPWARD
+        assert not bench_diff.lower_is_better(
+            "detail.autotune.sweeps.quantized_matmul.b.speedup_x")
+        assert not bench_diff.lower_is_better("detail.autotune.value")
+        assert not bench_diff.lower_is_better(
+            "detail.autotune.decode_on.tokens_per_sec")
+        assert not bench_diff.lower_is_better(
+            "detail.autotune.decode_on.table_hits")
+        assert not bench_diff.lower_is_better("tune.table.hits")
+        assert bench_diff.lower_is_better("tune.table.fallbacks")
+        assert bench_diff.lower_is_better("tune.table.invalid")
+        assert bench_diff.lower_is_better("detail.autotune.fallbacks")
+        assert bench_diff.lower_is_better(
+            "detail.autotune.sweeps.quantized_matmul.b.sweep_rejects")
+        # "tuned" (a counter/arm label) is higher-better WITHOUT
+        # swallowing the section name: "autotune." must not match the
+        # fragment, so plain kernel times under it still gate upward
+        assert not bench_diff.lower_is_better("detail.tuned_configs")
+        assert bench_diff.lower_is_better(
+            "detail.autotune.sweeps.quantized_matmul.b.default_ms")
+        assert bench_diff.lower_is_better(
+            "detail.autotune.sweeps.quantized_matmul.b.best_ms")
+        assert bench_diff.lower_is_better(
+            "detail.autotune.decode_on.mean_ttft_ms")
 
     def test_reduction_ratio_gates_on_drop_not_rise(self):
         """The PR-4 acceptance metric: kv_bytes_reduction_x falling

@@ -41,6 +41,26 @@ class TestContractRegistry:
         paged = CONTRACTS["paged_attention_decode"]
         assert paged.dim("head_align") == 8
         assert paged.dim("lane") == 128
+        # the int8 epilogue axis (ISSUE 14) defaults to the historical
+        # fused form — scale multiplies folded AFTER the dots
+        assert CONTRACTS["paged_attention_decode_int8"].dim(
+            "fused_dequant") == 1
+
+    def test_sweep_axes_bind_dims_and_default_is_a_member(self):
+        """The autotuner's search axes (ISSUE 14): every axis names a
+        dim the default config binds, every declared candidate value is
+        an int, and the default value appears on its own axis — the
+        config being tuned is always a member of the search space."""
+        swept = {n for n, c in CONTRACTS.items() if c.sweep}
+        assert swept == {"flash_attention_fwd",
+                         "paged_attention_decode",
+                         "paged_attention_decode_int8",
+                         "quantized_matmul"}
+        for name, c in CONTRACTS.items():
+            for sym, values in c.sweep.items():
+                assert sym in c.dims, (name, sym)
+                assert all(isinstance(v, int) for v in values)
+                assert c.dim(sym) in values, (name, sym)
 
     def test_kernel_modules_read_the_contract(self):
         from paddle_tpu.ops.pallas_ops import (flash_attention,

@@ -32,7 +32,6 @@ from paddle_tpu.serving import (PagedKVCache, PrefixCache, ServingEngine,
 from paddle_tpu.serving.router import DEAD
 from paddle_tpu.testing import chaos
 from paddle_tpu.testing.chaos import ChaosPlan, Fault
-from paddle_tpu.text.generation import generate
 
 VOCAB, HID, LAYERS, HEADS = 50, 32, 2, 2
 
@@ -55,12 +54,23 @@ def gpt(shared_gpt_small):
     return shared_gpt_small
 
 
-def _reference(gpt, prompt, budget):
-    want, _ = generate(gpt, np.asarray(prompt, np.int32)[None, :],
-                       max_new_tokens=budget, end_id=0)
-    w = want.numpy()[0]
-    if (w == 0).any():
-        w = w[: int(np.argmax(w == 0)) + 1]
+# session-scoped generate() memo (conftest greedy_ref_memo, ISSUE 14
+# suite health): the byte-identity refs here repeat across tests and
+# consume modes — each distinct (prompt, budget, end_id) compiles once
+# per suite instead of once per call
+_MEMO = None
+
+
+@pytest.fixture(autouse=True)
+def _bind_ref_memo(greedy_ref_memo):
+    global _MEMO
+    _MEMO = greedy_ref_memo
+
+
+def _reference(gpt, prompt, budget, end_id=0):
+    w = _MEMO(gpt, prompt, budget, end_id=end_id)
+    if end_id >= 0 and (w == end_id).any():
+        w = w[: int(np.argmax(w == end_id)) + 1]
     return w
 
 
@@ -363,9 +373,8 @@ class TestPrefillSkip:
         outs.update(_drain(eng))
         st = eng.stats()["prefix_cache"]
         assert st["hits"] == 1 and st["hit_tokens"] == 12
-        want, _ = generate(gpt, turn2[None, :], max_new_tokens=8,
-                           end_id=-1)
-        np.testing.assert_array_equal(outs["b"], want.numpy()[0])
+        np.testing.assert_array_equal(
+            outs["b"], _reference(gpt, turn2, 8, end_id=-1))
 
     def test_per_request_opt_out_and_type_validation(self, gpt):
         rng = np.random.RandomState(9)
@@ -472,8 +481,8 @@ class TestSharedPageFailureInvariants:
         for p in shared:
             assert eng.cache.ref_count(p) == 1
         outs = _drain(eng)
-        want, _ = generate(gpt, pa[None, :], max_new_tokens=20, end_id=-1)
-        np.testing.assert_array_equal(outs["a"], want.numpy()[0])
+        np.testing.assert_array_equal(
+            outs["a"], _reference(gpt, pa, 20, end_id=-1))
         assert eng.cache.pages_in_use == 0
         _invariant(eng.cache)
 
@@ -498,8 +507,8 @@ class TestSharedPageFailureInvariants:
         eng.step()
         assert "b" in eng.take_expired()
         outs = _drain(eng)
-        want, _ = generate(gpt, pa[None, :], max_new_tokens=16, end_id=-1)
-        np.testing.assert_array_equal(outs["a"], want.numpy()[0])
+        np.testing.assert_array_equal(
+            outs["a"], _reference(gpt, pa, 16, end_id=-1))
         assert eng.cache.pages_in_use == 0
         _invariant(eng.cache)
 
@@ -548,8 +557,8 @@ class TestSharedPageFailureInvariants:
                              max_batch_size=2, eos_id=-1)
         eng2.restore(snap)
         outs2 = _drain(eng2)
-        want, _ = generate(gpt, pb[None, :], max_new_tokens=14, end_id=-1)
-        np.testing.assert_array_equal(outs2["b"], want.numpy()[0])
+        np.testing.assert_array_equal(
+            outs2["b"], _reference(gpt, pb, 14, end_id=-1))
         # restored as PRIVATE: no index consulted, every page refcount 1
         assert eng2.stats()["prefix_cache"]["hits"] == 0
         assert eng.abort("b")

@@ -27,7 +27,7 @@ from paddle_tpu.serving.kv_cache import (kv_page_bytes, quantize_kv_page,
                                          dequantize_kv_page)
 from paddle_tpu.slim import (calibrate_kv_scales, export_serving_quant,
                              quantize_gpt_weights)
-from paddle_tpu.text.generation import (generate, make_gpt_decode_step,
+from paddle_tpu.text.generation import (make_gpt_decode_step,
                                         make_gpt_paged_decode_step)
 from paddle_tpu.text.models import GPTModel
 
@@ -47,6 +47,18 @@ def quant(gpt):
     rng = np.random.RandomState(5)
     return export_serving_quant(gpt, calib_prompts=rng.randint(
         1, VOCAB, (4, 16)))
+
+
+# session-scoped generate() memo (conftest greedy_ref_memo, ISSUE 14
+# suite health); quant refs key on the module's deterministic export
+_MEMO = None
+_QUANT_KEY = "quant_serving-calib5"
+
+
+@pytest.fixture(autouse=True)
+def _bind_ref_memo(greedy_ref_memo):
+    global _MEMO
+    _MEMO = greedy_ref_memo
 
 
 class TestKVPageRoundTrip:
@@ -241,10 +253,10 @@ class TestDecodeParity:
         # see docs/SERVING.md accuracy expectations)
         rng = np.random.RandomState(0)
         ids = rng.randint(1, VOCAB, (3, 8))
-        out_fp, _ = generate(gpt, ids, max_new_tokens=8, end_id=0)
-        out_q, _ = generate(gpt, ids, max_new_tokens=8, end_id=0,
-                            quant=quant)
-        np.testing.assert_array_equal(out_fp.numpy(), out_q.numpy())
+        out_fp = _MEMO(gpt, ids, 8, end_id=0)
+        out_q = _MEMO(gpt, ids, 8, end_id=0, quant=quant,
+                      quant_key=_QUANT_KEY)
+        np.testing.assert_array_equal(out_fp, out_q)
 
     def test_dense_int8_requires_calibration(self, gpt):
         with pytest.raises(ValueError, match="calibrated kv_scales"):
@@ -311,10 +323,9 @@ class TestQuantEngineIdentity:
             # token identity with the quantized dense reference on the
             # most preemption-churned prompt-length group
             members = [i for i in range(n) if plens[i] == 9][:8]
-            want, _ = generate(gpt,
-                               np.stack([prompts[i] for i in members]),
-                               max_new_tokens=6, end_id=0, quant=quant)
-            want = want.numpy()
+            want = _MEMO(gpt, np.stack([prompts[i] for i in members]),
+                         6, end_id=0, quant=quant,
+                         quant_key=_QUANT_KEY)
             for row, i in enumerate(members):
                 w = want[row]
                 if (w == 0).any():

@@ -41,7 +41,6 @@ from paddle_tpu.serving.resilience import (BROWNOUT_CLAMP, BROWNOUT_NORMAL,
 from paddle_tpu.serving.router import DEAD, HEALTHY, SUSPECT
 from paddle_tpu.testing import chaos
 from paddle_tpu.testing.chaos import ChaosPlan, Fault
-from paddle_tpu.text.generation import generate
 
 
 @pytest.fixture(autouse=True)
@@ -80,11 +79,22 @@ def quant(gpt):
         gpt, calib_prompts=rng.randint(1, VOCAB, (4, 12)).astype(np.int32))
 
 
+# session-scoped generate() memo (conftest greedy_ref_memo, ISSUE 14
+# suite health): the failover scenarios re-derive the same greedy refs
+# across tests — each distinct reference compiles once per suite
+_MEMO = None
+_QUANT_KEY = "calib-seed3-4x12"  # identical export in resilience+spec_decode
+
+
+@pytest.fixture(autouse=True)
+def _bind_ref_memo(greedy_ref_memo):
+    global _MEMO
+    _MEMO = greedy_ref_memo
+
+
 def _reference(gpt, prompt, budget, quant=None):
-    kw = {} if quant is None else {"quant": quant}
-    want, _ = generate(gpt, np.asarray(prompt, np.int32)[None, :],
-                       max_new_tokens=budget, end_id=0, **kw)
-    w = want.numpy()[0]
+    w = _MEMO(gpt, prompt, budget, end_id=0, quant=quant,
+              quant_key=None if quant is None else _QUANT_KEY)
     if (w == 0).any():
         w = w[: int(np.argmax(w == 0)) + 1]
     return w

@@ -27,8 +27,7 @@ from paddle_tpu.serving import (NgramDrafter, ServingEngine,
                                 ServingFrontend, SpecDecoder)
 from paddle_tpu.testing import chaos
 from paddle_tpu.testing.chaos import ChaosPlan, Fault
-from paddle_tpu.text.generation import (generate,
-                                        make_gpt_paged_decode_step,
+from paddle_tpu.text.generation import (make_gpt_paged_decode_step,
                                         make_gpt_paged_spec_verify_step)
 
 VOCAB, HID, LAYERS, HEADS = 50, 32, 2, 2
@@ -50,10 +49,22 @@ def quant(gpt):
         gpt, calib_prompts=rng.randint(1, VOCAB, (4, 12)).astype(np.int32))
 
 
+# session-scoped generate() memo (conftest greedy_ref_memo, ISSUE 14
+# suite health): the same mixed-prompt refs repeat across the consume
+# modes and KV dtypes — each distinct reference compiles once per suite
+_MEMO = None
+_QUANT_KEY = "calib-seed3-4x12"  # identical export in resilience+spec_decode
+
+
+@pytest.fixture(autouse=True)
+def _bind_ref_memo(greedy_ref_memo):
+    global _MEMO
+    _MEMO = greedy_ref_memo
+
+
 def _reference(gpt, prompt, budget, quant=None):
-    want, _ = generate(gpt, np.asarray(prompt)[None, :],
-                       max_new_tokens=budget, end_id=0, quant=quant)
-    w = want.numpy()[0]
+    w = _MEMO(gpt, prompt, budget, end_id=0, quant=quant,
+              quant_key=None if quant is None else _QUANT_KEY)
     if (w == 0).any():
         w = w[: int(np.argmax(w == 0)) + 1]
     return w
@@ -401,9 +412,7 @@ class TestFailover:
         rng = np.random.RandomState(2)
         prompt = np.tile(rng.randint(1, VOCAB, (4,)).astype(np.int32), 4)
         budget = 18
-        want, _ = generate(gpt, prompt[None, :], max_new_tokens=budget,
-                           end_id=-1)
-        want = want.numpy()[0]
+        want = _MEMO(gpt, prompt, budget, end_id=-1)
 
         class OracleDrafter(NgramDrafter):
             """Deterministic always-right drafts from the precomputed

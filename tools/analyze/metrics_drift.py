@@ -4,10 +4,12 @@ An operator dashboards against documented names; a metric the code emits
 but the doc omits is invisible operational surface, and a name the doc
 promises but nothing emits is a dashboard that will silently stay flat.
 The checker keeps the two sets equal for the ``serving.*`` / ``hapi.*``
-/ ``train.*`` / ``recorder.*`` families (``train.*`` is the
-training-resilience family added by ISSUE 9 — checkpoint/resume
+/ ``train.*`` / ``recorder.*`` / ``tune.*`` families (``train.*`` is
+the training-resilience family added by ISSUE 9 — checkpoint/resume
 accounting; ``recorder.*`` and the ``serving.trace.*`` sub-family are
-the flight-recorder / request-tracing surface added by ISSUE 11):
+the flight-recorder / request-tracing surface added by ISSUE 11;
+``tune.*`` is the kernel-autotuner family added by ISSUE 14 — sweep
+and tuning-table accounting):
 
 - CODE side: string literals passed to the StatRegistry surface
   (``stat_registry.get/histogram``, ``stat_add``/``stat_get``,
@@ -18,7 +20,8 @@ the flight-recorder / request-tracing surface added by ISSUE 11):
   ``t.hammer.counter`` is not operational surface (and the prefix
   filter drops such names anyway).
 - DOC side: backtick-quoted names in docs/OBSERVABILITY.md matching
-  ``^(serving|hapi|train|recorder)(\\.[a-z0-9_]+)+$``.  Two doc shorthands are
+  ``^(serving|hapi|train|recorder|tune)(\\.[a-z0-9_]+)+$``.  Two doc
+  shorthands are
   expanded: braces (```serving.{snapshots,restores}``` → two names) and
   leading-dot continuations (```serving.frontend.submitted``` followed
   by ```.completed``` → ``serving.frontend.completed``).
@@ -40,8 +43,9 @@ from .core import AnalysisContext, Finding, register, unparse
 CODE_ROOTS = ("paddle_tpu",)
 DOC_PATH = "docs/OBSERVABILITY.md"
 
-_PREFIXES = ("serving.", "hapi.", "train.", "recorder.")
-_NAME_RE = re.compile(r"^(serving|hapi|train|recorder)(\.[a-z0-9_]+)+$")
+_PREFIXES = ("serving.", "hapi.", "train.", "recorder.", "tune.")
+_NAME_RE = re.compile(
+    r"^(serving|hapi|train|recorder|tune)(\.[a-z0-9_]+)+$")
 _REGISTRY_FUNCS = frozenset({
     "stat_registry.get", "stat_registry.histogram", "stat_add",
     "stat_get", "histogram_observe", "histogram_snapshot", "gauge_set",

@@ -23,9 +23,14 @@ Codes:
 - **PC005** — contract/call-site drift: a contract that is not a pure
   literal (the lint cannot verify what it cannot read), a contract
   naming a kernel module that does not exist or does not import the
-  contracts module, or a ``block_*`` parameter default / module-level
+  contracts module, a ``block_*`` parameter default / module-level
   ``*BLOCK*`` constant written as a raw integer literal in a governed
-  kernel module instead of reading the contract.
+  kernel module instead of reading the contract, or an autotuner
+  ``sweep`` axis (ISSUE 14) naming a symbol the default ``dims`` does
+  not bind.  The tuning-table resolution seam itself is clean by
+  construction: kernels resolve swappable dims through
+  ``tune.runtime.lookup_dims`` with ``None``-defaulted parameters, so
+  no raw literal re-enters a governed module.
 
 Waivers declared in-contract (``BlockDecl(..., waivers=("sublane: why",
 ...))``) suppress their rule with the reason on record — the
@@ -161,6 +166,7 @@ def extract_contracts(ctx: AnalysisContext, rel: str
                 con.setdefault("double_buffered", True)
                 con.setdefault("platform", "tpu")
                 con.setdefault("vmem_budget_bytes", DEFAULT_VMEM_BUDGET)
+                con.setdefault("sweep", {})
                 contracts.append(con)
             else:
                 try:
@@ -224,6 +230,17 @@ def _check_contract(rel: str, con: Dict[str, Any],
         mult = 2 if (con.get("double_buffered", True)
                      and decl.get("kind") in ("in", "out")) else 1
         vmem_total += mult * n * DTYPE_BYTES.get(dtype, 4)
+    for sym in con.get("sweep", {}):
+        # the autotuner's declared search axes (ISSUE 14) must name
+        # dims the default config binds — otherwise the default is not
+        # a member of its own search space and the runtime twin
+        # (tune.search.enumerate_candidates) would refuse the sweep
+        if not isinstance(con.get("dims", {}).get(sym), int):
+            findings.append(Finding(
+                rel, con["__line__"], "PC005", CHECK,
+                f"contract {cname!r}: sweep axis {sym!r} has no "
+                "integer binding in dims — the default config must be "
+                "a member of its own search space"))
     for sym, buckets in con.get("shape_buckets", {}).items():
         size = con.get("dims", {}).get(sym)
         if not isinstance(size, int):

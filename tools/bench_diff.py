@@ -139,6 +139,14 @@ _HIGHER_BETTER = ("reduction", "per_sec", "per_second", "goodput",
                   # lower-better "_rate" fragment; "drafted" measures
                   # how much speculation even engages)
                   "accept", "drafted",
+                  # kernel autotuner (ISSUE 14): tuned-config counts /
+                  # ratios falling round-over-round mean the table is
+                  # winning less ("tuned" is NOT a substring of the
+                  # detail.autotune section path — the dot separates
+                  # "autotune" from what follows — so plain _ms times
+                  # under it still gate upward; pinned in
+                  # tests/test_bench_diff.py)
+                  "tuned",
                   "_x")
 # name fragments marking metrics where SMALLER is better (latencies,
 # misses, memory, churn, compile counts — a compile_count drifting up
@@ -169,7 +177,15 @@ _LOWER_BETTER = ("_ms", "latency", "ttft", "e2e", "gap", "miss", "bytes",
                  # rising round-over-round means the stack is healing
                  # more, i.e. numerically worse ("tokens_skipped", the
                  # prefix-cache win, outranks "skipped" above)
-                 "skipped", "spike", "quarantine", "nan", "corrupt")
+                 "skipped", "spike", "quarantine", "nan", "corrupt",
+                 # kernel autotuner (ISSUE 14): table fallbacks (corrupt
+                 # /stale tables degrading to contract defaults) and
+                 # invalid rows rising round-over-round mean the tuning
+                 # surface is decaying (parity rejections surface as
+                 # "sweep_rejects" — the pre-existing "reject" fragment
+                 # covers them; a bare "parity_rejects" path would trip
+                 # the higher-better "parity" fragment instead)
+                 "fallback", "invalid")
 
 
 def lower_is_better(metric: str) -> bool:

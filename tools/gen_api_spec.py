@@ -49,6 +49,9 @@ PUBLIC_MODULES = [
     # the declared Pallas kernel contracts (ISSUE 8): pure-stdlib, the
     # surface the pallas-contract lint and the autotuner program against
     "paddle_tpu.ops.pallas_ops.contracts",
+    # the kernel autotuner (ISSUE 14): sweep harness, tuning table and
+    # the kernel-side resolution seam
+    "paddle_tpu.tune",
     # repo tooling with a stable, test-pinned surface (ISSUE 7): the
     # AST lint suite other tooling may drive in-process
     "tools.analyze",
