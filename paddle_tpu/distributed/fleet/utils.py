@@ -10,7 +10,7 @@ import subprocess
 class LocalFS:
     def ls_dir(self, path):
         dirs, files = [], []
-        for name in os.listdir(path):
+        for name in sorted(os.listdir(path)):
             (dirs if os.path.isdir(os.path.join(path, name)) else files).append(name)
         return dirs, files
 

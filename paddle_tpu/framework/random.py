@@ -13,6 +13,7 @@ stateful facade:
 """
 from __future__ import annotations
 
+import random as _stdlib_random
 import threading
 from typing import Optional
 
@@ -62,13 +63,27 @@ class Generator:
         return self._seed
 
 
-default_generator = Generator(np.random.randint(0, 2**31 - 1))
+# the one sanctioned entropy source: the process-startup seed itself
+# must be fresh; every draw after this point rides the seeded generators
+default_generator = Generator(
+    np.random.randint(0, 2**31 - 1))  # analyze: allow[determinism] startup seed entropy
+
+# explicit stdlib generator for host-side data augmentation (vision
+# transforms): ``paddle_tpu.seed()`` reseeds it, so stdlib-random
+# augmentation replays — ambient ``random.*`` module draws never would
+# (the module-level stream is invisible to seed() and to checkpoints)
+py_random = _stdlib_random.Random()
 
 
 def seed(value: int):
-    """paddle.seed parity: seeds the global generator (and numpy for data aug)."""
+    """paddle.seed parity: seeds the global generator (and the numpy +
+    stdlib data-augmentation generators)."""
     default_generator.manual_seed(int(value))
-    np.random.seed(int(value) % (2**32))
+    # seeding the ambient numpy stream IS the sanctioned data-order
+    # source: samplers draw from it and hapi checkpoints snapshot/
+    # restore it for exact resume
+    np.random.seed(int(value) % (2**32))  # analyze: allow[determinism] the seeding facade itself
+    py_random.seed(int(value))
     return default_generator
 
 

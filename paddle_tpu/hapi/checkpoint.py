@@ -45,7 +45,7 @@ from ..framework.concurrency import OrderedCondition
 from ..framework.errors import (CheckpointIncompatibleError,
                                 ExecutionTimeoutError)
 from ..framework.monitor import gauge_set, histogram_observe, stat_add
-from ..framework.random import default_generator
+from ..framework.random import default_generator, py_random
 from ..io.checkpoint import CheckpointStore
 
 __all__ = ["TRAIN_STATE_SCHEMA", "capture_train_state",
@@ -78,7 +78,8 @@ def _tree_to_device(tree):
 
 def capture_train_state(model, *, global_step: int, epoch: int = 0,
                         next_batch: int = 0,
-                        np_state_epoch_start=None) -> Dict[str, Any]:
+                        np_state_epoch_start=None,
+                        py_state_epoch_start=None) -> Dict[str, Any]:
     """Snapshot everything a bit-exact resume of ``model`` needs, as a
     host tree of numpy leaves (CheckpointStore-serializable).
 
@@ -96,12 +97,19 @@ def capture_train_state(model, *, global_step: int, epoch: int = 0,
         "global_step": int(global_step),
         "rng": default_generator.state_dict(),
         "np_random": np.random.get_state(),
+        # the sanctioned stdlib stream (vision-transform augmentation,
+        # ISSUE 15 DT001 fix) resumes exactly like the numpy stream:
+        # mid state here, epoch-start state in the loader leaf
+        "py_random": py_random.getstate(),
         "loader": {
             "epoch": int(epoch),
             "next_batch": int(next_batch),
             "np_state_epoch_start": (np_state_epoch_start
                                      if np_state_epoch_start is not None
                                      else np.random.get_state()),
+            "py_state_epoch_start": (py_state_epoch_start
+                                     if py_state_epoch_start is not None
+                                     else py_random.getstate()),
         },
         "optimizer_host": {
             "step_count": int(getattr(opt, "_step_count", 0)),
@@ -163,6 +171,10 @@ def restore_train_state(model, state: Dict[str, Any]) -> Dict[str, Any]:
     default_generator.set_state_dict(state["rng"])
     loader = dict(state["loader"])
     loader["np_random"] = state["np_random"]
+    # absent in pre-ISSUE-15 checkpoints: .get() keeps them loadable
+    # (the stdlib stream then simply starts fresh, as it always did)
+    loader["py_random"] = state.get("py_random")
+    loader.setdefault("py_state_epoch_start", None)
     loader["global_step"] = int(state["global_step"])
     return loader
 
@@ -229,7 +241,8 @@ class TrainCheckpointer:
         return global_step % self.interval == 0
 
     def snapshot(self, model, *, global_step: int, epoch: int,
-                 next_batch: int, np_state_epoch_start) -> None:
+                 next_batch: int, np_state_epoch_start,
+                 py_state_epoch_start=None) -> None:
         """Capture + hand off one checkpoint.  Blocks for the host copy
         (and, if BOTH writer buffers are busy, for the older write) —
         that blocking cost is the ``train.checkpoint_ms`` histogram."""
@@ -237,7 +250,8 @@ class TrainCheckpointer:
         state = capture_train_state(
             model, global_step=global_step, epoch=epoch,
             next_batch=next_batch,
-            np_state_epoch_start=np_state_epoch_start)
+            np_state_epoch_start=np_state_epoch_start,
+            py_state_epoch_start=py_state_epoch_start)
         if self.async_write:
             with self._cond:
                 if self._error is not None:
@@ -253,12 +267,14 @@ class TrainCheckpointer:
                           (time.perf_counter() - t0) * 1e3)
 
     def maybe_snapshot(self, model, *, global_step: int, epoch: int,
-                       next_batch: int, np_state_epoch_start) -> bool:
+                       next_batch: int, np_state_epoch_start,
+                       py_state_epoch_start=None) -> bool:
         if not self.due(global_step):
             return False
         self.snapshot(model, global_step=global_step, epoch=epoch,
                       next_batch=next_batch,
-                      np_state_epoch_start=np_state_epoch_start)
+                      np_state_epoch_start=np_state_epoch_start,
+                      py_state_epoch_start=py_state_epoch_start)
         return True
 
     def _write(self, state, step: int):

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.monitor import histogram_observe
-from ..framework.random import default_generator, rng_scope
+from ..framework.random import default_generator, py_random, rng_scope
 from ..jit.functional import functional_call, get_state
 from ..metric.metrics import Metric
 from ..tensor import Tensor
@@ -541,14 +541,21 @@ class Model:
                     continue            # fully covered by the checkpoint
                 skip_batches = 0
                 np_resume_mid = None
+                py_resume_mid = None
                 if resume_pos is not None and epoch == start_epoch:
                     # replay the SAME epoch permutation the killed run
                     # drew, skip the batches it already trained, then
-                    # rejoin its exact numpy-RNG stream
+                    # rejoin its exact numpy-RNG stream (and the
+                    # sanctioned stdlib stream the vision transforms
+                    # draw from — absent in pre-ISSUE-15 checkpoints)
                     np.random.set_state(
                         resume_pos["np_state_epoch_start"])
+                    if resume_pos.get("py_state_epoch_start") is not None:
+                        py_random.setstate(
+                            resume_pos["py_state_epoch_start"])
                     skip_batches = resume_pos["next_batch"]
                     np_resume_mid = resume_pos["np_random"]
+                    py_resume_mid = resume_pos.get("py_random")
                 try:
                     # one span per epoch; per-batch spans + a latency
                     # histogram nest inside it (fit > epoch > train_batch)
@@ -561,6 +568,7 @@ class Model:
                         # permutation: the snapshot leaf a mid-epoch
                         # resume replays from
                         np_epoch_start = np.random.get_state()
+                        py_epoch_start = py_random.getstate()
                         it = iter(train_loader)
                         step = 0
                         while True:
@@ -583,6 +591,9 @@ class Model:
                                 # uninterrupted run
                                 np.random.set_state(np_resume_mid)
                                 np_resume_mid = None
+                                if py_resume_mid is not None:
+                                    py_random.setstate(py_resume_mid)
+                                    py_resume_mid = None
                             # -- fetch (chaos-instrumented, retried) --
                             batch = self._fetch_with_retry(
                                 it, step_retries, step_retry_backoff_s,
@@ -652,7 +663,8 @@ class Model:
                                 snapped = ckpt.maybe_snapshot(
                                     self, global_step=global_step,
                                     epoch=epoch, next_batch=step + 1,
-                                    np_state_epoch_start=np_epoch_start)
+                                    np_state_epoch_start=np_epoch_start,
+                                    py_state_epoch_start=py_epoch_start)
                             if anomaly_rt is not None:
                                 # SDC audit cadence: every N trained
                                 # steps, plus right after a committed
@@ -701,7 +713,8 @@ class Model:
                 # checkpoint carries
                 ckpt.snapshot(self, global_step=global_step,
                               epoch=epochs, next_batch=0,
-                              np_state_epoch_start=np.random.get_state())
+                              np_state_epoch_start=np.random.get_state(),
+                              py_state_epoch_start=py_random.getstate())
         finally:
             # guard mode is a per-fit property: leaving it armed would
             # make later standalone train_batch calls run guarded with
@@ -790,6 +803,7 @@ class Model:
         while True:
             key_state = default_generator.get_state()
             np_state = np.random.get_state()
+            py_state = py_random.getstate()
             xin = x
             try:
                 fault = chaos_site("train.step")
@@ -813,6 +827,7 @@ class Model:
                 attempt += 1
                 default_generator.set_state(key_state)
                 np.random.set_state(np_state)
+                py_random.setstate(py_state)
                 if attempt > retries:
                     raise
                 stat_add("train.step_retries", 1)
@@ -829,11 +844,12 @@ class Model:
                 self, outs, epoch=epoch, batch=batch,
                 global_step=global_step)
             if verdict == "skip":
-                # the batch is discarded: rewind both PRNG streams so
-                # the next batch consumes exactly the keys it would
+                # the batch is discarded: rewind all three PRNG streams
+                # so the next batch consumes exactly the keys it would
                 # have consumed had this batch never been drawn
                 default_generator.set_state(key_state)
                 np.random.set_state(np_state)
+                py_random.setstate(py_state)
                 return None
             return outs
 

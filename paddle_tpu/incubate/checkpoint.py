@@ -36,7 +36,7 @@ class TrainEpochRange:
     def _latest(self) -> Optional[int]:
         if not os.path.isdir(self.dir):
             return None
-        epochs = [int(d.split("_")[1]) for d in os.listdir(self.dir)
+        epochs = [int(d.split("_")[1]) for d in sorted(os.listdir(self.dir))
                   if d.startswith("epoch_")]
         return max(epochs) if epochs else None
 
@@ -56,7 +56,7 @@ class TrainEpochRange:
             yield epoch
             now = time.time()
             if (self._state_provider is not None
-                    and (now - self._last_save >= self.inter
+                    and (now - self._last_save >= self.inter  # analyze: allow[determinism] save-interval throttle; resume keys on epoch, not clock
                          or epoch == self.max_epoch_num - 1)):
                 from ..framework_io import save
 

@@ -144,7 +144,7 @@ class CheckpointStore:
         """Committed step checkpoints, ascending (tmp droppings and
         foreign files are invisible)."""
         out = []
-        for fn in os.listdir(self.directory):
+        for fn in sorted(os.listdir(self.directory)):
             m = _STEP_RE.match(fn)
             if m:
                 out.append(int(m.group(1)))
@@ -156,7 +156,7 @@ class CheckpointStore:
 
     def named(self) -> List[str]:
         out = []
-        for fn in os.listdir(self.directory):
+        for fn in sorted(os.listdir(self.directory)):
             m = _SLOT_RE.match(fn)
             if m:
                 out.append(m.group(1))
@@ -219,15 +219,15 @@ class CheckpointStore:
         the same set); ``force=True`` bypasses the throttle (tests,
         explicit maintenance)."""
         now = time.time()
-        if not force and now - self._last_sweep < min_interval_s:
+        if not force and now - self._last_sweep < min_interval_s:  # analyze: allow[determinism] gc sweep throttle never touches committed state
             return
         self._last_sweep = now
         self._sweeps += 1
-        for fn in os.listdir(self.directory):
+        for fn in sorted(os.listdir(self.directory)):
             if ".ckpt.tmp." in fn:
                 full = os.path.join(self.directory, fn)
                 try:
-                    if time.time() - os.path.getmtime(full) > max_age_s:
+                    if time.time() - os.path.getmtime(full) > max_age_s:  # analyze: allow[determinism] tmp-file age gc; committed checkpoints unaffected
                         os.remove(full)
                 except OSError:
                     pass
@@ -350,7 +350,7 @@ class CheckpointStore:
                 problems.append(
                     f"leaf {leaf!r} CRC mismatch "
                     f"({g['crc32']} != manifest {rec['crc32']})")
-        for leaf in set(got) - set(want):
+        for leaf in sorted(set(got) - set(want)):
             problems.append(f"leaf {leaf!r} not in manifest")
         return problems
 

@@ -103,7 +103,7 @@ def random_split(dataset, lengths, generator=None):
         lengths = [int(total * l) for l in lengths]
         lengths[-1] = total - sum(lengths[:-1])
     assert sum(lengths) == total
-    perm = np.random.permutation(total)
+    perm = np.random.permutation(total)  # analyze: allow[determinism] sanctioned data-order stream: seeded+checkpointed
     out, offset = [], 0
     for l in lengths:
         out.append(Subset(dataset, perm[offset : offset + l].tolist()))

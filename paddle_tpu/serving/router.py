@@ -186,7 +186,7 @@ class Router:
             if not recoverable or i + 1 >= max(1, int(attempts)):
                 return None
             if deadline is not None \
-                    and time.monotonic() + delay >= deadline:
+                    and time.monotonic() + delay >= deadline:  # analyze: allow[determinism] retry budget vs request deadline is wall-clock SLO
                 return None
             time.sleep(delay)
             delay *= 2.0

@@ -89,7 +89,7 @@ class Request:
         would produce arrives late)."""
         if self.deadline is None:
             return False
-        return (time.monotonic() if now is None else now) >= self.deadline
+        return (time.monotonic() if now is None else now) >= self.deadline  # analyze: allow[determinism] request deadline SLO is wall-clock by contract
 
 
 class Sequence:
@@ -324,7 +324,7 @@ class Scheduler:
         # before it decodes again, so evicting it costs no recompute
         now = time.monotonic()
         for seq in reversed(self.running):
-            if seq is not exclude and seq.request.expired(now):
+            if seq is not exclude and seq.request.expired(now):  # analyze: allow[determinism] deadline-slack eviction is wall-clock SLO territory
                 return seq
         for seq in reversed(self.running):      # youngest first
             if seq is not exclude:

@@ -9,6 +9,7 @@ from a seed instead of depending on thread timing.
 """
 from . import chaos
 from .chaos import ChaosPlan, Fault, active_plan, chaos_site, install
+from .determinism import AmbientRngError, ambient_rng_guard
 
 __all__ = ["chaos", "ChaosPlan", "Fault", "active_plan", "chaos_site",
-           "install"]
+           "install", "AmbientRngError", "ambient_rng_guard"]

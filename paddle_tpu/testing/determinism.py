@@ -1,0 +1,95 @@
+"""Runtime twin of the ``determinism`` static checker (DT001).
+
+The AST lint proves no PRODUCTION code path contains an ambient RNG
+draw; this guard proves the same thing DYNAMICALLY for whatever runs
+inside a replay-sensitive scope — including paths the lint cannot see
+(C extensions aside): while active, every module-level
+``np.random.*`` draw and every ambient stdlib ``random.*`` draw raises
+:class:`AmbientRngError` with the offending function named.
+
+Byte-identity tests wrap their generate/replay drives in it::
+
+    with ambient_rng_guard():
+        out = engine.generate(...)     # any ambient draw -> loud error
+
+Explicit generators (``np.random.RandomState(seed)``,
+``np.random.default_rng(seed)``, ``random.Random(seed)``,
+``framework.random``'s seeded Generator / ``rng_scope``) are untouched
+— the guard patches only the MODULE-LEVEL entry points, which is
+exactly the ambient surface DT001 lints.  ``get_state``/``set_state``
+stay live too: snapshotting ambient state is the exact-resume
+discipline, not a draw.
+
+The guard is reentrant and restores the patched functions even on
+error; it is test-only machinery (nothing in ``paddle_tpu/`` proper
+imports it), so production paths pay nothing.
+"""
+from __future__ import annotations
+
+import contextlib
+import random as _py_random
+from typing import Iterator, List, Tuple
+
+import numpy as np
+
+__all__ = ["AmbientRngError", "ambient_rng_guard"]
+
+# the ambient draw surface is enumerated DYNAMICALLY (everything
+# callable the module exports that is not an explicit-generator
+# constructor or a state snapshot), mirroring DT001's
+# everything-not-exempt rule — a hand-kept list would silently pass
+# new/rare distributions (np.random.gamma, laplace, ...)
+_NP_EXEMPT = frozenset({
+    "RandomState", "Generator", "default_rng", "SeedSequence",
+    "BitGenerator", "MT19937", "PCG64", "PCG64DXSM", "Philox", "SFC64",
+    "get_state", "set_state", "get_bit_generator",
+})
+_PY_EXEMPT = frozenset({"Random", "SystemRandom", "getstate",
+                        "setstate"})
+
+
+def _draw_names(mod, exempt) -> List[str]:
+    names = getattr(mod, "__all__", None) or dir(mod)
+    out = []
+    for name in names:
+        if name.startswith("_") or name in exempt:
+            continue
+        fn = getattr(mod, name, None)
+        if callable(fn) and not isinstance(fn, type):
+            out.append(name)
+    return out
+
+
+class AmbientRngError(AssertionError):
+    """An ambient RNG draw happened inside a replay-sensitive scope."""
+
+
+def _tripwire(qualname: str):
+    def trip(*args, **kwargs):
+        raise AmbientRngError(
+            f"ambient RNG draw {qualname}() inside an "
+            "ambient_rng_guard() scope — byte-identical replay "
+            "requires every draw to ride framework.random (seeded "
+            "Generator / rng_scope) or an explicit generator object")
+    trip.__name__ = f"guarded_{qualname.replace('.', '_')}"
+    return trip
+
+
+@contextlib.contextmanager
+def ambient_rng_guard() -> Iterator[None]:
+    """Fail loudly on any ambient ``np.random.*`` / ``random.*`` draw
+    for the duration of the block (reentrant; always restores)."""
+    patched: List[Tuple[object, str, object]] = []
+    try:
+        for name in _draw_names(np.random, _NP_EXEMPT):
+            fn = getattr(np.random, name)
+            patched.append((np.random, name, fn))
+            setattr(np.random, name, _tripwire(f"np.random.{name}"))
+        for name in _draw_names(_py_random, _PY_EXEMPT):
+            fn = getattr(_py_random, name)
+            patched.append((_py_random, name, fn))
+            setattr(_py_random, name, _tripwire(f"random.{name}"))
+        yield
+    finally:
+        for mod, name, fn in reversed(patched):
+            setattr(mod, name, fn)

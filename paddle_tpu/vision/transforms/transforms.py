@@ -1,7 +1,7 @@
 """Transform classes (reference: vision/transforms/transforms.py)."""
 from __future__ import annotations
 
-import random
+from ...framework.random import py_random
 
 import numpy as np
 
@@ -62,13 +62,13 @@ class RandomResizedCrop(BaseTransform):
         h, w = img.shape[:2]
         area = h * w
         for _ in range(10):
-            target = random.uniform(*self.scale) * area
-            ar = random.uniform(*self.ratio)
+            target = py_random.uniform(*self.scale) * area
+            ar = py_random.uniform(*self.ratio)
             tw = int(round((target * ar) ** 0.5))
             th = int(round((target / ar) ** 0.5))
             if 0 < tw <= w and 0 < th <= h:
-                i = random.randint(0, h - th)
-                j = random.randint(0, w - tw)
+                i = py_random.randint(0, h - th)
+                j = py_random.randint(0, w - tw)
                 return F.resize(F.crop(img, i, j, th, tw), self.size,
                                 self.interpolation)
         return F.resize(F.center_crop(img, min(h, w)), self.size, self.interpolation)
@@ -100,8 +100,8 @@ class RandomCrop(BaseTransform):
         th, tw = self.size
         if h == th and w == tw:
             return img
-        i = random.randint(0, max(h - th, 0))
-        j = random.randint(0, max(w - tw, 0))
+        i = py_random.randint(0, max(h - th, 0))
+        j = py_random.randint(0, max(w - tw, 0))
         return F.crop(img, i, j, th, tw)
 
 
@@ -111,7 +111,7 @@ class RandomHorizontalFlip(BaseTransform):
         self.prob = prob
 
     def _apply_image(self, img):
-        if random.random() < self.prob:
+        if py_random.random() < self.prob:
             return F.hflip(img)
         return np.asarray(img)
 
@@ -122,7 +122,7 @@ class RandomVerticalFlip(BaseTransform):
         self.prob = prob
 
     def _apply_image(self, img):
-        if random.random() < self.prob:
+        if py_random.random() < self.prob:
             return F.vflip(img)
         return np.asarray(img)
 
@@ -138,7 +138,7 @@ class RandomRotation(BaseTransform):
                        fill=fill)
 
     def _apply_image(self, img):
-        angle = random.uniform(*self.degrees)
+        angle = py_random.uniform(*self.degrees)
         return F.rotate(img, angle, **self.kw)
 
 
@@ -186,7 +186,7 @@ class BrightnessTransform(BaseTransform):
     def _apply_image(self, img):
         if self.value == 0:
             return np.asarray(img)
-        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        factor = py_random.uniform(max(0, 1 - self.value), 1 + self.value)
         return F.adjust_brightness(img, factor)
 
 
@@ -198,7 +198,7 @@ class ContrastTransform(BaseTransform):
     def _apply_image(self, img):
         if self.value == 0:
             return np.asarray(img)
-        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        factor = py_random.uniform(max(0, 1 - self.value), 1 + self.value)
         return F.adjust_contrast(img, factor)
 
 
@@ -210,7 +210,7 @@ class SaturationTransform(BaseTransform):
     def _apply_image(self, img):
         if self.value == 0:
             return np.asarray(img)
-        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        factor = py_random.uniform(max(0, 1 - self.value), 1 + self.value)
         return F.adjust_saturation(img, factor)
 
 
@@ -222,7 +222,7 @@ class HueTransform(BaseTransform):
     def _apply_image(self, img):
         if self.value == 0:
             return np.asarray(img)
-        factor = random.uniform(-self.value, self.value)
+        factor = py_random.uniform(-self.value, self.value)
         return F.adjust_hue(img, factor)
 
 
@@ -241,7 +241,7 @@ class ColorJitter(BaseTransform):
 
     def _apply_image(self, img):
         ts = list(self.transforms)
-        random.shuffle(ts)
+        py_random.shuffle(ts)
         for t in ts:
             img = t(img)
         return img

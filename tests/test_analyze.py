@@ -483,6 +483,429 @@ class TestErrorTaxonomy:
 
 
 # =============================================================================
+# determinism (ISSUE 15)
+# =============================================================================
+class TestDeterminism:
+    def test_dt001_ambient_rng_fire_and_exemptions(self, tmp_path):
+        root = make_tree(tmp_path, {
+            "paddle_tpu/io/bad.py": '''
+                import random
+
+                import numpy as np
+
+
+                def draw():
+                    a = np.random.rand(3)                  # DT001
+                    np.random.seed(7)                      # DT001
+                    b = random.uniform(0.0, 1.0)           # DT001
+                    ok1 = np.random.RandomState(0).rand(2)
+                    ok2 = np.random.default_rng(0).random()
+                    ok3 = random.Random(0).random()
+                    state = np.random.get_state()          # snapshot ok
+                    waived = np.random.rand(1)  # analyze: allow[determinism] test
+                    return a, b, ok1, ok2, ok3, state, waived
+                ''',
+            "paddle_tpu/testing/fixture_gen.py": '''
+                import numpy as np
+
+
+                def soak_entropy():
+                    # testing/ is excluded: fixtures are allowed entropy
+                    return np.random.rand(4)
+                '''})
+        found = run_checks(root=root, checks=["determinism"])
+        assert [f.code for f in found] == ["DT001"] * 3
+        msgs = " ".join(f.message for f in found)
+        assert "np.random.rand" in msgs and "np.random.seed" in msgs \
+            and "random.uniform" in msgs
+        assert all(f.file == "paddle_tpu/io/bad.py" for f in found)
+
+    def test_dt002_wall_clock_control_flow(self, tmp_path):
+        root = make_tree(tmp_path, {"paddle_tpu/serving/bad.py": '''
+            import time
+
+
+            def loop(deadline):
+                while time.monotonic() < deadline:         # DT002
+                    pass
+                now = time.time()
+                if now > deadline:                         # DT002 (name)
+                    return 1
+                t0 = time.perf_counter()
+                work = 2 + 2
+                elapsed = time.perf_counter() - t0         # metric: ok
+                record(elapsed)
+                return work
+
+
+            def state_dict():
+                return {"created": time.time()}            # DT002 persisted
+
+
+            def regular():
+                return {"created": time.time()}            # not a boundary
+
+
+            def record(x):
+                pass
+            '''})
+        found = run_checks(root=root, checks=["determinism"])
+        assert [f.code for f in found] == ["DT002"] * 3
+        assert {f.line for f in found} == {6, 9, 19}
+
+    def test_dt003_unsorted_listings(self, tmp_path):
+        root = make_tree(tmp_path, {"paddle_tpu/io/bad.py": '''
+            import glob
+            import os
+
+
+            def pick(d):
+                names = os.listdir(d)                      # DT003
+                pats = glob.glob("*.ckpt")                 # DT003
+                ok1 = sorted(os.listdir(d))
+                ok2 = sorted(e.name for e in os.scandir(d))
+                ok3 = len(os.listdir(d))                   # aggregation
+                return names, pats, ok1, ok2, ok3
+            '''})
+        found = run_checks(root=root, checks=["determinism"])
+        assert [f.code for f in found] == ["DT003"] * 2
+        assert {f.line for f in found} == {7, 8}
+
+    def test_dt004_set_iteration(self, tmp_path):
+        root = make_tree(tmp_path, {"paddle_tpu/serving/bad.py": '''
+            def dispatch(a, b, mapping):
+                for x in set(a):                           # DT004
+                    emit(x)
+                live = set(a) - set(b)
+                for x in live:                             # DT004 (name)
+                    emit(x)
+                got = [x for x in set(a) | set(b)]         # DT004 (comp)
+                for x in sorted(set(a)):                   # ok
+                    emit(x)
+                for k in mapping:                          # dict: ordered
+                    emit(k)
+                return got
+
+
+            def emit(x):
+                pass
+            '''})
+        found = run_checks(root=root, checks=["determinism"])
+        assert [f.code for f in found] == ["DT004"] * 3
+        assert {f.line for f in found} == {3, 6, 8}
+
+    def test_dt005_id_keys_on_replay_boundaries(self, tmp_path):
+        root = make_tree(tmp_path, {"paddle_tpu/serving/bad.py": '''
+            def state_dict(params, store):
+                return {p: store[id(p)] for p in params}   # DT005
+
+
+            def snapshot_meta(objs):
+                return {id(o): o.name for o in objs}       # DT005 (key)
+
+
+            def describe(cache, obj):
+                return cache.get(id(obj))                  # DT005 (.get)
+
+
+            def in_process_dedup(objs):
+                seen = {}
+                for o in objs:
+                    seen[id(o)] = o                        # not a boundary
+                return list(seen.values())
+            '''})
+        found = run_checks(root=root, checks=["determinism"])
+        assert [f.code for f in found] == ["DT005"] * 3
+        assert {f.line for f in found} == {3, 7, 11}
+
+    def test_live_repo_clean(self):
+        assert run_checks(root=ROOT, checks=["determinism"]) == []
+
+
+# =============================================================================
+# host-sync (ISSUE 15)
+# =============================================================================
+class TestHostSync:
+    def test_hs001_hs002_coercions_and_transfers_in_loops(self, tmp_path):
+        root = make_tree(tmp_path, {"paddle_tpu/ops/bad.py": '''
+            import jax
+            import numpy as np
+
+
+            def _decode(x):
+                return x
+
+
+            w = jax.jit(_decode)
+
+
+            def drive(xs, host_rows):
+                out = []
+                for x in xs:
+                    y = w(x)
+                    out.append(int(y))                 # HS001
+                    out.append(y.item())               # HS001
+                    out.append(np.asarray(y))          # HS002
+                    got = jax.device_get(y)            # HS002
+                    out.append(int(host_rows[0]))      # non-jit: ok
+                z = w(xs)
+                hoisted = np.asarray(z)                # outside loop: ok
+                return out, int(hoisted[0]), got
+            '''})
+        found = run_checks(root=root, checks=["host-sync"])
+        codes = sorted(f.code for f in found)
+        assert codes == ["HS001", "HS001", "HS002", "HS002"]
+        assert {f.line for f in found} == {17, 18, 19, 20}
+
+    def test_hs001_engine_jit_attr_idiom(self, tmp_path):
+        root = make_tree(tmp_path, {"paddle_tpu/ops/bad.py": '''
+            class Engine:
+                def drive(self, xs):
+                    toks = []
+                    for x in xs:
+                        out = self._decode_jit(x)
+                        toks.append(int(out))          # HS001 (_jit attr)
+                    return toks
+            '''})
+        found = run_checks(root=root, checks=["host-sync"])
+        assert [f.code for f in found] == ["HS001"]
+        assert "'out'" in found[0].message
+
+    def test_hs003_implicit_truthiness(self, tmp_path):
+        root = make_tree(tmp_path, {"paddle_tpu/ops/bad.py": '''
+            import jax
+
+
+            def go(fn, x, flag):
+                y = jax.jit(fn)(x)
+                if y:                                  # HS003
+                    return 1
+                while not y:                           # HS003
+                    break
+                if flag and y:                         # HS003
+                    return 2
+                if flag:                               # host bool: ok
+                    return 3
+                done = bool(y)                         # not a test: HS-free
+                return done
+            '''})
+        found = run_checks(root=root, checks=["host-sync"])
+        assert [f.code for f in found] == ["HS003"] * 3
+        assert {f.line for f in found} == {7, 9, 11}
+
+    def test_hs004_hot_module_roundtrips_and_waiver(self, tmp_path):
+        code = '''
+            import jax
+
+
+            def pump(handles):
+                for h in handles:
+                    jax.device_get(h)                  # HS004 (hot only)
+                    h.block_until_ready()              # HS004 (hot only)
+                snap = jax.device_get(handles)         # off-loop: ok
+                return snap
+
+
+            def drain(handles):
+                for h in handles:
+                    jax.device_get(h)  # analyze: allow[host-sync] test
+            '''
+        hot = make_tree(tmp_path / "hot",
+                        {"paddle_tpu/serving/engine.py": code})
+        cold = make_tree(tmp_path / "cold",
+                         {"paddle_tpu/ops/helper.py": code})
+        found = run_checks(root=hot, checks=["host-sync"])
+        assert [f.code for f in found] == ["HS004"] * 2
+        assert {f.line for f in found} == {7, 8}
+        # the same code outside engine/scheduler/frontend: operand is
+        # unresolvable, so no finding — HS004 is the hot-path ratchet
+        assert run_checks(root=cold, checks=["host-sync"]) == []
+
+    def test_live_repo_clean(self):
+        assert run_checks(root=ROOT, checks=["host-sync"]) == []
+
+
+# =============================================================================
+# chaos-coverage (ISSUE 15)
+# =============================================================================
+class TestChaosCoverage:
+    def _tree(self, tmp_path):
+        return make_tree(tmp_path, {
+            "paddle_tpu/serving/sites.py": '''
+                from ..testing.chaos import chaos_site
+
+
+                def a():
+                    chaos_site("a.site", key="k")
+
+
+                def b():
+                    chaos_site("b.site")
+
+
+                def c():
+                    chaos_site("c.site")
+                ''',
+            "paddle_tpu/testing/chaos.py": '''
+                """Chaos harness.
+
+                Instrumented sites
+                ------------------
+                ``a.site``       the documented, drilled site
+                ``d.gone``       documented but no longer instrumented
+
+                Actions like ``deny`` or ``kill`` in prose are not
+                site rows; neither is an indented ``x.y``   mention.
+                """
+
+
+                def chaos_site(site, key=None):
+                    return None
+                ''',
+            "tests/test_drill.py": '''
+                from paddle_tpu.testing.chaos import Fault
+
+
+                def test_drills():
+                    plan = [Fault("a.site", at=1, action="deny"),
+                            Fault("b.site", at=2, action="raise")]
+                    return plan
+                '''})
+
+    def test_all_three_drift_directions(self, tmp_path):
+        found = run_checks(root=self._tree(tmp_path),
+                           checks=["chaos-coverage"])
+        by_code = {}
+        for f in found:
+            by_code.setdefault(f.code, []).append(f)
+        # b.site + c.site instrumented but undocumented
+        assert sorted(f.message.split("'")[1]
+                      for f in by_code["CC001"]) == ["b.site", "c.site"]
+        assert all(f.file == "paddle_tpu/serving/sites.py"
+                   for f in by_code["CC001"])
+        # d.gone documented but gone from code
+        assert len(by_code["CC002"]) == 1
+        assert "d.gone" in by_code["CC002"][0].message
+        assert by_code["CC002"][0].file == "paddle_tpu/testing/chaos.py"
+        # c.site never scheduled by any test Fault
+        assert len(by_code["CC003"]) == 1
+        assert "c.site" in by_code["CC003"][0].message
+        assert len(found) == 4
+
+    def test_doc_table_parser_ignores_prose_backticks(self, tmp_path):
+        from tools.analyze.chaos_coverage import collect_doc_sites
+
+        doc = collect_doc_sites(AnalysisContext(self._tree(tmp_path)))
+        assert set(doc) == {"a.site", "d.gone"}
+
+    def test_live_repo_every_site_documented_and_drilled(self):
+        """The ISSUE 15 acceptance pin: every chaos_site() in the live
+        repo is in the chaos.py site table AND scheduled by at least
+        one test — and the table promises nothing the code lacks."""
+        from tools.analyze.chaos_coverage import (collect_code_sites,
+                                                  collect_doc_sites,
+                                                  collect_scheduled_sites)
+
+        ctx = AnalysisContext(ROOT)
+        code = set(collect_code_sites(ctx))
+        doc = set(collect_doc_sites(ctx))
+        drilled = collect_scheduled_sites(ctx)
+        assert code, "site collector found nothing — collector broken?"
+        assert code == doc
+        assert code <= drilled
+        assert run_checks(root=ROOT, checks=["chaos-coverage"]) == []
+
+
+# =============================================================================
+# --changed-only (ISSUE 15)
+# =============================================================================
+class TestChangedOnly:
+    _FILES = {
+        "paddle_tpu/io/one.py": '''
+            import os
+
+
+            def pick(d):
+                return os.listdir(d)                       # DT003
+            ''',
+        "paddle_tpu/io/two.py": '''
+            import numpy as np
+
+
+            def draw():
+                return np.random.rand(2)                   # DT001
+            ''',
+    }
+
+    def test_restricted_run_agrees_with_full_run(self, tmp_path):
+        """The agreement pin: per-file checkers over only=<all files>
+        produce byte-for-byte the findings of the unrestricted run."""
+        root = make_tree(tmp_path, self._FILES)
+        full = run_checks(root=root, checks=["determinism"])
+        agree = run_checks(root=root, checks=["determinism"],
+                           only=sorted(self._FILES))
+        assert [f.key() for f in agree] == [f.key() for f in full]
+        assert len(full) == 2
+
+    def test_restriction_drops_other_files_findings(self, tmp_path):
+        root = make_tree(tmp_path, self._FILES)
+        got = run_checks(root=root, checks=["determinism"],
+                         only=["paddle_tpu/io/one.py"])
+        assert [f.code for f in got] == ["DT003"]
+        assert got[0].file == "paddle_tpu/io/one.py"
+
+    def test_cross_file_checkers_ignore_restriction(self, tmp_path):
+        """chaos-coverage must see the full tree even under
+        --changed-only: a restricted view would misreport every
+        unchanged site as missing."""
+        root = TestChaosCoverage()._tree(tmp_path)
+        full = run_checks(root=root, checks=["chaos-coverage"])
+        restricted = run_checks(root=root, checks=["chaos-coverage"],
+                                only=["paddle_tpu/serving/sites.py"])
+        assert [f.key() for f in restricted] == [f.key() for f in full]
+
+    def test_baseline_forces_full_run(self, tmp_path, monkeypatch,
+                                      capsys):
+        """--baseline + --changed-only must not write a baseline from a
+        restricted run (it would drop every grandfathered finding in
+        unchanged files): the combination forces the full tree."""
+        monkeypatch.setattr(analyze_core, "baseline_path",
+                            lambda: str(tmp_path / "baseline.txt"))
+        root = make_tree(tmp_path, self._FILES)
+        args = ["--root", root, "--check", "determinism"]
+        assert analyze_main(args + ["--changed-only", "--baseline"]) == 0
+        out = capsys.readouterr().out
+        assert "ignored with --baseline" in out
+        # both files' findings were grandfathered, not just a diff's
+        assert "wrote 2 finding(s)" in out
+        assert analyze_main(args) == 0
+
+    def test_cli_changed_only_against_git_worktree(self, tmp_path):
+        """End-to-end: an untracked file with a planted finding is
+        linted under --changed-only; a clean tree falls back to the
+        full run (never silently lints nothing)."""
+        from tools.analyze.__main__ import changed_files
+
+        root = make_tree(tmp_path, self._FILES)
+        git = lambda *a: subprocess.run(  # noqa: E731
+            ["git", *a], cwd=root, capture_output=True, text=True,
+            timeout=60)
+        if git("init", "-q").returncode != 0:
+            pytest.skip("git unavailable")
+        assert sorted(changed_files(root)) == sorted(self._FILES)
+        assert analyze_main(["--root", root, "--changed-only",
+                             "--check", "determinism"]) == 1
+        git("add", "-A")
+        git("-c", "user.email=t@t", "-c", "user.name=t",
+            "commit", "-qm", "x")
+        # clean tree -> changed_files None -> full-run fallback still
+        # sees the committed findings
+        assert changed_files(root) is None
+        assert analyze_main(["--root", root, "--changed-only",
+                             "--check", "determinism"]) == 1
+
+
+# =============================================================================
 # runner / baseline / CLI contract
 # =============================================================================
 class TestRunnerAndCLI:
@@ -545,7 +968,9 @@ class TestRunnerAndCLI:
         names = res.stdout.split()
         assert names == sorted(["error-taxonomy", "jit-hazard",
                                 "lock-discipline", "metrics-drift",
-                                "pallas-contract", "retrace-hazard"])
+                                "pallas-contract", "retrace-hazard",
+                                "determinism", "host-sync",
+                                "chaos-coverage"])
 
     def test_suppression_requires_matching_check_name(self, tmp_path):
         root = make_tree(tmp_path, {"paddle_tpu/serving/bad.py": '''

@@ -175,3 +175,73 @@ class TestSeededSchedules:
             "replica.kill", "engine.step", "kv.allocate"]
         assert all(3 <= f["at"] < 30 for f in sched)
         assert sched[0]["match"] in ("replica-0", "replica-1")
+
+
+class TestAmbientRngGuard:
+    """Runtime twin of the determinism lint (ISSUE 15): inside an
+    ambient_rng_guard() scope, module-level np.random / stdlib random
+    draws raise; explicit generators and the framework surface stay
+    live.  The static side (DT001) proves production code contains no
+    such draws — this proves it for whatever actually RUNS."""
+
+    def test_ambient_draws_raise_and_name_the_function(self):
+        import numpy as np
+
+        from paddle_tpu.testing import AmbientRngError, ambient_rng_guard
+
+        with ambient_rng_guard():
+            with pytest.raises(AmbientRngError, match="np.random.rand"):
+                np.random.rand(2)
+            with pytest.raises(AmbientRngError, match="random.randint"):
+                import random
+
+                random.randint(0, 9)
+            # seeding is a draw-surface mutation too: a mid-replay
+            # np.random.seed() would silently fork the stream
+            with pytest.raises(AmbientRngError, match="np.random.seed"):
+                np.random.seed(0)
+
+    def test_explicit_generators_and_framework_random_stay_live(self):
+        import numpy as np
+
+        from paddle_tpu.framework import random as frandom
+        from paddle_tpu.testing import ambient_rng_guard
+
+        with ambient_rng_guard():
+            assert np.random.RandomState(3).rand(2).shape == (2,)
+            assert np.random.default_rng(3).random() >= 0
+            import random
+
+            assert 0 <= random.Random(3).random() < 1
+            # the seeded framework facade (and the vision transforms'
+            # explicit py_random instance) ride explicit state
+            frandom.next_rng_key()
+            frandom.py_random.random()
+            # snapshotting ambient state is exact-resume machinery,
+            # not a draw
+            np.random.get_state()
+
+    def test_guard_restores_on_exit_even_on_error(self):
+        import numpy as np
+
+        from paddle_tpu.testing import AmbientRngError, ambient_rng_guard
+
+        with pytest.raises(RuntimeError, match="boom"):
+            with ambient_rng_guard():
+                raise RuntimeError("boom")
+        # restored: draws work again
+        assert np.random.rand(1).shape == (1,)
+
+    def test_guard_nests(self):
+        import numpy as np
+
+        from paddle_tpu.testing import AmbientRngError, ambient_rng_guard
+
+        with ambient_rng_guard():
+            with ambient_rng_guard():
+                with pytest.raises(AmbientRngError):
+                    np.random.rand(1)
+            # inner exit must not un-guard the outer scope
+            with pytest.raises(AmbientRngError):
+                np.random.rand(1)
+        assert np.random.rand(1).shape == (1,)

@@ -144,6 +144,32 @@ class TestServingCompilePins:
         finally:
             fe.close()
 
+    def test_fused_variant_shares_base_programs(self):
+        """ISSUE 15 suite health: ``fused_steps`` is a per-variant
+        PROGRAM cached on the shared base bundle, not a new bundle key
+        — an engine mixing plain and fused modes on one model compiles
+        the decode/prefill/maintenance set once, and only the fused
+        K-step program is variant-specific."""
+        gpt = fresh_gpt(24)
+        rng = np.random.RandomState(4)
+
+        def drive(eng):
+            for p in (3, 5):
+                eng.add_request(
+                    rng.randint(1, VOCAB, (p,)).astype(np.int32),
+                    max_new_tokens=4)
+            eng.drain()
+
+        plain = ServingEngine(gpt, page_size=4, max_batch_size=2,
+                              eos_id=-1)
+        drive(plain)
+        with compile_budget(None, prefix="serving.") as cb:
+            fused = ServingEngine(gpt, page_size=4, max_batch_size=2,
+                                  eos_id=-1, fused_steps=4)
+            drive(fused)
+        delta = {k: v for k, v in cb.compiles().items() if v}
+        assert set(delta) == {"serving.decode_fused"}, delta
+
     def test_steady_state_decode_zero_retraces_32_steps(self):
         """The acceptance pin: once the lane bucket is stable, >= 32
         decode steps perform ZERO retraces of ANY serving program —

@@ -301,7 +301,12 @@ class TestQuantEngineIdentity:
             # num_pages tight enough that a full 8-lane batch preempts;
             # one pinned lane bucket keeps the per-engine trace count
             # low (the bucket-churn path is covered by
-            # tests/test_serving_async.py on the native dtype)
+            # tests/test_serving_async.py on the native dtype).
+            # ISSUE 15 suite health: the 3 variants (and the session's
+            # other engines on this model+dtype) share ONE base program
+            # bundle — fused_steps is a per-variant program, not a new
+            # bundle key — so the 6 builds across both modes compile
+            # the decode/prefill/maintenance set once per mode
             return ServingEngine(gpt, page_size=4, num_pages=21,
                                  max_batch_size=8, bucket_sizes=[8],
                                  eos_id=0, **qkw, **kw)

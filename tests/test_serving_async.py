@@ -156,12 +156,20 @@ class TestAsyncTokenIdentity:
             return ServingEngine(gpt, page_size=4, num_pages=25,
                                  max_batch_size=8, eos_id=0, **kw)
 
+        # runtime twin of the determinism lint (DT001): the whole
+        # serving drive — admission, scheduling, preemption, decode —
+        # must never draw ambient RNG, or this byte-identity could not
+        # survive a replay in another process
+        from paddle_tpu.testing import ambient_rng_guard
+
         sync = build(sync_mode=True)
-        ids_sync = _drive_staggered(sync, prompts, budgets, arrivals)
+        with ambient_rng_guard():
+            ids_sync = _drive_staggered(sync, prompts, budgets, arrivals)
         outs_sync = dict(sync.outputs)
 
         pipe = build(fused_steps=4)
-        ids_pipe = _drive_staggered(pipe, prompts, budgets, arrivals)
+        with ambient_rng_guard():
+            ids_pipe = _drive_staggered(pipe, prompts, budgets, arrivals)
         outs_pipe = dict(pipe.outputs)
 
         assert len(outs_sync) == n and len(outs_pipe) == n

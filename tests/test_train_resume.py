@@ -173,9 +173,16 @@ class TestExactResume:
                 return PER_EPOCH
 
             def __iter__(self):
+                from paddle_tpu.framework.random import py_random
+
                 for i in range(PER_EPOCH):
-                    noise = (np.random.randn(BATCH, FEAT)
-                             * 0.05).astype(np.float32)
+                    # both sanctioned host streams at fetch time: the
+                    # ambient numpy stream AND the stdlib py_random
+                    # stream the vision transforms ride (ISSUE 15) —
+                    # resume must rejoin each mid-epoch exactly
+                    noise = (np.random.randn(BATCH, FEAT) * 0.05
+                             + py_random.random() * 0.01
+                             ).astype(np.float32)
                     yield [paddle.to_tensor(self.x[i] + noise),
                            paddle.to_tensor(self.y[i])]
 
@@ -327,6 +334,35 @@ class TestTrainStateCapture:
                                           m2.state_dict()[k].numpy())
         # step counter restored into the traced state
         assert int(np.asarray(m2._state["step"])) == PER_EPOCH
+
+    def test_py_random_stream_rides_the_capture(self):
+        """ISSUE 15: the sanctioned stdlib stream (vision-transform
+        augmentation) is a capture leaf like np_random — restore hands
+        the mid state back for the fit loop to rejoin, and a
+        pre-ISSUE-15 state tree (no such leaf) still loads."""
+        from paddle_tpu.framework.random import py_random
+
+        paddle.seed(11)
+        m = make_model()
+        m.fit(make_ds(), batch_size=BATCH, epochs=1, shuffle=False,
+              verbose=0)
+        py_random.random()                    # advance the stream
+        state = capture_train_state(m, global_step=1)
+        want = [py_random.random() for _ in range(4)]
+        py_random.seed(999)                   # wreck the live stream
+        m2 = make_model()
+        pos = restore_train_state(m2, state)
+        assert pos["py_random"] is not None
+        py_random.setstate(pos["py_random"])
+        assert [py_random.random() for _ in range(4)] == want
+        # backward compat: a pre-ISSUE-15 tree without the leaf
+        legacy = dict(state)
+        legacy.pop("py_random")
+        legacy["loader"] = {k: v for k, v in state["loader"].items()
+                            if k != "py_state_epoch_start"}
+        pos = restore_train_state(make_model(), legacy)
+        assert pos["py_random"] is None
+        assert pos["py_state_epoch_start"] is None
 
     def test_eager_roundtrip_with_scheduler(self):
         from paddle_tpu.optimizer import lr as lr_mod

@@ -2,9 +2,11 @@
 
 Static companion to the runtime witnesses (the lock-order witness in
 ``paddle_tpu.framework.concurrency``, the compile ledger in
-``paddle_tpu.profiler.jit_cost``): six checkers over the parsed source
-keep the hazards PR reviews kept catching by hand machine-checked
-instead (docs/ANALYSIS.md has the catalog and the baseline workflow):
+``paddle_tpu.profiler.jit_cost``, the transfer guard, the
+``testing.determinism`` ambient-RNG guard): nine checkers over the
+parsed source keep the hazards PR reviews kept catching by hand
+machine-checked instead (docs/ANALYSIS.md has the catalog and the
+baseline workflow):
 
 - ``lock-discipline``  blocking calls while a framework lock is held
 - ``jit-hazard``       host-sync ops inside jitted functions
@@ -16,6 +18,16 @@ instead (docs/ANALYSIS.md has the catalog and the baseline workflow):
 - ``metrics-drift``    emitted metric names <-> docs/OBSERVABILITY.md
 - ``error-taxonomy``   serving raises use framework.errors classes and
                        every class has an HTTP mapping
+- ``determinism``      byte-identity discipline: ambient RNG draws,
+                       wall-clock in control flow/persisted state,
+                       unsorted listdir/glob, set-iteration ordering,
+                       id()-keyed replay-boundary containers
+- ``host-sync``        static twin of the runtime transfer guard:
+                       per-step host coercions/transfers of jit
+                       outputs, implicit array truthiness, hot-loop
+                       device round-trips
+- ``chaos-coverage``   chaos_site() instrumentation <-> chaos.py site
+                       table <-> Fault(...) schedules in tests/
 
 Findings print as ``file:line CODE message``; the committed
 ``baseline.txt`` grandfathers accepted findings (this repo keeps it

@@ -1,0 +1,140 @@
+"""chaos-coverage: every fault site is documented AND drilled.
+
+Three sources of truth about chaos injection drift by hand today:
+
+1. the ``chaos_site("<name>", ...)`` instrumentation calls in
+   ``paddle_tpu/`` (the sites that actually exist),
+2. the site table in ``paddle_tpu/testing/chaos.py``'s module docstring
+   (what an operator reading the fault model believes exists),
+3. the ``Fault("<name>", ...)`` schedules in ``tests/`` (what actually
+   gets drilled).
+
+A fault point added without docs is an undocumented blast radius; a
+documented site that no longer exists is a fault model that lies; an
+instrumented site no test ever schedules is a recovery path that has
+never once run.  This checker keeps the three sets equal, so a chaos
+site can no longer be added without both documentation and a drill.
+
+Codes:
+
+- **CC001** — a ``chaos_site()`` call names a site missing from the
+  chaos.py docstring site table (undocumented site).
+- **CC002** — the table documents a site no code instruments
+  (documented-but-gone site).
+- **CC003** — an instrumented site is never scheduled by any
+  ``Fault(...)`` in ``tests/`` (never-drilled site).
+
+Site-table syntax: a docstring line starting with a double-backtick
+site name followed by whitespace, e.g. ``"``kv.allocate``       ..."``
+— exactly the format chaos.py has used since ISSUE 6.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from .core import AnalysisContext, Finding, last_component, register
+
+CHECK = "chaos-coverage"
+CODE_ROOTS = ("paddle_tpu",)
+TEST_ROOTS = ("tests",)
+CHAOS_DOC = "paddle_tpu/testing/chaos.py"
+
+# a site-table row: the line (stripped) STARTS with ``site.name``
+# followed by spacing and prose
+_TABLE_ROW = re.compile(r"^``([a-z][a-z0-9_.]*)``(?:\s{2,}|\s*$)")
+
+
+def _str_arg0(node: ast.Call) -> str:
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value
+    return ""
+
+
+def collect_code_sites(ctx: AnalysisContext
+                       ) -> Dict[str, List[Tuple[str, int]]]:
+    """site name -> [(file, line)] of every ``chaos_site("<name>")``
+    instrumentation call under ``paddle_tpu/``."""
+    sites: Dict[str, List[Tuple[str, int]]] = {}
+    for rel in ctx.iter_py(CODE_ROOTS):
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and last_component(node.func) == "chaos_site":
+                name = _str_arg0(node)
+                if name:
+                    sites.setdefault(name, []).append((rel, node.lineno))
+    return sites
+
+
+def collect_doc_sites(ctx: AnalysisContext) -> Dict[str, int]:
+    """site name -> docstring line number from chaos.py's site table."""
+    tree = ctx.tree(CHAOS_DOC)
+    if tree is None:
+        return {}
+    doc = ast.get_docstring(tree, clean=False)
+    if not doc:
+        return {}
+    # the docstring starts on line 1 of the module (pinned by chaos.py's
+    # layout); find its offset from the first line for robustness
+    doc_start = 1
+    if isinstance(tree, ast.Module) and tree.body \
+            and isinstance(tree.body[0], ast.Expr):
+        doc_start = tree.body[0].lineno
+    out: Dict[str, int] = {}
+    for off, line in enumerate(doc.splitlines()):
+        m = _TABLE_ROW.match(line.strip())
+        if m and "." in m.group(1):
+            out.setdefault(m.group(1), doc_start + off)
+    return out
+
+
+def collect_scheduled_sites(ctx: AnalysisContext) -> Set[str]:
+    """Sites named by any ``Fault("<site>", ...)`` construction in
+    tests/ (``chaos.Fault(...)`` included — resolution is by callee
+    tail)."""
+    out: Set[str] = set()
+    for rel in ctx.iter_py(TEST_ROOTS):
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and last_component(node.func) == "Fault":
+                name = _str_arg0(node)
+                if name:
+                    out.add(name)
+    return out
+
+
+@register("chaos-coverage")
+def run(ctx: AnalysisContext) -> List[Finding]:
+    code = collect_code_sites(ctx)
+    doc = collect_doc_sites(ctx)
+    scheduled = collect_scheduled_sites(ctx)
+    findings: List[Finding] = []
+    for site in sorted(set(code) - set(doc)):
+        rel, line = code[site][0]
+        findings.append(Finding(
+            rel, line, "CC001", CHECK,
+            f"chaos site {site!r} is instrumented here but missing "
+            f"from the {CHAOS_DOC} docstring site table — a fault "
+            "point without documentation is undocumented blast radius"))
+    for site in sorted(set(doc) - set(code)):
+        findings.append(Finding(
+            CHAOS_DOC, doc[site], "CC002", CHECK,
+            f"chaos site {site!r} is documented in the site table but "
+            "no chaos_site() call instruments it — the fault model "
+            "promises an injection point that does not exist"))
+    for site in sorted(set(code) - scheduled):
+        rel, line = code[site][0]
+        findings.append(Finding(
+            rel, line, "CC003", CHECK,
+            f"chaos site {site!r} is instrumented here but never "
+            "scheduled by a Fault(...) in tests/ — its recovery path "
+            "has never once been drilled"))
+    return findings

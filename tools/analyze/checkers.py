@@ -2,5 +2,6 @@
 with ``core.CHECKS`` (each checker module calls ``@register`` at import
 time).  New checkers: add the module here and it joins the CLI, the
 baseline workflow and the tier-1 self-run automatically."""
-from . import (error_taxonomy, jit_hazard, lock_discipline,  # noqa: F401
-               metrics_drift, pallas_contract, retrace_hazard)
+from . import (chaos_coverage, determinism, error_taxonomy,  # noqa: F401
+               host_sync, jit_hazard, lock_discipline, metrics_drift,
+               pallas_contract, retrace_hazard)

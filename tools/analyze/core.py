@@ -168,11 +168,18 @@ def new_findings(findings: Sequence[Finding],
 # --- registry / runner -------------------------------------------------------
 CheckFn = Callable[[AnalysisContext], List[Finding]]
 CHECKS: Dict[str, CheckFn] = {}
+# checkers whose findings are a pure per-file function of that file's
+# source (no cross-file/doc reconciliation): safe to run over a
+# restricted file set (--changed-only) without changing any finding a
+# full run would produce for those files
+PER_FILE: set = set()
 
 
-def register(name: str):
+def register(name: str, per_file: bool = False):
     def deco(fn: CheckFn) -> CheckFn:
         CHECKS[name] = fn
+        if per_file:
+            PER_FILE.add(name)
         return fn
     return deco
 
@@ -183,14 +190,38 @@ def default_root() -> str:
         os.path.dirname(os.path.abspath(__file__))))
 
 
+class _RestrictedContext(AnalysisContext):
+    """View over a shared context that walks only ``only`` files —
+    handed to PER_FILE checkers under --changed-only.  Shares the
+    parent's parse/line caches (same dicts) so nothing is read twice."""
+
+    def __init__(self, parent: AnalysisContext, only):
+        self.root = parent.root
+        self._asts = parent._asts
+        self._lines = parent._lines
+        self._only = set(only)
+
+    def iter_py(self, subdirs) -> List[str]:
+        return [rel for rel in super().iter_py(subdirs)
+                if rel in self._only]
+
+
 def run_checks(root: Optional[str] = None,
-               checks: Optional[Sequence[str]] = None) -> List[Finding]:
+               checks: Optional[Sequence[str]] = None,
+               only: Optional[Sequence[str]] = None) -> List[Finding]:
     """Run the selected checkers; returns findings with suppressions
-    already dropped (baseline filtering is the caller's policy)."""
+    already dropped (baseline filtering is the caller's policy).
+
+    ``only`` (repo-relative paths) restricts PER_FILE checkers to those
+    files; cross-file checkers (doc/table reconciliation) always see
+    the full tree — a restricted metrics scan would misreport every
+    unchanged emission site as missing."""
     from . import checkers  # noqa: PLC0415 — registers CHECKS lazily
 
     del checkers
     ctx = AnalysisContext(root or default_root())
+    restricted = _RestrictedContext(ctx, only) if only is not None \
+        else ctx
     names = list(checks) if checks else sorted(CHECKS)
     unknown = [n for n in names if n not in CHECKS]
     if unknown:
@@ -198,7 +229,8 @@ def run_checks(root: Optional[str] = None,
                        f"available: {sorted(CHECKS)}")
     findings: List[Finding] = []
     for name in names:
-        findings.extend(f for f in CHECKS[name](ctx)
+        use = restricted if name in PER_FILE else ctx
+        findings.extend(f for f in CHECKS[name](use)
                         if not suppressed(ctx, f))
     findings.sort(key=lambda f: (f.file, f.line, f.code, f.message))
     return findings

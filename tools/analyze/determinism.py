@@ -1,0 +1,386 @@
+"""determinism: byte-identity is a static property, not a test outcome.
+
+Every acceptance gate in this repo — exact resume, warm failover,
+prefix-cache sharing, spec-decode, the autotuner parity gate — rests on
+byte-identical, deterministically replayable execution.  The runtime
+side is enforced where a test happens to look (byte-identity pins, the
+``testing.determinism.ambient_rng_guard`` runtime twin); this checker
+makes the DISCIPLINE itself machine-checked over ``paddle_tpu/``
+(``testing/`` excluded — fixtures and soak generators are allowed
+entropy), so the kernel/sharding refactors queued next cannot silently
+reintroduce a replay hazard on a path no test drives.
+
+Codes:
+
+- **DT001** — ambient RNG draw: a module-level ``np.random.*`` draw or
+  a stdlib ``random.*`` call.  Randomness must ride
+  ``framework.random`` (the seeded Generator / ``rng_scope``) or an
+  explicit generator object (``np.random.RandomState(seed)``,
+  ``np.random.default_rng(seed)``, ``random.Random(seed)`` — all
+  exempt), or replay of a seeded run diverges.  ``get_state`` /
+  ``set_state`` are exempt: snapshotting ambient state IS the
+  exact-resume discipline.
+- **DT002** — wall-clock read feeding control flow or persisted state:
+  ``time.time/monotonic/perf_counter/process_time`` used in an
+  ``if``/``while`` test or comparison (directly or through a local
+  name), or returned from a persistence-shaped function
+  (``state_dict``/``describe``/``schedule``/``snapshot*``).  Pure
+  elapsed-time metrics (``t1 - t0`` into a histogram) never compare
+  and are not flagged.  Sanctioned clock-driven sites — watchdog,
+  deadlines, backoff — carry reasoned ``analyze: allow[determinism]``
+  waivers.
+- **DT003** — unsorted ``os.listdir``/``glob.glob`` result: filesystem
+  enumeration order is platform/inode-dependent; anything selecting
+  from it (the ``CheckpointStore.load_latest`` shape) must ``sorted()``
+  first.
+- **DT004** — iteration over a set: element order depends on
+  PYTHONHASHSEED for str keys — two processes replaying the same
+  schedule can dispatch/emit in different orders.  Wrap in
+  ``sorted()`` or keep an insertion-ordered structure (dict keys are
+  fine).
+- **DT005** — ``id()``-keyed container access inside a replay-boundary
+  function (``state_dict``/``set_state_dict``/``describe``/
+  ``schedule``/``snapshot*``/``*_payload``): CPython ids are
+  per-process addresses; a persisted mapping keyed by them can never
+  be replayed.  Reading an id-keyed store while EMITTING positionally
+  is the sanctioned pattern and gets a reasoned waiver.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .core import AnalysisContext, Finding, last_component, register, unparse
+
+CHECK = "determinism"
+ROOTS = ("paddle_tpu",)
+EXCLUDE_PREFIX = "paddle_tpu/testing/"
+
+# np.random.<attr> calls that do NOT touch the ambient global stream
+_NP_RANDOM_EXEMPT = frozenset({
+    "RandomState", "default_rng", "Generator", "get_state", "set_state",
+    "SeedSequence", "PCG64", "Philox", "BitGenerator",
+})
+# stdlib random module draw/mutate functions (explicit random.Random(...)
+# instances are exempt — the method call is on the instance, not the
+# module, so it never matches the ``random.<fn>`` shape)
+_PY_RANDOM_DRAWS = frozenset({
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "gauss", "normalvariate", "betavariate",
+    "expovariate", "triangular", "seed", "getrandbits", "vonmisesvariate",
+    "paretovariate", "weibullvariate", "lognormvariate",
+})
+_CLOCK_FUNCS = frozenset({
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.process_time", "time.monotonic_ns", "time.time_ns",
+    "time.perf_counter_ns",
+})
+_LIST_FUNCS = frozenset({
+    "os.listdir", "listdir", "glob.glob", "glob.iglob", "iglob",
+    "os.scandir", "scandir",
+})
+_ORDER_FIXERS = frozenset({"sorted", "set", "frozenset", "len", "max",
+                           "min", "sum", "Counter", "collections.Counter"})
+# iterating set(...) directly IS the DT004 hazard — only genuinely
+# order-neutralizing wrappers exempt an iteration
+_ITER_FIXERS = _ORDER_FIXERS - {"set", "frozenset"}
+_PERSIST_NAMES = ("state_dict", "set_state_dict", "describe", "schedule",
+                  "snapshot", "to_payload", "from_payload", "manifest")
+_GETLIKE_ATTRS = frozenset({"get", "setdefault", "pop"})
+_SET_METHODS = frozenset({"union", "intersection", "difference",
+                          "symmetric_difference"})
+
+
+def _is_persist_fn(name: str) -> bool:
+    return any(name == p or name.startswith(p) or name.endswith(p)
+               for p in _PERSIST_NAMES)
+
+
+def _is_clock_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and unparse(node.func) in _CLOCK_FUNCS)
+
+
+def _np_random_draw(func: ast.AST) -> str:
+    """The drawing attr name when ``func`` is ``np.random.X`` /
+    ``numpy.random.X`` with X an ambient draw ('' otherwise)."""
+    if not isinstance(func, ast.Attribute):
+        return ""
+    base = unparse(func.value)
+    if base in ("np.random", "numpy.random") \
+            and func.attr not in _NP_RANDOM_EXEMPT:
+        return func.attr
+    return ""
+
+
+def _py_random_draw(func: ast.AST) -> str:
+    if (isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "random"
+            and func.attr in _PY_RANDOM_DRAWS):
+        return func.attr
+    return ""
+
+
+class _SetTypes:
+    """Local set-typed expression inference for one function scope."""
+
+    def __init__(self):
+        self.names: Set[str] = set()
+
+    def is_set(self, node: ast.AST) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call):
+            callee = unparse(node.func)
+            if callee in ("set", "frozenset"):
+                return True
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SET_METHODS \
+                    and self.is_set(node.func.value):
+                return True
+            return False
+        if isinstance(node, ast.BinOp) \
+                and isinstance(node.op, (ast.Sub, ast.BitOr, ast.BitAnd,
+                                         ast.BitXor)):
+            return self.is_set(node.left) or self.is_set(node.right)
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        return False
+
+    def feed_assign(self, node: ast.Assign):
+        if self.is_set(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.names.add(t.id)
+        else:
+            # rebinding to a non-set value clears the inference
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.names.discard(t.id)
+
+
+class _Scan(ast.NodeVisitor):
+    """One pass per module; function scopes are visited recursively so
+    clock-name and set-type inference stays local to each scope."""
+
+    def __init__(self, rel: str):
+        self.rel = rel
+        self.findings: List[Finding] = []
+        self._fn_stack: List[str] = []
+        self._clock_names: List[Set[str]] = [set()]
+        self._set_types: List[_SetTypes] = [_SetTypes()]
+        self._in_test: int = 0
+        # depth of enclosing order-neutralizing calls (sorted/max/...):
+        # a listdir/glob inside one is deterministic by construction
+        self._order_fixed: int = 0
+
+    # --- emit helpers ----------------------------------------------------
+    def _add(self, node: ast.AST, code: str, msg: str):
+        self.findings.append(Finding(self.rel, node.lineno, code, CHECK,
+                                     msg))
+
+    # --- scopes ----------------------------------------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._fn_stack.append(node.name)
+        self._clock_names.append(set())
+        self._set_types.append(_SetTypes())
+        self.generic_visit(node)
+        self._set_types.pop()
+        self._clock_names.pop()
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # --- DT002: wall clock -----------------------------------------------
+    def _scan_test_expr(self, test: ast.AST):
+        clocks = self._clock_names[-1]
+        for sub in ast.walk(test):
+            if _is_clock_call(sub):
+                self._add(sub, "DT002",
+                          f"wall-clock read {unparse(sub.func)}() feeds "
+                          "control flow — replay of the same schedule "
+                          "takes a different branch; derive the decision "
+                          "from step/evaluation counters (or waive: "
+                          "watchdog/deadline territory)")
+            elif isinstance(sub, ast.Name) and sub.id in clocks \
+                    and isinstance(sub.ctx, ast.Load):
+                self._add(sub, "DT002",
+                          f"wall-clock value {sub.id!r} feeds control "
+                          "flow — replay of the same schedule takes a "
+                          "different branch; derive the decision from "
+                          "step/evaluation counters (or waive: "
+                          "watchdog/deadline territory)")
+
+    def visit_If(self, node: ast.If):
+        self._scan_test_expr(node.test)
+        self._in_test += 1
+        self.visit(node.test)
+        self._in_test -= 1
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def visit_While(self, node: ast.While):
+        self._scan_test_expr(node.test)
+        self._in_test += 1
+        self.visit(node.test)
+        self._in_test -= 1
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def visit_Compare(self, node: ast.Compare):
+        if not self._in_test:      # if/while tests were already scanned
+            self._scan_test_expr(node)
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp):
+        if not self._in_test:   # an enclosing if/while already scanned
+            self._scan_test_expr(node.test)
+        self._in_test += 1
+        self.visit(node.test)
+        self._in_test -= 1
+        self.visit(node.body)
+        self.visit(node.orelse)
+
+    def visit_Return(self, node: ast.Return):
+        if node.value is not None and self._fn_stack \
+                and _is_persist_fn(self._fn_stack[-1]):
+            for sub in ast.walk(node.value):
+                if _is_clock_call(sub):
+                    self._add(sub, "DT002",
+                              f"wall-clock read {unparse(sub.func)}() "
+                              "returned from persistence-shaped "
+                              f"function {self._fn_stack[-1]!r} — "
+                              "persisted state must replay "
+                              "byte-identical")
+        self.generic_visit(node)
+
+    # --- assignments: clock names + set types ----------------------------
+    def visit_Assign(self, node: ast.Assign):
+        if _is_clock_call(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self._clock_names[-1].add(t.id)
+        else:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self._clock_names[-1].discard(t.id)
+        self._set_types[-1].feed_assign(node)
+        self.generic_visit(node)
+
+    # --- calls: DT001 / DT003 / DT005 ------------------------------------
+    def visit_Call(self, node: ast.Call):
+        draw = _np_random_draw(node.func)
+        if draw:
+            self._add(node, "DT001",
+                      f"ambient RNG draw np.random.{draw}() — replay "
+                      "diverges unless every draw rides "
+                      "framework.random (seeded Generator / rng_scope) "
+                      "or an explicit np.random.Generator")
+        else:
+            draw = _py_random_draw(node.func)
+            if draw:
+                self._add(node, "DT001",
+                          f"ambient stdlib random.{draw}() — "
+                          "paddle_tpu.seed() does not seed the stdlib "
+                          "module; ride framework.random or an "
+                          "explicit random.Random(seed)")
+        callee = unparse(node.func)
+        if callee in _LIST_FUNCS and not self._order_fixed:
+            self._add(node, "DT003",
+                      f"unsorted {callee}() result — filesystem "
+                      "enumeration order is platform-dependent; wrap "
+                      "in sorted() before anything selects from it")
+        # DT005: id(...) as a container key on a replay boundary
+        if self._fn_stack and _is_persist_fn(self._fn_stack[-1]) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _GETLIKE_ATTRS \
+                and node.args and self._is_id_call(node.args[0]):
+            self._add(node, "DT005",
+                      f"id()-keyed .{node.func.attr}() inside "
+                      f"replay-boundary function "
+                      f"{self._fn_stack[-1]!r} — CPython ids are "
+                      "per-process addresses and can never replay; "
+                      "key by a stable name/position")
+        if last_component(node.func) in _ORDER_FIXERS:
+            self._order_fixed += 1
+            self.generic_visit(node)
+            self._order_fixed -= 1
+        else:
+            self.generic_visit(node)
+
+    # --- DT005: id() subscripts / dict keys ------------------------------
+    @staticmethod
+    def _is_id_call(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "id")
+
+    def visit_Subscript(self, node: ast.Subscript):
+        if self._fn_stack and _is_persist_fn(self._fn_stack[-1]) \
+                and self._is_id_call(node.slice):
+            self._add(node, "DT005",
+                      f"id()-keyed subscript inside replay-boundary "
+                      f"function {self._fn_stack[-1]!r} — CPython ids "
+                      "are per-process addresses and can never "
+                      "replay; key by a stable name/position")
+        self.generic_visit(node)
+
+    def _flag_id_key(self, key: Optional[ast.AST]):
+        if key is not None and self._is_id_call(key) and self._fn_stack \
+                and _is_persist_fn(self._fn_stack[-1]):
+            self._add(key, "DT005",
+                      f"id()-keyed dict built inside replay-boundary "
+                      f"function {self._fn_stack[-1]!r} — CPython ids "
+                      "are per-process addresses and can never "
+                      "replay; key by a stable name/position")
+
+    def visit_Dict(self, node: ast.Dict):
+        for key in node.keys:
+            self._flag_id_key(key)
+        self.generic_visit(node)
+
+    # --- DT004: set iteration --------------------------------------------
+    def _flag_set_iter(self, iter_node: ast.AST):
+        # sorted(<set>) / len() / aggregation neutralize ordering
+        if isinstance(iter_node, ast.Call) \
+                and last_component(iter_node.func) in _ITER_FIXERS:
+            return
+        if self._set_types[-1].is_set(iter_node):
+            self._add(iter_node, "DT004",
+                      "iteration over a set — element order depends on "
+                      "PYTHONHASHSEED for str elements, so two "
+                      "processes replaying one schedule can order "
+                      "dispatch/emission differently; sorted() it or "
+                      "use an insertion-ordered dict")
+
+    def visit_For(self, node: ast.For):
+        self._flag_set_iter(node.iter)
+        self.generic_visit(node)
+
+    visit_AsyncFor = visit_For
+
+    def _visit_comp(self, node):
+        for gen in node.generators:
+            self._flag_set_iter(gen.iter)
+        if isinstance(node, ast.DictComp):
+            self._flag_id_key(node.key)
+        self.generic_visit(node)
+
+    visit_ListComp = visit_SetComp = visit_GeneratorExp = _visit_comp
+    visit_DictComp = _visit_comp
+
+
+@register("determinism", per_file=True)
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in ctx.iter_py(ROOTS):
+        if rel.startswith(EXCLUDE_PREFIX):
+            continue
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        scan = _Scan(rel)
+        scan.visit(tree)
+        findings.extend(scan.findings)
+    return findings

@@ -1,0 +1,283 @@
+"""host-sync: the static twin of the runtime transfer guard.
+
+The steady-state decode path is pinned transfer-clean at runtime with
+``jax.transfer_guard("disallow")`` (tests/test_serving_async.py, the
+``serving.decode`` double-buffered consume idiom) — but only on paths a
+test drives.  This checker pins the SHAPE of the discipline statically
+over all of ``paddle_tpu/``: a value produced by a jit dispatch
+(resolved through :mod:`.jit_scopes` — a name bound from ``jax.jit``/
+``profiled_jit`` wrap, a def jitted by decorator/name-wrap, the engine's
+``*_jit`` attribute idiom, or an immediately-invoked wrap) must not be
+coerced to host data inside a per-step loop, and the serving hot-loop
+modules must not grow per-iteration device round-trips at all.
+
+Codes:
+
+- **HS001** — host coercion of a jit output inside a loop:
+  ``int()``/``float()``/``bool()``/``len()`` or ``.item()``/
+  ``.tolist()``/``.numpy()`` applied to a name assigned from a jit
+  dispatch (or to the dispatch call itself) within a ``for``/``while``/
+  comprehension.  Each iteration blocks on the device — the pipeline
+  the double-buffered consume exists to create collapses.  Batch the
+  transfer once per step (``device_get`` the whole token row) instead.
+- **HS002** — explicit per-iteration transfer of a jit output:
+  ``np.asarray``/``np.array``/``jax.device_get`` on a jit-output value
+  inside a loop.  Same physics as HS001 with the sync spelled out.
+- **HS003** — implicit array truthiness: a jit-output name used
+  directly as an ``if``/``while`` test (or under ``not``/``and``/
+  ``or``).  Forces a blocking sync AND is ambiguous for size != 1 —
+  the classic silent host round-trip the transfer guard catches only
+  when a test happens to cross it.
+- **HS004** — per-iteration device round-trip in a serving hot-loop
+  module (``serving/engine.py``, ``serving/scheduler.py``,
+  ``serving/frontend.py``): any ``jax.device_get``/
+  ``.block_until_ready()`` inside a loop, whatever its operand — these
+  three modules are the steady-state decode path, where the budget is
+  ONE batched transfer per step (the ``_consume_one`` idiom).
+  Sanctioned exceptions (snapshot/drain paths that are off the decode
+  fast path) carry reasoned ``analyze: allow[host-sync]`` waivers.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .core import AnalysisContext, Finding, last_component, register, unparse
+from .jit_scopes import JitCollector, is_jit_wrapper_name
+
+CHECK = "host-sync"
+ROOTS = ("paddle_tpu",)
+HOT_MODULES = frozenset({
+    "paddle_tpu/serving/engine.py",
+    "paddle_tpu/serving/scheduler.py",
+    "paddle_tpu/serving/frontend.py",
+})
+
+_COERCE_NAMES = frozenset({"int", "float", "bool", "len"})
+_COERCE_ATTRS = frozenset({"item", "tolist", "numpy"})
+_TRANSFER_FUNCS = frozenset({"np.asarray", "np.array", "numpy.asarray",
+                             "numpy.array", "jax.device_get",
+                             "device_get"})
+_ROUNDTRIP_ATTRS = frozenset({"block_until_ready"})
+
+
+class _Scan(ast.NodeVisitor):
+    """Per-module pass with the retrace-hazard scope discipline: a
+    lexical scope chain for jit-callee resolution, a loop-depth stack
+    per function, and per-function sets of names known to hold jit
+    outputs."""
+
+    def __init__(self, rel: str, col: JitCollector, module: ast.Module):
+        self.rel = rel
+        self.col = col
+        self.hot = rel in HOT_MODULES
+        self.findings: List[Finding] = []
+        self.scope_chain: List[ast.AST] = [module]
+        self.loop_depth: List[int] = [0]
+        self.jit_names: List[Set[str]] = [set()]
+
+    # --- scope / loop bookkeeping ----------------------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self.scope_chain.append(node)
+        self.loop_depth.append(0)
+        self.jit_names.append(set())
+        self.generic_visit(node)
+        self.jit_names.pop()
+        self.loop_depth.pop()
+        self.scope_chain.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        # class bodies are not in the lexical chain of their methods
+        self.generic_visit(node)
+
+    def _in_loop(self) -> bool:
+        return self.loop_depth[-1] > 0
+
+    def visit_For(self, node: ast.For):
+        self.visit(node.iter)
+        self.loop_depth[-1] += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        self.loop_depth[-1] -= 1
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    visit_AsyncFor = visit_For
+
+    def visit_While(self, node: ast.While):
+        self._check_truthiness(node.test)
+        self.visit(node.test)
+        self.loop_depth[-1] += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        self.loop_depth[-1] -= 1
+        for stmt in node.orelse:
+            self.visit(stmt)
+
+    def _visit_comp(self, node):
+        for gen in node.generators:
+            self.visit(gen.iter)
+            for cond in gen.ifs:
+                self.visit(cond)
+        self.loop_depth[-1] += 1
+        for child in (getattr(node, "elt", None),
+                      getattr(node, "key", None),
+                      getattr(node, "value", None)):
+            if child is not None:
+                self.visit(child)
+        self.loop_depth[-1] -= 1
+
+    visit_ListComp = visit_SetComp = visit_GeneratorExp = _visit_comp
+    visit_DictComp = _visit_comp
+
+    # --- jit-output resolution -------------------------------------------
+    def _is_jit_dispatch(self, node: ast.AST) -> Optional[str]:
+        """Callee description when ``node`` is a call crossing a jit
+        dispatch boundary (mirrors retrace-hazard's resolution)."""
+        if not isinstance(node, ast.Call):
+            return None
+        func = node.func
+        if isinstance(func, ast.Name):
+            if is_jit_wrapper_name(func.id):
+                return None               # a wrap, not a dispatch
+            hit = self.col.resolve_jit_callee(func.id,
+                                             list(self.scope_chain))
+            return func.id if hit is not None else None
+        if isinstance(func, ast.Attribute):
+            if is_jit_wrapper_name(func.attr):
+                return None
+            if func.attr.endswith("_jit"):
+                return unparse(func)
+            return None
+        if isinstance(func, ast.Call) \
+                and is_jit_wrapper_name(last_component(func.func)):
+            return unparse(func)          # jax.jit(fn)(...)
+        return None
+
+    def _is_jit_value(self, node: ast.AST) -> Optional[str]:
+        """Description when ``node`` is a jit output: a tracked name or
+        a direct dispatch call."""
+        if isinstance(node, ast.Name) and node.id in self.jit_names[-1]:
+            return node.id
+        return self._is_jit_dispatch(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        names = self.jit_names[-1]
+        if self._is_jit_dispatch(node.value) is not None:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+                elif isinstance(t, ast.Tuple):
+                    for elt in t.elts:
+                        if isinstance(elt, ast.Name):
+                            names.add(elt.id)
+        else:
+            for t in node.targets:
+                targets = t.elts if isinstance(t, ast.Tuple) else [t]
+                for elt in targets:
+                    if isinstance(elt, ast.Name):
+                        names.discard(elt.id)
+        self.generic_visit(node)
+
+    # --- the rules --------------------------------------------------------
+    def _add(self, node: ast.AST, code: str, msg: str):
+        self.findings.append(Finding(self.rel, node.lineno, code, CHECK,
+                                     msg))
+
+    def _check_truthiness(self, test: ast.AST):
+        """HS003 on an if/while test: the jit-output name itself, or
+        under not/and/or."""
+        stack = [test]
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, ast.BoolOp):
+                stack.extend(sub.values)
+            elif isinstance(sub, ast.UnaryOp) \
+                    and isinstance(sub.op, ast.Not):
+                stack.append(sub.operand)
+            elif isinstance(sub, ast.Name) \
+                    and sub.id in self.jit_names[-1]:
+                self._add(sub, "HS003",
+                          f"implicit truthiness of jit output "
+                          f"{sub.id!r} — forces a blocking device sync "
+                          "and is ambiguous for size != 1; compare an "
+                          "explicit host-side flag or device_get once "
+                          "per step")
+
+    def visit_If(self, node: ast.If):
+        self._check_truthiness(node.test)
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp):
+        self._check_truthiness(node.test)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        # HS001: int()/float()/bool()/len() coercions
+        if isinstance(func, ast.Name) and func.id in _COERCE_NAMES \
+                and len(node.args) == 1 and self._in_loop():
+            desc = self._is_jit_value(node.args[0])
+            if desc is not None:
+                self._add(node, "HS001",
+                          f"{func.id}() coerces jit output {desc!r} to "
+                          "host data inside a per-step loop — each "
+                          "iteration blocks on the device; batch ONE "
+                          "transfer per step (the double-buffered "
+                          "consume idiom) instead")
+        # HS001: .item()/.tolist()/.numpy()
+        elif isinstance(func, ast.Attribute) \
+                and func.attr in _COERCE_ATTRS and self._in_loop():
+            desc = self._is_jit_value(func.value)
+            if desc is not None:
+                self._add(node, "HS001",
+                          f".{func.attr}() coerces jit output {desc!r} "
+                          "to host data inside a per-step loop — each "
+                          "iteration blocks on the device; batch ONE "
+                          "transfer per step (the double-buffered "
+                          "consume idiom) instead")
+        # HS002: explicit transfer of a jit output in a loop
+        elif unparse(func) in _TRANSFER_FUNCS and node.args \
+                and self._in_loop():
+            desc = self._is_jit_value(node.args[0])
+            if desc is not None:
+                self._add(node, "HS002",
+                          f"{unparse(func)}() transfers jit output "
+                          f"{desc!r} device->host inside a per-step "
+                          "loop — hoist the transfer out of the loop "
+                          "and read the whole batch once per step")
+        # HS004: any device round-trip in a hot-loop module's loop
+        if self.hot and self._in_loop():
+            txt = unparse(func)
+            roundtrip = txt in ("jax.device_get", "device_get") \
+                or (isinstance(func, ast.Attribute)
+                    and func.attr in _ROUNDTRIP_ATTRS)
+            already = any(f.line == node.lineno
+                          and f.code in ("HS001", "HS002")
+                          for f in self.findings)
+            if roundtrip and not already:
+                self._add(node, "HS004",
+                          f"{txt}() inside a loop in a serving "
+                          "hot-loop module — the steady-state budget "
+                          "is ONE batched transfer per step "
+                          "(_consume_one); hoist it, or waive with "
+                          "reason if this path is off the decode fast "
+                          "path")
+        self.generic_visit(node)
+
+
+@register("host-sync", per_file=True)
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in ctx.iter_py(ROOTS):
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        col = JitCollector(rel, ctx)
+        col.visit(tree)
+        scan = _Scan(rel, col, tree)
+        scan.visit(tree)
+        findings.extend(scan.findings)
+    return findings

@@ -72,7 +72,7 @@ class _HazardScan(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-@register("jit-hazard")
+@register("jit-hazard", per_file=True)
 def run(ctx: AnalysisContext) -> List[Finding]:
     findings: List[Finding] = []
     for rel in ctx.iter_py(ROOTS):

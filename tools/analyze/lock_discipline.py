@@ -135,7 +135,7 @@ class _Visitor(ast.NodeVisitor):
         return ""
 
 
-@register("lock-discipline")
+@register("lock-discipline", per_file=True)
 def run(ctx: AnalysisContext) -> List[Finding]:
     findings: List[Finding] = []
     for rel in ctx.iter_py(ROOTS):

@@ -458,7 +458,7 @@ def _parent_functions(tree: ast.Module
     return out
 
 
-@register("retrace-hazard")
+@register("retrace-hazard", per_file=True)
 def run(ctx: AnalysisContext) -> List[Finding]:
     findings: List[Finding] = []
     for rel in ctx.iter_py(ROOTS):
