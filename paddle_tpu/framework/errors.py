@@ -106,6 +106,18 @@ class TuningTableIncompatibleError(CheckpointIncompatibleError):
     raises (a precondition of applying the table, hence 412)."""
 
 
+class PageTransportError(UnavailableError):
+    """A KV page failed to MOVE — a tier demotion/promotion (host-RAM /
+    disk prefix tiers, serving.kv_transport; ISSUE 16) or a prefill→
+    decode ship could not complete.  Always transient-infrastructure
+    shaped, never a wrong answer: the tier paths degrade (a failed
+    demotion discards the page exactly like the tier-off eviction, a
+    failed promotion is a MISS re-prefilled from tokens, a failed ship
+    leaves the request decoding where its pages already are), so this
+    class surfaces only when a caller asked for a transport strictly
+    (503: retry-later territory, like any unavailable replica)."""
+
+
 class NumericalFaultError(InternalError):
     """Numerical damage detected by a device-side guard — a non-finite
     loss/gradient in the train step, or non-finite logits on a serving
@@ -140,6 +152,7 @@ ERROR_HTTP_STATUS = {
     UnimplementedError: 501,
     ExternalError: 502,            # a dependency outside the framework
     UnavailableError: 503,         # brownout / no healthy replica
+    PageTransportError: 503,       # KV page move failed — transient
     DeadlineExceededError: 504,
     ExecutionTimeoutError: 504,
     CheckpointCorruptError: 500,       # durable state lost server-side

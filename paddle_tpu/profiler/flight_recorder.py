@@ -65,11 +65,12 @@ EV_PREEMPTED = "preempted"        # evicted mid-decode (replays later)
 EV_SNAPSHOT = "snapshot"          # warm-failover checkpoint {tokens}
 EV_RESUMED_ON = "resumed_on"      # failover resume {replica, from}
 EV_RESTARTED = "restarted"        # failover with no checkpoint (token 0)
+EV_SHIPPED = "shipped"            # prefill→decode page ship {replica, pages}
 EV_TERMINAL = "terminal"          # exactly-once final outcome {status}
 LIFECYCLE_EVENTS = frozenset({
     EV_QUEUED, EV_PLACED, EV_ADMITTED, EV_PREFIX_HIT, EV_PREFILL_CHUNK,
     EV_FIRST_TOKEN, EV_SPECULATED, EV_PREEMPTED, EV_SNAPSHOT,
-    EV_RESUMED_ON, EV_RESTARTED, EV_TERMINAL})
+    EV_RESUMED_ON, EV_RESTARTED, EV_SHIPPED, EV_TERMINAL})
 
 BUNDLE_SCHEMA = 1
 

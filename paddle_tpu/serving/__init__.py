@@ -28,6 +28,16 @@ Components
                                 shared-prefix prompts skip straight to
                                 the first uncached token at prefill
                                 (docs/SERVING.md "Prefix caching")
+- ``kv_transport``              tiered KV transport (``PageTransport``
+                                over a host-RAM ``HostTier`` + CRC'd
+                                on-disk ``DiskTier``): prefix-cache
+                                evictions demote pages off-device and
+                                radix hits promote them back instead of
+                                re-prefilling; the same page payloads
+                                ride EngineSnapshots between
+                                disaggregated prefill/decode replicas
+                                (docs/SERVING.md "Tiered KV &
+                                disaggregation")
 - ``spec_decode``               speculative decoding: model-free n-gram
                                 drafter (pluggable ``Drafter``) + one
                                 fused K-token ``serving.spec_verify``
@@ -78,6 +88,7 @@ from .frontend import (ResponseHandle, ServingFrontend,
                        create_serving_frontend)
 from .http import ServingHTTPServer, start_http_server
 from .kv_cache import PagedKVCache
+from .kv_transport import DiskTier, HostTier, PageTransport
 from .metrics import FrontendMetrics, ServingMetrics
 from .prefix_cache import PrefixCache
 from .resilience import (BrownoutController, BrownoutPolicy,
@@ -93,4 +104,4 @@ __all__ = ["ServingEngine", "create_serving_engine", "PagedKVCache",
            "ServingHTTPServer", "start_http_server", "EngineSnapshot",
            "Watchdog", "WatchdogConfig", "BrownoutPolicy",
            "BrownoutController", "Drafter", "NgramDrafter",
-           "SpecDecoder"]
+           "SpecDecoder", "PageTransport", "HostTier", "DiskTier"]

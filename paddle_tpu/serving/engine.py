@@ -360,6 +360,7 @@ class ServingEngine:
                  weight_dtype: Optional[str] = None,
                  quant_scales: Optional[dict] = None,
                  prefix_cache: bool = False,
+                 kv_tiering=False,
                  spec_decode=False,
                  spec_drafter=None,
                  numeric_guards: bool = True,
@@ -542,6 +543,48 @@ class ServingEngine:
                 self.prefix_cache = PrefixCache(self.cache,
                                                 metrics=self.metrics)
                 self.scheduler.prefix_cache = self.prefix_cache
+
+        # --- tiered KV transport (ISSUE 16, docs/SERVING.md "Tiered KV
+        # & disaggregation"): evicted prefix pages demote to a host-RAM
+        # tier (spilling to a CRC'd disk tier) instead of discarding,
+        # and tier hits promote back with one H2D page_restore — ≈10x
+        # cheaper than re-prefilling.  False | True (host tier only,
+        # default capacity) | dict(host_pages=, disk_dir=, disk_pages=).
+        if not isinstance(kv_tiering, (bool, dict)):
+            raise InvalidArgumentError(
+                f"kv_tiering must be a bool or a dict of tier options "
+                f"(host_pages/disk_dir/disk_pages), got {kv_tiering!r}")
+        if kv_tiering and not prefix_cache:
+            # truthy configs must not silently do nothing (the
+            # watchdog=/brownout= validation discipline)
+            raise InvalidArgumentError(
+                "kv_tiering was provided but prefix_cache is off — the "
+                "tiers extend the radix index (pass prefix_cache=True)")
+        self.kv_transport = None
+        if kv_tiering and self.prefix_cache is not None:
+            # int8_dynamic bypasses the prefix cache (and therefore the
+            # tiers) with _prefix_bypass_reason already set — same
+            # documented scale contract
+            opts = dict(kv_tiering) if isinstance(kv_tiering, dict) else {}
+            unknown = set(opts) - {"host_pages", "disk_dir", "disk_pages"}
+            if unknown:
+                raise InvalidArgumentError(
+                    f"unknown kv_tiering option(s) {sorted(unknown)}; "
+                    "expected host_pages/disk_dir/disk_pages")
+            disk_store = None
+            if opts.get("disk_dir"):
+                from ..io.checkpoint import CheckpointStore
+
+                disk_store = CheckpointStore(str(opts["disk_dir"]))
+            from .kv_transport import PageTransport
+
+            self.kv_transport = PageTransport(
+                self._tier_gather, self._tier_restore,
+                host_pages=int(opts.get("host_pages", 64)),
+                disk_store=disk_store,
+                disk_pages=int(opts.get("disk_pages", 0)),
+                metrics=self.metrics)
+            self.prefix_cache.attach_transport(self.kv_transport)
         # chaos-injection key for the "engine.step" site (the frontend
         # sets this to the owning replica's id so fault schedules count
         # per replica instead of racing across pump threads)
@@ -970,6 +1013,54 @@ class ServingEngine:
             self._ttft_recorded.add(seq.seq_id)
             seq.first_token_time = snap.created_at
         self.metrics.on_restore()
+
+    # --- tiered KV transport closures (ISSUE 16) ---------------------------
+    # The PageTransport is device-free: these two closures are its only
+    # window onto the pools, reusing the snapshot machinery's
+    # page_gather / page_restore programs and pow2 row padding (bounded
+    # compile cache).  Both run only at the admission boundary (the
+    # demote window / promote_for), never in steady decode.
+    def _tier_gather(self, page_ids: List[int]) -> List[dict]:
+        """D2H: one payload dict per page, in ``page_ids`` order —
+        per-layer [P, H, D] k/v arrays plus [H] scale rows in
+        int8_static mode (the pool's own dtypes, so a restore is
+        bit-exact)."""
+        rows = np.asarray(page_ids, np.int32)
+        R = len(rows)
+        padded = np.zeros((next_pow2(R),), np.int32)
+        padded[:R] = rows
+        got = jax.device_get(
+            self._page_gather_jit(self._kv, jax.device_put(padded)))
+        return [{key: [np.asarray(a[i]) for a in arrs]
+                 for key, arrs in got.items()} for i in range(R)]
+
+    def _tier_restore(self, page_ids: List[int], payloads: List[dict]):
+        """H2D: scatter promoted payloads into freshly taken pages (the
+        inverse of ``_tier_gather`` — same keys, same dtypes)."""
+        R = len(page_ids)
+        Rp = next_pow2(R)
+        rows_np = np.zeros((Rp,), np.int32)
+        rows_np[:R] = np.asarray(page_ids, np.int32)
+        dev = {}
+        for key in payloads[0]:
+            arrs = []
+            for li in range(len(payloads[0][key])):
+                stacked = np.stack([p[key][li] for p in payloads])
+                if Rp != R:
+                    stacked = np.concatenate(
+                        [stacked,
+                         np.zeros((Rp - R,) + stacked.shape[1:],
+                                  stacked.dtype)])
+                arrs.append(jax.device_put(stacked))
+            dev[key] = arrs
+        if self.kv_cache_dtype != "int8":
+            # native pools carry the model dtype — cast on device, the
+            # _upload_snapshot discipline (no-op when already equal)
+            model_dt = self._kv["k"][0].dtype
+            dev["k"] = [a.astype(model_dt) for a in dev["k"]]
+            dev["v"] = [a.astype(model_dt) for a in dev["v"]]
+        self._kv = self._page_put_jit(self._kv, jax.device_put(rows_np),
+                                      dev)
 
     # --- device-resident lane state ---------------------------------------
     def _grow_state(self, new_bucket: int):
@@ -1546,7 +1637,23 @@ class ServingEngine:
         # stays pipelined under queue pressure
         if sched.waiting and len(sched.running) < sched.max_batch_size:
             emitted += self._sync_pending()
-            admitted = sched.admit()
+            if self.kv_transport is not None:
+                # admission boundary (ISSUE 16): promote tier hits for
+                # the waiting prompts, and open the ONLY window where
+                # evictions demote (admission-pressure reclaims gather
+                # D2H here; decode-time pressure keeps discarding, so
+                # steady decode never pays a transfer)
+                self.kv_transport.chaos_key = self.chaos_key
+                self.kv_transport.demote_window = True
+                try:
+                    for req in sched.waiting:
+                        if req.resume is None and req.use_prefix_cache:
+                            self.prefix_cache.promote_for(req.prompt)
+                    admitted = sched.admit()
+                finally:
+                    self.kv_transport.demote_window = False
+            else:
+                admitted = sched.admit()
             for seq in admitted:
                 flight.request_event(seq.seq_id, EV_ADMITTED,
                                      replica=self.chaos_key,

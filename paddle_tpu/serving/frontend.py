@@ -65,7 +65,7 @@ from ..framework.errors import (AlreadyExistsError,
                                 ResourceExhaustedError, UnavailableError)
 from ..profiler.flight_recorder import (EV_PLACED, EV_QUEUED,
                                         EV_RESTARTED, EV_RESUMED_ON,
-                                        EV_SNAPSHOT)
+                                        EV_SHIPPED, EV_SNAPSHOT)
 from ..profiler.flight_recorder import recorder as flight
 from ..testing.chaos import chaos_site
 from .engine import ServingEngine
@@ -393,6 +393,7 @@ class ServingFrontend:
     """
 
     def __init__(self, model=None, *, replicas: int = 1,
+                 prefill_replicas: int = 0,
                  queue_cap: Optional[int] = 64,
                  default_deadline_ms: Optional[float] = None,
                  engine_kwargs: Optional[dict] = None,
@@ -444,6 +445,16 @@ class ServingFrontend:
           (docs/OBSERVABILITY.md "Request tracing & flight recorder");
           None leaves the recorder's current setting (tracing stays on
           either way — only crash-time bundle WRITES need a directory).
+        - ``prefill_replicas``: disaggregated prefill/decode fleet
+          (ISSUE 16, docs/SERVING.md "Tiered KV & disaggregation"):
+          this many ADDITIONAL replicas (ids ``prefill-<i>``) carry the
+          "prefill" role — fresh submissions place there, and once a
+          request has its first token its filled KV pages SHIP to a
+          "decode"-role replica inside an EngineSnapshot (the failover
+          transport), so decode ITL stops paying for other requests'
+          prefill bursts.  ``replicas`` then counts the decode pool.
+          0 (default) keeps the colocated fleet (every replica role
+          "any") byte-identically.
         """
         if model is None and engine_factory is None:
             raise InvalidArgumentError(
@@ -479,6 +490,13 @@ class ServingFrontend:
                 "ServingEngine(spec_decode=...) inside the factory")
         if replicas < 1:
             raise InvalidArgumentError("replicas must be >= 1")
+        if not isinstance(prefill_replicas, int) \
+                or isinstance(prefill_replicas, bool) \
+                or prefill_replicas < 0:
+            raise InvalidArgumentError(
+                f"prefill_replicas must be an int >= 0, "
+                f"got {prefill_replicas!r}")
+        self._disagg = prefill_replicas > 0
         self.metrics = metrics or FrontendMetrics()
         # ONE ServingMetrics across replicas: the process-global
         # serving.* registry names hold fleet aggregates instead of N
@@ -560,8 +578,15 @@ class ServingFrontend:
         self._closing = False
         self._rid = itertools.count()
         self._replicas: List[Replica] = []
+        # disaggregation (ISSUE 16): when a prefill pool exists the
+        # ``replica-*`` fleet becomes the DECODE pool and ``prefill-*``
+        # replicas fill pages and ship them over; with no prefill pool
+        # every replica stays role "any" (colocated, byte-identical to
+        # the pre-disaggregation fleet)
+        decode_role = "decode" if self._disagg else "any"
         for i in range(int(replicas)):
-            rep = Replica(f"replica-{i}", engine_factory())
+            rep = Replica(f"replica-{i}", engine_factory(),
+                          role=decode_role)
             # engine emits per-token; bind the replica so tokens from a
             # replica the request has been failed away from are dropped
             rep.engine.token_callback = (
@@ -569,6 +594,15 @@ class ServingFrontend:
                 self._emit(rep, rid, idx, tok))
             # chaos "engine.step" faults count per replica, not per
             # whoever's pump thread raced first
+            rep.engine.chaos_key = rep.id
+            self.router.add(rep)
+            self._replicas.append(rep)
+        for i in range(int(prefill_replicas)):
+            rep = Replica(f"prefill-{i}", engine_factory(),
+                          role="prefill")
+            rep.engine.token_callback = (
+                lambda rid, idx, tok, rep=rep:
+                self._emit(rep, rid, idx, tok))
             rep.engine.chaos_key = rep.id
             self.router.add(rep)
             self._replicas.append(rep)
@@ -680,7 +714,10 @@ class ServingFrontend:
                                         detail="deadline expired at "
                                                "submit")
                 return handle
-            rep = self.router.pick(cost=cost)
+            # disaggregated fleets place fresh submissions on the
+            # prefill pool; shipping moves them to decode later
+            place_role = "prefill" if self._disagg else None
+            rep = self.router.pick(cost=cost, role=place_role)
             if rep is not None:
                 self._place_locked(handle, prompt, max_new_tokens, rep,
                                    use_prefix_cache=prefix_cache)
@@ -700,7 +737,8 @@ class ServingFrontend:
         # OUTSIDE the frontend lock — other submissions/pumps proceed
         rep = self.router.pick_with_retry(
             cost=cost, attempts=self._placement_attempts,
-            backoff_s=self._placement_backoff, deadline=deadline)
+            backoff_s=self._placement_backoff, deadline=deadline,
+            role=place_role)
         with self._lock:
             if self._closing:
                 return self._reject_locked(handle, "frontend is closing")
@@ -710,7 +748,7 @@ class ServingFrontend:
                 # collected) before we re-acquired it — placing there
                 # would strand the entry forever.  One locked re-pick
                 # closes the window.
-                rep = self.router.pick(cost=cost)
+                rep = self.router.pick(cost=cost, role=place_role)
             if rep is None:
                 return self._reject_locked(
                     handle, "no healthy replica (after bounded "
@@ -995,6 +1033,7 @@ class ServingFrontend:
                 "snapshot_store": (None if self._snapshot_store is None
                                    else self._snapshot_store.directory),
                 "snapshot_persist_errors": self._persist_errors,
+                "disaggregated": self._disagg,
             },
         }
 
@@ -1182,6 +1221,14 @@ class ServingFrontend:
                     self.watchdog.observe_step(rep.id, step_s)
                 self._harvest(rep, eng)
                 self._maybe_snapshot(rep, eng)
+                if rep.role == "prefill":
+                    self._ship_ready(rep, eng)
+                # snapshot/ship calls SYNC a pipelined engine: a request
+                # whose final token was still in flight at the harvest
+                # above retires during that sync, and with no work left
+                # the pump would idle with its output stranded — sweep
+                # again so the iteration that retires also resolves
+                self._harvest(rep, eng)
                 fault = chaos_site("replica.kill", key=rep.id)
                 if fault is not None and fault.action == "kill":
                     self._kill(rep, f"chaos kill at step {rep.steps}")
@@ -1238,6 +1285,66 @@ class ServingFrontend:
                 except Exception:  # noqa: BLE001 — durability degraded,
                     with self._lock:  # stream unaffected
                         self._persist_errors += 1
+
+    def _ship_ready(self, rep: Replica, eng: ServingEngine):
+        """Disaggregation hand-off (ISSUE 16): move every request on a
+        PREFILL replica that has produced its first token over to the
+        decode pool.  The transport vehicle is the warm-failover
+        ``EngineSnapshot`` — pages come off the device through the same
+        CRC-free but exactly-once snapshot/abort/restore path failover
+        already trusts, so a prefill death mid-ship is indistinguishable
+        from any other replica death (the snapshot re-routes, nothing is
+        half-shipped).  Runs on the prefill replica's pump thread right
+        after its step: snapshot + abort happen with no step in between,
+        so the snapshot is exactly the live stream (``num_generated ==
+        handle.num_tokens``) and the decode replica's re-emission splices
+        seamlessly through ``_on_token``'s forward-progress filter.
+
+        Per-request chaos site ``kv.ship`` (deny → the request simply
+        stays and decodes where it is — colocated fallback, never an
+        error).  No decode capacity → same fallback.
+        """
+        with self._lock:
+            ready = [e for e in self._live.values()
+                     if e.replica is rep and e.in_engine
+                     and not e.cancel_requested and not e.shed_requested
+                     and e.handle.num_tokens >= 1]
+        for entry in ready:
+            rid = entry.handle.request_id
+            fault = chaos_site("kv.ship", key=rid)
+            if fault is not None and fault.action == "deny":
+                continue          # colocated fallback: decode in place
+            t0 = time.perf_counter()
+            snap = eng.snapshot(rid)
+            if snap is None:
+                continue          # finished/preempted meanwhile
+            target = self.router.pick(cost=entry.cost, exclude=rep,
+                                      role="decode")
+            if target is None:
+                continue          # no decode capacity — decode in place
+            if not eng.abort(rid):
+                continue          # completed first — harvest owns it
+            pages = (int(snap.pages["k"][0].shape[0])
+                     if snap.pages.get("k") else 0)
+            self.engine_metrics.on_ship(
+                pages, time.perf_counter() - t0)
+            moved = False
+            with self._lock:
+                if (self._live.get(rid) is entry
+                        and entry.replica is rep):
+                    entry.snapshot = snap
+                    entry.snap_tokens = snap.num_generated
+                    self.router.discharge(rep, entry.cost)
+                    self.router.charge(target, entry.cost)
+                    entry.replica = target
+                    entry.in_engine = False
+                    target.inbox.append(entry)
+                    target.wake.set()
+                    self._update_depth_gauges_locked()
+                    moved = True
+            if moved:
+                flight.request_event(rid, EV_SHIPPED, replica=target.id,
+                                     from_replica=rep.id, pages=pages)
 
     def _harvest(self, rep: Replica, eng: ServingEngine):
         for rid in eng.take_expired():
@@ -1412,10 +1519,10 @@ def create_serving_frontend(model, config=None, **overrides
         engine_kwargs.update(config.serving_config())
         fe_kwargs.update(config.frontend_config())
     engine_kwargs.update(overrides.pop("engine_kwargs", {}))
-    for key in ("replicas", "queue_cap", "default_deadline_ms",
-                "engine_factory", "metrics", "poll_interval_s",
-                "snapshot_interval", "watchdog", "brownout",
-                "placement_attempts", "placement_backoff_s",
+    for key in ("replicas", "prefill_replicas", "queue_cap",
+                "default_deadline_ms", "engine_factory", "metrics",
+                "poll_interval_s", "snapshot_interval", "watchdog",
+                "brownout", "placement_attempts", "placement_backoff_s",
                 "snapshot_store", "prefix_cache", "spec_decode",
                 "bundle_dir"):
         if key in overrides:

@@ -347,6 +347,20 @@ class PagedKVCache:
         if page_id not in self._ref:
             self._free.append(page_id)
 
+    def take_cached_page(self) -> Optional[int]:
+        """Pop one FREE page and hand it straight to the prefix index as
+        cached residency (tier promotion, ISSUE 16): free → cached in
+        one move, so the leak invariant never sees an intermediate
+        state.  Returns None when the free list is empty — promotion
+        deliberately does NOT reclaim: evicting a resident prefix to
+        promote a demoted one would just churn the index, so under
+        pressure the demoted chain stays in its tier (a miss)."""
+        if not self._free:
+            return None
+        page = self._free.pop()
+        self._cached.add(page)
+        return page
+
     # --- page-table export ------------------------------------------------
     def seq_page_ids(self, seq_id: str) -> List[int]:
         """The physical page ids ``seq_id`` currently owns, in order."""

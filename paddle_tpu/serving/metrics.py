@@ -58,6 +58,9 @@ class ServingMetrics:
               # prefix cache (ISSUE 10): tokens' worth of KV the radix
               # index can currently serve (resident sealed pages)
               "serving.prefix.cached_tokens",
+              # tiered KV (ISSUE 16): page payloads currently held by
+              # the host-RAM and disk tiers (demoted, promotable)
+              "serving.prefix.host_pages", "serving.prefix.disk_pages",
               # speculative decoding (ISSUE 12): lifetime fraction of
               # drafted tokens the verifier accepted
               "serving.spec.accept_rate")
@@ -74,6 +77,14 @@ class ServingMetrics:
                 "serving.prefix.hits", "serving.prefix.misses",
                 "serving.prefix.hit_tokens", "serving.prefix.evictions",
                 "serving.prefix.cow",
+                # tiered KV (ISSUE 16): evicted payloads captured into
+                # the host tier instead of discarded, and tier hits
+                # restored to device pages (each one a re-prefill the
+                # H2D copy replaced)
+                "serving.prefix.demotions", "serving.prefix.promotions",
+                # disaggregation (ISSUE 16): KV pages shipped prefill →
+                # decode inside EngineSnapshots
+                "serving.disagg.shipped_pages",
                 # speculative decoding (ISSUE 12): drafted tokens
                 # submitted to the verifier, the split into accepted
                 # (emitted for ~1/K of the bandwidth) vs rejected, and
@@ -88,7 +99,11 @@ class ServingMetrics:
     HISTOGRAMS = ("serving.step_latency_ms", "serving.prefill_latency_ms",
                   "serving.decode_latency_ms", "serving.ttft_ms",
                   "serving.dispatch_gap_ms",
-                  "serving.failover_recovery_ms")
+                  "serving.failover_recovery_ms",
+                  # disaggregation (ISSUE 16): one prefill→decode ship,
+                  # snapshot-gather through re-admission on the decode
+                  # replica
+                  "serving.disagg.transfer_ms")
 
     def __init__(self):
         self._lock = OrderedLock("serving.metrics")
@@ -192,6 +207,31 @@ class ServingMetrics:
 
     def set_prefix_cached_tokens(self, tokens: int):
         stat_registry.get("serving.prefix.cached_tokens").set(int(tokens))
+
+    # --- tiered KV transport (ISSUE 16) ------------------------------------
+    def on_prefix_demote(self, n: int = 1):
+        """An evicted page's payload was captured into the host tier
+        (device→host gather) instead of discarded."""
+        stat_registry.get("serving.prefix.demotions").add(n)
+
+    def on_prefix_promote(self, n: int = 1):
+        """A tier hit was restored into a fresh device page (host→device
+        scatter) and re-published — a re-prefill avoided."""
+        stat_registry.get("serving.prefix.promotions").add(n)
+
+    def set_tier_pages(self, host: int, disk: int):
+        stat_registry.get("serving.prefix.host_pages").set(int(host))
+        stat_registry.get("serving.prefix.disk_pages").set(int(disk))
+
+    def on_ship(self, pages: int, seconds: float):
+        """One prefill→decode handoff: ``pages`` KV pages travelled
+        inside an EngineSnapshot in ``seconds`` (gather on the prefill
+        replica through re-admission on the decode replica)."""
+        if pages > 0:
+            stat_registry.get("serving.disagg.shipped_pages").add(
+                int(pages))
+        stat_registry.histogram("serving.disagg.transfer_ms").observe(
+            seconds * 1e3)
 
     # --- speculative decoding (docs/SERVING.md "Speculative decoding") -----
     def on_spec(self, drafted: int, accepted: int, rejected: int,
@@ -324,7 +364,8 @@ class ServingMetrics:
         snap["prefix"] = {
             short: stat_registry.get(f"serving.prefix.{short}").get()
             for short in ("hits", "misses", "hit_tokens", "evictions",
-                          "cow", "cached_tokens")}
+                          "cow", "cached_tokens", "demotions",
+                          "promotions", "host_pages", "disk_pages")}
         snap["spec"] = {
             short: stat_registry.get(f"serving.spec.{short}").get()
             for short in ("drafted", "accepted", "rejected", "rollbacks",
@@ -332,11 +373,17 @@ class ServingMetrics:
         snap["guard"] = {
             short: stat_registry.get(f"serving.guard.{short}").get()
             for short in ("nan_lanes", "quarantines")}
+        snap["disagg"] = {"shipped_pages": stat_registry.get(
+            "serving.disagg.shipped_pages").get()}
         for name in self.HISTOGRAMS:
             h = stat_registry.histogram(name).snapshot()
             key = name[len("serving."):]
-            snap[key] = {k: h[k] for k in
-                         ("count", "mean", "p50", "p95", "p99")}
+            summary = {k: h[k] for k in
+                       ("count", "mean", "p50", "p95", "p99")}
+            if key.startswith("disagg."):
+                snap["disagg"][key[len("disagg."):]] = summary
+            else:
+                snap[key] = summary
         return snap
 
 

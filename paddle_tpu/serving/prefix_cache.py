@@ -31,10 +31,22 @@ How the pieces fit (docs/SERVING.md "Prefix caching"):
   the chunked prefill at the first uncached token; the ``valid_len``
   machinery already handles ragged starts, so the skipped tokens cost
   zero dispatches and zero FLOPs.
-- **Eviction**: pages whose refcount is 0 stay RESIDENT in the index
-  (evictable, not free) and are reclaimed leaf-first in LRU order only
-  when an allocation would otherwise fail — cached prefixes always
-  yield to live sequences before any preemption fires.
+- **Eviction → demotion** (ISSUE 16): pages whose refcount is 0 stay
+  RESIDENT in the index (evictable, not free) and are reclaimed
+  leaf-first in LRU order only when an allocation would otherwise fail
+  — cached prefixes always yield to live sequences before any
+  preemption fires.  With a ``kv_transport.PageTransport`` attached,
+  every eviction routes through its demotion hook: inside the engine's
+  admission window the payload is gathered to the host tier before the
+  device page frees; outside it (decode-time pressure) or with no
+  transport the page discards exactly as before — tier-off configs are
+  byte-identical to the pre-tier behavior, pinned in
+  tests/test_kv_transport.py.
+- **Promotion** (ISSUE 16): ``promote_for`` extends a radix walk past
+  the resident trie by consulting the tiers with the full token-chain
+  key; a tier hit takes a free page, scatters the payload H2D and
+  re-publishes the node, so the ``match`` that follows sees it exactly
+  like an always-resident hit.
 
 Sealing (who publishes pages): at ADMISSION a sequence seals every full
 prompt page strictly before the page its first decode write touches; at
@@ -117,7 +129,15 @@ class PrefixCache:
         self.hit_tokens = 0
         self.evictions = 0
         self.cow_copies = 0
+        # optional kv_transport.PageTransport: evictions demote through
+        # it, promote_for restores through it (None = tier-off, the
+        # pre-ISSUE-16 discard behavior byte-identically)
+        self.transport = None
         cache.set_reclaimer(self.evict)
+
+    def attach_transport(self, transport):
+        """Attach the tiered page transport (engine wiring, ISSUE 16)."""
+        self.transport = transport
 
     # --- lookup -------------------------------------------------------------
     def _chunks(self, tokens: np.ndarray, limit_pages: int):
@@ -209,10 +229,77 @@ class PrefixCache:
         return released
 
     def _drop_node(self, node: _Node):
+        # EVERY eviction funnels through here — the single demotion
+        # hook (ISSUE 16).  The transport captures the payload host-side
+        # (or declines: no transport, window closed, chaos deny, gather
+        # failure); the device page releases either way, so demotion can
+        # change WHERE the payload survives but never the allocator's
+        # accounting — tier-off behavior is byte-identical.
+        if self.transport is not None:
+            self.transport.demote(self._chain_key(node), node.page)
         del self._by_page[node.page]
         if node.parent is not None:
             node.parent.children.pop(node.chunk, None)
         self.cache.release_cached(node.page)
+
+    @staticmethod
+    def _chain_key(node: _Node) -> Tuple[int, ...]:
+        """The FULL token chain from the prompt start through ``node``'s
+        page — the tier key (page content is a function of the whole
+        prefix, never of the node's own chunk alone)."""
+        chunks: List[Tuple[int, ...]] = []
+        walk: Optional[_Node] = node
+        while walk is not None and walk.parent is not None:
+            chunks.append(walk.chunk)
+            walk = walk.parent
+        key: List[int] = []
+        for chunk in reversed(chunks):
+            key.extend(chunk)
+        return tuple(key)
+
+    # --- tier promotion (ISSUE 16) ------------------------------------------
+    def promote_for(self, prompt: np.ndarray) -> int:
+        """Extend the resident trie along ``prompt`` from the tiers:
+        where the radix walk would fall off, fetch the chain's payload
+        (host tier, then disk), take a free page, restore H2D and
+        publish the node — the ``match`` that follows maps it like an
+        always-resident hit.  Engine-called at ADMISSION only (the same
+        boundary where demotions run), so steady decode never pays an
+        H2D copy.  Stops at the first miss (deeper chains cannot be
+        resident without their parents).  Returns pages promoted."""
+        if self.transport is None:
+            return 0
+        node = self._root
+        key: List[int] = []
+        promoted = 0
+        for chunk in self._chunks(prompt, self.cache.pages_per_seq):
+            key.extend(chunk)
+            child = node.children.get(chunk)
+            if child is not None:
+                node = child
+                continue
+            payload = self.transport.fetch(tuple(key))
+            if payload is None:
+                break
+            page = self.cache.take_cached_page()
+            if page is None:
+                # no free page: promotion never evicts (that would just
+                # churn the index) — stay demoted, admission re-prefills
+                break
+            try:
+                self.transport.restore_page(page, payload)
+            except Exception:  # noqa: BLE001 — degrade to a miss
+                self.cache.release_cached(page)
+                break
+            child = _Node(chunk, page, node)
+            node.children[chunk] = child
+            self._by_page[page] = child
+            child.lru = next(self._clock)
+            node = child
+            promoted += 1
+        if promoted:
+            self._publish_gauge()
+        return promoted
 
     # --- accounting ---------------------------------------------------------
     def on_admission(self, matched_tokens: int):
@@ -259,7 +346,7 @@ class PrefixCache:
         self.evictions = self.cow_copies = 0
 
     def stats(self) -> dict:
-        return {
+        out = {
             "enabled": True,
             "pages": self.num_pages,
             "cached_tokens": self.cached_tokens,
@@ -270,3 +357,6 @@ class PrefixCache:
             "evictions": self.evictions,
             "cow_copies": self.cow_copies,
         }
+        if self.transport is not None:
+            out["tiers"] = self.transport.stats()
+        return out

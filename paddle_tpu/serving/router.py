@@ -13,6 +13,18 @@ placement      least-outstanding-tokens — a new request goes to the
                retry-with-backoff for transient no-routable-replica
                conditions (every replica momentarily SUSPECT) instead
                of failing the request on first error.
+roles          two-stage scheduling (ISSUE 16, disaggregated prefill/
+               decode): each replica carries a ROLE — ``"prefill"``
+               (fills pages, ships them), ``"decode"`` (receives
+               shipped pages, streams tokens) or ``"any"`` (colocated,
+               the default).  ``pick(role=...)`` places within the
+               matching pool ("any" replicas belong to every pool);
+               when a pool has no healthy member the pick FALLS BACK to
+               the full healthy set — a dead prefill fleet degrades to
+               colocated serving, never to an outage.  Each pool's
+               health is independently visible in ``healthz()``, so
+               the existing watchdog/brownout machinery (and an
+               autoscaler reading it) reasons per pool.
 health         a replica is routable only in the HEALTHY state.
                SUSPECT replicas (watchdog: overdue/hung step) take
                nothing new until the watchdog re-admits them after an
@@ -64,9 +76,18 @@ class Replica:
     reacts immediately instead of on its poll timeout.
     """
 
-    def __init__(self, replica_id: str, engine):
+    def __init__(self, replica_id: str, engine, role: str = "any"):
+        if role not in ("any", "prefill", "decode"):
+            from ..framework.errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                f"replica role must be 'any', 'prefill' or 'decode', "
+                f"got {role!r}")
         self.id = str(replica_id)
         self.engine = engine
+        # disaggregation pool membership (ISSUE 16): "any" serves both
+        # pools (the colocated default)
+        self.role = role
         self.state = HEALTHY
         self.dead_reason = ""
         self.inbox: List = []                # guarded by the frontend lock
@@ -107,6 +128,7 @@ class Replica:
     def status(self) -> dict:
         return {
             "id": self.id,
+            "role": self.role,
             "state": self.state,
             "dead_reason": self.dead_reason or None,
             "steps": self.steps,
@@ -147,14 +169,22 @@ class Router:
 
     # --- placement ----------------------------------------------------------
     def pick(self, cost: int = 0,
-             exclude: Optional[Replica] = None) -> Optional[Replica]:
+             exclude: Optional[Replica] = None,
+             role: Optional[str] = None) -> Optional[Replica]:
         """The healthy replica with the least outstanding work (tokens),
         ties broken by id; None when no healthy replica exists.  ``cost``
         is accepted for symmetry with charge() but does not affect the
-        choice."""
+        choice.  ``role`` restricts the pick to that pool ("any"
+        replicas belong to every pool); an empty pool falls back to ALL
+        healthy replicas — disaggregation degrades to colocation, never
+        to an outage."""
         with self._lock:
             cands = [r for r in self.replicas
                      if r.state == HEALTHY and r is not exclude]
+            if role is not None:
+                pool = [r for r in cands if r.role in (role, "any")]
+                if pool:
+                    cands = pool
             if not cands:
                 return None
             return min(cands, key=lambda r: (r.outstanding_tokens, r.id))
@@ -162,7 +192,8 @@ class Router:
     def pick_with_retry(self, cost: int = 0,
                         exclude: Optional[Replica] = None,
                         attempts: int = 4, backoff_s: float = 0.02,
-                        deadline: Optional[float] = None
+                        deadline: Optional[float] = None,
+                        role: Optional[str] = None
                         ) -> Optional[Replica]:
         """``pick`` with bounded retry-with-backoff for TRANSIENT
         placement failures: when no replica is routable right now (all
@@ -175,7 +206,7 @@ class Router:
         ``serving.retries_backoff``."""
         delay = float(backoff_s)
         for i in range(max(1, int(attempts))):
-            rep = self.pick(cost=cost, exclude=exclude)
+            rep = self.pick(cost=cost, exclude=exclude, role=role)
             if rep is not None:
                 return rep
             with self._lock:
@@ -264,9 +295,18 @@ class Router:
             reps = [r.status() for r in self.replicas]
             healthy = sum(1 for r in self.replicas if r.state == HEALTHY)
             suspect = sum(1 for r in self.replicas if r.state == SUSPECT)
+            # per-pool health (ISSUE 16): "any" replicas back both
+            # pools, so each count answers "can this STAGE make
+            # progress" — what an autoscaler scales on
+            pools = {
+                stage: sum(1 for r in self.replicas
+                           if r.state == HEALTHY
+                           and r.role in (stage, "any"))
+                for stage in ("prefill", "decode")}
         return {
             "healthy_replicas": healthy,
             "suspect_replicas": suspect,
             "total_replicas": len(reps),
+            "healthy_by_role": pools,
             "replicas": reps,
         }

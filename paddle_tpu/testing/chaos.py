@@ -51,6 +51,25 @@ Instrumented sites (grep ``chaos_site(`` for the live list)
                       motivating failure — an argmax over NaN logits
                       streaming token 0 forever (ISSUE 13).
 
+``kv.demote``         PageTransport.demote (ISSUE 16 tiered KV) —
+                      ``deny`` makes the eviction-time D2H gather fail,
+                      so the evicted prefix page is DISCARDED instead of
+                      demoted to the host tier (the page itself is
+                      released either way — a failed demotion can only
+                      cost a future promotion hit, never leak a page or
+                      corrupt a tier).  Key: the engine's chaos/replica
+                      key.
+``kv.promote``        PageTransport.fetch, admission-time tier lookup —
+                      ``deny`` turns the lookup into a MISS (the prompt
+                      re-prefills from scratch; answers are unchanged,
+                      only the TTFT saving is lost).  Key: the engine's
+                      chaos/replica key.
+``kv.ship``           frontend._ship_ready, the prefill→decode page
+                      hand-off — ``deny`` skips the ship, so the request
+                      decodes in place on the prefill replica (colocated
+                      fallback; the stream is unchanged).  Key: request
+                      id.
+
 Training-side sites (ISSUE 9 — docs/CHECKPOINT.md "Chaos sites"):
 
 ``train.step``        hapi fit step driver, before each train step —

@@ -1,0 +1,234 @@
+"""Disaggregated prefill/decode fleet (ISSUE 16): role-tagged replicas
++ snapshot-vehicle page shipping.
+
+Acceptance anchors:
+- ``prefill_replicas>0`` splits the fleet: fresh submissions place on
+  the prefill pool, and after the first token the pump SHIPS the
+  request (snapshot → abort → requeue) to the least-loaded decode
+  replica — streams BYTE-IDENTICAL to colocated serving;
+- a prefill replica dying mid-stream re-routes its requests through the
+  existing failover path (the shipped snapshot doubles as the warm
+  checkpoint) — no corrupted pages, everything completes;
+- chaos ``kv.ship`` denial and an empty decode pool degrade to
+  colocation (decode in place), never to an outage;
+- router role pools: ``pick(role=...)`` prefers the pool, falls back to
+  all healthy replicas when the pool is empty; per-pool health is
+  visible in ``healthz()``.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.framework.errors import InvalidArgumentError
+from paddle_tpu.serving import ServingEngine, ServingFrontend
+from paddle_tpu.serving.router import DEAD, Replica, Router
+from paddle_tpu.testing import chaos
+from paddle_tpu.testing.chaos import ChaosPlan, Fault
+
+VOCAB = 50
+ENGINE_KW = dict(page_size=4, max_batch_size=4, eos_id=0)
+
+
+@pytest.fixture(autouse=True)
+def _lock_witness():
+    from paddle_tpu.framework import concurrency
+
+    with concurrency.witness(raise_on_violation=False):
+        yield
+    concurrency.assert_clean()
+
+
+@pytest.fixture(scope="module")
+def gpt(shared_gpt_small):
+    return shared_gpt_small
+
+
+def _drain(eng):
+    out = {}
+    while eng.scheduler.has_work() or eng._pending:
+        eng.step()
+        out.update({k: eng.take_output(k) for k in list(eng.outputs)})
+    return out
+
+
+def _colocated_reference(gpt, prompts, budget):
+    eng = ServingEngine(gpt, **ENGINE_KW)
+    rids = [eng.add_request(p, max_new_tokens=budget) for p in prompts]
+    outs = _drain(eng)
+    return [outs[r] for r in rids]
+
+
+# =============================================================================
+# Router role pools (host-only)
+# =============================================================================
+class TestRouterRoles:
+    def test_role_validation(self):
+        with pytest.raises(InvalidArgumentError):
+            Replica("r0", engine=None, role="verifier")
+        assert Replica("r1", engine=None).role == "any"
+
+    def test_pick_prefers_pool_and_falls_back(self):
+        r = Router()
+        pre = Replica("prefill-0", engine=None, role="prefill")
+        dec = Replica("replica-0", engine=None, role="decode")
+        r.add(pre)
+        r.add(dec)
+        assert r.pick(role="prefill") is pre
+        assert r.pick(role="decode") is dec
+        # pool empty -> full healthy set (degrade to colocation)
+        pre.state = DEAD
+        assert r.pick(role="prefill") is dec
+        hz = r.healthz()
+        assert hz["healthy_by_role"] == {"prefill": 0, "decode": 1}
+        assert hz["replicas"][0]["role"] == "prefill"
+
+    def test_any_serves_both_pools(self):
+        r = Router()
+        anyrep = Replica("replica-0", engine=None, role="any")
+        r.add(anyrep)
+        assert r.pick(role="prefill") is anyrep
+        assert r.pick(role="decode") is anyrep
+        assert r.healthz()["healthy_by_role"] == {
+            "prefill": 1, "decode": 1}
+
+
+# =============================================================================
+# Fleet integration
+# =============================================================================
+class TestDisaggFleet:
+    def test_ships_and_streams_byte_identical(self, gpt):
+        """The headline: a 1-prefill/1-decode fleet completes every
+        request byte-identical to colocated serving, with the pages
+        actually moving (shipped_pages > 0, `shipped` lifecycle
+        events)."""
+        rng = np.random.RandomState(41)
+        prompts = [rng.randint(1, VOCAB, (k,)).astype(np.int32)
+                   for k in (5, 9, 7, 12)]
+        fe = ServingFrontend(gpt, replicas=1, prefill_replicas=1,
+                             queue_cap=32,
+                             engine_kwargs=dict(ENGINE_KW))
+        try:
+            handles = [fe.submit(p, max_new_tokens=10) for p in prompts]
+            assert [h.wait(timeout=300) for h in handles] == \
+                ["completed"] * 4
+            st = fe.stats()
+            assert st["engines"]["disagg"]["shipped_pages"] > 0
+            assert st["engines"]["disagg"]["transfer_ms"]["count"] >= 1
+            assert st["router"]["healthy_by_role"] == {
+                "prefill": 1, "decode": 1}
+            assert st["resilience"]["disaggregated"] is True
+            # decode replica finished the streams: it stepped, and the
+            # prefill engine retired nothing to completion itself
+            dec = fe.router.get("replica-0")
+            assert dec.steps > 0
+        finally:
+            fe.close()
+        for h, ref in zip(handles,
+                          _colocated_reference(gpt, prompts, 10)):
+            np.testing.assert_array_equal(h.tokens, ref)
+
+    def test_ship_deny_decodes_in_place(self, gpt):
+        """kv.ship denial (chaos) keeps requests decoding on the
+        prefill replica — colocated fallback, streams unchanged."""
+        rng = np.random.RandomState(42)
+        prompts = [rng.randint(1, VOCAB, (k,)).astype(np.int32)
+                   for k in (6, 8)]
+        plan = ChaosPlan([Fault("kv.ship", at=1, action="deny",
+                                count=10 ** 6)], name="ship-deny")
+        fe = ServingFrontend(gpt, replicas=1, prefill_replicas=1,
+                             queue_cap=32,
+                             engine_kwargs=dict(ENGINE_KW))
+        try:
+            with chaos.running(plan):
+                handles = [fe.submit(p, max_new_tokens=8)
+                           for p in prompts]
+                assert [h.wait(timeout=300) for h in handles] == \
+                    ["completed"] * 2
+            assert any(e["site"] == "kv.ship" for e in plan.fired_log())
+            assert fe.stats()["engines"]["disagg"]["shipped_pages"] == 0
+        finally:
+            fe.close()
+        for h, ref in zip(handles,
+                          _colocated_reference(gpt, prompts, 8)):
+            np.testing.assert_array_equal(h.tokens, ref)
+
+    def test_short_budget_requests_never_strand(self, gpt):
+        """Regression: ``snapshot``/``abort`` during shipping SYNC a
+        pipelined engine, which can retire a request AFTER the pump's
+        harvest pass already ran that iteration; the pump's re-sweep
+        must resolve it.  Without the re-sweep, a short-budget request
+        whose final token was in flight at harvest time strands in
+        ``eng.outputs`` forever (handle stuck 'running')."""
+        rng = np.random.RandomState(44)
+        fe = ServingFrontend(gpt, replicas=1, prefill_replicas=1,
+                             queue_cap=32,
+                             engine_kwargs=dict(ENGINE_KW))
+        try:
+            handles = [fe.submit(
+                rng.randint(1, VOCAB, (6,)).astype(np.int32),
+                max_new_tokens=2) for _ in range(3)]
+            assert [h.wait(timeout=300) for h in handles] == \
+                ["completed"] * 3
+            assert all(h.num_tokens >= 1 for h in handles)
+        finally:
+            fe.close()
+
+    def test_prefill_death_reroutes_no_corruption(self, gpt):
+        """A prefill replica killed mid-stream: its live requests fail
+        over through the standard path (the shipped snapshot IS the
+        warm checkpoint), later submissions fall back to the decode
+        pool, and every stream still matches the colocated reference."""
+        rng = np.random.RandomState(43)
+        prompts = [rng.randint(1, VOCAB, (k,)).astype(np.int32)
+                   for k in (7, 10, 6, 9)]
+        fe = ServingFrontend(gpt, replicas=1, prefill_replicas=1,
+                             queue_cap=32, snapshot_interval=4,
+                             engine_kwargs=dict(ENGINE_KW))
+        try:
+            fe.inject_failure("prefill-0", at_step=2)
+            handles = [fe.submit(p, max_new_tokens=10) for p in prompts]
+            assert [h.wait(timeout=300) for h in handles] == \
+                ["completed"] * 4
+            assert fe.router.get("prefill-0").state == DEAD
+            hz = fe.stats()["router"]["healthy_by_role"]
+            assert hz == {"prefill": 0, "decode": 1}
+            leaks = fe.router.get("replica-0").engine.cache.pages_in_use
+            assert leaks == 0
+        finally:
+            fe.close()
+        for h, ref in zip(handles,
+                          _colocated_reference(gpt, prompts, 10)):
+            np.testing.assert_array_equal(h.tokens, ref)
+
+
+# =============================================================================
+# Knob surface
+# =============================================================================
+class TestDisaggKnob:
+    def test_validation_and_colocated_default(self, gpt):
+        with pytest.raises(InvalidArgumentError):
+            ServingFrontend(gpt, prefill_replicas=-1,
+                            engine_kwargs=dict(ENGINE_KW))
+        with pytest.raises(InvalidArgumentError):
+            ServingFrontend(gpt, prefill_replicas=True,
+                            engine_kwargs=dict(ENGINE_KW))
+        fe = ServingFrontend(gpt, replicas=2,
+                             engine_kwargs=dict(ENGINE_KW))
+        try:
+            assert all(rep.role == "any" for rep in fe._replicas)
+            assert fe.stats()["resilience"]["disaggregated"] is False
+        finally:
+            fe.close()
+
+    def test_create_serving_frontend_passes_knob(self, gpt):
+        from paddle_tpu.inference import Config
+        from paddle_tpu.serving.frontend import create_serving_frontend
+
+        cfg = Config()
+        cfg.enable_serving(page_size=4, max_batch_size=4, eos_id=0)
+        fe = create_serving_frontend(gpt, cfg, prefill_replicas=1)
+        try:
+            roles = sorted((rep.id, rep.role) for rep in fe._replicas)
+            assert roles == [("prefill-0", "prefill"),
+                             ("replica-0", "decode")]
+        finally:
+            fe.close()

@@ -1,0 +1,439 @@
+"""Tiered KV transport (ISSUE 16): host/disk prefix tiers over the
+radix index.
+
+Acceptance anchors:
+- eviction DEMOTES refcount-0 prefix pages to a host-RAM tier (D2H via
+  ``serving.page_gather``) instead of discarding; a later radix walk
+  PROMOTES them back (H2D via ``serving.page_restore``) and the tiered
+  stream is BYTE-IDENTICAL to the always-resident one — including
+  ``int8_static`` scale rows;
+- host-tier overflow spills to a disk tier reusing the CheckpointStore
+  CRC'd atomic format; a corrupt/torn disk entry is a MISS (re-prefill),
+  never a wrong answer;
+- zero-leak invariant across tiers: the device equation
+  ``in_use + cached + free == N-1`` holds through demote/promote churn;
+- chaos sites ``kv.demote`` / ``kv.promote`` degrade (discard / miss)
+  without corrupting a stream, deterministically under double-drive;
+- steady decode stays transfer-guard- and ``compile_budget(0)``-clean
+  with tiering on (demote/promote run at admission only).
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.framework.errors import (InvalidArgumentError,
+                                         PageTransportError)
+from paddle_tpu.io.checkpoint import CheckpointStore
+from paddle_tpu.profiler.jit_cost import compile_budget
+from paddle_tpu.serving import ServingEngine
+from paddle_tpu.serving.kv_transport import (DiskTier, HostTier,
+                                             PageTransport, chain_key,
+                                             payload_nbytes)
+from paddle_tpu.testing import chaos
+from paddle_tpu.testing.chaos import ChaosPlan, Fault
+
+VOCAB = 50
+ENGINE_KW = dict(page_size=4, max_batch_size=4, eos_id=0)
+
+
+@pytest.fixture(autouse=True)
+def _lock_witness():
+    from paddle_tpu.framework import concurrency
+
+    with concurrency.witness(raise_on_violation=False):
+        yield
+    concurrency.assert_clean()
+
+
+@pytest.fixture(scope="module")
+def gpt(shared_gpt_small):
+    return shared_gpt_small
+
+
+_MEMO = None
+
+
+@pytest.fixture(autouse=True)
+def _bind_ref_memo(greedy_ref_memo):
+    global _MEMO
+    _MEMO = greedy_ref_memo
+
+
+def _reference(gpt, prompt, budget, end_id=0):
+    w = _MEMO(gpt, prompt, budget, end_id=end_id)
+    if end_id >= 0 and (w == end_id).any():
+        w = w[: int(np.argmax(w == end_id)) + 1]
+    return w
+
+
+def _drain(eng):
+    out = {}
+    while eng.scheduler.has_work() or eng._pending:
+        eng.step()
+        out.update({k: eng.take_output(k) for k in list(eng.outputs)})
+    return out
+
+
+def _invariant(cache):
+    assert (cache.pages_in_use + cache.pages_cached + cache.free_pages
+            == cache.num_pages - 1)
+
+
+def _payload(seed, nbytes=32):
+    rng = np.random.RandomState(seed)
+    return {"k": [rng.rand(2, 2, 2).astype(np.float32)],
+            "v": [rng.rand(2, 2, 2).astype(np.float32)]}
+
+
+def _evict_all(eng):
+    """Demote every cached page through the admission window (the same
+    window the engine opens around ``Scheduler.admit``)."""
+    eng.kv_transport.demote_window = True
+    try:
+        return eng.prefix_cache.evict(eng.cache.pages_cached)
+    finally:
+        eng.kv_transport.demote_window = False
+
+
+# =============================================================================
+# Host-only units: tiers + transport policy (numpy fakes, no device)
+# =============================================================================
+class TestHostTier:
+    def test_lru_spill_order_and_refresh(self):
+        t = HostTier(2)
+        pa, pb, pc = _payload(1), _payload(2), _payload(3)
+        assert t.put((1,), pa) == []
+        assert t.put((2,), pb) == []
+        t.get((1,))                      # refresh: (2,) is now LRU
+        spilled = t.put((3,), pc)
+        assert [k for k, _ in spilled] == [(2,)]
+        assert (1,) in t and (3,) in t and (2,) not in t
+        assert t.nbytes() == payload_nbytes(pa) + payload_nbytes(pc)
+
+    def test_zero_capacity_spills_immediately(self):
+        t = HostTier(0)
+        p = _payload(4)
+        assert t.put((9,), p) == [((9,), p)]
+        assert len(t) == 0
+        with pytest.raises(InvalidArgumentError):
+            HostTier(-1)
+
+
+class TestDiskTier:
+    def test_round_trip_capacity_and_collision_guard(self, tmp_path):
+        store = CheckpointStore(str(tmp_path), keep_last=64)
+        t = DiskTier(store, capacity_pages=2)
+        p1, p2, p3 = _payload(5), _payload(6), _payload(7)
+        t.put((1, 2), p1)
+        t.put((3, 4), p2)
+        got = t.get((1, 2))
+        np.testing.assert_array_equal(got["k"][0], p1["k"][0])
+        assert "_chain" not in got       # the key rides inside, stripped
+        t.put((5, 6), p3)                # capacity 2: oldest slot retired
+        assert t.get((1, 2)) is None and len(t) == 2
+        # a slot whose stored chain mismatches the requested key (the
+        # sha1-collision shape) is a miss, never foreign content
+        t._names[(9, 9)] = t._names[(3, 4)]
+        assert t.get((9, 9)) is None
+
+    def test_corrupt_slot_is_miss_and_retired(self, tmp_path):
+        store = CheckpointStore(str(tmp_path), keep_last=64)
+        t = DiskTier(store, capacity_pages=4)
+        t.put((1, 2, 3), _payload(8))
+        name = t._names[(1, 2, 3)]
+        with open(store._slot_path(name), "wb") as f:
+            f.write(b"torn")
+        assert t.get((1, 2, 3)) is None  # CRC fails -> miss, not raise
+        assert (1, 2, 3) not in t._names
+        assert name not in store.named()
+
+
+class TestTransportPolicy:
+    def test_window_spill_and_fetch_order(self, tmp_path):
+        store = CheckpointStore(str(tmp_path), keep_last=64)
+        payloads = {1: _payload(11), 2: _payload(12), 3: _payload(13)}
+        tr = PageTransport(lambda ids: [payloads[i] for i in ids],
+                           lambda ids, ps: None,
+                           host_pages=1, disk_store=store, disk_pages=8)
+        # outside the admission window: discard (tier-off behavior)
+        assert not tr.demote((1,), 1)
+        assert tr.demote_denied == 1 and tr.host_pages == 0
+        tr.demote_window = True
+        assert tr.demote((1,), 1)
+        assert tr.demote((2,), 2)        # host cap 1 -> (1,) spills
+        assert tr.host_pages == 1 and tr.disk_pages == 1
+        got = tr.fetch((1,))             # host miss -> disk hit
+        np.testing.assert_array_equal(got["k"][0], payloads[1]["k"][0])
+        assert tr.disk_hits == 1
+        assert tr.fetch((7,)) is None
+        st = tr.stats()
+        assert st["demotions"] == 2 and st["host_capacity"] == 1
+
+    def test_gather_failure_degrades_restore_failure_raises(self):
+        def boom(_ids):
+            raise RuntimeError("gather broke")
+
+        tr = PageTransport(boom, lambda ids, ps: boom(ids), host_pages=4)
+        tr.demote_window = True
+        assert not tr.demote((1,), 1)    # degrade: discard, no raise
+        assert tr.demote_denied == 1
+        with pytest.raises(PageTransportError):
+            tr.restore_page(3, _payload(14))
+        with pytest.raises(InvalidArgumentError):
+            PageTransport(boom, boom, disk_pages=4)  # needs a store
+
+    def test_chaos_demote_and_promote_deny(self):
+        payloads = {1: _payload(15)}
+        tr = PageTransport(lambda ids: [payloads[i] for i in ids],
+                           lambda ids, ps: None, host_pages=4)
+        tr.demote_window = True
+        plan = ChaosPlan([Fault("kv.demote", at=1, action="deny"),
+                          Fault("kv.promote", at=1, action="deny")],
+                         name="tier-deny")
+        with chaos.running(plan):
+            assert not tr.demote((1,), 1)   # denied -> discarded
+            assert tr.demote((1,), 1)       # next attempt lands
+            assert tr.fetch((1,)) is None   # denied -> miss
+            assert tr.fetch((1,)) is not None
+        assert sorted(e["site"] for e in plan.fired_log()) == [
+            "kv.demote", "kv.promote"]
+
+    def test_chain_key_canonicalizes(self):
+        assert chain_key(np.asarray([3, 4], np.int32)) == (3, 4)
+        assert chain_key([3, 4]) == (3, 4)
+
+
+# =============================================================================
+# Engine integration: demote -> promote round trips
+# =============================================================================
+class TestEngineRoundTrip:
+    def test_demote_promote_byte_identical(self, gpt):
+        """The headline: serve A, demote its sealed pages to the host
+        tier, then serve B sharing A's prefix — the promoted pages hit
+        like always-resident ones and the stream is byte-identical to
+        the tier-off / cache-off references."""
+        rng = np.random.RandomState(31)
+        p8 = rng.randint(1, VOCAB, (8,)).astype(np.int32)
+        pb = np.concatenate([p8,
+                             rng.randint(1, VOCAB, (5,)).astype(np.int32)])
+        eng = ServingEngine(gpt, prefix_cache=True, kv_tiering=True,
+                            **ENGINE_KW)
+        eng.add_request(p8, max_new_tokens=6, request_id="a")
+        outs = _drain(eng)
+        assert _evict_all(eng) >= 2
+        assert eng.cache.pages_cached == 0
+        tiers = eng.prefix_cache.stats()["tiers"]
+        assert tiers["demotions"] >= 2 and tiers["host_pages"] >= 2
+        _invariant(eng.cache)
+        eng.add_request(pb, max_new_tokens=6, request_id="b")
+        outs.update(_drain(eng))
+        tiers = eng.prefix_cache.stats()["tiers"]
+        assert tiers["promotions"] == 2     # pb shares p8's 2 full pages
+        assert eng.prefix_cache.hits == 1
+        np.testing.assert_array_equal(outs["a"], _reference(gpt, p8, 6))
+        np.testing.assert_array_equal(outs["b"], _reference(gpt, pb, 6))
+        assert eng.cache.pages_in_use == 0
+        _invariant(eng.cache)
+        # engine stats surface the tier section
+        assert eng.stats()["prefix_cache"]["tiers"]["promotions"] == 2
+
+    def test_int8_static_scale_rows_round_trip(self, gpt):
+        """int8_static payloads carry the per-page scale rows through
+        the tiers — the promoted stream matches the tier-off int8
+        engine byte-for-byte."""
+        from paddle_tpu.slim import export_serving_quant
+
+        rng = np.random.RandomState(32)
+        quant = export_serving_quant(
+            gpt, calib_prompts=rng.randint(1, VOCAB,
+                                           (4, 12)).astype(np.int32))
+        p8 = rng.randint(1, VOCAB, (8,)).astype(np.int32)
+        pb = np.concatenate([p8,
+                             rng.randint(1, VOCAB, (4,)).astype(np.int32)])
+        got = {}
+        for name, tiering in (("tiered", True), ("off", False)):
+            eng = ServingEngine(gpt, kv_cache_dtype="int8",
+                                quant_scales=quant, prefix_cache=True,
+                                kv_tiering=tiering, **ENGINE_KW)
+            eng.add_request(p8, max_new_tokens=6, request_id="a")
+            _drain(eng)
+            if tiering:
+                assert _evict_all(eng) >= 2
+            eng.add_request(pb, max_new_tokens=6, request_id="b")
+            got[name] = _drain(eng)["b"]
+            assert eng.cache.pages_in_use == 0
+            _invariant(eng.cache)
+        np.testing.assert_array_equal(got["tiered"], got["off"])
+
+    def test_disk_spill_hit_and_corrupt_miss(self, gpt, tmp_path):
+        """host_pages=1 forces demotions through the disk tier; a
+        promotion comes back from disk byte-identical.  Corrupting the
+        slots degrades to a miss — the stream still matches (re-prefill),
+        nothing raises."""
+        rng = np.random.RandomState(33)
+        p8 = rng.randint(1, VOCAB, (8,)).astype(np.int32)
+        pb = np.concatenate([p8,
+                             rng.randint(1, VOCAB, (5,)).astype(np.int32)])
+
+        def build():
+            return ServingEngine(
+                gpt, prefix_cache=True,
+                kv_tiering=dict(host_pages=1, disk_dir=str(tmp_path),
+                                disk_pages=16), **ENGINE_KW)
+
+        eng = build()
+        eng.add_request(p8, max_new_tokens=6, request_id="a")
+        _drain(eng)
+        assert _evict_all(eng) >= 2
+        tiers = eng.prefix_cache.stats()["tiers"]
+        assert tiers["host_pages"] == 1 and tiers["disk_pages"] >= 1
+        eng.add_request(pb, max_new_tokens=6, request_id="b")
+        out_b = _drain(eng)["b"]
+        tiers = eng.prefix_cache.stats()["tiers"]
+        assert tiers["promotions"] == 2 and tiers["disk_hits"] >= 1
+        np.testing.assert_array_equal(out_b, _reference(gpt, pb, 6))
+        # second engine, same spill dir, slots torn: MISS not wrong
+        eng2 = build()
+        eng2.add_request(p8, max_new_tokens=6, request_id="a")
+        _drain(eng2)
+        assert _evict_all(eng2) >= 2
+        store = eng2.kv_transport.disk.store
+        for name in store.named():
+            with open(store._slot_path(name), "wb") as f:
+                f.write(b"torn")
+        # empty the host tier too, so every fetch must face the torn
+        # disk slots
+        eng2.kv_transport.host._entries.clear()
+        eng2.add_request(pb, max_new_tokens=6, request_id="b")
+        out2 = _drain(eng2)["b"]
+        np.testing.assert_array_equal(out2, _reference(gpt, pb, 6))
+        assert eng2.prefix_cache.hits == 0      # all misses, re-prefilled
+        assert eng2.kv_transport.promotions == 0
+        _invariant(eng2.cache)
+
+    def test_zero_leak_invariant_across_tier_churn(self, gpt):
+        """The extended leak pin: through demote / promote / re-demote
+        churn the device equation in_use + cached + free == N-1 holds at
+        every boundary, and tier accounting stays consistent."""
+        rng = np.random.RandomState(34)
+        prompts = [rng.randint(1, VOCAB, (8,)).astype(np.int32)
+                   for _ in range(3)]
+        eng = ServingEngine(gpt, prefix_cache=True, kv_tiering=True,
+                            **ENGINE_KW)
+        for round_ in range(2):
+            for i, p in enumerate(prompts):
+                eng.add_request(p, max_new_tokens=4,
+                                request_id=f"r{round_}-{i}")
+                _drain(eng)
+                _invariant(eng.cache)
+            demoted = _evict_all(eng)
+            assert demoted > 0 and eng.cache.pages_cached == 0
+            _invariant(eng.cache)
+        tr = eng.kv_transport
+        assert tr.demotions >= tr.host_pages     # nothing double-counted
+        assert eng.cache.pages_in_use == 0
+        _invariant(eng.cache)
+
+    def test_seeded_chaos_double_drive_deterministic(self, gpt):
+        """kv.demote/kv.promote denials under a seeded plan: streams
+        stay byte-identical (degradations re-derive from tokens), zero
+        pages leak, and an identical plan replays to identical
+        outcomes."""
+        rng = np.random.RandomState(35)
+        p8 = rng.randint(1, VOCAB, (8,)).astype(np.int32)
+        prompts = [np.concatenate(
+            [p8, rng.randint(1, VOCAB, (k,)).astype(np.int32)])
+            for k in (2, 5, 3)]
+
+        def drive(plan):
+            eng = ServingEngine(gpt, prefix_cache=True, kv_tiering=True,
+                                **ENGINE_KW)
+            outs = {}
+            with chaos.running(plan):
+                eng.add_request(p8, max_new_tokens=6, request_id="seed")
+                outs.update(_drain(eng))
+                _evict_all(eng)
+                for i, p in enumerate(prompts):
+                    eng.add_request(p, max_new_tokens=6,
+                                    request_id=f"r{i}")
+                    outs.update(_drain(eng))
+                    _evict_all(eng)
+            assert eng.cache.pages_in_use == 0
+            _invariant(eng.cache)
+            return outs, eng.kv_transport.stats()
+
+        def plan():
+            return ChaosPlan([
+                Fault("kv.demote", at=3, action="deny"),
+                Fault("kv.promote", at=2, action="deny"),
+            ], name="tier-chaos")
+
+        plan_a = plan()
+        outs_a, stats_a = drive(plan_a)
+        assert sorted(e["site"] for e in plan_a.fired_log()) == [
+            "kv.demote", "kv.promote"]
+        np.testing.assert_array_equal(outs_a["seed"],
+                                      _reference(gpt, p8, 6))
+        for i, p in enumerate(prompts):
+            np.testing.assert_array_equal(outs_a[f"r{i}"],
+                                          _reference(gpt, p, 6))
+        outs_b, stats_b = drive(plan())
+        assert stats_b == stats_a
+        for rid, toks in outs_a.items():
+            np.testing.assert_array_equal(outs_b[rid], toks)
+
+    def test_steady_decode_transfer_and_retrace_clean(self, gpt):
+        """Tiering changes NOTHING on the hot path: after promotion-fed
+        admissions, steady decode runs under transfer_guard("disallow")
+        and compile_budget(0) — demote/promote live at admission only
+        (the demote_window pin)."""
+        rng = np.random.RandomState(36)
+        prefix = rng.randint(1, VOCAB, (9,)).astype(np.int32)
+        eng = ServingEngine(gpt, page_size=4, max_batch_size=4,
+                            eos_id=-1, prefix_cache=True, kv_tiering=True)
+        eng.add_request(np.concatenate([prefix, [7]]).astype(np.int32),
+                        max_new_tokens=4, request_id="warm")
+        _drain(eng)
+        assert _evict_all(eng) > 0
+        for i in range(4):
+            sfx = rng.randint(1, VOCAB, (2 + i,)).astype(np.int32)
+            eng.add_request(np.concatenate([prefix, sfx]),
+                            max_new_tokens=24, request_id=f"s{i}")
+        for _ in range(4):
+            eng.step()
+        assert eng.kv_transport.promotions > 0
+        with jax.transfer_guard("disallow"), \
+                compile_budget(0, prefix="serving."):
+            for _ in range(8):
+                assert eng.step()["bucket"] == 4
+        _drain(eng)
+        assert eng.cache.pages_in_use == 0
+
+
+# =============================================================================
+# Knob surface
+# =============================================================================
+class TestTieringKnob:
+    def test_validation(self, gpt):
+        with pytest.raises(InvalidArgumentError):
+            ServingEngine(gpt, kv_tiering=True, **ENGINE_KW)  # no index
+        with pytest.raises(InvalidArgumentError):
+            ServingEngine(gpt, prefix_cache=True, kv_tiering="on",
+                          **ENGINE_KW)
+        with pytest.raises(InvalidArgumentError):
+            ServingEngine(gpt, prefix_cache=True,
+                          kv_tiering=dict(host_mb=1), **ENGINE_KW)
+        with pytest.raises(InvalidArgumentError):
+            ServingEngine(gpt, prefix_cache=True,
+                          kv_tiering=dict(disk_pages=4), **ENGINE_KW)
+
+    def test_int8_dynamic_bypass_and_off_default(self, gpt):
+        # dynamic scales bypass the index — and with it, the tiers
+        dyn = ServingEngine(gpt, kv_cache_dtype="int8",
+                            prefix_cache=True, kv_tiering=True,
+                            **ENGINE_KW)
+        assert dyn.prefix_cache is None and dyn.kv_transport is None
+        off = ServingEngine(gpt, prefix_cache=True, **ENGINE_KW)
+        assert off.kv_transport is None
+        assert "tiers" not in off.prefix_cache.stats()

@@ -338,20 +338,28 @@ class TestQuantEngineIdentity:
                 np.testing.assert_array_equal(outs["sync"][i], w)
 
     def test_steady_state_transfer_guard_clean(self, gpt, quant):
-        eng = ServingEngine(gpt, page_size=4, max_batch_size=4, eos_id=-1,
+        # ISSUE 16 suite health: same engine SHAPES as the identity
+        # test above (bucket [8], num_pages 21) so the static int8
+        # programs XLA-compile once for the module — the bundle cache
+        # shares traces, but a different (bucket, num_pages) pair would
+        # still pay a fresh XLA compile.  Budget 11 keeps the four
+        # lanes inside the 20 allocatable pages (no preemption, the
+        # steady-state precondition) while covering the 10 driven steps.
+        eng = ServingEngine(gpt, page_size=4, num_pages=21,
+                            max_batch_size=8, bucket_sizes=[8], eos_id=-1,
                             kv_cache_dtype="int8", weight_dtype="int8",
                             quant_scales=quant)
         rng = np.random.RandomState(1)
-        for p in (3, 6, 9, 12):
+        for p in (3, 4, 9, 12):
             eng.add_request(rng.randint(1, VOCAB, (p,)).astype(np.int32),
-                            max_new_tokens=20)
+                            max_new_tokens=11)
         for _ in range(4):
             eng.step()
-        assert all(s is not None for s in eng._lanes)
+        assert sum(s is not None for s in eng._lanes) == 4
         with jax.transfer_guard("disallow"):
             for _ in range(6):
                 stats = eng.step()
-                assert stats["bucket"] == 4
+                assert stats["bucket"] == 8
         assert len(eng.drain()) == 4
 
 

@@ -38,6 +38,19 @@ def gpt(shared_gpt_small):
     return shared_gpt_small
 
 
+# session-scoped generate() memo (conftest greedy_ref_memo, ISSUE 16
+# suite health): the no-abort / solo byte-identity baselines below are
+# plain greedy streams — the memo derives each once per suite instead
+# of spinning up a reference engine per test
+_MEMO = None
+
+
+@pytest.fixture(autouse=True)
+def _bind_ref_memo(greedy_ref_memo):
+    global _MEMO
+    _MEMO = greedy_ref_memo
+
+
 def _drain(eng):
     while eng.scheduler.has_work() or eng._pending:
         eng.step()
@@ -65,19 +78,25 @@ class TestAbort:
 
     def test_abort_mid_decode_survivors_byte_identical(self, gpt):
         """The satellite acceptance: run A+B, abort A mid-decode; B's
-        stream must match the no-abort run byte for byte, and no page
-        may leak — across all three consume paths (ONE no-abort
-        baseline suffices: sync==pipelined==fused byte-identity is
-        already pinned by tests/test_serving_async.py)."""
+        stream must match the no-abort stream byte for byte, and no
+        page may leak — across all three consume paths.  The no-abort
+        baseline is the memoized greedy ``generate()`` reference
+        (serving==generate byte-identity is pinned elsewhere; with
+        eos=-1 the untruncated memo stream IS the no-abort run).  The
+        ([2, 9], 8, -1) key is shared with test_serving_frontend's
+        cancel test, so the reference costs this module nothing."""
         prompts = {"A": np.array([3, 5, 7], np.int32),
                    "B": np.array([2, 9], np.int32)}
+        base_b = _MEMO(gpt, prompts["B"], 8, end_id=-1)
 
         def run(kwargs, abort_a):
             eng = ServingEngine(gpt, page_size=4, max_batch_size=2,
                                 eos_id=-1, **kwargs)
             for rid, p in prompts.items():
-                eng.add_request(p, max_new_tokens=24, request_id=rid)
-            for _ in range(2):
+                eng.add_request(p, max_new_tokens=8, request_id=rid)
+            # one fused step already covers 4 of the 8 tokens — abort
+            # after a single step there so A is still mid-decode
+            for _ in range(1 if kwargs.get("fused_steps") else 2):
                 eng.step()
             if abort_a:
                 assert eng.abort("A") is True
@@ -85,19 +104,19 @@ class TestAbort:
             assert eng.cache.pages_in_use == 0
             return outs
 
-        base = run({}, abort_a=False)
-        assert "A" in base
         for kwargs in ({},                  # pipelined (default)
                        {"sync_mode": True},
                        {"fused_steps": 4}):
             aborted = run(kwargs, abort_a=True)
             assert "A" not in aborted
-            np.testing.assert_array_equal(base["B"], aborted["B"])
+            np.testing.assert_array_equal(base_b, aborted["B"])
 
     def test_abort_frees_lane_for_reuse(self, gpt):
         """The freed batch lane and pages must be reusable: a request
-        admitted after the abort decodes byte-identically to running
-        solo on a fresh engine."""
+        admitted after the abort decodes byte-identically to a solo
+        run (the memoized greedy reference — with eos=-1 the
+        untruncated memo stream IS the solo run; the ([2, 9], 8, -1)
+        key is shared with test_serving_frontend, costing nothing)."""
         eng = ServingEngine(gpt, page_size=4, max_batch_size=1,
                             num_pages=5, eos_id=-1)
         eng.add_request(np.array([3, 5, 7, 1], np.int32),
@@ -105,13 +124,11 @@ class TestAbort:
         for _ in range(4):
             eng.step()
         assert eng.abort("A")
-        c_prompt = np.array([4, 8, 2], np.int32)
+        c_prompt = np.array([2, 9], np.int32)
         eng.add_request(c_prompt, max_new_tokens=8, request_id="C")
         outs = _drain(eng)
-        solo = ServingEngine(gpt, page_size=4, max_batch_size=1,
-                             num_pages=5, eos_id=-1)
-        solo.add_request(c_prompt, max_new_tokens=8, request_id="C")
-        np.testing.assert_array_equal(outs["C"], _drain(solo)["C"])
+        np.testing.assert_array_equal(
+            outs["C"], _MEMO(gpt, c_prompt, 8, end_id=-1))
         assert eng.cache.pages_in_use == 0
 
     def test_abort_dynamic_int8_resets_page_scales(self, gpt):

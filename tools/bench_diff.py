@@ -147,6 +147,14 @@ _HIGHER_BETTER = ("reduction", "per_sec", "per_second", "goodput",
                   # under it still gate upward; pinned in
                   # tests/test_bench_diff.py)
                   "tuned",
+                  # tiered KV transport (ISSUE 16): promotions are
+                  # evictions the host/disk tiers turned back into
+                  # prefix hits — falling round-over-round on a fixed
+                  # workload means the tiers stopped saving re-prefills
+                  # (the matching hit rates ride the pre-existing "hit"
+                  # fragment; ship/transfer timings gate downward via
+                  # "_ms")
+                  "promot",
                   "_x")
 # name fragments marking metrics where SMALLER is better (latencies,
 # misses, memory, churn, compile counts — a compile_count drifting up
@@ -185,7 +193,11 @@ _LOWER_BETTER = ("_ms", "latency", "ttft", "e2e", "gap", "miss", "bytes",
                  # "sweep_rejects" — the pre-existing "reject" fragment
                  # covers them; a bare "parity_rejects" path would trip
                  # the higher-better "parity" fragment instead)
-                 "fallback", "invalid")
+                 "fallback", "invalid",
+                 # tiered KV transport (ISSUE 16): demotions rising on a
+                 # fixed workload mean more device-cache churn (pages
+                 # spilling off-device that used to stay resident)
+                 "demot")
 
 
 def lower_is_better(metric: str) -> bool:
