@@ -2016,6 +2016,96 @@ def bench_serving_observability(num_requests=24, max_new_tokens=16):
     }
 
 
+def bench_serving_slo(num_requests=16, max_new_tokens=16):
+    """ISSUE 17: the cost of the fleet SLO engine + windowed telemetry
+    on the steady-decode hot path, A/B-measured through the frontend.
+
+    The same closed-loop workload runs alternately with SLO tracking
+    OFF (``slo=False``: no tracker, no burn-rate evaluations) and ON
+    (default policy, aggressive 50ms eval interval so every pump
+    iteration that can evaluate does — a worst-case cadence, the
+    shipped default is 1s); interleaved arms, median per arm.  The
+    windowed histograms record in BOTH arms (they are part of the
+    always-on metrics path), so the headline ``slo_overhead_pct``
+    isolates the tracker itself: counter reads, window differencing,
+    hysteresis, the labeled-gauge export.  Acceptance: noise floor
+    (< 2%).  Also reports the ops-surface numbers: ``healthz()``
+    latency with the SLO section live, and the steady-state burn rates
+    the drill leaves behind."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler.slo import SLOPolicy, SLOTracker
+    from paddle_tpu.serving import ServingFrontend
+    from paddle_tpu.text.models import GPTModel
+
+    V, HID, L, HEADS, FF, SEQ = 4096, 128, 2, 4, 512, 256
+    paddle.seed(0)
+    model = GPTModel(vocab_size=V, hidden_size=HID, num_layers=L,
+                     num_heads=HEADS, ffn_size=FF, max_seq_len=SEQ,
+                     dropout=0.0)
+    model.eval()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, V, (int(p),)).astype(np.int32)
+               for p in rng.randint(8, 48, num_requests)]
+    reps = int(os.environ.get("BENCH_SLO_REPS", "3"))
+
+    def arm(slo_on):
+        slo = (SLOTracker(SLOPolicy.default(eval_interval_s=0.05))
+               if slo_on else False)
+        fe = ServingFrontend(
+            model, replicas=1, queue_cap=num_requests,
+            engine_kwargs=dict(page_size=16, max_batch_size=8,
+                               max_seq_len=SEQ, eos_id=-1),
+            slo=slo)
+        try:
+            t0 = time.perf_counter()
+            handles = [fe.submit(p, max_new_tokens=max_new_tokens)
+                       for p in prompts]
+            for h in handles:
+                h.wait(timeout=600)
+            dt = time.perf_counter() - t0
+            tokens = sum(h.num_tokens for h in handles)
+            t1 = time.perf_counter()
+            hz = fe.healthz()
+            hz_ms = (time.perf_counter() - t1) * 1e3
+            return tokens / dt, hz_ms, hz
+        finally:
+            fe.close()
+
+    arm(True)                       # warmup: compile every bucket
+    offs, ons = [], []
+    for _ in range(reps):           # interleaved A/B: noise lands on both
+        offs.append(arm(False))
+        ons.append(arm(True))
+    thr_off = float(np.median([r[0] for r in offs]))
+    thr_on = float(np.median([r[0] for r in ons]))
+    hz_ms = float(np.median([r[1] for r in ons]))
+    hz = ons[-1][2]
+    overhead = (thr_off - thr_on) / thr_off * 100.0 if thr_off else 0.0
+    avail = hz["slo"]["objectives"]["availability"]
+    return {
+        "metric": "serving_slo_overhead_pct",
+        "value": round(overhead, 3),
+        "unit": "% tokens/s lost, SLO tracking on (accept < 2)",
+        "detail": {
+            "num_requests": num_requests,
+            "max_new_tokens": max_new_tokens,
+            "runs_per_arm": reps,
+            "slo_overhead_pct": round(overhead, 3),
+            "tokens_per_sec_off": round(thr_off, 2),
+            "tokens_per_sec_on": round(thr_on, 2),
+            "healthz_ms": round(hz_ms, 3),
+            "objectives_tracked": len(hz["slo"]["objectives"]),
+            "availability_attainment": round(avail["attainment"], 6),
+            "availability_burn_rate": round(avail["burn_rate"], 3),
+            "alerts_fired": len(hz["slo"]["alert_log"]),
+            "model": {"hidden": HID, "layers": L, "heads": HEADS,
+                      "max_seq_len": SEQ},
+        },
+    }
+
+
 def bench_autotune(num_requests=4, max_new_tokens=6):
     """Contract-gated Pallas kernel autotuner (ISSUE 14): sweep the
     runnable kernels at their bench shape buckets (candidates pruned by
@@ -2400,6 +2490,19 @@ def main():
         except Exception as e:  # noqa: BLE001 — rider workload, never fatal
             sys.stderr.write(
                 f"serving observability bench failed after retries "
+                f"({type(e).__name__}: {e})\n")
+        try:
+            # SLO engine + windowed telemetry overhead A/B + healthz
+            # latency with the ops surface live (ISSUE 17)
+            result.setdefault("detail", {})["slo"] = \
+                _with_retries(
+                    "serving_slo",
+                    lambda: bench_serving_slo(
+                        int(os.environ.get("BENCH_SLO_REQUESTS", "16")),
+                        int(os.environ.get("BENCH_SLO_TOKENS", "16"))))
+        except Exception as e:  # noqa: BLE001 — rider workload, never fatal
+            sys.stderr.write(
+                f"serving slo bench failed after retries "
                 f"({type(e).__name__}: {e})\n")
         try:
             # kernel autotuner: contract-gated sweep + tuned-vs-default

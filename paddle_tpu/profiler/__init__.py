@@ -30,6 +30,8 @@ from .exposition import (MetricsServer, prometheus_text,  # noqa: F401
                          start_metrics_server)
 from .flight_recorder import (FlightRecorder, RequestTrace,  # noqa: F401
                               TraceContext, recorder)
+from .slo import (AlertCenter, SLOObjective, SLOPolicy,  # noqa: F401
+                  SLOTracker, snap_to_bucket_bound)
 from .jit_cost import (CompileBudget, CompileBudgetExceeded,  # noqa: F401
                        CompileLedger, JitCostRegistry, ProfiledJit,
                        compile_budget, compile_ledger, cost_registry,
@@ -45,6 +47,8 @@ __all__ = [
     "export_chrome_trace", "to_trace_events",
     "request_trace_events", "export_request_trace",
     "FlightRecorder", "RequestTrace", "TraceContext", "recorder",
+    "SLOObjective", "SLOPolicy", "SLOTracker", "AlertCenter",
+    "snap_to_bucket_bound",
     "prometheus_text", "start_metrics_server", "MetricsServer",
     "profiled_jit", "ProfiledJit", "JitCostRegistry", "cost_registry",
     "device_memory_stats",
@@ -67,6 +71,7 @@ def metrics_snapshot() -> dict:
                    for key, val in g.values().items()}
             for name, g in stat_registry.labeled_gauges().items()},
         "histograms": stat_registry.histogram_snapshots(),
+        "windowed": stat_registry.windowed_snapshots(),
         "span_aggregates": aggregates(),
         "jit_costs": cost_registry.snapshot(),
         "device_memory": device_memory_stats(),

@@ -53,27 +53,56 @@ def _labels_str(label_items) -> str:
 
 def prometheus_text(registry: Optional[StatRegistry] = None) -> str:
     """Render every stat/gauge/histogram in ``registry`` (default: the
-    process-wide one) as Prometheus text exposition."""
+    process-wide one) as Prometheus text exposition.
+
+    Families are keyed by the SANITIZED name: two raw registry names
+    that collapse to the same exposition name (``t.mem`` and ``t_mem``)
+    merge into one family — one ``# TYPE`` line, samples grouped —
+    because a duplicate TYPE line makes the scraper reject the whole
+    page.  A cross-TYPE collision (a gauge and a histogram collapsing
+    to the same name) disambiguates by suffixing the later family with
+    its type instead of emitting an invalid page.
+    """
     reg = registry if registry is not None else stat_registry
-    lines = []
+    # family order = first appearance; value = [type, [sample lines]]
+    families: dict = {}
+
+    def family(raw_name: str, typ: str):
+        pn = _sanitize(raw_name)
+        while pn in families and families[pn][0] != typ:
+            pn = f"{pn}_{typ}"
+        entry = families.setdefault(pn, [typ, []])
+        return pn, entry[1]
+
     # plain stats: exposed as gauges (callers use both add() and set())
     for name, value in sorted(reg.stat_values().items()):
-        pn = _sanitize(name)
-        lines.append(f"# TYPE {pn} gauge")
-        lines.append(f"{pn} {_fmt(value)}")
+        pn, out = family(name, "gauge")
+        out.append(f"{pn} {_fmt(value)}")
     for name, gauge in sorted(reg.labeled_gauges().items()):
-        pn = _sanitize(name)
-        lines.append(f"# TYPE {pn} gauge")
+        pn, out = family(name, "gauge")
         for label_items, value in sorted(gauge.values().items()):
-            lines.append(f"{pn}{_labels_str(label_items)} {_fmt(value)}")
+            out.append(f"{pn}{_labels_str(label_items)} {_fmt(value)}")
     for name, hist in sorted(reg.histograms().items()):
-        pn = _sanitize(name)
-        lines.append(f"# TYPE {pn} histogram")
+        pn, out = family(name, "histogram")
         buckets, total, count = hist.exposition_state()
         for le, cum in buckets:
-            lines.append(f'{pn}_bucket{{le="{_fmt(le)}"}} {cum}')
-        lines.append(f"{pn}_sum {_fmt(total)}")
-        lines.append(f"{pn}_count {count}")
+            out.append(f'{pn}_bucket{{le="{_fmt(le)}"}} {cum}')
+        out.append(f"{pn}_sum {_fmt(total)}")
+        out.append(f"{pn}_count {count}")
+    # windowed histograms: recent-window percentiles render as a
+    # Prometheus SUMMARY (quantiles are point-in-time estimates over
+    # the rotating window, not cumulative — exactly what summary means)
+    for name, whist in sorted(reg.windowed_histograms().items()):
+        pn, out = family(name, "summary")
+        quantiles, total, count = whist.exposition_state()
+        for q, value in quantiles:
+            out.append(f'{pn}{{quantile="{_fmt(q)}"}} {_fmt(value)}')
+        out.append(f"{pn}_sum {_fmt(total)}")
+        out.append(f"{pn}_count {count}")
+    lines = []
+    for pn, (typ, samples) in families.items():
+        lines.append(f"# TYPE {pn} {typ}")
+        lines.extend(samples)
     return "\n".join(lines) + "\n"
 
 

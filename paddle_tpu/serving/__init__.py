@@ -78,10 +78,13 @@ from ..framework.concurrency import declare_hierarchy as _declare_hierarchy
 
 # The serving fleet's declared lock hierarchy (docs/ANALYSIS.md),
 # outermost first: frontend RLock > router RLock > handle condvar >
-# metrics locks.  The framework.concurrency witness enforces it (and
-# hunts undeclared ABBA cycles) whenever tests run with the witness on.
+# metrics locks > SLO tracker (the tracker is evaluated from pump ticks
+# and adaptive-brownout reads that may hold the frontend lock, and it
+# never takes a serving lock itself).  The framework.concurrency
+# witness enforces it (and hunts undeclared ABBA cycles) whenever tests
+# run with the witness on.
 _declare_hierarchy("serving.frontend", "serving.router",
-                   "serving.handle", "serving.metrics")
+                   "serving.handle", "serving.metrics", "serving.slo")
 
 from .engine import ServingEngine, create_serving_engine
 from .frontend import (ResponseHandle, ServingFrontend,
@@ -89,7 +92,7 @@ from .frontend import (ResponseHandle, ServingFrontend,
 from .http import ServingHTTPServer, start_http_server
 from .kv_cache import PagedKVCache
 from .kv_transport import DiskTier, HostTier, PageTransport
-from .metrics import FrontendMetrics, ServingMetrics
+from .metrics import FleetMetrics, FrontendMetrics, ServingMetrics
 from .prefix_cache import PrefixCache
 from .resilience import (BrownoutController, BrownoutPolicy,
                          EngineSnapshot, Watchdog, WatchdogConfig)
@@ -104,4 +107,5 @@ __all__ = ["ServingEngine", "create_serving_engine", "PagedKVCache",
            "ServingHTTPServer", "start_http_server", "EngineSnapshot",
            "Watchdog", "WatchdogConfig", "BrownoutPolicy",
            "BrownoutController", "Drafter", "NgramDrafter",
-           "SpecDecoder", "PageTransport", "HostTier", "DiskTier"]
+           "SpecDecoder", "PageTransport", "HostTier", "DiskTier",
+           "FleetMetrics"]

@@ -58,6 +58,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from ..framework.concurrency import OrderedCondition, OrderedRLock
+from ..framework.monitor import stat_get
 from ..framework.errors import (AlreadyExistsError,
                                 DeadlineExceededError, EnforceNotMet,
                                 ExecutionTimeoutError, InternalError,
@@ -67,9 +68,10 @@ from ..profiler.flight_recorder import (EV_PLACED, EV_QUEUED,
                                         EV_RESTARTED, EV_RESUMED_ON,
                                         EV_SHIPPED, EV_SNAPSHOT)
 from ..profiler.flight_recorder import recorder as flight
+from ..profiler.slo import SLOPolicy, SLOTracker
 from ..testing.chaos import chaos_site
 from .engine import ServingEngine
-from .metrics import FrontendMetrics, ServingMetrics
+from .metrics import FleetMetrics, FrontendMetrics, ServingMetrics
 from .resilience import (BROWNOUT_CLAMP, BROWNOUT_REJECT, BROWNOUT_SHED,
                          BrownoutController, BrownoutPolicy, EngineSnapshot,
                          Watchdog, WatchdogConfig)
@@ -408,7 +410,9 @@ class ServingFrontend:
                  snapshot_store=None,
                  prefix_cache: Optional[bool] = None,
                  spec_decode=None,
-                 bundle_dir: Optional[str] = None):
+                 bundle_dir: Optional[str] = None,
+                 slo=None,
+                 slo_adaptive_brownout: bool = False):
         """Resilience knobs (docs/SERVING.md "Resilience"):
 
         - ``snapshot_interval``: checkpoint each in-flight request every
@@ -445,6 +449,23 @@ class ServingFrontend:
           (docs/OBSERVABILITY.md "Request tracing & flight recorder");
           None leaves the recorder's current setting (tracing stays on
           either way — only crash-time bundle WRITES need a directory).
+        - ``slo``: the fleet SLO engine (ISSUE 17,
+          docs/OBSERVABILITY.md "SLO objectives & burn-rate alerts").
+          None/True = the stock ``SLOPolicy.default()`` objectives
+          (availability, deadline, NaN-quarantine error budgets + a p95
+          TTFT latency target); an ``SLOPolicy`` customizes the
+          objectives; an ``SLOTracker`` is used as-is (tests inject a
+          fake clock this way); False disables —
+          ``healthz()["slo"]`` is then None.  Evaluation rides the pump
+          ticks (throttled by the tracker's own clock) and every
+          ``healthz()`` call; alerts land in the flight recorder and in
+          crash postmortem bundles.
+        - ``slo_adaptive_brownout``: opt-in (default OFF — byte-
+          identity suites untouched): a FIRING burn-rate alert raises
+          the BrownoutController's pressure floor (shed stage; clamp at
+          2× the page threshold), so the fleet degrades before the
+          queue alone would force it.  Requires both ``slo`` and
+          ``brownout`` enabled.
         - ``prefill_replicas``: disaggregated prefill/decode fleet
           (ISSUE 16, docs/SERVING.md "Tiered KV & disaggregation"):
           this many ADDITIONAL replicas (ids ``prefill-<i>``) carry the
@@ -573,6 +594,39 @@ class ServingFrontend:
                     f"BrownoutPolicy, got {brownout!r}")
             self.brownout = BrownoutController(
                 brownout if isinstance(brownout, BrownoutPolicy) else None)
+        # SLO engine (ISSUE 17): None/True = stock policy; a policy or
+        # a ready tracker customizes; False = off.  Same discipline as
+        # watchdog=/brownout=: an unrecognized truthy config must not
+        # silently become the default objectives
+        self.slo: Optional[SLOTracker] = None
+        if slo is None or slo is True:
+            self.slo = SLOTracker()
+        elif slo is False:
+            self.slo = None
+        elif isinstance(slo, SLOTracker):
+            self.slo = slo
+        elif isinstance(slo, SLOPolicy):
+            self.slo = SLOTracker(slo)
+        else:
+            raise InvalidArgumentError(
+                "slo must be None/True (stock objectives), False "
+                "(off), an SLOPolicy, or an SLOTracker — "
+                f"got {slo!r}")
+        if not isinstance(slo_adaptive_brownout, bool):
+            raise InvalidArgumentError(
+                f"slo_adaptive_brownout must be a bool, "
+                f"got {slo_adaptive_brownout!r}")
+        if slo_adaptive_brownout and (self.slo is None
+                                      or self.brownout is None):
+            # a knob that silently does nothing is a misconfigured SLO
+            # an operator believes is active
+            raise InvalidArgumentError(
+                "slo_adaptive_brownout=True requires both slo= and "
+                "brownout= enabled")
+        self._slo_adaptive = slo_adaptive_brownout
+        # fleet rollup (ISSUE 17): {replica, role} labeled gauges
+        # re-derived on every healthz()/stats() read
+        self.fleet = FleetMetrics(self.router)
         self._lock = OrderedRLock("serving.frontend")
         self._live: Dict[str, _Entry] = {}
         self._closing = False
@@ -663,7 +717,8 @@ class ServingFrontend:
         stage = 0
         if self.brownout is not None:
             with self._lock:
-                stage = self.brownout.evaluate(self._pressure_locked())
+                stage = self.brownout.evaluate(
+                    self._brownout_pressure_locked())
             if stage >= BROWNOUT_CLAMP:
                 cap = self.brownout.policy.clamp_max_new_tokens
                 if max_new_tokens > cap:
@@ -792,6 +847,18 @@ class ServingFrontend:
         if self.queue_cap is None or self.queue_cap <= 0:
             return 0.0
         return len(self._live) / float(self.queue_cap)
+
+    def _brownout_pressure_locked(self) -> float:
+        """Pressure fed to the brownout controller.  Normally queue
+        pressure; with ``slo_adaptive_brownout=True`` a firing SLO
+        alert imposes a pressure FLOOR (shed_at while burning, clamp_at
+        once the burn is runaway) so the fleet starts load-shedding on
+        budget burn even before the queue itself backs up."""
+        p = self._pressure_locked()
+        if self._slo_adaptive and self.slo is not None:
+            p = max(p, self.slo.brownout_pressure_floor(
+                self.brownout.policy))
+        return p
 
     def _shed_lowest_slack_locked(self, exclude: Optional[str] = None):
         """Brownout stage 1+: shed the live not-yet-decoding request
@@ -987,6 +1054,35 @@ class ServingFrontend:
                                 else self.brownout.stage)
         return hz
 
+    def healthz(self) -> dict:
+        """``health()`` plus the ops surface: refreshes the per-replica
+        fleet gauges (``serving.fleet.*``) and, when SLO tracking is on,
+        appends per-objective ``{attainment, budget_remaining,
+        burn_rate, alert}`` plus the recent alert log under ``"slo"``
+        (``None`` when tracking is disabled).  This is what the HTTP
+        ``/healthz`` endpoint and ``tools/dash.py`` serve."""
+        hz = self.health()
+        self.fleet.refresh()
+        if self.slo is None:
+            hz["slo"] = None
+        else:
+            hz["slo"] = {
+                "objectives": self.slo.evaluate(),
+                "active_alerts": self.slo.active_alerts(),
+                "alert_log": self.slo.alert_log(),
+            }
+        hz["window"] = {
+            "frontend": self.metrics.snapshot().get("window", {}),
+            "engine": self.engine_metrics.snapshot().get("window", {}),
+        }
+        hz["tiers"] = {
+            "kv_pages_in_use": stat_get("serving.kv_pages_in_use"),
+            "prefix_cached_tokens": stat_get("serving.prefix.cached_tokens"),
+            "host_pages": stat_get("serving.prefix.host_pages"),
+            "disk_pages": stat_get("serving.prefix.disk_pages"),
+        }
+        return hz
+
     def trace(self, request_id: str) -> Optional[dict]:
         """Structured lifecycle timeline of a live or recently-terminal
         request (queued → placed → admitted → ... → terminal, replicas
@@ -1015,6 +1111,11 @@ class ServingFrontend:
                 "dead_reason": rep.dead_reason or None,
                 "engine": rep.engine.stats(),
             }
+        if self.slo is not None:
+            # active alerts + objective states ride into every crash
+            # bundle — the first postmortem question is "were we
+            # burning budget when it died?"
+            out["slo"] = self.slo.context()
         return out
 
     def stats(self) -> dict:
@@ -1035,6 +1136,7 @@ class ServingFrontend:
                 "snapshot_persist_errors": self._persist_errors,
                 "disaggregated": self._disagg,
             },
+            "slo": (None if self.slo is None else self.slo.status()),
         }
 
     def close(self, timeout: float = 30.0):
@@ -1151,7 +1253,12 @@ class ServingFrontend:
                 if self.brownout is not None:
                     # pressure falls as requests finish — keep the stage
                     # tracking reality between submissions too
-                    self.brownout.evaluate(self._pressure_locked())
+                    self.brownout.evaluate(
+                        self._brownout_pressure_locked())
+            if self.slo is not None:
+                # outside the frontend lock: the tracker has its own
+                # (lower-ranked) lock and only reads counter registries
+                self.slo.maybe_evaluate()
             if rep.state == DEAD:
                 break
             now = time.monotonic()
@@ -1524,7 +1631,7 @@ def create_serving_frontend(model, config=None, **overrides
                 "poll_interval_s", "snapshot_interval", "watchdog",
                 "brownout", "placement_attempts", "placement_backoff_s",
                 "snapshot_store", "prefix_cache", "spec_decode",
-                "bundle_dir"):
+                "bundle_dir", "slo", "slo_adaptive_brownout"):
         if key in overrides:
             fe_kwargs[key] = overrides.pop(key)
     engine_kwargs.update(overrides)

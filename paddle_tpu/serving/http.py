@@ -120,7 +120,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         path, _, query = self.path.partition("?")
         path = path.rstrip("/")
         if path == "/healthz":
-            hz = self.frontend.health()
+            hz = self.frontend.healthz()
             self._send_json(200 if hz["status"] == "ok" else 503, hz)
         elif path == "/metrics":
             body = prometheus_text().encode()

@@ -28,12 +28,19 @@ checker).
 from __future__ import annotations
 
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from ..framework.concurrency import OrderedLock
 from ..framework.monitor import stat_registry
 
-__all__ = ["ServingMetrics", "FrontendMetrics"]
+__all__ = ["ServingMetrics", "FrontendMetrics", "FleetMetrics"]
+
+# recent-window geometry for the serving WindowedHistograms (ISSUE 17):
+# six 10s slices give "the last minute" at 10s resolution — coarse
+# enough to stay O(1) memory, fine enough that a decode regression is
+# visible within one scrape interval
+_WINDOW_S = 60.0
+_WINDOW_SLICES = 6
 
 
 class ServingMetrics:
@@ -104,9 +111,19 @@ class ServingMetrics:
                   # snapshot-gather through re-admission on the decode
                   # replica
                   "serving.disagg.transfer_ms")
+    # recent-window twins (ISSUE 17): same samples as the cumulative
+    # histograms above, but over the last _WINDOW_S seconds only —
+    # "is decode degrading RIGHT NOW", the feed for the SLO engine's
+    # latency view and the ops dashboard
+    WINDOWED = ("serving.window.ttft_ms", "serving.window.itl_ms",
+                "serving.window.decode_latency_ms")
 
-    def __init__(self):
+    def __init__(self, clock: Optional[Callable[[], float]] = None):
+        """``clock``: injectable monotonic clock (default
+        ``time.monotonic``) — drives window rotation and the derived
+        elapsed/rate accounting, so tests replay deterministic time."""
         self._lock = OrderedLock("serving.metrics")
+        self._clock = clock if clock is not None else time.monotonic
         self.reset()
 
     def reset(self):
@@ -125,6 +142,14 @@ class ServingMetrics:
             stat_registry.get(name).reset()
         for name in self.HISTOGRAMS:
             stat_registry.histogram(name).reset()
+        for name in self.WINDOWED:
+            # re-bind the registry-cached window to THIS instance's
+            # clock (a fresh fleet with a fake clock must not inherit a
+            # previous fleet's)
+            stat_registry.windowed(
+                name, _WINDOW_S, _WINDOW_SLICES).configure(
+                window_s=_WINDOW_S, slices=_WINDOW_SLICES,
+                clock=self._clock)
 
     # --- event hooks (called by the engine) --------------------------------
     def on_admission(self, n: int):
@@ -137,6 +162,8 @@ class ServingMetrics:
             self._ttft_sum += ttft
             self._ttft_count += 1
         stat_registry.histogram("serving.ttft_ms").observe(ttft * 1e3)
+        stat_registry.windowed("serving.window.ttft_ms").observe(
+            ttft * 1e3, now=now)
 
     def on_completion(self, n: int = 1):
         with self._lock:
@@ -287,6 +314,8 @@ class ServingMetrics:
         device latency, the full step time in sync_mode."""
         stat_registry.histogram("serving.decode_latency_ms").observe(
             seconds * 1e3)
+        stat_registry.windowed(
+            "serving.window.decode_latency_ms").observe(seconds * 1e3)
 
     def on_dispatch_gap(self, seconds: float):
         """Host-side gap between consecutive decode dispatches — the
@@ -295,12 +324,16 @@ class ServingMetrics:
         host-scheduling bubbles."""
         stat_registry.histogram("serving.dispatch_gap_ms").observe(
             seconds * 1e3)
+        # the dispatch gap IS the fleet's inter-token latency (ITL) in
+        # steady decode — windowed under the operator-facing name
+        stat_registry.windowed("serving.window.itl_ms").observe(
+            seconds * 1e3)
 
     def on_step(self, *, queue_depth: int, running: int, bucket: int,
                 pages_in_use: int, tokens_emitted: int,
                 step_seconds: Optional[float] = None,
                 kv_cache_bytes: Optional[int] = None):
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             if self._start is None:
                 self._start = now
@@ -334,9 +367,9 @@ class ServingMetrics:
 
     # --- derived ----------------------------------------------------------
     def snapshot(self) -> dict:
+        now = self._clock()
         with self._lock:
-            elapsed = ((time.monotonic() - self._start)
-                       if self._start else 0.0)
+            elapsed = (now - self._start) if self._start else 0.0
             snap = {
                 "steps": self._steps,
                 "tokens_generated": self._tokens,
@@ -384,6 +417,11 @@ class ServingMetrics:
                 snap["disagg"][key[len("disagg."):]] = summary
             else:
                 snap[key] = summary
+        snap["window"] = {
+            name[len("serving.window."):]: {
+                k: w[k] for k in ("count", "mean", "p50", "p95", "p99")}
+            for name, w in ((n, stat_registry.windowed(n).snapshot(
+                now=now)) for n in self.WINDOWED)}
         return snap
 
 
@@ -428,9 +466,14 @@ class FrontendMetrics:
                 # frontend process (recover_pending)
                 "serving.frontend.recovered")
     HISTOGRAMS = ("serving.frontend.ttft_ms", "serving.frontend.e2e_ms")
+    # recent-window twins (ISSUE 17): client-observed TTFT/e2e over the
+    # last minute — what the SLO latency objectives and dashboard read
+    WINDOWED = ("serving.frontend.window.ttft_ms",
+                "serving.frontend.window.e2e_ms")
 
-    def __init__(self):
+    def __init__(self, clock: Optional[Callable[[], float]] = None):
         self._lock = OrderedLock("serving.metrics")
+        self._clock = clock if clock is not None else time.monotonic
         self.reset()
 
     def reset(self):
@@ -443,6 +486,11 @@ class FrontendMetrics:
             stat_registry.get(name).reset()
         for name in self.HISTOGRAMS:
             stat_registry.histogram(name).reset()
+        for name in self.WINDOWED:
+            stat_registry.windowed(
+                name, _WINDOW_S, _WINDOW_SLICES).configure(
+                window_s=_WINDOW_S, slices=_WINDOW_SLICES,
+                clock=self._clock)
 
     # --- event hooks --------------------------------------------------------
     def on_submit(self):
@@ -495,8 +543,12 @@ class FrontendMetrics:
         if ttft_s is not None:
             stat_registry.histogram("serving.frontend.ttft_ms").observe(
                 ttft_s * 1e3)
+            stat_registry.windowed(
+                "serving.frontend.window.ttft_ms").observe(ttft_s * 1e3)
         stat_registry.histogram("serving.frontend.e2e_ms").observe(
             e2e_s * 1e3)
+        stat_registry.windowed(
+            "serving.frontend.window.e2e_ms").observe(e2e_s * 1e3)
         with self._lock:
             if ttft_s is not None:
                 self._ttft_sum += ttft_s
@@ -525,4 +577,61 @@ class FrontendMetrics:
             h = stat_registry.histogram(name).snapshot()
             snap[name[len("serving.frontend."):]] = {
                 k: h[k] for k in ("count", "mean", "p50", "p95", "p99")}
+        now = self._clock()
+        snap["window"] = {
+            name[len("serving.frontend.window."):]: {
+                k: w[k] for k in ("count", "mean", "p50", "p95", "p99")}
+            for name, w in ((n, stat_registry.windowed(n).snapshot(
+                now=now)) for n in self.WINDOWED)}
         return snap
+
+
+# replica lifecycle states as gauge values (serving.fleet.state):
+# healthy replicas sit at 0 so ANY non-zero fleet cell is actionable
+_STATE_CODE = {"healthy": 0, "suspect": 1, "draining": 2, "dead": 3}
+
+
+class FleetMetrics:
+    """Fleet rollup (ISSUE 17): merges per-replica router status into
+    ``LabeledGauge`` families keyed by ``{replica, role}``, so ONE
+    Prometheus scrape separates the prefill pool from the decode pool
+    (before this, per-replica state existed only inside the /healthz
+    JSON — invisible to the metrics pipeline).
+
+    ``refresh()`` re-derives every family from the router's current
+    replica list; it is called from ``ServingFrontend.healthz()`` /
+    ``stats()`` (and therefore on every scrape of those surfaces), not
+    from the hot pump loop — the rollup is a read-side aggregation, so
+    steady decode pays nothing for it.
+    """
+
+    LABELED = ("serving.fleet.state", "serving.fleet.steps",
+               "serving.fleet.outstanding_tokens",
+               "serving.fleet.inbox_depth", "serving.fleet.healthy")
+
+    def __init__(self, router):
+        self._router = router
+
+    def refresh(self) -> dict:
+        """Re-export the rollup; returns the router healthz payload the
+        gauges were derived from (callers embed it, so one router lock
+        pass serves both surfaces)."""
+        hz = self._router.healthz()
+        per_replica = {
+            "serving.fleet.state": lambda r: _STATE_CODE.get(
+                r["state"], -1),
+            "serving.fleet.steps": lambda r: r["steps"],
+            "serving.fleet.outstanding_tokens":
+                lambda r: r["outstanding_tokens"],
+            "serving.fleet.inbox_depth": lambda r: r["inbox_depth"],
+        }
+        for name, fn in per_replica.items():
+            g = stat_registry.labeled_gauge(name)
+            g.reset()
+            for rep in hz["replicas"]:
+                g.set(fn(rep), replica=rep["id"], role=rep["role"])
+        g = stat_registry.labeled_gauge("serving.fleet.healthy")
+        g.reset()
+        for role, n in hz["healthy_by_role"].items():
+            g.set(n, role=role)
+        return hz

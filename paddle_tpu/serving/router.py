@@ -137,6 +137,9 @@ class Replica:
             "last_step_age_s": (
                 None if self.last_step_time is None
                 else round(time.monotonic() - self.last_step_time, 3)),
+            "busy_for_s": (
+                None if self.step_started is None
+                else round(time.monotonic() - self.step_started, 3)),
         }
 
 

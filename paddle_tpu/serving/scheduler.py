@@ -82,14 +82,15 @@ class Request:
         if not self.request_id:
             self.request_id = f"req-{next(_req_counter)}"
 
-    def expired(self, now: Optional[float] = None) -> bool:
-        """True once the deadline has passed.  The comparison is
-        ``now >= deadline``: a request expiring exactly on the admission
-        step is NOT admitted (the SLO is already blown — any token it
-        would produce arrives late)."""
+    def expired(self, now: float) -> bool:
+        """True once the deadline has passed at the caller-supplied
+        clock reading — callers own the clock (so tests drive fake
+        time).  The comparison is ``now >= deadline``: a request
+        expiring exactly on the admission step is NOT admitted (the SLO
+        is already blown — any token it would produce arrives late)."""
         if self.deadline is None:
             return False
-        return (time.monotonic() if now is None else now) >= self.deadline  # analyze: allow[determinism] request deadline SLO is wall-clock by contract
+        return now >= self.deadline
 
 
 class Sequence:

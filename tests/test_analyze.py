@@ -20,6 +20,7 @@ from tools.analyze import run_checks  # noqa: E402
 from tools.analyze import core as analyze_core  # noqa: E402
 from tools.analyze.core import (AnalysisContext, Finding,  # noqa: E402
                                 load_baseline, new_findings)
+from tools.analyze.metrics_coverage import collect_table_names  # noqa: E402
 from tools.analyze.metrics_drift import collect_doc_names  # noqa: E402
 
 
@@ -429,6 +430,63 @@ class TestMetricsDrift:
 
     def test_live_repo_clean(self):
         assert run_checks(root=ROOT, checks=["metrics-drift"]) == []
+
+
+# =============================================================================
+# metrics-coverage (ISSUE 17 — serving.* names <-> doc metric TABLES)
+# =============================================================================
+class TestMetricsCoverage:
+    CODE = '''
+        from paddle_tpu.framework.monitor import stat_registry
+
+
+        def f():
+            stat_registry.get("serving.tabled").add(1)
+            stat_registry.get("serving.prose_only").add(1)
+            stat_registry.windowed("serving.window.tabled_ms").observe(1)
+        '''
+
+    def test_planted_drift_both_directions(self, tmp_path):
+        root = make_tree(tmp_path, {
+            "paddle_tpu/m.py": self.CODE,
+            "docs/OBSERVABILITY.md": """
+                Prose mentions `serving.prose_only` (satisfies
+                metrics-drift, NOT metrics-coverage).
+
+                | metric | meaning |
+                |---|---|
+                | `serving.tabled` | documented in a table row |
+                | `serving.window.tabled_ms` | windowed family row |
+                | `serving.table_orphan` | nothing emits this |
+                """})
+        found = run_checks(root=root, checks=["metrics-coverage"])
+        by_code = {}
+        for f in found:
+            by_code.setdefault(f.code, []).append(f.message)
+        assert len(by_code.get("MC001", [])) == 1
+        assert "serving.prose_only" in by_code["MC001"][0]
+        assert len(by_code.get("MC002", [])) == 1
+        assert "serving.table_orphan" in by_code["MC002"][0]
+
+    def test_table_shorthands_and_prose_isolation(self, tmp_path):
+        root = make_tree(tmp_path, {"docs/OBSERVABILITY.md": """
+            Prose names `serving.not_in_table` and sets up a dangling
+            prefix with `serving.frontend.submitted` — continuations
+            must NOT leak into the table below.
+
+            | metric | meaning |
+            |---|---|
+            | `serving.a.one`, `.two` | continuation inside a table row |
+            | `serving.{snapshots,restores}` | brace expansion |
+            | `serving.frontend.*` | wildcards ignored |
+            """})
+        names = collect_table_names(AnalysisContext(root))
+        assert set(names) == {
+            "serving.a.one", "serving.a.two", "serving.snapshots",
+            "serving.restores"}
+
+    def test_live_repo_clean(self):
+        assert run_checks(root=ROOT, checks=["metrics-coverage"]) == []
 
 
 # =============================================================================
@@ -967,10 +1025,10 @@ class TestRunnerAndCLI:
         assert res.returncode == 0, res.stderr
         names = res.stdout.split()
         assert names == sorted(["error-taxonomy", "jit-hazard",
-                                "lock-discipline", "metrics-drift",
-                                "pallas-contract", "retrace-hazard",
-                                "determinism", "host-sync",
-                                "chaos-coverage"])
+                                "lock-discipline", "metrics-coverage",
+                                "metrics-drift", "pallas-contract",
+                                "retrace-hazard", "determinism",
+                                "host-sync", "chaos-coverage"])
 
     def test_suppression_requires_matching_check_name(self, tmp_path):
         root = make_tree(tmp_path, {"paddle_tpu/serving/bad.py": '''

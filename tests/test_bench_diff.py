@@ -216,6 +216,23 @@ class TestFailOnRegression:
             "detail.autotune.sweeps.quantized_matmul.b.best_ms")
         assert bench_diff.lower_is_better(
             "detail.autotune.decode_on.mean_ttft_ms")
+        # fleet SLO section (ISSUE 17): the tracker overhead %, healthz
+        # latency, burn rates and alert counters all regress UPWARD;
+        # attainment / budget_remaining are unmatched paths and gate
+        # downward as bigger-is-better
+        assert bench_diff.lower_is_better("detail.slo.slo_overhead_pct")
+        assert bench_diff.lower_is_better("detail.slo.healthz_ms")
+        assert bench_diff.lower_is_better(
+            "detail.slo.availability_burn_rate")
+        assert bench_diff.lower_is_better("detail.slo.alerts_fired")
+        assert bench_diff.lower_is_better("serving.slo.alerts_fired")
+        assert bench_diff.lower_is_better("serving.slo.burn_rate")
+        assert not bench_diff.lower_is_better(
+            "detail.slo.availability_attainment")
+        assert not bench_diff.lower_is_better(
+            "serving.slo.budget_remaining")
+        assert not bench_diff.lower_is_better(
+            "detail.slo.tokens_per_sec_on")
 
     def test_reduction_ratio_gates_on_drop_not_rise(self):
         """The PR-4 acceptance metric: kv_bytes_reduction_x falling

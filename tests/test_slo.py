@@ -1,0 +1,742 @@
+"""Fleet SLO engine acceptance (ISSUE 17): windowed telemetry,
+burn-rate alerting, the ops surface.
+
+Four layers pinned here:
+
+- ``WindowedHistogram`` — bounded-memory recent percentiles on a ring
+  of rotating log-bucket slices, driven by an injected clock (rotation
+  is pure arithmetic over the clock reading: every assertion below is
+  exact, no sleeps);
+- burn-rate math — textbook multi-window multi-burn-rate behavior
+  under a fake clock: fire after ``fire_after`` consecutive
+  double-window exceedances, strict-inequality at the threshold,
+  hysteresis clears, exact error-budget arithmetic;
+- the Prometheus text exposition — a 0.0.4 text-grammar parser
+  validates the full scrape round-trip (sanitized names, escaped
+  labels, +Inf bucket/_count consistency, the new summary families);
+- the seeded-chaos drill — a replica-kill storm fails every in-flight
+  request, the availability objective fires, the alert is visible in
+  ``healthz()["slo"]`` / the flight-recorder transition ring / the
+  postmortem bundle, recovery clears it, and the whole drive is
+  byte-deterministic (double-drive equality on the slo payloads).
+"""
+import json
+import math
+import re
+
+import numpy as np
+import pytest
+
+from paddle_tpu.framework.errors import InvalidArgumentError
+from paddle_tpu.framework.monitor import (Histogram, WindowedHistogram,
+                                          StatRegistry, stat_registry)
+from paddle_tpu.profiler import prometheus_text
+from paddle_tpu.profiler.flight_recorder import recorder
+from paddle_tpu.profiler.slo import (AlertCenter, SLOObjective, SLOPolicy,
+                                     SLOTracker, snap_to_bucket_bound)
+from paddle_tpu.serving import ServingFrontend
+from paddle_tpu.testing import chaos
+from paddle_tpu.testing.chaos import ChaosPlan, Fault
+
+VOCAB = 50
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float):
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def gpt(shared_gpt_small):
+    return shared_gpt_small
+
+
+# =============================================================================
+# WindowedHistogram
+# =============================================================================
+class TestWindowedHistogram:
+    def test_observe_and_snapshot_current_window(self):
+        clk = FakeClock(1000.0)
+        wh = WindowedHistogram(window_s=60.0, slices=6, clock=clk)
+        for v in (10.0, 20.0, 30.0):
+            wh.observe(v)
+        snap = wh.snapshot()
+        assert snap["count"] == 3
+        assert snap["sum"] == pytest.approx(60.0)
+        assert snap["min"] == 10.0 and snap["max"] == 30.0
+        assert snap["window_s"] == 60.0
+
+    def test_rotation_discards_expired_slices(self):
+        clk = FakeClock(1000.0)
+        wh = WindowedHistogram(window_s=60.0, slices=6, clock=clk)
+        wh.observe(10.0)            # epoch E
+        clk.advance(30.0)
+        wh.observe(20.0)            # epoch E+3
+        snap = wh.snapshot()
+        assert snap["count"] == 2 and snap["min"] == 10.0
+        clk.advance(40.0)           # first sample now > window old
+        snap = wh.snapshot()
+        assert snap["count"] == 1
+        assert snap["min"] == snap["max"] == 20.0
+
+    def test_idle_gap_resets_everything(self):
+        clk = FakeClock(1000.0)
+        wh = WindowedHistogram(window_s=60.0, slices=6, clock=clk)
+        for _ in range(100):
+            wh.observe(5.0)
+        clk.advance(61.0)
+        assert wh.snapshot()["count"] == 0
+        # and the ring is reusable after the reset
+        wh.observe(7.0)
+        assert wh.snapshot()["count"] == 1
+
+    def test_memory_is_bounded_by_the_ring(self):
+        clk = FakeClock(0.0)
+        wh = WindowedHistogram(window_s=60.0, slices=4, clock=clk)
+        # hammer many windows' worth of samples — the ring never grows
+        for i in range(10_000):
+            wh.observe(float(i % 97) + 1.0)
+            if i % 50 == 0:
+                clk.advance(7.0)
+        assert len(wh._ring) == 4
+        assert wh.snapshot()["count"] <= 10_000
+
+    def test_percentiles_track_recent_distribution(self):
+        clk = FakeClock(50.0)
+        wh = WindowedHistogram(window_s=60.0, slices=6, clock=clk)
+        for v in range(1, 101):
+            wh.observe(float(v))
+        # log-bucket resolution: one bucket is a 10^(1/20) ≈ 12% band
+        assert wh.percentile(50) == pytest.approx(50.0, rel=0.13)
+        assert wh.percentile(99) == pytest.approx(99.0, rel=0.13)
+        snap = wh.snapshot()
+        assert snap["p50"] <= snap["p95"] <= snap["p99"]
+
+    def test_validation_and_configure_rebinds_clock(self):
+        with pytest.raises(ValueError):
+            WindowedHistogram(window_s=0.0)
+        with pytest.raises(ValueError):
+            WindowedHistogram(slices=0)
+        clk_a, clk_b = FakeClock(0.0), FakeClock(1e6)
+        wh = WindowedHistogram(window_s=60.0, slices=6, clock=clk_a)
+        wh.observe(1.0)
+        wh.configure(clock=clk_b)   # rebind discards prior samples
+        assert wh.snapshot()["count"] == 0
+        wh.observe(2.0)
+        assert wh.snapshot()["count"] == 1
+
+    def test_registry_accessor_caches_and_resets(self):
+        name = "t.slo.win_ms"
+        wh = stat_registry.windowed(name, window_s=60.0, slices=6)
+        assert stat_registry.windowed(name) is wh
+        wh.observe(3.0)
+        assert name in stat_registry.windowed_snapshots()
+        stat_registry.reset_all()
+        assert wh.snapshot()["count"] == 0
+
+
+# =============================================================================
+# Threshold snapping & the exact over/under split
+# =============================================================================
+class TestSnapAndCountOver:
+    def test_snap_returns_nearest_bound(self):
+        # 1000.0 == 10^(60/20) is ON the grid — snapping is identity
+        assert snap_to_bucket_bound(1000.0) == pytest.approx(1000.0)
+        s = snap_to_bucket_bound(997.0)
+        assert s == pytest.approx(1000.0)
+
+    def test_count_over_exact_at_snapped_bound(self):
+        h = Histogram()
+        for v in (900.0, 999.0, 1000.0, 1001.0, 2000.0):
+            h.observe(v)
+        # at-the-bound samples are GOOD (<= threshold), strictly-over
+        # samples are BAD — exact, because 1000.0 is a bucket bound
+        assert h.count_over(1000.0) == (2, 5)
+
+    def test_latency_objective_reads_exact_split(self):
+        hist_name = "t.slo.lat_ms"
+        stat_registry.histogram(hist_name).reset()
+        obj = SLOObjective(name="lat", kind="latency", target=0.9,
+                           histogram=hist_name, threshold_ms=1000.0)
+        assert obj.threshold_ms == pytest.approx(1000.0)
+        h = stat_registry.histogram(hist_name)
+        for v in (10.0, 999.0, 1000.0, 1500.0):
+            h.observe(v)
+        assert obj.read() == (1, 4)
+
+
+# =============================================================================
+# Objective / policy validation
+# =============================================================================
+class TestPolicyValidation:
+    def test_objective_validation(self):
+        with pytest.raises(InvalidArgumentError):
+            SLOObjective(name="", target=0.9, bad=("b",), total=("t",))
+        with pytest.raises(InvalidArgumentError):
+            SLOObjective(name="x", target=1.0, bad=("b",), total=("t",))
+        with pytest.raises(InvalidArgumentError):
+            SLOObjective(name="x", target=0.9)          # no counters
+        with pytest.raises(InvalidArgumentError):
+            SLOObjective(name="x", target=0.9, kind="latency")
+        with pytest.raises(InvalidArgumentError):
+            SLOObjective(name="x", target=0.9, kind="nope",
+                         bad=("b",), total=("t",))
+
+    def test_policy_validation(self):
+        obj = SLOObjective(name="a", target=0.9, bad=("b",), total=("t",))
+        with pytest.raises(InvalidArgumentError):
+            SLOPolicy(objectives=())
+        with pytest.raises(InvalidArgumentError):
+            SLOPolicy(objectives=(obj, obj))            # duplicate names
+        with pytest.raises(InvalidArgumentError):
+            SLOPolicy(objectives=(obj,), fast_window_s=300,
+                      slow_window_s=60)
+        with pytest.raises(InvalidArgumentError):
+            SLOPolicy(objectives=(obj,), burn_threshold=1.0)
+        with pytest.raises(InvalidArgumentError):
+            SLOPolicy(objectives=(obj,), fire_after=0)
+
+    def test_default_policy_names_live_counters(self):
+        pol = SLOPolicy.default()
+        names = sorted(o.name for o in pol.objectives)
+        assert names == ["availability", "deadline", "nan_quarantine",
+                         "ttft_p95"]
+
+
+# =============================================================================
+# AlertCenter hysteresis
+# =============================================================================
+class TestAlertCenter:
+    def test_fire_needs_consecutive_exceedances(self):
+        ac = AlertCenter(fire_after=2, clear_after=3)
+        assert ac.feed("o", True, True, 1.0) == "ok"
+        assert ac.feed("o", False, False, 2.0) == "ok"   # streak broken
+        assert ac.feed("o", True, True, 3.0) == "ok"
+        assert ac.feed("o", True, True, 4.0) == "firing"
+        assert ac.firing() == ["o"]
+        assert [e["kind"] for e in ac.log] == ["slo.fire"]
+
+    def test_clear_hysteresis_resets_on_relapse(self):
+        ac = AlertCenter(fire_after=1, clear_after=3)
+        ac.feed("o", True, True, 1.0)
+        assert ac.state("o") == "firing"
+        ac.feed("o", False, False, 2.0)
+        ac.feed("o", False, False, 3.0)
+        ac.feed("o", False, True, 4.0)       # relapse: fast still paging
+        ac.feed("o", False, False, 5.0)
+        ac.feed("o", False, False, 6.0)
+        assert ac.state("o") == "firing"     # 2-streak, needs 3
+        ac.feed("o", False, False, 7.0)
+        assert ac.state("o") == "ok"
+        assert [e["kind"] for e in ac.log] == ["slo.fire", "slo.clear"]
+
+
+# =============================================================================
+# Burn-rate math under a fake clock
+# =============================================================================
+def _counters(bad_name="t.slo.bad", total_name="t.slo.total"):
+    b, t = stat_registry.get(bad_name), stat_registry.get(total_name)
+    b.reset()
+    t.reset()
+    return b, t
+
+
+def _policy(**kw):
+    defaults = dict(
+        objectives=(SLOObjective(name="avail", target=0.99,
+                                 bad=("t.slo.bad",),
+                                 total=("t.slo.total",)),),
+        fast_window_s=60.0, slow_window_s=300.0, budget_window_s=3600.0,
+        burn_threshold=10.0, fire_after=2, clear_after=3,
+        eval_interval_s=1.0)
+    defaults.update(kw)
+    return SLOPolicy(**defaults)
+
+
+class TestBurnRateMath:
+    def test_textbook_fire_and_exact_budget_arithmetic(self):
+        bad, total = _counters()
+        clk = FakeClock(0.0)
+        tr = SLOTracker(_policy(), clock=clk)
+        out = tr.evaluate(now=0.0)
+        assert out["avail"]["alert"] == "ok"
+        assert out["avail"]["burn_rate"] == 0.0
+        # 50% errors against a 1% budget: burn = 0.5/0.01 = 50×
+        bad.add(50)
+        total.add(100)
+        out = tr.evaluate(now=10.0)
+        assert out["avail"]["burn_rate"] == pytest.approx(50.0)
+        assert out["avail"]["alert"] == "ok"          # streak 1 of 2
+        bad.add(50)
+        total.add(100)
+        out = tr.evaluate(now=20.0)
+        assert out["avail"]["alert"] == "firing"
+        assert out["avail"]["attainment"] == pytest.approx(0.5)
+        # budget_remaining = 1 - rate/budget_rate = 1 - 0.5/0.01
+        assert out["avail"]["budget_remaining"] == pytest.approx(-49.0)
+        assert stat_registry.get("serving.slo.alerts_fired").get() == 1
+        assert tr.active_alerts() == ["avail"]
+        assert tr.alert_log()[-1]["kind"] == "slo.fire"
+        # labeled gauges exported per objective
+        g = stat_registry.labeled_gauge("serving.slo.alert")
+        assert g.get(objective="avail") == 1.0
+
+    def test_burn_exactly_at_threshold_does_not_page(self):
+        bad, total = _counters()
+        clk = FakeClock(0.0)
+        # target 0.5 → budget 0.5 (exact in binary); 100% errors →
+        # burn exactly 2.0 == threshold → strict > means NO page
+        pol = _policy(objectives=(SLOObjective(
+            name="edge", target=0.5, bad=("t.slo.bad",),
+            total=("t.slo.total",)),), burn_threshold=2.0, fire_after=1)
+        tr = SLOTracker(pol, clock=clk)
+        tr.evaluate(now=0.0)
+        for i in range(1, 6):
+            bad.add(10)
+            total.add(10)
+            out = tr.evaluate(now=10.0 * i)
+            assert out["edge"]["burn_rate"] == 2.0
+            assert out["edge"]["alert"] == "ok"
+
+    def test_clear_after_fast_window_recovers(self):
+        bad, total = _counters()
+        clk = FakeClock(0.0)
+        tr = SLOTracker(_policy(), clock=clk)
+        tr.evaluate(now=0.0)
+        for t in (10.0, 20.0):
+            bad.add(50)
+            total.add(100)
+            tr.evaluate(now=t)
+        assert tr.active_alerts() == ["avail"]
+        # errors stop; the fast window still spans the bad era until
+        # t-60 passes t=20, so clearing starts at t=90
+        states = []
+        for t in (90.0, 100.0, 110.0):
+            total.add(100)
+            states.append(tr.evaluate(now=t)["avail"]["alert"])
+        assert states == ["firing", "firing", "ok"]
+        assert stat_registry.get("serving.slo.alerts_cleared").get() == 1
+        assert tr.alert_log()[-1]["kind"] == "slo.clear"
+
+    def test_same_timestamp_evaluations_replace_not_stack(self):
+        bad, total = _counters()
+        clk = FakeClock(0.0)
+        tr = SLOTracker(_policy(), clock=clk)
+        tr.evaluate(now=0.0)
+        bad.add(5)
+        total.add(10)
+        a = tr.evaluate(now=10.0)
+        b = tr.evaluate(now=10.0)          # second scrape, same tick
+        assert a == b
+        assert len(tr._samples["avail"]) == 2
+
+    def test_maybe_evaluate_throttles_on_injected_clock(self):
+        _counters()
+        clk = FakeClock(0.0)
+        tr = SLOTracker(_policy(eval_interval_s=5.0), clock=clk)
+        assert tr.maybe_evaluate() is not None
+        clk.advance(4.9)
+        assert tr.maybe_evaluate() is None
+        clk.advance(0.2)
+        assert tr.maybe_evaluate() is not None
+
+    def test_brownout_pressure_floor_mapping(self):
+        from paddle_tpu.serving.resilience import BrownoutPolicy
+
+        bad, total = _counters()
+        clk = FakeClock(0.0)
+        tr = SLOTracker(_policy(fire_after=1), clock=clk)
+        bp = BrownoutPolicy()
+        assert tr.brownout_pressure_floor(bp) == 0.0
+        tr.evaluate(now=0.0)
+        bad.add(50)
+        total.add(100)
+        tr.evaluate(now=10.0)              # burn 50 ≥ 2×10 → clamp floor
+        assert tr.active_alerts() == ["avail"]
+        assert tr.brownout_pressure_floor(bp) == bp.clamp_at
+
+    def test_reset_forgets_samples_and_alerts(self):
+        bad, total = _counters()
+        clk = FakeClock(0.0)
+        tr = SLOTracker(_policy(fire_after=1), clock=clk)
+        tr.evaluate(now=0.0)
+        bad.add(50)
+        total.add(100)
+        tr.evaluate(now=10.0)
+        assert tr.active_alerts()
+        tr.reset()
+        assert tr.active_alerts() == []
+        assert tr.status() == {}
+        assert stat_registry.get("serving.slo.alerts_fired").get() == 0
+
+
+# =============================================================================
+# Prometheus 0.0.4 text-grammar round-trip
+# =============================================================================
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})? (\S+)$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+                      r"(gauge|counter|histogram|summary)$")
+
+
+def _unescape(v: str) -> str:
+    return (v.replace(r"\n", "\n").replace(r"\"", '"')
+            .replace("\\\\", "\\"))
+
+
+def parse_prometheus(text: str):
+    """Strict 0.0.4 text parser: {family: {"type": t, "samples":
+    [(name, labels_dict, value)]}}.  Raises on any malformed line —
+    the round-trip tests feed it the real exposition output."""
+    families, cur = {}, None
+    assert text.endswith("\n"), "exposition must end with a newline"
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = _TYPE_RE.match(line)
+            assert m, f"malformed comment line: {line!r}"
+            cur = m.group(1)
+            assert cur not in families, f"duplicate TYPE for {cur}"
+            families[cur] = {"type": m.group(2), "samples": []}
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        name, labels_raw, value_raw = m.groups()
+        labels = {}
+        if labels_raw:
+            body = labels_raw[1:-1]
+            parsed = _LABEL_RE.findall(body)
+            rebuilt = ",".join(f'{k}="{v}"' for k, v in
+                               ((k, v) for k, v in parsed))
+            assert rebuilt == body, f"unparsed label residue: {body!r}"
+            labels = {k: _unescape(v) for k, v in parsed}
+        value = float(value_raw)   # accepts +Inf/-Inf/NaN spellings
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in families:
+                base = name[: -len(suffix)]
+                break
+        assert base in families, f"sample {name!r} has no TYPE line"
+        families[base]["samples"].append((name, labels, value))
+    return families
+
+
+def _check_histogram_invariants(fam, base):
+    buckets = [(lab["le"], v) for n, lab, v in fam["samples"]
+               if n == base + "_bucket"]
+    assert buckets, f"{base}: no buckets"
+    counts = [v for _, v in buckets]
+    assert counts == sorted(counts), f"{base}: non-cumulative buckets"
+    les = [float(le) for le, _ in buckets]
+    assert les == sorted(les), f"{base}: le not ascending"
+    assert les[-1] == math.inf, f"{base}: missing +Inf bucket"
+    count = [v for n, _, v in fam["samples"] if n == base + "_count"]
+    assert count and count[0] == counts[-1], \
+        f"{base}: +Inf bucket != _count"
+    assert any(n == base + "_sum" for n, _, v in fam["samples"])
+
+
+class TestPrometheusRoundTrip:
+    def test_private_registry_round_trip(self):
+        reg = StatRegistry()
+        reg.get("serving.steps").add(7)
+        reg.labeled_gauge("serving.fleet.state").set(
+            2, replica='rep "zero"\\x', role="pre\nfill")
+        h = reg.histogram("serving.lat_ms")
+        for v in (0.5, 2.0, 1e9):          # 1e9 > top bound → +Inf land
+            h.observe(v)
+        clk = FakeClock(0.0)
+        w = reg.windowed("serving.window.lat_ms", 60.0, 6, clock=clk)
+        w.observe(42.0)
+        fams = parse_prometheus(prometheus_text(reg))
+        assert fams["serving_steps"]["type"] == "gauge"
+        assert fams["serving_steps"]["samples"][0][2] == 7.0
+        (_, labels, value), = fams["serving_fleet_state"]["samples"]
+        assert labels == {"replica": 'rep "zero"\\x', "role": "pre\nfill"}
+        assert value == 2.0
+        assert fams["serving_lat_ms"]["type"] == "histogram"
+        _check_histogram_invariants(fams["serving_lat_ms"],
+                                    "serving_lat_ms")
+        summ = fams["serving_window_lat_ms"]
+        assert summ["type"] == "summary"
+        quants = {lab["quantile"]: v for n, lab, v in summ["samples"]
+                  if n == "serving_window_lat_ms"}
+        assert set(quants) == {"0.5", "0.95", "0.99"}
+        assert quants["0.5"] == pytest.approx(42.0)
+        count = [v for n, _, v in summ["samples"]
+                 if n == "serving_window_lat_ms_count"]
+        assert count == [1.0]
+
+    def test_sanitize_collision_merges_into_one_family(self):
+        # "t.mem" and "t_mem" collapse to the same exposition name: the
+        # page must carry ONE TYPE line with the samples grouped (a
+        # duplicate TYPE makes a scraper reject the whole page); a
+        # cross-type collision disambiguates by suffixing the type
+        reg = StatRegistry()
+        reg.get("t_mem").add(8)
+        reg.labeled_gauge("t.mem").set(7, kind="host")
+        reg.get("t.col").add(1)
+        reg.histogram("t_col").observe(2.0)
+        text = prometheus_text(reg)
+        assert text.count("# TYPE t_mem gauge") == 1
+        fams = parse_prometheus(text)
+        samples = fams["t_mem"]["samples"]
+        assert ("t_mem", {}, 8.0) in samples
+        assert ("t_mem", {"kind": "host"}, 7.0) in samples
+        assert fams["t_col"]["type"] == "gauge"
+        assert fams["t_col_histogram"]["type"] == "histogram"
+        _check_histogram_invariants(fams["t_col_histogram"],
+                                    "t_col_histogram")
+
+    def test_live_registry_scrape_parses_clean(self):
+        # whatever state the suite left behind, the real scrape must
+        # be grammatically valid with histogram invariants intact
+        fams = parse_prometheus(prometheus_text())
+        for base, fam in fams.items():
+            if fam["type"] == "histogram" and fam["samples"]:
+                _check_histogram_invariants(fam, base)
+            if fam["type"] == "summary":
+                count = [v for n, _, v in fam["samples"]
+                         if n == base + "_count"]
+                assert len(count) == 1 and count[0] >= 0
+
+
+# =============================================================================
+# Dashboard rendering (pure payload → frame)
+# =============================================================================
+def _payload():
+    return {
+        "status": "ok", "healthy_replicas": 2, "total_replicas": 2,
+        "healthy_by_role": {"prefill": 1, "decode": 1},
+        "inflight": 3, "queued": 1, "closing": False,
+        "brownout_stage": 1,
+        "replicas": [
+            {"id": "replica-0", "role": "prefill", "state": "healthy",
+             "steps": 12, "outstanding_tokens": 40, "inbox_depth": 2,
+             "last_step_age_s": 0.1, "busy_for_s": None,
+             "dead_reason": ""},
+            {"id": "replica-1", "role": "decode", "state": "suspect",
+             "steps": 40, "outstanding_tokens": 9, "inbox_depth": 0,
+             "last_step_age_s": 2.0, "busy_for_s": 1.5,
+             "dead_reason": ""},
+        ],
+        "tiers": {"kv_pages_in_use": 17, "prefix_cached_tokens": 128,
+                  "host_pages": 4, "disk_pages": 9},
+        "window": {
+            "frontend": {"ttft_ms": {"count": 5, "mean": 100.0,
+                                     "p50": 90.0, "p95": 200.0,
+                                     "p99": 210.0}},
+            "engine": {"itl_ms": {"count": 0}},
+        },
+        "slo": {
+            "objectives": {
+                "availability": {"kind": "error_budget", "target": 0.999,
+                                 "attainment": 0.97,
+                                 "budget_remaining": -29.0,
+                                 "burn_rate": 30.0,
+                                 "burn_rate_slow": 12.0,
+                                 "alert": "firing"},
+                "ttft_p95": {"kind": "latency", "target": 0.95,
+                             "attainment": 0.99,
+                             "budget_remaining": 0.8, "burn_rate": 0.2,
+                             "burn_rate_slow": 0.1, "alert": "ok",
+                             "threshold_ms": 1000.0},
+            },
+            "active_alerts": ["availability"],
+            "alert_log": [{"at": 120.0, "kind": "slo.fire",
+                           "objective": "availability",
+                           "detail": "burn_fast=30.00"}],
+        },
+    }
+
+
+class TestDashRender:
+    def test_frame_contains_every_section(self):
+        from tools.dash import render_frame
+
+        frame = render_frame(_payload())
+        for needle in ("fleet status: OK", "replica-0", "replica-1",
+                       "suspect", "busy 1.5s", "brownout=1",
+                       "host tier 4 pages", "disk tier 9 pages",
+                       "frontend.ttft_ms", "90.0ms",
+                       "availability", "FIRING", "ttft_p95",
+                       "slo.fire", "burn_fast=30.00"):
+            assert needle in frame, f"missing {needle!r} in frame"
+        # --once / --file output is plain: no ANSI escapes
+        assert "\x1b[" not in frame
+        # empty-window metrics are elided, not rendered as zeros
+        assert "engine.itl_ms" not in frame
+
+    def test_color_mode_only_adds_sgr(self):
+        from tools.dash import render_frame
+
+        plain = render_frame(_payload())
+        color = render_frame(_payload(), color=True)
+        assert "\x1b[" in color
+        assert re.sub(r"\x1b\[[0-9;]*m", "", color) == plain
+
+    def test_slo_disabled_payload_renders(self):
+        from tools.dash import render_frame
+
+        p = _payload()
+        p["slo"] = None
+        assert "(tracking disabled)" in render_frame(p)
+
+    def test_cli_once_from_file(self, tmp_path, capsys):
+        from tools.dash import main
+
+        path = tmp_path / "hz.json"
+        path.write_text(json.dumps(_payload()))
+        assert main(["--file", str(path), "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "fleet status: OK" in out and "availability" in out
+
+
+# =============================================================================
+# Seeded-chaos drill: storm → fire → visible everywhere → clear,
+# byte-deterministic across drives
+# =============================================================================
+ENGINE_KW = dict(page_size=4, max_batch_size=4, eos_id=-1)
+
+
+def _drill(gpt):
+    """One full storm: kill both replicas under chaos so every live
+    request fails terminal, then probe the tracker at fixed fake-clock
+    instants.  Everything returned is a pure function of the schedule
+    and the clock — the double-drive test pins equality."""
+    recorder.reset()
+    recorder.configure(enabled=True)
+    clk = FakeClock(0.0)
+    policy = SLOPolicy(
+        objectives=(SLOObjective(
+            name="availability", target=0.999,
+            bad=("serving.frontend.failures",),
+            total=("serving.frontend.submitted",)),),
+        fast_window_s=60.0, slow_window_s=300.0, budget_window_s=3600.0,
+        burn_threshold=10.0, fire_after=2, clear_after=3,
+        eval_interval_s=1e9)         # pump auto-evals throttled away
+    tracker = SLOTracker(policy, clock=clk)
+    plan = ChaosPlan([
+        Fault("replica.kill", at=2, action="kill", match="replica-0"),
+        Fault("replica.kill", at=2, action="kill", match="replica-1"),
+    ], name="slo-availability-storm")
+    fe = ServingFrontend(gpt, replicas=2, queue_cap=32,
+                         engine_kwargs=ENGINE_KW, slo=tracker)
+    probes = []
+    try:
+        # deterministic zero baseline before any traffic (counters
+        # were reset by the frontend's metrics construction)
+        tracker.evaluate(now=0.0)
+        rng = np.random.RandomState(3)
+        with chaos.running(plan):
+            handles = [fe.submit(
+                rng.randint(1, VOCAB, (4,)).astype(np.int32),
+                max_new_tokens=10) for _ in range(6)]
+            statuses = [h.wait(timeout=120) for h in handles]
+        assert statuses == ["failed"] * 6
+        for t in (10.0, 20.0):
+            clk.t = t
+            probes.append(fe.healthz()["slo"])
+        bundle = recorder.build_bundle("slo drill")
+        # recovery: errors stopped; the fast window passes the bad era
+        for t in (90.0, 100.0, 110.0):
+            clk.t = t
+            probes.append(fe.healthz()["slo"])
+    finally:
+        fe.close()
+        recorder.reset()
+    return probes, bundle
+
+
+class TestChaosDrill:
+    def test_storm_fires_availability_everywhere_then_clears(self, gpt):
+        probes, bundle = _drill(gpt)
+        # fire_after=2: first probe is streak 1, second fires
+        assert probes[0]["objectives"]["availability"]["alert"] == "ok"
+        fired = probes[1]["objectives"]["availability"]
+        assert fired["alert"] == "firing"
+        # 6 failures / 6 submissions: exact arithmetic
+        assert fired["attainment"] == pytest.approx(0.0)
+        assert fired["burn_rate"] == pytest.approx(1.0 / 0.001)
+        assert probes[1]["active_alerts"] == ["availability"]
+        assert probes[1]["alert_log"][-1]["kind"] == "slo.fire"
+        # the flight recorder ring carries the transition...
+        kinds = [t["kind"] for t in bundle["transitions"]]
+        assert "slo.fire" in kinds and "replica.dead" in kinds
+        # ...and the postmortem context answers "was it burning?"
+        slo_ctx = [v["slo"] for k, v in bundle["context"].items()
+                   if k.startswith("serving.frontend")]
+        assert slo_ctx and slo_ctx[0]["active_alerts"] == ["availability"]
+        # hysteresis: clears on the third recovered evaluation
+        states = [p["objectives"]["availability"]["alert"]
+                  for p in probes[2:]]
+        assert states == ["firing", "firing", "ok"]
+        assert probes[-1]["alert_log"][-1]["kind"] == "slo.clear"
+
+    def test_double_drive_identical_slo_payloads(self, gpt):
+        probes_a, bundle_a = _drill(gpt)
+        probes_b, bundle_b = _drill(gpt)
+        assert probes_a == probes_b
+        kinds = [t["kind"] for t in bundle_a["transitions"]]
+        assert kinds == [t["kind"] for t in bundle_b["transitions"]]
+
+
+# =============================================================================
+# Frontend knob validation + windowed families end-to-end
+# =============================================================================
+class TestFrontendIntegration:
+    def test_slo_knob_validation(self, gpt):
+        with pytest.raises(InvalidArgumentError):
+            ServingFrontend(gpt, replicas=1, engine_kwargs=ENGINE_KW,
+                            slo="yes")
+        with pytest.raises(InvalidArgumentError):
+            ServingFrontend(gpt, replicas=1, engine_kwargs=ENGINE_KW,
+                            slo_adaptive_brownout="on")
+        with pytest.raises(InvalidArgumentError):
+            # adaptive brownout needs BOTH slo and brownout enabled
+            ServingFrontend(gpt, replicas=1, engine_kwargs=ENGINE_KW,
+                            slo=True, brownout=None,
+                            slo_adaptive_brownout=True)
+
+    def test_disabled_slo_surfaces_none(self, gpt):
+        fe = ServingFrontend(gpt, replicas=1, engine_kwargs=ENGINE_KW,
+                             slo=False)
+        try:
+            hz = fe.healthz()
+            assert hz["slo"] is None
+            assert fe.stats()["slo"] is None
+        finally:
+            fe.close()
+
+    def test_healthz_carries_windows_tiers_and_fleet(self, gpt):
+        fe = ServingFrontend(
+            gpt, replicas=1, queue_cap=8,
+            engine_kwargs=dict(page_size=4, max_batch_size=4, eos_id=0))
+        try:
+            h = fe.submit(np.array([3, 5, 7], np.int32),
+                          max_new_tokens=4)
+            assert h.wait(timeout=120) in ("completed",)
+            hz = fe.healthz()
+            assert set(hz["tiers"]) == {"kv_pages_in_use",
+                                        "prefix_cached_tokens",
+                                        "host_pages", "disk_pages"}
+            assert "ttft_ms" in hz["window"]["frontend"]
+            assert hz["window"]["frontend"]["ttft_ms"]["count"] >= 1
+            assert "decode_latency_ms" in hz["window"]["engine"]
+            slo = hz["slo"]
+            assert set(slo["objectives"]) == {
+                "availability", "deadline", "nan_quarantine",
+                "ttft_p95"}
+            # fleet rollup refreshed by healthz()
+            g = stat_registry.labeled_gauge("serving.fleet.state")
+            assert g.get(replica="replica-0", role="any") == 0.0
+        finally:
+            fe.close()

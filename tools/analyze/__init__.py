@@ -3,7 +3,7 @@
 Static companion to the runtime witnesses (the lock-order witness in
 ``paddle_tpu.framework.concurrency``, the compile ledger in
 ``paddle_tpu.profiler.jit_cost``, the transfer guard, the
-``testing.determinism`` ambient-RNG guard): nine checkers over the
+``testing.determinism`` ambient-RNG guard): ten checkers over the
 parsed source keep the hazards PR reviews kept catching by hand
 machine-checked instead (docs/ANALYSIS.md has the catalog and the
 baseline workflow):
@@ -16,6 +16,9 @@ baseline workflow):
 - ``pallas-contract``  declared KernelContract tiling/VMEM/divisibility
                        rules + contract/call-site drift
 - ``metrics-drift``    emitted metric names <-> docs/OBSERVABILITY.md
+- ``metrics-coverage`` serving.* names <-> the OBSERVABILITY.md metric
+                       TABLES (prose mentions don't count — the ops
+                       catalog an operator dashboards from)
 - ``error-taxonomy``   serving raises use framework.errors classes and
                        every class has an HTTP mapping
 - ``determinism``      byte-identity discipline: ambient RNG draws,

@@ -3,5 +3,6 @@ with ``core.CHECKS`` (each checker module calls ``@register`` at import
 time).  New checkers: add the module here and it joins the CLI, the
 baseline workflow and the tier-1 self-run automatically."""
 from . import (chaos_coverage, determinism, error_taxonomy,  # noqa: F401
-               host_sync, jit_hazard, lock_discipline, metrics_drift,
-               pallas_contract, retrace_hazard)
+               host_sync, jit_hazard, lock_discipline,
+               metrics_coverage, metrics_drift, pallas_contract,
+               retrace_hazard)

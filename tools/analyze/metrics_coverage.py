@@ -1,0 +1,96 @@
+"""metrics-coverage: serving metric names <-> OBSERVABILITY.md *tables*.
+
+``metrics-drift`` (MD001/MD002) keeps the emitted-name set equal to the
+names MENTIONED anywhere in docs/OBSERVABILITY.md — a backtick in prose
+satisfies it.  This checker enforces the stricter ops-surface
+discipline ISSUE 17 introduced with the SLO engine and the fleet
+dashboard: every ``serving.*`` name the code emits (engine, frontend,
+fleet, SLO families alike) must have a row in one of the doc's metric
+TABLES (a ``|``-delimited markdown row — the catalog an operator
+dashboards from), and every table row must name a metric something
+actually emits.  Prose mentions don't count: a metric described in a
+paragraph but missing from the catalog tables is exactly the drift this
+lint exists to catch.
+
+- CODE side: same collection as ``metrics-drift`` (the StatRegistry
+  call surface plus the ``GAUGES``/``COUNTERS``/``HISTOGRAMS``/
+  ``WINDOWED``/``LABELED`` class-attribute tuples), filtered to the
+  ``serving.`` family.
+- DOC side: backtick spans inside markdown table rows of
+  docs/OBSERVABILITY.md, with the same brace expansion
+  (```serving.{snapshots,restores}```) and leading-dot continuation
+  (```serving.frontend.submitted``` then ```.completed```) shorthands
+  — continuations reset at each table so a dangling prefix can't leak
+  across sections.
+
+MC001 = emitted but missing from every metric table;
+MC002 = a table row names a metric nothing emits.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Set, Tuple
+
+from .core import AnalysisContext, Finding, register
+from .metrics_drift import (_CodeScan, _expand_braces, _metric_name,
+                            _SPAN_RE, CODE_ROOTS, DOC_PATH)
+
+_FAMILY = "serving."
+_TABLE_ROW_RE = re.compile(r"^\s*\|")
+_RULE_ROW_RE = re.compile(r"^\s*\|[\s\-:|]+\|\s*$")
+
+
+def collect_table_names(ctx: AnalysisContext,
+                        doc_rel: str = DOC_PATH) -> Dict[str, int]:
+    """Metric names appearing in markdown TABLE rows -> first line."""
+    names: Dict[str, int] = {}
+    prev_prefix = ""
+    for lineno, line in enumerate(ctx.lines(doc_rel), start=1):
+        if not _TABLE_ROW_RE.match(line):
+            prev_prefix = ""          # continuations live within a table
+            continue
+        if _RULE_ROW_RE.match(line):
+            continue
+        for raw in _SPAN_RE.findall(line):
+            for span in _expand_braces(raw):
+                if "*" in span:
+                    continue
+                if span.startswith(".") and prev_prefix \
+                        and re.match(r"^\.[a-z0-9_]+$", span):
+                    span = prev_prefix + span
+                if _metric_name(span):
+                    names.setdefault(span, lineno)
+                    prev_prefix = span.rsplit(".", 1)[0]
+    return names
+
+
+@register("metrics-coverage")
+def run(ctx: AnalysisContext) -> List[Finding]:
+    emitted: Dict[str, Tuple[str, int]] = {}
+    attribution: Set[str] = set()
+    for rel in ctx.iter_py(CODE_ROOTS):
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        scan = _CodeScan(rel)
+        scan.visit(tree)
+        for name, where in scan.emitted.items():
+            emitted.setdefault(name, where)
+        attribution |= scan.attribution
+    emitted = {n: w for n, w in emitted.items()
+               if n.startswith(_FAMILY)}
+    tabled = {n: ln for n, ln in collect_table_names(ctx).items()
+              if n.startswith(_FAMILY)}
+    findings: List[Finding] = []
+    for name in sorted(set(emitted) - set(tabled)):
+        rel, line = emitted[name]
+        findings.append(Finding(
+            rel, line, "MC001", "metrics-coverage",
+            f"serving metric {name!r} is emitted here but has no row "
+            f"in the {DOC_PATH} metric tables"))
+    for name in sorted(set(tabled) - set(emitted) - attribution):
+        findings.append(Finding(
+            DOC_PATH, tabled[name], "MC002", "metrics-coverage",
+            f"{DOC_PATH} metric table lists {name!r} but nothing "
+            "emits it"))
+    return findings

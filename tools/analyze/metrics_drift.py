@@ -12,11 +12,12 @@ the flight-recorder / request-tracing surface added by ISSUE 11;
 and tuning-table accounting):
 
 - CODE side: string literals passed to the StatRegistry surface
-  (``stat_registry.get/histogram``, ``stat_add``/``stat_get``,
-  ``histogram_observe``/``histogram_snapshot``, ``gauge_set``) plus the
-  ``GAUGES``/``COUNTERS``/``HISTOGRAMS`` class-attribute tuples the
-  metrics classes enumerate (their f-string emissions are derived from
-  these).  Test files are not scanned — a test hammering
+  (``stat_registry.get/histogram/windowed/labeled_gauge``,
+  ``stat_add``/``stat_get``, ``histogram_observe``/
+  ``histogram_snapshot``, ``gauge_set``) plus the ``GAUGES``/
+  ``COUNTERS``/``HISTOGRAMS``/``WINDOWED``/``LABELED`` class-attribute
+  tuples the metrics classes enumerate (their f-string emissions are
+  derived from these).  Test files are not scanned — a test hammering
   ``t.hammer.counter`` is not operational surface (and the prefix
   filter drops such names anyway).
 - DOC side: backtick-quoted names in docs/OBSERVABILITY.md matching
@@ -49,10 +50,12 @@ _NAME_RE = re.compile(
 _REGISTRY_FUNCS = frozenset({
     "stat_registry.get", "stat_registry.histogram", "stat_add",
     "stat_get", "histogram_observe", "histogram_snapshot", "gauge_set",
+    "stat_registry.windowed", "stat_registry.labeled_gauge",
 })
 _ATTR_FUNCS = frozenset({"profiled_jit", "RecordEvent", "span",
                          "instant"})
-_LIST_ATTRS = frozenset({"GAUGES", "COUNTERS", "HISTOGRAMS"})
+_LIST_ATTRS = frozenset({"GAUGES", "COUNTERS", "HISTOGRAMS",
+                         "WINDOWED", "LABELED"})
 _SPAN_RE = re.compile(r"`([^`]+)`")
 
 

@@ -197,7 +197,13 @@ _LOWER_BETTER = ("_ms", "latency", "ttft", "e2e", "gap", "miss", "bytes",
                  # tiered KV transport (ISSUE 16): demotions rising on a
                  # fixed workload mean more device-cache churn (pages
                  # spilling off-device that used to stay resident)
-                 "demot")
+                 "demot",
+                 # fleet SLOs (ISSUE 17): alerts firing on the fixed
+                 # bench workload mean the fleet burned budget it
+                 # didn't used to (attainment / budget_remaining need
+                 # no fragment — unmatched paths already gate downward
+                 # as bigger-is-better; burn rates ride "_rate")
+                 "alert")
 
 
 def lower_is_better(metric: str) -> bool:

@@ -1,0 +1,223 @@
+"""Live fleet ops dashboard — ANSI terminal rendering of
+``ServingFrontend.healthz()``, zero dependencies beyond the stdlib.
+
+One ``healthz()`` payload carries everything an operator triages with:
+replica pools and their health states, brownout stage, KV tier
+occupancy, recent-window latency percentiles (the bounded-memory
+``WindowedHistogram`` families), and the SLO engine's per-objective
+attainment / error-budget / burn-rate / alert states with the recent
+alert transition log.  This tool renders that payload as a compact
+terminal frame.
+
+Usage:
+    python -m tools.dash --url http://127.0.0.1:8100/healthz   # live loop
+    python -m tools.dash --url ... --once                      # one frame
+    python -m tools.dash --file healthz.json --once            # offline
+
+``render_frame(payload)`` is a pure function of the payload dict (no
+clock, no network, no ANSI cursor control) — ``--once`` prints exactly
+one frame and exits 0, which is what the tests drive.  The live loop
+repaints with ANSI clear-home every ``--interval`` seconds and exits
+cleanly on Ctrl-C.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+WIDTH = 72
+
+# plain-vs-color cell renderers: color only on a TTY loop, never in
+# --once output (tests and shell pipelines see stable bytes)
+_STATE_GLYPH = {"healthy": "●", "suspect": "◐", "draining": "◌",
+                "dead": "✗"}
+_STATE_COLOR = {"healthy": "32", "suspect": "33", "draining": "36",
+                "dead": "31"}
+_ALERT_COLOR = {"ok": "32", "firing": "31"}
+
+
+def _c(text: str, code: str, color: bool) -> str:
+    return f"\x1b[{code}m{text}\x1b[0m" if color else text
+
+
+def _bar(frac: float, width: int = 20) -> str:
+    frac = max(0.0, min(1.0, float(frac)))
+    filled = int(round(frac * width))
+    return "█" * filled + "░" * (width - filled)
+
+
+def _fmt_ms(v) -> str:
+    if v is None:
+        return "-"
+    v = float(v)
+    if v >= 10000:
+        return f"{v / 1000:.1f}s"
+    return f"{v:.1f}ms"
+
+
+def _rule(title: str) -> str:
+    pad = WIDTH - len(title) - 4
+    return f"── {title} " + "─" * max(0, pad)
+
+
+def _fleet_lines(payload: dict, color: bool) -> List[str]:
+    lines = [_rule("fleet")]
+    by_role = payload.get("healthy_by_role") or {}
+    lines.append(
+        f"  replicas {payload.get('healthy_replicas', '?')}"
+        f"/{payload.get('total_replicas', '?')} healthy"
+        f"   prefill={by_role.get('prefill', 0)}"
+        f" decode={by_role.get('decode', 0)}"
+        f"   inflight={payload.get('inflight', 0)}"
+        f" queued={payload.get('queued', 0)}"
+        f"   brownout={payload.get('brownout_stage', 0)}")
+    for rep in payload.get("replicas", []):
+        state = rep.get("state", "?")
+        glyph = _c(_STATE_GLYPH.get(state, "?"),
+                   _STATE_COLOR.get(state, "0"), color)
+        busy = rep.get("busy_for_s")
+        busy_s = "" if busy is None else f"  busy {busy:.1f}s"
+        dead = rep.get("dead_reason")
+        dead_s = f"  [{dead}]" if dead else ""
+        lines.append(
+            f"  {glyph} {rep.get('id', '?'):<12} {rep.get('role', '?'):<8}"
+            f" {state:<9} steps={rep.get('steps', 0):<6}"
+            f" out_tok={rep.get('outstanding_tokens', 0):<6}"
+            f" inbox={rep.get('inbox_depth', 0)}{busy_s}{dead_s}")
+    return lines
+
+
+def _tier_lines(payload: dict) -> List[str]:
+    tiers = payload.get("tiers")
+    if not tiers:
+        return []
+    return [
+        _rule("kv tiers"),
+        f"  device pages in use {int(tiers.get('kv_pages_in_use', 0))}"
+        f"   prefix-cached tokens {int(tiers.get('prefix_cached_tokens', 0))}",
+        f"  host tier {int(tiers.get('host_pages', 0))} pages"
+        f"   disk tier {int(tiers.get('disk_pages', 0))} pages",
+    ]
+
+
+def _window_lines(payload: dict) -> List[str]:
+    window = payload.get("window")
+    if not window:
+        return []
+    lines = [_rule("recent latency (windowed)")]
+    lines.append(f"  {'metric':<22}{'count':>7}{'p50':>10}{'p95':>10}"
+                 f"{'p99':>10}")
+    for scope in ("frontend", "engine"):
+        for short, snap in sorted((window.get(scope) or {}).items()):
+            if not snap or not snap.get("count"):
+                continue
+            lines.append(
+                f"  {scope + '.' + short:<22}{snap['count']:>7}"
+                f"{_fmt_ms(snap.get('p50')):>10}"
+                f"{_fmt_ms(snap.get('p95')):>10}"
+                f"{_fmt_ms(snap.get('p99')):>10}")
+    if len(lines) == 2:
+        lines.append("  (no samples in window)")
+    return lines
+
+
+def _slo_lines(payload: dict, color: bool) -> List[str]:
+    slo = payload.get("slo")
+    if not slo:
+        return [_rule("slo"), "  (tracking disabled)"]
+    lines = [_rule("slo objectives")]
+    lines.append(f"  {'objective':<16}{'target':>8}{'attain':>9}"
+                 f"{'budget':>9}{'burn':>8}  alert")
+    for name, obj in sorted((slo.get("objectives") or {}).items()):
+        alert = obj.get("alert", "?")
+        alert_s = _c(alert.upper() if alert == "firing" else alert,
+                     _ALERT_COLOR.get(alert, "0"), color)
+        budget = obj.get("budget_remaining", 0.0)
+        lines.append(
+            f"  {name:<16}{obj.get('target', 0):>8.4g}"
+            f"{obj.get('attainment', 0):>9.4f}"
+            f"{budget:>9.3f}{obj.get('burn_rate', 0):>8.2f}  {alert_s}"
+            + ("  " + _bar(max(0.0, budget), 12) if alert == "ok" else ""))
+    active = slo.get("active_alerts") or []
+    if active:
+        lines.append("  " + _c(f"FIRING: {', '.join(active)}", "31;1",
+                               color))
+    log = slo.get("alert_log") or []
+    if log:
+        lines.append(_rule("alert log (newest last)"))
+        for entry in log[-6:]:
+            kind = entry.get("kind", "?")
+            lines.append(
+                f"  t={entry.get('at', 0):>10.1f}  "
+                + _c(kind, "31" if kind == "slo.fire" else "32", color)
+                + f"  {entry.get('objective', '?')}"
+                + (f"  {entry.get('detail')}" if entry.get("detail")
+                   else ""))
+    return lines
+
+
+def render_frame(payload: dict, color: bool = False) -> str:
+    """Render one healthz payload as a multi-line terminal frame.
+    Pure: same payload → same string (color only changes SGR codes)."""
+    status = payload.get("status", "?")
+    head = _c(f" fleet status: {status.upper()} ",
+              "42;30" if status == "ok" else "41;97", color)
+    lines = ["┌" + "─" * WIDTH + "┐", " " + head]
+    lines += _fleet_lines(payload, color)
+    lines += _tier_lines(payload)
+    lines += _window_lines(payload)
+    lines += _slo_lines(payload, color)
+    lines.append("└" + "─" * WIDTH + "┘")
+    return "\n".join(lines)
+
+
+def _fetch(url: Optional[str], path: Optional[str]) -> dict:
+    if path is not None:
+        with open(path) as f:
+            return json.load(f)
+    # a 503 /healthz still carries the full JSON payload — render it
+    # (an unhealthy fleet is exactly when the dashboard matters)
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return json.load(resp)
+    except urllib.error.HTTPError as e:
+        return json.load(e)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.dash",
+        description="ANSI terminal dashboard over ServingFrontend "
+                    "healthz()")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="healthz endpoint to poll")
+    src.add_argument("--file", help="render a saved healthz JSON payload")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no ANSI control)")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="refresh period in seconds (live loop)")
+    ap.add_argument("--color", action="store_true",
+                    help="force ANSI color even when not a TTY")
+    args = ap.parse_args(argv)
+
+    if args.once or args.file:
+        print(render_frame(_fetch(args.url, args.file), color=args.color))
+        return 0
+    color = args.color or sys.stdout.isatty()
+    try:
+        while True:
+            frame = render_frame(_fetch(args.url, None), color=color)
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
