@@ -253,6 +253,12 @@ FLASH_BWD_DKV = KernelContract(
     ),
     shape_buckets={"block_q": (1024, 2048, 4096, 8192),
                    "block_k": (1024, 2048, 4096, 8192)},
+    # block_k partitions independent kv rows (exactly parity-preserving);
+    # block_q reorders the dk/dv accumulation over visiting query sets
+    # (winners must pass the sweep's parity gate) — ISSUE 18 grad-path
+    # runner (tune/runners.py) drives this sweep
+    sweep={"block_q": (256, 512, 1024),
+           "block_k": (512, 1024, 2048)},
 )
 
 FLASH_BWD_DQ = KernelContract(
@@ -277,6 +283,11 @@ FLASH_BWD_DQ = KernelContract(
     ),
     shape_buckets={"block_q": (1024, 2048, 4096, 8192),
                    "block_k": (1024, 2048, 4096, 8192)},
+    # mirror of the dkv sweep: block_q partitions independent query rows
+    # (exactly parity-preserving), block_k reorders the dq accumulation
+    # over kv chunks (parity gate applies)
+    sweep={"block_q": (256, 512, 1024),
+           "block_k": (512, 1024, 2048)},
 )
 
 # ===========================================================================
@@ -364,6 +375,110 @@ PAGED_DECODE_INT8 = KernelContract(
 )
 
 # ===========================================================================
+# paged_attention.py — UNIFIED ragged-QUERY paged attention (ISSUE 18).
+# One grid group = one lane: a block of up to ``q_align`` query rows
+# (decode lane = 1 row, chunked-prefill lane = chunk rows, spec-verify
+# lane = K rows) sharing ONE page-table row, so the page DMA is paid
+# once per lane instead of once per query row.  Same online-softmax
+# scratch as the decode contract, widened by the query-row dim.
+# ===========================================================================
+PAGED_RAGGED = KernelContract(
+    name="paged_attention_ragged",
+    module="paddle_tpu/ops/pallas_ops/paged_attention.py",
+    grid=("groups", "pages_per_seq"),
+    dims={"page_size": 16, "heads": 8, "head_dim": 128, "lane": 128,
+          "head_align": 8, "q_align": 8},
+    blocks=(
+        BlockDecl("page_tables", "in", ("groups", "pages_per_seq"),
+                  "int32", memory="smem"),
+        BlockDecl("group_lens", "in", ("groups",), "int32",
+                  memory="smem"),
+        BlockDecl("row_lens", "in", (1, "q_align"), "int32",
+                  lanes_full=True,
+                  waivers=("sublane: one [Qp] int32 per-row length "
+                           "vector rides each group — a sub-tile row "
+                           "block by design (padding it to 8 rows "
+                           "would 8x the length traffic for zeros)",)),
+        BlockDecl("q", "in", (1, "q_align", "heads", "head_dim"),
+                  "float32"),
+        BlockDecl("k_page", "in", (1, "page_size", "heads", "head_dim"),
+                  "float32"),
+        BlockDecl("v_page", "in", (1, "page_size", "heads", "head_dim"),
+                  "float32"),
+        BlockDecl("o", "out", (1, "q_align", "heads", "head_dim"),
+                  "float32"),
+        BlockDecl("acc", "scratch", ("heads", "q_align", "head_dim"),
+                  "float32"),
+        BlockDecl("m", "scratch", ("heads", "q_align", "lane"),
+                  "float32"),
+        BlockDecl("l", "scratch", ("heads", "q_align", "lane"),
+                  "float32"),
+    ),
+    shape_buckets={"head_dim": (128, 256), "heads": (8, 16, 32)},
+    # head_align as in the decode contract; q_align is the padding floor
+    # for the per-lane query-row dim — padded rows carry row_len 0 and
+    # are sliced off, so both axes are exactly parity-preserving
+    sweep={"head_align": (8, 16), "q_align": (8, 16)},
+)
+
+PAGED_RAGGED_INT8 = KernelContract(
+    name="paged_attention_ragged_int8",
+    module="paddle_tpu/ops/pallas_ops/paged_attention.py",
+    grid=("groups", "pages_per_seq"),
+    # fused_dequant as in the decode int8 contract: 1 folds the [H]
+    # scale rows into the logits/context epilogues, 0 dequantizes the
+    # page in-register before the dots
+    dims={"page_size": 16, "heads": 8, "head_dim": 128, "lane": 128,
+          "head_align": 8, "q_align": 8, "fused_dequant": 1},
+    blocks=(
+        BlockDecl("page_tables", "in", ("groups", "pages_per_seq"),
+                  "int32", memory="smem"),
+        BlockDecl("group_lens", "in", ("groups",), "int32",
+                  memory="smem"),
+        BlockDecl("row_lens", "in", (1, "q_align"), "int32",
+                  lanes_full=True,
+                  waivers=("sublane: same trade as the ragged f32 "
+                           "contract's row_lens — one sub-tile int32 "
+                           "row per group by design",)),
+        BlockDecl("q", "in", (1, "q_align", "heads", "head_dim"),
+                  "float32"),
+        BlockDecl("k_page", "in", (1, "page_size", "heads", "head_dim"),
+                  "int8",
+                  waivers=("sublane: int8 pages keep the f32 page "
+                           "layout (heads padded to 8, not the int8 "
+                           "floor 32) — same storage-vs-tiling trade "
+                           "as paged_attention_decode_int8's k_page",)),
+        BlockDecl("v_page", "in", (1, "page_size", "heads", "head_dim"),
+                  "int8",
+                  waivers=("sublane: same trade as k_page — see its "
+                           "waiver",)),
+        BlockDecl("k_scales", "in", (1, "heads"), "float32",
+                  lanes_full=True,
+                  waivers=("sublane: one [H] fp32 scale row rides each "
+                           "page DMA — a sub-tile row block by design "
+                           "(padding it to 8 rows would 8x the scale "
+                           "traffic for zeros)",)),
+        BlockDecl("v_scales", "in", (1, "heads"), "float32",
+                  lanes_full=True,
+                  waivers=("sublane: same trade as k_scales",)),
+        BlockDecl("o", "out", (1, "q_align", "heads", "head_dim"),
+                  "float32"),
+        BlockDecl("acc", "scratch", ("heads", "q_align", "head_dim"),
+                  "float32"),
+        BlockDecl("m", "scratch", ("heads", "q_align", "lane"),
+                  "float32"),
+        BlockDecl("l", "scratch", ("heads", "q_align", "lane"),
+                  "float32"),
+    ),
+    shape_buckets={"head_dim": (128, 256), "heads": (8, 16, 32)},
+    # fused_dequant moves the scale multiply across the dot — NOT
+    # bit-exact, non-default choices need an explicit sweep tolerance
+    # (docs/TUNING.md); head_align/q_align are exactly parity-preserving
+    sweep={"head_align": (8, 16), "q_align": (8, 16),
+           "fused_dequant": (0, 1)},
+)
+
+# ===========================================================================
 # quantized_matmul.py — weight-only int8 matmul.  Grid (M/bm, N/bn,
 # K/bk), K innermost; int8 weight blocks satisfy the (32, 128) floor at
 # the default 128x128x128 tiling.
@@ -397,5 +512,6 @@ QUANTIZED_MATMUL = KernelContract(
 CONTRACTS: Dict[str, KernelContract] = {
     c.name: c for c in (FLASH_FWD, FLASH_BWD_DKV, FLASH_BWD_DQ,
                         PAGED_DECODE, PAGED_DECODE_INT8,
+                        PAGED_RAGGED, PAGED_RAGGED_INT8,
                         QUANTIZED_MATMUL)
 }

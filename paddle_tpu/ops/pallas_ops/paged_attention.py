@@ -50,7 +50,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .contracts import PAGED_DECODE, PAGED_DECODE_INT8
+from .contracts import (PAGED_DECODE, PAGED_DECODE_INT8, PAGED_RAGGED,
+                        PAGED_RAGGED_INT8)
 
 NEG_INF = -1e30
 
@@ -60,6 +61,11 @@ NEG_INF = -1e30
 _HEAD_ALIGN = PAGED_DECODE.dim("head_align")
 _LANE = PAGED_DECODE.dim("lane")
 _FUSED_DEQUANT = PAGED_DECODE_INT8.dim("fused_dequant")
+# ragged-query variants (ISSUE 18): the per-lane query-row dim pads to
+# its own contract floor
+_RAGGED_HEAD_ALIGN = PAGED_RAGGED.dim("head_align")
+_RAGGED_Q_ALIGN = PAGED_RAGGED.dim("q_align")
+_RAGGED_FUSED_DEQUANT = PAGED_RAGGED_INT8.dim("fused_dequant")
 
 
 def _resolved_dims(H, D, quantized):
@@ -76,6 +82,23 @@ def _resolved_dims(H, D, quantized):
         return _HEAD_ALIGN, bool(_FUSED_DEQUANT)
     return (tuned.get("head_align", _HEAD_ALIGN),
             bool(tuned.get("fused_dequant", _FUSED_DEQUANT)))
+
+
+def _ragged_resolved_dims(H, D, quantized):
+    """(head_align, q_align, fused_dequant) for a ragged-query call —
+    same explicit-arg > table-hit > contract-default chain as
+    :func:`_resolved_dims`, against the ragged contracts."""
+    from ...tune.runtime import lookup_dims
+
+    contract = PAGED_RAGGED_INT8 if quantized else PAGED_RAGGED
+    tuned = lookup_dims(contract, {"heads": H, "head_dim": D},
+                        dtype="int8" if quantized else "float32")
+    if tuned is None:
+        return (_RAGGED_HEAD_ALIGN, _RAGGED_Q_ALIGN,
+                bool(_RAGGED_FUSED_DEQUANT))
+    return (tuned.get("head_align", _RAGGED_HEAD_ALIGN),
+            tuned.get("q_align", _RAGGED_Q_ALIGN),
+            bool(tuned.get("fused_dequant", _RAGGED_FUSED_DEQUANT)))
 
 # trace-time routing telemetry, mirroring ops/attention.py ROUTE_STATS
 PAGED_ROUTE_STATS = {"pallas": 0, "xla": 0}
@@ -357,3 +380,273 @@ def paged_attention(q, k_pages, v_pages, page_tables, seq_lens,
     PAGED_ROUTE_STATS["xla"] += 1
     return paged_attention_xla(q, k_pages, v_pages, page_tables, seq_lens,
                                k_scales, v_scales)
+
+
+# ===========================================================================
+# Unified ragged-QUERY paged attention (ISSUE 18, PAPERS.md [1]).
+#
+# One grid group = one serving lane carrying Qb query rows that share a
+# single page-table row: a decode lane uses 1 real row, a chunked-
+# prefill lane up to ``prefill_chunk`` rows, a spec-verify lane K rows.
+# The page DMA (and its scale rows on the int8 path) is paid ONCE per
+# lane per page instead of once per query row, and one dispatch carries
+# a mixed batch of all three lane kinds — the engine's separate
+# prefill/decode/spec programs collapse onto this kernel.
+#
+# Raggedness is per ROW, not per lane: ``row_lens[g, r]`` is row r's own
+# causal KV horizon (its absolute position + 1), so prefill rows within
+# one chunk see staircase masks while the lane streams each page once.
+# Padded rows carry row_len 0 and write exact zeros.
+# ===========================================================================
+
+
+def _ragged_kernel(pt_ref, gl_ref, rl_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_sc, m_sc, l_sc, *, scale, page_size,
+                   num_pages_grid):
+    """Grid (G, max_pages_per_seq), pages innermost — the decode kernel's
+    online softmax widened by the query-row dim.  The group early-out
+    keys on the LANE's max horizon (``gl_ref``); rows shorter than the
+    lane mask the tail pages per row.  A row fully masked on an active
+    page keeps m == NEG_INF, so probabilities are re-masked AFTER the
+    exp (exp(NEG_INF - NEG_INF) == 1 would otherwise corrupt l)."""
+    g = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    group_len = gl_ref[g]
+
+    @pl.when(i * page_size < group_len)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale          # [Qp, H, D]
+        k = k_ref[0].astype(jnp.float32)                  # [P, H, D]
+        v = v_ref[0].astype(jnp.float32)
+        rl = rl_ref[0]                                    # [Qp] int32
+        # per-head q·k over the page: batch H, contract D -> [H, Qp, P]
+        s = jax.lax.dot_general(q, k, (((2,), (2,)), ((1,), (1,))),
+                                preferred_element_type=jnp.float32)
+        H, Qp, P = s.shape
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (H, Qp, P), 2)
+        valid = pos < rl[None, :, None]
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev = m_sc[:, :, :1]                           # [H, Qp, 1]
+        l_prev = l_sc[:, :, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # p [H, Qp, P] @ v [P, H, D]: batch H, contract P -> [H, Qp, D]
+        acc_sc[:] = acc_sc[:] * alpha + jax.lax.dot_general(
+            p, v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
+
+    @pl.when(i == num_pages_grid - 1)
+    def _write():
+        # rows with row_len == 0 (padding) have l == 0 -> exact zeros
+        l_safe = jnp.maximum(l_sc[:, :, :1], 1e-30)
+        o_ref[0] = jnp.transpose(acc_sc[:] / l_safe,
+                                 (1, 0, 2)).astype(o_ref.dtype)
+
+
+def _ragged_kernel_quant(pt_ref, gl_ref, rl_ref, q_ref, k_ref, v_ref,
+                         ks_ref, vs_ref, o_ref, acc_sc, m_sc, l_sc, *,
+                         scale, page_size, num_pages_grid,
+                         fused_dequant=True):
+    """Int8-KV variant of ``_ragged_kernel`` — the scale rows ride the
+    page DMA exactly as in ``_decode_kernel_quant``, paid once per lane
+    per page for all of the lane's query rows."""
+    g = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    group_len = gl_ref[g]
+
+    @pl.when(i * page_size < group_len)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale          # [Qp, H, D]
+        k = k_ref[0].astype(jnp.float32)                  # [P, H, D]
+        v = v_ref[0].astype(jnp.float32)
+        ks = ks_ref[0].astype(jnp.float32)                # [H] page K scale
+        vs = vs_ref[0].astype(jnp.float32)                # [H] page V scale
+        rl = rl_ref[0]                                    # [Qp] int32
+        if not fused_dequant:
+            k = k * ks[None, :, None]                     # dequant K pre-dot
+            v = v * vs[None, :, None]                     # dequant V pre-dot
+        s = jax.lax.dot_general(q, k, (((2,), (2,)), ((1,), (1,))),
+                                preferred_element_type=jnp.float32)
+        if fused_dequant:
+            s = s * ks[:, None, None]                     # dequant K
+        H, Qp, P = s.shape
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (H, Qp, P), 2)
+        valid = pos < rl[None, :, None]
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev = m_sc[:, :, :1]
+        l_prev = l_sc[:, :, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        ctx = jax.lax.dot_general(p, v, (((2,), (0,)), ((0,), (1,))),
+                                  preferred_element_type=jnp.float32)
+        if fused_dequant:
+            ctx = ctx * vs[:, None, None]                 # dequant V
+        acc_sc[:] = acc_sc[:] * alpha + ctx
+        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
+
+    @pl.when(i == num_pages_grid - 1)
+    def _write():
+        l_safe = jnp.maximum(l_sc[:, :, :1], 1e-30)
+        o_ref[0] = jnp.transpose(acc_sc[:] / l_safe,
+                                 (1, 0, 2)).astype(o_ref.dtype)
+
+
+def ragged_paged_attention_kernel(q, k_pages, v_pages, page_tables,
+                                  row_lens, k_scales=None, v_scales=None,
+                                  *, interpret=None, head_align=None,
+                                  q_align=None, fused_dequant=None):
+    """The ragged-query Pallas kernel proper.
+
+    q           [G, Qb, H, D]  Qb query rows per lane (decode lane: row 0
+                               real, rest padded; prefill lane: chunk
+                               rows; spec-verify lane: K rows)
+    k_pages     [N, P, H, D]   global K page pool
+    v_pages     [N, P, H, D]   global V page pool
+    page_tables [G, M] int32   ONE page-table row per lane (pad with 0)
+    row_lens    [G, Qb] int32  per-ROW causal KV horizon (row's absolute
+                               position + 1; 0 = padded/inactive row)
+    k_scales    [N, H] fp32    per-page-per-head K scales (iff int8)
+    v_scales    [N, H] fp32    per-page-per-head V scales
+
+    Returns [G, Qb, H, D]; softmax scale 1/sqrt(D) applied internally.
+    ``head_align``/``q_align``/``fused_dequant`` resolve explicit
+    argument > tuning-table hit > contract default.
+    """
+    G, Qb, H, D = q.shape
+    page_size = k_pages.shape[1]
+    max_pages = page_tables.shape[1]
+    quantized = k_pages.dtype == jnp.int8
+    if quantized and (k_scales is None or v_scales is None):
+        raise ValueError("int8 KV pages require k_scales/v_scales")
+    if head_align is None or q_align is None \
+            or (quantized and fused_dequant is None):
+        t_align, t_q, t_fused = _ragged_resolved_dims(H, D, quantized)
+        head_align = t_align if head_align is None else head_align
+        q_align = t_q if q_align is None else q_align
+        fused_dequant = t_fused if fused_dequant is None else fused_dequant
+    scale = 1.0 / math.sqrt(D)
+    page_tables = page_tables.astype(jnp.int32)
+    row_lens = row_lens.astype(jnp.int32)
+
+    # pad the query-row dim to the contract floor (padded rows carry
+    # row_len 0 and are sliced off) and H/D exactly as the decode kernel
+    Qp = -(-Qb // q_align) * q_align
+    Hp = -(-H // head_align) * head_align
+    Dp = _LANE if D <= _LANE else -(-D // _LANE) * _LANE
+    if Qp != Qb:
+        q = jnp.pad(q, ((0, 0), (0, Qp - Qb), (0, 0), (0, 0)))
+        row_lens = jnp.pad(row_lens, ((0, 0), (0, Qp - Qb)))
+    if Hp != H or Dp != D:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, Hp - H), (0, Dp - D)))
+        k_pages = jnp.pad(k_pages,
+                          ((0, 0), (0, 0), (0, Hp - H), (0, Dp - D)))
+        v_pages = jnp.pad(v_pages,
+                          ((0, 0), (0, 0), (0, Hp - H), (0, Dp - D)))
+        if quantized:
+            k_scales = jnp.pad(k_scales, ((0, 0), (0, Hp - H)),
+                               constant_values=1.0)
+            v_scales = jnp.pad(v_scales, ((0, 0), (0, Hp - H)),
+                               constant_values=1.0)
+    Gq, Qq, Hq, Dq = q.shape
+    # the lane's page early-out keys on its longest row
+    group_lens = jnp.max(row_lens, axis=1).astype(jnp.int32)
+
+    in_specs = [
+        pl.BlockSpec((1, Qq), lambda g, i, pt, gl: (g, 0)),
+        pl.BlockSpec((1, Qq, Hq, Dq), lambda g, i, pt, gl: (g, 0, 0, 0)),
+        pl.BlockSpec((1, page_size, Hq, Dq),
+                     lambda g, i, pt, gl: (pt[g, i], 0, 0, 0)),
+        pl.BlockSpec((1, page_size, Hq, Dq),
+                     lambda g, i, pt, gl: (pt[g, i], 0, 0, 0)),
+    ]
+    operands = [row_lens, q, k_pages, v_pages]
+    kern = _ragged_kernel
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, Hq), lambda g, i, pt, gl: (pt[g, i], 0)),
+            pl.BlockSpec((1, Hq), lambda g, i, pt, gl: (pt[g, i], 0)),
+        ]
+        operands += [k_scales.astype(jnp.float32),
+                     v_scales.astype(jnp.float32)]
+        kern = functools.partial(_ragged_kernel_quant,
+                                 fused_dequant=bool(fused_dequant))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,        # page_tables, group_lens
+        grid=(G, max_pages),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, Qq, Hq, Dq),
+                               lambda g, i, pt, gl: (g, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Hq, Qq, Dq), jnp.float32),
+            pltpu.VMEM((Hq, Qq, _LANE), jnp.float32),
+            pltpu.VMEM((Hq, Qq, _LANE), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(kern, scale=scale, page_size=page_size,
+                          num_pages_grid=max_pages),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((Gq, Qq, Hq, Dq), q.dtype),
+        compiler_params=_compiler_params(),
+        interpret=_interpret_mode() if interpret is None else interpret,
+    )(page_tables, group_lens, *operands)
+    if Qq != Qb or Hq != H or Dq != D:
+        out = out[:, :Qb, :H, :D]
+    return out
+
+
+def ragged_paged_attention_xla(q, k_pages, v_pages, page_tables,
+                               row_lens, k_scales=None, v_scales=None):
+    """Exact XLA reference for the ragged-query kernel: flatten the
+    G x Qb rows, repeat each lane's page-table row across its queries
+    and delegate to :func:`paged_attention_xla` — byte-identical to
+    running each query row through the decode reference on its own,
+    BY CONSTRUCTION (that is the split-program path the unified engine
+    dispatch must match)."""
+    G, Qb, H, D = q.shape
+    rows_q = q.reshape(G * Qb, H, D)
+    rows_pt = jnp.repeat(page_tables, Qb, axis=0)
+    rows_len = row_lens.reshape(G * Qb)
+    out = paged_attention_xla(rows_q, k_pages, v_pages, rows_pt,
+                              rows_len, k_scales, v_scales)
+    return out.reshape(G, Qb, H, D)
+
+
+def ragged_paged_attention(q, k_pages, v_pages, page_tables, row_lens,
+                           k_scales=None, v_scales=None):
+    """Routing entry for the unified serving dispatch: Pallas kernel on
+    TPU (or under PADDLE_TPU_FORCE_PAGED=1), exact XLA gather reference
+    elsewhere — the same routing contract as :func:`paged_attention`."""
+    forced = os.environ.get("PADDLE_TPU_FORCE_PAGED") == "1"
+    if forced or jax.default_backend() == "tpu":
+        PAGED_ROUTE_STATS["pallas"] += 1
+        return ragged_paged_attention_kernel(q, k_pages, v_pages,
+                                             page_tables, row_lens,
+                                             k_scales, v_scales)
+    PAGED_ROUTE_STATS["xla"] += 1
+    return ragged_paged_attention_xla(q, k_pages, v_pages, page_tables,
+                                      row_lens, k_scales, v_scales)

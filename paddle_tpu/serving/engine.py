@@ -96,7 +96,8 @@ def _shared_programs(model, *, page_size: int, pages_per_seq: int,
                      numeric_guards: bool = True) -> dict:
     from ..jit.functional import get_state
     from ..text.generation import (make_gpt_paged_decode_step,
-                                   make_gpt_paged_prefill_step)
+                                   make_gpt_paged_prefill_step,
+                                   make_gpt_paged_ragged_step)
 
     params, _ = get_state(model)
     # BASE key deliberately excludes fused_steps/spec_steps: the
@@ -144,6 +145,8 @@ def _shared_programs(model, *, page_size: int, pages_per_seq: int,
         model, page_size, pages_per_seq, **qkw)
     prefill_fn, _ = make_gpt_paged_prefill_step(
         model, page_size, pages_per_seq, **qkw)
+    ragged_fn, _ = make_gpt_paged_ragged_step(
+        model, page_size, pages_per_seq, with_guard=numeric_guards, **qkw)
 
     def _decode(tokens, pos, page_tables, kv):
         logits, kv = step_fn(tokens, pos, page_tables, kv)
@@ -188,6 +191,15 @@ def _shared_programs(model, *, page_size: int, pages_per_seq: int,
                                donate_argnums=(3,)),
         "prefill": profiled_jit("serving.prefill", prefill_fn,
                                 donate_argnums=(4,)),
+        # the unified mixed-batch program (ISSUE 18): decode, prefill
+        # chunks and spec verify all ride ONE dispatch.  In the BASE
+        # bundle, not a variant — replicas and plain/spec mixes of one
+        # config all share its compiles, and a ragged engine never
+        # compiles the split decode/prefill/spec programs at all
+        # (profiled_jit traces lazily).  Retraces only on (lane bucket,
+        # row bucket) change, like decode x prefill today.
+        "ragged": profiled_jit("serving.ragged_step", ragged_fn,
+                               donate_argnums=(7,)),
         # NOT donated: self._tokens aliases the newest _Pending entry's
         # handle (single-step dispatch returns one buffer for both), so
         # donating it into a lane clear would delete tokens still
@@ -356,6 +368,7 @@ class ServingEngine:
                  prefill_chunk: int = 64,
                  sync_mode: bool = False,
                  fused_steps: int = 1,
+                 ragged: Optional[bool] = None,
                  kv_cache_dtype: Optional[str] = None,
                  weight_dtype: Optional[str] = None,
                  quant_scales: Optional[dict] = None,
@@ -387,6 +400,29 @@ class ServingEngine:
         self.prefill_chunk = max(1, int(prefill_chunk))
         self.sync_mode = bool(sync_mode)
         self.fused_steps = max(1, int(fused_steps))
+        # --- unified ragged dispatch (ISSUE 18, docs/SERVING.md
+        # "Unified ragged dispatch"): ONE serving.ragged_step program
+        # carries the whole mixed batch — steady decode rows, prefill
+        # CHUNK rows (one chunk per planned lane per step, riding
+        # BESIDE the decode ticks instead of serializing ahead of
+        # them) and spec-verify rows.  Per-lane streams stay
+        # byte-identical to the split programs' by construction (the
+        # Q=1 all-advance shape IS the split decode computation).
+        # Default on; fused_steps > 1 keeps the split path (the fused
+        # K-step fori_loop is a different dispatch-amortization axis
+        # and stays a split-program variant).
+        if ragged is None:
+            ragged = self.fused_steps == 1
+        if not isinstance(ragged, bool):
+            # the watchdog=/brownout= validation discipline
+            raise InvalidArgumentError(
+                f"ragged must be a bool, got {ragged!r}")
+        if ragged and self.fused_steps > 1:
+            raise InvalidArgumentError(
+                "ragged=True is incompatible with fused_steps > 1 — the "
+                "fused K-step loop is a split-program variant; pass "
+                "ragged=False (or drop fused_steps) ")
+        self.ragged = ragged
         self.outputs: Dict[str, np.ndarray] = {}
         self._ttft_recorded = set()      # per REQUEST, preemption-proof
         # streaming hook: called as (request_id, index, token) for every
@@ -493,6 +529,12 @@ class ServingEngine:
                                     metrics=self.metrics,
                                     sequential=self._kv_dynamic)
 
+        # ragged engines fold spec verify into the ragged program (a
+        # verify lane IS a ragged-query lane) — EXCEPT int8_dynamic,
+        # which keeps the split SEQUENTIAL verifier: its rollback
+        # replays progressive per-page scale growth bit-for-bit, a
+        # schedule the one-shot ragged forward cannot reproduce
+        spec_folds = self.ragged and not self._kv_dynamic
         progs = _shared_programs(
             model, page_size=self.page_size,
             pages_per_seq=self.pages_per_seq,
@@ -500,7 +542,8 @@ class ServingEngine:
             weight_dtype=self.weight_dtype, kv_scales=kv_scales,
             weights=qs.get("weights") if self.weight_dtype == "int8"
             else None,
-            fused_steps=self.fused_steps, spec_steps=spec_k,
+            fused_steps=self.fused_steps,
+            spec_steps=0 if spec_folds else spec_k,
             spec_sequential=self._kv_dynamic,
             numeric_guards=self.numeric_guards)
         self._kv = progs["init_pages"](num_pages)
@@ -511,6 +554,7 @@ class ServingEngine:
         self._row_set_jit = progs["row_set"]
         self._fused_jit = progs["fused"]
         self._spec_jit = progs["spec_verify"]
+        self._ragged_jit = progs["ragged"]
         self._scale_reset_jit = progs["scale_reset"]
         self._page_gather_jit = progs["page_gather"]
         self._page_put_jit = progs["page_put"]
@@ -607,6 +651,20 @@ class ServingEngine:
         # must re-upload the row before the next dispatch, or writes
         # past the stale row land in the trash page
         self._uploaded_pages: Dict[str, int] = {}
+        # --- unified ragged dispatch state (ISSUE 18) ------------------
+        # per-request prefill PLAN: the chunk queue admission builds
+        # instead of dispatching — each engine step pops one chunk per
+        # planned lane into the mixed ragged dispatch, so decode ticks
+        # never stall behind a long prompt.  A lane is inert (its
+        # device state untouched, advance=0) until its plan drains.
+        self._prefill_plans: Dict[str, dict] = {}
+        # per-bucket cached steady-decode row arrays (all-zero rows,
+        # no-limit row_valid, all-advance) — uploaded once per bucket so
+        # steady ragged decode stays transfer-guard- and
+        # compile_budget(0)-clean like the split decode path
+        self._ragged_steady: Dict[int, tuple] = {}
+        from ..text.generation import RAGGED_NO_LIMIT
+        self._ragged_no_limit = RAGGED_NO_LIMIT
 
     # --- request intake ---------------------------------------------------
     def check_request(self, prompt, max_new_tokens: int = 32) -> np.ndarray:
@@ -730,6 +788,9 @@ class ServingEngine:
         """Drop per-request engine bookkeeping (abort/expiry path)."""
         self._ttft_recorded.discard(request_id)
         self._uploaded_pages.pop(request_id, None)
+        stale = self._drop_plan(request_id)
+        if stale:
+            self._preempt_plan_sharers(stale)
         if self.spec is not None:
             self.spec.on_drop(request_id)
 
@@ -886,6 +947,13 @@ class ServingEngine:
         seq = next((s for s in self.scheduler.running
                     if s.seq_id == request_id and not s.done), None)
         if seq is None:
+            return None
+        if request_id in self._prefill_plans:
+            # mid-plan (ragged mode): the prompt pages are only
+            # partially written — a snapshot here would capture a
+            # half-prefilled sequence that restore would wrongly resume
+            # as fully prefilled.  The caller keeps its previous
+            # snapshot; the plan drains within a few steps.
             return None
         g = len(seq.generated)
         pos = seq.request.prompt.size - 1 + g
@@ -1219,6 +1287,236 @@ class ServingEngine:
         self.metrics.on_prefill(dt)
         self.metrics.on_prefill_chunks(len(spans), n - start, dt)
 
+    # --- unified ragged dispatch (ISSUE 18) -------------------------------
+    def _plan_prefill(self, seq: Sequence, awaits=()):
+        """Ragged-mode admission: BUILD the chunk plan (host arrays
+        only, no dispatch) — each following engine step pops one chunk
+        into the mixed ragged dispatch, interleaved with every other
+        lane's decode tick.  Same chunk_schedule spans, positions and
+        valid_len masking as ``_prefill_seq``, so each chunk's rows are
+        bit-identical to what the split prefill program would consume.
+        A fully-covered prompt (prefix hit) plans nothing: the lane
+        decodes on the very next step, exactly like the split path.
+
+        Write-visibility bookkeeping (prefix cache): admission seals a
+        prompt's full pages into the index BEFORE this plan has written
+        them, so the plan registers them as ``unwritten`` and clears
+        each one as the chunk covering it is issued.  ``awaits`` lists
+        shared pages THIS sequence reads that some other live plan has
+        not written yet — the lane idles (no chunk, no decode, no COW
+        copy) until every awaited page's write has been dispatched, so
+        device program order commits the payload before any read.  A
+        fully-covered prompt with a non-empty barrier gets a chunkless
+        plan that exists only to hold the lane idle."""
+        prompt = seq.request.prompt
+        n = prompt.size - 1
+        start = min(seq.cached_tokens, n)
+        awaits = set(awaits)
+        cow = seq.cow_pair is not None and bool(awaits)
+        if n - start == 0 and not awaits:
+            return
+        chunks: Deque[Tuple[np.ndarray, np.ndarray, int]] = deque()
+        for off, size in chunk_schedule(n - start, self.prefill_chunk) \
+                if n - start else ():
+            s0 = start + off
+            ctok = np.zeros((size,), np.int32)
+            valid = min(s0 + size, n) - s0
+            ctok[:valid] = prompt[s0:s0 + valid]
+            cpos = (s0 + np.arange(size)).astype(np.int32)
+            chunks.append((ctok, cpos, n))
+        pend: List[Tuple[int, int]] = []
+        pc = self.prefix_cache
+        if chunks and pc is not None and seq.request.resume is None \
+                and seq.request.use_prefix_cache:
+            # the pages admission just sealed but this plan has yet to
+            # write: page j is complete once positions through
+            # (j+1)*P - 1 have been issued
+            P = self.page_size
+            ids = self.cache.seq_page_ids(seq.seq_id)
+            for j in range(start // P, n // P):
+                pid = int(ids[j])
+                pc.unwritten.add(pid)
+                pend.append((pid, (j + 1) * P - 1))
+        self._prefill_plans[seq.seq_id] = {
+            "chunks": chunks, "t0": time.perf_counter(),
+            "count": len(chunks), "tokens": n - start,
+            "await": awaits, "cow": cow, "pending": pend}
+
+    def _drop_plan(self, seq_id: str) -> set:
+        """Remove a sequence's prefill plan (preemption / abort /
+        expiry mid-plan).  Pages the plan never wrote through were
+        sealed at admission but hold no valid KV: un-publish them so no
+        future request can hit them, and return them so current
+        sharers can be recomputed too (``_preempt_plan_sharers``)."""
+        plan = self._prefill_plans.pop(seq_id, None)
+        if plan is None:
+            return set()
+        stale = {pid for pid, _ in plan["pending"]}
+        if stale and self.prefix_cache is not None:
+            self.prefix_cache.invalidate_pages(stale)
+        return stale
+
+    def _preempt_plan_sharers(self, stale: set):
+        """Cascade recompute: every running sequence still barrier-held
+        on one of the ``stale`` pages shared KV that will now never be
+        written — preempt it back to the queue (deterministic replay,
+        like any recompute-preemption) before it can read garbage."""
+        for s in list(self.scheduler.running):
+            plan = self._prefill_plans.get(s.seq_id)
+            if plan is None or not (plan["await"] & stale):
+                continue
+            self.scheduler.preempt(s)
+            self.metrics.on_preemption(1)
+            self._uploaded_pages.pop(s.seq_id, None)
+            sub = self._drop_plan(s.seq_id)
+            if self.spec is not None:
+                self.spec.on_drop(s.seq_id)
+            for i, lane_seq in enumerate(self._lanes):
+                if lane_seq is s:
+                    self._lanes[i] = None
+                    self._clear_lane(i)
+            if sub:
+                self._preempt_plan_sharers(sub)
+
+    def _steady_rows(self, bucket: int):
+        """The steady-decode ragged inputs for one lane bucket (Q=1,
+        every lane advancing, no KV horizon) — device arrays cached per
+        bucket, so steady decode performs no host transfer at all."""
+        ent = self._ragged_steady.get(bucket)
+        if ent is None:
+            ent = (jax.device_put(np.zeros((bucket, 1), np.int32)),
+                   jax.device_put(np.zeros((bucket, 1), np.int32)),
+                   jax.device_put(np.full((bucket, 1),
+                                          self._ragged_no_limit,
+                                          np.int32)),
+                   jax.device_put(np.ones((bucket,), np.int32)))
+            self._ragged_steady[bucket] = ent
+        return ent
+
+    def _dispatch_ragged(self, active: List[Tuple[int, Sequence]]) -> int:
+        """Issue ONE mixed ragged dispatch: every bound lane rides —
+        decode lanes advance one position on device; lanes with a
+        pending prefill plan carry their next chunk's rows (advance=0,
+        device state untouched until the plan drains).  Steady decode
+        (no plans) reuses per-bucket cached input arrays and is
+        bit-identical to the split decode program."""
+        B = self._state_bucket
+        chunks: Dict[int, Tuple[np.ndarray, np.ndarray, int]] = {}
+        idle: set = set()
+        done_plans: List[Tuple[str, dict]] = []
+        # barrier snapshot BEFORE this dispatch issues anything: a lane
+        # may only read a shared page once the chunk writing it was
+        # issued by an EARLIER dispatch (device program order then
+        # commits the payload ahead of the read)
+        pc = self.prefix_cache
+        pending_before = set(pc.unwritten) if pc is not None \
+            and pc.unwritten else ()
+        for lane, seq in active:
+            plan = self._prefill_plans.get(seq.seq_id)
+            if plan is None:
+                continue
+            aw = plan["await"]
+            if aw:
+                aw.intersection_update(pending_before)
+            if aw:
+                idle.add(lane)           # barrier holds: no chunk, no
+                continue                 # decode, device state frozen
+            if plan["cow"]:
+                # deferred copy-on-write: the source page's payload is
+                # committed now — duplicate it before this dispatch
+                self._apply_cow(seq)
+                plan["cow"] = False
+            if plan["chunks"]:
+                ctok, cpos, n = plan["chunks"].popleft()
+                chunks[lane] = (ctok, cpos, n)
+                pend = plan["pending"]
+                if pend:
+                    # sealed pages this chunk writes through are now
+                    # issued — readers may pass their barrier next step
+                    through = min(int(cpos[-1]), n - 1)
+                    while pend and pend[0][1] <= through:
+                        pc.unwritten.discard(pend.pop(0)[0])
+            if not plan["chunks"]:
+                done_plans.append(
+                    (seq.seq_id,
+                     self._prefill_plans.pop(seq.seq_id)))
+        self._sync_rows(active)
+        t = time.perf_counter()
+        if self._last_dispatch is not None:
+            self.metrics.on_dispatch_gap(t - self._last_dispatch)
+        self._last_dispatch = t
+        prefill_rows = 0
+        if not chunks and not idle:
+            Q = 1
+            rows_tok, rows_pos, row_valid, advance = self._steady_rows(B)
+        else:
+            # mixed step: fresh host rows for this step's chunk mix —
+            # pow2 row bucket (chunk sizes already are), junk padding
+            # rows carry row_valid 0 (trash-page scatter, zero
+            # attention span)
+            Q = max((c[0].size for c in chunks.values()), default=1)
+            rt = np.zeros((B, Q), np.int32)
+            rp = np.zeros((B, Q), np.int32)
+            rv = np.zeros((B, Q), np.int32)
+            adv = np.ones((B,), np.int32)
+            rv[:, 0] = self._ragged_no_limit
+            for lane, (ctok, cpos, n) in chunks.items():
+                sz = ctok.size
+                rt[lane, :sz] = ctok
+                rp[lane, :sz] = cpos
+                rv[lane, :] = 0
+                rv[lane, :sz] = n
+                adv[lane] = 0
+                prefill_rows += sz
+            for lane in idle:
+                # barrier-held lane: every row junk, no advance — the
+                # device state is untouched until the awaited pages'
+                # writes have been issued
+                rv[lane, :] = 0
+                adv[lane] = 0
+            for lane, seq in active:
+                if lane in chunks:
+                    flight.request_event(
+                        seq.seq_id, EV_PREFILL_CHUNK,
+                        replica=self.chaos_key,
+                        size=int(chunks[lane][0].size))
+            rows_tok = jax.device_put(rt)
+            rows_pos = jax.device_put(rp)
+            row_valid = jax.device_put(rv)
+            advance = jax.device_put(adv)
+        with RecordEvent("serving/ragged_step", bucket=B, rows=Q):
+            (_out_rows, out_dec, self._tokens, self._pos,
+             self._kv) = self._ragged_jit(
+                self._tokens, self._pos, self._tables, rows_tok,
+                rows_pos, row_valid, advance, self._kv)
+        # chunk lanes did not decode this step: their out_dec entry is
+        # junk and their host mirror must not advance — snapshot them
+        # as None so the consume loop skips them
+        snapshot = tuple(
+            (s, s.epoch) if s is not None and i not in chunks
+            and i not in idle else None
+            for i, s in enumerate(self._lanes))
+        for lane, s in active:
+            if lane not in chunks and lane not in idle:
+                s.pos += 1
+        self._pending.append(_Pending(out_dec, 1, snapshot))
+        self.metrics.on_ragged(
+            decode_rows=sum(1 for lane, _ in active
+                            if lane not in chunks and lane not in idle),
+            prefill_rows=prefill_rows, q_bucket=Q)
+        for sid, plan in done_plans:
+            if not plan["count"]:
+                # barrier-only plan (fully-covered prefix hit): the
+                # split path records no prefill either
+                continue
+            # the plan drained: prefill accounting records wall time
+            # since admission (the chunks ran interleaved across steps)
+            dt = time.perf_counter() - plan["t0"]
+            self.metrics.on_prefill(dt)
+            self.metrics.on_prefill_chunks(plan["count"],
+                                           plan["tokens"], dt)
+        return 1
+
     # --- prefix cache (docs/SERVING.md "Prefix caching") ------------------
     def _apply_cow(self, seq: Sequence):
         """Perform the device half of a copy-on-write admission: the
@@ -1263,6 +1561,8 @@ class ServingEngine:
     def _dispatch(self, active: List[Tuple[int, Sequence]]) -> int:
         """Issue one decode program (single or fused K-step) against the
         device-resident state; returns the number of steps dispatched."""
+        if self.ragged:
+            return self._dispatch_ragged(active)
         k = 1
         if (self._fused_jit is not None and not self.sync_mode
                 and not self.scheduler.waiting
@@ -1450,6 +1750,10 @@ class ServingEngine:
         tokens per weight-set stream, not dispatch overlap."""
         spec = self.spec
         K = spec.k
+        if self._prefill_plans:
+            # ragged mode: a lane mid-prefill-plan carries chunk rows
+            # every step — speculation resumes once the plans drain
+            return None
         # NOTE: unlike fused mode there is no ``scheduler.waiting``
         # gate — a verify is ONE dispatch (admission latency matches a
         # plain step, and admission runs before dispatch every step),
@@ -1531,11 +1835,35 @@ class ServingEngine:
             self.metrics.on_dispatch_gap(t - self._last_dispatch)
         self._last_dispatch = t
         with RecordEvent("serving/spec_verify", bucket=bucket, steps=K):
-            out, self._kv = self._spec_jit(
-                jax.device_put(draft_mat), self._pos, self._tables,
-                self._kv)
-            t0 = time.perf_counter()
-            toks = np.asarray(jax.device_get(out))        # [K, bucket]
+            if self._spec_jit is not None:
+                out, self._kv = self._spec_jit(
+                    jax.device_put(draft_mat), self._pos, self._tables,
+                    self._kv)
+                t0 = time.perf_counter()
+                toks = np.asarray(jax.device_get(out))    # [K, bucket]
+            else:
+                # ragged fold-in: the verify rides the unified kernel —
+                # K teacher-forcing rows per lane, advance=0 everywhere
+                # (the accept decision below uploads the surviving
+                # state wholesale, exactly like the split path)
+                rows_tok = np.ascontiguousarray(draft_mat.T)
+                rows_pos = np.zeros((bucket, K), np.int32)
+                rows_val = np.zeros((bucket, K), np.int32)
+                for lane, seq in active:
+                    rows_pos[lane] = seq.pos + np.arange(K)
+                    rows_val[lane] = self._ragged_no_limit
+                (out_rows, _dec, self._tokens, self._pos,
+                 self._kv) = self._ragged_jit(
+                    self._tokens, self._pos, self._tables,
+                    jax.device_put(rows_tok), jax.device_put(rows_pos),
+                    jax.device_put(rows_val),
+                    jax.device_put(np.zeros((bucket,), np.int32)),
+                    self._kv)
+                self.metrics.on_ragged(spec_rows=K * len(active),
+                                       q_bucket=K)
+                t0 = time.perf_counter()
+                toks = np.ascontiguousarray(              # [K, bucket]
+                    np.asarray(jax.device_get(out_rows)).T)
             self.metrics.on_decode(time.perf_counter() - t0)
         now = time.monotonic()
         results = []
@@ -1677,9 +2005,35 @@ class ServingEngine:
                     # so intra-batch sharing works); the device halves
                     # — the COW page copy and the suffix prefill — run
                     # here in admission order
-                    if seq.cow_pair is not None:
+                    deps = ()
+                    if self.ragged and seq.cached_tokens \
+                            and self.prefix_cache is not None:
+                        # shared pages this sequence READS whose writer
+                        # is itself still mid-plan: the lane must idle
+                        # until their writes are issued (and the COW
+                        # copy below must wait with it — it would
+                        # duplicate an empty page)
+                        ids = self.cache.seq_page_ids(seq.seq_id)
+                        unw = self.prefix_cache.unwritten
+                        deps = {int(p) for p in
+                                ids[:seq.cached_tokens // self.page_size]
+                                if int(p) in unw}
+                        if seq.cow_pair is not None \
+                                and int(seq.cow_pair[0]) in unw:
+                            # the COW SOURCE is no longer in this
+                            # sequence's table (the host already
+                            # swapped in the copy) but the copy's
+                            # payload comes from it
+                            deps.add(int(seq.cow_pair[0]))
+                    if seq.cow_pair is not None and not deps:
                         self._apply_cow(seq)
-                    self._prefill_seq(seq)
+                    if self.ragged:
+                        # unified dispatch: plan now, chunks ride the
+                        # mixed ragged steps (no dedicated prefill
+                        # program, no serialization ahead of decode)
+                        self._plan_prefill(seq, awaits=deps)
+                    else:
+                        self._prefill_seq(seq)
                 self._bind_lane(seq)
                 if self.spec is not None:
                     # seed the drafter with the lane's full history
@@ -1701,12 +2055,17 @@ class ServingEngine:
                 self.metrics.on_preemption(len(preempted))
                 for victim in preempted:
                     self._uploaded_pages.pop(victim.seq_id, None)
+                    stale = self._drop_plan(victim.seq_id)
                     if self.spec is not None:
                         self.spec.on_drop(victim.seq_id)
                     for i, lane_seq in enumerate(self._lanes):
                         if lane_seq is victim:
                             self._lanes[i] = None
                             self._clear_lane(i)
+                    if stale:
+                        # mid-plan victim: sharers of its never-written
+                        # sealed pages must recompute too
+                        self._preempt_plan_sharers(stale)
             active = [(i, s) for i, s in enumerate(self._lanes)
                       if s is not None]
             if any(self._remaining(s) > 0 for _, s in active):
@@ -1828,6 +2187,7 @@ class ServingEngine:
             "pipeline": {
                 "sync_mode": self.sync_mode,
                 "fused_steps": self.fused_steps,
+                "ragged": self.ragged,
                 "prefill_chunk": self.prefill_chunk,
                 "in_flight": len(self._pending),
                 "state_bucket": self._state_bucket,

@@ -70,7 +70,11 @@ class ServingMetrics:
               "serving.prefix.host_pages", "serving.prefix.disk_pages",
               # speculative decoding (ISSUE 12): lifetime fraction of
               # drafted tokens the verifier accepted
-              "serving.spec.accept_rate")
+              "serving.spec.accept_rate",
+              # unified ragged dispatch (ISSUE 18): per-lane query-row
+              # bucket (Q) of the most recent ragged step — 1 in steady
+              # decode, the chunk bucket while prefill rows ride along
+              "serving.ragged.row_bucket")
     COUNTERS = ("serving.steps", "serving.tokens_generated",
                 "serving.requests_admitted", "serving.requests_completed",
                 "serving.preemptions", "serving.prefill_chunks",
@@ -102,7 +106,15 @@ class ServingMetrics:
                 # logits came back non-finite, and the requests
                 # quarantined (failed with NumericalFaultError, lane
                 # reset, pages scrubbed + freed) as a result
-                "serving.guard.nan_lanes", "serving.guard.quarantines")
+                "serving.guard.nan_lanes", "serving.guard.quarantines",
+                # unified ragged dispatch (ISSUE 18): mixed-batch
+                # dispatches and the per-kind query rows they carried —
+                # decode rows (one per advancing lane), prefill-chunk
+                # rows (prompt positions riding beside decode instead of
+                # blocking it) and spec-verify rows (K teacher-forced
+                # positions per speculating lane)
+                "serving.ragged.steps", "serving.ragged.decode_rows",
+                "serving.ragged.prefill_rows", "serving.ragged.spec_rows")
     HISTOGRAMS = ("serving.step_latency_ms", "serving.prefill_latency_ms",
                   "serving.decode_latency_ms", "serving.ttft_ms",
                   "serving.dispatch_gap_ms",
@@ -282,6 +294,29 @@ class ServingMetrics:
             stat_registry.get("serving.spec.accept_rate").set(
                 total_a / total_d)
 
+    # --- unified ragged dispatch (ISSUE 18) --------------------------------
+    def on_ragged(self, *, decode_rows: int = 0, prefill_rows: int = 0,
+                  spec_rows: int = 0, q_bucket: int = 0):
+        """One ``serving.ragged_step`` dispatch's row mix: ``decode_rows``
+        lanes advanced one position, ``prefill_rows`` prompt positions
+        rode along as chunk rows (instead of serializing ahead of the
+        decode ticks), ``spec_rows`` positions were teacher-forced for
+        speculative verify.  ``q_bucket`` is the step's per-lane
+        query-row bucket Q (gauged — 1 in steady decode)."""
+        stat_registry.get("serving.ragged.steps").add(1)
+        if decode_rows:
+            stat_registry.get("serving.ragged.decode_rows").add(
+                int(decode_rows))
+        if prefill_rows:
+            stat_registry.get("serving.ragged.prefill_rows").add(
+                int(prefill_rows))
+        if spec_rows:
+            stat_registry.get("serving.ragged.spec_rows").add(
+                int(spec_rows))
+        if q_bucket:
+            stat_registry.get("serving.ragged.row_bucket").set(
+                int(q_bucket))
+
     # --- numeric guards (ISSUE 13, docs/SERVING.md "Logit quarantine") -----
     def on_nan_lane(self, n: int = 1):
         """A decode/verify dispatch returned non-finite logits for a
@@ -406,6 +441,10 @@ class ServingMetrics:
         snap["guard"] = {
             short: stat_registry.get(f"serving.guard.{short}").get()
             for short in ("nan_lanes", "quarantines")}
+        snap["ragged"] = {
+            short: stat_registry.get(f"serving.ragged.{short}").get()
+            for short in ("steps", "decode_rows", "prefill_rows",
+                          "spec_rows", "row_bucket")}
         snap["disagg"] = {"shipped_pages": stat_registry.get(
             "serving.disagg.shipped_pages").get()}
         for name in self.HISTOGRAMS:

@@ -72,7 +72,7 @@ frontend pump) exactly like the scheduler — no locks, no device calls.
 from __future__ import annotations
 
 import itertools
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -133,6 +133,14 @@ class PrefixCache:
         # it, promote_for restores through it (None = tier-off, the
         # pre-ISSUE-16 discard behavior byte-identically)
         self.transport = None
+        # pages sealed at admission whose device payload has NOT been
+        # written yet: the ragged engine's prefill plans (ISSUE 18)
+        # write a prompt's pages across later steps, so a page can sit
+        # in the index before its KV exists.  The engine maintains
+        # membership; readers gate on it (the dispatch barrier), and
+        # eviction must never demote such a page — there is no valid
+        # payload to capture.
+        self.unwritten: Set[int] = set()
         cache.set_reclaimer(self.evict)
 
     def attach_transport(self, transport):
@@ -228,15 +236,41 @@ class PrefixCache:
                 f"released={released} resident_pages={len(self._by_page)}")
         return released
 
+    def invalidate_pages(self, page_ids: Iterable[int]) -> int:
+        """Un-publish specific pages whose device payload never
+        materialized — a mid-plan preemption or abort in the engine's
+        ragged mode strikes a writer before its prefill plan wrote
+        them through (docs/SERVING.md "Unified ragged dispatch").  The
+        nodes leave the index WITHOUT the demotion hook (there is no
+        valid payload to capture); descendant nodes belong to barrier-
+        blocked sharers whose own cascade drop removes them.  Returns
+        the number of nodes dropped."""
+        dropped = 0
+        for page in sorted(int(p) for p in page_ids):
+            self.unwritten.discard(page)
+            node = self._by_page.pop(page, None)
+            if node is None:
+                continue
+            if node.parent is not None:
+                node.parent.children.pop(node.chunk, None)
+            self.cache.release_cached(page)
+            dropped += 1
+        if dropped:
+            self._publish_gauge()
+        return dropped
+
     def _drop_node(self, node: _Node):
         # EVERY eviction funnels through here — the single demotion
         # hook (ISSUE 16).  The transport captures the payload host-side
         # (or declines: no transport, window closed, chaos deny, gather
         # failure); the device page releases either way, so demotion can
         # change WHERE the payload survives but never the allocator's
-        # accounting — tier-off behavior is byte-identical.
-        if self.transport is not None:
+        # accounting — tier-off behavior is byte-identical.  A page the
+        # ragged engine has not written through yet holds no payload at
+        # all — demoting it would tier garbage.
+        if self.transport is not None and node.page not in self.unwritten:
             self.transport.demote(self._chain_key(node), node.page)
+        self.unwritten.discard(node.page)
         del self._by_page[node.page]
         if node.parent is not None:
             node.parent.children.pop(node.chunk, None)

@@ -23,7 +23,15 @@ from ..jit.functional import get_state
 
 __all__ = ["make_gpt_decode_step", "make_gpt_paged_decode_step",
            "make_gpt_paged_prefill_step", "make_gpt_paged_fused_decode_step",
-           "make_gpt_paged_spec_verify_step", "prefill", "generate"]
+           "make_gpt_paged_spec_verify_step", "make_gpt_paged_ragged_step",
+           "RAGGED_NO_LIMIT", "prefill", "generate"]
+
+# per-row KV-horizon sentinel for the unified ragged step (ISSUE 18): a
+# decode/spec row carries this instead of a real valid_len, making the
+# core's padding clamps exact integer identities (min(pos+1, BIG) ==
+# pos+1, pos < BIG always) — the row behaves bit-for-bit like the split
+# programs' valid_len=None path
+RAGGED_NO_LIMIT = 1 << 30
 
 
 def _ln(x, w, b, eps=1e-5):
@@ -270,6 +278,8 @@ def _make_gpt_paged_core(model, page_size: int, pages_per_seq: int, *,
     projection/MLP matmuls through the weight-only int8 kernel.
     """
     from ..ops.pallas_ops.paged_attention import paged_attention as paged_attn
+    from ..ops.pallas_ops.paged_attention import (
+        ragged_paged_attention as ragged_paged_attn)
 
     params, _ = get_state(model)
     L = len(model.layers)
@@ -316,15 +326,26 @@ def _make_gpt_paged_core(model, page_size: int, pages_per_seq: int, *,
                              for i in range(L)]
         return kv
 
-    def core(tokens, pos, page_tables, kv, valid_len=None, with_head=True):
+    def core(tokens, pos, page_tables, kv, valid_len=None, with_head=True,
+             qgroup=None):
         N = tokens.shape[0]
+        # ``qgroup=Q`` selects the ragged-group layout (ISSUE 18): the N
+        # rows are G = N // Q lanes of Q query rows each and
+        # ``page_tables`` is ONE row per lane ([G, M]); the scatter path
+        # expands it per row while attention takes the grouped form so
+        # the ragged kernel pays each lane's page DMA once per page, not
+        # once per row
+        if qgroup is not None:
+            row_tables = jnp.repeat(page_tables, qgroup, axis=0)
+        else:
+            row_tables = page_tables
         # clamp junk lanes (prefill bucket padding) instead of relying on
         # gather clipping: positions past the wpe table or the page table
         # width belong to masked lanes whose output is discarded
         pos_c = jnp.minimum(pos, max_pos - 1)
         x = wte[tokens] + wpe[pos_c]
         page_of = jnp.minimum(pos // page_size, pages_per_seq - 1)
-        page_idx = jnp.take_along_axis(page_tables, page_of[:, None],
+        page_idx = jnp.take_along_axis(row_tables, page_of[:, None],
                                        axis=1)[:, 0]
         slot = pos % page_size
         seq_lens = pos + 1
@@ -352,13 +373,19 @@ def _make_gpt_paged_core(model, page_size: int, pages_per_seq: int, *,
                     v_sc[i] if v_sc else None)
                 ksc_out.append(ksc)
                 vsc_out.append(vsc)
-                ctx = paged_attn(q, kc, vc, page_tables, seq_lens,
-                                 ksc, vsc).reshape(N, hidden)
+                scales = (ksc, vsc)
             else:
                 kc = kv["k"][i].at[page_idx, slot].set(k1)
                 vc = kv["v"][i].at[page_idx, slot].set(v1)
-                ctx = paged_attn(q, kc, vc, page_tables,
-                                 seq_lens).reshape(N, hidden)
+                scales = ()
+            if qgroup is None:
+                ctx = paged_attn(q, kc, vc, page_tables, seq_lens,
+                                 *scales).reshape(N, hidden)
+            else:
+                G = N // qgroup
+                ctx = ragged_paged_attn(
+                    q.reshape(G, qgroup, H, D), kc, vc, page_tables,
+                    seq_lens.reshape(G, qgroup), *scales).reshape(N, hidden)
             ks.append(kc)
             vs.append(vc)
             x = x + (mm(ctx, f"layers.{i}.attn.out_proj.weight")
@@ -596,6 +623,72 @@ def make_gpt_paged_spec_verify_step(model, page_size: int,
             return _pack(out, logits).reshape(B, K).T, kv
 
     return verify_fn, init_pages
+
+
+def make_gpt_paged_ragged_step(model, page_size: int, pages_per_seq: int, *,
+                               kv_cache_dtype=None, kv_scales=None,
+                               weight_quant=None, with_guard: bool = False):
+    """Unified ragged step (ISSUE 18): ONE device program carries a mixed
+    batch of {steady-decode, chunked-prefill, spec-verify} lanes, each
+    lane a group of Q query rows against its single page-table row, so
+    the engine stops serializing prefill chunks ahead of decode ticks.
+
+    Builds ``(ragged_fn, init_pages)``:
+
+    ``ragged_fn(state_tok [B], state_pos [B], page_tables [B, M],
+    rows_tok [B, Q], rows_pos [B, Q], row_valid [B, Q], advance [B], kv)
+    -> (out_rows [B, Q], out_dec [B], state_tok' [B], state_pos' [B],
+    kv')``.
+
+    Per lane ``b``:
+
+    - ``advance[b] > 0`` — a DECODE lane: row 0's token/position are
+      taken from the device-resident ``state_tok``/``state_pos`` (the
+      greedy feedback loop never round-trips the host) and the lane's
+      state advances to (argmax, pos + 1).  With ``row_valid[b, 0] ==
+      RAGGED_NO_LIMIT`` and Q == 1 this is bit-identical to the split
+      ``serving.decode`` program: the padding clamps are exact integer
+      identities and the attention reduces to the same flat rows.
+    - ``advance[b] == 0`` — a PREFILL-CHUNK or SPEC-VERIFY lane: rows
+      carry host-provided (token, position, valid_len) triples exactly
+      as the split ``serving.prefill`` / ``serving.spec_verify``
+      programs would see them; device state is untouched.
+    - junk rows (bucket padding past a lane's chunk) carry
+      ``row_valid == 0``: they scatter into the reserved trash page and
+      attend to nothing, so live pages can never see padding.
+
+    ``out_rows`` is the greedy argmax at every row (spec-verify accept
+    rule reads it), ``out_dec`` its row-0 column (the decode stream).
+    ``with_guard=True`` negative-packs non-finite rows in-band, exactly
+    like the split programs; the clean argmax still feeds device state.
+    """
+    core, init_pages = _make_gpt_paged_core(
+        model, page_size, pages_per_seq, kv_cache_dtype=kv_cache_dtype,
+        kv_scales=kv_scales, weight_quant=weight_quant)
+
+    def ragged_fn(state_tok, state_pos, page_tables, rows_tok, rows_pos,
+                  row_valid, advance, kv):
+        B, Q = rows_tok.shape
+        live = advance > 0
+        eff_tok = rows_tok.at[:, 0].set(
+            jnp.where(live, state_tok, rows_tok[:, 0]))
+        eff_pos = rows_pos.at[:, 0].set(
+            jnp.where(live, state_pos, rows_pos[:, 0]))
+        logits, kv = core(eff_tok.reshape(-1), eff_pos.reshape(-1),
+                          page_tables, kv,
+                          valid_len=row_valid.reshape(-1), qgroup=Q)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        out = nxt
+        if with_guard:
+            fin = jnp.all(jnp.isfinite(logits), axis=-1)
+            out = jnp.where(fin, nxt, -1 - nxt)
+        out = out.reshape(B, Q)
+        clean0 = nxt.reshape(B, Q)[:, 0]
+        new_tok = jnp.where(live, clean0, state_tok)
+        new_pos = jnp.where(live, state_pos + 1, state_pos)
+        return out, out[:, 0], new_tok, new_pos, kv
+
+    return ragged_fn, init_pages
 
 
 def prefill(step_fn, state, prompt: jnp.ndarray):

@@ -42,14 +42,29 @@ DEFAULT_EXTENTS: Dict[str, List[Dict[str, int]]] = {
     "flash_attention_fwd": [
         {"block_q": 1024, "block_k": 1024},
     ],
+    # grad-path pair (ISSUE 18): one extent covers every sweep
+    # candidate (2048 is divisible by all declared block_q/block_k)
+    "flash_attention_bwd_dkv": [
+        {"block_q": 2048, "block_k": 2048},
+    ],
+    "flash_attention_bwd_dq": [
+        {"block_q": 2048, "block_k": 2048},
+    ],
     "paged_attention_decode": [
         {"heads": 8, "head_dim": 128},
     ],
     "paged_attention_decode_int8": [
         {"heads": 8, "head_dim": 128},
     ],
+    "paged_attention_ragged": [
+        {"heads": 8, "head_dim": 128},
+    ],
+    "paged_attention_ragged_int8": [
+        {"heads": 8, "head_dim": 128},
+    ],
 }
 _KERNEL_DTYPE = {"paged_attention_decode_int8": "int8",
+                 "paged_attention_ragged_int8": "int8",
                  "quantized_matmul": "int8_weights"}
 
 

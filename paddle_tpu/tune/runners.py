@@ -10,11 +10,14 @@ serving programs' (docs/OBSERVABILITY.md).
 
 Runners exist for the kernels with a runtime-swappable config:
 ``flash_attention_fwd`` (block_q/block_k through the wrapper),
-``paged_attention_decode`` / ``..._int8`` (head padding floor, and the
-int8 fused-dequant epilogue choice) and ``quantized_matmul``
-(block_m/n/k).  The flash BACKWARD contracts declare no sweep axes and
-have no runner — their blocks ride the forward's choices today; a
-dedicated grad-path runner is future work (docs/TUNING.md).
+``flash_attention_bwd_dkv`` / ``..._bwd_dq`` (the grad-path pair:
+forward stats are precomputed ONCE at the default blocks, each
+candidate re-tiles only the backward kernel under the sweep's parity
+gate — ISSUE 18), ``paged_attention_decode`` / ``..._int8`` (head
+padding floor, and the int8 fused-dequant epilogue choice),
+``paged_attention_ragged`` / ``..._int8`` (query-row and head padding
+floors for the unified serving dispatch) and ``quantized_matmul``
+(block_m/n/k).
 
 Kernel modules are imported lazily inside each runner so this package
 never participates in an import cycle with ``ops.pallas_ops``.
@@ -124,6 +127,76 @@ def _flash_runner(contract: KernelContract, bucket: Mapping[str, int],
     return run
 
 
+def _flash_bwd_inputs(bucket: Mapping[str, int]):
+    """Deterministic (q, k, v, g, lse, delta, mask, seed, scale) for the
+    grad-path runners: ONE forward at the contract-default blocks
+    yields the global per-row stats every backward candidate consumes —
+    the sweep re-tiles only the backward kernel, so parity failures are
+    attributable to the candidate blocks alone."""
+    import jax.numpy as jnp
+
+    from ..ops.pallas_ops.contracts import FLASH_FWD
+    from ..ops.pallas_ops.flash_attention import _flash_fwd_bhsd
+
+    S = max(bucket["block_q"], bucket["block_k"])
+    B, H, D = 1, 2, 64
+    rng = np.random.RandomState(4)
+    q = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32) * 0.2)
+    k = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32) * 0.2)
+    v = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32) * 0.2)
+    g = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32) * 0.2)
+    mask = jnp.ones((B, 1, S), jnp.float32)
+    seed = jnp.zeros((1,), jnp.int32)
+    scale = 1.0 / float(np.sqrt(D))
+    bq = min(FLASH_FWD.dim("block_q"), S)
+    bk = min(FLASH_FWD.dim("block_k"), S)
+    out, lse = _flash_fwd_bhsd(q, k, v, mask, seed, scale, True, 0.0,
+                               bq, bk)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(B * H, S, 1)
+    return q, k, v, g, lse, delta, mask, seed, scale
+
+
+@register_runner("flash_attention_bwd_dkv")
+def _flash_dkv_runner(contract: KernelContract,
+                      bucket: Mapping[str, int], dtype: str):
+    import jax.numpy as jnp
+
+    from ..ops.pallas_ops.flash_attention import _flash_dkv_bhsd
+
+    q, k, v, g, lse, delta, mask, seed, scale = _flash_bwd_inputs(bucket)
+
+    jit_for = _per_choice(
+        contract.name,
+        lambda c: lambda *a: jnp.stack(_flash_dkv_bhsd(
+            *a, scale=scale, causal=True, dropout_p=0.0,
+            block_q=c["block_q"], block_k=c["block_k"])))
+
+    def run(choice):
+        return jit_for(choice)(q, k, v, g, lse, delta, mask, seed)
+
+    return run
+
+
+@register_runner("flash_attention_bwd_dq")
+def _flash_dq_runner(contract: KernelContract,
+                     bucket: Mapping[str, int], dtype: str):
+    from ..ops.pallas_ops.flash_attention import _flash_dq_bhsd
+
+    q, k, v, g, lse, delta, mask, seed, scale = _flash_bwd_inputs(bucket)
+
+    jit_for = _per_choice(
+        contract.name,
+        lambda c: lambda *a: _flash_dq_bhsd(
+            *a, scale=scale, causal=True, dropout_p=0.0,
+            block_q=c["block_q"], block_k=c["block_k"]))
+
+    def run(choice):
+        return jit_for(choice)(q, k, v, g, lse, delta, mask, seed)
+
+    return run
+
+
 def _paged_inputs(bucket: Mapping[str, int], page_size: int,
                   int8: bool):
     import jax.numpy as jnp
@@ -187,5 +260,84 @@ def _paged_int8_runner(contract: KernelContract,
 
     def run(choice):
         return jit_for(choice)(q, kp, vp, pt, sl, ks, vs)
+
+    return run
+
+
+def _ragged_inputs(bucket: Mapping[str, int], page_size: int,
+                   int8: bool):
+    """A representative MIXED group batch for the unified-dispatch
+    kernel: a steady-decode lane (1 live row), a prefill-chunk lane
+    (5 rows at ascending positions) and a spec-verify-shaped lane
+    (3 rows) — ragged exactly as the engine dispatches them."""
+    import jax.numpy as jnp
+
+    H, D = bucket["heads"], bucket["head_dim"]
+    N, G, Qb, M = 9, 3, 5, 4
+    rng = np.random.RandomState(6)
+    q = jnp.asarray(rng.randn(G, Qb, H, D).astype(np.float32) * 0.3)
+    kf = rng.randn(N, page_size, H, D).astype(np.float32)
+    vf = rng.randn(N, page_size, H, D).astype(np.float32)
+    pt = np.zeros((G, M), np.int32)
+    pt[0, :3] = [1, 2, 3]
+    pt[1, :4] = [4, 5, 6, 7]
+    pt[2, :2] = [8, 1]
+    rl = np.zeros((G, Qb), np.int32)
+    rl[0, 0] = page_size * 2 + 3                    # decode row
+    rl[1, :] = np.arange(8, 8 + Qb)                 # prefill chunk
+    rl[2, :3] = np.arange(3, 6)                     # spec-verify rows
+    rl_j = jnp.asarray(rl)
+    pt_j = jnp.asarray(pt)
+    if not int8:
+        return q, jnp.asarray(kf), jnp.asarray(vf), pt_j, rl_j, None, None
+    ks = (np.abs(kf).max(axis=(1, 3)) / 127 + 1e-9).astype(np.float32)
+    vs = (np.abs(vf).max(axis=(1, 3)) / 127 + 1e-9).astype(np.float32)
+    kq = np.clip(np.round(kf / ks[:, None, :, None]), -127,
+                 127).astype(np.int8)
+    vq = np.clip(np.round(vf / vs[:, None, :, None]), -127,
+                 127).astype(np.int8)
+    return (q, jnp.asarray(kq), jnp.asarray(vq), pt_j, rl_j,
+            jnp.asarray(ks), jnp.asarray(vs))
+
+
+@register_runner("paged_attention_ragged")
+def _ragged_runner(contract: KernelContract, bucket: Mapping[str, int],
+                   dtype: str):
+    from ..ops.pallas_ops.paged_attention import \
+        ragged_paged_attention_kernel
+
+    q, kp, vp, pt, rl, _, _ = _ragged_inputs(
+        bucket, contract.dim("page_size"), int8=False)
+
+    jit_for = _per_choice(
+        contract.name,
+        lambda c: lambda a, b, d, e, f: ragged_paged_attention_kernel(
+            a, b, d, e, f, head_align=c["head_align"],
+            q_align=c["q_align"]))
+
+    def run(choice):
+        return jit_for(choice)(q, kp, vp, pt, rl)
+
+    return run
+
+
+@register_runner("paged_attention_ragged_int8")
+def _ragged_int8_runner(contract: KernelContract,
+                        bucket: Mapping[str, int], dtype: str):
+    from ..ops.pallas_ops.paged_attention import \
+        ragged_paged_attention_kernel
+
+    q, kp, vp, pt, rl, ks, vs = _ragged_inputs(
+        bucket, contract.dim("page_size"), int8=True)
+
+    jit_for = _per_choice(
+        contract.name,
+        lambda c: lambda a, b, d, e, f, g, h: ragged_paged_attention_kernel(
+            a, b, d, e, f, g, h, head_align=c["head_align"],
+            q_align=c["q_align"],
+            fused_dequant=bool(c["fused_dequant"])))
+
+    def run(choice):
+        return jit_for(choice)(q, kp, vp, pt, rl, ks, vs)
 
     return run

@@ -233,6 +233,31 @@ class TestFailOnRegression:
             "serving.slo.budget_remaining")
         assert not bench_diff.lower_is_better(
             "detail.slo.tokens_per_sec_on")
+        # unified ragged dispatch section (ISSUE 18): TTFT/ITL
+        # percentiles regress UPWARD in both arms ("ttft" / "_ms"),
+        # the split/unified win ratios are higher-better "_x", and the
+        # cold-bundle program counts ride the "compile" fragment — a
+        # rising programs_compiled is the shared-cache regression the
+        # section exists to catch
+        assert bench_diff.lower_is_better(
+            "detail.ragged.unified.ttft_ms_p95")
+        assert bench_diff.lower_is_better(
+            "detail.ragged.unified.itl_ms_p95")
+        assert bench_diff.lower_is_better(
+            "detail.ragged.split.itl_ms_p50")
+        assert bench_diff.lower_is_better(
+            "detail.ragged.unified.programs_compiled")
+        assert bench_diff.lower_is_better(
+            "detail.ragged.split.programs_compiled")
+        assert not bench_diff.lower_is_better(
+            "detail.ragged.itl_p95_speedup_x")
+        assert not bench_diff.lower_is_better(
+            "detail.ragged.ttft_p95_speedup_x")
+        assert not bench_diff.lower_is_better(
+            "detail.ragged.unified.tokens_per_sec")
+        assert not bench_diff.lower_is_better("serving.ragged.steps")
+        assert not bench_diff.lower_is_better(
+            "serving.ragged.decode_rows")
 
     def test_reduction_ratio_gates_on_drop_not_rise(self):
         """The PR-4 acceptance metric: kv_bytes_reduction_x falling

@@ -3,9 +3,11 @@ accounting + the ``compile_budget`` assertion context, and the serving
 compile-count contracts it exists to pin:
 
 - a 2-replica fleet compiles each shared program EXACTLY ONCE (the
-  PR-6 shared-program-cache contract, now machine-pinned);
+  PR-6 shared-program-cache contract, now machine-pinned) — under the
+  unified ragged dispatch (ISSUE 18) that is serving.ragged_step plus
+  maintenance, STRICTLY fewer programs than the split set;
 - steady-state decode retraces ZERO times across >= 32 steps;
-- a lane-bucket change retraces the decode program EXACTLY ONCE.
+- a lane-bucket change retraces the ragged program EXACTLY ONCE.
 
 Each serving test builds its OWN GPTModel: the shared program cache is
 keyed per model object, so a fresh model guarantees a cold cache and
@@ -117,9 +119,13 @@ class TestServingCompilePins:
     def test_fleet_of_2_compiles_each_program_exactly_once(self):
         """The shared-program-cache contract, pinned by count: two
         replica engines serving one request each must compile every
-        serving program EXACTLY once — not once per replica.
-        max_batch_size=1 keeps every decode at lane bucket 1, so each
-        program has exactly one signature regardless of routing."""
+        serving program EXACTLY once per signature — not once per
+        replica.  Under the unified ragged dispatch (ISSUE 18) the
+        whole workload runs on ONE program name: serving.ragged_step
+        at two row shapes (the 5-token prompts' 4-row chunk step +
+        the 1-row steady shape) plus the two maintenance programs —
+        serving.{prefill,decode} never compile at all.
+        max_batch_size=1 keeps every dispatch at lane bucket 1."""
         gpt = fresh_gpt(21)
         fe = ServingFrontend(gpt, replicas=2, queue_cap=8,
                              engine_kwargs=dict(page_size=4,
@@ -136,13 +142,60 @@ class TestServingCompilePins:
                     == ["completed"] * 2
             delta = cb.compiles()
             assert delta, "no serving compiles recorded — cold cache?"
-            # each compiled program compiled exactly once, fleet-wide
-            assert all(v == 1 for v in delta.values()), delta
-            assert set(delta) == {"serving.decode", "serving.prefill",
-                                  "serving.lane_update",
-                                  "serving.table_update"}, delta
+            assert delta == {"serving.ragged_step": 2,
+                             "serving.lane_update": 1,
+                             "serving.table_update": 1}, delta
         finally:
             fe.close()
+
+    def test_ragged_strictly_fewer_compiles_than_split(self):
+        """The ISSUE 18 acceptance pin: the SAME 2-replica fleet
+        workload (prompt lengths 5 and 2 — two chunk shapes) compiles
+        STRICTLY fewer serving programs unified than split.  Split
+        pays prefill at both chunk shapes + decode + maintenance (5);
+        ragged folds all three streams into serving.ragged_step, whose
+        1-row chunk step IS the steady-decode signature (4).  A second
+        ragged fleet on the same model then adds ZERO compiles — the
+        ragged program lives in the shared BASE bundle."""
+        rng = np.random.RandomState(6)
+        prompts = [rng.randint(1, VOCAB, (5,)).astype(np.int32),
+                   rng.randint(1, VOCAB, (2,)).astype(np.int32)]
+
+        def drive(fe, tag):
+            handles = [fe.submit(p, max_new_tokens=6) for p in prompts]
+            assert [h.wait(timeout=300) for h in handles] \
+                == ["completed"] * 2, tag
+
+        totals = {}
+        for tag, ragged in (("split", False), ("ragged", None)):
+            gpt = fresh_gpt(31 if ragged is None else 32)
+            kw = dict(page_size=4, max_batch_size=1, eos_id=-1)
+            if ragged is not None:
+                kw["ragged"] = ragged
+            fe = ServingFrontend(gpt, replicas=2, queue_cap=8,
+                                 engine_kwargs=kw)
+            try:
+                with compile_budget(None, prefix="serving.") as cb:
+                    drive(fe, tag)
+                totals[tag] = cb.total()
+                if tag == "ragged":
+                    assert set(cb.compiles()) == {
+                        "serving.ragged_step", "serving.lane_update",
+                        "serving.table_update"}, cb.compiles()
+            finally:
+                fe.close()
+            if tag == "ragged":
+                # replica count is not a compile axis: a whole second
+                # fleet on the same model stays compile-free
+                fe2 = ServingFrontend(gpt, replicas=2, queue_cap=8,
+                                      engine_kwargs=kw)
+                try:
+                    with compile_budget(0, prefix="serving."):
+                        drive(fe2, "ragged-2nd-fleet")
+                finally:
+                    fe2.close()
+        assert totals["ragged"] < totals["split"], totals
+        assert totals == {"split": 5, "ragged": 4}, totals
 
     def test_fused_variant_shares_base_programs(self):
         """ISSUE 15 suite health: ``fused_steps`` is a per-variant
@@ -160,8 +213,11 @@ class TestServingCompilePins:
                     max_new_tokens=4)
             eng.drain()
 
+        # ragged=False: the point is fused-vs-plain SPLIT program
+        # sharing — a ragged first engine would leave decode/prefill
+        # cold and the delta would show them, not the fused variant
         plain = ServingEngine(gpt, page_size=4, max_batch_size=2,
-                              eos_id=-1)
+                              eos_id=-1, ragged=False)
         drive(plain)
         with compile_budget(None, prefix="serving.") as cb:
             fused = ServingEngine(gpt, page_size=4, max_batch_size=2,
@@ -193,8 +249,10 @@ class TestServingCompilePins:
 
     def test_bucket_change_retraces_exactly_once(self):
         """Growing the lane bucket is the ONE sanctioned retrace: the
-        decode program recompiles exactly once for the new bucket and
-        never again."""
+        unified ragged program recompiles exactly once for the new
+        bucket and never again.  The joining prompt is 2 tokens, so
+        its single 1-token chunk step shares the steady 1-row
+        signature — ONE compile covers both."""
         gpt = fresh_gpt(23)
         eng = ServingEngine(gpt, page_size=4, max_batch_size=2,
                             eos_id=-1)
@@ -204,15 +262,15 @@ class TestServingCompilePins:
         for _ in range(3):
             eng.step()                           # bucket 1 decoding
         assert eng._state_bucket == 1
-        with compile_budget(None, names=("serving.decode",)) as cb:
-            eng.add_request(rng.randint(1, VOCAB, (5,)).astype(np.int32),
+        with compile_budget(None, names=("serving.ragged_step",)) as cb:
+            eng.add_request(rng.randint(1, VOCAB, (2,)).astype(np.int32),
                             max_new_tokens=40, request_id="b")
             for _ in range(6):
                 eng.step()                       # admit -> bucket 2
             assert eng._state_bucket == 2
-        assert cb.compiles() == {"serving.decode": 1}
+        assert cb.compiles() == {"serving.ragged_step": 1}
         # ... and steady at the new bucket: zero further retraces
-        with compile_budget(0, names=("serving.decode",)):
+        with compile_budget(0, prefix="serving."):
             for _ in range(8):
                 eng.step()
         eng.drain()

@@ -359,13 +359,17 @@ class TestServingObservability:
         for k in ("p50", "p95", "p99"):
             assert snap["step_latency_ms"][k] > 0
 
-        # --- per-jit attribution: decode FLOPs ------------------------
+        # --- per-jit attribution: the unified ragged program ----------
+        # (ISSUE 18: the default engine runs ONE serving.ragged_step
+        # program for prefill chunks and decode ticks alike)
         costs = eng.stats()["jit_costs"]
-        assert costs["serving.decode"]["flops"] > 0
-        assert costs["serving.decode"]["compile_count"] >= 1
-        assert costs["serving.prefill"]["calls"] == 4
+        assert costs["serving.ragged_step"]["flops"] > 0
+        assert costs["serving.ragged_step"]["compile_count"] >= 1
+        # 4 prompts, one plan each (every prompt shorter than the
+        # default 64-token chunk) — prefill latency records per plan
+        assert snap["prefill_latency_ms"]["count"] == 4
 
-        # --- Chrome trace: loadable, nested prefill/decode under step -
+        # --- Chrome trace: loadable, ragged dispatch nested under step
         path = profiler.export_chrome_trace(str(tmp_path / "serve.json"))
         events = json.load(open(path))["traceEvents"]
         by_name = {}
@@ -373,14 +377,13 @@ class TestServingObservability:
             if e["ph"] == "X":
                 by_name.setdefault(e["name"], []).append(e)
         assert "serving/step" in by_name
-        assert "serving/prefill" in by_name
-        assert "serving/decode_step" in by_name
+        assert "serving/ragged_step" in by_name
         step_ids = {e["args"]["span_id"] for e in by_name["serving/step"]}
-        for child in by_name["serving/prefill"] + by_name["serving/decode_step"]:
+        for child in by_name["serving/ragged_step"]:
             assert child["args"]["parent_id"] in step_ids
-        # decode spans carry the batch bucket they ran at
-        assert all("bucket" in e["args"]
-                   for e in by_name["serving/decode_step"])
+        # ragged spans carry the batch bucket and row count they ran at
+        assert all("bucket" in e["args"] and "rows" in e["args"]
+                   for e in by_name["serving/ragged_step"])
 
 
 class TestRecordEventOverhead:

@@ -219,8 +219,11 @@ class TestDispatchCounters:
         48-sequential-step scan — a 16x reduction (>= 5x required)."""
         before = cost_registry.snapshot().get("serving.prefill",
                                              {}).get("calls", 0)
+        # ragged=False: this pins the SPLIT prefill program's dispatch
+        # count (ragged engines route chunks through serving.ragged_step
+        # — their accounting is pinned in test_serving_ragged.py)
         eng = ServingEngine(gpt, page_size=4, max_batch_size=2,
-                            prefill_chunk=16, eos_id=-1)
+                            prefill_chunk=16, eos_id=-1, ragged=False)
         prompt = np.random.RandomState(0).randint(
             1, VOCAB, (49,)).astype(np.int32)
         eng.add_request(prompt, max_new_tokens=2)
