@@ -2088,6 +2088,161 @@ def bench_serving_ragged(num_requests=16, max_new_tokens=32):
     }
 
 
+def bench_serving_mesh(num_requests=8, max_new_tokens=16):
+    """Mesh-sharded serving (ISSUE 19, docs/SERVING.md "Mesh-sharded
+    replicas"): two curves off the SAME model and workload.
+
+    tokens/s-vs-chips — the steady-decode throughput of a 1-chip
+    engine vs tp=2 / tp=2,sp=2 mesh engines on an identical Poisson
+    drive, token streams asserted BYTE-IDENTICAL per mesh shape before
+    any number is reported (the tp head-shard contract is exact; the
+    sp partial-softmax merge reassociates in f32 lse space and lands
+    on the same bytes).  On a real multi-chip slice the tp curve is
+    the decode-bandwidth headline (each chip reads only its head shard
+    of every page); on the CPU host platform the "chips" are XLA
+    virtual devices sharing one socket, so the absolute slope mostly
+    measures collective overhead — the curve exists to pin the
+    identity + direction, the TPU slope comes from the MULTICHIP run.
+
+    context-length-vs-TTFT/ITL — single-request TTFT and mean ITL at
+    growing prompt lengths on the plain engine vs a sp=2 engine (each
+    chip holds half the sequence's pages, partial attention stats
+    merged in-step); the long-context regime where one chip's HBM
+    can't hold the sequence is the case sp exists for.
+
+    Skipped (detail.skipped set) when fewer than 4 devices are
+    visible — the TPU CI slice and the 8-virtual-device CPU host both
+    qualify, a single locally-attached chip does not."""
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.text.models import GPTModel
+
+    if jax.device_count() < 4:
+        return {
+            "metric": "serving_mesh_tp2_speedup",
+            "value": 0.0,
+            "unit": "x tokens/s (tp=2 vs 1-chip, byte-identical)",
+            "detail": {"skipped": f"{jax.device_count()} devices < 4"},
+        }
+
+    V, HID, L, HEADS, FF, SEQ = 512, 64, 2, 4, 256, 512
+    CHUNK, BATCH = 8, 4
+
+    def make_model():
+        paddle.seed(0)                 # same weights in every arm
+        m = GPTModel(vocab_size=V, hidden_size=HID, num_layers=L,
+                     num_heads=HEADS, ffn_size=FF, max_seq_len=SEQ,
+                     dropout=0.0)
+        m.eval()
+        return m
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, V, (int(n),)).astype(np.int32)
+               for n in rng.randint(8, 17, (num_requests,))]
+
+    def run(model, mesh_axes, tag):
+        eng = ServingEngine(model, page_size=16, max_batch_size=BATCH,
+                            max_seq_len=SEQ, eos_id=-1,
+                            prefill_chunk=CHUNK, mesh_axes=mesh_axes)
+
+        def drive(prefix):
+            ids = [eng.add_request(p, max_new_tokens=max_new_tokens,
+                                   request_id=f"{prefix}-{i}")
+                   for i, p in enumerate(prompts)]
+            t0 = time.perf_counter()
+            outs = eng.drain()
+            return time.perf_counter() - t0, {i: outs[r]
+                                              for i, r in enumerate(ids)}
+        drive(f"warm-{tag}")           # compiles land here, not in timing
+        eng.metrics.reset()
+        dt, outs = drive(tag)
+        toks = sum(len(v) for v in outs.values())
+        return {"tokens_per_sec": round(toks / dt, 2),
+                "wall_seconds": round(dt, 3)}, outs
+
+    model = make_model()
+    arms = {}
+    shapes = [("chips1", None), ("tp2", {"tp": 2})]
+    if jax.device_count() >= 4:
+        shapes.append(("tp2sp2", {"tp": 2, "sp": 2}))
+    for tag, axes in shapes:
+        arms[tag], outs = run(model, axes, tag)
+        if axes is None:
+            ref = outs
+        else:
+            for i in range(num_requests):
+                if not np.array_equal(ref[i], outs[i]):
+                    raise AssertionError(
+                        f"mesh {axes} changed request {i}'s token "
+                        "stream — shard identity is broken; no "
+                        "throughput number is reportable")
+            arms[tag]["chips"] = (axes.get("tp", 1) * axes.get("sp", 1))
+            arms[tag]["speedup_x"] = round(
+                arms[tag]["tokens_per_sec"]
+                / max(arms["chips1"]["tokens_per_sec"], 1e-9), 2)
+    arms["chips1"]["chips"] = 1
+
+    # context-length sweep: one request at a time, plain vs sp=2 —
+    # TTFT (submit -> first token) and mean ITL per prompt length
+    context = {}
+    ctx_lens = [int(x) for x in os.environ.get(
+        "BENCH_MESH_CTX_LENS", "64,128,256").split(",")]
+    for tag, axes in (("plain", None), ("sp2", {"sp": 2})):
+        stamps = {}
+
+        def cb(rid, idx, tok):
+            stamps.setdefault(rid, []).append(time.perf_counter())
+
+        eng = ServingEngine(model, page_size=16, max_batch_size=2,
+                            max_seq_len=SEQ, eos_id=-1,
+                            prefill_chunk=CHUNK, mesh_axes=axes,
+                            token_callback=cb)
+        per_len = {}
+        for n in ctx_lens:
+            prompt = rng.randint(1, V, (n,)).astype(np.int32)
+            eng.add_request(prompt, max_new_tokens=max_new_tokens,
+                            request_id=f"warm-{tag}-{n}")
+            eng.drain()                # warm this length's buckets
+            stamps.clear()
+            rid = f"ctx-{tag}-{n}"
+            t0 = time.perf_counter()
+            eng.add_request(prompt, max_new_tokens=max_new_tokens,
+                            request_id=rid)
+            outs = eng.drain()
+            ts = stamps[rid]
+            gaps = [(b - a) * 1e3 for a, b in zip(ts, ts[1:])]
+            per_len[n] = {
+                "ttft_ms": round((ts[0] - t0) * 1e3, 3),
+                "itl_ms_p95": round(
+                    float(np.percentile(gaps, 95)) if gaps else 0.0, 3),
+                "tokens": len(outs[rid]),
+            }
+        context[tag] = per_len
+
+    tp2_x = arms["tp2"]["speedup_x"]
+    return {
+        "metric": "serving_mesh_tp2_speedup",
+        "value": tp2_x,
+        "unit": "x tokens/s (tp=2 vs 1-chip, byte-identical streams)",
+        "detail": {
+            "num_requests": num_requests,
+            "max_new_tokens": max_new_tokens,
+            "byte_identical": True,
+            "devices_visible": jax.device_count(),
+            "scaling": arms,
+            "context": {tag: {f"len{n}": v for n, v in d.items()}
+                        for tag, d in context.items()},
+            "context_lens": ctx_lens,
+            "model": {"hidden": HID, "layers": L, "heads": HEADS,
+                      "max_seq_len": SEQ},
+        },
+    }
+
+
 def bench_serving_observability(num_requests=24, max_new_tokens=16):
     """ISSUE 11: the cost of the always-on request tracing + flight
     recorder, A/B-measured on the serving engine's hot path.
@@ -2664,6 +2819,20 @@ def main():
         except Exception as e:  # noqa: BLE001 — rider workload, never fatal
             sys.stderr.write(
                 f"serving ragged bench failed after retries "
+                f"({type(e).__name__}: {e})\n")
+        try:
+            # mesh-sharded replicas: tokens/s-vs-chips (tp) +
+            # context-length-vs-TTFT/ITL (sp), byte-identity asserted
+            # per mesh shape (ISSUE 19); self-skips under 4 devices
+            result.setdefault("detail", {})["mesh"] = \
+                _with_retries(
+                    "serving_mesh",
+                    lambda: bench_serving_mesh(
+                        int(os.environ.get("BENCH_MESH_REQUESTS", "8")),
+                        int(os.environ.get("BENCH_MESH_TOKENS", "16"))))
+        except Exception as e:  # noqa: BLE001 — rider workload, never fatal
+            sys.stderr.write(
+                f"serving mesh bench failed after retries "
                 f"({type(e).__name__}: {e})\n")
         try:
             # tracing + flight-recorder overhead A/B + bundle numbers

@@ -479,6 +479,63 @@ PAGED_RAGGED_INT8 = KernelContract(
 )
 
 # ===========================================================================
+# paged_attention.py — mesh-aware head-shard STATS form (ISSUE 19).
+# Same grid/scratch as the unified ragged contract, but the kernel runs
+# on ONE mesh shard: its page pool holds the shard's 1/sp of the pages
+# (and its H/tp head-shard of each), a third scalar-prefetch operand
+# masks page-table entries by OWNERSHIP, and alongside the locally-
+# normalized context the kernel emits the online-softmax running stats
+# as lse = m + log(l) — the cross-shard merge (pmax of lse, psum of
+# exp-weighted context/denominator) lives in the sharded serving core
+# (text/generation.py), mirroring distributed/ring_attention.py.
+# ===========================================================================
+PAGED_RAGGED_STATS = KernelContract(
+    name="paged_attention_ragged_stats",
+    module="paddle_tpu/ops/pallas_ops/paged_attention.py",
+    grid=("groups", "pages_per_seq"),
+    dims={"page_size": 16, "heads": 8, "head_dim": 128, "lane": 128,
+          "head_align": 8, "q_align": 8},
+    blocks=(
+        BlockDecl("page_tables", "in", ("groups", "pages_per_seq"),
+                  "int32", memory="smem"),
+        BlockDecl("group_lens", "in", ("groups",), "int32",
+                  memory="smem"),
+        BlockDecl("page_ok", "in", ("groups", "pages_per_seq"),
+                  "int32", memory="smem"),
+        BlockDecl("row_lens", "in", (1, "q_align"), "int32",
+                  lanes_full=True,
+                  waivers=("sublane: same trade as the ragged f32 "
+                           "contract's row_lens — one sub-tile int32 "
+                           "row per group by design",)),
+        BlockDecl("q", "in", (1, "q_align", "heads", "head_dim"),
+                  "float32"),
+        BlockDecl("k_page", "in", (1, "page_size", "heads", "head_dim"),
+                  "float32"),
+        BlockDecl("v_page", "in", (1, "page_size", "heads", "head_dim"),
+                  "float32"),
+        BlockDecl("o", "out", (1, "q_align", "heads", "head_dim"),
+                  "float32"),
+        BlockDecl("lse", "out", (1, "q_align", "heads"), "float32",
+                  lanes_full=True,
+                  waivers=("lane: the [Qp, H] lse stats row spans the "
+                           "full head extent (H/tp local heads, not a "
+                           "128-lane tile) — one sub-lane stats block "
+                           "per group by design, like the flash "
+                           "kernels' lse",)),
+        BlockDecl("acc", "scratch", ("heads", "q_align", "head_dim"),
+                  "float32"),
+        BlockDecl("m", "scratch", ("heads", "q_align", "lane"),
+                  "float32"),
+        BlockDecl("l", "scratch", ("heads", "q_align", "lane"),
+                  "float32"),
+    ),
+    shape_buckets={"head_dim": (128, 256), "heads": (8, 16, 32)},
+    # no sweep: the stats form's config is structural (it must mirror
+    # the unified ragged contract it shards — a divergent padding floor
+    # would change nothing but the slice-off)
+)
+
+# ===========================================================================
 # quantized_matmul.py — weight-only int8 matmul.  Grid (M/bm, N/bn,
 # K/bk), K innermost; int8 weight blocks satisfy the (32, 128) floor at
 # the default 128x128x128 tiling.
@@ -513,5 +570,5 @@ CONTRACTS: Dict[str, KernelContract] = {
     c.name: c for c in (FLASH_FWD, FLASH_BWD_DKV, FLASH_BWD_DQ,
                         PAGED_DECODE, PAGED_DECODE_INT8,
                         PAGED_RAGGED, PAGED_RAGGED_INT8,
-                        QUANTIZED_MATMUL)
+                        PAGED_RAGGED_STATS, QUANTIZED_MATMUL)
 }

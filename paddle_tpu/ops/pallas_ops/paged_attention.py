@@ -51,7 +51,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .contracts import (PAGED_DECODE, PAGED_DECODE_INT8, PAGED_RAGGED,
-                        PAGED_RAGGED_INT8)
+                        PAGED_RAGGED_INT8, PAGED_RAGGED_STATS)
 
 NEG_INF = -1e30
 
@@ -66,6 +66,9 @@ _FUSED_DEQUANT = PAGED_DECODE_INT8.dim("fused_dequant")
 _RAGGED_HEAD_ALIGN = PAGED_RAGGED.dim("head_align")
 _RAGGED_Q_ALIGN = PAGED_RAGGED.dim("q_align")
 _RAGGED_FUSED_DEQUANT = PAGED_RAGGED_INT8.dim("fused_dequant")
+# mesh-aware head-shard stats form (ISSUE 19)
+_STATS_HEAD_ALIGN = PAGED_RAGGED_STATS.dim("head_align")
+_STATS_Q_ALIGN = PAGED_RAGGED_STATS.dim("q_align")
 
 
 def _resolved_dims(H, D, quantized):
@@ -650,3 +653,301 @@ def ragged_paged_attention(q, k_pages, v_pages, page_tables, row_lens,
     PAGED_ROUTE_STATS["xla"] += 1
     return ragged_paged_attention_xla(q, k_pages, v_pages, page_tables,
                                       row_lens, k_scales, v_scales)
+
+
+# ===========================================================================
+# Mesh-aware head-shard form (ISSUE 19): partial-softmax stats.
+#
+# Under sequence (sp) sharding each chip holds 1/sp of the page pool
+# (and, under tp, its head-shard of every page).  A shard cannot
+# normalize the softmax alone — it reduces over only the pages it OWNS
+# and returns the ragged kernel's running stats instead of a normalized
+# context: ``(o, lse)`` where ``o`` is the shard-local softmax over the
+# owned pages and ``lse = m + log(l)`` its log-sum-exp (NEG_INF for a
+# row with no owned/visible positions).  The caller merges shards in
+# lse space (distributed/ring_attention.py's recipe):
+#
+#   M   = pmax(lse)            w = exp(lse - M)
+#   ctx = psum(o * w) / psum(w)
+#
+# ``page_ok [G, M]`` masks page-table entries by OWNERSHIP: a non-owned
+# entry was remapped to the shard's local trash row, whose zero content
+# would otherwise contribute exp(0) terms to the softmax — ownership
+# masking (not just the positional row_lens mask) is what keeps the
+# merged result equal to the unsharded softmax.
+# ===========================================================================
+
+
+def _ragged_stats_kernel(pt_ref, gl_ref, ok_ref, rl_ref, q_ref, k_ref,
+                         v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
+                         scale, page_size, num_pages_grid):
+    """``_ragged_kernel`` widened with a page-ownership mask (third
+    scalar-prefetch operand) and an lse output: grid cell (g, i) skips
+    non-owned pages' contributions entirely, and the final write emits
+    the running stats alongside the locally-normalized context."""
+    g = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    group_len = gl_ref[g]
+
+    @pl.when((i * page_size < group_len) & (ok_ref[g, i] != 0))
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale          # [Qp, H, D]
+        k = k_ref[0].astype(jnp.float32)                  # [P, H, D]
+        v = v_ref[0].astype(jnp.float32)
+        rl = rl_ref[0]                                    # [Qp] int32
+        s = jax.lax.dot_general(q, k, (((2,), (2,)), ((1,), (1,))),
+                                preferred_element_type=jnp.float32)
+        H, Qp, P = s.shape
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (H, Qp, P), 2)
+        valid = pos < rl[None, :, None]
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev = m_sc[:, :, :1]
+        l_prev = l_sc[:, :, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_sc[:] = acc_sc[:] * alpha + jax.lax.dot_general(
+            p, v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
+
+    @pl.when(i == num_pages_grid - 1)
+    def _write():
+        l_cur = l_sc[:, :, :1]
+        l_safe = jnp.maximum(l_cur, 1e-30)
+        o_ref[0] = jnp.transpose(acc_sc[:] / l_safe,
+                                 (1, 0, 2)).astype(o_ref.dtype)
+        # a row with NO owned/visible positions keeps l == 0: lse is
+        # NEG_INF so the merge weight exp(lse - M) underflows to 0
+        lse = jnp.where(l_cur > 0, m_sc[:, :, :1] + jnp.log(l_safe),
+                        NEG_INF)
+        lse_ref[0] = jnp.transpose(lse[:, :, 0], (1, 0))
+
+
+def _ragged_stats_kernel_quant(pt_ref, gl_ref, ok_ref, rl_ref, q_ref,
+                               k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                               lse_ref, acc_sc, m_sc, l_sc, *, scale,
+                               page_size, num_pages_grid,
+                               fused_dequant=True):
+    """Int8-KV variant of ``_ragged_stats_kernel`` — in-register dequant
+    exactly as ``_ragged_kernel_quant``; the K scale lands before the
+    running max so lse is the dequantized logits' log-sum-exp."""
+    g = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    group_len = gl_ref[g]
+
+    @pl.when((i * page_size < group_len) & (ok_ref[g, i] != 0))
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale          # [Qp, H, D]
+        k = k_ref[0].astype(jnp.float32)                  # [P, H, D]
+        v = v_ref[0].astype(jnp.float32)
+        ks = ks_ref[0].astype(jnp.float32)                # [H] page K scale
+        vs = vs_ref[0].astype(jnp.float32)                # [H] page V scale
+        rl = rl_ref[0]                                    # [Qp] int32
+        if not fused_dequant:
+            k = k * ks[None, :, None]
+            v = v * vs[None, :, None]
+        s = jax.lax.dot_general(q, k, (((2,), (2,)), ((1,), (1,))),
+                                preferred_element_type=jnp.float32)
+        if fused_dequant:
+            s = s * ks[:, None, None]
+        H, Qp, P = s.shape
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (H, Qp, P), 2)
+        valid = pos < rl[None, :, None]
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev = m_sc[:, :, :1]
+        l_prev = l_sc[:, :, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        ctx = jax.lax.dot_general(p, v, (((2,), (0,)), ((0,), (1,))),
+                                  preferred_element_type=jnp.float32)
+        if fused_dequant:
+            ctx = ctx * vs[:, None, None]
+        acc_sc[:] = acc_sc[:] * alpha + ctx
+        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
+
+    @pl.when(i == num_pages_grid - 1)
+    def _write():
+        l_cur = l_sc[:, :, :1]
+        l_safe = jnp.maximum(l_cur, 1e-30)
+        o_ref[0] = jnp.transpose(acc_sc[:] / l_safe,
+                                 (1, 0, 2)).astype(o_ref.dtype)
+        lse = jnp.where(l_cur > 0, m_sc[:, :, :1] + jnp.log(l_safe),
+                        NEG_INF)
+        lse_ref[0] = jnp.transpose(lse[:, :, 0], (1, 0))
+
+
+def ragged_paged_attention_stats_kernel(q, k_pages, v_pages, page_tables,
+                                        row_lens, page_ok, k_scales=None,
+                                        v_scales=None, *, interpret=None,
+                                        head_align=None, q_align=None,
+                                        fused_dequant=None):
+    """The stats-form Pallas kernel proper — ``ragged_paged_attention_kernel``
+    plus a ``page_ok [G, M]`` ownership mask (third scalar prefetch) and
+    an lse output.  Returns ``(o [G, Qb, H, D], lse [G, Qb, H] f32)``."""
+    G, Qb, H, D = q.shape
+    page_size = k_pages.shape[1]
+    max_pages = page_tables.shape[1]
+    quantized = k_pages.dtype == jnp.int8
+    if quantized and (k_scales is None or v_scales is None):
+        raise ValueError("int8 KV pages require k_scales/v_scales")
+    if head_align is None:
+        head_align = _STATS_HEAD_ALIGN
+    if q_align is None:
+        q_align = _STATS_Q_ALIGN
+    if quantized and fused_dequant is None:
+        fused_dequant = bool(_RAGGED_FUSED_DEQUANT)
+    scale = 1.0 / math.sqrt(D)
+    page_tables = page_tables.astype(jnp.int32)
+    row_lens = row_lens.astype(jnp.int32)
+    page_ok = page_ok.astype(jnp.int32)
+
+    Qp = -(-Qb // q_align) * q_align
+    Hp = -(-H // head_align) * head_align
+    Dp = _LANE if D <= _LANE else -(-D // _LANE) * _LANE
+    if Qp != Qb:
+        q = jnp.pad(q, ((0, 0), (0, Qp - Qb), (0, 0), (0, 0)))
+        row_lens = jnp.pad(row_lens, ((0, 0), (0, Qp - Qb)))
+    if Hp != H or Dp != D:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, Hp - H), (0, Dp - D)))
+        k_pages = jnp.pad(k_pages,
+                          ((0, 0), (0, 0), (0, Hp - H), (0, Dp - D)))
+        v_pages = jnp.pad(v_pages,
+                          ((0, 0), (0, 0), (0, Hp - H), (0, Dp - D)))
+        if quantized:
+            k_scales = jnp.pad(k_scales, ((0, 0), (0, Hp - H)),
+                               constant_values=1.0)
+            v_scales = jnp.pad(v_scales, ((0, 0), (0, Hp - H)),
+                               constant_values=1.0)
+    Gq, Qq, Hq, Dq = q.shape
+    group_lens = jnp.max(row_lens, axis=1).astype(jnp.int32)
+
+    in_specs = [
+        pl.BlockSpec((1, Qq), lambda g, i, pt, gl, ok: (g, 0)),
+        pl.BlockSpec((1, Qq, Hq, Dq),
+                     lambda g, i, pt, gl, ok: (g, 0, 0, 0)),
+        pl.BlockSpec((1, page_size, Hq, Dq),
+                     lambda g, i, pt, gl, ok: (pt[g, i], 0, 0, 0)),
+        pl.BlockSpec((1, page_size, Hq, Dq),
+                     lambda g, i, pt, gl, ok: (pt[g, i], 0, 0, 0)),
+    ]
+    operands = [row_lens, q, k_pages, v_pages]
+    kern = _ragged_stats_kernel
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, Hq), lambda g, i, pt, gl, ok: (pt[g, i], 0)),
+            pl.BlockSpec((1, Hq), lambda g, i, pt, gl, ok: (pt[g, i], 0)),
+        ]
+        operands += [k_scales.astype(jnp.float32),
+                     v_scales.astype(jnp.float32)]
+        kern = functools.partial(_ragged_stats_kernel_quant,
+                                 fused_dequant=bool(fused_dequant))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,        # page_tables, group_lens, page_ok
+        grid=(G, max_pages),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, Qq, Hq, Dq),
+                         lambda g, i, pt, gl, ok: (g, 0, 0, 0)),
+            pl.BlockSpec((1, Qq, Hq), lambda g, i, pt, gl, ok: (g, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((Hq, Qq, Dq), jnp.float32),
+            pltpu.VMEM((Hq, Qq, _LANE), jnp.float32),
+            pltpu.VMEM((Hq, Qq, _LANE), jnp.float32),
+        ],
+    )
+    out, lse = pl.pallas_call(
+        functools.partial(kern, scale=scale, page_size=page_size,
+                          num_pages_grid=max_pages),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((Gq, Qq, Hq, Dq), q.dtype),
+                   jax.ShapeDtypeStruct((Gq, Qq, Hq), jnp.float32)],
+        compiler_params=_compiler_params(),
+        interpret=_interpret_mode() if interpret is None else interpret,
+    )(page_tables, group_lens, page_ok, *operands)
+    if Qq != Qb or Hq != H or Dq != D:
+        out = out[:, :Qb, :H, :D]
+        lse = lse[:, :Qb, :H]
+    return out, lse
+
+
+def ragged_paged_attention_stats_xla(q, k_pages, v_pages, page_tables,
+                                     row_lens, page_ok, k_scales=None,
+                                     v_scales=None):
+    """Exact XLA reference for the stats form: gather, mask by position
+    AND page ownership, and return the locally-normalized context with
+    its log-sum-exp — the same (o, lse) definition the kernel emits."""
+    G, Qb, H, D = q.shape
+    page_size = k_pages.shape[1]
+    M = page_tables.shape[1]
+    S = M * page_size
+    k = k_pages[page_tables].reshape(G, S, H, D)
+    v = v_pages[page_tables].reshape(G, S, H, D)
+    if k_pages.dtype == jnp.int8:
+        if k_scales is None or v_scales is None:
+            raise ValueError("int8 KV pages require k_scales/v_scales")
+        ks = jnp.repeat(k_scales[page_tables], page_size, axis=1)
+        vs = jnp.repeat(v_scales[page_tables], page_size, axis=1)
+        k = k.astype(jnp.float32) * ks[..., None]
+        v = v.astype(jnp.float32) * vs[..., None]
+    scale = 1.0 / math.sqrt(D)
+    s = jnp.einsum("gqhd,gshd->gqhs", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    valid = (jnp.arange(S)[None, None, :]
+             < row_lens[:, :, None])                      # [G, Qb, S]
+    ok = jnp.repeat(page_ok.astype(bool), page_size, axis=1)
+    valid = valid & ok[:, None, :]
+    vmask = valid[:, :, None, :]                          # [G, Qb, 1, S]
+    s = jnp.where(vmask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)                               # [G, Qb, H]
+    p = jnp.where(vmask, jnp.exp(s - m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)                               # [G, Qb, H]
+    l_safe = jnp.maximum(l, 1e-30)
+    o = jnp.einsum("gqhs,gshd->gqhd", p,
+                   v.astype(jnp.float32)) / l_safe[..., None]
+    lse = jnp.where(l > 0, m + jnp.log(l_safe), NEG_INF)
+    return o.astype(q.dtype), lse.astype(jnp.float32)
+
+
+def ragged_paged_attention_stats(q, k_pages, v_pages, page_tables,
+                                 row_lens, page_ok, k_scales=None,
+                                 v_scales=None):
+    """Routing entry for the mesh-sharded (sp) serving core: Pallas
+    kernel on TPU (or under PADDLE_TPU_FORCE_PAGED=1), exact XLA gather
+    reference elsewhere — the same routing contract as
+    :func:`ragged_paged_attention`.  ``page_ok [G, M]`` marks the
+    page-table entries this shard owns; returns ``(o, lse)`` partial
+    stats for the cross-shard lse-space merge."""
+    forced = os.environ.get("PADDLE_TPU_FORCE_PAGED") == "1"
+    if forced or jax.default_backend() == "tpu":
+        PAGED_ROUTE_STATS["pallas"] += 1
+        return ragged_paged_attention_stats_kernel(
+            q, k_pages, v_pages, page_tables, row_lens, page_ok,
+            k_scales, v_scales)
+    PAGED_ROUTE_STATS["xla"] += 1
+    return ragged_paged_attention_stats_xla(
+        q, k_pages, v_pages, page_tables, row_lens, page_ok,
+        k_scales, v_scales)

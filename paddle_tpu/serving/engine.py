@@ -93,7 +93,8 @@ def _shared_programs(model, *, page_size: int, pages_per_seq: int,
                      kv_cache_dtype, weight_dtype, kv_scales, weights,
                      fused_steps: int, spec_steps: int = 0,
                      spec_sequential: bool = False,
-                     numeric_guards: bool = True) -> dict:
+                     numeric_guards: bool = True,
+                     mesh_layout=None) -> dict:
     from ..jit.functional import get_state
     from ..text.generation import (make_gpt_paged_decode_step,
                                    make_gpt_paged_prefill_step,
@@ -106,7 +107,7 @@ def _shared_programs(model, *, page_size: int, pages_per_seq: int,
     # engine's compiles and only its fused/spec_verify program is
     # per-variant (cached under the base bundle's "_variants")
     key = (page_size, pages_per_seq, kv_cache_dtype, weight_dtype,
-           numeric_guards,
+           numeric_guards, mesh_layout,
            None if kv_scales is None else id(kv_scales),
            None if weights is None else id(weights),
            tuple(sorted((k, id(v)) for k, v in params.items())))
@@ -145,8 +146,15 @@ def _shared_programs(model, *, page_size: int, pages_per_seq: int,
         model, page_size, pages_per_seq, **qkw)
     prefill_fn, _ = make_gpt_paged_prefill_step(
         model, page_size, pages_per_seq, **qkw)
-    ragged_fn, _ = make_gpt_paged_ragged_step(
-        model, page_size, pages_per_seq, with_guard=numeric_guards, **qkw)
+    ragged_fn, ragged_init = make_gpt_paged_ragged_step(
+        model, page_size, pages_per_seq, with_guard=numeric_guards,
+        mesh_layout=mesh_layout, **qkw)
+    if mesh_layout is not None and mesh_layout.size > 1:
+        # mesh engines run ragged-only: the pools must come from the
+        # SHARDED builder (laid out per the mesh layout), and the split
+        # decode/prefill programs are never traced (profiled_jit is
+        # lazy) — the sharded core would reject them anyway
+        init_pages = ragged_init
 
     def _decode(tokens, pos, page_tables, kv):
         logits, kv = step_fn(tokens, pos, page_tables, kv)
@@ -369,6 +377,7 @@ class ServingEngine:
                  sync_mode: bool = False,
                  fused_steps: int = 1,
                  ragged: Optional[bool] = None,
+                 mesh_axes: Optional[dict] = None,
                  kv_cache_dtype: Optional[str] = None,
                  weight_dtype: Optional[str] = None,
                  quant_scales: Optional[dict] = None,
@@ -388,11 +397,74 @@ class ServingEngine:
                 f"max_seq_len ({self.max_seq_len}) exceeds the model's "
                 f"position table ({model_max})")
         self.pages_per_seq = -(-self.max_seq_len // self.page_size)
+        # --- mesh-sharded replica (ISSUE 19, docs/SERVING.md
+        # "Mesh-sharded replicas"): mesh_axes={"tp": N} and/or
+        # {"sp": N} spans this ONE engine across tp*sp chips — qkv/ffn
+        # weights and the KV pools' head dim shard over tp (decode at
+        # aggregate HBM bandwidth, bitwise-identical streams), the page
+        # dim shards over sp (long-context partial-softmax exchange).
+        # The host side (scheduler, page tables, lane state) is
+        # unchanged: one logical replica, uploads replicated via _dput.
+        self._mesh_layout = None
+        if mesh_axes is not None:
+            if not isinstance(mesh_axes, dict):
+                # the watchdog=/brownout= validation discipline
+                raise InvalidArgumentError(
+                    f"mesh_axes must be a dict of axis degrees "
+                    f"(tp=/sp=), got {mesh_axes!r}")
+            unknown = set(mesh_axes) - {"tp", "sp"}
+            if unknown:
+                raise InvalidArgumentError(
+                    f"unknown mesh_axes key(s) {sorted(unknown)}; "
+                    "expected tp (head sharding) / sp (sequence "
+                    "sharding)")
+            try:
+                mesh_tp = int(mesh_axes.get("tp", 1))
+                mesh_sp = int(mesh_axes.get("sp", 1))
+            except (TypeError, ValueError):
+                raise InvalidArgumentError(
+                    f"mesh_axes degrees must be ints, got {mesh_axes!r}")
+            if mesh_tp < 1 or mesh_sp < 1:
+                raise InvalidArgumentError(
+                    f"mesh_axes degrees must be >= 1, got tp={mesh_tp} "
+                    f"sp={mesh_sp}")
+            if mesh_tp * mesh_sp > 1:
+                heads = int(model.layers[0].attn.num_heads)
+                if heads % mesh_tp:
+                    raise InvalidArgumentError(
+                        f"mesh_axes tp={mesh_tp} must divide the "
+                        f"model's num_heads ({heads})")
+                if mesh_tp * mesh_sp > jax.device_count():
+                    raise InvalidArgumentError(
+                        f"mesh_axes needs tp*sp = "
+                        f"{mesh_tp * mesh_sp} devices but only "
+                        f"{jax.device_count()} are available")
+                from ..text.generation import ServingMeshLayout
+                self._mesh_layout = ServingMeshLayout(tp=mesh_tp,
+                                                      sp=mesh_sp)
+        self._mesh_sharding = None
+        if self._mesh_layout is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from ..distributed.mesh import init_mesh
+            mesh = init_mesh(self._mesh_layout.axes())
+            self._mesh_sharding = NamedSharding(mesh, PartitionSpec())
         if num_pages is None:
             # roomy default: every slot can hold a full-length sequence
             num_pages = max_batch_size * self.pages_per_seq + 1
+            if self._mesh_layout is not None:
+                # the pool must split evenly across sequence shards
+                num_pages += (-num_pages) % self._mesh_layout.sp
+        elif self._mesh_layout is not None \
+                and int(num_pages) % self._mesh_layout.sp:
+            raise InvalidArgumentError(
+                f"num_pages ({num_pages}) must be divisible by mesh "
+                f"sp ({self._mesh_layout.sp}) — the page pool splits "
+                "evenly across sequence shards")
+        reserved = ((0,) if self._mesh_layout is None else
+                    self._mesh_layout.reserved_pages(int(num_pages)))
         self.cache = PagedKVCache(num_pages, self.page_size,
-                                  self.pages_per_seq)
+                                  self.pages_per_seq,
+                                  reserved_pages=reserved)
         self.scheduler = Scheduler(self.cache, max_batch_size,
                                    bucket_sizes=bucket_sizes)
         self.metrics = metrics or ServingMetrics()
@@ -423,6 +495,11 @@ class ServingEngine:
                 "fused K-step loop is a split-program variant; pass "
                 "ragged=False (or drop fused_steps) ")
         self.ragged = ragged
+        if self._mesh_layout is not None and not self.ragged:
+            raise InvalidArgumentError(
+                "mesh_axes requires the unified ragged dispatch — the "
+                "sharded core serves only the ragged layout; drop "
+                "ragged=False (and fused_steps)")
         self.outputs: Dict[str, np.ndarray] = {}
         self._ttft_recorded = set()      # per REQUEST, preemption-proof
         # streaming hook: called as (request_id, index, token) for every
@@ -521,6 +598,15 @@ class ServingEngine:
                 "spec_drafter was provided but spec_decode is off — "
                 "pass spec_decode=True (or an int horizon) to enable "
                 "speculative decoding")
+        if spec_k and self._mesh_layout is not None and self._kv_dynamic:
+            # int8_dynamic speculation verifies through the split
+            # SEQUENTIAL program (progressive scale-growth replay) —
+            # a split program the sharded core does not serve
+            raise InvalidArgumentError(
+                "mesh_axes with spec_decode requires native or "
+                "int8_static KV — the int8_dynamic sequential verifier "
+                "is a split program the mesh-sharded core does not "
+                "serve")
         self.spec = None
         if spec_k:
             from .spec_decode import SpecDecoder
@@ -545,7 +631,8 @@ class ServingEngine:
             fused_steps=self.fused_steps,
             spec_steps=0 if spec_folds else spec_k,
             spec_sequential=self._kv_dynamic,
-            numeric_guards=self.numeric_guards)
+            numeric_guards=self.numeric_guards,
+            mesh_layout=self._mesh_layout)
         self._kv = progs["init_pages"](num_pages)
         self._weight_quant = progs["weight_quant"]
         self._decode_jit = progs["decode"]
@@ -559,6 +646,28 @@ class ServingEngine:
         self._page_gather_jit = progs["page_gather"]
         self._page_put_jit = progs["page_put"]
         self._page_cow_jit = progs["page_cow"]
+        if self._mesh_layout is not None:
+            # snapshots / tiering / scrubs on a SHARDED pool assemble or
+            # scatter pages across every shard (jax.device_get gathers a
+            # sharded array transparently — EngineSnapshot stays
+            # portable to any mesh shape, including single-device) —
+            # count those cross-shard moves so the failover/tiering
+            # cost of a mesh replica is observable (serving.shard.*)
+            _gather, _put = self._page_gather_jit, self._page_put_jit
+
+            def _mesh_gather(kv, rows, _g=_gather):
+                self.metrics.on_shard_page_gather()
+                return _g(kv, rows)
+
+            def _mesh_put(kv, rows, payload, _p=_put):
+                self.metrics.on_shard_page_scatter()
+                return _p(kv, rows, payload)
+
+            self._page_gather_jit = _mesh_gather
+            self._page_put_jit = _mesh_put
+            self.metrics.on_shard_config(
+                tp=self._mesh_layout.tp, sp=self._mesh_layout.sp,
+                devices=self._mesh_layout.size)
 
         # --- prefix cache (docs/SERVING.md "Prefix caching") -----------
         # opt-in radix index over resident full prompt/output pages:
@@ -641,8 +750,8 @@ class ServingEngine:
         self._state_bucket = 0
         self._lanes: List[Optional[Sequence]] = []
         self._lane_ids: List = []        # device () int32 per lane index
-        self._zero_i32 = jax.device_put(np.int32(0))
-        self._zero_row = jax.device_put(
+        self._zero_i32 = self._dput(np.int32(0))
+        self._zero_row = self._dput(
             np.zeros((self.pages_per_seq,), np.int32))
         self._pending: Deque[_Pending] = deque()
         self._last_dispatch: Optional[float] = None
@@ -665,6 +774,17 @@ class ServingEngine:
         self._ragged_steady: Dict[int, tuple] = {}
         from ..text.generation import RAGGED_NO_LIMIT
         self._ragged_no_limit = RAGGED_NO_LIMIT
+
+    def _dput(self, x):
+        """Host→device upload for engine state.  In mesh mode every
+        upload is REPLICATED over the replica's (tp, sp, data) mesh —
+        a plain ``jax.device_put`` would commit the array to one device
+        and the jitted programs would reject mixing it with the
+        mesh-sharded pools; replicated inputs cost nothing extra (XLA
+        broadcasts once) and keep every host path mesh-agnostic."""
+        if self._mesh_sharding is not None:
+            return jax.device_put(x, self._mesh_sharding)
+        return jax.device_put(x)
 
     # --- request intake ---------------------------------------------------
     def check_request(self, prompt, max_new_tokens: int = 32) -> np.ndarray:
@@ -691,7 +811,7 @@ class ServingEngine:
         # a request that could never fit even running ALONE would sit in
         # the admission queue forever (nothing to preempt) — reject loudly
         need = self.cache.pages_needed(prompt.size + max_new_tokens - 1)
-        cap = min(self.cache.num_pages - 1, self.pages_per_seq)
+        cap = min(self.cache.allocatable_pages, self.pages_per_seq)
         if need > cap:
             raise InvalidArgumentError(
                 f"request needs {need} KV pages (prompt {prompt.size} + "
@@ -829,18 +949,18 @@ class ServingEngine:
         rows_np = np.zeros((R,), np.int32)
         rows_np[: len(page_ids)] = page_ids
         payload = {
-            side: [jnp.zeros((R,) + tuple(p.shape[1:]),
-                             p.dtype) for p in self._kv[side]]
+            side: [self._dput(np.zeros((R,) + tuple(p.shape[1:]),
+                                       p.dtype)) for p in self._kv[side]]
             for side in ("k", "v")}
         if self._static_kv_scales is not None:
             for side in ("k", "v"):
                 payload[f"{side}_scale"] = [
-                    jnp.broadcast_to(
-                        jnp.asarray(np.asarray(s, np.float32))[None, :],
-                        (R, np.asarray(s).shape[0])) + 0
+                    self._dput(np.broadcast_to(
+                        np.asarray(s, np.float32)[None, :],
+                        (R, np.asarray(s).shape[0])).copy())
                     for s in self._static_kv_scales[side]]
         self._kv = self._page_put_jit(self._kv,
-                                      jax.device_put(rows_np), payload)
+                                      self._dput(rows_np), payload)
 
     def _quarantine(self, seq: Sequence):
         """Fail one guard-flagged request NOW (pipeline already
@@ -910,7 +1030,7 @@ class ServingEngine:
             return
         pos = max(seq.pos - 1, 0)
         page = table[min(pos // self.page_size, len(table) - 1)]
-        rows = jax.device_put(np.asarray([page], np.int32))
+        rows = self._dput(np.asarray([page], np.int32))
         payload = {key: [np.array(a) for a in arrs]    # writable copies
                    for key, arrs in jax.device_get(
                        self._page_gather_jit(self._kv, rows)).items()}
@@ -920,7 +1040,7 @@ class ServingEngine:
         else:
             for arr in payload["k"]:
                 arr[...] = np.nan
-        dev = {key: [jax.device_put(a) for a in arrs]
+        dev = {key: [self._dput(a) for a in arrs]
                for key, arrs in payload.items()}
         self._kv = self._page_put_jit(self._kv, rows, dev)
 
@@ -965,7 +1085,7 @@ class ServingEngine:
             padded = np.zeros((next_pow2(len(rows)),), np.int32)
             padded[: len(rows)] = rows
             got = jax.device_get(
-                self._page_gather_jit(self._kv, jax.device_put(padded)))
+                self._page_gather_jit(self._kv, self._dput(padded)))
             R = len(rows)
             if mode == "int8_dynamic":
                 # dynamic per-page scales are device state owned by the
@@ -1066,14 +1186,14 @@ class ServingEngine:
                 if Rp != R:
                     a = np.concatenate(
                         [a, np.zeros((Rp - R,) + a.shape[1:], a.dtype)])
-                padded.append(jax.device_put(a))
+                padded.append(self._dput(a))
             dev[key] = padded
         if snap.kv_mode == "native":
             # pools carry the model dtype (e.g. bf16) — cast on device
             model_dt = self._kv["k"][0].dtype
             dev["k"] = [a.astype(model_dt) for a in dev["k"]]
             dev["v"] = [a.astype(model_dt) for a in dev["v"]]
-        self._kv = self._page_put_jit(self._kv, jax.device_put(rows_np),
+        self._kv = self._page_put_jit(self._kv, self._dput(rows_np),
                                       dev)
         if snap.num_generated:
             # TTFT already happened on the donor replica — a resumed
@@ -1098,7 +1218,7 @@ class ServingEngine:
         padded = np.zeros((next_pow2(R),), np.int32)
         padded[:R] = rows
         got = jax.device_get(
-            self._page_gather_jit(self._kv, jax.device_put(padded)))
+            self._page_gather_jit(self._kv, self._dput(padded)))
         return [{key: [np.asarray(a[i]) for a in arrs]
                  for key, arrs in got.items()} for i in range(R)]
 
@@ -1119,7 +1239,7 @@ class ServingEngine:
                         [stacked,
                          np.zeros((Rp - R,) + stacked.shape[1:],
                                   stacked.dtype)])
-                arrs.append(jax.device_put(stacked))
+                arrs.append(self._dput(stacked))
             dev[key] = arrs
         if self.kv_cache_dtype != "int8":
             # native pools carry the model dtype — cast on device, the
@@ -1127,7 +1247,7 @@ class ServingEngine:
             model_dt = self._kv["k"][0].dtype
             dev["k"] = [a.astype(model_dt) for a in dev["k"]]
             dev["v"] = [a.astype(model_dt) for a in dev["v"]]
-        self._kv = self._page_put_jit(self._kv, jax.device_put(rows_np),
+        self._kv = self._page_put_jit(self._kv, self._dput(rows_np),
                                       dev)
 
     # --- device-resident lane state ---------------------------------------
@@ -1138,9 +1258,9 @@ class ServingEngine:
         assert not self._pending
         M = self.pages_per_seq
         if self._state_bucket == 0:
-            self._tokens = jnp.zeros((new_bucket,), jnp.int32)
-            self._pos = jnp.zeros((new_bucket,), jnp.int32)
-            self._tables = jnp.zeros((new_bucket, M), jnp.int32)
+            self._tokens = self._dput(np.zeros((new_bucket,), np.int32))
+            self._pos = self._dput(np.zeros((new_bucket,), np.int32))
+            self._tables = self._dput(np.zeros((new_bucket, M), np.int32))
         else:
             pad = new_bucket - self._state_bucket
             self._tokens = jnp.pad(self._tokens, (0, pad))
@@ -1148,7 +1268,7 @@ class ServingEngine:
             self._tables = jnp.pad(self._tables, ((0, pad), (0, 0)))
         self._lanes.extend([None] * (new_bucket - self._state_bucket))
         self._state_bucket = new_bucket
-        self._lane_ids = [jax.device_put(np.int32(i))
+        self._lane_ids = [self._dput(np.int32(i))
                           for i in range(new_bucket)]
 
     def _bind_lane(self, seq: Sequence) -> int:
@@ -1160,11 +1280,11 @@ class ServingEngine:
                                              self.scheduler.bucket_sizes))
             lane = self._lanes.index(None)
         self._lanes[lane] = seq
-        row = jax.device_put(self.cache.page_table_row(seq.seq_id))
+        row = self._dput(self.cache.page_table_row(seq.seq_id))
         self._tokens, self._pos, self._tables = self._lane_set_jit(
             self._tokens, self._pos, self._tables, self._lane_ids[lane],
-            jax.device_put(np.int32(seq.next_token)),
-            jax.device_put(np.int32(seq.pos)), row)
+            self._dput(np.int32(seq.next_token)),
+            self._dput(np.int32(seq.pos)), row)
         self._uploaded_pages[seq.seq_id] = self.cache.seq_pages(seq.seq_id)
         return lane
 
@@ -1182,7 +1302,7 @@ class ServingEngine:
         table = self.cache.seq_page_ids(seq.seq_id)
         self._reset_page_scales(
             table[self._uploaded_pages.get(seq.seq_id, 0):])
-        row = jax.device_put(self.cache.page_table_row(seq.seq_id))
+        row = self._dput(self.cache.page_table_row(seq.seq_id))
         self._tables = self._row_set_jit(self._tables,
                                          self._lane_ids[lane], row)
         self._uploaded_pages[seq.seq_id] = len(table)
@@ -1197,7 +1317,7 @@ class ServingEngine:
             return
         rows = np.zeros((next_pow2(len(page_ids)),), np.int32)
         rows[: len(page_ids)] = page_ids
-        self._kv = self._scale_reset_jit(self._kv, jax.device_put(rows))
+        self._kv = self._scale_reset_jit(self._kv, self._dput(rows))
 
     def _sync_rows(self, active: List[Tuple[int, "Sequence"]]):
         """Re-upload every device table row whose host allocation grew
@@ -1235,12 +1355,12 @@ class ServingEngine:
             tokens[i] = s.next_token
             pos[i] = s.pos
             tables[i] = self.cache.page_table_row(s.seq_id)
-        self._tokens = jax.device_put(tokens)
-        self._pos = jax.device_put(pos)
-        self._tables = jax.device_put(tables)
+        self._tokens = self._dput(tokens)
+        self._pos = self._dput(pos)
+        self._tables = self._dput(tables)
         self._lanes = active + [None] * (desired - len(active))
         self._state_bucket = desired
-        self._lane_ids = [jax.device_put(np.int32(i))
+        self._lane_ids = [self._dput(np.int32(i))
                           for i in range(desired)]
 
     # --- prefill ----------------------------------------------------------
@@ -1262,8 +1382,8 @@ class ServingEngine:
         if n - start == 0:
             return
         spans = chunk_schedule(n - start, self.prefill_chunk)
-        row = jax.device_put(self.cache.page_table_row(seq.seq_id))
-        n_dev = jax.device_put(np.int32(n))
+        row = self._dput(self.cache.page_table_row(seq.seq_id))
+        n_dev = self._dput(np.int32(n))
         t0 = time.perf_counter()
         with RecordEvent("serving/prefill", chunks=len(spans),
                          prompt_len=int(prompt.size)):
@@ -1277,7 +1397,7 @@ class ServingEngine:
                                      replica=self.chaos_key, size=size)
                 with RecordEvent("serving/prefill_chunk", size=size):
                     self._kv = self._prefill_jit(
-                        jax.device_put(ctok), jax.device_put(cpos),
+                        self._dput(ctok), self._dput(cpos),
                         row, n_dev, self._kv)
             # sync inside the timed window: dispatch is async, and the
             # decode that follows needs this kv anyway — without the
@@ -1384,12 +1504,12 @@ class ServingEngine:
         bucket, so steady decode performs no host transfer at all."""
         ent = self._ragged_steady.get(bucket)
         if ent is None:
-            ent = (jax.device_put(np.zeros((bucket, 1), np.int32)),
-                   jax.device_put(np.zeros((bucket, 1), np.int32)),
-                   jax.device_put(np.full((bucket, 1),
+            ent = (self._dput(np.zeros((bucket, 1), np.int32)),
+                   self._dput(np.zeros((bucket, 1), np.int32)),
+                   self._dput(np.full((bucket, 1),
                                           self._ragged_no_limit,
                                           np.int32)),
-                   jax.device_put(np.ones((bucket,), np.int32)))
+                   self._dput(np.ones((bucket,), np.int32)))
             self._ragged_steady[bucket] = ent
         return ent
 
@@ -1480,10 +1600,20 @@ class ServingEngine:
                         seq.seq_id, EV_PREFILL_CHUNK,
                         replica=self.chaos_key,
                         size=int(chunks[lane][0].size))
-            rows_tok = jax.device_put(rt)
-            rows_pos = jax.device_put(rp)
-            row_valid = jax.device_put(rv)
-            advance = jax.device_put(adv)
+            rows_tok = self._dput(rt)
+            rows_pos = self._dput(rp)
+            row_valid = self._dput(rv)
+            advance = self._dput(adv)
+        if self._mesh_layout is not None:
+            # chaos site ``serving.shard_sync``: the last host boundary
+            # before the mesh-wide sharded dispatch — ``delay`` models a
+            # straggler shard holding the collective back, ``raise``
+            # models a failed cross-shard exchange (the frontend treats
+            # an engine-step exception as a replica crash and fails the
+            # whole mesh replica over, which is exactly the blast
+            # radius of a dead chip in a tp/sp group)
+            chaos_site("serving.shard_sync", key=self.chaos_key)
+            self.metrics.on_shard_step()
         with RecordEvent("serving/ragged_step", bucket=B, rows=Q):
             (_out_rows, out_dec, self._tokens, self._pos,
              self._kv) = self._ragged_jit(
@@ -1526,8 +1656,8 @@ class ServingEngine:
         decode writes diverge privately."""
         src, dst = seq.cow_pair
         self._kv = self._page_cow_jit(self._kv,
-                                      jax.device_put(np.int32(src)),
-                                      jax.device_put(np.int32(dst)))
+                                      self._dput(np.int32(src)),
+                                      self._dput(np.int32(dst)))
         self.prefix_cache.on_cow()
 
     def _seal_prefix(self, seq: Sequence, upto_pos: int):
@@ -1728,12 +1858,12 @@ class ServingEngine:
         here: their junk is inert until overwritten."""
         rows_dev, payload = saved
         self._kv = self._page_put_jit(self._kv, rows_dev, payload)
-        row = jax.device_put(self.cache.page_table_row(seq.seq_id))
+        row = self._dput(self.cache.page_table_row(seq.seq_id))
         for j in range(took):
             self._kv = self._prefill_jit(
-                jax.device_put(np.asarray([inputs[j]], np.int32)),
-                jax.device_put(np.asarray([pos0 + j], np.int32)),
-                row, jax.device_put(np.int32(pos0 + j + 1)), self._kv)
+                self._dput(np.asarray([inputs[j]], np.int32)),
+                self._dput(np.asarray([pos0 + j], np.int32)),
+                row, self._dput(np.int32(pos0 + j + 1)), self._kv)
 
     def _spec_step(self, active) -> Optional[dict]:
         """Attempt one drafter/verifier speculation step.  Returns None
@@ -1817,7 +1947,7 @@ class ServingEngine:
                 if rows:
                     padded = np.zeros((next_pow2(len(rows)),), np.int32)
                     padded[: len(rows)] = rows
-                    rows_dev = jax.device_put(padded)
+                    rows_dev = self._dput(padded)
                     saved[lane] = (rows_dev, self._page_gather_jit(
                         self._kv, rows_dev))
         # [K, bucket] teacher-forcing inputs: row 0 every lane's real
@@ -1837,7 +1967,7 @@ class ServingEngine:
         with RecordEvent("serving/spec_verify", bucket=bucket, steps=K):
             if self._spec_jit is not None:
                 out, self._kv = self._spec_jit(
-                    jax.device_put(draft_mat), self._pos, self._tables,
+                    self._dput(draft_mat), self._pos, self._tables,
                     self._kv)
                 t0 = time.perf_counter()
                 toks = np.asarray(jax.device_get(out))    # [K, bucket]
@@ -1855,9 +1985,9 @@ class ServingEngine:
                 (out_rows, _dec, self._tokens, self._pos,
                  self._kv) = self._ragged_jit(
                     self._tokens, self._pos, self._tables,
-                    jax.device_put(rows_tok), jax.device_put(rows_pos),
-                    jax.device_put(rows_val),
-                    jax.device_put(np.zeros((bucket,), np.int32)),
+                    self._dput(rows_tok), self._dput(rows_pos),
+                    self._dput(rows_val),
+                    self._dput(np.zeros((bucket,), np.int32)),
                     self._kv)
                 self.metrics.on_ragged(spec_rows=K * len(active),
                                        q_bucket=K)
@@ -1917,8 +2047,8 @@ class ServingEngine:
             if s is not None:
                 tokens[i] = s.next_token
                 pos[i] = s.pos
-        self._tokens = jax.device_put(tokens)
-        self._pos = jax.device_put(pos)
+        self._tokens = self._dput(tokens)
+        self._pos = self._dput(pos)
         return {"emitted": emitted, "bucket": bucket,
                 "lanes": len(active)}
 
@@ -2192,6 +2322,11 @@ class ServingEngine:
                 "in_flight": len(self._pending),
                 "state_bucket": self._state_bucket,
                 "numeric_guards": self.numeric_guards,
+                "mesh": (None if self._mesh_layout is None else {
+                    "tp": self._mesh_layout.tp,
+                    "sp": self._mesh_layout.sp,
+                    "devices": self._mesh_layout.size,
+                }),
             },
             "prefix_cache": (
                 self.prefix_cache.stats()

@@ -13,6 +13,14 @@ lanes scatter into it.  Every page-table entry is therefore always a
 valid index — the kernel (ops/pallas_ops/paged_attention.py) needs no
 bounds checks, and the decode step needs no per-lane branching.
 
+Mesh-sharded pools (ISSUE 19) generalize this: with the page dimension
+split over ``sp`` shards, each shard needs its OWN local trash row, so
+the engine passes ``reserved_pages=(0, N/sp, 2N/sp, ...)`` (global page
+``s*(N/sp)`` is shard ``s``'s local row 0 — see
+``text.generation.ServingMeshLayout.reserved_pages``).  Reserved ids
+are simply never placed on the free list; page 0 stays the table-row
+padding value either way.
+
 Allocation is a LIFO free list (O(1) alloc/free, recently-freed pages
 are reused first which keeps the working set dense).  ``stats()``
 reports alloc/free counters, high-water mark, and internal
@@ -120,7 +128,8 @@ def dequantize_kv_page(qpage: np.ndarray, scales: np.ndarray) -> np.ndarray:
 class PagedKVCache:
     """Free-list page allocator + per-sequence page tables."""
 
-    def __init__(self, num_pages: int, page_size: int, pages_per_seq: int):
+    def __init__(self, num_pages: int, page_size: int, pages_per_seq: int,
+                 reserved_pages: Tuple[int, ...] = (0,)):
         if num_pages < 2:
             raise InvalidArgumentError(
                 "num_pages must be >= 2 (page 0 is the "
@@ -131,8 +140,22 @@ class PagedKVCache:
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
         self.pages_per_seq = int(pages_per_seq)
-        # LIFO free list; page 0 excluded (trash page)
-        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        # page 0 is ALWAYS reserved (table-row padding); a mesh-sharded
+        # pool reserves one trash row per sp shard on top of it
+        reserved = {0} | {int(p) for p in reserved_pages}
+        for p in sorted(reserved):
+            if not (0 <= p < self.num_pages):
+                raise InvalidArgumentError(
+                    f"reserved page id {p} out of range "
+                    f"(0..{self.num_pages - 1})")
+        if len(reserved) >= self.num_pages:
+            raise InvalidArgumentError(
+                "reserved_pages leaves no allocatable pages")
+        self.reserved_pages: Tuple[int, ...] = tuple(sorted(reserved))
+        # LIFO free list; reserved pages excluded (trash rows)
+        self._free: List[int] = [p for p in
+                                 range(self.num_pages - 1, 0, -1)
+                                 if p not in reserved]
         self._tables: Dict[str, List[int]] = {}
         # page id -> number of sequence tables containing it (absent =
         # not referenced); a page appears in pages_in_use ONCE however
@@ -159,6 +182,14 @@ class PagedKVCache:
     @property
     def free_pages(self) -> int:
         return len(self._free)
+
+    @property
+    def allocatable_pages(self) -> int:
+        """Pages the allocator can ever hand out: ``num_pages`` minus
+        the reserved trash rows (one classically, sp under a mesh).  The
+        leak invariant closes over THIS — ``pages_in_use + pages_cached
+        + free_pages == allocatable_pages`` always."""
+        return self.num_pages - len(self.reserved_pages)
 
     @property
     def pages_in_use(self) -> int:
@@ -285,10 +316,11 @@ class PagedKVCache:
         if seq_id in self._tables or len(page_ids) > self.pages_per_seq:
             return False
         for page in page_ids:
-            if not (0 < page < self.num_pages):
+            if not (0 < page < self.num_pages) \
+                    or page in self.reserved_pages:
                 raise InvalidArgumentError(
                     f"shared page id {page} out of range (1.."
-                    f"{self.num_pages - 1})")
+                    f"{self.num_pages - 1}) or reserved")
         self._tables[seq_id] = list(int(p) for p in page_ids)
         for page in self._tables[seq_id]:
             self._ref[page] = self._ref.get(page, 0) + 1
@@ -378,7 +410,7 @@ class PagedKVCache:
         """Allocator stats; pass live ``{seq_id: valid_len}`` to also get
         internal fragmentation (allocated slots minus used slots)."""
         out = {
-            "num_pages": self.num_pages - 1,      # allocatable (sans trash)
+            "num_pages": self.allocatable_pages,  # sans reserved trash rows
             "page_size": self.page_size,
             "pages_in_use": self.pages_in_use,
             "pages_cached": self.pages_cached,
@@ -389,7 +421,8 @@ class PagedKVCache:
             "total_shared_maps": self.total_shared_maps,
             "total_cow": self.total_cow,
             "peak_pages_in_use": self.peak_pages_in_use,
-            "utilization": self.pages_in_use / max(self.num_pages - 1, 1),
+            "utilization": self.pages_in_use / max(self.allocatable_pages,
+                                                   1),
         }
         if seq_lens is not None:
             frag = 0

@@ -74,7 +74,12 @@ class ServingMetrics:
               # unified ragged dispatch (ISSUE 18): per-lane query-row
               # bucket (Q) of the most recent ragged step — 1 in steady
               # decode, the chunk bucket while prefill rows ride along
-              "serving.ragged.row_bucket")
+              "serving.ragged.row_bucket",
+              # mesh-sharded serving (ISSUE 19): the engine's mesh shape
+              # — tensor-parallel head shards, sequence-parallel page
+              # shards, and their product (chips per replica)
+              "serving.shard.tp", "serving.shard.sp",
+              "serving.shard.devices")
     COUNTERS = ("serving.steps", "serving.tokens_generated",
                 "serving.requests_admitted", "serving.requests_completed",
                 "serving.preemptions", "serving.prefill_chunks",
@@ -114,7 +119,15 @@ class ServingMetrics:
                 # blocking it) and spec-verify rows (K teacher-forced
                 # positions per speculating lane)
                 "serving.ragged.steps", "serving.ragged.decode_rows",
-                "serving.ragged.prefill_rows", "serving.ragged.spec_rows")
+                "serving.ragged.prefill_rows", "serving.ragged.spec_rows",
+                # mesh-sharded serving (ISSUE 19): ragged dispatches that
+                # ran as one mesh program (every step crosses the
+                # tp/sp collectives), and maintenance traffic that had to
+                # assemble (gather) or re-distribute (scatter) sharded
+                # KV pages through the host — snapshots, tier demotions,
+                # scrubs and restores
+                "serving.shard.steps", "serving.shard.page_gathers",
+                "serving.shard.page_scatters")
     HISTOGRAMS = ("serving.step_latency_ms", "serving.prefill_latency_ms",
                   "serving.decode_latency_ms", "serving.ttft_ms",
                   "serving.dispatch_gap_ms",
@@ -317,6 +330,34 @@ class ServingMetrics:
             stat_registry.get("serving.ragged.row_bucket").set(
                 int(q_bucket))
 
+    # --- mesh-sharded serving (ISSUE 19) -----------------------------------
+    def on_shard_config(self, *, tp: int, sp: int, devices: int):
+        """Published once at engine construction: the replica's mesh
+        shape — ``tp`` head shards × ``sp`` KV-page shards over
+        ``devices`` chips.  Gauged (not counted) so a scrape always
+        reads the live topology."""
+        stat_registry.get("serving.shard.tp").set(int(tp))
+        stat_registry.get("serving.shard.sp").set(int(sp))
+        stat_registry.get("serving.shard.devices").set(int(devices))
+
+    def on_shard_step(self, n: int = 1):
+        """One ragged dispatch executed as a mesh program — its decode
+        matmuls ran head-sharded on ``tp`` and/or its paged attention
+        page-sharded on ``sp``, with the partial-softmax stats exchange
+        inside the step."""
+        stat_registry.get("serving.shard.steps").add(n)
+
+    def on_shard_page_gather(self, n: int = 1):
+        """One maintenance gather assembled sharded KV pages into a
+        host-visible array (snapshot, tier demotion, scrub read) — each
+        is a cross-shard collect the single-chip engine does for free."""
+        stat_registry.get("serving.shard.page_gathers").add(n)
+
+    def on_shard_page_scatter(self, n: int = 1):
+        """One maintenance scatter re-distributed host page payloads
+        across the mesh shards (restore, tier promotion, scrub write)."""
+        stat_registry.get("serving.shard.page_scatters").add(n)
+
     # --- numeric guards (ISSUE 13, docs/SERVING.md "Logit quarantine") -----
     def on_nan_lane(self, n: int = 1):
         """A decode/verify dispatch returned non-finite logits for a
@@ -447,6 +488,10 @@ class ServingMetrics:
                           "spec_rows", "row_bucket")}
         snap["disagg"] = {"shipped_pages": stat_registry.get(
             "serving.disagg.shipped_pages").get()}
+        snap["shard"] = {
+            short: stat_registry.get(f"serving.shard.{short}").get()
+            for short in ("tp", "sp", "devices", "steps",
+                          "page_gathers", "page_scatters")}
         for name in self.HISTOGRAMS:
             h = stat_registry.histogram(name).snapshot()
             key = name[len("serving."):]

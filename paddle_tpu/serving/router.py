@@ -76,7 +76,8 @@ class Replica:
     reacts immediately instead of on its poll timeout.
     """
 
-    def __init__(self, replica_id: str, engine, role: str = "any"):
+    def __init__(self, replica_id: str, engine, role: str = "any",
+                 mesh_size: Optional[int] = None):
         if role not in ("any", "prefill", "decode"):
             from ..framework.errors import InvalidArgumentError
 
@@ -88,6 +89,20 @@ class Replica:
         # disaggregation pool membership (ISSUE 16): "any" serves both
         # pools (the colocated default)
         self.role = role
+        # mesh-sharded serving (ISSUE 19): chips backing this replica —
+        # an N-chip tp/sp replica decodes at ~N× aggregate bandwidth,
+        # so placement normalizes outstanding work by it.  Defaults to
+        # the engine's own mesh size (1 for single-chip engines and for
+        # the bare test doubles that carry no mesh attribute).
+        if mesh_size is None:
+            layout = getattr(engine, "_mesh_layout", None)
+            mesh_size = 1 if layout is None else int(layout.size)
+        if int(mesh_size) < 1:
+            from ..framework.errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                f"replica mesh_size must be >= 1, got {mesh_size}")
+        self.mesh_size = int(mesh_size)
         self.state = HEALTHY
         self.dead_reason = ""
         self.inbox: List = []                # guarded by the frontend lock
@@ -129,6 +144,7 @@ class Replica:
         return {
             "id": self.id,
             "role": self.role,
+            "mesh_size": self.mesh_size,
             "state": self.state,
             "dead_reason": self.dead_reason or None,
             "steps": self.steps,
@@ -180,7 +196,13 @@ class Router:
         choice.  ``role`` restricts the pick to that pool ("any"
         replicas belong to every pool); an empty pool falls back to ALL
         healthy replicas — disaggregation degrades to colocation, never
-        to an outage."""
+        to an outage.
+
+        Mesh normalization (ISSUE 19): the score is outstanding tokens
+        PER CHIP (``outstanding_tokens / mesh_size``) — an N-chip mesh
+        replica decodes at ~N× the single-chip rate, so equal raw
+        backlogs mean the mesh replica finishes sooner; without the
+        divide a mixed fleet would starve its biggest replicas."""
         with self._lock:
             cands = [r for r in self.replicas
                      if r.state == HEALTHY and r is not exclude]
@@ -190,7 +212,8 @@ class Router:
                     cands = pool
             if not cands:
                 return None
-            return min(cands, key=lambda r: (r.outstanding_tokens, r.id))
+            return min(cands, key=lambda r: (
+                r.outstanding_tokens / r.mesh_size, r.id))
 
     def pick_with_retry(self, cost: int = 0,
                         exclude: Optional[Replica] = None,
@@ -306,10 +329,18 @@ class Router:
                            if r.state == HEALTHY
                            and r.role in (stage, "any"))
                 for stage in ("prefill", "decode")}
+            # chip accounting (ISSUE 19): replicas are the routing
+            # unit, chips the capacity unit — an autoscaler sizing a
+            # mixed fleet needs both
+            chips = sum(r.mesh_size for r in self.replicas)
+            healthy_chips = sum(r.mesh_size for r in self.replicas
+                                if r.state == HEALTHY)
         return {
             "healthy_replicas": healthy,
             "suspect_replicas": suspect,
             "total_replicas": len(reps),
+            "total_chips": chips,
+            "healthy_chips": healthy_chips,
             "healthy_by_role": pools,
             "replicas": reps,
         }

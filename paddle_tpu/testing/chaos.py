@@ -69,6 +69,18 @@ Instrumented sites (grep ``chaos_site(`` for the live list)
                       decodes in place on the prefill replica (colocated
                       fallback; the stream is unchanged).  Key: request
                       id.
+``serving.shard_sync``  ServingEngine._dispatch_ragged, before each
+                      mesh-program dispatch (ISSUE 19, mesh engines
+                      only) — ``delay`` models a straggler shard
+                      holding up the step's tp/sp collectives (the
+                      whole replica stalls: one mesh replica is one
+                      failure domain), ``raise`` a failed collective
+                      exchange, which the frontend treats as a
+                      replica crash — the blast radius of losing ONE
+                      chip in an N-chip replica is the full replica,
+                      the exact cost the warm-failover snapshot path
+                      (gather → re-admit elsewhere) bounds.  Key: the
+                      engine's chaos/replica key.
 
 Training-side sites (ISSUE 9 — docs/CHECKPOINT.md "Chaos sites"):
 

@@ -13,6 +13,7 @@ test (tests/test_gpt_generation.py) pins incremental logits to the full
 forward's."""
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Tuple
 
 import jax
@@ -24,7 +25,7 @@ from ..jit.functional import get_state
 __all__ = ["make_gpt_decode_step", "make_gpt_paged_decode_step",
            "make_gpt_paged_prefill_step", "make_gpt_paged_fused_decode_step",
            "make_gpt_paged_spec_verify_step", "make_gpt_paged_ragged_step",
-           "RAGGED_NO_LIMIT", "prefill", "generate"]
+           "RAGGED_NO_LIMIT", "ServingMeshLayout", "prefill", "generate"]
 
 # per-row KV-horizon sentinel for the unified ragged step (ISSUE 18): a
 # decode/spec row carries this instead of a real valid_len, making the
@@ -137,6 +138,322 @@ def _as_layer_scales(kv_scales, L, H):
     return ks, vs
 
 
+# ---------------------------------------------------------------------------
+# Mesh-sharded serving (ISSUE 19): one replica spans tp*sp chips.
+#
+# ``ServingMeshLayout`` is the SpecLayout-style per-parameter-name spec
+# assignment: a frozen layout object mapping every weight name / KV-pool
+# leaf to a PartitionSpec over a named (tp, sp, data) mesh.
+#
+#   tp — HEAD sharding.  qkv/fc1 weights are column-sharded by head, so
+#        each chip projects and attends over H/tp heads against its
+#        head-shard of every KV page ([N, P, H/tp, D] locally); the
+#        per-head context is reassembled with one tiled all-gather and
+#        out_proj/fc2 run replicated.  Every per-element reduction is
+#        the same dot the single-device core computes, so the tp path
+#        is BITWISE identical to the unsharded core — decode just
+#        streams the pools at tp-chip aggregate HBM bandwidth.
+#   sp — SEQUENCE (page-dim) sharding for long contexts.  The page pool
+#        splits along pages ([N/sp, P, H/tp, D] locally): global page p
+#        lives on shard p // (N/sp) at local row p % (N/sp).  Each shard
+#        runs the ragged kernel's partial-softmax form over the pages it
+#        OWNS (ownership-masked) and the shards exchange running-max /
+#        denominator stats in lse space (the ring_attention.py merge):
+#        m = pmax(lse), o = psum(o·e^{lse-m}) / psum(e^{lse-m}).  A
+#        non-owned row scatters into the shard's reserved local trash
+#        row — the allocator reserves global page s·(N/sp) on every
+#        shard s (kv_cache.PagedKVCache reserved_pages).
+# ---------------------------------------------------------------------------
+
+# parameter-name fragments whose weights column-shard over tp (output
+# dim = heads·head_dim for qkv, ffn for fc1); everything else replicates
+_TP_COLUMN_SHARDED = (".attn.q_proj.", ".attn.k_proj.", ".attn.v_proj.",
+                      ".fc1.")
+
+
+@dataclass(frozen=True)
+class ServingMeshLayout:
+    """Sharding layout of one mesh-sized serving replica.
+
+    ``param_spec(name)`` assigns each parameter its PartitionSpec by
+    name (the SpecLayout pattern); ``page_spec``/``scale_spec`` lay out
+    the paged KV pools.  ``size == tp * sp`` chips form the replica.
+    """
+
+    tp: int = 1
+    sp: int = 1
+    tp_axis: str = "tp"
+    sp_axis: str = "sp"
+    data_axis: str = "data"
+
+    def __post_init__(self):
+        if int(self.tp) < 1 or int(self.sp) < 1:
+            raise ValueError(
+                f"mesh degrees must be >= 1, got tp={self.tp} sp={self.sp}")
+
+    @property
+    def size(self) -> int:
+        return int(self.tp) * int(self.sp)
+
+    def axes(self):
+        """Named-mesh axis sizes for ``distributed.mesh.init_mesh``."""
+        return {self.tp_axis: int(self.tp), self.sp_axis: int(self.sp),
+                self.data_axis: 1}
+
+    def param_spec(self, name: str):
+        from jax.sharding import PartitionSpec
+
+        if any(frag in name for frag in _TP_COLUMN_SHARDED):
+            if name.endswith(".weight"):
+                return PartitionSpec(None, self.tp_axis)
+            if name.endswith(".bias"):
+                return PartitionSpec(self.tp_axis)
+        return PartitionSpec()
+
+    def page_spec(self):
+        """[num_pages, P, H, D] pool: pages over sp, heads over tp."""
+        from jax.sharding import PartitionSpec
+
+        return PartitionSpec(self.sp_axis, None, self.tp_axis, None)
+
+    def scale_spec(self):
+        """[num_pages, H] int8 dequant scales ride their pool's split."""
+        from jax.sharding import PartitionSpec
+
+        return PartitionSpec(self.sp_axis, self.tp_axis)
+
+    def kv_spec(self, kv):
+        """PartitionSpec pytree matching a paged-KV pool pytree."""
+        return {key: [self.scale_spec() if key.endswith("_scale")
+                      else self.page_spec() for _ in leaves]
+                for key, leaves in kv.items()}
+
+    def reserved_pages(self, num_pages: int):
+        """Global page ids reserved as per-shard trash rows: shard s's
+        local row 0 is global page s*(num_pages//sp) — non-owned and
+        masked-lane scatters land there, so it can never hold live KV.
+        Degenerates to (0,) (the classic trash page) at sp == 1."""
+        pl = int(num_pages) // int(self.sp)
+        return tuple(s * pl for s in range(int(self.sp)))
+
+
+def _make_gpt_paged_sharded_core(model, page_size: int, pages_per_seq: int,
+                                 layout: ServingMeshLayout, *,
+                                 kv_cache_dtype=None, kv_scales=None,
+                                 weight_quant=None):
+    """Mesh-sharded twin of ``_make_gpt_paged_core`` (ISSUE 19).
+
+    Same ``(core, init_pages)`` contract, but the core is an explicit
+    ``shard_map`` over the layout's (tp, sp, data) mesh: weights enter
+    pre-sharded per ``layout.param_spec``, the KV pools per
+    ``page_spec``/``scale_spec``, and the partial-softmax exchange is
+    spelled out in code (pmax/psum of lse-space stats) rather than left
+    to GSPMD — which is what keeps the tp path bitwise identical to the
+    single-device core and the sp merge auditable.  Serves the unified
+    ragged layout only (``qgroup`` required): the mesh engine always
+    runs ``ragged=True``.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+    from ..distributed import mesh as mesh_lib
+    from ..ops.pallas_ops.paged_attention import (
+        ragged_paged_attention as ragged_paged_attn,
+        ragged_paged_attention_stats as ragged_stats)
+
+    P = PartitionSpec
+    params, _ = get_state(model)
+    L = len(model.layers)
+    H = model.layers[0].attn.num_heads
+    hidden = model.wte.weight.shape[1]
+    D = hidden // H
+    max_pos = params["wpe.weight"].shape[0]
+    tp, sp = int(layout.tp), int(layout.sp)
+    tpn, spn = layout.tp_axis, layout.sp_axis
+    if H % tp:
+        raise ValueError(
+            f"num_heads ({H}) must be divisible by tp ({tp})")
+    H_loc = H // tp
+    quant_kv = kv_cache_dtype == "int8"
+    if kv_cache_dtype not in (None, "int8"):
+        raise ValueError(f"kv_cache_dtype must be None or 'int8', got "
+                         f"{kv_cache_dtype!r}")
+    k_sc, v_sc = _as_layer_scales(kv_scales, L, H)
+    mesh = mesh_lib.init_mesh(layout.axes())
+
+    def put(v, spec_):
+        return jax.device_put(v, NamedSharding(mesh, spec_))
+
+    # weights land on-device PRE-SHARDED (tp column shards for qkv/fc1,
+    # replicated otherwise): the compiled step's input layouts already
+    # match, so no weight movement happens per dispatch — decode streams
+    # each chip's weight shard at that chip's HBM bandwidth
+    params = {name: put(v, layout.param_spec(name))
+              for name, v in params.items()}
+    consts = {"p": params}
+    cspecs = {"p": {name: layout.param_spec(name) for name in params}}
+    if weight_quant:
+        wq, wqs = {}, {}
+        for name, (qv, sv) in weight_quant.items():
+            qspec = layout.param_spec(name)
+            sspec = P(tpn) if qspec != P() else P()
+            wq[name] = (put(jnp.asarray(qv), qspec),
+                        put(jnp.asarray(sv, jnp.float32), sspec))
+            wqs[name] = (qspec, sspec)
+        consts["wq"] = wq
+        cspecs["wq"] = wqs
+    if k_sc is not None:
+        consts["ksc"] = [put(a, P(tpn)) for a in k_sc]
+        consts["vsc"] = [put(a, P(tpn)) for a in v_sc]
+        cspecs["ksc"] = [P(tpn)] * L
+        cspecs["vsc"] = [P(tpn)] * L
+
+    def init_pages(num_pages: int):
+        if num_pages % sp:
+            raise ValueError(
+                f"num_pages ({num_pages}) must be divisible by sp ({sp})")
+
+        def z():
+            dt = jnp.int8 if quant_kv else params["wte.weight"].dtype
+            return put(jnp.zeros((num_pages, page_size, H, D), dt),
+                       layout.page_spec())
+
+        kv = {"k": [z() for _ in range(L)], "v": [z() for _ in range(L)]}
+        if quant_kv:
+            def sc(static):
+                from ..serving.kv_cache import KV_SCALE_EPS
+
+                if static is None:
+                    arr = jnp.full((num_pages, H), KV_SCALE_EPS,
+                                   jnp.float32)
+                else:
+                    arr = jnp.broadcast_to(
+                        static[None, :],
+                        (num_pages, H)).astype(jnp.float32) + 0
+                return put(arr, layout.scale_spec())
+            kv["k_scale"] = [sc(k_sc[i] if k_sc else None)
+                             for i in range(L)]
+            kv["v_scale"] = [sc(v_sc[i] if v_sc else None)
+                             for i in range(L)]
+        return kv
+
+    def core(tokens, pos, page_tables, kv, valid_len=None, with_head=True,
+             qgroup=None):
+        if qgroup is None:
+            raise NotImplementedError(
+                "the mesh-sharded paged core serves the unified ragged "
+                "layout only (the mesh engine runs ragged=True)")
+        has_vl = valid_len is not None
+        Q = int(qgroup)
+
+        def body(consts_l, tokens, pos, page_tables, vlen, kv_l):
+            pl_ = consts_l["p"]
+            mm = _make_mm(pl_, consts_l.get("wq"))
+            ksc_l = consts_l.get("ksc")
+            vsc_l = consts_l.get("vsc")
+            sp_i = jax.lax.axis_index(spn)
+            pages_local = kv_l["k"][0].shape[0]
+
+            def lpl(i, name):
+                return pl_[f"layers.{i}.{name}"]
+
+            N = tokens.shape[0]
+            row_tables = jnp.repeat(page_tables, Q, axis=0)
+            pos_c = jnp.minimum(pos, max_pos - 1)
+            x = pl_["wte.weight"][tokens] + pl_["wpe.weight"][pos_c]
+            page_of = jnp.minimum(pos // page_size, pages_per_seq - 1)
+            page_idx = jnp.take_along_axis(row_tables, page_of[:, None],
+                                           axis=1)[:, 0]
+            slot = pos % page_size
+            seq_lens = pos + 1
+            if has_vl:
+                page_idx = jnp.where(pos < vlen, page_idx, 0)
+                seq_lens = jnp.minimum(seq_lens, vlen)
+            # global -> shard-local page ids: a non-owned row scatters
+            # into this shard's reserved trash row (local 0, a global
+            # reserved page) and attention masks pages by OWNERSHIP, so
+            # each chip holds and streams 1/sp of every sequence's KV
+            owner = (page_idx // pages_local) == sp_i
+            local_idx = jnp.where(owner, page_idx % pages_local, 0)
+            G = N // Q
+            pt_owner = (page_tables // pages_local) == sp_i
+            pt_local = jnp.where(pt_owner, page_tables % pages_local, 0)
+            ks, vs, ksc_out, vsc_out = [], [], [], []
+            for i in range(L):
+                h = _ln(x, lpl(i, "ln1.weight"), lpl(i, "ln1.bias"))
+                q = (mm(h, f"layers.{i}.attn.q_proj.weight")
+                     + lpl(i, "attn.q_proj.bias")).reshape(N, H_loc, D)
+                k1 = (mm(h, f"layers.{i}.attn.k_proj.weight")
+                      + lpl(i, "attn.k_proj.bias")).reshape(N, H_loc, D)
+                v1 = (mm(h, f"layers.{i}.attn.v_proj.weight")
+                      + lpl(i, "attn.v_proj.bias")).reshape(N, H_loc, D)
+                if quant_kv:
+                    kc, ksc = _quant_write_page(
+                        kv_l["k"][i], kv_l["k_scale"][i], local_idx, slot,
+                        k1, ksc_l[i] if ksc_l else None)
+                    vc, vsc = _quant_write_page(
+                        kv_l["v"][i], kv_l["v_scale"][i], local_idx, slot,
+                        v1, vsc_l[i] if vsc_l else None)
+                    ksc_out.append(ksc)
+                    vsc_out.append(vsc)
+                    scales = (ksc, vsc)
+                else:
+                    kc = kv_l["k"][i].at[local_idx, slot].set(k1)
+                    vc = kv_l["v"][i].at[local_idx, slot].set(v1)
+                    scales = ()
+                qg = q.reshape(G, Q, H_loc, D)
+                sl = seq_lens.reshape(G, Q)
+                if sp == 1:
+                    ctx_l = ragged_paged_attn(qg, kc, vc, pt_local, sl,
+                                              *scales)
+                else:
+                    # partial-softmax exchange: each shard reduces over
+                    # its OWNED pages only, then the running-max /
+                    # denominator stats merge across sp in lse space
+                    # (the ring_attention.py recipe)
+                    o, lse = ragged_stats(qg, kc, vc, pt_local, sl,
+                                          pt_owner, *scales)
+                    mx = jax.lax.pmax(lse, spn)
+                    w = jnp.exp(lse - mx)
+                    num = jax.lax.psum(o * w[..., None], spn)
+                    den = jax.lax.psum(w, spn)
+                    ctx_l = num / jnp.maximum(den, 1e-30)[..., None]
+                ctx_l = ctx_l.reshape(N, H_loc, D)
+                if tp > 1:
+                    ctx = jax.lax.all_gather(ctx_l, tpn, axis=1,
+                                             tiled=True)
+                else:
+                    ctx = ctx_l
+                ks.append(kc)
+                vs.append(vc)
+                x = x + (mm(ctx.reshape(N, hidden),
+                            f"layers.{i}.attn.out_proj.weight")
+                         + lpl(i, "attn.out_proj.bias"))
+                h2 = _ln(x, lpl(i, "ln2.weight"), lpl(i, "ln2.bias"))
+                ff = _gelu(mm(h2, f"layers.{i}.fc1.weight")
+                           + lpl(i, "fc1.bias"))
+                if tp > 1:
+                    ff = jax.lax.all_gather(ff, tpn, axis=1, tiled=True)
+                x = x + mm(ff, f"layers.{i}.fc2.weight") + lpl(i, "fc2.bias")
+            kv_out = {"k": ks, "v": vs}
+            if quant_kv:
+                kv_out["k_scale"] = ksc_out
+                kv_out["v_scale"] = vsc_out
+            if not with_head:
+                return kv_out
+            x = _ln(x, pl_["ln_f.weight"], pl_["ln_f.bias"])
+            return x @ pl_["wte.weight"].T, kv_out
+
+        kvs = layout.kv_spec(kv)
+        in_specs = (cspecs, P(), P(), P(), P(), kvs)
+        out_specs = (P(), kvs) if with_head else kvs
+        f = mesh_lib.shard_map(body, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs)
+        vlen = valid_len if has_vl else jnp.zeros((), jnp.int32)
+        out = f(consts, tokens, pos, page_tables, vlen, kv)
+        return out if with_head else (None, out)
+
+    return core, init_pages
+
+
 def make_gpt_decode_step(model, max_len: int, *, kv_cache_dtype=None,
                          kv_scales=None, weight_quant=None):
     """Build (step_fn, init_state) for a GPTModel.
@@ -243,8 +560,12 @@ def make_gpt_decode_step(model, max_len: int, *, kv_cache_dtype=None,
 
 def _make_gpt_paged_core(model, page_size: int, pages_per_seq: int, *,
                          kv_cache_dtype=None, kv_scales=None,
-                         weight_quant=None):
+                         weight_quant=None, mesh_layout=None):
     """Shared paged-KV transformer core behind the serving step builders.
+
+    ``mesh_layout`` (a ``ServingMeshLayout`` spanning > 1 chip) swaps in
+    the mesh-sharded twin ``_make_gpt_paged_sharded_core`` — same
+    contract, weights/pools sharded over the named (tp, sp, data) mesh.
 
     Returns ``(core, init_pages)`` where ``core(tokens [N], pos [N],
     page_tables [N, M], kv, valid_len=None, with_head=True)`` runs one
@@ -277,6 +598,11 @@ def _make_gpt_paged_core(model, page_size: int, pages_per_seq: int, *,
     page's scales when it is reallocated).  ``weight_quant`` routes the
     projection/MLP matmuls through the weight-only int8 kernel.
     """
+    if mesh_layout is not None and mesh_layout.size > 1:
+        return _make_gpt_paged_sharded_core(
+            model, page_size, pages_per_seq, mesh_layout,
+            kv_cache_dtype=kv_cache_dtype, kv_scales=kv_scales,
+            weight_quant=weight_quant)
     from ..ops.pallas_ops.paged_attention import paged_attention as paged_attn
     from ..ops.pallas_ops.paged_attention import (
         ragged_paged_attention as ragged_paged_attn)
@@ -627,7 +953,8 @@ def make_gpt_paged_spec_verify_step(model, page_size: int,
 
 def make_gpt_paged_ragged_step(model, page_size: int, pages_per_seq: int, *,
                                kv_cache_dtype=None, kv_scales=None,
-                               weight_quant=None, with_guard: bool = False):
+                               weight_quant=None, with_guard: bool = False,
+                               mesh_layout=None):
     """Unified ragged step (ISSUE 18): ONE device program carries a mixed
     batch of {steady-decode, chunked-prefill, spec-verify} lanes, each
     lane a group of Q query rows against its single page-table row, so
@@ -661,10 +988,16 @@ def make_gpt_paged_ragged_step(model, page_size: int, pages_per_seq: int, *,
     rule reads it), ``out_dec`` its row-0 column (the decode stream).
     ``with_guard=True`` negative-packs non-finite rows in-band, exactly
     like the split programs; the clean argmax still feeds device state.
+
+    ``mesh_layout`` (ISSUE 19) builds the step over the mesh-sharded
+    core: same host-visible contract, device state sharded per the
+    layout — the engine's one-mixed-batch-program-per-step dispatch
+    drives tp*sp chips.
     """
     core, init_pages = _make_gpt_paged_core(
         model, page_size, pages_per_seq, kv_cache_dtype=kv_cache_dtype,
-        kv_scales=kv_scales, weight_quant=weight_quant)
+        kv_scales=kv_scales, weight_quant=weight_quant,
+        mesh_layout=mesh_layout)
 
     def ragged_fn(state_tok, state_pos, page_tables, rows_tok, rows_pos,
                   row_valid, advance, kv):

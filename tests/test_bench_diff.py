@@ -258,6 +258,23 @@ class TestFailOnRegression:
         assert not bench_diff.lower_is_better("serving.ragged.steps")
         assert not bench_diff.lower_is_better(
             "serving.ragged.decode_rows")
+        # mesh-sharded serving section (ISSUE 19): the scaling-curve
+        # throughputs gate DOWNWARD ("per_sec" outranks the new "shard"
+        # fragment on collision), TTFT/ITL-vs-context latencies and the
+        # shard-sync / maintenance gather-scatter costs gate UPWARD
+        assert not bench_diff.lower_is_better(
+            "detail.mesh.scaling.tp2.tokens_per_sec")
+        assert not bench_diff.lower_is_better(
+            "detail.mesh.scaling.tp2.speedup_x")
+        assert bench_diff.lower_is_better(
+            "detail.mesh.context.sp2.ttft_ms")
+        assert bench_diff.lower_is_better(
+            "detail.mesh.context.sp2.itl_ms_p95")
+        assert bench_diff.lower_is_better("detail.mesh.shard_sync_ms")
+        assert bench_diff.lower_is_better("serving.shard.page_gathers")
+        assert bench_diff.lower_is_better("serving.shard.page_scatters")
+        assert bench_diff.lower_is_better(
+            "detail.mesh.snapshot_gather_ms")
 
     def test_reduction_ratio_gates_on_drop_not_rise(self):
         """The PR-4 acceptance metric: kv_bytes_reduction_x falling

@@ -136,13 +136,19 @@ def _drive_staggered(eng, prompts, budgets, arrivals):
 
 
 class TestAsyncTokenIdentity:
-    def test_64_staggered_poisson_async_equals_sync_and_generate(self, gpt):
-        """The acceptance scenario: 64 Poisson arrivals, mixed prompt
-        lengths, a KV cache tight enough to force preemption; pipelined
-        (+ fused K-step) output must equal the synchronous engine's
-        byte for byte, and generate(greedy) on reference groups."""
+    @pytest.mark.parametrize(
+        "n", [24, pytest.param(64, marks=pytest.mark.slow)])
+    def test_staggered_poisson_async_equals_sync_and_generate(self, gpt,
+                                                              n):
+        """The acceptance scenario: staggered Poisson arrivals, mixed
+        prompt lengths, a KV cache tight enough to force preemption;
+        pipelined (+ fused K-step) output must equal the synchronous
+        engine's byte for byte, and generate(greedy) on reference
+        groups.  Tier-1 drives 24 arrivals (preemption still forced —
+        asserted below); the full 64-request soak is the slow-tier
+        variant (ISSUE 6-style suite health: it was tier-1's single
+        slowest test at ~19s on the 1-CPU driver)."""
         rng = np.random.RandomState(7)
-        n = 64
         lens = [1, 4, 9, 16]
         plens = [lens[i % len(lens)] for i in range(n)]
         budgets = [6] * n

@@ -203,7 +203,17 @@ _LOWER_BETTER = ("_ms", "latency", "ttft", "e2e", "gap", "miss", "bytes",
                  # didn't used to (attainment / budget_remaining need
                  # no fragment — unmatched paths already gate downward
                  # as bigger-is-better; burn rates ride "_rate")
-                 "alert")
+                 "alert",
+                 # mesh-sharded serving (ISSUE 19): shard-sync stalls /
+                 # exchange overhead and host-side page gathers/scatters
+                 # (maintenance traffic that assembles sharded pools
+                 # through the host) rising on a fixed workload mean the
+                 # mesh is paying more for its collectives — the
+                 # tokens/s-vs-chips and TTFT/ITL-vs-context headline
+                 # curves ride the pre-existing "per_sec"/"_ms"
+                 # fragments, which also outrank these on collision
+                 # (shard_tokens_per_sec gates downward-is-worse)
+                 "shard", "gather", "scatter")
 
 
 def lower_is_better(metric: str) -> bool:
